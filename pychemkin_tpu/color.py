@@ -1,0 +1,40 @@
+"""ANSI color helpers (reference: src/ansys/chemkin/color.py:24-83)."""
+
+from __future__ import annotations
+
+import sys
+
+
+class Color:
+    """ANSI escape fragments used to compose colored log/terminal messages."""
+
+    RESET = "\033[0m"
+    BOLD = "\033[1m"
+    UNDERLINE = "\033[4m"
+    BLACK = "\033[30m"
+    RED = "\033[31m"
+    GREEN = "\033[32m"
+    YELLOW = "\033[33m"
+    BLUE = "\033[34m"
+    MAGENTA = "\033[35m"
+    CYAN = "\033[36m"
+    WHITE = "\033[37m"
+    BRIGHT_RED = "\033[91m"
+    BRIGHT_GREEN = "\033[92m"
+    BRIGHT_YELLOW = "\033[93m"
+    BRIGHT_BLUE = "\033[94m"
+    BRIGHT_MAGENTA = "\033[95m"
+    BRIGHT_CYAN = "\033[96m"
+
+    # Semantic aliases used throughout the package (mirrors reference usage).
+    ERROR = BRIGHT_RED
+    WARNING = BRIGHT_YELLOW
+    INFO = BRIGHT_CYAN
+    OK = BRIGHT_GREEN
+
+
+def ckprint(*fragments: str, end: str = "\n", file=None) -> None:
+    """Print pre-colored fragments and always reset the terminal state
+    (reference: color.py:63-83)."""
+    out = file if file is not None else sys.stdout
+    print("".join(str(f) for f in fragments) + Color.RESET, end=end, file=out)

@@ -1,0 +1,60 @@
+"""Physical constants and canonical recipes (CGS units).
+
+TPU-native re-implementation of the reference's constants module
+(reference: src/ansys/chemkin/constants.py:26-121). All values are CGS —
+the unit system the reference locks in at import time
+(reference: src/ansys/chemkin/__init__.py:106).
+"""
+
+from __future__ import annotations
+
+import math
+
+# --- fundamental constants (CGS) -------------------------------------------
+#: Boltzmann constant [erg/K]
+BOLTZMANN = 1.380649e-16
+#: Avogadro's number [1/mol]
+AVOGADRO = 6.02214076e23
+#: universal gas constant [erg/(mol K)]
+R_GAS = BOLTZMANN * AVOGADRO  # 8.31446261815324e7
+#: universal gas constant [cal/(mol K)] — Arrhenius activation energies are cal/mol
+R_CAL = 1.987204258640832
+#: standard atmosphere [dyne/cm^2]
+P_ATM = 1.01325e6
+#: standard gravity [cm/s^2]
+G_GRAV = 980.665
+#: speed of light [cm/s]
+C_LIGHT = 2.99792458e10
+#: Planck constant [erg s]
+PLANCK = 6.62607015e-27
+#: Stefan-Boltzmann constant [erg/(cm^2 s K^4)]
+STEFAN_BOLTZMANN = 5.670374419e-5
+#: standard temperature [K]
+T_STD = 298.15
+#: calories per joule conversion
+CAL_PER_JOULE = 1.0 / 4.184
+#: erg per calorie
+ERG_PER_CAL = 4.184e7
+
+# --- canonical air recipes (reference: constants.py:44-61) ------------------
+#: Mole-fraction air recipe (simplified 2-component air).
+Air = {"O2": 0.21, "N2": 0.79}
+#: Mole-fraction air recipe including argon.
+air = {"O2": 0.2095, "N2": 0.7808, "AR": 0.0093, "CO2": 0.0004}
+
+
+def water_heat_vaporization(temperature: float) -> float:
+    """Latent heat of vaporization of water [erg/g] at ``temperature`` [K].
+
+    Watson-style correlation anchored at the normal boiling point
+    (reference: constants.py:78-121). Valid between the triple point and
+    the critical point (647.096 K); returns 0 above critical.
+    """
+    t_crit = 647.096
+    if temperature >= t_crit:
+        return 0.0
+    # latent heat at the normal boiling point, 2256.4 J/g
+    h_vap_nbp = 2256.4e7  # erg/g
+    t_nbp = 373.15
+    tr = (t_crit - temperature) / (t_crit - t_nbp)
+    return h_vap_nbp * math.pow(max(tr, 0.0), 0.38)

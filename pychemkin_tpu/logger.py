@@ -1,0 +1,87 @@
+"""Singleton logger with the reference's surface
+(reference: src/ansys/chemkin/logger.py:32-127).
+
+Default level is ERROR; ``enable_output`` attaches a stream handler,
+``add_file_handler`` writes to ``./.log/chemkin_service.log``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+class SingletonType(type):
+    """Metaclass making every instantiation return the same object
+    (reference: logger.py:32-42)."""
+
+    _instances: dict = {}
+
+    def __call__(cls, *args, **kwargs):
+        if cls not in cls._instances:
+            cls._instances[cls] = super().__call__(*args, **kwargs)
+        return cls._instances[cls]
+
+
+class ChemkinLogger(metaclass=SingletonType):
+    """Thin wrapper over :mod:`logging` (reference: logger.py:44-127)."""
+
+    def __init__(self) -> None:
+        self._logger = logging.getLogger("pychemkin_tpu")
+        self._logger.setLevel(logging.ERROR)
+        self._stream_handler: logging.Handler | None = None
+        self._file_handler: logging.Handler | None = None
+
+    # -- level control -------------------------------------------------------
+    def set_level(self, level) -> None:
+        if isinstance(level, str):
+            level = getattr(logging, level.upper())
+        self._logger.setLevel(level)
+
+    def get_level(self) -> int:
+        return self._logger.level
+
+    # -- handlers ------------------------------------------------------------
+    def enable_output(self, stream=None) -> None:
+        if self._stream_handler is None:
+            handler = logging.StreamHandler(stream)
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s [%(levelname)s] %(message)s")
+            )
+            self._logger.addHandler(handler)
+            self._stream_handler = handler
+
+    def disable_output(self) -> None:
+        if self._stream_handler is not None:
+            self._logger.removeHandler(self._stream_handler)
+            self._stream_handler = None
+
+    def add_file_handler(self, logdir: str = "./.log") -> None:
+        if self._file_handler is None:
+            os.makedirs(logdir, exist_ok=True)
+            handler = logging.FileHandler(os.path.join(logdir, "chemkin_service.log"))
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s [%(levelname)s] %(message)s")
+            )
+            self._logger.addHandler(handler)
+            self._file_handler = handler
+
+    # -- passthroughs --------------------------------------------------------
+    def debug(self, msg, *args, **kwargs):
+        self._logger.debug(msg, *args, **kwargs)
+
+    def info(self, msg, *args, **kwargs):
+        self._logger.info(msg, *args, **kwargs)
+
+    def warning(self, msg, *args, **kwargs):
+        self._logger.warning(msg, *args, **kwargs)
+
+    def error(self, msg, *args, **kwargs):
+        self._logger.error(msg, *args, **kwargs)
+
+    def critical(self, msg, *args, **kwargs):
+        self._logger.critical(msg, *args, **kwargs)
+
+
+#: module-level singleton, mirroring ``from ansys.chemkin.logger import logger``
+logger = ChemkinLogger()

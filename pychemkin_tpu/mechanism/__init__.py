@@ -1,0 +1,51 @@
+"""Mechanism toolchain: CHEMKIN-format parsing into immutable JAX pytrees.
+
+Replaces the reference's native preprocessor (``KINPreProcess``,
+reference: chemkin_wrapper.py:303) and its linking-file workspace.
+"""
+
+import os
+
+from .parser import (
+    MechanismError,
+    MechanismParser,
+    load_mechanism,
+    load_mechanism_from_strings,
+    parse_thermo_file,
+    parse_transport_file,
+)
+from .record import MechanismRecord
+
+#: directory of embedded mechanism fixtures (the reference relies on
+#: mechanism data from the Ansys install, which is not redistributable)
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+def load_embedded(name: str) -> MechanismRecord:
+    """Load an embedded mechanism fixture by name.
+
+    Available: ``"h2o2"`` (GRI-3.0-derived H2/O2/N2/AR subsystem, with
+    transport data), ``"grisyn"`` (synthetic GRI-3.0-sized perf fixture).
+    """
+    if name == "h2o2":
+        return load_mechanism(
+            os.path.join(DATA_DIR, "h2o2.inp"),
+            transport_path=os.path.join(DATA_DIR, "tran_h2o2.dat"),
+        )
+    if name == "grisyn":
+        return load_mechanism(os.path.join(DATA_DIR, "grisyn.inp"))
+    raise ValueError(f"unknown embedded mechanism {name!r}; "
+                     "available: 'h2o2', 'grisyn'")
+
+
+__all__ = [
+    "DATA_DIR",
+    "MechanismError",
+    "MechanismParser",
+    "MechanismRecord",
+    "load_embedded",
+    "load_mechanism",
+    "load_mechanism_from_strings",
+    "parse_thermo_file",
+    "parse_transport_file",
+]

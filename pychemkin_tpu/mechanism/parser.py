@@ -1,0 +1,935 @@
+"""CHEMKIN-format mechanism parser.
+
+Pure-Python replacement for the reference's native preprocessor
+(``KINPreProcess`` — reference: chemkin_wrapper.py:303, called from
+chemistry.py:675). Parses:
+
+- mechanism files (``chem.inp``): ELEMENTS / SPECIES / THERMO / REACTIONS blocks
+  with Arrhenius lines, DUP, REV, LOW, TROE, SRI, PLOG, third-body efficiencies,
+  ``+M`` / ``(+M)`` / specific-collider ``(+SP)`` notation, unit declarations
+  (CAL/MOLE, KCAL/MOLE, JOULES/MOLE, KJOULES/MOLE, KELVINS, EVOLTS, MOLES,
+  MOLECULES),
+- NASA-7 thermodynamic databases (``therm.dat``, fixed-column, two T ranges),
+- transport databases (``tran.dat``: geometry, LJ eps/k, sigma, dipole,
+  polarizability, Zrot).
+
+Emits a :class:`~pychemkin_tpu.mechanism.record.MechanismRecord` of dense
+numpy arrays ready for the JAX kernels. Instead of the reference's linking
+files (``chem.asc``/``Summary.out``), the record itself is the artifact.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..constants import AVOGADRO, P_ATM, R_CAL
+from ..logger import logger
+from .record import (
+    FALLOFF_CHEM_ACT,
+    FALLOFF_LINDEMANN,
+    FALLOFF_NONE,
+    FALLOFF_SRI,
+    FALLOFF_TROE,
+    TB_MIXTURE,
+    TB_NONE,
+    TB_SPECIES,
+    MechanismRecord,
+)
+
+# --- standard atomic weights [g/mol] ---------------------------------------
+ATOMIC_WEIGHTS = {
+    "H": 1.008, "D": 2.014, "T": 3.016, "HE": 4.002602, "LI": 6.94,
+    "BE": 9.0121831, "B": 10.81, "C": 12.011, "N": 14.007, "O": 15.999,
+    "F": 18.998403163, "NE": 20.1797, "NA": 22.98976928, "MG": 24.305,
+    "AL": 26.9815385, "SI": 28.085, "P": 30.973761998, "S": 32.06,
+    "CL": 35.45, "AR": 39.948, "K": 39.0983, "CA": 40.078, "TI": 47.867,
+    "CR": 51.9961, "MN": 54.938044, "FE": 55.845, "NI": 58.6934,
+    "CU": 63.546, "ZN": 65.38, "BR": 79.904, "KR": 83.798, "ZR": 91.224,
+    "MO": 95.95, "RH": 102.90550, "PD": 106.42, "AG": 107.8682,
+    "CD": 112.414, "SN": 118.71, "I": 126.90447, "XE": 131.293,
+    "BA": 137.327, "W": 183.84, "PT": 195.084, "AU": 196.966569,
+    "PB": 207.2, "U": 238.02891, "E": 5.48579909e-4,
+}
+
+
+class MechanismError(RuntimeError):
+    """Raised on malformed mechanism input. The reference's uniform error style
+    is log-and-``exit()`` (e.g. chemistry.py:614); here we raise instead so a
+    batch of parses cannot take the process down (SURVEY §5 rebuild note)."""
+
+
+@dataclass
+class _ReactionDraft:
+    equation: str
+    reactants: list  # [(species_index, coeff)]
+    products: list
+    reversible: bool
+    A: float
+    beta: float
+    Ea: float  # in declared units, converted at finalize
+    tb_type: int = TB_NONE
+    tb_collider: int = -1  # species index for TB_SPECIES
+    efficiencies: dict = field(default_factory=dict)
+    falloff_type: int = FALLOFF_NONE
+    low: tuple | None = None
+    high: tuple | None = None  # for chemically-activated (HIGH keyword)
+    troe: tuple | None = None
+    sri: tuple | None = None
+    rev: tuple | None = None
+    plog: list = field(default_factory=list)  # [(P_atm, A, beta, Ea)]
+    duplicate: bool = False
+    ford: dict = field(default_factory=dict)  # species_index -> order override
+
+
+def _strip_comment(line: str) -> str:
+    for marker in ("!",):
+        idx = line.find(marker)
+        if idx >= 0:
+            line = line[:idx]
+    return line.rstrip("\n")
+
+
+_NUM_RE = re.compile(r"^[+-]?(\d+\.?\d*|\.\d+)([eEdD][+-]?\d+)?$")
+
+
+def _to_float(tok: str) -> float:
+    return float(tok.replace("d", "e").replace("D", "E"))
+
+
+def _is_number(tok: str) -> bool:
+    return bool(_NUM_RE.match(tok.strip()))
+
+
+# ---------------------------------------------------------------------------
+# THERMO database
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ThermoEntry:
+    name: str
+    composition: dict
+    t_low: float
+    t_mid: float
+    t_high: float
+    coeffs_low: np.ndarray   # [7]
+    coeffs_high: np.ndarray  # [7]
+    phase: str = "G"
+
+
+def _parse_thermo_composition(line1: str) -> dict:
+    """Elemental composition from fixed columns 25-44 (+ optional 74-78)."""
+    comp: dict = {}
+    fields = [line1[24:29], line1[29:34], line1[34:39], line1[39:44]]
+    if len(line1) > 73:
+        fields.append(line1[73:78])
+    for f in fields:
+        if len(f) < 3:
+            continue
+        elem = f[:2].strip().upper()
+        cnt = f[2:].strip()
+        if not elem or elem == "0":
+            continue
+        try:
+            n = float(cnt) if cnt else 0.0
+        except ValueError:
+            continue
+        if n != 0:
+            comp[elem] = comp.get(elem, 0.0) + n
+    return comp
+
+
+def parse_thermo_block(lines: list, default_ranges=(300.0, 1000.0, 5000.0)) -> dict:
+    """Parse the body of a THERMO block / therm.dat file into
+    {SPECIES: ThermoEntry}. ``lines`` excludes the THERMO keyword itself."""
+    entries: dict = {}
+    t_lo_g, t_mid_g, t_hi_g = default_ranges
+    i = 0
+    # optional global range line: three floats
+    while i < len(lines) and not _strip_comment(lines[i]).strip():
+        i += 1
+    if i < len(lines):
+        toks = _strip_comment(lines[i]).split()
+        if len(toks) == 3 and all(_is_number(t) for t in toks):
+            t_lo_g, t_mid_g, t_hi_g = (_to_float(t) for t in toks)
+            i += 1
+    while i < len(lines):
+        raw = lines[i]
+        line = _strip_comment(raw)
+        if not line.strip():
+            i += 1
+            continue
+        if line.strip().upper() in ("END", "THERMO", "THERMO ALL"):
+            i += 1
+            continue
+        # need 4 card lines
+        if i + 3 >= len(lines):
+            break
+        l1, l2, l3, l4 = lines[i], lines[i + 1], lines[i + 2], lines[i + 3]
+        if len(l1) < 45:
+            i += 1
+            continue
+        name = l1[:18].split()[0].upper() if l1[:18].split() else ""
+        if not name:
+            i += 1
+            continue
+        comp = _parse_thermo_composition(l1)
+        phase = l1[44:45].strip() or "G"
+
+        def _col_float(s, default):
+            s = s.strip()
+            if not s:
+                return default
+            try:
+                return _to_float(s)
+            except ValueError:
+                return default
+
+        t_low = _col_float(l1[45:55], t_lo_g)
+        t_high = _col_float(l1[55:65], t_hi_g)
+        t_mid = _col_float(l1[65:73], t_mid_g)
+
+        def _coeffs(line, n):
+            out = []
+            for j in range(n):
+                seg = line[15 * j:15 * (j + 1)]
+                out.append(_to_float(seg) if seg.strip() else 0.0)
+            return out
+
+        try:
+            c = _coeffs(l2, 5) + _coeffs(l3, 5) + _coeffs(l4, 4)
+        except ValueError as exc:
+            raise MechanismError(
+                f"bad THERMO coefficient card for species {name!r}: {exc}"
+            ) from exc
+        coeffs_high = np.array(c[0:7])
+        coeffs_low = np.array(c[7:14])
+        entries[name] = ThermoEntry(
+            name=name, composition=comp, t_low=t_low, t_mid=t_mid,
+            t_high=t_high, coeffs_low=coeffs_low, coeffs_high=coeffs_high,
+            phase=phase,
+        )
+        i += 4
+    return entries
+
+
+def parse_thermo_file(path: str) -> dict:
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    # drop leading THERMO keyword line if present
+    body = []
+    for ln in lines:
+        if _strip_comment(ln).strip().upper().startswith("THERMO"):
+            continue
+        body.append(ln)
+    return parse_thermo_block(body)
+
+
+# ---------------------------------------------------------------------------
+# Transport database
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TransportEntry:
+    name: str
+    geom: int
+    eps_k: float
+    sigma: float
+    dipole: float
+    polar: float
+    zrot: float
+
+
+def parse_transport_block(lines: list) -> dict:
+    entries: dict = {}
+    for raw in lines:
+        line = _strip_comment(raw).strip()
+        if not line or line.upper() == "END":
+            continue
+        toks = line.split()
+        if len(toks) < 7 or not all(_is_number(t) for t in toks[1:7]):
+            continue
+        entries[toks[0].upper()] = TransportEntry(
+            name=toks[0].upper(), geom=int(float(toks[1])),
+            eps_k=_to_float(toks[2]), sigma=_to_float(toks[3]),
+            dipole=_to_float(toks[4]), polar=_to_float(toks[5]),
+            zrot=_to_float(toks[6]),
+        )
+    return entries
+
+
+def parse_transport_file(path: str) -> dict:
+    with open(path) as fh:
+        return parse_transport_block(fh.read().splitlines())
+
+
+# ---------------------------------------------------------------------------
+# Reaction equation parsing
+# ---------------------------------------------------------------------------
+
+_ARROWS = ("<=>", "=>", "=")
+
+
+def _split_equation(eq: str):
+    """Return (lhs, rhs, reversible)."""
+    if "<=>" in eq:
+        l, r = eq.split("<=>", 1)
+        return l, r, True
+    if "=>" in eq:
+        l, r = eq.split("=>", 1)
+        return l, r, False
+    if "=" in eq:
+        l, r = eq.split("=", 1)
+        return l, r, True
+    raise MechanismError(f"no arrow found in reaction equation: {eq!r}")
+
+
+_FALLOFF_RE = re.compile(r"\(\+\s*([A-Za-z0-9_()\-*',.]+?)\s*\)\s*$")
+
+
+def _parse_side(side: str, species_map: dict, eq: str):
+    """Parse one side of a reaction equation.
+
+    Returns (terms, tb_type, collider_index) where terms = [(k_index, coeff)].
+    Handles ``+M``, ``(+M)``, ``(+SPECIES)`` and numeric stoichiometric
+    prefixes (``2H2O``, ``0.5O2``). Species whose names themselves contain
+    ``+`` are resolved by greedy longest-match re-joining.
+    """
+    side = side.strip()
+    tb_type = TB_NONE
+    collider = -1
+    m = _FALLOFF_RE.search(side)
+    if m:
+        name = m.group(1).upper()
+        side = side[: m.start()].strip()
+        if name == "M":
+            tb_type = TB_MIXTURE
+        else:
+            tb_type = TB_SPECIES
+            if name not in species_map:
+                raise MechanismError(
+                    f"unknown falloff collider {name!r} in reaction {eq!r}")
+            collider = species_map[name]
+        # mark falloff with sentinel coeff on tb_type sign handled by caller
+        falloff = True
+    else:
+        falloff = False
+
+    # split on '+', then re-join fragments that are not (coeff +) species
+    raw_frags = [f.strip() for f in side.split("+")]
+    frags: list = []
+    i = 0
+    while i < len(raw_frags):
+        frag = raw_frags[i]
+        # try to extend with following fragments for species containing '+'
+        j = i
+        cand = frag
+        while True:
+            name_part = _strip_coeff(cand)[1].upper()
+            if name_part in species_map or name_part == "M" or not cand:
+                break
+            if j + 1 < len(raw_frags):
+                j += 1
+                cand = cand + "+" + raw_frags[j]
+            else:
+                break
+        frags.append(cand)
+        i = j + 1
+
+    terms: list = []
+    for frag in frags:
+        frag = frag.strip()
+        if not frag:
+            continue
+        coeff, name = _strip_coeff(frag)
+        name = name.upper()
+        if name == "M":
+            if tb_type == TB_SPECIES:
+                raise MechanismError(f"both (+SP) and +M in reaction {eq!r}")
+            tb_type = TB_MIXTURE
+            continue
+        if name not in species_map:
+            raise MechanismError(
+                f"unknown species {name!r} in reaction {eq!r}")
+        terms.append((species_map[name], coeff))
+    return terms, tb_type, collider, falloff
+
+
+_COEFF_RE = re.compile(r"^(\d+\.?\d*|\.\d+)\s*(.*)$")
+
+
+def _strip_coeff(frag: str):
+    """Split a leading stoichiometric coefficient off a species fragment."""
+    frag = frag.strip()
+    m = _COEFF_RE.match(frag)
+    if m and m.group(2):
+        return float(m.group(1)), m.group(2).strip()
+    return 1.0, frag
+
+
+# ---------------------------------------------------------------------------
+# Mechanism file parsing
+# ---------------------------------------------------------------------------
+
+_AUX_KEYWORDS = (
+    "DUP", "DUPLICATE", "LOW", "HIGH", "TROE", "SRI", "REV", "PLOG",
+    "FORD", "RORD", "LT", "RLT", "XSMI", "MOME", "EXCI", "TDEP", "CHEB",
+    "PCHEB", "TCHEB", "UNITS",
+)
+
+
+def _energy_factor(units: str) -> float:
+    """Multiplier converting declared activation-energy units to cal/mol."""
+    u = units.upper()
+    if u in ("CAL", "CAL/MOLE"):
+        return 1.0
+    if u in ("KCAL", "KCAL/MOLE"):
+        return 1000.0
+    if u in ("JOU", "JOULES/MOLE", "JOULES"):
+        return 1.0 / 4.184
+    if u in ("KJOU", "KJOULES/MOLE", "KJOULES", "KJOU/MOLE"):
+        return 1000.0 / 4.184
+    if u in ("KELV", "KELVINS", "KELVIN"):
+        return R_CAL
+    if u in ("EVOL", "EVOLTS"):
+        return 23060.547830619026  # eV -> cal/mol
+    raise MechanismError(f"unknown energy unit {units!r}")
+
+
+class MechanismParser:
+    """Stateful parser for one mechanism (optionally + external thermo /
+    transport databases)."""
+
+    def __init__(self) -> None:
+        self.elements: list = []
+        self.species: list = []
+        self.species_map: dict = {}
+        self.thermo: dict = {}
+        self.transport: dict = {}
+        self.reactions: list = []
+        self.e_factor = 1.0       # declared-energy-unit -> cal/mol
+        self.molecules = False    # A given in molecule units
+        self._awt_override: dict = {}
+
+    # -- top level -----------------------------------------------------------
+    def parse(self, mech_path: str, thermo_path: str | None = None,
+              transport_path: str | None = None) -> MechanismRecord:
+        if thermo_path:
+            self.thermo.update(parse_thermo_file(thermo_path))
+        if transport_path:
+            self.transport.update(parse_transport_file(transport_path))
+        with open(mech_path) as fh:
+            self._parse_mech_lines(fh.read().splitlines())
+        return self._finalize()
+
+    def parse_string(self, mech_text: str, thermo_text: str | None = None,
+                     transport_text: str | None = None) -> MechanismRecord:
+        if thermo_text:
+            body = [ln for ln in thermo_text.splitlines()
+                    if not _strip_comment(ln).strip().upper().startswith("THERMO")]
+            self.thermo.update(parse_thermo_block(body))
+        if transport_text:
+            self.transport.update(parse_transport_block(transport_text.splitlines()))
+        self._parse_mech_lines(mech_text.splitlines())
+        return self._finalize()
+
+    # -- block dispatch ------------------------------------------------------
+    def _parse_mech_lines(self, lines: list) -> None:
+        block = None
+        block_lines: list = []
+        i = 0
+        while i <= len(lines):
+            raw = lines[i] if i < len(lines) else "END"
+            line = _strip_comment(raw)
+            stripped = line.strip()
+            upper = stripped.upper()
+            first = upper.split("/")[0].split()[0] if upper.split() else ""
+            new_block = None
+            if first in ("ELEMENTS", "ELEM"):
+                new_block = "ELEMENTS"
+            elif first in ("SPECIES", "SPEC"):
+                new_block = "SPECIES"
+            elif first in ("THERMO", "THER"):
+                new_block = "THERMO"
+            elif first in ("TRANSPORT", "TRAN"):
+                new_block = "TRANSPORT"
+            elif first in ("REACTIONS", "REAC"):
+                new_block = "REACTIONS"
+            elif first == "END" or i == len(lines):
+                new_block = "END"
+            if new_block is not None:
+                if block == "ELEMENTS":
+                    self._parse_elements(block_lines)
+                elif block == "SPECIES":
+                    self._parse_species(block_lines)
+                elif block == "THERMO":
+                    self.thermo.update(parse_thermo_block(block_lines))
+                elif block == "TRANSPORT":
+                    self.transport.update(parse_transport_block(block_lines))
+                elif block == "REACTIONS":
+                    self._parse_reactions(block_lines)
+                block_lines = []
+                if new_block == "REACTIONS":
+                    # unit declarations on the REACTIONS line
+                    toks = upper.split()[1:]
+                    for t in toks:
+                        if t in ("MOLES",):
+                            self.molecules = False
+                        elif t in ("MOLECULES",):
+                            self.molecules = True
+                        else:
+                            self.e_factor = _energy_factor(t)
+                block = None if new_block == "END" else new_block
+                # ELEMENTS/SPECIES may carry entries on the same line
+                if block in ("ELEMENTS", "SPECIES"):
+                    rest = stripped.split(None, 1)
+                    if len(rest) > 1:
+                        block_lines.append(rest[1])
+            elif block is not None:
+                block_lines.append(raw)
+            elif stripped:
+                logger.warning("ignoring line outside any block: %r", stripped)
+            i += 1
+
+    def _parse_elements(self, lines: list) -> None:
+        for raw in lines:
+            line = _strip_comment(raw)
+            toks = line.replace("/", " / ").split()
+            j = 0
+            while j < len(toks):
+                tok = toks[j].upper()
+                if tok == "END":
+                    j += 1
+                    continue
+                if tok == "/":
+                    # atomic-weight override: EL / weight /
+                    if j + 2 < len(toks) and self.elements:
+                        self._awt_override[self.elements[-1]] = _to_float(toks[j + 1])
+                        j += 3
+                        continue
+                    j += 1
+                    continue
+                if tok not in self.elements:
+                    self.elements.append(tok)
+                j += 1
+
+    def _parse_species(self, lines: list) -> None:
+        for raw in lines:
+            for tok in _strip_comment(raw).split():
+                t = tok.upper()
+                if t == "END":
+                    continue
+                if t not in self.species_map:
+                    self.species_map[t] = len(self.species)
+                    self.species.append(t)
+
+    # -- reactions -----------------------------------------------------------
+    def _parse_reactions(self, lines: list) -> None:
+        current: _ReactionDraft | None = None
+        for raw in lines:
+            line = _strip_comment(raw).strip()
+            if not line or line.upper() == "END":
+                continue
+            if self._is_aux_line(line):
+                if current is None:
+                    raise MechanismError(
+                        f"auxiliary line before any reaction: {line!r}")
+                self._parse_aux_line(line, current)
+            else:
+                current = self._parse_reaction_line(line)
+                self.reactions.append(current)
+
+    def _is_aux_line(self, line: str) -> bool:
+        up = line.upper()
+        head = re.split(r"[\s/]", up, 1)[0]
+        if head in _AUX_KEYWORDS:
+            return True
+        # efficiency lines look like "H2/2.0/ H2O/6.0/"
+        if "/" in line and "=" not in line:
+            name = line.split("/", 1)[0].strip().upper()
+            return name in self.species_map
+        return False
+
+    def _parse_reaction_line(self, line: str) -> _ReactionDraft:
+        # rightmost three numeric tokens are A, beta, Ea
+        toks = line.split()
+        if len(toks) < 4:
+            raise MechanismError(f"malformed reaction line: {line!r}")
+        try:
+            A, beta, Ea = (_to_float(t) for t in toks[-3:])
+        except ValueError as exc:
+            raise MechanismError(f"bad Arrhenius numbers in {line!r}") from exc
+        eq = " ".join(toks[:-3])
+        lhs, rhs, reversible = _split_equation(eq)
+        r_terms, r_tb, r_coll, r_fall = _parse_side(lhs, self.species_map, eq)
+        p_terms, p_tb, p_coll, p_fall = _parse_side(rhs, self.species_map, eq)
+        if (r_tb or r_fall) and (p_tb or p_fall):
+            if (r_tb, r_coll, r_fall) != (p_tb, p_coll, p_fall):
+                raise MechanismError(f"inconsistent third body in {eq!r}")
+        tb_type = r_tb or p_tb
+        collider = r_coll if r_coll >= 0 else p_coll
+        falloff = r_fall or p_fall
+        draft = _ReactionDraft(
+            equation=re.sub(r"\s+", " ", eq.strip()),
+            reactants=r_terms, products=p_terms, reversible=reversible,
+            A=A, beta=beta, Ea=Ea, tb_type=tb_type, tb_collider=collider,
+        )
+        if falloff:
+            # actual type (Lindemann/Troe/SRI/chem-act) resolved by aux lines
+            draft.falloff_type = FALLOFF_LINDEMANN
+        return draft
+
+    def _parse_aux_line(self, line: str, rxn: _ReactionDraft) -> None:
+        up = line.upper()
+        head = re.split(r"[\s/]", up, 1)[0]
+        if head in ("DUP", "DUPLICATE"):
+            rxn.duplicate = True
+            return
+        if head == "UNITS":
+            vals = _slash_values_raw(line)
+            for v in vals:
+                v = v.upper()
+                if v == "MOLECULES":
+                    self.molecules = True
+                elif v == "MOLES":
+                    self.molecules = False
+                else:
+                    self.e_factor = _energy_factor(v)
+            return
+        if head in ("LOW", "HIGH", "TROE", "SRI", "REV", "PLOG"):
+            vals = _slash_numbers(line)
+            if head == "LOW":
+                if len(vals) != 3:
+                    raise MechanismError(f"LOW needs 3 numbers: {line!r}")
+                rxn.low = tuple(vals)
+            elif head == "HIGH":
+                if len(vals) != 3:
+                    raise MechanismError(f"HIGH needs 3 numbers: {line!r}")
+                rxn.high = tuple(vals)
+                rxn.falloff_type = FALLOFF_CHEM_ACT
+            elif head == "TROE":
+                if len(vals) not in (3, 4):
+                    raise MechanismError(f"TROE needs 3 or 4 numbers: {line!r}")
+                rxn.troe = tuple(vals)
+                if rxn.falloff_type != FALLOFF_CHEM_ACT:
+                    rxn.falloff_type = FALLOFF_TROE
+            elif head == "SRI":
+                if len(vals) not in (3, 5):
+                    raise MechanismError(f"SRI needs 3 or 5 numbers: {line!r}")
+                if len(vals) == 3:
+                    vals = list(vals) + [1.0, 0.0]
+                rxn.sri = tuple(vals)
+                rxn.falloff_type = FALLOFF_SRI
+            elif head == "REV":
+                if len(vals) != 3:
+                    raise MechanismError(f"REV needs 3 numbers: {line!r}")
+                rxn.rev = tuple(vals)
+            elif head == "PLOG":
+                if len(vals) != 4:
+                    raise MechanismError(f"PLOG needs 4 numbers: {line!r}")
+                rxn.plog.append(tuple(vals))
+            return
+        if head in ("FORD", "RORD"):
+            vals = _slash_values_raw(line)
+            if len(vals) != 2:
+                raise MechanismError(f"{head} needs species + order: {line!r}")
+            name = vals[0].upper()
+            if name not in self.species_map:
+                raise MechanismError(f"unknown species in {head}: {line!r}")
+            if head == "FORD":
+                rxn.ford[self.species_map[name]] = _to_float(vals[1])
+            else:
+                logger.warning("RORD not supported; ignoring %r", line)
+            return
+        if head in ("LT", "RLT", "XSMI", "MOME", "EXCI", "TDEP", "CHEB",
+                    "PCHEB", "TCHEB"):
+            raise MechanismError(
+                f"unsupported auxiliary keyword {head} in {line!r}")
+        # otherwise: third-body efficiency pairs  "H2/2.0/ H2O/6.0/"
+        for name, val in _efficiency_pairs(line):
+            if name.upper() not in self.species_map:
+                raise MechanismError(
+                    f"unknown species {name!r} in efficiency line {line!r}")
+            rxn.efficiencies[self.species_map[name.upper()]] = val
+
+    # -- finalize -------------------------------------------------------------
+    def _finalize(self) -> MechanismRecord:
+        if not self.species:
+            raise MechanismError("mechanism declares no species")
+        KK = len(self.species)
+        MM = len(self.elements)
+        II = len(self.reactions)
+
+        missing = [s for s in self.species if s not in self.thermo]
+        if missing:
+            raise MechanismError(
+                f"no thermodynamic data for species: {missing}")
+
+        awt = np.array([
+            self._awt_override.get(e, ATOMIC_WEIGHTS.get(e, float("nan")))
+            for e in self.elements
+        ])
+        if np.isnan(awt).any():
+            bad = [e for e, w in zip(self.elements, awt) if math.isnan(w)]
+            raise MechanismError(f"unknown element(s) {bad}; declare atomic "
+                                 "weight with EL/weight/ syntax")
+
+        ncf = np.zeros((KK, MM))
+        for k, sp in enumerate(self.species):
+            for elem, cnt in self.thermo[sp].composition.items():
+                if elem not in self.elements:
+                    raise MechanismError(
+                        f"species {sp} contains undeclared element {elem}")
+                ncf[k, self.elements.index(elem)] = cnt
+        wt = ncf @ awt
+
+        nasa_coeffs = np.zeros((KK, 2, 7))
+        nasa_T = np.zeros((KK, 3))
+        for k, sp in enumerate(self.species):
+            te = self.thermo[sp]
+            nasa_coeffs[k, 0] = te.coeffs_low
+            nasa_coeffs[k, 1] = te.coeffs_high
+            nasa_T[k] = (te.t_low, te.t_mid, te.t_high)
+
+        nu_f = np.zeros((II, KK))
+        nu_r = np.zeros((II, KK))
+        A = np.zeros(II)
+        beta = np.zeros(II)
+        Ea_R = np.zeros(II)
+        reversible = np.zeros(II, dtype=bool)
+        has_rev = np.zeros(II, dtype=bool)
+        rev_A = np.zeros(II)
+        rev_beta = np.zeros(II)
+        rev_Ea_R = np.zeros(II)
+        tb_type = np.zeros(II, dtype=np.int32)
+        tb_eff = np.zeros((II, KK))
+        falloff_type = np.zeros(II, dtype=np.int32)
+        low_A = np.zeros(II)
+        low_beta = np.zeros(II)
+        low_Ea_R = np.zeros(II)
+        troe = np.zeros((II, 4))
+        troe[:, 3] = np.inf
+        sri = np.tile(np.array([0.0, 0.0, 0.0, 1.0, 0.0]), (II, 1))
+        equations: list = []
+        plog_rows: list = []
+
+        cal_to_K = 1.0 / R_CAL  # cal/mol -> K
+
+        for i, rx in enumerate(self.reactions):
+            order_f = sum(c for _, c in rx.reactants)
+            conv = 1.0
+            if self.molecules:
+                tb_extra = 1 if (rx.tb_type == TB_MIXTURE
+                                 and rx.falloff_type == FALLOFF_NONE) else 0
+                conv = AVOGADRO ** (order_f + tb_extra - 1)
+            for k, c in rx.reactants:
+                nu_f[i, k] += c
+            for k, c in rx.products:
+                nu_r[i, k] += c
+            A[i] = rx.A * conv
+            beta[i] = rx.beta
+            Ea_R[i] = rx.Ea * self.e_factor * cal_to_K
+            reversible[i] = rx.reversible
+            if rx.rev is not None:
+                has_rev[i] = True
+                order_r = sum(c for _, c in rx.products)
+                conv_r = AVOGADRO ** (order_r - 1) if self.molecules else 1.0
+                rev_A[i] = rx.rev[0] * conv_r
+                rev_beta[i] = rx.rev[1]
+                rev_Ea_R[i] = rx.rev[2] * self.e_factor * cal_to_K
+            tb_type[i] = rx.tb_type
+            if rx.tb_type == TB_MIXTURE:
+                tb_eff[i, :] = 1.0
+                for k, e in rx.efficiencies.items():
+                    tb_eff[i, k] = e
+            elif rx.tb_type == TB_SPECIES:
+                tb_eff[i, rx.tb_collider] = 1.0
+            falloff_type[i] = rx.falloff_type
+            if rx.falloff_type in (FALLOFF_LINDEMANN, FALLOFF_TROE, FALLOFF_SRI):
+                if rx.low is None:
+                    raise MechanismError(
+                        f"falloff reaction missing LOW line: {rx.equation!r}")
+                low_A[i] = rx.low[0]
+                low_beta[i] = rx.low[1]
+                low_Ea_R[i] = rx.low[2] * self.e_factor * cal_to_K
+            elif rx.falloff_type == FALLOFF_CHEM_ACT:
+                # chem-activated: the rate line is the LOW limit, HIGH aux line
+                # gives the high-pressure limit
+                low_A[i] = A[i]
+                low_beta[i] = beta[i]
+                low_Ea_R[i] = Ea_R[i]
+                A[i] = rx.high[0]
+                beta[i] = rx.high[1]
+                Ea_R[i] = rx.high[2] * self.e_factor * cal_to_K
+            if rx.troe is not None:
+                t = list(rx.troe)
+                if len(t) == 3:
+                    t = t + [np.inf]
+                troe[i] = t
+            if rx.sri is not None:
+                sri[i] = rx.sri
+            if rx.plog:
+                plog_rows.append((i, rx.plog))
+            if rx.ford:
+                raise MechanismError(
+                    f"FORD orders not yet supported: {rx.equation!r}")
+            equations.append(rx.equation)
+
+        self._check_balance(nu_f, nu_r, ncf, equations)
+        self._check_duplicates(equations)
+
+        # ---- PLOG compaction -------------------------------------------------
+        plog_arrays = _build_plog_arrays(plog_rows, self.e_factor, cal_to_K,
+                                         self.molecules)
+
+        # ---- transport -------------------------------------------------------
+        has_tran = all(s in self.transport for s in self.species)
+        geom = np.zeros(KK, dtype=np.int32)
+        eps_k = np.zeros(KK)
+        sigma = np.zeros(KK)
+        dipole = np.zeros(KK)
+        polar = np.zeros(KK)
+        zrot = np.zeros(KK)
+        if has_tran:
+            for k, sp in enumerate(self.species):
+                tr = self.transport[sp]
+                geom[k] = tr.geom
+                eps_k[k] = tr.eps_k
+                sigma[k] = tr.sigma
+                dipole[k] = tr.dipole
+                polar[k] = tr.polar
+                zrot[k] = tr.zrot
+
+        return MechanismRecord(
+            element_names=tuple(self.elements),
+            species_names=tuple(self.species),
+            reaction_equations=tuple(equations),
+            has_transport=has_tran,
+            awt=awt, wt=wt, ncf=ncf,
+            nasa_coeffs=nasa_coeffs, nasa_T=nasa_T,
+            nu_f=nu_f, nu_r=nu_r,
+            A=A, beta=beta, Ea_R=Ea_R,
+            reversible=reversible, has_rev_params=has_rev,
+            rev_A=rev_A, rev_beta=rev_beta, rev_Ea_R=rev_Ea_R,
+            tb_type=tb_type, tb_eff=tb_eff,
+            falloff_type=falloff_type,
+            low_A=low_A, low_beta=low_beta, low_Ea_R=low_Ea_R,
+            troe=troe, sri=sri,
+            **plog_arrays,
+            geom=geom, eps_k=eps_k, sigma=sigma, dipole=dipole,
+            polar=polar, zrot=zrot,
+        )
+
+    def _check_balance(self, nu_f, nu_r, ncf, equations) -> None:
+        """Element balance check per reaction (the native preprocessor's
+        fatal BALANCE diagnostic)."""
+        imbalance = (nu_r - nu_f) @ ncf  # [II, MM]
+        bad = np.where(np.abs(imbalance).max(axis=1) > 1e-6)[0]
+        if bad.size:
+            msgs = [f"{equations[i]!r} (element imbalance "
+                    f"{imbalance[i].tolist()})" for i in bad[:5]]
+            raise MechanismError("unbalanced reaction(s): " + "; ".join(msgs))
+
+    def _check_duplicates(self, equations) -> None:
+        seen: dict = {}
+        for i, rx in enumerate(self.reactions):
+            key = (tuple(sorted(rx.reactants)), tuple(sorted(rx.products)),
+                   rx.tb_type, rx.tb_collider)
+            if key in seen:
+                j = seen[key]
+                if not (rx.duplicate and self.reactions[j].duplicate):
+                    logger.warning(
+                        "reactions %d and %d are duplicates without DUP: %r",
+                        j + 1, i + 1, equations[i])
+            seen[key] = i
+
+
+def _slash_numbers(line: str) -> list:
+    vals = _slash_values_raw(line)
+    return [_to_float(v) for v in vals]
+
+
+def _slash_values_raw(line: str) -> list:
+    m = re.search(r"/(.*)/", line, re.DOTALL)
+    if not m:
+        raise MechanismError(f"expected /values/ in {line!r}")
+    return m.group(1).split()
+
+
+_EFF_RE = re.compile(r"([^\s/]+)\s*/\s*([+-]?[\d.eEdD+-]+)\s*/")
+
+
+def _efficiency_pairs(line: str):
+    out = []
+    for m in _EFF_RE.finditer(line):
+        out.append((m.group(1), _to_float(m.group(2))))
+    if not out:
+        raise MechanismError(f"unrecognized auxiliary line: {line!r}")
+    return out
+
+
+def _build_plog_arrays(plog_rows, e_factor, cal_to_K, molecules) -> dict:
+    """Compact padded PLOG tables. Multiple entries at the same pressure are
+    stored as extra terms (summed in k-space by the kernel)."""
+    if not plog_rows:
+        return dict(
+            plog_idx=np.zeros(0, dtype=np.int32),
+            plog_ln_P=np.zeros((0, 1)),
+            plog_n_levels=np.zeros(0, dtype=np.int32),
+            plog_A=np.zeros((0, 1, 1)),
+            plog_beta=np.zeros((0, 1, 1)),
+            plog_Ea_R=np.zeros((0, 1, 1)),
+        )
+    tables = []
+    for i, entries in plog_rows:
+        by_p: dict = {}
+        for (p_atm, a, b, e) in entries:
+            by_p.setdefault(p_atm, []).append((a, b, e))
+        levels = sorted(by_p.items())
+        tables.append((i, levels))
+    L = max(len(lv) for _, lv in tables)
+    Tm = max(max(len(terms) for _, terms in lv) for _, lv in tables)
+    n = len(tables)
+    plog_idx = np.zeros(n, dtype=np.int32)
+    plog_ln_P = np.zeros((n, L))
+    plog_n = np.zeros(n, dtype=np.int32)
+    pA = np.zeros((n, L, Tm))
+    pB = np.zeros((n, L, Tm))
+    pE = np.zeros((n, L, Tm))
+    for r, (i, levels) in enumerate(tables):
+        plog_idx[r] = i
+        plog_n[r] = len(levels)
+        for l, (p_atm, terms) in enumerate(levels):
+            plog_ln_P[r, l] = math.log(p_atm * P_ATM)
+            for t, (a, b, e) in enumerate(terms):
+                order = 0.0
+                # A conversion for MOLECULES units uses the forward order of
+                # the owning reaction — rare; handled crudely via caller
+                pA[r, l, t] = a
+                pB[r, l, t] = b
+                pE[r, l, t] = e * e_factor * cal_to_K
+        # pad trailing levels with the last level's values (flat extrapolation)
+        for l in range(len(levels), L):
+            plog_ln_P[r, l] = plog_ln_P[r, len(levels) - 1] + (l - len(levels) + 1)
+            pA[r, l] = pA[r, len(levels) - 1]
+            pB[r, l] = pB[r, len(levels) - 1]
+            pE[r, l] = pE[r, len(levels) - 1]
+    if molecules:
+        logger.warning("PLOG with MOLECULES units: A left unconverted")
+    return dict(plog_idx=plog_idx, plog_ln_P=plog_ln_P, plog_n_levels=plog_n,
+                plog_A=pA, plog_beta=pB, plog_Ea_R=pE)
+
+
+def load_mechanism(mech_path: str, thermo_path: str | None = None,
+                   transport_path: str | None = None) -> MechanismRecord:
+    """Parse a CHEMKIN mechanism (+ optional thermo/transport databases) into
+    a :class:`MechanismRecord` — the rebuild's ``KINPreProcess``."""
+    return MechanismParser().parse(mech_path, thermo_path, transport_path)
+
+
+def load_mechanism_from_strings(mech_text: str, thermo_text: str | None = None,
+                                transport_text: str | None = None) -> MechanismRecord:
+    return MechanismParser().parse_string(mech_text, thermo_text, transport_text)

@@ -1,0 +1,154 @@
+"""MechanismRecord — the immutable JAX pytree that replaces the reference's
+native chemistry-set workspace.
+
+In the reference, a mechanism lives inside the licensed Fortran library as a
+single mutable global workspace (reference: src/ansys/chemkin/chemistry.py:46-51,
+chemkin_wrapper.py:324-331 KINUpdateChemistrySet/KINSwitchChemistrySet). Here a
+mechanism is a *value*: a frozen dataclass of arrays registered as a JAX pytree.
+Multiple mechanisms coexist trivially; kernels take the record as an argument and
+are jit/vmap/shard_map-transparent.
+
+Array-shape glossary: KK = n species, MM = n elements, II = n reactions.
+All units CGS + mol + K + cal/mol converted to Kelvin (Ea/R), matching the
+reference's locked CGS unit system (reference: __init__.py:106).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+
+# falloff_type codes
+FALLOFF_NONE = 0
+FALLOFF_LINDEMANN = 1
+FALLOFF_TROE = 2
+FALLOFF_SRI = 3
+# chemically-activated (kf scales with 1/(1+Pr) instead of Pr/(1+Pr))
+FALLOFF_CHEM_ACT = 4
+
+# third-body codes
+TB_NONE = 0      # no third body
+TB_MIXTURE = 1   # +M with efficiency row
+TB_SPECIES = 2   # specific collider, e.g. (+H2O): eff row is one-hot
+
+GEOM_ATOM = 0
+GEOM_LINEAR = 1
+GEOM_NONLINEAR = 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MechanismRecord:
+    """Complete mechanism data: elements, species, NASA-7 thermo, reactions,
+    rate parameters, and (optionally) transport.
+
+    Replaces the linking-file output of ``KINPreProcess``
+    (reference: chemkin_wrapper.py:303, chemistry.py:675).
+    """
+
+    # ---- static metadata (not traced) --------------------------------------
+    element_names: tuple = dataclasses.field(metadata={"static": True})
+    species_names: tuple = dataclasses.field(metadata={"static": True})
+    reaction_equations: tuple = dataclasses.field(metadata={"static": True})
+    has_transport: bool = dataclasses.field(metadata={"static": True})
+
+    # ---- element/species data ----------------------------------------------
+    awt: Any = None        # [MM] atomic weights, g/mol
+    wt: Any = None         # [KK] molecular weights, g/mol
+    ncf: Any = None        # [KK, MM] elemental composition counts
+
+    # NASA-7 thermo: coeffs[k, 0, :] = low-T range, coeffs[k, 1, :] = high-T
+    nasa_coeffs: Any = None  # [KK, 2, 7]
+    nasa_T: Any = None       # [KK, 3]  (Tlow, Tmid, Thigh)
+
+    # ---- reaction stoichiometry --------------------------------------------
+    nu_f: Any = None       # [II, KK] forward (reactant) stoichiometric coeffs
+    nu_r: Any = None       # [II, KK] reverse (product) stoichiometric coeffs
+    # nu = nu_r - nu_f is derived in kernels
+
+    # ---- Arrhenius ----------------------------------------------------------
+    A: Any = None          # [II] pre-exponential (cgs mole units)
+    beta: Any = None       # [II] temperature exponent
+    Ea_R: Any = None       # [II] activation temperature, K
+
+    reversible: Any = None     # [II] bool
+    has_rev_params: Any = None  # [II] bool: explicit REV parameters
+    rev_A: Any = None
+    rev_beta: Any = None
+    rev_Ea_R: Any = None
+
+    # ---- third body / falloff ----------------------------------------------
+    tb_type: Any = None    # [II] int: TB_NONE / TB_MIXTURE / TB_SPECIES
+    tb_eff: Any = None     # [II, KK] third-body efficiencies (0 where unused)
+    falloff_type: Any = None  # [II] int
+    low_A: Any = None      # [II] low-pressure-limit Arrhenius (falloff)
+    low_beta: Any = None
+    low_Ea_R: Any = None
+    troe: Any = None       # [II, 4]  (a, T3*, T1*, T2*); T2*=inf if absent
+    sri: Any = None        # [II, 5]  (a, b, c, d, e)
+
+    # ---- PLOG ---------------------------------------------------------------
+    # Compact layout over the subset of reactions that carry PLOG tables.
+    # plog_idx maps compact row -> reaction index. Tables are padded to
+    # (n_levels_max, n_terms_max); padding has A = 0 so padded terms add 0.
+    plog_idx: Any = None       # [IIp] int32
+    plog_ln_P: Any = None      # [IIp, L] ln(P in dyne/cm^2); padded by edge value
+    plog_n_levels: Any = None  # [IIp] int32
+    plog_A: Any = None         # [IIp, L, Tm]
+    plog_beta: Any = None      # [IIp, L, Tm]
+    plog_Ea_R: Any = None      # [IIp, L, Tm]
+
+    # ---- transport ----------------------------------------------------------
+    geom: Any = None       # [KK] int: 0 atom / 1 linear / 2 nonlinear
+    eps_k: Any = None      # [KK] LJ well depth / kB, K
+    sigma: Any = None      # [KK] LJ collision diameter, Angstrom
+    dipole: Any = None     # [KK] dipole moment, Debye
+    polar: Any = None      # [KK] polarizability, Angstrom^3
+    zrot: Any = None       # [KK] rotational relaxation number at 298 K
+
+    # ------------------------------------------------------------------------
+    @property
+    def n_elements(self) -> int:
+        """MM — reference: KINGetChemistrySizes (chemkin_wrapper.py:333)."""
+        return len(self.element_names)
+
+    @property
+    def n_species(self) -> int:
+        """KK."""
+        return len(self.species_names)
+
+    @property
+    def n_reactions(self) -> int:
+        """II (gas reactions; the reference's IIGas, chemistry.py:949-991)."""
+        return len(self.reaction_equations)
+
+    def species_index(self, name: str) -> int:
+        """Index of species ``name`` (case-insensitive)."""
+        try:
+            return self._species_lookup[name.upper()]
+        except AttributeError:
+            lookup = {s.upper(): i for i, s in enumerate(self.species_names)}
+            object.__setattr__(self, "_species_lookup", lookup)
+            return self._species_lookup[name.upper()]
+
+    def element_index(self, name: str) -> int:
+        names = [e.upper() for e in self.element_names]
+        return names.index(name.upper())
+
+    def with_A_factor(self, reaction_index: int, new_A: float) -> "MechanismRecord":
+        """Functional analog of ``KINSetAFactorForAReaction``
+        (reference: chemkin_wrapper.py:506, chemistry.py:1636): returns a new
+        record with one pre-exponential replaced."""
+        A = np.asarray(self.A).copy()
+        A[reaction_index] = new_A
+        return dataclasses.replace(self, A=type(self.A)(A) if not isinstance(self.A, np.ndarray) else A)
+
+    def with_rate_multipliers(self, multipliers) -> "MechanismRecord":
+        """Scale all forward A-factors by ``multipliers`` ([II] or scalar) —
+        the analog of the reference's gas rate multiplier keyword
+        (reference: reactormodel.py:1440)."""
+        A = np.asarray(self.A) * np.asarray(multipliers)
+        return dataclasses.replace(self, A=A)

@@ -1,0 +1,211 @@
+"""Unit tests for the CHEMKIN-format parser.
+
+The reference has no numerics unit tests (SURVEY §4) — the math lived in the
+licensed Fortran library. The rebuild tests its own preprocessor directly.
+"""
+
+import numpy as np
+import pytest
+
+from pychemkin_tpu.constants import P_ATM, R_CAL
+from pychemkin_tpu.mechanism import (
+    MechanismError,
+    load_embedded,
+    load_mechanism_from_strings,
+)
+from pychemkin_tpu.mechanism.record import (
+    FALLOFF_LINDEMANN,
+    FALLOFF_NONE,
+    FALLOFF_TROE,
+    TB_MIXTURE,
+    TB_NONE,
+    TB_SPECIES,
+)
+
+THERM_AB = """\
+THERMO ALL
+   300.000  1000.000  5000.000
+A                 test  H   2               G   300.000  5000.000 1000.00      1
+ 2.50000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00    2
+ 0.00000000E+00 0.00000000E+00 2.50000000E+00 0.00000000E+00 0.00000000E+00    3
+ 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00                   4
+B                 test  H   2               G   300.000  5000.000 1000.00      1
+ 2.50000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00    2
+ 0.00000000E+00 0.00000000E+00 2.50000000E+00 0.00000000E+00 0.00000000E+00    3
+ 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00                   4
+END
+"""
+
+
+def _tiny(reactions, extra=""):
+    mech = (
+        "ELEMENTS\nH\nEND\n"
+        "SPECIES\nA B\nEND\n"
+        "REACTIONS" + extra + "\n" + reactions + "\nEND\n")
+    return load_mechanism_from_strings(mech, thermo_text=THERM_AB)
+
+
+class TestTinyMechanisms:
+    def test_simple_reversible(self):
+        rec = _tiny("A<=>B   1.0E10  0.5  1000.0")
+        assert rec.n_species == 2
+        assert rec.n_reactions == 1
+        assert rec.reversible[0]
+        np.testing.assert_allclose(rec.A[0], 1.0e10)
+        np.testing.assert_allclose(rec.beta[0], 0.5)
+        np.testing.assert_allclose(rec.Ea_R[0], 1000.0 / R_CAL)
+        np.testing.assert_array_equal(rec.nu_f[0], [1.0, 0.0])
+        np.testing.assert_array_equal(rec.nu_r[0], [0.0, 1.0])
+        assert rec.tb_type[0] == TB_NONE
+        assert rec.falloff_type[0] == FALLOFF_NONE
+
+    def test_irreversible_and_coefficients(self):
+        rec = _tiny("2A=>2B   1.0E10  0.0  0.0")
+        assert not rec.reversible[0]
+        np.testing.assert_array_equal(rec.nu_f[0], [2.0, 0.0])
+        np.testing.assert_array_equal(rec.nu_r[0], [0.0, 2.0])
+
+    def test_third_body(self):
+        rec = _tiny("A+M<=>B+M   1.0E10  0.0  0.0\nA/2.5/ B/0.0/")
+        assert rec.tb_type[0] == TB_MIXTURE
+        np.testing.assert_array_equal(rec.tb_eff[0], [2.5, 0.0])
+
+    def test_falloff_troe(self):
+        rec = _tiny(
+            "A(+M)<=>B(+M)   1.0E10  0.0  0.0\n"
+            "LOW/1.0E16 -1.0 500.0/\n"
+            "TROE/0.6 100.0 2000.0/\n"
+            "B/3.0/")
+        assert rec.falloff_type[0] == FALLOFF_TROE
+        np.testing.assert_allclose(rec.low_A[0], 1e16)
+        np.testing.assert_allclose(rec.low_Ea_R[0], 500.0 / R_CAL)
+        assert rec.troe[0, 3] == np.inf  # 3-parameter TROE
+        np.testing.assert_array_equal(rec.tb_eff[0], [1.0, 3.0])
+
+    def test_falloff_specific_collider(self):
+        rec = _tiny(
+            "A(+B)<=>B(+B)   1.0E10  0.0  0.0\nLOW/1.0E16 0.0 0.0/")
+        assert rec.tb_type[0] == TB_SPECIES
+        assert rec.falloff_type[0] == FALLOFF_LINDEMANN
+        np.testing.assert_array_equal(rec.tb_eff[0], [0.0, 1.0])
+
+    def test_duplicates_ok(self):
+        rec = _tiny(
+            "A<=>B 1.0E10 0.0 0.0\nDUP\nA<=>B 2.0E10 0.0 0.0\nDUP")
+        assert rec.n_reactions == 2
+
+    def test_rev_params(self):
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\nREV/5.0E9 0.1 100.0/")
+        assert rec.has_rev_params[0]
+        np.testing.assert_allclose(rec.rev_A[0], 5e9)
+
+    def test_plog(self):
+        rec = _tiny(
+            "A<=>B 1.0E10 0.0 0.0\n"
+            "PLOG/0.1  1.0E9  0.0 0.0/\n"
+            "PLOG/1.0  1.0E10 0.0 0.0/\n"
+            "PLOG/10.0 1.0E11 0.0 0.0/")
+        assert rec.plog_idx.shape == (1,)
+        assert rec.plog_n_levels[0] == 3
+        np.testing.assert_allclose(
+            rec.plog_ln_P[0, :3],
+            np.log(np.array([0.1, 1.0, 10.0]) * P_ATM))
+
+    def test_kelvin_units(self):
+        rec = _tiny("A<=>B 1.0E10 0.0 5000.0", extra=" KELVINS")
+        np.testing.assert_allclose(rec.Ea_R[0], 5000.0)
+
+    def test_kcal_units(self):
+        rec = _tiny("A<=>B 1.0E10 0.0 5.0", extra=" KCAL/MOLE")
+        np.testing.assert_allclose(rec.Ea_R[0], 5000.0 / R_CAL)
+
+    def test_unbalanced_rejected(self):
+        with pytest.raises(MechanismError, match="unbalanced"):
+            _tiny("A+A<=>B+B+B 1.0E10 0.0 0.0")
+
+    def test_unknown_species_rejected(self):
+        with pytest.raises(MechanismError, match="unknown species"):
+            _tiny("A+C<=>B 1.0E10 0.0 0.0")
+
+    def test_missing_thermo_rejected(self):
+        with pytest.raises(MechanismError, match="thermodynamic"):
+            load_mechanism_from_strings(
+                "ELEMENTS\nH\nEND\nSPECIES\nA B C\nEND\n"
+                "REACTIONS\nA<=>B 1.0 0.0 0.0\nEND\n",
+                thermo_text=THERM_AB)
+
+
+class TestEmbeddedH2O2:
+    @pytest.fixture(scope="class")
+    def rec(self):
+        return load_embedded("h2o2")
+
+    def test_sizes(self, rec):
+        # the reference exposes sizes via KINGetChemistrySizes
+        # (chemistry.py:693): MM elements, KK species, II reactions
+        assert rec.n_elements == 4
+        assert rec.n_species == 10
+        assert rec.n_reactions == 27
+
+    def test_molecular_weights(self, rec):
+        k = rec.species_index("H2O")
+        np.testing.assert_allclose(rec.wt[k], 18.015, atol=0.01)
+        np.testing.assert_allclose(rec.wt[rec.species_index("N2")], 28.014,
+                                   atol=0.01)
+        np.testing.assert_allclose(rec.wt[rec.species_index("AR")], 39.948,
+                                   atol=0.001)
+
+    def test_composition_matrix(self, rec):
+        # NCF matrix (reference: chemistry.py:1472 SpeciesComposition)
+        k = rec.species_index("H2O2")
+        comp = {e: rec.ncf[k, j] for j, e in enumerate(rec.element_names)}
+        assert comp["H"] == 2 and comp["O"] == 2
+
+    def test_troe_falloff_present(self, rec):
+        i = list(rec.reaction_equations).index("2OH(+M)<=>H2O2(+M)")
+        assert rec.falloff_type[i] == FALLOFF_TROE
+        np.testing.assert_allclose(rec.low_A[i], 2.3e18)
+        np.testing.assert_allclose(rec.troe[i, 0], 0.7346)
+
+    def test_specific_collider_reactions(self, rec):
+        # H+O2+N2<=>HO2+N2 is a plain reaction whose N2 appears on both sides
+        i = list(rec.reaction_equations).index("H+O2+N2<=>HO2+N2")
+        assert rec.tb_type[i] == TB_NONE
+        kN2 = rec.species_index("N2")
+        assert rec.nu_f[i, kN2] == 1.0 and rec.nu_r[i, kN2] == 1.0
+
+    def test_thermo_continuity(self, rec):
+        """cp, h, s must be continuous at Tmid (validates embedded data)."""
+        from pychemkin_tpu.mechanism.parser import _to_float  # noqa: F401
+        T = rec.nasa_T[:, 1]  # Tmid per species
+        for k in range(rec.n_species):
+            lo = rec.nasa_coeffs[k, 0]
+            hi = rec.nasa_coeffs[k, 1]
+            t = T[k]
+            powers = np.array([1, t, t**2, t**3, t**4])
+            cp_lo = lo[:5] @ powers
+            cp_hi = hi[:5] @ powers
+            assert abs(cp_lo - cp_hi) < 5e-3, rec.species_names[k]
+            h_lo = lo[0] + sum(lo[j] / (j + 1) * t**j for j in range(1, 5)) + lo[5] / t
+            h_hi = hi[0] + sum(hi[j] / (j + 1) * t**j for j in range(1, 5)) + hi[5] / t
+            assert abs(h_lo - h_hi) < 1e-6 * abs(h_lo), rec.species_names[k]
+
+    def test_transport_loaded(self, rec):
+        assert rec.has_transport
+        k = rec.species_index("H2O")
+        np.testing.assert_allclose(rec.eps_k[k], 572.4)
+        np.testing.assert_allclose(rec.sigma[k], 2.605)
+        assert rec.geom[k] == 2
+
+    def test_element_balance_all(self, rec):
+        imbalance = (rec.nu_r - rec.nu_f) @ rec.ncf
+        np.testing.assert_allclose(imbalance, 0.0, atol=1e-10)
+
+
+class TestEmbeddedGrisyn:
+    def test_sizes(self):
+        rec = load_embedded("grisyn")
+        assert rec.n_species == 53
+        assert rec.n_reactions == 325
+        imbalance = (rec.nu_r - rec.nu_f) @ rec.ncf
+        np.testing.assert_allclose(imbalance, 0.0, atol=1e-10)

@@ -1,0 +1,323 @@
+"""Generate the embedded CHEMKIN-format mechanism fixtures.
+
+The reference ships no mechanism files (they live in the licensed Ansys
+install), so the rebuild embeds its own fixtures:
+
+- ``h2o2.inp`` / ``therm_h2o2.dat`` / ``tran_h2o2.dat`` — a GRI-3.0-derived
+  H2/O2/N2/AR subsystem (10 species, 26 reactions) exercising third bodies,
+  Troe falloff, duplicates, and negative activation energies.
+- ``grisyn.inp`` — a synthetic GRI-3.0-*sized* mechanism (53 species /
+  325 reactions) for performance benchmarking: same tensor shapes and
+  reaction-type mix as GRI-3.0, thermodynamically consistent by
+  construction, but NOT a validated chemistry model.
+
+NASA-7 a6/a7 of the high-T range are repaired to enforce exact h/s
+continuity at Tmid, guarding against transcription error.
+
+Run from repo root:  python tools/gen_mech_data.py
+"""
+
+import os
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(__file__), "..",
+                   "pychemkin_tpu", "mechanism", "data")
+
+# species: (composition, Tlow, Tmid, Thigh, low7, high7)
+# NASA-7 polynomials (GRI-3.0 thermo database values).
+THERMO = {
+    "H2": ({"H": 2}, 200.0, 1000.0, 3500.0,
+           [2.34433112e+00, 7.98052075e-03, -1.94781510e-05, 2.01572094e-08,
+            -7.37611761e-12, -9.17935173e+02, 6.83010238e-01],
+           [3.33727920e+00, -4.94024731e-05, 4.99456778e-07, -1.79566394e-10,
+            2.00255376e-14, -9.50158922e+02, -3.20502331e+00]),
+    "H": ({"H": 1}, 200.0, 1000.0, 3500.0,
+          [2.50000000e+00, 7.05332819e-13, -1.99591964e-15, 2.30081632e-18,
+           -9.27732332e-22, 2.54736599e+04, -4.46682853e-01],
+          [2.50000001e+00, -2.30842973e-11, 1.61561948e-14, -4.73515235e-18,
+           4.98197357e-22, 2.54736599e+04, -4.46682914e-01]),
+    "O": ({"O": 1}, 200.0, 1000.0, 3500.0,
+          [3.16826710e+00, -3.27931884e-03, 6.64306396e-06, -6.12806624e-09,
+           2.11265971e-12, 2.91222592e+04, 2.05193346e+00],
+          [2.56942078e+00, -8.59741137e-05, 4.19484589e-08, -1.00177799e-11,
+           1.22833691e-15, 2.92175791e+04, 4.78433864e+00]),
+    "O2": ({"O": 2}, 200.0, 1000.0, 3500.0,
+           [3.78245636e+00, -2.99673416e-03, 9.84730201e-06, -9.68129509e-09,
+            3.24372837e-12, -1.06394356e+03, 3.65767573e+00],
+           [3.28253784e+00, 1.48308754e-03, -7.57966669e-07, 2.09470555e-10,
+            -2.16717794e-14, -1.08845772e+03, 5.45323129e+00]),
+    "OH": ({"O": 1, "H": 1}, 200.0, 1000.0, 3500.0,
+           [3.99201543e+00, -2.40131752e-03, 4.61793841e-06, -3.88113333e-09,
+            1.36411470e-12, 3.61508056e+03, -1.03925458e-01],
+           [3.09288767e+00, 5.48429716e-04, 1.26505228e-07, -8.79461556e-11,
+            1.17412376e-14, 3.85865700e+03, 4.47669610e+00]),
+    "H2O": ({"H": 2, "O": 1}, 200.0, 1000.0, 3500.0,
+            [4.19864056e+00, -2.03643410e-03, 6.52040211e-06, -5.48797062e-09,
+             1.77197817e-12, -3.02937267e+04, -8.49032208e-01],
+            [3.03399249e+00, 2.17691804e-03, -1.64072518e-07, -9.70419870e-11,
+             1.68200992e-14, -3.00042971e+04, 4.96677010e+00]),
+    "HO2": ({"H": 1, "O": 2}, 200.0, 1000.0, 3500.0,
+            [4.30179801e+00, -4.74912051e-03, 2.11582891e-05, -2.42763894e-08,
+             9.29225124e-12, 2.94808040e+02, 3.71666245e+00],
+            [4.01721090e+00, 2.23982013e-03, -6.33658150e-07, 1.14246370e-10,
+             -1.07908535e-14, 1.11856713e+02, 3.78510215e+00]),
+    "H2O2": ({"H": 2, "O": 2}, 200.0, 1000.0, 3500.0,
+             [4.27611269e+00, -5.42822417e-04, 1.67335701e-05, -2.15770813e-08,
+              8.62454363e-12, -1.77025821e+04, 3.43505074e+00],
+             [4.16500285e+00, 4.90831694e-03, -1.90139225e-06, 3.71185986e-10,
+              -2.91615662e-14, -1.78617877e+04, 2.91615662e+00]),
+    "N2": ({"N": 2}, 300.0, 1000.0, 5000.0,
+           [3.29867700e+00, 1.40824040e-03, -3.96322200e-06, 5.64151500e-09,
+            -2.44485400e-12, -1.02089990e+03, 3.95037200e+00],
+           [2.92664000e+00, 1.48797680e-03, -5.68476000e-07, 1.00970380e-10,
+            -6.75335100e-15, -9.22797700e+02, 5.98052800e+00]),
+    "AR": ({"AR": 1}, 300.0, 1000.0, 5000.0,
+           [2.50000000e+00, 0.0, 0.0, 0.0, 0.0, -7.45375000e+02, 4.36600000e+00],
+           [2.50000000e+00, 0.0, 0.0, 0.0, 0.0, -7.45375000e+02, 4.36600000e+00]),
+}
+
+TRANSPORT = {
+    #        geom  eps/k    sigma   dipole  polar   zrot
+    "H2":   (1,   38.000,  2.920,  0.000,  0.790, 280.000),
+    "H":    (0,  145.000,  2.050,  0.000,  0.000,   0.000),
+    "O":    (0,   80.000,  2.750,  0.000,  0.000,   0.000),
+    "O2":   (1,  107.400,  3.458,  0.000,  1.600,   3.800),
+    "OH":   (1,   80.000,  2.750,  0.000,  0.000,   0.000),
+    "H2O":  (2,  572.400,  2.605,  1.844,  0.000,   4.000),
+    "HO2":  (2,  107.400,  3.458,  0.000,  0.000,   1.000),
+    "H2O2": (2,  107.400,  3.458,  0.000,  0.000,   3.800),
+    "N2":   (1,   97.530,  3.621,  0.000,  1.760,   4.000),
+    "AR":   (0,  136.500,  3.330,  0.000,  0.000,   0.000),
+}
+
+H2O2_REACTIONS = """\
+2O+M<=>O2+M                              1.200E+17   -1.000        0.00
+H2/2.4/ H2O/15.4/ AR/0.83/
+O+H+M<=>OH+M                             5.000E+17   -1.000        0.00
+H2/2.0/ H2O/6.0/ AR/0.7/
+O+H2<=>H+OH                              3.870E+04    2.700     6260.00
+O+HO2<=>OH+O2                            2.000E+13    0.000        0.00
+O+H2O2<=>OH+HO2                          9.630E+06    2.000     4000.00
+H+O2+M<=>HO2+M                           2.800E+18   -0.860        0.00
+O2/0.0/ H2O/0.0/ N2/0.0/ AR/0.0/
+H+2O2<=>HO2+O2                           2.080E+19   -1.240        0.00
+H+O2+H2O<=>HO2+H2O                       1.126E+19   -0.760        0.00
+H+O2+N2<=>HO2+N2                         2.600E+19   -1.240        0.00
+H+O2+AR<=>HO2+AR                         7.000E+17   -0.800        0.00
+H+O2<=>O+OH                              2.650E+16   -0.671    17041.00
+2H+M<=>H2+M                              1.000E+18   -1.000        0.00
+H2/0.0/ H2O/0.0/
+2H+H2<=>2H2                              9.000E+16   -0.600        0.00
+2H+H2O<=>H2+H2O                          6.000E+19   -1.250        0.00
+H+OH+M<=>H2O+M                           2.200E+22   -2.000        0.00
+H2/0.73/ H2O/3.65/ AR/0.38/
+H+HO2<=>O+H2O                            3.970E+12    0.000      671.00
+H+HO2<=>O2+H2                            4.480E+13    0.000     1068.00
+H+HO2<=>2OH                              8.400E+13    0.000      635.00
+H+H2O2<=>HO2+H2                          1.210E+07    2.000     5200.00
+H+H2O2<=>OH+H2O                          1.000E+13    0.000     3600.00
+OH+H2<=>H+H2O                            2.160E+08    1.510     3430.00
+2OH(+M)<=>H2O2(+M)                       7.400E+13   -0.370        0.00
+LOW/2.300E+18 -0.900 -1700.00/
+TROE/0.7346 94.00 1756.00 5182.00/
+H2/2.0/ H2O/6.0/ AR/0.7/
+2OH<=>O+H2O                              3.570E+04    2.400    -2110.00
+OH+HO2<=>O2+H2O                          1.450E+13    0.000     -500.00
+DUPLICATE
+OH+HO2<=>O2+H2O                          5.000E+15    0.000    17330.00
+DUPLICATE
+HO2+HO2<=>O2+H2O2                        1.300E+11    0.000    -1630.00
+DUPLICATE
+HO2+HO2<=>O2+H2O2                        4.200E+14    0.000    12000.00
+DUPLICATE
+"""
+
+
+def nasa_h_RT(c, T):
+    return (c[0] + c[1] / 2 * T + c[2] / 3 * T**2 + c[3] / 4 * T**3
+            + c[4] / 5 * T**4 + c[5] / T)
+
+
+def nasa_s_R(c, T):
+    return (c[0] * np.log(T) + c[1] * T + c[2] / 2 * T**2 + c[3] / 3 * T**3
+            + c[4] / 4 * T**4 + c[6])
+
+
+def nasa_cp_R(c, T):
+    return c[0] + c[1] * T + c[2] * T**2 + c[3] * T**3 + c[4] * T**4
+
+
+def repair_continuity():
+    """Force exact h/s continuity at Tmid by adjusting high-range a6/a7.
+    Reports cp discontinuities (unfixable without touching a1..a5)."""
+    for name, (comp, tlo, tmid, thi, lo, hi) in THERMO.items():
+        cp_jump = nasa_cp_R(hi, tmid) - nasa_cp_R(lo, tmid)
+        if abs(cp_jump) > 2e-3:
+            print(f"WARNING {name}: cp/R discontinuity {cp_jump:+.2e} at Tmid")
+        dh = nasa_h_RT(lo, tmid) - nasa_h_RT(hi, tmid)  # in h/RT units
+        hi[5] += dh * tmid
+        ds = nasa_s_R(lo, tmid) - nasa_s_R(hi, tmid)
+        if abs(ds) > 5e-3:
+            print(f"note {name}: adjusting high-range a7 by {ds:+.2e}")
+        hi[6] += ds
+
+
+def fmt_coeff(x):
+    s = f"{x: .8E}"  # ' 2.34433112E+00' / '-7.37611761E-12'
+    return s
+
+
+def thermo_card(name, comp, tlo, tmid, thi, lo, hi, index):
+    compstr = ""
+    items = list(comp.items())[:4]
+    for el, n in items:
+        compstr += f"{el:<2s}{int(n):>3d}"
+    compstr = f"{compstr:<20s}"
+    l1 = f"{name:<18s}{'g tpu':<6s}{compstr}G{tlo:10.3f}{thi:10.3f}{tmid:8.2f}"
+    l1 = f"{l1:<79s}1"
+    c = hi + lo
+    l2 = "".join(fmt_coeff(v) for v in c[0:5])
+    l2 = f"{l2:<79s}2"
+    l3 = "".join(fmt_coeff(v) for v in c[5:10])
+    l3 = f"{l3:<79s}3"
+    l4 = "".join(fmt_coeff(v) for v in c[10:14])
+    l4 = f"{l4:<79s}4"
+    return "\n".join([l1, l2, l3, l4])
+
+
+def write_h2o2():
+    species = list(THERMO.keys())
+    cards = "\n".join(
+        thermo_card(n, *THERMO[n], i + 1) for i, n in enumerate(species))
+    therm = ("THERMO ALL\n   200.000  1000.000  5000.000\n"
+             + cards + "\nEND\n")
+    with open(os.path.join(OUT, "therm_h2o2.dat"), "w") as fh:
+        fh.write(therm)
+    mech = (
+        "! GRI-3.0-derived H2/O2/N2/AR subsystem — embedded fixture for\n"
+        "! pychemkin_tpu (reference ships no mechanisms; see tools/gen_mech_data.py)\n"
+        "ELEMENTS\nO  H  N  AR\nEND\n"
+        "SPECIES\n" + "  ".join(species) + "\nEND\n"
+        + therm +
+        "REACTIONS\n" + H2O2_REACTIONS + "END\n")
+    with open(os.path.join(OUT, "h2o2.inp"), "w") as fh:
+        fh.write(mech)
+    tran_lines = []
+    for n, (g, e, s, d, p, z) in TRANSPORT.items():
+        tran_lines.append(
+            f"{n:<16s}{g:4d}{e:10.3f}{s:10.3f}{d:10.3f}{p:10.3f}{z:10.3f}")
+    with open(os.path.join(OUT, "tran_h2o2.dat"), "w") as fh:
+        fh.write("\n".join(tran_lines) + "\n")
+    print(f"wrote h2o2 fixture: {len(species)} species")
+
+
+def write_grisyn(seed=20260729, n_extra_species=43, n_reactions=298):
+    """Synthetic GRI-3.0-sized mechanism: the 10 real H2/O2 species plus
+    CHON pseudo-species with smooth, consistent NASA-7 fits; 325 reactions
+    total (26 real H2/O2 + synthetic), with a GRI-like mix of plain,
+    third-body, and Troe-falloff reactions. For PERFORMANCE WORK ONLY."""
+    rng = np.random.default_rng(seed)
+    species = list(THERMO.keys())
+    synth = {}
+    for i in range(n_extra_species):
+        nC = int(rng.integers(0, 4))
+        nH = int(rng.integers(0, 9))
+        nO = int(rng.integers(0, 3))
+        if nC == 0 and nH == 0 and nO == 0:
+            nC, nH = 1, 4
+        name = f"S{i:02d}C{nC}H{nH}O{nO}"
+        comp = {k: v for k, v in (("C", nC), ("H", nH), ("O", nO)) if v}
+        natoms = nC + nH + nO
+        # plausible cp/R: rises from ~3+1.5*natoms to ~3+2.5*natoms
+        cp0 = 3.0 + 1.2 * natoms + rng.uniform(-0.5, 0.5)
+        cp_slope = (0.8 * natoms + rng.uniform(0, 1)) / 3000.0
+        a1 = cp0
+        a2 = cp_slope
+        hf_R = rng.uniform(-3e4, 2e4)  # h_f/R at 0 K-ish
+        a6 = hf_R
+        a7 = rng.uniform(2.0, 15.0)
+        lo = [a1, a2, 0.0, 0.0, 0.0, a6, a7]
+        hi = list(lo)
+        synth[name] = (comp, 200.0, 1000.0, 3500.0, lo, hi)
+    all_thermo = dict(THERMO)
+    all_thermo.update(synth)
+    species = list(all_thermo.keys())
+
+    # build balanced synthetic reactions: A + B <=> C + D with element balance
+    # enforced by constructing products from reactant element pool via a
+    # greedy decomposition into existing species.
+    comp_of = {n: dict(all_thermo[n][0]) for n in species}
+    names = [n for n in species if n not in ("AR", "N2")]
+    rxn_lines = []
+    count = 0
+    attempts = 0
+    while count < n_reactions and attempts < 200000:
+        attempts += 1
+        a, b = rng.choice(names, 2, replace=False)
+        pool = {}
+        for s_ in (a, b):
+            for el, n_ in comp_of[s_].items():
+                pool[el] = pool.get(el, 0) + n_
+        # find product pair with identical pool
+        cands = []
+        for c in names:
+            rem = dict(pool)
+            ok = True
+            for el, n_ in comp_of[c].items():
+                if rem.get(el, 0) < n_:
+                    ok = False
+                    break
+                rem[el] -= n_
+            if not ok:
+                continue
+            for d in names:
+                if comp_of[d] == {el: n_ for el, n_ in rem.items() if n_}:
+                    cands.append((c, d))
+                    break
+        cands = [cd for cd in cands if set(cd) != {a, b}]
+        if not cands:
+            continue
+        c, d = cands[int(rng.integers(0, len(cands)))]
+        A = 10 ** rng.uniform(8, 15)
+        beta = rng.uniform(-1.5, 2.0)
+        Ea = rng.uniform(0, 45000)
+        kind = rng.uniform()
+        eq = f"{a}+{b}<=>{c}+{d}"
+        if kind < 0.85:
+            rxn_lines.append(f"{eq:<48s}{A:10.3E}{beta:9.3f}{Ea:12.2f}")
+        elif kind < 0.95:
+            eq = f"{a}+{b}+M<=>{c}+{d}+M"
+            rxn_lines.append(f"{eq:<48s}{A:10.3E}{beta:9.3f}{Ea:12.2f}")
+            rxn_lines.append("H2O/6.0/ H2/2.0/")
+        else:
+            eq = f"{a}+{b}(+M)<=>{c}+{d}(+M)"
+            rxn_lines.append(f"{eq:<48s}{A:10.3E}{beta:9.3f}{Ea:12.2f}")
+            rxn_lines.append(f"LOW/{A*1e3:10.3E} {beta-0.5:6.3f} {max(Ea-2000,0):10.2f}/")
+            rxn_lines.append("TROE/0.6 100.0 1500.0 5000.0/")
+        count += 1
+    if count < n_reactions:
+        raise RuntimeError(f"only built {count} synthetic reactions")
+
+    cards = "\n".join(
+        thermo_card(n, *all_thermo[n], i + 1) for i, n in enumerate(species))
+    mech = (
+        "! SYNTHETIC GRI-3.0-sized mechanism (53 species / 325 reactions).\n"
+        "! Real H2/O2 subsystem + generated CHON pseudo-species. Tensor shapes\n"
+        "! and reaction-type mix match GRI-3.0; NOT a validated chemistry model.\n"
+        "! Generated by tools/gen_mech_data.py (seeded, reproducible).\n"
+        "ELEMENTS\nO  H  N  AR  C\nEND\n"
+        "SPECIES\n" + "\n".join("  ".join(species[i:i + 8])
+                                 for i in range(0, len(species), 8)) + "\nEND\n"
+        "THERMO ALL\n   200.000  1000.000  5000.000\n" + cards + "\nEND\n"
+        "REACTIONS\n" + H2O2_REACTIONS + "\n".join(rxn_lines) + "\nEND\n")
+    with open(os.path.join(OUT, "grisyn.inp"), "w") as fh:
+        fh.write(mech)
+    print(f"wrote grisyn fixture: {len(species)} species, {27 + count} reactions")
+
+
+if __name__ == "__main__":
+    os.makedirs(OUT, exist_ok=True)
+    repair_continuity()
+    write_h2o2()
+    write_grisyn()
