@@ -75,6 +75,7 @@ class _ReactionDraft:
     tb_collider: int = -1  # species index for TB_SPECIES
     efficiencies: dict = field(default_factory=dict)
     falloff_type: int = FALLOFF_NONE
+    chem_act: bool = False
     low: tuple | None = None
     high: tuple | None = None  # for chemically-activated (HIGH keyword)
     troe: tuple | None = None
@@ -609,13 +610,12 @@ class MechanismParser:
                 if len(vals) != 3:
                     raise MechanismError(f"HIGH needs 3 numbers: {line!r}")
                 rxn.high = tuple(vals)
-                rxn.falloff_type = FALLOFF_CHEM_ACT
+                rxn.chem_act = True
             elif head == "TROE":
                 if len(vals) not in (3, 4):
                     raise MechanismError(f"TROE needs 3 or 4 numbers: {line!r}")
                 rxn.troe = tuple(vals)
-                if rxn.falloff_type != FALLOFF_CHEM_ACT:
-                    rxn.falloff_type = FALLOFF_TROE
+                rxn.falloff_type = FALLOFF_TROE
             elif head == "SRI":
                 if len(vals) not in (3, 5):
                     raise MechanismError(f"SRI needs 3 or 5 numbers: {line!r}")
@@ -707,6 +707,7 @@ class MechanismParser:
         tb_type = np.zeros(II, dtype=np.int32)
         tb_eff = np.zeros((II, KK))
         falloff_type = np.zeros(II, dtype=np.int32)
+        is_chem_act = np.zeros(II, dtype=bool)
         low_A = np.zeros(II)
         low_beta = np.zeros(II)
         low_Ea_R = np.zeros(II)
@@ -748,22 +749,27 @@ class MechanismParser:
             elif rx.tb_type == TB_SPECIES:
                 tb_eff[i, rx.tb_collider] = 1.0
             falloff_type[i] = rx.falloff_type
-            if rx.falloff_type in (FALLOFF_LINDEMANN, FALLOFF_TROE, FALLOFF_SRI):
-                if rx.low is None:
-                    raise MechanismError(
-                        f"falloff reaction missing LOW line: {rx.equation!r}")
-                low_A[i] = rx.low[0]
-                low_beta[i] = rx.low[1]
-                low_Ea_R[i] = rx.low[2] * self.e_factor * cal_to_K
-            elif rx.falloff_type == FALLOFF_CHEM_ACT:
+            is_chem_act[i] = rx.chem_act
+            if rx.chem_act:
                 # chem-activated: the rate line is the LOW limit, HIGH aux line
-                # gives the high-pressure limit
+                # gives the high-pressure limit. TROE/SRI broadening composes.
+                if rx.low is not None:
+                    raise MechanismError(
+                        f"both LOW and HIGH given: {rx.equation!r}")
                 low_A[i] = A[i]
                 low_beta[i] = beta[i]
                 low_Ea_R[i] = Ea_R[i]
                 A[i] = rx.high[0]
                 beta[i] = rx.high[1]
                 Ea_R[i] = rx.high[2] * self.e_factor * cal_to_K
+            elif rx.falloff_type in (FALLOFF_LINDEMANN, FALLOFF_TROE,
+                                     FALLOFF_SRI):
+                if rx.low is None:
+                    raise MechanismError(
+                        f"falloff reaction missing LOW line: {rx.equation!r}")
+                low_A[i] = rx.low[0]
+                low_beta[i] = rx.low[1]
+                low_Ea_R[i] = rx.low[2] * self.e_factor * cal_to_K
             if rx.troe is not None:
                 t = list(rx.troe)
                 if len(t) == 3:
@@ -815,7 +821,7 @@ class MechanismParser:
             reversible=reversible, has_rev_params=has_rev,
             rev_A=rev_A, rev_beta=rev_beta, rev_Ea_R=rev_Ea_R,
             tb_type=tb_type, tb_eff=tb_eff,
-            falloff_type=falloff_type,
+            falloff_type=falloff_type, is_chem_act=is_chem_act,
             low_A=low_A, low_beta=low_beta, low_Ea_R=low_Ea_R,
             troe=troe, sri=sri,
             **plog_arrays,

@@ -21,12 +21,13 @@ from typing import Any
 import jax
 import numpy as np
 
-# falloff_type codes
+# falloff_type codes (broadening form; orthogonal to is_chem_act)
 FALLOFF_NONE = 0
 FALLOFF_LINDEMANN = 1
 FALLOFF_TROE = 2
 FALLOFF_SRI = 3
-# chemically-activated (kf scales with 1/(1+Pr) instead of Pr/(1+Pr))
+# legacy alias: chemically-activated is now carried by the separate
+# is_chem_act flag so TROE/SRI broadening composes with it
 FALLOFF_CHEM_ACT = 4
 
 # third-body codes
@@ -83,7 +84,9 @@ class MechanismRecord:
     # ---- third body / falloff ----------------------------------------------
     tb_type: Any = None    # [II] int: TB_NONE / TB_MIXTURE / TB_SPECIES
     tb_eff: Any = None     # [II, KK] third-body efficiencies (0 where unused)
-    falloff_type: Any = None  # [II] int
+    falloff_type: Any = None  # [II] int (broadening: NONE/LINDEMANN/TROE/SRI)
+    is_chem_act: Any = None   # [II] bool: chemically-activated (HIGH keyword);
+    #                           rate uses k_low/(1+Pr) instead of kinf*Pr/(1+Pr)
     low_A: Any = None      # [II] low-pressure-limit Arrhenius (falloff)
     low_beta: Any = None
     low_Ea_R: Any = None

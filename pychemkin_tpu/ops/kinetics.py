@@ -1,0 +1,269 @@
+"""Gas-phase kinetics kernels (JAX) — the hot path of the framework.
+
+TPU-native replacement for ``KINGetGasROP`` (reference:
+chemkin_wrapper.py:482, called from mixture.py:1442) and
+``KINGetGasReactionRates`` (chemkin_wrapper.py:490, mixture.py:1551).
+Where the reference evaluates ONE state per ctypes call, these kernels are
+pure functions of (mechanism, T, P, Y) designed to be ``vmap``-ed over
+thousands of states and ``shard_map``-ed over a device mesh.
+
+TPU-first design notes:
+- Rate-of-progress products are computed as ``exp(nu_f @ ln C)`` — a dense
+  [II, KK] matmul that maps onto the MXU, instead of the gather/scatter
+  loops a CPU code would use. Species production rates are the transpose
+  matmul ``nu^T q``.
+- Temperature-range and reaction-type selection is all ``jnp.where`` masking
+  (no data-dependent control flow), so a single fused XLA computation covers
+  plain/third-body/falloff/PLOG reactions at once.
+
+Units: CGS + mol (A-factors cm-mol-s, concentrations mol/cm^3, rates
+mol/(cm^3 s), activation temperatures K).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import P_ATM, R_GAS
+from ..mechanism.record import (
+    FALLOFF_CHEM_ACT,
+    FALLOFF_LINDEMANN,
+    FALLOFF_NONE,
+    FALLOFF_SRI,
+    FALLOFF_TROE,
+    TB_MIXTURE,
+)
+from . import thermo
+
+_LN10 = 2.302585092994046
+# IMPORTANT range note: this platform's float64 is TPU-style double-single
+# emulation (two float32s): full-ish mantissa precision but FLOAT32 EXPONENT
+# RANGE. Values below ~1e-38 flush to zero and exp() underflows at ~-88.
+# Every floor/clamp here is chosen to stay inside that range.
+_TINY = 1e-30
+
+
+def _safe_exp(x):
+    """exp with the argument clipped to the emulated-f64 safe range.
+
+    On this platform exp() of huge-magnitude arguments (|x| beyond ~1e4)
+    returns NaN rather than 0/inf (double-single range overflow inside the
+    exp algorithm), and those NaNs poison reverse-mode AD even through
+    jnp.where. exp(±85) ~ 1e∓37 is already numerical zero/saturation."""
+    return jnp.exp(jnp.clip(x, -85.0, 85.0))
+
+
+def _arrhenius(A, beta, Ea_R, T, lnT):
+    """k = A T^beta exp(-Ea_R / T), computed in log space.
+
+    Sign-preserving: negative pre-exponentials are legal CHEMKIN (used in
+    negative-A duplicate pairs); A = 0 yields k = 0 exactly."""
+    mag = _safe_exp(jnp.log(jnp.maximum(jnp.abs(A), _TINY)) + beta * lnT
+                    - Ea_R / T)
+    return jnp.sign(A) * mag
+
+
+def _plog_rate(mech, T, lnT, lnP):
+    """Forward rate constants for the PLOG subset: [IIp].
+
+    Piecewise ln-k vs ln-P interpolation between bracketing pressure levels
+    (flat extrapolation outside the table). Multiple Arrhenius terms at one
+    pressure level are summed in k-space.
+    """
+    if mech.plog_idx.shape[0] == 0:
+        return jnp.zeros((0,), dtype=jnp.result_type(T))
+
+    def one_row(ln_P_row, n_levels, A_row, beta_row, Ea_row):
+        # k at every level: sum over padded terms (padding has A=0)
+        k_terms = A_row * _safe_exp(beta_row * lnT - Ea_row / T)  # [L, Tm]
+        k_lvl = jnp.maximum(k_terms.sum(axis=-1), _TINY)        # [L]
+        ln_k = jnp.log(k_lvl)
+        # bracketing interval
+        idx = jnp.clip(jnp.searchsorted(ln_P_row, lnP) - 1, 0, n_levels - 2)
+        lnp0 = ln_P_row[idx]
+        lnp1 = ln_P_row[idx + 1]
+        w = jnp.clip((lnP - lnp0) / jnp.maximum(lnp1 - lnp0, 1e-12), 0.0, 1.0)
+        return jnp.exp((1.0 - w) * ln_k[idx] + w * ln_k[idx + 1])
+
+    return jax.vmap(one_row)(mech.plog_ln_P, mech.plog_n_levels,
+                             mech.plog_A, mech.plog_beta, mech.plog_Ea_R)
+
+
+def third_body_concentrations(mech, C):
+    """Effective third-body concentration [M] per reaction: [II].
+
+    For TB_MIXTURE rows the efficiency-weighted total; for TB_SPECIES rows
+    the collider's own concentration (one-hot efficiency row); 0 elsewhere.
+    """
+    return mech.tb_eff @ C
+
+
+def forward_rate_constants(mech, T, C, P=None):
+    """Forward rate constants kf [II], including third-body falloff blending
+    and PLOG pressure interpolation.
+
+    ``P`` (dyne/cm^2) is only needed when the mechanism has PLOG reactions;
+    if omitted it is reconstructed from C and T by the ideal-gas law.
+    """
+    lnT = jnp.log(T)
+    k_inf = _arrhenius(mech.A, mech.beta, mech.Ea_R, T, lnT)
+
+    ftype = mech.falloff_type
+    # static structure decision: skip the whole falloff branch when the
+    # mechanism has none (numpy on concrete record leaves; if the record is
+    # itself traced, conservatively include the branch)
+    try:
+        any_falloff = bool(np.any(np.asarray(mech.falloff_type) != FALLOFF_NONE))
+    except jax.errors.TracerArrayConversionError:
+        any_falloff = True
+    if any_falloff:
+        k0 = _arrhenius(mech.low_A, mech.low_beta, mech.low_Ea_R, T, lnT)
+        M = third_body_concentrations(mech, C)
+        Pr = jnp.maximum(k0 * M / jnp.maximum(k_inf, _TINY), 1e-35)
+        log10_Pr = jnp.log(Pr) / _LN10
+
+        # Troe broadening factor. T2* = inf marks the absent 4th parameter;
+        # compute exp on a sanitized finite value and mask, so reverse-mode
+        # AD never sees 0 * inf (the jnp.where NaN-gradient trap).
+        a, T3, T1, T2 = (mech.troe[:, 0], mech.troe[:, 1],
+                         mech.troe[:, 2], mech.troe[:, 3])
+        has_T2 = jnp.isfinite(T2)
+        T2_safe = jnp.where(has_T2, T2, 0.0)
+        term_T2 = jnp.where(has_T2, _safe_exp(-T2_safe / T), 0.0)
+        Fcent = ((1.0 - a) * _safe_exp(-T / jnp.maximum(T3, 1e-30))
+                 + a * _safe_exp(-T / jnp.maximum(T1, 1e-30))
+                 + term_T2)
+        Fcent = jnp.maximum(Fcent, 1e-30)
+        log10_Fc = jnp.log(Fcent) / _LN10
+        c_t = -0.4 - 0.67 * log10_Fc
+        n_t = 0.75 - 1.27 * log10_Fc
+        f1 = (log10_Pr + c_t) / (n_t - 0.14 * (log10_Pr + c_t))
+        log10_F_troe = log10_Fc / (1.0 + f1 * f1)
+        F_troe = _safe_exp(_LN10 * log10_F_troe)
+
+        # SRI broadening factor
+        sa, sb, sc, sd, se = (mech.sri[:, 0], mech.sri[:, 1], mech.sri[:, 2],
+                              mech.sri[:, 3], mech.sri[:, 4])
+        x_sri = 1.0 / (1.0 + log10_Pr * log10_Pr)
+        base = jnp.maximum(sa * _safe_exp(-sb / T)
+                           + _safe_exp(-T / jnp.maximum(sc, 1e-30)), _TINY)
+        F_sri = sd * _safe_exp(x_sri * jnp.log(base)) * _safe_exp(se * lnT)
+
+        F = jnp.where(ftype == FALLOFF_TROE, F_troe,
+                      jnp.where(ftype == FALLOFF_SRI, F_sri, 1.0))
+        # fall-off (LOW given): kinf * Pr/(1+Pr) * F
+        # chemically activated (HIGH given): k_low * 1/(1+Pr) * F
+        # — broadening F composes with both forms
+        blend = jnp.where(mech.is_chem_act,
+                          k0 / (1.0 + Pr),
+                          k_inf * Pr / (1.0 + Pr))
+        kf = jnp.where(ftype != FALLOFF_NONE, blend * F, k_inf)
+    else:
+        kf = k_inf
+
+    if mech.plog_idx.shape[0] > 0:
+        if P is None:
+            P = jnp.sum(C) * R_GAS * T
+        k_plog = _plog_rate(mech, T, lnT, jnp.log(P))
+        kf = kf.at[mech.plog_idx].set(k_plog)
+    return kf
+
+
+def ln_equilibrium_constants(mech, T):
+    """ln Kc [II] (unclipped):
+    ln Kc = -sum_k nu_ki g_k/(RT) + (sum_k nu_ki) ln(P_atm / (R T))."""
+    nu = mech.nu_r - mech.nu_f           # [II, KK]
+    g = thermo.g_RT(mech, T)             # [KK]
+    dnu = nu.sum(axis=1)                 # [II]
+    return -(nu @ g) + dnu * jnp.log(P_ATM / (R_GAS * T))
+
+
+def equilibrium_constants(mech, T):
+    """Concentration-based equilibrium constants Kc [II], reproducing the
+    reference's reverse-rate construction from thermochemistry (native;
+    surfaced through KINGetGasReactionRates).
+
+    Clamped to the emulated-f64 exponent range (float32 exponents): beyond
+    |ln Kc| ~ 85 the corresponding reverse rate is numerically zero/infinite
+    anyway, and overflow to inf turns into NaN under double-single
+    multiplication."""
+    return _safe_exp(ln_equilibrium_constants(mech, T))
+
+
+def reverse_rate_constants(mech, T, kf):
+    """Reverse rate constants kr [II]: from Kc for reversible reactions,
+    from explicit REV parameters where given, 0 for irreversible.
+
+    Computed entirely in log space (ln kr = ln kf - ln Kc): dividing by a
+    large Kc would square it inside the division's derivative and overflow
+    the float32 exponent range of the emulated f64."""
+    ln_Kc = ln_equilibrium_constants(mech, T)
+    ln_kr = jnp.log(jnp.maximum(kf, _TINY)) - ln_Kc
+    kr_thermo = _safe_exp(ln_kr)
+    lnT = jnp.log(T)
+    kr_explicit = _arrhenius(mech.rev_A, mech.rev_beta, mech.rev_Ea_R, T, lnT)
+    kr = jnp.where(mech.has_rev_params, kr_explicit, kr_thermo)
+    return jnp.where(mech.reversible, kr, 0.0)
+
+
+def rates_of_progress(mech, T, C, P=None):
+    """Net rate of progress q [II] in mol/(cm^3 s), plus (qf, qr).
+
+    q_i = [M]_i^(tb) * (kf_i prod_k C_k^nu'_ki - kr_i prod_k C_k^nu''_ki)
+    with the [M] multiplier applied only to non-falloff +M reactions.
+    """
+    kf = forward_rate_constants(mech, T, C, P)
+    kr = reverse_rate_constants(mech, T, kf)
+    lnC = jnp.log(jnp.maximum(C, _TINY))
+    # MXU-friendly concentration products
+    prod_f = _safe_exp(mech.nu_f @ lnC)
+    prod_r = _safe_exp(mech.nu_r @ lnC)
+    qf = kf * prod_f
+    qr = kr * prod_r
+    plain_tb = (mech.tb_type == TB_MIXTURE) & (mech.falloff_type == FALLOFF_NONE)
+    M = third_body_concentrations(mech, C)
+    tb_mult = jnp.where(plain_tb, M, 1.0)
+    return tb_mult * (qf - qr), tb_mult * qf, tb_mult * qr
+
+
+def net_production_rates(mech, T, C, P=None):
+    """Species net molar production rates omega_dot [KK], mol/(cm^3 s)."""
+    q, _, _ = rates_of_progress(mech, T, C, P)
+    return (mech.nu_r - mech.nu_f).T @ q
+
+
+def rop(mech, T, P, Y):
+    """The reference's ``Mixture.ROP`` kernel (mixture.py:1354-1442):
+    net species production rates from (T, P, mass fractions).
+
+    Returns omega_dot [KK] in mol/(cm^3 s)."""
+    rho = thermo.density(mech, T, P, Y)
+    C = thermo.Y_to_C(mech, Y, rho)
+    return net_production_rates(mech, T, C, P)
+
+
+def reaction_rates(mech, T, P, Y):
+    """The reference's ``Mixture.RxnRates`` kernel (mixture.py:1457-1551):
+    forward and reverse rates of progress per reaction.
+
+    Returns (qf, qr) each [II] in mol/(cm^3 s)."""
+    rho = thermo.density(mech, T, P, Y)
+    C = thermo.Y_to_C(mech, Y, rho)
+    _, qf, qr = rates_of_progress(mech, T, C, P)
+    return qf, qr
+
+
+def volumetric_heat_release_rate(mech, T, P, Y):
+    """Volumetric heat release rate [erg/(cm^3 s)] (reference volHRR,
+    mixture.py:2172): -sum_k h_k(molar) * omega_dot_k."""
+    wdot = rop(mech, T, P, Y)
+    h_molar = thermo.h_RT(mech, T) * R_GAS * T
+    return -jnp.dot(h_molar, wdot)
+
+
+def mass_production_rates(mech, T, P, Y):
+    """Species mass production rates [g/(cm^3 s)] (reference massROP,
+    mixture.py:2204)."""
+    return rop(mech, T, P, Y) * mech.wt

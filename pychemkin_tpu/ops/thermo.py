@@ -1,0 +1,193 @@
+"""NASA-7 thermodynamic property kernels (JAX).
+
+TPU-native replacement for the reference's native thermo entry points:
+``KINGetGasSpecificHeat`` (chemkin_wrapper.py:375), ``KINGetGasSpeciesEnthalpy``
+(:381), ``KINGetGasSpeciesInternalEnergy`` (:387), ``KINGetMassDensity``
+(:398), mixture Cp/H (:427-440), ``KINGetGamma`` (:582) and the fraction
+conversions (:855-867).
+
+All functions are pure, jit/vmap-transparent, and take the
+:class:`MechanismRecord` as their first argument. Units are CGS + mol + K:
+energies erg, pressures dyne/cm^2, concentrations mol/cm^3, specific
+(per-mass) quantities erg/g. Temperature-range selection between the two
+NASA-7 fits uses ``jnp.where`` on Tmid per species — no data-dependent
+control flow, so everything tiles cleanly under jit.
+
+Shapes: T is scalar (vmap for batches); species arrays are [KK].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..constants import R_GAS
+
+
+def _select_coeffs(mech, T):
+    """Per-species NASA-7 coefficient selection: [KK, 7]."""
+    t_mid = mech.nasa_T[:, 1]
+    lo = mech.nasa_coeffs[:, 0, :]
+    hi = mech.nasa_coeffs[:, 1, :]
+    return jnp.where((T < t_mid)[:, None], lo, hi)
+
+
+def cp_R(mech, T):
+    """Species molar heat capacity Cp/R, [KK] (dimensionless)."""
+    a = _select_coeffs(mech, T)
+    return a[:, 0] + T * (a[:, 1] + T * (a[:, 2] + T * (a[:, 3] + T * a[:, 4])))
+
+
+def h_RT(mech, T):
+    """Species molar enthalpy h/(RT), [KK] (dimensionless)."""
+    a = _select_coeffs(mech, T)
+    return (a[:, 0] + T * (a[:, 1] / 2 + T * (a[:, 2] / 3
+            + T * (a[:, 3] / 4 + T * a[:, 4] / 5))) + a[:, 5] / T)
+
+
+def s_R(mech, T):
+    """Species molar entropy s/R at standard pressure, [KK]."""
+    a = _select_coeffs(mech, T)
+    return (a[:, 0] * jnp.log(T) + T * (a[:, 1] + T * (a[:, 2] / 2
+            + T * (a[:, 3] / 3 + T * a[:, 4] / 4))) + a[:, 6])
+
+
+def g_RT(mech, T):
+    """Species standard-state Gibbs energy g/(RT) = h/(RT) - s/R, [KK]."""
+    return h_RT(mech, T) - s_R(mech, T)
+
+
+def cv_R(mech, T):
+    """Species molar heat capacity Cv/R (ideal gas), [KK]."""
+    return cp_R(mech, T) - 1.0
+
+
+def u_RT(mech, T):
+    """Species molar internal energy u/(RT), [KK]."""
+    return h_RT(mech, T) - 1.0
+
+
+# --- mass-based species properties (reference: SpeciesCp/Cv/H/U,
+# chemistry.py:1069-1314, in erg/g or erg/g-K) -------------------------------
+
+def species_cp_mass(mech, T):
+    """[KK] erg/(g K)."""
+    return cp_R(mech, T) * R_GAS / mech.wt
+
+
+def species_cv_mass(mech, T):
+    return cv_R(mech, T) * R_GAS / mech.wt
+
+
+def species_enthalpy_mass(mech, T):
+    """[KK] erg/g."""
+    return h_RT(mech, T) * R_GAS * T / mech.wt
+
+
+def species_internal_energy_mass(mech, T):
+    return u_RT(mech, T) * R_GAS * T / mech.wt
+
+
+# --- composition conversions (reference: chemkin_wrapper.py:855-867) --------
+
+def mean_molecular_weight_X(mech, X):
+    """Mean molar mass from mole fractions, g/mol (reference WTM,
+    mixture.py:541)."""
+    return jnp.dot(X, mech.wt)
+
+
+def mean_molecular_weight_Y(mech, Y):
+    """Mean molar mass from mass fractions, g/mol.
+
+    Guarded against all-zero Y (returns a huge-but-finite weight instead of
+    inf, so downstream kernels produce zeros rather than NaN)."""
+    return 1.0 / jnp.maximum(jnp.dot(Y, 1.0 / mech.wt), 1e-30)
+
+
+def X_to_Y(mech, X):
+    """Mole fractions -> mass fractions."""
+    wx = X * mech.wt
+    return wx / jnp.sum(wx)
+
+
+def Y_to_X(mech, Y):
+    """Mass fractions -> mole fractions."""
+    n = Y / mech.wt
+    return n / jnp.sum(n)
+
+
+def Y_to_C(mech, Y, rho):
+    """Mass fractions + density -> molar concentrations [mol/cm^3]."""
+    return rho * Y / mech.wt
+
+
+def X_to_C(mech, X, T, P):
+    """Mole fractions + (T, P) -> molar concentrations [mol/cm^3]."""
+    return X * P / (R_GAS * T)
+
+
+# --- equation of state (ideal gas; real-gas cubic EOS is a phase-2 module) --
+
+def density(mech, T, P, Y):
+    """Mass density rho = P Wbar / (R T), g/cm^3 (reference RHO,
+    mixture.py:1092 -> KINGetMassDensity chemkin_wrapper.py:398)."""
+    return P * mean_molecular_weight_Y(mech, Y) / (R_GAS * T)
+
+
+def pressure(mech, T, rho, Y):
+    """P from rho (ideal gas), dyne/cm^2."""
+    return rho * R_GAS * T / mean_molecular_weight_Y(mech, Y)
+
+
+# --- mixture-averaged properties (reference: mixture.py:1150-1699) ----------
+
+def mixture_cp_mass(mech, T, Y):
+    """Mixture specific heat, erg/(g K) (reference mixture_specific_heat,
+    mixture.py:1150)."""
+    return jnp.dot(Y, species_cp_mass(mech, T))
+
+
+def mixture_cv_mass(mech, T, Y):
+    return jnp.dot(Y, species_cv_mass(mech, T))
+
+
+def mixture_enthalpy_mass(mech, T, Y):
+    """Mixture specific enthalpy, erg/g (reference mixture_enthalpy,
+    mixture.py:1255)."""
+    return jnp.dot(Y, species_enthalpy_mass(mech, T))
+
+
+def mixture_internal_energy_mass(mech, T, Y):
+    return jnp.dot(Y, species_internal_energy_mass(mech, T))
+
+
+def mixture_enthalpy_molar(mech, T, X):
+    """Mixture molar enthalpy, erg/mol (reference HML, mixture.py:1599)."""
+    return jnp.dot(X, h_RT(mech, T)) * R_GAS * T
+
+
+def mixture_cp_molar(mech, T, X):
+    """Mixture molar Cp, erg/(mol K) (reference CPBL, mixture.py:1646)."""
+    return jnp.dot(X, cp_R(mech, T)) * R_GAS
+
+
+def mixture_entropy_molar(mech, T, P, X):
+    """Mixture molar entropy including mixing terms, erg/(mol K)."""
+    from ..constants import P_ATM
+    x_safe = jnp.maximum(X, 1e-30)
+    s_mix = s_R(mech, T) - jnp.log(x_safe) - jnp.log(P / P_ATM)
+    return jnp.dot(X, s_mix) * R_GAS
+
+
+def gamma(mech, T, Y):
+    """Ratio of specific heats (reference KINGetGamma,
+    chemkin_wrapper.py:582)."""
+    cp = mixture_cp_mass(mech, T, Y)
+    wbar = mean_molecular_weight_Y(mech, Y)
+    cv = cp - R_GAS / wbar
+    return cp / cv
+
+
+def sound_speed(mech, T, P, Y):
+    """Frozen sound speed, cm/s."""
+    rho = density(mech, T, P, Y)
+    return jnp.sqrt(gamma(mech, T, Y) * P / rho)

@@ -1,0 +1,284 @@
+"""Unit tests of the kinetics kernels: hand-computed Arrhenius rates,
+falloff limits, equilibrium/reverse-rate consistency, and the conservation
+invariants every ROP evaluation must satisfy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu.constants import P_ATM, R_GAS
+from pychemkin_tpu.mechanism import load_embedded, load_mechanism_from_strings
+from pychemkin_tpu.ops import kinetics, thermo
+
+THERM_AB = """\
+THERMO ALL
+   300.000  1000.000  5000.000
+A                 test  H   2               G   300.000  5000.000 1000.00      1
+ 2.50000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00    2
+ 1.00000000E+03 5.00000000E+00 2.50000000E+00 0.00000000E+00 0.00000000E+00    3
+ 0.00000000E+00 0.00000000E+00 1.00000000E+03 5.00000000E+00                   4
+B                 test  H   2               G   300.000  5000.000 1000.00      1
+ 2.50000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00    2
+ 0.00000000E+00 0.00000000E+00 2.50000000E+00 0.00000000E+00 0.00000000E+00    3
+ 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00                   4
+END
+"""
+
+
+def _tiny(reactions, extra=""):
+    mech = ("ELEMENTS\nH\nEND\nSPECIES\nA B\nEND\n"
+            "REACTIONS" + extra + "\n" + reactions + "\nEND\n")
+    return load_mechanism_from_strings(mech, thermo_text=THERM_AB)
+
+
+@pytest.fixture(scope="module")
+def h2o2():
+    return load_embedded("h2o2")
+
+
+class TestRateConstants:
+    def test_plain_arrhenius_hand_value(self, h2o2):
+        """O+H2<=>H+OH: A=3.87e4, b=2.7, Ea=6260 cal/mol."""
+        T = 1500.0
+        C = np.full(h2o2.n_species, 1e-6)
+        kf = kinetics.forward_rate_constants(h2o2, T, jnp.asarray(C))
+        i = list(h2o2.reaction_equations).index("O+H2<=>H+OH")
+        expect = 3.87e4 * T**2.7 * np.exp(-6260.0 / (1.987204258640832 * T))
+        np.testing.assert_allclose(float(kf[i]), expect, rtol=1e-7)
+
+    def test_negative_activation_energy(self, h2o2):
+        """2OH<=>O+H2O has Ea = -2110 cal/mol: hand value at 500 K, and
+        kf/T^2.4 (the exp(-Ea/RT) part) must DECREASE with T."""
+        C = jnp.full(h2o2.n_species, 1e-6)
+        i = list(h2o2.reaction_equations).index("2OH<=>O+H2O")
+        k1 = kinetics.forward_rate_constants(h2o2, 500.0, C)[i]
+        expect = 3.57e4 * 500.0**2.4 * np.exp(2110.0 / (1.987204258640832 * 500.0))
+        np.testing.assert_allclose(float(k1), expect, rtol=1e-7)
+        k2 = kinetics.forward_rate_constants(h2o2, 1500.0, C)[i]
+        assert float(k1) / 500.0**2.4 > float(k2) / 1500.0**2.4
+
+    def test_negative_A_duplicate_pair(self):
+        """Negative pre-exponentials (negative-A duplicate pairs) must
+        subtract, not clamp to zero."""
+        rec = _tiny("A<=>B 5.0E10 0.0 0.0\nDUP\nA<=>B -2.0E10 0.0 0.0\nDUP")
+        C = jnp.array([1e-6, 0.0])
+        kf = kinetics.forward_rate_constants(rec, 1000.0, C)
+        np.testing.assert_allclose(float(kf.sum()), 3e10, rtol=1e-6)
+        w = kinetics.net_production_rates(rec, 1000.0, C)
+        np.testing.assert_allclose(float(w[1]), 3e10 * 1e-6, rtol=1e-6)
+
+    def test_chemically_activated_with_troe(self):
+        """HIGH + TROE: the broadening factor must compose with the
+        chem-activated 1/(1+Pr) form (k -> k_low as [M] -> 0)."""
+        rec = _tiny(
+            "A(+M)<=>B(+M) 1.0E6 0.0 0.0\n"
+            "HIGH/1.0E12 0.0 0.0/\n"
+            "TROE/0.6 100.0 2000.0/")
+        T = 1000.0
+        # as [M] -> 0: Pr -> 0, F -> 1, k -> k_low = 1e6
+        k_lo = kinetics.forward_rate_constants(rec, T, jnp.full(2, 1e-22))
+        np.testing.assert_allclose(float(k_lo[0]), 1e6, rtol=5e-2)
+        # mid-pressure: hand-compute chem-act Lindemann x Troe F
+        C = jnp.full(2, 1e-6)
+        M = 2e-6
+        k0, kinf = 1e6, 1e12
+        Pr = (k0 / kinf) * M * kinf / k0  # = M * k0*... careful below
+        # Pr = k_low*[M]/k_inf per the chem-act convention used in the kernel
+        Pr = k0 * M / kinf
+        log10_Pr = np.log10(Pr)
+        Fcent = 0.4 * np.exp(-T / 100.0) + 0.6 * np.exp(-T / 2000.0)
+        lf = np.log10(Fcent)
+        c = -0.4 - 0.67 * lf
+        n = 0.75 - 1.27 * lf
+        f1 = (log10_Pr + c) / (n - 0.14 * (log10_Pr + c))
+        F = 10 ** (lf / (1 + f1**2))
+        expect = k0 / (1.0 + Pr) * F
+        k_mid = kinetics.forward_rate_constants(rec, T, C)
+        np.testing.assert_allclose(float(k_mid[0]), expect, rtol=1e-6)
+        assert abs(F - 1.0) > 0.05  # the test is vacuous if F ~ 1
+
+    def test_falloff_high_pressure_limit(self, h2o2):
+        """2OH(+M)<=>H2O2(+M): as [M] -> inf, kf -> k_inf (Troe F -> 1)."""
+        T = 1200.0
+        i = list(h2o2.reaction_equations).index("2OH(+M)<=>H2O2(+M)")
+        C_huge = jnp.full(h2o2.n_species, 1e6)   # absurdly dense
+        kf = kinetics.forward_rate_constants(h2o2, T, C_huge)
+        k_inf = 7.4e13 * T**(-0.37)
+        np.testing.assert_allclose(float(kf[i]), k_inf, rtol=1e-3)
+
+    def test_falloff_low_pressure_limit(self, h2o2):
+        """As [M] -> 0, kf -> k0 [M]."""
+        T = 1200.0
+        i = list(h2o2.reaction_equations).index("2OH(+M)<=>H2O2(+M)")
+        C_tiny = jnp.full(h2o2.n_species, 1e-22)
+        kf = kinetics.forward_rate_constants(h2o2, T, C_tiny)
+        M = float(h2o2.tb_eff[i] @ C_tiny)
+        k0 = 2.3e18 * T**(-0.9) * np.exp(1700.0 / (1.987204258640832 * T))
+        # Troe F approaches 1 only logarithmically as Pr -> 0, so even at
+        # [M] ~ 4e-21 the broadening factor is still ~0.96
+        np.testing.assert_allclose(float(kf[i]), k0 * M, rtol=5e-2)
+
+    def test_troe_between_limits(self, h2o2):
+        T = 1200.0
+        i = list(h2o2.reaction_equations).index("2OH(+M)<=>H2O2(+M)")
+        C_mid = jnp.full(h2o2.n_species, 1e-8)
+        kf_mid = float(kinetics.forward_rate_constants(h2o2, T, C_mid)[i])
+        k_inf = 7.4e13 * T**(-0.37)
+        M = float(h2o2.tb_eff[i] @ C_mid)
+        k0 = 2.3e18 * T**(-0.9) * np.exp(1700.0 / (1.987204258640832 * T))
+        k_lind = k_inf * (k0 * M / k_inf) / (1.0 + k0 * M / k_inf)
+        assert kf_mid < k_lind  # Troe F < 1 narrows the blend
+        assert kf_mid < k_inf and kf_mid < k0 * M
+
+    def test_plog_interpolation(self):
+        rec = _tiny(
+            "A<=>B 1.0E10 0.0 0.0\n"
+            "PLOG/0.1  1.0E8  0.0 0.0/\n"
+            "PLOG/1.0  1.0E10 0.0 0.0/\n"
+            "PLOG/10.0 1.0E12 0.0 0.0/")
+        T = 1000.0
+        # at P = 1 atm exactly: k = 1e10
+        C1 = jnp.array([1.0, 1.0]) * (P_ATM / (R_GAS * T) / 2)
+        kf = kinetics.forward_rate_constants(rec, T, C1)
+        np.testing.assert_allclose(float(kf[0]), 1e10, rtol=1e-8)
+        # at sqrt(0.1*1) atm: log-log midpoint -> k = 1e9
+        Cg = C1 * np.sqrt(0.1)
+        kf = kinetics.forward_rate_constants(rec, T, Cg)
+        np.testing.assert_allclose(float(kf[0]), 1e9, rtol=1e-6)
+        # above table: clamp to top value
+        Ch = C1 * 100.0
+        kf = kinetics.forward_rate_constants(rec, T, Ch)
+        np.testing.assert_allclose(float(kf[0]), 1e12, rtol=1e-6)
+
+    def test_explicit_rev_params(self):
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\nREV/3.0E9 0.0 0.0/")
+        kf = kinetics.forward_rate_constants(rec, 1000.0, jnp.array([1e-6, 1e-6]))
+        kr = kinetics.reverse_rate_constants(rec, 1000.0, kf)
+        np.testing.assert_allclose(float(kr[0]), 3e9, rtol=1e-7)
+
+    def test_irreversible_zero_reverse(self):
+        rec = _tiny("A=>B 1.0E10 0.0 0.0")
+        kf = kinetics.forward_rate_constants(rec, 1000.0, jnp.array([1e-6, 1e-6]))
+        kr = kinetics.reverse_rate_constants(rec, 1000.0, kf)
+        assert float(kr[0]) == 0.0
+
+
+class TestEquilibriumConsistency:
+    def test_kc_identity_mechanism(self):
+        """A<=>B with identical thermo except dH: Kc = exp(-dG/RT)."""
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0")
+        T = 1000.0
+        Kc = kinetics.equilibrium_constants(rec, T)
+        # A has a6=1000 (h/R offset), a7=5 (s/R offset); B has zeros
+        dh_R = -1000.0
+        ds_R = -5.0
+        expect = np.exp(-(dh_R / T - ds_R))
+        np.testing.assert_allclose(float(Kc[0]), expect, rtol=1e-7)
+
+    def test_detailed_balance_at_equilibrium(self):
+        """Net rate of progress vanishes at the equilibrium composition."""
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0")
+        T = 1000.0
+        Kc = float(kinetics.equilibrium_constants(rec, T)[0])
+        Ctot = 1e-5
+        Ca = Ctot / (1 + Kc)
+        Cb = Ctot * Kc / (1 + Kc)
+        q, qf, qr = kinetics.rates_of_progress(rec, T, jnp.array([Ca, Cb]))
+        assert abs(float(q[0])) < 1e-6 * float(qf[0])
+
+    def test_kc_units_dnu(self, h2o2):
+        """H+OH+M<=>H2O+M has dnu=-1: Kc has units cm^3/mol; check against
+        Kp * (RT/Patm)."""
+        T = 1500.0
+        i = list(h2o2.reaction_equations).index("H+OH+M<=>H2O+M")
+        Kc = float(kinetics.equilibrium_constants(h2o2, T)[i])
+        g = np.asarray(thermo.g_RT(h2o2, T))
+        nu = np.asarray(h2o2.nu_r[i] - h2o2.nu_f[i])
+        ln_Kp = -(nu @ g)
+        expect = np.exp(ln_Kp) * (P_ATM / (R_GAS * T)) ** (-1.0)
+        np.testing.assert_allclose(Kc, expect, rtol=1e-7)
+
+
+class TestROP:
+    @pytest.fixture()
+    def state(self, h2o2):
+        Y = np.zeros(h2o2.n_species)
+        Y[h2o2.species_index("H2")] = 0.028
+        Y[h2o2.species_index("O2")] = 0.226
+        Y[h2o2.species_index("N2")] = 0.745
+        Y[h2o2.species_index("H")] = 1e-6
+        Y[h2o2.species_index("OH")] = 1e-6
+        Y /= Y.sum()
+        return 1200.0, 20.0 * P_ATM, jnp.asarray(Y)
+
+    def test_mass_conservation(self, h2o2, state):
+        T, P, Y = state
+        wdot = kinetics.rop(h2o2, T, P, Y)
+        # sum_k wdot_k W_k = 0 (total mass conserved)
+        total = float(jnp.dot(wdot, h2o2.wt))
+        scale = float(jnp.max(jnp.abs(wdot * h2o2.wt)))
+        assert abs(total) < 1e-12 * max(scale, 1e-30)
+
+    def test_element_conservation(self, h2o2, state):
+        T, P, Y = state
+        wdot = np.asarray(kinetics.rop(h2o2, T, P, Y))
+        elems = wdot @ np.asarray(h2o2.ncf)
+        scale = np.abs(wdot).max()
+        np.testing.assert_allclose(elems, 0.0, atol=1e-12 * max(scale, 1e-30))
+
+    def test_h2_consumed_heat_released(self, h2o2, state):
+        T, P, Y = state
+        wdot = kinetics.rop(h2o2, T, P, Y)
+        assert float(wdot[h2o2.species_index("H2")]) < 0.0
+        hrr = kinetics.volumetric_heat_release_rate(h2o2, T, P, Y)
+        assert float(hrr) > 0.0
+
+    def test_third_body_efficiency_effect(self, h2o2):
+        """2O+M<=>O2+M with H2O eff 15.4: ROP of O must rise when N2 is
+        replaced by H2O."""
+        T = 3000.0
+        P = P_ATM
+        Yb = np.zeros(h2o2.n_species)
+        Yb[h2o2.species_index("O")] = 0.5
+        Yb[h2o2.species_index("N2")] = 0.5
+        Yw = np.zeros(h2o2.n_species)
+        Yw[h2o2.species_index("O")] = 0.5
+        Yw[h2o2.species_index("H2O")] = 0.5
+        i = list(h2o2.reaction_equations).index("2O+M<=>O2+M")
+        for Y, label in ((Yb, "N2"), (Yw, "H2O")):
+            rho = thermo.density(h2o2, T, P, jnp.asarray(Y))
+            C = thermo.Y_to_C(h2o2, jnp.asarray(Y), rho)
+            q, _, _ = kinetics.rates_of_progress(h2o2, T, C)
+            if label == "N2":
+                q_n2 = float(q[i])
+            else:
+                q_h2o = float(q[i])
+        assert q_h2o > 2.0 * q_n2
+
+    def test_duplicate_reactions_sum(self):
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\nDUP\nA<=>B 2.0E10 0.0 0.0\nDUP")
+        rec_single = _tiny("A<=>B 3.0E10 0.0 0.0")
+        C = jnp.array([1e-6, 0.0])
+        w_dup = kinetics.net_production_rates(rec, 800.0, C)
+        w_one = kinetics.net_production_rates(rec_single, 800.0, C)
+        # double-single exp/log round-trip costs ~1e-8 relative
+        np.testing.assert_allclose(np.asarray(w_dup), np.asarray(w_one),
+                                   rtol=1e-6)
+
+    def test_jit_vmap_batch(self, h2o2, state):
+        T, P, Y = state
+        B = 32
+        Ts = jnp.linspace(900.0, 1800.0, B)
+        Ys = jnp.tile(Y[None, :], (B, 1))
+        f = jax.jit(jax.vmap(lambda t, y: kinetics.rop(h2o2, t, P, y)))
+        out = f(Ts, Ys)
+        assert out.shape == (B, h2o2.n_species)
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_grad_through_rop(self, h2o2, state):
+        """ROP must be differentiable (sensitivity analysis path)."""
+        T, P, Y = state
+        g = jax.grad(lambda t: kinetics.volumetric_heat_release_rate(
+            h2o2, t, P, Y))(T)
+        assert np.isfinite(float(g)) and float(g) != 0.0

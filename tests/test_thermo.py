@@ -1,0 +1,117 @@
+"""Unit tests of the NASA-7 thermo kernels against literature values.
+
+The reference has no such tests (its math was in the licensed library);
+these anchor the rebuild to known thermochemistry: standard-state heats of
+formation, cp at 298.15 K, and consistency identities.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu.constants import P_ATM, R_GAS, T_STD
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import thermo
+
+ERG_PER_KCAL = 4.184e10
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+class TestSpeciesThermo:
+    def test_cp_n2_298(self, mech):
+        # N2 cp at 298.15 K = 29.12 J/mol/K (NIST)
+        cp = thermo.cp_R(mech, T_STD) * R_GAS  # erg/mol/K
+        k = mech.species_index("N2")
+        np.testing.assert_allclose(cp[k] / 1e7, 29.12, rtol=2e-3)
+
+    def test_cp_h2o_1000(self, mech):
+        # H2O cp at 1000 K = 41.27 J/mol/K (NIST-JANAF)
+        cp = thermo.cp_R(mech, 1000.0) * R_GAS
+        k = mech.species_index("H2O")
+        np.testing.assert_allclose(cp[k] / 1e7, 41.27, rtol=5e-3)
+
+    def test_heats_of_formation_298(self, mech):
+        # standard heats of formation, kcal/mol (JANAF; OH uses the older
+        # 9.40 kcal/mol value that the GRI-3.0 thermo database carries)
+        expected = {"H2O": -57.80, "OH": 9.40, "H": 52.10, "O": 59.56,
+                    "HO2": 2.94, "H2O2": -32.48, "H2": 0.0, "O2": 0.0,
+                    "N2": 0.0, "AR": 0.0}
+        h = thermo.h_RT(mech, T_STD) * R_GAS * T_STD  # erg/mol
+        for name, hf_kcal in expected.items():
+            k = mech.species_index(name)
+            got = float(h[k]) / ERG_PER_KCAL
+            assert abs(got - hf_kcal) < 0.25, (name, got, hf_kcal)
+
+    def test_entropy_o2_298(self, mech):
+        # O2 standard entropy at 298.15 K = 49.0 cal/mol/K (205.1 J/mol/K)
+        s = thermo.s_R(mech, T_STD) * R_GAS
+        k = mech.species_index("O2")
+        np.testing.assert_allclose(s[k] / 1e7, 205.15, rtol=2e-3)
+
+    def test_h_minus_u_is_RT(self, mech):
+        T = 1234.0
+        diff = (thermo.h_RT(mech, T) - thermo.u_RT(mech, T))
+        np.testing.assert_allclose(np.asarray(diff), 1.0, rtol=1e-12)
+
+    def test_cp_is_dh_dT(self, mech):
+        """cp = dh/dT — checks the polynomial integration relationships."""
+        def h_of_T(T):
+            return thermo.h_RT(mech, T) * R_GAS * T
+        T0 = 900.0
+        dh = jax.jacfwd(h_of_T)(T0)
+        cp = thermo.cp_R(mech, T0) * R_GAS
+        np.testing.assert_allclose(np.asarray(dh), np.asarray(cp), rtol=1e-10)
+
+
+class TestMixture:
+    def test_mean_mw_air(self, mech):
+        X = np.zeros(mech.n_species)
+        X[mech.species_index("O2")] = 0.21
+        X[mech.species_index("N2")] = 0.79
+        wtm = thermo.mean_molecular_weight_X(mech, X)
+        np.testing.assert_allclose(float(wtm), 28.85, atol=0.02)
+
+    def test_x_y_roundtrip(self, mech):
+        rng = np.random.default_rng(0)
+        X = rng.random(mech.n_species)
+        X /= X.sum()
+        Y = thermo.X_to_Y(mech, X)
+        X2 = thermo.Y_to_X(mech, Y)
+        np.testing.assert_allclose(np.asarray(X2), X, rtol=1e-12)
+
+    def test_density_air_stp(self, mech):
+        # O2/N2-only air (no argon) at 1 atm, 273.15 K: P Wbar/(R T) with
+        # Wbar = 1/(0.233/31.998 + 0.767/28.014) = 28.84 -> 1.287e-3 g/cm^3
+        Y = np.zeros(mech.n_species)
+        Y[mech.species_index("O2")] = 0.233
+        Y[mech.species_index("N2")] = 0.767
+        rho = thermo.density(mech, 273.15, P_ATM, Y)
+        np.testing.assert_allclose(float(rho), 1.287e-3, rtol=1e-3)
+
+    def test_gamma_air(self, mech):
+        Y = np.zeros(mech.n_species)
+        Y[mech.species_index("O2")] = 0.233
+        Y[mech.species_index("N2")] = 0.767
+        g = thermo.gamma(mech, 300.0, Y)
+        np.testing.assert_allclose(float(g), 1.40, atol=0.005)
+
+    def test_sound_speed_air(self, mech):
+        # ~34300 cm/s at 293 K... (343 m/s)
+        Y = np.zeros(mech.n_species)
+        Y[mech.species_index("O2")] = 0.233
+        Y[mech.species_index("N2")] = 0.767
+        a = thermo.sound_speed(mech, 293.15, P_ATM, Y)
+        np.testing.assert_allclose(float(a), 34330.0, rtol=5e-3)
+
+    def test_jit_vmap(self, mech):
+        """Kernels must be jit- and vmap-transparent."""
+        Ts = jnp.linspace(300.0, 3000.0, 16)
+        f = jax.jit(jax.vmap(lambda T: thermo.cp_R(mech, T)))
+        out = f(Ts)
+        assert out.shape == (16, mech.n_species)
+        assert bool(jnp.all(out > 0))
