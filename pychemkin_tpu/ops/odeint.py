@@ -1,0 +1,380 @@
+"""Stiff ODE integration in JAX — the TPU-native replacement for the
+reference's 0-D transient engine.
+
+In the reference, ``KINAll0D_Calculate`` (chemkin_wrapper.py:688, called from
+batchreactors/batchreactor.py:1158) runs a DASPK-class BDF integration of one
+reactor entirely inside the licensed Fortran library, one reactor per blocking
+FFI call. Here the integrator is a pure JAX function designed to be ``vmap``-ed
+over thousands of initial conditions and sharded over a TPU mesh.
+
+Method: SDIRK3 — Alexander's 3-stage, L-stable, stiffly-accurate singly
+diagonally implicit Runge-Kutta method of order 3 (R. Alexander, SIAM J.
+Numer. Anal. 14 (1977) 1006-1021), with an embedded 2nd-order error estimate
+filtered through (I - h*gamma*J)^-1 for stiff robustness (the filtering used
+by ode23tb). The order conditions are asserted numerically at import, so a
+transcription error cannot survive.
+
+TPU-first design notes:
+- One Newton matrix M = I - h*gamma*J serves all three stages (SDIRK); one
+  LU per step attempt. The Jacobian is ``jax.jacfwd`` of the RHS — for a
+  matmul-heavy combustion RHS this pushes N tangents through the [II, KK]
+  stoichiometry matmuls at once, which is itself MXU work.
+- The Jacobian is refreshed every attempt rather than cached: under ``vmap``
+  a lazily-refreshed Jacobian is evaluated on every iteration regardless
+  (both branches of the mask execute), so caching would only add carried
+  state without saving work in the batched regime this solver targets.
+- All control flow is ``lax.while_loop``/``lax.scan``; updates are masked so
+  the body is a no-op for finished batch elements (a vmapped while_loop body
+  executes for every element until all are done).
+- Event *accumulators* replace dense output: ignition-delay detection (max
+  dT/dt, threshold upcrossings) samples the event signal at the step
+  endpoints AND the two internal SDIRK stages — free, since stage values and
+  stage derivatives are already available — and refines with a quadratic
+  fit, so no trajectory storage is needed beyond the user's output grid.
+
+Shapes: y is [N]; vmap for batches. Times/units are caller-defined (CGS
+seconds in this package).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# SDIRK3 (Alexander 1977): gamma is the root of
+#   g^3 - 3 g^2 + (3/2) g - 1/6 = 0  in (1/6, 1/2)  -> L-stable.
+_GAMMA = 0.435866521508458999416019
+_C2 = (1.0 + _GAMMA) / 2.0
+_A21 = _C2 - _GAMMA
+_B1 = -(6.0 * _GAMMA**2 - 16.0 * _GAMMA + 1.0) / 4.0
+_B2 = (6.0 * _GAMMA**2 - 20.0 * _GAMMA + 5.0) / 4.0
+_B3 = _GAMMA
+
+_A = np.array([
+    [_GAMMA, 0.0, 0.0],
+    [_A21, _GAMMA, 0.0],
+    [_B1, _B2, _B3],      # stiffly accurate: last row = b
+])
+_B = np.array([_B1, _B2, _B3])
+_C = np.array([_GAMMA, _C2, 1.0])
+# Embedded 2nd-order weights: sum(bh)=1, sum(bh*c)=1/2 with bh[2]=0.
+_BH1 = (0.5 - _C[0]) / (_C[1] - _C[0])
+_BHAT = np.array([1.0 - _BH1, _BH1, 0.0])
+_ERR_W = _B - _BHAT
+_ORDER = 3
+
+# Verify the tableau at import: a wrong coefficient cannot survive.
+assert abs(_GAMMA**3 - 3 * _GAMMA**2 + 1.5 * _GAMMA - 1.0 / 6.0) < 1e-12
+assert abs(_B.sum() - 1.0) < 1e-12
+assert abs((_B * _C).sum() - 0.5) < 1e-12
+assert abs((_B * _C**2).sum() - 1.0 / 3.0) < 1e-12
+assert abs((_B @ _A @ _C) - 1.0 / 6.0) < 1e-12
+assert abs(_BHAT.sum() - 1.0) < 1e-12
+assert abs((_BHAT * _C).sum() - 0.5) < 1e-12
+
+_NEWTON_MAX = 8
+_NEWTON_TOL = 0.03     # in the step-error weight norm
+_MIN_FACTOR = 0.2
+_MAX_FACTOR = 5.0
+_SAFETY = 0.9
+_MAX_CONSECUTIVE_REJECTS = 30
+
+
+class Event(NamedTuple):
+    """An event tracked inside the step loop (no dense output needed).
+
+    ``fn(t, y, f) -> scalar`` where f = dy/dt at (t, y).
+
+    kind:
+      "max"      — track the running maximum of fn and its time, refined by a
+                   quadratic fit through in-step samples (ignition by dT/dt
+                   inflection, reference batchreactor.py:482 TIFP).
+      "crossing" — record the FIRST time fn crosses 0 upward, linearly
+                   interpolated within the step (T-rise DTIGN / T-limit TLIM
+                   detection, reference batchreactor.py:462-543).
+    """
+    fn: Callable
+    kind: str = "max"
+
+
+class ODESolution(NamedTuple):
+    ts: Any           # [n_out] output times (== requested grid)
+    ys: Any           # [n_out, N] solution at output times
+    event_times: Any  # [n_events] time of max / first crossing (nan if none)
+    event_values: Any  # [n_events] max value / slope at crossing
+    n_steps: Any
+    n_rejected: Any
+    success: Any      # bool: reached ts[-1] without stalling
+
+
+@dataclasses.dataclass(frozen=True)
+class _Ctrl:
+    rtol: float
+    atol: Any
+    max_steps_per_segment: int
+    h0: float
+    dt_min_rel: float = 5e-14
+
+
+def _norm(x, w):
+    return jnp.sqrt(jnp.mean((x / w) ** 2))
+
+
+def _newton_stage(rhs, t_stage, y_base, z0, h, lu, piv, args, weights):
+    """Solve the SDIRK stage equation z = h * f(t_stage, y_base + gamma*z)
+    by modified Newton with the factored M = I - h*gamma*J.
+
+    Returns (z, converged)."""
+    def body(carry):
+        z, _, it, prev_dn, _ = carry
+        g = z - h * rhs(t_stage, y_base + _GAMMA * z, args)
+        dz = jax.scipy.linalg.lu_solve((lu, piv), -g)
+        z_new = z + dz
+        dn = _norm(dz, weights)
+        dn = jnp.where(jnp.isfinite(dn), dn, jnp.inf)
+        diverged = (it > 0) & (dn > 2.0 * prev_dn)
+        converged = dn < _NEWTON_TOL
+        return z_new, converged, it + 1, dn, diverged
+
+    def cond(carry):
+        _, converged, it, _, diverged = carry
+        return (~converged) & (~diverged) & (it < _NEWTON_MAX)
+
+    init = (z0, jnp.array(False), jnp.array(0), jnp.array(jnp.inf),
+            jnp.array(False))
+    z, converged, _, _, _ = jax.lax.while_loop(cond, body, init)
+    return z, converged
+
+
+def _quad_peak(tq, gq):
+    """Interior maximum of the Lagrange quadratic through the three (t, g)
+    samples; returns (t_peak, g_peak) among {vertex, endpoints}."""
+    t0, t1, t2 = tq
+    g0, g1, g2 = gq
+    # quadratic in s = t - t0
+    s1 = t1 - t0
+    s2 = t2 - t0
+    denom = s1 * s2 * (s2 - s1)
+    denom = jnp.where(jnp.abs(denom) > 0, denom, 1.0)
+    a = (s1 * (g2 - g0) - s2 * (g1 - g0)) / denom
+    b = (s2 * s2 * (g1 - g0) - s1 * s1 * (g2 - g0)) / denom
+    s_v = jnp.where(jnp.abs(a) > 0, -b / jnp.where(a == 0, 1.0, 2.0 * a), 0.0)
+    s_v = jnp.clip(s_v, 0.0, s2)
+    g_v = a * s_v * s_v + b * s_v + g0
+    has_interior_max = a < 0.0
+    cand_t = jnp.stack([t0 + s_v, t0, t2])
+    cand_g = jnp.stack([jnp.where(has_interior_max, g_v, -jnp.inf), g0, g2])
+    i = jnp.argmax(cand_g)
+    return cand_t[i], cand_g[i]
+
+
+def _update_events(events, acc_t, acc_v, samples, active):
+    """Update event accumulators over an accepted step.
+
+    ``samples``: list of (t_j, y_j, f_j) in increasing t — step start, the
+    two internal stage points, and the step end."""
+    if not events:
+        return acc_t, acc_v
+    new_t, new_v = [], []
+    for i, ev in enumerate(events):
+        g = [ev.fn(t, y, f) for (t, y, f) in samples]
+        ts_all = [s[0] for s in samples]
+        if ev.kind == "max":
+            # quadratic through (start, stage2, end) — stage1 is close to
+            # stage2; three well-spread points suffice
+            tp, vp = _quad_peak((ts_all[0], ts_all[2], ts_all[3]),
+                                (g[0], g[2], g[3]))
+            better = active & (vp > acc_v[i])
+            new_t.append(jnp.where(better, tp, acc_t[i]))
+            new_v.append(jnp.where(better, vp, acc_v[i]))
+        elif ev.kind == "crossing":
+            # first upward crossing among consecutive sample pairs
+            not_yet = ~jnp.isfinite(acc_t[i])
+            best_t = acc_t[i]
+            best_v = acc_v[i]
+            found = jnp.array(False)
+            for j in range(len(samples) - 1):
+                g0, g1 = g[j], g[j + 1]
+                t0, t1 = ts_all[j], ts_all[j + 1]
+                crossed = active & not_yet & (~found) & (g0 <= 0.0) & (g1 > 0.0)
+                frac = -g0 / jnp.where(g1 - g0 == 0, 1.0, g1 - g0)
+                tc = t0 + jnp.clip(frac, 0.0, 1.0) * (t1 - t0)
+                best_t = jnp.where(crossed, tc, best_t)
+                best_v = jnp.where(crossed, g1 - g0, best_v)
+                found = found | crossed
+            new_t.append(best_t)
+            new_v.append(best_v)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown event kind {ev.kind!r}")
+    return jnp.stack(new_t), jnp.stack(new_v)
+
+
+def _initial_step(f0, y0, ctrl, t_span):
+    """Cheap starting-step heuristic (scipy-style, simplified)."""
+    if ctrl.h0 > 0:
+        return jnp.asarray(ctrl.h0, dtype=y0.dtype)
+    w = ctrl.atol + ctrl.rtol * jnp.abs(y0)
+    d0 = _norm(y0, w)
+    d1 = _norm(f0, w)
+    h = 0.01 * d0 / jnp.maximum(d1, 1e-30)
+    h = jnp.where((d0 < 1e-6) | (d1 < 1e-6), 1e-8 * t_span, h)
+    return jnp.clip(h, 1e-12 * t_span, 0.1 * t_span)
+
+
+class _StepState(NamedTuple):
+    t: Any
+    y: Any
+    f: Any          # rhs at (t, y)
+    h: Any
+    n_steps: Any
+    n_rejected: Any
+    consec_rej: Any
+    acc_t: Any
+    acc_v: Any
+    stalled: Any
+
+
+def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
+    """Advance from state.t to t_end with adaptive steps (vmap-safe)."""
+    n = state.y.shape[0]
+    dtype = state.y.dtype
+    dt_min = ctrl.dt_min_rel * jnp.maximum(jnp.abs(t_end), 1e-30)
+    budget = state.n_steps + state.n_rejected + ctrl.max_steps_per_segment
+
+    def cond(s):
+        return (s.t < t_end) & (~s.stalled) & (
+            s.n_steps + s.n_rejected < budget)
+
+    def body(s):
+        active = s.t < t_end
+        h = jnp.clip(s.h, dt_min, jnp.maximum(t_end - s.t, dt_min))
+
+        J = jac_fn(s.t, s.y, args)
+        M = jnp.eye(n, dtype=dtype) - (h * _GAMMA) * J
+        lu, piv = jax.scipy.linalg.lu_factor(M)
+
+        w = ctrl.atol + ctrl.rtol * jnp.abs(s.y)
+
+        z0 = h * s.f
+        z1, ok1 = _newton_stage(rhs, s.t + _C[0] * h, s.y, z0, h, lu, piv,
+                                args, w)
+        y_base2 = s.y + _A21 * z1
+        z2, ok2 = _newton_stage(rhs, s.t + _C[1] * h, y_base2, z1, h, lu, piv,
+                                args, w)
+        y_base3 = s.y + _B1 * z1 + _B2 * z2
+        z3, ok3 = _newton_stage(rhs, s.t + h, y_base3, z2, h, lu, piv,
+                                args, w)
+        newton_ok = ok1 & ok2 & ok3
+
+        y_new = y_base3 + _B3 * z3        # stiffly accurate
+        e_raw = _ERR_W[0] * z1 + _ERR_W[1] * z2 + _ERR_W[2] * z3
+        e = jax.scipy.linalg.lu_solve((lu, piv), e_raw)
+        w_new = ctrl.atol + ctrl.rtol * jnp.maximum(jnp.abs(s.y),
+                                                    jnp.abs(y_new))
+        err = _norm(e, w_new)
+        finite = jnp.all(jnp.isfinite(y_new)) & jnp.isfinite(err)
+
+        accept = active & newton_ok & finite & (err <= 1.0)
+
+        err_safe = jnp.maximum(err, 1e-10)
+        fac = _SAFETY * err_safe ** (-1.0 / _ORDER)
+        fac = jnp.where(newton_ok & finite, jnp.clip(fac, _MIN_FACTOR,
+                                                     _MAX_FACTOR), 0.25)
+        h_next = jnp.maximum(h * fac, dt_min)
+
+        # stage derivatives are free: f(t + c_i h, Y_i) = z_i / h
+        h_safe = jnp.maximum(h, 1e-300)
+        samples = [
+            (s.t, s.y, s.f),
+            (s.t + _C[0] * h, s.y + _GAMMA * z1, z1 / h_safe),
+            (s.t + _C[1] * h, y_base2 + _GAMMA * z2, z2 / h_safe),
+            (s.t + h, y_new, z3 / h_safe),
+        ]
+        acc_t, acc_v = _update_events(events, s.acc_t, s.acc_v, samples,
+                                      accept)
+
+        consec = jnp.where(accept, 0, jnp.where(active, s.consec_rej + 1,
+                                                s.consec_rej))
+        stalled = active & (consec >= _MAX_CONSECUTIVE_REJECTS)
+
+        return _StepState(
+            t=jnp.where(accept, s.t + h, s.t),
+            y=jnp.where(accept, y_new, s.y),
+            f=jnp.where(accept, z3 / h_safe, s.f),
+            h=jnp.where(active, h_next, s.h),
+            n_steps=s.n_steps + jnp.where(accept, 1, 0),
+            n_rejected=s.n_rejected + jnp.where(active & ~accept, 1, 0),
+            consec_rej=consec,
+            acc_t=acc_t, acc_v=acc_v,
+            stalled=s.stalled | stalled,
+        )
+
+    return jax.lax.while_loop(cond, body, state)
+
+
+def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
+           events=(), max_steps_per_segment=100_000, h0=0.0, jac=None):
+    """Integrate dy/dt = rhs(t, y, args) from ts[0] through ts[-1]; return
+    the solution on the output grid ``ts`` plus event accumulators.
+
+    TPU-native analog of ``KINAll0D_Calculate`` + solution retrieval
+    (reference chemkin_wrapper.py:688, :740-779): array-in/array-out, pure,
+    jit/vmap-safe. ``atol`` may be a scalar or an [N] vector (the reference's
+    ATOL/RTOL keywords, batchreactor.py:91-92, defaults 1e-12/1e-6).
+    """
+    events = tuple(events)
+    y0 = jnp.asarray(y0)
+    ts = jnp.asarray(ts)
+    atol_vec = jnp.broadcast_to(jnp.asarray(atol, dtype=y0.dtype), y0.shape)
+    ctrl = _Ctrl(rtol=rtol, atol=atol_vec,
+                 max_steps_per_segment=max_steps_per_segment, h0=h0)
+
+    if jac is None:
+        jac_fn = lambda t, y, a: jax.jacfwd(lambda yy: rhs(t, yy, a))(y)
+    else:
+        jac_fn = jac
+
+    t0 = ts[0]
+    t_span = jnp.maximum(ts[-1] - t0, 1e-30)
+    f0 = rhs(t0, y0, args)
+    h_init = _initial_step(f0, y0, ctrl, t_span)
+
+    n_ev = max(len(events), 1)
+    if events:
+        # "max" events start at -inf; "crossing" events use +inf = not-found
+        acc_t0 = jnp.where(
+            jnp.array([ev.kind == "crossing" for ev in events]),
+            jnp.inf, jnp.nan).astype(y0.dtype)
+    else:
+        acc_t0 = jnp.full((n_ev,), jnp.nan, dtype=y0.dtype)
+    state = _StepState(
+        t=t0, y=y0, f=f0, h=h_init,
+        n_steps=jnp.array(0), n_rejected=jnp.array(0),
+        consec_rej=jnp.array(0),
+        acc_t=acc_t0,
+        acc_v=jnp.full((n_ev,), -jnp.inf, dtype=y0.dtype),
+        stalled=jnp.array(False),
+    )
+
+    def scan_body(st, t_target):
+        st = _solve_segment(rhs, jac_fn, events, ctrl, st, t_target, args)
+        return st, st.y
+
+    state, ys_tail = jax.lax.scan(scan_body, state, ts[1:])
+    ys = jnp.concatenate([y0[None], ys_tail], axis=0)
+
+    ev_t = state.acc_t
+    if events:
+        is_cross = jnp.array([ev.kind == "crossing" for ev in events])
+        ev_t = jnp.where(is_cross & ~jnp.isfinite(ev_t), jnp.nan, ev_t)
+
+    success = (~state.stalled) & (state.t >= ts[-1] - 1e-12 * t_span)
+    return ODESolution(ts=ts, ys=ys, event_times=ev_t,
+                       event_values=state.acc_v,
+                       n_steps=state.n_steps, n_rejected=state.n_rejected,
+                       success=success)

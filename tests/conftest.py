@@ -3,18 +3,23 @@
 Multi-chip TPU hardware is not available in CI; sharding tests run on a
 virtual 8-device CPU mesh (the driver separately dry-runs the multi-chip
 path via __graft_entry__.dryrun_multichip).
+
+NOTE: this image injects an axon TPU-tunnel sitecustomize that imports jax
+at interpreter startup, so setting JAX_PLATFORMS via os.environ here is too
+late — ``jax.config.update("jax_platforms", ...)`` is the reliable way to
+pin the unit tests to CPU (and it keeps them from silently running over the
+remote-TPU tunnel, or hanging when the tunnel is down).
 """
 
 import os
 
-# Force CPU even when the environment pre-sets an accelerator platform
-# (the TPU tunnel would otherwise run every unit test remotely).
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,122 @@
+"""Stiff-integrator validation against analytic solutions and scipy.
+
+The reference has no integrator tests (its integration lives in the licensed
+Fortran library, SURVEY.md §4); these unit tests are the rebuild's
+replacement oracle for the 0-D engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.integrate import solve_ivp
+
+from pychemkin_tpu.ops.odeint import Event, odeint
+
+
+def test_linear_decay_exact():
+    rhs = lambda t, y, a: -a * y
+    ts = jnp.linspace(0.0, 2.0, 5)
+    sol = odeint(rhs, jnp.array([1.0]), ts, args=3.0, rtol=1e-8, atol=1e-12)
+    assert bool(sol.success)
+    np.testing.assert_allclose(np.asarray(sol.ys[:, 0]),
+                               np.exp(-3.0 * np.asarray(ts)), rtol=1e-6)
+
+
+def test_robertson_vs_scipy():
+    """The canonical stiff benchmark: 3-species Robertson kinetics."""
+    def rhs(t, y, args):
+        y1, y2, y3 = y[0], y[1], y[2]
+        r1 = 0.04 * y1
+        r2 = 1e4 * y2 * y3
+        r3 = 3e7 * y2 * y2
+        return jnp.stack([-r1 + r2, r1 - r2 - r3, r3])
+
+    y0 = jnp.array([1.0, 0.0, 0.0])
+    ts = jnp.array([0.0, 0.4, 4.0, 40.0, 400.0, 4000.0])
+    sol = odeint(rhs, y0, ts, rtol=1e-8, atol=1e-12)
+    assert bool(sol.success)
+
+    def rhs_np(t, y):
+        return np.array([-0.04 * y[0] + 1e4 * y[1] * y[2],
+                         0.04 * y[0] - 1e4 * y[1] * y[2] - 3e7 * y[1] ** 2,
+                         3e7 * y[1] ** 2])
+
+    ref = solve_ivp(rhs_np, (0.0, 4000.0), np.array([1.0, 0.0, 0.0]),
+                    method="BDF", t_eval=np.asarray(ts), rtol=1e-10,
+                    atol=1e-14)
+    np.testing.assert_allclose(np.asarray(sol.ys), ref.y.T, rtol=2e-5,
+                               atol=1e-10)
+    # conservation: Robertson sums to 1
+    np.testing.assert_allclose(np.asarray(sol.ys).sum(axis=1), 1.0,
+                               rtol=1e-7)
+
+
+def test_van_der_pol_stiff():
+    mu = 1000.0
+
+    def rhs(t, y, args):
+        return jnp.stack([y[1], mu * ((1 - y[0] ** 2) * y[1]) - y[0]])
+
+    ts = jnp.array([0.0, 1.0])
+    sol = odeint(rhs, jnp.array([2.0, 0.0]), ts, rtol=1e-7, atol=1e-10)
+    assert bool(sol.success)
+    ref = solve_ivp(lambda t, y: [y[1], mu * (1 - y[0] ** 2) * y[1] - y[0]],
+                    (0.0, 1.0), [2.0, 0.0], method="BDF", rtol=1e-10,
+                    atol=1e-12)
+    np.testing.assert_allclose(np.asarray(sol.ys[-1]), ref.y[:, -1],
+                               rtol=1e-4)
+
+
+def test_event_max_and_crossing():
+    """Logistic growth: y' = y(1-y). Max slope at y=1/2, t = -ln(y0/(1-y0))
+    for y(0)=y0; slope-crossing of y-1/2 at the same time."""
+    y0 = 0.01
+    rhs = lambda t, y, a: y * (1.0 - y)
+    t_exact = float(-np.log(y0 / (1.0 - y0)))   # time when y = 1/2
+    events = (
+        Event(fn=lambda t, y, f: f[0], kind="max"),
+        Event(fn=lambda t, y, f: y[0] - 0.5, kind="crossing"),
+    )
+    ts = jnp.linspace(0.0, 12.0, 3)
+    sol = odeint(rhs, jnp.array([y0]), ts, rtol=1e-9, atol=1e-12,
+                 events=events)
+    assert bool(sol.success)
+    assert abs(float(sol.event_times[0]) - t_exact) < 2e-3
+    assert abs(float(sol.event_times[1]) - t_exact) < 1e-4
+    assert abs(float(sol.event_values[0]) - 0.25) < 1e-6
+
+
+def test_crossing_never_fires_is_nan():
+    rhs = lambda t, y, a: -y
+    events = (Event(fn=lambda t, y, f: y[0] - 10.0, kind="crossing"),)
+    sol = odeint(rhs, jnp.array([1.0]), jnp.array([0.0, 1.0]), events=events)
+    assert np.isnan(float(sol.event_times[0]))
+
+
+def test_vmap_batch():
+    rhs = lambda t, y, a: -a * y
+    rates = jnp.array([0.5, 1.0, 2.0, 8.0])
+    ts = jnp.linspace(0.0, 1.0, 3)
+
+    def solve_one(rate):
+        return odeint(rhs, jnp.array([1.0]), ts, args=rate, rtol=1e-8,
+                      atol=1e-12)
+
+    sols = jax.vmap(solve_one)(rates)
+    assert bool(jnp.all(sols.success))
+    expect = np.exp(-np.asarray(rates)[:, None] * np.asarray(ts)[None, :])
+    np.testing.assert_allclose(np.asarray(sols.ys[..., 0]), expect,
+                               rtol=1e-6)
+
+
+def test_jit_wrapped():
+    rhs = lambda t, y, a: -y
+
+    @jax.jit
+    def run(y0):
+        return odeint(rhs, y0, jnp.array([0.0, 1.0]), rtol=1e-8,
+                      atol=1e-12).ys[-1]
+
+    out = run(jnp.array([2.0]))
+    np.testing.assert_allclose(float(out[0]), 2.0 * np.exp(-1.0), rtol=1e-6)
