@@ -204,8 +204,9 @@ def _update_events(events, acc_t, acc_v, samples, active):
                 crossed = active & not_yet & (~found) & (g0 <= 0.0) & (g1 > 0.0)
                 frac = -g0 / jnp.where(g1 - g0 == 0, 1.0, g1 - g0)
                 tc = t0 + jnp.clip(frac, 0.0, 1.0) * (t1 - t0)
+                slope = (g1 - g0) / jnp.maximum(t1 - t0, 1e-300)
                 best_t = jnp.where(crossed, tc, best_t)
-                best_v = jnp.where(crossed, g1 - g0, best_v)
+                best_v = jnp.where(crossed, slope, best_v)
                 found = found | crossed
             new_t.append(best_t)
             new_v.append(best_v)
@@ -252,7 +253,13 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
 
     def body(s):
         active = s.t < t_end
-        h = jnp.clip(s.h, dt_min, jnp.maximum(t_end - s.t, dt_min))
+        # h is the controller's ideal step; the step actually taken may be
+        # clipped to the segment remainder (output point). The controller
+        # value is preserved across such clips so dense output grids don't
+        # collapse the step size (it would otherwise re-grow at <=5x/step).
+        remaining = jnp.maximum(t_end - s.t, dt_min)
+        h = jnp.clip(s.h, dt_min, remaining)
+        clipped = s.h > remaining
 
         J = jac_fn(s.t, s.y, args)
         M = jnp.eye(n, dtype=dtype) - (h * _GAMMA) * J
@@ -286,6 +293,9 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
         fac = jnp.where(newton_ok & finite, jnp.clip(fac, _MIN_FACTOR,
                                                      _MAX_FACTOR), 0.25)
         h_next = jnp.maximum(h * fac, dt_min)
+        # accepted output-clipped step: keep the controller's larger h
+        h_next = jnp.where(accept & clipped, jnp.maximum(h_next, s.h),
+                           h_next)
 
         # stage derivatives are free: f(t + c_i h, Y_i) = z_i / h
         h_safe = jnp.maximum(h, 1e-300)
@@ -314,7 +324,11 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
             stalled=s.stalled | stalled,
         )
 
-    return jax.lax.while_loop(cond, body, state)
+    out = jax.lax.while_loop(cond, body, state)
+    # exiting short of t_end (budget exhausted or stall) is a failure; the
+    # output point recorded for this segment would otherwise silently hold
+    # y at the wrong time
+    return out._replace(stalled=out.stalled | (out.t < t_end))
 
 
 def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
@@ -330,6 +344,13 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
     events = tuple(events)
     y0 = jnp.asarray(y0)
     ts = jnp.asarray(ts)
+    try:
+        ts_np = np.asarray(ts)
+        if not np.all(np.diff(ts_np) > 0):
+            raise ValueError("odeint output grid ts must be strictly "
+                             "increasing")
+    except jax.errors.TracerArrayConversionError:
+        pass  # traced grid: caller's responsibility
     atol_vec = jnp.broadcast_to(jnp.asarray(atol, dtype=y0.dtype), y0.shape)
     ctrl = _Ctrl(rtol=rtol, atol=atol_vec,
                  max_steps_per_segment=max_steps_per_segment, h0=h0)

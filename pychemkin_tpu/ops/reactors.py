@@ -1,0 +1,328 @@
+"""0-D homogeneous-reactor physics (JAX) — RHS assembly and batched solves.
+
+TPU-native replacement for the reference's native "All0D" batch-reactor
+engine: ``KINAll0D_SetupBatchInputs`` + ``KINAll0D_Calculate`` (reference:
+chemkin_wrapper.py:590-688, batchreactors/batchreactor.py:980-1161). The
+reference runs ONE stiff integration per blocking FFI call; here the whole
+problem — RHS, analytic-via-AD Jacobian, stiff integration, ignition-event
+detection — is a pure jit/vmap-able function of arrays, so thousands of
+reactors integrate simultaneously on one chip and shard over a mesh.
+
+Problem variants (reference batchreactor.py:58-68 ProblemTypes):
+  given pressure  (CONP) x {energy equation (ENRG), given temperature (TGIV)}
+  given volume    (CONV) x {ENRG, TGIV}
+with piecewise-linear time profiles for the constrained variable
+(PPRO/VPRO/TPRO, reference batchreactor.py:644-733) and wall heat transfer
+(QLOS / HTC+TAMB+area, reference batchreactor.py:700-708 keywords).
+
+State vector: y = [Y_1..Y_KK, T] (mass fractions + temperature). All units
+CGS (P dyne/cm^2, V cm^3, Q erg/s, t s).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import R_GAS
+from . import kinetics, thermo
+from .odeint import Event, odeint
+
+
+class Profile(NamedTuple):
+    """Piecewise-linear profile (the reference's Profile keyword data,
+    reactormodel.py:467-671). Clamped (flat) outside the data range."""
+    x: Any   # [n] knots (time, s)
+    y: Any   # [n] values
+
+
+def constant_profile(value, dtype=jnp.float64):
+    v = jnp.asarray(value, dtype=dtype)
+    return Profile(x=jnp.array([0.0, 1.0], dtype=dtype),
+                   y=jnp.stack([v, v]))
+
+
+def profile_value_slope(p: Profile, t):
+    """Value and slope of the profile at t (slope 0 outside the range)."""
+    n = p.x.shape[0]
+    i = jnp.clip(jnp.searchsorted(p.x, t, side="right") - 1, 0, n - 2)
+    x0, x1 = p.x[i], p.x[i + 1]
+    y0, y1 = p.y[i], p.y[i + 1]
+    dx = jnp.maximum(x1 - x0, 1e-300)
+    slope = (y1 - y0) / dx
+    inside = (t >= p.x[0]) & (t <= p.x[-1])
+    val = jnp.clip(y0 + slope * (t - x0), jnp.minimum(y0, y1),
+                   jnp.maximum(y0, y1))
+    val = jnp.where(t < p.x[0], p.y[0], jnp.where(t > p.x[-1], p.y[-1], val))
+    return val, jnp.where(inside, slope, 0.0)
+
+
+class BatchArgs(NamedTuple):
+    """Everything the batch-reactor RHS needs besides (t, y).
+
+    ``constraint`` is the P(t) profile for CONP problems or the V(t) profile
+    for CONV problems. ``tprof`` is the T(t) profile for TGIV problems.
+    ``mass`` is the (constant — closed reactor) gas mass in g.
+    Heat transfer: Qdot_ext = -qloss + htc*area*(tamb - T)  [erg/s]
+    (reference QLOS/QPRO, HTC, TAMB, AREAQ keywords, batchreactor.py:700-733).
+    """
+    mech: Any
+    constraint: Profile
+    tprof: Profile
+    qloss: Profile         # heat-loss rate profile, erg/s (QLOS/QPRO)
+    mass: Any = 1.0
+    htc: Any = 0.0         # erg/(cm^2 K s)
+    tamb: Any = 298.15     # K
+    area: Any = 0.0        # cm^2
+
+
+def _heat_rate(args, T, t):
+    ql, _ = profile_value_slope(args.qloss, t)
+    return -ql + args.htc * args.area * (args.tamb - T)
+
+
+def _split(y):
+    return y[:-1], jnp.maximum(y[-1], 50.0)
+
+
+def conp_enrg_rhs(t, y, args: BatchArgs):
+    """Constant/given-pressure, energy equation:
+    dH/dt = Qdot + V dP/dt  =>
+    dT/dt = (Qdot/m + Pdot/rho - sum_k h_k(molar) wdot_k / rho) / cp."""
+    mech = args.mech
+    Y, T = _split(y)
+    P, Pdot = profile_value_slope(args.constraint, t)
+    rho = thermo.density(mech, T, P, Y)
+    C = thermo.Y_to_C(mech, Y, rho)
+    wdot = kinetics.net_production_rates(mech, T, C, P)
+    dY = wdot * mech.wt / rho
+    cp = thermo.mixture_cp_mass(mech, T, Y)
+    h_molar = thermo.h_RT(mech, T) * (R_GAS * T)
+    q = _heat_rate(args, T, t) / args.mass
+    dT = (q + Pdot / rho - jnp.dot(h_molar, wdot) / rho) / cp
+    return jnp.concatenate([dY, dT[None]])
+
+
+def conp_tgiv_rhs(t, y, args: BatchArgs):
+    """Given pressure + given temperature (CONP+TGIV,
+    reference batchreactor.py:1649): species only; T follows its profile."""
+    mech = args.mech
+    Y, _ = _split(y)
+    T, Tdot = profile_value_slope(args.tprof, t)
+    P, _ = profile_value_slope(args.constraint, t)
+    rho = thermo.density(mech, T, P, Y)
+    C = thermo.Y_to_C(mech, Y, rho)
+    wdot = kinetics.net_production_rates(mech, T, C, P)
+    dY = wdot * mech.wt / rho
+    return jnp.concatenate([dY, Tdot[None]])
+
+
+def conv_enrg_rhs(t, y, args: BatchArgs):
+    """Given-volume, energy equation:
+    dU/dt = Qdot - P dV/dt  =>
+    dT/dt = (Qdot/m - P Vdot/m - sum_k u_k(molar) wdot_k / rho) / cv."""
+    mech = args.mech
+    Y, T = _split(y)
+    V, Vdot = profile_value_slope(args.constraint, t)
+    rho = args.mass / V
+    C = thermo.Y_to_C(mech, Y, rho)
+    wdot = kinetics.net_production_rates(mech, T, C)
+    dY = wdot * mech.wt / rho
+    wbar = thermo.mean_molecular_weight_Y(mech, Y)
+    P = rho * R_GAS * T / wbar
+    cv = thermo.mixture_cp_mass(mech, T, Y) - R_GAS / wbar
+    u_molar = (thermo.h_RT(mech, T) - 1.0) * (R_GAS * T)
+    q = _heat_rate(args, T, t) / args.mass
+    dT = (q - P * Vdot / args.mass - jnp.dot(u_molar, wdot) / rho) / cv
+    return jnp.concatenate([dY, dT[None]])
+
+
+def conv_tgiv_rhs(t, y, args: BatchArgs):
+    """Given volume + given temperature (CONV+TGIV,
+    reference batchreactor.py:2070)."""
+    mech = args.mech
+    Y, _ = _split(y)
+    T, Tdot = profile_value_slope(args.tprof, t)
+    V, _ = profile_value_slope(args.constraint, t)
+    rho = args.mass / V
+    C = thermo.Y_to_C(mech, Y, rho)
+    wdot = kinetics.net_production_rates(mech, T, C)
+    dY = wdot * mech.wt / rho
+    return jnp.concatenate([dY, Tdot[None]])
+
+
+_RHS = {
+    ("CONP", "ENRG"): conp_enrg_rhs,
+    ("CONP", "TGIV"): conp_tgiv_rhs,
+    ("CONV", "ENRG"): conv_enrg_rhs,
+    ("CONV", "TGIV"): conv_tgiv_rhs,
+}
+
+# Ignition-delay detection methods (reference batchreactor.py:462-543:
+# set_ignition_delay modes TIFP / DTIGN / TLIM / KLIM).
+IGN_T_INFLECTION = "T_inflection"
+IGN_T_RISE = "T_rise"
+IGN_T_IGNITION = "T_ignition"
+IGN_SPECIES_PEAK = "Species_peak"
+
+
+def ignition_events(mode, *, T0=None, delta_T=400.0, T_limit=1800.0,
+                    species_index=0, min_slope=1e4):
+    """Build integrator events for an ignition-delay definition.
+
+    Mirrors reference set_ignition_delay (batchreactor.py:462): the default
+    is the max-dT/dt inflection point; DTIGN triggers at T0 + delta_T
+    (default rise 400 K, reference :489); TLIM at an absolute temperature;
+    KLIM at the peak of a species mass fraction.
+
+    ``min_slope`` [K/s] only applies to T_inflection: a peak dT/dt below it
+    is slow oxidation, not ignition, and is reported as nan (igniting
+    systems peak at 1e6-1e9 K/s)."""
+    if mode == IGN_T_INFLECTION:
+        return (Event(fn=lambda t, y, f: f[-1], kind="max"),)
+    if mode == IGN_T_RISE:
+        thresh = T0 + delta_T
+        return (Event(fn=lambda t, y, f: y[-1] - thresh, kind="crossing"),)
+    if mode == IGN_T_IGNITION:
+        return (Event(fn=lambda t, y, f: y[-1] - T_limit, kind="crossing"),)
+    if mode == IGN_SPECIES_PEAK:
+        k = species_index
+        return (Event(fn=lambda t, y, f: y[k], kind="max"),)
+    raise ValueError(f"unknown ignition-delay mode {mode!r}")
+
+
+class BatchSolution(NamedTuple):
+    """Array-in/array-out solution store (replaces the reference's in-memory
+    native solution + KINAll0D_GetGasSolnResponse copies,
+    batchreactor.py:1335-1486).
+
+    ``ignition_time`` is nan when not detected. For the crossing-based modes
+    (T_rise / T_ignition) "not detected" means the threshold was never
+    crossed; for T_inflection it means the peak dT/dt stayed below
+    ``min_slope`` (no thermal runaway). For Species_peak the peak time is
+    the definition itself and is always finite on success."""
+    times: Any          # [n_out]
+    T: Any              # [n_out]
+    P: Any              # [n_out]
+    volume: Any         # [n_out] (specific volume * mass)
+    Y: Any              # [n_out, KK]
+    ignition_time: Any  # scalar (s); nan if not detected
+    n_steps: Any
+    success: Any
+
+
+def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
+                n_out=101, rtol=1e-6, atol=1e-12,
+                constraint_profile=None, t_profile=None, qloss_profile=None,
+                volume=1.0, htc=0.0, tamb=298.15, area=0.0,
+                ignition_mode=IGN_T_INFLECTION, ignition_kwargs=None,
+                t_start=0.0, max_steps_per_segment=20_000):
+    """Solve one 0-D batch reactor; jit/vmap-safe core of the reference's
+    ``BatchReactors.run()`` (batchreactor.py:1161).
+
+    problem: "CONP" | "CONV"; energy: "ENRG" | "TGIV".
+    For CONP the constraint profile is P(t) [dyne/cm^2] (default: constant
+    P0); for CONV it is V(t) [cm^3] (default: constant ``volume``).
+    """
+    rhs = _RHS[(problem, energy)]
+    dtype = jnp.result_type(jnp.asarray(Y0).dtype, jnp.float64)
+    Y0 = jnp.asarray(Y0, dtype=dtype)
+    T0 = jnp.asarray(T0, dtype=dtype)
+    P0 = jnp.asarray(P0, dtype=dtype)
+
+    if constraint_profile is None:
+        if problem == "CONP":
+            constraint_profile = constant_profile(P0)
+        else:
+            constraint_profile = constant_profile(volume)
+    if t_profile is None:
+        t_profile = constant_profile(T0)
+    if qloss_profile is None:
+        qloss_profile = constant_profile(0.0)
+
+    if problem == "CONP":
+        # initial density from the profile's own P(t_start), so an explicit
+        # P(t) profile with P(t_start) != P0 stays self-consistent
+        p_start, _ = profile_value_slope(constraint_profile,
+                                         jnp.asarray(t_start))
+        rho0 = thermo.density(mech, T0, p_start, Y0)
+        mass = rho0 * volume
+    else:
+        v0, _ = profile_value_slope(constraint_profile, jnp.asarray(t_start))
+        rho0 = thermo.density(mech, T0, P0, Y0)
+        mass = rho0 * v0
+
+    args = BatchArgs(mech=mech, constraint=constraint_profile,
+                     tprof=t_profile, qloss=qloss_profile, mass=mass,
+                     htc=htc, tamb=tamb, area=area)
+
+    events = ignition_events(ignition_mode, T0=T0,
+                             **(ignition_kwargs or {}))
+
+    y0 = jnp.concatenate([Y0, T0[None]])
+    ts = jnp.linspace(t_start, t_end, n_out)
+    atol_vec = jnp.full(y0.shape, atol, dtype=dtype)
+    atol_vec = atol_vec.at[-1].set(jnp.maximum(atol * 1e6, 1e-8))
+    sol = odeint(rhs, y0, ts, args, rtol=rtol, atol=atol_vec, events=events,
+                 max_steps_per_segment=max_steps_per_segment)
+
+    ignition_time = sol.event_times[0]
+    if ignition_mode == IGN_T_INFLECTION:
+        min_slope = (ignition_kwargs or {}).get("min_slope", 1e4)
+        ignition_time = jnp.where(sol.event_values[0] >= min_slope,
+                                  ignition_time, jnp.nan)
+
+    Ys = sol.ys[:, :-1]
+    Ts = sol.ys[:, -1]
+    if energy == "TGIV":
+        Ts = jax.vmap(lambda t: profile_value_slope(t_profile, t)[0])(ts)
+
+    if problem == "CONP":
+        Ps = jax.vmap(lambda t: profile_value_slope(constraint_profile,
+                                                    t)[0])(ts)
+        rhos = jax.vmap(lambda T, P, Y: thermo.density(mech, T, P, Y))(
+            Ts, Ps, Ys)
+        Vs = mass / rhos
+    else:
+        Vs = jax.vmap(lambda t: profile_value_slope(constraint_profile,
+                                                    t)[0])(ts)
+        rhos = mass / Vs
+        wbars = jax.vmap(lambda Y: thermo.mean_molecular_weight_Y(mech, Y))(
+            Ys)
+        Ps = rhos * R_GAS * Ts / wbars
+
+    return BatchSolution(times=ts, T=Ts, P=Ps, volume=Vs, Y=Ys,
+                         ignition_time=ignition_time,
+                         n_steps=sol.n_steps, success=sol.success)
+
+
+def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
+                         rtol=1e-6, atol=1e-12,
+                         ignition_mode=IGN_T_INFLECTION,
+                         ignition_kwargs=None, n_out=2,
+                         max_steps_per_segment=20_000):
+    """Batched ignition-delay computation over [B] initial conditions — the
+    TPU answer to the reference's serial Python sweep loop
+    (tests/integration_tests/ignitiondelay.py:127-144). Returns ignition
+    times [B] in seconds (nan where not detected).
+
+    All inputs broadcast along the leading batch axis.
+    """
+    B = jnp.asarray(T0s).shape[0]
+    T0s = jnp.broadcast_to(jnp.asarray(T0s, jnp.float64), (B,))
+    P0s = jnp.broadcast_to(jnp.asarray(P0s, jnp.float64), (B,))
+    Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64),
+                           (B, jnp.asarray(Y0s).shape[-1]))
+    t_ends = jnp.broadcast_to(jnp.asarray(t_ends, jnp.float64), (B,))
+
+    def one(T0, P0, Y0, t_end):
+        sol = solve_batch(mech, problem, energy, T0, P0, Y0, t_end,
+                          n_out=n_out, rtol=rtol, atol=atol,
+                          ignition_mode=ignition_mode,
+                          ignition_kwargs=ignition_kwargs,
+                          max_steps_per_segment=max_steps_per_segment)
+        return sol.ignition_time, sol.success
+
+    return jax.vmap(one)(T0s, P0s, Y0s, t_ends)

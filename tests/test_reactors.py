@@ -1,0 +1,204 @@
+"""Batch-reactor physics validation.
+
+The reference's oracle is the licensed Fortran solver (absent here), so the
+rebuild validates against: (a) an independent integrator (scipy BDF) on the
+identical RHS, (b) exact conservation laws (elements, mass, energy), and
+(c) physical sanity of H2/O2 ignition (monotone delay vs temperature,
+post-ignition temperature near the adiabatic flame temperature).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.integrate import solve_ivp
+
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import reactors, thermo
+from pychemkin_tpu.constants import P_ATM
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+def stoich_h2_air(mech):
+    """Stoichiometric H2/air mole fractions -> mass fractions."""
+    X = np.zeros(mech.n_species)
+    X[mech.species_index("H2")] = 0.2958
+    X[mech.species_index("O2")] = 0.1479
+    X[mech.species_index("N2")] = 0.5563
+    X /= X.sum()
+    return np.asarray(thermo.X_to_Y(mech, jnp.asarray(X)))
+
+
+def test_conp_ignition_h2_air(mech):
+    Y0 = stoich_h2_air(mech)
+    sol = reactors.solve_batch(mech, "CONP", "ENRG", 1200.0, P_ATM, Y0,
+                               2e-3, n_out=51, rtol=1e-8, atol=1e-14)
+    assert bool(sol.success)
+    tau = float(sol.ignition_time)
+    # stoich H2-air, 1 atm, 1200 K: ignition delay is tens of microseconds
+    assert 1e-6 < tau < 1e-3
+    # post-ignition: approaches the constant-P adiabatic flame state;
+    # H2-air from 1200 K ends well above 2400 K
+    assert float(sol.T[-1]) > 2400.0
+    # enthalpy conservation at constant pressure, no heat loss
+    h0 = float(thermo.mixture_enthalpy_mass(mech, 1200.0, jnp.asarray(Y0)))
+    h1 = float(thermo.mixture_enthalpy_mass(mech, sol.T[-1], sol.Y[-1]))
+    assert abs(h1 - h0) / abs(h0) < 1e-5
+    # element conservation
+    moles0 = Y0 / np.asarray(mech.wt)
+    moles1 = np.asarray(sol.Y[-1]) / np.asarray(mech.wt)
+    e0 = np.asarray(mech.ncf).T @ moles0
+    e1 = np.asarray(mech.ncf).T @ moles1
+    np.testing.assert_allclose(e1, e0, rtol=1e-7, atol=1e-12)
+    # mass fractions sum to 1
+    assert abs(float(sol.Y[-1].sum()) - 1.0) < 1e-7
+
+
+def test_conp_matches_scipy(mech):
+    """Same RHS, independent integrator: trajectories must agree."""
+    Y0 = stoich_h2_air(mech)
+    T0, P0, t_end = 1400.0, P_ATM, 2e-4
+    args = reactors.BatchArgs(
+        mech=mech,
+        constraint=reactors.constant_profile(P0),
+        tprof=reactors.constant_profile(T0),
+        qloss=reactors.constant_profile(0.0),
+        mass=1.0)
+    y0 = np.concatenate([Y0, [T0]])
+
+    rhs_jit = jax.jit(lambda t, y: reactors.conp_enrg_rhs(t, y, args))
+
+    ref = solve_ivp(lambda t, y: np.asarray(rhs_jit(t, jnp.asarray(y))),
+                    (0.0, t_end), y0, method="BDF", rtol=1e-9, atol=1e-14)
+    sol = reactors.solve_batch(mech, "CONP", "ENRG", T0, P0, Y0, t_end,
+                               n_out=2, rtol=1e-9, atol=1e-14)
+    assert bool(sol.success)
+    # final temperature agreement between the two integrators
+    assert abs(float(sol.T[-1]) - ref.y[-1, -1]) < 0.5
+    # major species agreement
+    for name in ("H2", "O2", "H2O", "OH"):
+        k = mech.species_index(name)
+        assert abs(float(sol.Y[-1, k]) - ref.y[k, -1]) < 2e-5
+
+
+def test_conv_energy_conservation(mech):
+    """Constant-volume adiabatic: internal energy is exactly conserved."""
+    Y0 = stoich_h2_air(mech)
+    T0, P0 = 1100.0, 2 * P_ATM
+    sol = reactors.solve_batch(mech, "CONV", "ENRG", T0, P0, Y0, 2e-3,
+                               n_out=11, rtol=1e-8, atol=1e-14)
+    assert bool(sol.success)
+    u0 = float(thermo.mixture_internal_energy_mass(mech, T0,
+                                                   jnp.asarray(Y0)))
+    u1 = float(thermo.mixture_internal_energy_mass(mech, sol.T[-1],
+                                                   sol.Y[-1]))
+    assert abs(u1 - u0) / abs(u0) < 1e-5
+    # constant volume: pressure rises on ignition
+    assert float(sol.P[-1]) > 1.5 * P0
+    assert float(sol.T[-1]) > 2500.0
+
+
+def test_tgiv_holds_temperature(mech):
+    Y0 = stoich_h2_air(mech)
+    sol = reactors.solve_batch(mech, "CONP", "TGIV", 900.0, P_ATM, Y0,
+                               1e-3, n_out=5, rtol=1e-7, atol=1e-13)
+    assert bool(sol.success)
+    np.testing.assert_allclose(np.asarray(sol.T), 900.0, atol=1e-8)
+    # fuel is consumed isothermally
+    k = mech.species_index("H2")
+    assert float(sol.Y[-1, k]) < Y0[k]
+
+
+def test_ignition_monotone_in_temperature(mech):
+    """Ignition delay decreases with initial temperature (high-T regime)."""
+    Y0 = stoich_h2_air(mech)
+    T0s = jnp.array([1100.0, 1250.0, 1400.0])
+    taus, ok = reactors.ignition_delay_sweep(
+        mech, "CONP", "ENRG", T0s, P_ATM, jnp.asarray(Y0)[None, :],
+        5e-3, rtol=1e-7, atol=1e-13)
+    assert bool(jnp.all(ok))
+    taus = np.asarray(taus)
+    assert np.all(np.isfinite(taus))
+    assert taus[0] > taus[1] > taus[2]
+
+
+def test_ignition_modes_consistent(mech):
+    """T_rise and T_inflection ignition times agree to within a factor."""
+    Y0 = stoich_h2_air(mech)
+    common = dict(n_out=2, rtol=1e-8, atol=1e-14)
+    s1 = reactors.solve_batch(mech, "CONP", "ENRG", 1200.0, P_ATM, Y0, 2e-3,
+                              ignition_mode=reactors.IGN_T_INFLECTION,
+                              **common)
+    s2 = reactors.solve_batch(mech, "CONP", "ENRG", 1200.0, P_ATM, Y0, 2e-3,
+                              ignition_mode=reactors.IGN_T_RISE, **common)
+    s3 = reactors.solve_batch(mech, "CONP", "ENRG", 1200.0, P_ATM, Y0, 2e-3,
+                              ignition_mode=reactors.IGN_T_IGNITION,
+                              ignition_kwargs={"T_limit": 2000.0}, **common)
+    t1, t2, t3 = (float(s.ignition_time) for s in (s1, s2, s3))
+    assert np.isfinite([t1, t2, t3]).all()
+    assert abs(t2 - t1) / t1 < 0.5
+    assert abs(t3 - t1) / t1 < 0.5
+
+
+def test_heat_loss_quenches(mech):
+    """Strong convective heat loss delays/prevents ignition."""
+    Y0 = stoich_h2_air(mech)
+    adiabatic = reactors.solve_batch(mech, "CONP", "ENRG", 1050.0, P_ATM,
+                                     Y0, 5e-3, n_out=2, rtol=1e-7,
+                                     atol=1e-13)
+    cooled = reactors.solve_batch(mech, "CONP", "ENRG", 1050.0, P_ATM,
+                                  Y0, 5e-3, n_out=2, rtol=1e-7, atol=1e-13,
+                                  htc=1e6, tamb=300.0, area=10.0)
+    assert bool(adiabatic.success) and bool(cooled.success)
+    assert float(cooled.T[-1]) < float(adiabatic.T[-1])
+
+
+def test_volume_profile_compression_heats(mech):
+    """CONV with a shrinking volume profile: compression raises T (inert)."""
+    X = np.zeros(mech.n_species)
+    X[mech.species_index("N2")] = 1.0
+    Y0 = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X)))
+    t_end = 1e-2
+    vprof = reactors.Profile(x=jnp.array([0.0, t_end]),
+                             y=jnp.array([10.0, 2.0]))
+    sol = reactors.solve_batch(mech, "CONV", "ENRG", 600.0, P_ATM, Y0,
+                               t_end, n_out=5, rtol=1e-9, atol=1e-12,
+                               constraint_profile=vprof)
+    assert bool(sol.success)
+    # isentropic N2 (gamma~1.4): T1 = T0 (V0/V1)^(gamma-1) ~ 600*5^0.39
+    T_end = float(sol.T[-1])
+    assert 1050.0 < T_end < 1200.0
+
+
+def test_no_ignition_reports_nan(mech):
+    """A cold mixture does not ignite: T_inflection must report nan."""
+    Y0 = stoich_h2_air(mech)
+    sol = reactors.solve_batch(mech, "CONP", "ENRG", 600.0, P_ATM, Y0,
+                               1e-4, n_out=2, rtol=1e-7, atol=1e-13)
+    assert bool(sol.success)
+    assert np.isnan(float(sol.ignition_time))
+    s2 = reactors.solve_batch(mech, "CONP", "ENRG", 600.0, P_ATM, Y0,
+                              1e-4, n_out=2, rtol=1e-7, atol=1e-13,
+                              ignition_mode=reactors.IGN_T_RISE)
+    assert np.isnan(float(s2.ignition_time))
+
+
+def test_decreasing_grid_rejected():
+    from pychemkin_tpu.ops.odeint import odeint
+    with pytest.raises(ValueError):
+        odeint(lambda t, y, a: -y, jnp.array([1.0]),
+               jnp.array([1.0, 0.0]))
+
+
+def test_vmap_sweep_batch(mech):
+    Y0 = stoich_h2_air(mech)
+    T0s = jnp.array([1150.0, 1300.0])
+    taus, ok = reactors.ignition_delay_sweep(
+        mech, "CONV", "ENRG", T0s, P_ATM, jnp.asarray(Y0)[None, :], 5e-3,
+        rtol=1e-7, atol=1e-13)
+    assert bool(jnp.all(ok))
+    assert np.all(np.isfinite(np.asarray(taus)))
