@@ -130,10 +130,9 @@ def conv_enrg_rhs(t, y, args: BatchArgs):
     C = thermo.Y_to_C(mech, Y, rho)
     wdot = kinetics.net_production_rates(mech, T, C)
     dY = wdot * mech.wt / rho
-    wbar = thermo.mean_molecular_weight_Y(mech, Y)
-    P = rho * R_GAS * T / wbar
-    cv = thermo.mixture_cp_mass(mech, T, Y) - R_GAS / wbar
-    u_molar = (thermo.h_RT(mech, T) - 1.0) * (R_GAS * T)
+    P = thermo.pressure(mech, T, rho, Y)
+    cv = thermo.mixture_cv_mass(mech, T, Y)
+    u_molar = thermo.u_RT(mech, T) * (R_GAS * T)
     q = _heat_rate(args, T, t) / args.mass
     dT = (q - P * Vdot / args.mass - jnp.dot(u_molar, wdot) / rho) / cv
     return jnp.concatenate([dY, dT[None]])
@@ -305,8 +304,9 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                          max_steps_per_segment=20_000):
     """Batched ignition-delay computation over [B] initial conditions — the
     TPU answer to the reference's serial Python sweep loop
-    (tests/integration_tests/ignitiondelay.py:127-144). Returns ignition
-    times [B] in seconds (nan where not detected).
+    (tests/integration_tests/ignitiondelay.py:127-144). Returns a pair
+    ``(ignition_times, success)``, each [B]: ignition times in seconds
+    (nan where not detected) and per-element integrator success flags.
 
     All inputs broadcast along the leading batch axis.
     """

@@ -1,0 +1,165 @@
+"""Equilibrium-kernel tests.
+
+The reference has NO unit tests of equilibrium numerics (the math lives in
+the licensed Fortran library; see SURVEY.md §4), so the oracles here are
+(a) literature values for H2/air (adiabatic flame temperature, CJ detonation
+speed), (b) internal consistency: detailed balance (net production rates
+vanish at TP equilibrium), element conservation, constraint preservation,
+and (c) a cross-check of constant-(V,U) equilibrium against the long-time
+limit of an independent CONV/ENRG batch-reactor integration.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu.constants import P_ATM, R_GAS
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import equilibrium as eq
+from pychemkin_tpu.ops import kinetics, reactors, thermo
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def h2_air(mech):
+    """Stoichiometric H2/air mass fractions."""
+    names = list(mech.species_names)
+    X = np.zeros(len(names))
+    X[names.index("H2")] = 2.0
+    X[names.index("O2")] = 1.0
+    X[names.index("N2")] = 3.76
+    X /= X.sum()
+    return np.asarray(thermo.X_to_Y(mech, jnp.asarray(X)))
+
+
+class TestConstraintPairs:
+    def test_all_nine_options_converge_from_cold(self, mech, h2_air):
+        for opt in range(1, 10):
+            r = eq.equilibrate(mech, 298.15, P_ATM, h2_air, option=opt)
+            assert bool(r.converged), f"option {opt} did not converge"
+            assert np.isfinite(float(r.T)) and float(r.T) > 0
+
+    def test_element_conservation(self, mech, h2_air):
+        b0 = np.asarray(eq.element_moles(mech, jnp.asarray(h2_air)))
+        for opt in (1, 5, 7):
+            r = eq.equilibrate(mech, 298.15, P_ATM, h2_air, option=opt)
+            b1 = np.asarray(eq.element_moles(mech, r.Y))
+            # absent elements carry the solver's trace floor (~1e-21 mol/g)
+            np.testing.assert_allclose(b1, b0, rtol=1e-8, atol=1e-20)
+
+    def test_constraints_held(self, mech, h2_air):
+        T0, P0 = 298.15, P_ATM
+        Y = jnp.asarray(h2_air)
+        h0 = float(thermo.mixture_enthalpy_mass(mech, T0, Y))
+        wbar0 = float(thermo.mean_molecular_weight_Y(mech, Y))
+        v0 = R_GAS * T0 / (P0 * wbar0)
+        u0 = float(thermo.mixture_internal_energy_mass(mech, T0, Y))
+        X0 = thermo.Y_to_X(mech, Y)
+        s0 = float(thermo.mixture_entropy_molar(mech, T0, P0, X0)) / wbar0
+
+        r5 = eq.equilibrate(mech, T0, P0, h2_air, option=5)    # P, H
+        assert abs(float(r5.P) - P0) / P0 < 1e-10
+        assert abs(float(r5.h) - h0) < 1e-4 * abs(h0) + 1e3
+
+        r7 = eq.equilibrate(mech, T0, P0, h2_air, option=7)    # V, U
+        assert abs(float(r7.v) - v0) / v0 < 1e-8
+        assert abs(float(r7.u) - u0) < 1e-4 * abs(u0) + 1e3
+
+        r6 = eq.equilibrate(mech, T0, P0, h2_air, option=6)    # P, S
+        assert abs(float(r6.s) - s0) / abs(s0) < 1e-6
+
+
+class TestPhysics:
+    def test_adiabatic_flame_temperature_h2_air(self, mech, h2_air):
+        """Literature: stoich H2/air from 298 K, 1 atm -> T_ad ~ 2390 K."""
+        r = eq.equilibrate(mech, 298.15, P_ATM, h2_air, option=5)
+        assert bool(r.converged)
+        assert 2350.0 < float(r.T) < 2430.0
+
+    def test_constant_volume_flame_temperature(self, mech, h2_air):
+        """UV flame temp is hotter than HP and pressure rises ~8x."""
+        r = eq.equilibrate(mech, 298.15, P_ATM, h2_air, option=7)
+        assert 2700.0 < float(r.T) < 2830.0
+        assert 7.0 < float(r.P) / P_ATM < 9.0
+
+    def test_detailed_balance_at_tp_equilibrium(self, mech, h2_air):
+        """Net production rates vanish at equilibrium — ties the
+        equilibrium solver to the kinetics kernels through an entirely
+        independent code path (Kc from the same thermo)."""
+        r = eq.equilibrate(mech, 3000.0, P_ATM, h2_air, option=1)
+        C = thermo.X_to_C(mech, r.X, r.T, r.P)
+        wdot = np.asarray(kinetics.net_production_rates(mech, r.T, C))
+        scale = float(jnp.sum(C)) * 1e3  # mol/cm3 * (1/s) rate scale
+        assert np.max(np.abs(wdot)) < 1e-9 * scale
+
+    def test_hot_products_composition(self, mech, h2_air):
+        """At 3000 K / 1 atm the major product is H2O with significant
+        dissociation into OH / H2 / O2 / H / O."""
+        r = eq.equilibrate(mech, 3000.0, P_ATM, h2_air, option=1)
+        names = list(mech.species_names)
+        x = np.asarray(r.X)
+        assert 0.15 < x[names.index("H2O")] < 0.30
+        assert x[names.index("OH")] > 1e-3
+        assert x[names.index("H")] > 1e-4
+        assert abs(x.sum() - 1.0) < 1e-10
+
+    def test_uv_equilibrium_matches_long_time_batch_integration(
+            self, mech, h2_air):
+        """Independent cross-check: a closed constant-volume adiabatic
+        reactor must relax to the (V,U) equilibrium state (SURVEY.md §7
+        risk item g: cross-checks among our own independent paths)."""
+        T0, P0 = 1100.0, P_ATM
+        r = eq.equilibrate(mech, T0, P0, h2_air, option=7)
+        sol = reactors.solve_batch(mech, "CONV", "ENRG", T0, P0,
+                                   jnp.asarray(h2_air), 0.5,
+                                   n_out=3, rtol=1e-9, atol=1e-14)
+        assert bool(sol.success)
+        T_end = float(sol.T[-1])
+        assert abs(T_end - float(r.T)) < 2.0
+        Y_end = np.asarray(sol.Y[-1])
+        np.testing.assert_allclose(Y_end, np.asarray(r.Y), atol=2e-5)
+
+
+class TestDetonation:
+    def test_cj_h2_air(self, mech, h2_air):
+        """Literature CJ for stoich H2/air (298 K, 1 atm): D ~ 1968 m/s,
+        T2 ~ 2940-2970 K, P2/P1 ~ 15.6."""
+        d = eq.chapman_jouguet(mech, 298.15, P_ATM, h2_air)
+        assert bool(d.converged)
+        assert 1.90e5 < float(d.detonation_speed) < 2.05e5
+        assert 2880.0 < float(d.T) < 3050.0
+        assert 14.5 < float(d.P) / P_ATM < 16.8
+        # CJ identity: D = (v1/v2) * a2 with u2 sonic
+        assert float(d.sound_speed) < float(d.detonation_speed)
+
+    def test_equilibrium_sound_speed_vs_frozen(self, mech, h2_air):
+        """Shifting-equilibrium sound speed of burnt gas is slightly BELOW
+        the frozen sound speed (re-equilibration softens the gas), and
+        within ~10% of it."""
+        r = eq.equilibrate(mech, 298.15, P_ATM, h2_air, option=5)
+        a_eq = float(eq.equilibrium_sound_speed(mech, r))
+        a_fr = float(thermo.sound_speed(mech, r.T, r.P, r.Y))
+        assert a_eq < a_fr
+        assert a_eq > 0.85 * a_fr
+
+
+class TestBatching:
+    def test_vmap_hp_equilibria(self, mech, h2_air):
+        """The solver vmaps over initial temperatures (the batched
+        equilibrium path used for PSR initial guesses and SI burned gas)."""
+        T0s = jnp.array([298.15, 400.0, 600.0, 800.0])
+
+        def one(T0):
+            r = eq.equilibrate(mech, T0, P_ATM, h2_air, option=5)
+            return r.T, r.converged
+
+        Ts, conv = jax.vmap(one)(T0s)
+        assert bool(jnp.all(conv))
+        # flame temperature increases with preheat
+        assert bool(jnp.all(jnp.diff(Ts) > 0))
+        assert 2350.0 < float(Ts[0]) < 2430.0
