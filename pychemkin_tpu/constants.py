@@ -35,12 +35,39 @@ T_STD = 298.15
 CAL_PER_JOULE = 1.0 / 4.184
 #: erg per calorie
 ERG_PER_CAL = 4.184e7
+#: aliases matching the reference's names (reference: constants.py:26-40)
+P_TORRS = P_ATM / 760.0
+ERGS_PER_JOULE = 1.0e7
+JOULES_PER_CALORIE = 1.0 / CAL_PER_JOULE
+ERGS_PER_CALORIE = ERG_PER_CAL
+R_GAS_CAL = R_CAL
 
 # --- canonical air recipes (reference: constants.py:44-61) ------------------
-#: Mole-fraction air recipe (simplified 2-component air).
-Air = {"O2": 0.21, "N2": 0.79}
-#: Mole-fraction air recipe including argon.
-air = {"O2": 0.2095, "N2": 0.7808, "AR": 0.0093, "CO2": 0.0004}
+class Air:
+    """Canonical air recipes, upper-case species symbols
+    (reference: constants.py:44-58). A recipe is a list of
+    (species symbol, fraction) tuples."""
+
+    @staticmethod
+    def X() -> list:
+        return [("O2", 0.21), ("N2", 0.79)]
+
+    @staticmethod
+    def Y() -> list:
+        return [("O2", 0.23), ("N2", 0.77)]
+
+
+class air:
+    """Air recipes with lower-case species symbols
+    (reference: constants.py:61-76)."""
+
+    @staticmethod
+    def X() -> list:
+        return [("o2", 0.21), ("n2", 0.79)]
+
+    @staticmethod
+    def Y() -> list:
+        return [("o2", 0.23), ("n2", 0.77)]
 
 
 def water_heat_vaporization(temperature: float) -> float:

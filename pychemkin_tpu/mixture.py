@@ -1,0 +1,792 @@
+"""Mixture — thermodynamic state container with the reference's full API.
+
+TPU-native re-implementation of the reference's ``Mixture`` class and
+module-level mixing/equilibrium functions
+(reference: src/ansys/chemkin/mixture.py). Every property that the
+reference computes with a per-state ctypes call into the native library
+(ROP at mixture.py:1442, RxnRates at :1551, RHO at :1081, HML/CPBL at
+:1599/:1646, transport at :1943-2170) is here a call into the batched JAX
+kernels of :mod:`pychemkin_tpu.ops`; single-state queries evaluate the
+same jitted kernels the reactor models vmap over thousands of states.
+
+Semantics preserved from the reference:
+- CGS units everywhere (P dyne/cm^2, T K, V cm^3, rho g/cm^3, h erg,
+  rates mol/(cm^3 s)).
+- T/P/V/X/Y set-flags and ``validate()`` (mixture.py:2637).
+- Recipe-or-array polymorphism of the X/Y setters (mixture.py:272/:366):
+  a recipe is a list of (species symbol, fraction) tuples.
+- Static helpers take a ``chemID`` resolved through the chemistry-set
+  registry, matching the reference's call signatures.
+Error style: exceptions instead of the reference's ``exit()``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .chemistry import Chemistry, get_chemistryset
+from .constants import P_ATM, R_GAS
+from .logger import logger
+from .ops import equilibrium as eq_ops
+from .ops import kinetics, thermo, transport
+
+Recipe = List[Tuple[str, float]]
+
+
+def _as_fraction_array(mech, value, what: str) -> np.ndarray:
+    """Accept a recipe (list of (symbol, fraction)) or a full [KK] array
+    (the reference's setter polymorphism, mixture.py:272)."""
+    KK = mech.n_species
+    if isinstance(value, (list, tuple)) and len(value) > 0 and isinstance(
+            value[0], (list, tuple)) and isinstance(value[0][0], str):
+        frac = np.zeros(KK, dtype=np.double)
+        for name, f in value:
+            idx = mech.species_index(name)
+            frac[idx] += float(f)
+        return frac
+    arr = np.asarray(value, dtype=np.double)
+    if arr.shape != (KK,):
+        raise ValueError(f"{what} must be a recipe or a [{KK}] array")
+    return arr
+
+
+class Mixture:
+    """Gas-mixture state: (T, P, V) + composition with set-flags
+    (reference: mixture.py:49)."""
+
+    def __init__(self, chem: Chemistry):
+        if not isinstance(chem, Chemistry):
+            raise TypeError("Mixture requires a Chemistry object "
+                            "(reference: mixture.py:54)")
+        chem._require_mech()
+        self._chem = chem
+        self._KK = chem.KK
+        self._T = 0.0
+        self._P = 0.0
+        self._V = 0.0
+        self._Tset = 0
+        self._Pset = 0
+        self._Vset = 0
+        self._Xset = 0
+        self._Yset = 0
+        self._X = np.zeros(self._KK, dtype=np.double)
+        self._Y = np.zeros(self._KK, dtype=np.double)
+
+    # --- identity ----------------------------------------------------------
+    @property
+    def chemistry(self) -> Chemistry:
+        return self._chem
+
+    @property
+    def mech(self):
+        return self._chem.mech
+
+    @property
+    def chemID(self) -> int:
+        """Chemistry-set index (reference: mixture.py:112)."""
+        return self._chem.chemID
+
+    @property
+    def KK(self) -> int:
+        """Number of gas species (reference: mixture.py:124)."""
+        return self._KK
+
+    @property
+    def species_symbols(self) -> list:
+        return self._chem.species_symbols
+
+    # --- scalar state (reference: mixture.py:136-243) ----------------------
+    @property
+    def pressure(self) -> float:
+        """Pressure [dyne/cm^2]."""
+        if not self._Pset:
+            logger.warning("mixture pressure has not been set")
+        return self._P
+
+    @pressure.setter
+    def pressure(self, p: float):
+        if p <= 0.0:
+            raise ValueError("pressure must be positive")
+        self._P = float(p)
+        self._Pset = 1
+
+    @property
+    def temperature(self) -> float:
+        """Temperature [K]."""
+        if not self._Tset:
+            logger.warning("mixture temperature has not been set")
+        return self._T
+
+    @temperature.setter
+    def temperature(self, t: float):
+        if t <= 0.0:
+            raise ValueError("temperature must be positive")
+        self._T = float(t)
+        self._Tset = 1
+
+    @property
+    def volume(self) -> float:
+        """Volume [cm^3] (reference: mixture.py:209; defaults to 1.0 when
+        unset, as reactor models treat volume as optional)."""
+        return self._V if self._Vset else 1.0
+
+    @volume.setter
+    def volume(self, vol: float):
+        if vol <= 0.0:
+            raise ValueError("volume must be positive")
+        self._V = float(vol)
+        self._Vset = 1
+
+    # --- composition (reference: mixture.py:244-431) -----------------------
+    @property
+    def X(self) -> np.ndarray:
+        """Mole fractions [KK]."""
+        if self._Xset:
+            return self._X.copy()
+        if self._Yset:
+            return np.asarray(thermo.Y_to_X(self.mech, jnp.asarray(self._Y)))
+        logger.warning("mixture composition has not been set")
+        return np.zeros(self._KK, dtype=np.double)
+
+    @X.setter
+    def X(self, recipe: Union[Recipe, Sequence[float]]):
+        frac = _as_fraction_array(self.mech, recipe, "X")
+        if np.any(frac < 0.0):
+            raise ValueError("negative mole fraction")
+        total = frac.sum()
+        if total <= 0.0:
+            raise ValueError("mole fractions sum to zero")
+        self._X = frac / total
+        self._Xset = 1
+        self._Yset = 0
+
+    @property
+    def Y(self) -> np.ndarray:
+        """Mass fractions [KK]."""
+        if self._Yset:
+            return self._Y.copy()
+        if self._Xset:
+            return np.asarray(thermo.X_to_Y(self.mech, jnp.asarray(self._X)))
+        logger.warning("mixture composition has not been set")
+        return np.zeros(self._KK, dtype=np.double)
+
+    @Y.setter
+    def Y(self, recipe: Union[Recipe, Sequence[float]]):
+        frac = _as_fraction_array(self.mech, recipe, "Y")
+        if np.any(frac < 0.0):
+            raise ValueError("negative mass fraction")
+        total = frac.sum()
+        if total <= 0.0:
+            raise ValueError("mass fractions sum to zero")
+        self._Y = frac / total
+        self._Yset = 1
+        self._Xset = 0
+
+    @property
+    def concentration(self) -> np.ndarray:
+        """Molar concentrations [KK], mol/cm^3 (reference: mixture.py:433)."""
+        self._require_state()
+        return np.asarray(thermo.X_to_C(self.mech, jnp.asarray(self.X),
+                                        self._T, self._P))
+
+    @property
+    def EOS(self) -> int:
+        """Equation of state: 0 = ideal gas (reference: mixture.py:473)."""
+        return 0
+
+    @staticmethod
+    def normalize(frac: Sequence[float]) -> Tuple[int, np.ndarray]:
+        """Normalize a fraction array; returns (status, normalized)
+        (reference: mixture.py:486)."""
+        arr = np.asarray(frac, dtype=np.double)
+        total = arr.sum()
+        if total <= 0.0 or np.any(arr < 0.0):
+            return 1, arr
+        return 0, arr / total
+
+    # --- molar-mass helpers (reference: mixture.py:525-936) ----------------
+    @property
+    def WT(self) -> np.ndarray:
+        """Species molecular weights [KK], g/mol (reference:
+        mixture.py:525)."""
+        return np.asarray(self.mech.wt)
+
+    @property
+    def WTM(self) -> float:
+        """Mean molar mass of this mixture, g/mol (reference:
+        mixture.py:541)."""
+        if self._Xset:
+            return float(thermo.mean_molecular_weight_X(
+                self.mech, jnp.asarray(self._X)))
+        return float(thermo.mean_molecular_weight_Y(
+            self.mech, jnp.asarray(self.Y)))
+
+    @staticmethod
+    def mean_molar_mass(frac, wt, mode: str) -> float:
+        """(reference: mixture.py:649)."""
+        frac = np.asarray(frac, dtype=np.double)
+        wt = np.asarray(wt, dtype=np.double)
+        if mode.lower() == "mole":
+            return float(np.dot(frac, wt) / frac.sum())
+        return float(1.0 / np.dot(frac / frac.sum(), 1.0 / wt))
+
+    @staticmethod
+    def mole_fraction_to_mass_fraction(molefrac, wt) -> np.ndarray:
+        """(reference: mixture.py:720)."""
+        x = np.asarray(molefrac, dtype=np.double)
+        wx = x * np.asarray(wt)
+        return wx / wx.sum()
+
+    @staticmethod
+    def mass_fraction_to_mole_fraction(massfrac, wt) -> np.ndarray:
+        """(reference: mixture.py:772)."""
+        y = np.asarray(massfrac, dtype=np.double)
+        n = y / np.asarray(wt)
+        return n / n.sum()
+
+    @staticmethod
+    def mass_fraction_to_concentration(p: float, t: float, massfrac,
+                                       wt) -> np.ndarray:
+        """[mol/cm^3] (reference: mixture.py:820)."""
+        y = np.asarray(massfrac, dtype=np.double)
+        wt = np.asarray(wt, dtype=np.double)
+        wbar = 1.0 / np.dot(y / y.sum(), 1.0 / wt)
+        rho = p * wbar / (R_GAS * t)
+        return rho * (y / y.sum()) / wt
+
+    @staticmethod
+    def mole_fraction_to_concentration(p: float, t: float,
+                                       molefrac) -> np.ndarray:
+        """[mol/cm^3] (reference: mixture.py:877)."""
+        x = np.asarray(molefrac, dtype=np.double)
+        return (x / x.sum()) * p / (R_GAS * t)
+
+    # --- listers (reference: mixture.py:937-991, 2219-2382) ----------------
+    def list_composition(self, mode: str, option: str = " ",
+                         bound: float = 0.0):
+        """Print the composition in 'mass' or 'mole' fractions above
+        ``bound`` (reference: mixture.py:937)."""
+        frac = self.Y if mode.lower() == "mass" else self.X
+        names = self.species_symbols
+        for k in np.argsort(frac)[::-1]:
+            if frac[k] > bound:
+                print(f"  {names[k]:<16s} {frac[k]:.6e}")
+
+    # --- density / EOS (reference: mixture.py:992-1148) --------------------
+    @staticmethod
+    def density(chemID: int, p: float, t: float, frac, wt,
+                mode: str) -> float:
+        """Mass density [g/cm^3] (reference: mixture.py:992)."""
+        mech = get_chemistryset(chemID).mech
+        frac = np.asarray(frac, dtype=np.double)
+        if mode.lower() == "mole":
+            Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
+        else:
+            Y = frac / frac.sum()
+        return float(thermo.density(mech, t, p, jnp.asarray(Y)))
+
+    @property
+    def RHO(self) -> float:
+        """Mass density of this mixture [g/cm^3] (reference:
+        mixture.py:1091)."""
+        self._require_state()
+        return float(thermo.density(self.mech, self._T, self._P,
+                                    jnp.asarray(self.Y)))
+
+    @property
+    def mass(self) -> float:
+        """Gas mass [g] from density and volume."""
+        return self.RHO * self.volume
+
+    # --- mixture thermo properties (reference: mixture.py:1149-1352) -------
+    @staticmethod
+    def mixture_specific_heat(chemID: int, p: float, t: float, frac, wt,
+                              mode: str) -> float:
+        """Mixture Cp [erg/(g K)] (reference: mixture.py:1149)."""
+        mech = get_chemistryset(chemID).mech
+        frac = np.asarray(frac, dtype=np.double)
+        if mode.lower() == "mole":
+            Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
+        else:
+            Y = frac / frac.sum()
+        return float(thermo.mixture_cp_mass(mech, t, jnp.asarray(Y)))
+
+    @staticmethod
+    def mixture_enthalpy(chemID: int, p: float, t: float, frac, wt,
+                         mode: str) -> float:
+        """Mixture specific enthalpy [erg/g] (reference: mixture.py:1254)."""
+        mech = get_chemistryset(chemID).mech
+        frac = np.asarray(frac, dtype=np.double)
+        if mode.lower() == "mole":
+            Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
+        else:
+            Y = frac / frac.sum()
+        return float(thermo.mixture_enthalpy_mass(mech, t, jnp.asarray(Y)))
+
+    # --- kinetics (reference: mixture.py:1353-1568) ------------------------
+    @staticmethod
+    def rate_of_production(chemID: int, p: float, t: float, frac, wt,
+                           mode: str) -> np.ndarray:
+        """Species net molar production rates [KK], mol/(cm^3 s)
+        (reference: mixture.py:1354 -> KINGetGasROP :1442)."""
+        mech = get_chemistryset(chemID).mech
+        frac = np.asarray(frac, dtype=np.double)
+        if mode.lower() == "mole":
+            Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
+        else:
+            Y = frac / frac.sum()
+        return np.asarray(kinetics.rop(mech, t, p, jnp.asarray(Y)))
+
+    @staticmethod
+    def reaction_rates(chemID: int, p: float, t: float, frac, wt,
+                       mode: str) -> Tuple[np.ndarray, np.ndarray]:
+        """Forward/reverse rates of progress per reaction [II each],
+        mol/(cm^3 s) (reference: mixture.py:1457 ->
+        KINGetGasReactionRates :1551)."""
+        mech = get_chemistryset(chemID).mech
+        frac = np.asarray(frac, dtype=np.double)
+        if mode.lower() == "mole":
+            Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
+        else:
+            Y = frac / frac.sum()
+        qf, qr = kinetics.reaction_rates(mech, t, p, jnp.asarray(Y))
+        return np.asarray(qf), np.asarray(qr)
+
+    def Find_Equilibrium(self) -> "Mixture":
+        """Equilibrium mixture at this mixture's (T, P)
+        (reference: mixture.py:1569)."""
+        return equilibrium(self, opt=1)
+
+    # --- instance property shortcuts (reference: mixture.py:1599-2217) -----
+    @property
+    def HML(self) -> float:
+        """Mixture molar enthalpy [erg/mol] (reference: mixture.py:1599)."""
+        self._require_state(need_P=False)
+        return float(thermo.mixture_enthalpy_molar(
+            self.mech, self._T, jnp.asarray(self.X)))
+
+    @property
+    def CPBL(self) -> float:
+        """Mixture molar Cp [erg/(mol K)] (reference: mixture.py:1646)."""
+        self._require_state(need_P=False)
+        return float(thermo.mixture_cp_molar(self.mech, self._T,
+                                             jnp.asarray(self.X)))
+
+    @property
+    def ROP(self) -> np.ndarray:
+        """Net production rates at this state (reference:
+        mixture.py:1693)."""
+        self._require_state()
+        return np.asarray(kinetics.rop(self.mech, self._T, self._P,
+                                       jnp.asarray(self.Y)))
+
+    @property
+    def RxnRates(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(qf, qr) at this state (reference: mixture.py:1748)."""
+        self._require_state()
+        qf, qr = kinetics.reaction_rates(self.mech, self._T, self._P,
+                                         jnp.asarray(self.Y))
+        return np.asarray(qf), np.asarray(qr)
+
+    @property
+    def species_Cp(self) -> np.ndarray:
+        """[KK] erg/(g K) at this T (reference: mixture.py:1810)."""
+        self._require_state(need_P=False, need_comp=False)
+        return np.asarray(thermo.species_cp_mass(self.mech, self._T))
+
+    @property
+    def species_H(self) -> np.ndarray:
+        """[KK] erg/g at this T (reference: mixture.py:1837)."""
+        self._require_state(need_P=False, need_comp=False)
+        return np.asarray(thermo.species_enthalpy_mass(self.mech, self._T))
+
+    @property
+    def species_Visc(self) -> np.ndarray:
+        """[KK] g/(cm s) at this T (reference: mixture.py:1860)."""
+        self._require_state(need_P=False, need_comp=False)
+        return np.asarray(transport.species_viscosities(
+            self._transport_mech(), self._T))
+
+    @property
+    def species_Cond(self) -> np.ndarray:
+        """[KK] erg/(cm K s) (reference: mixture.py:1885)."""
+        self._require_state(need_P=False, need_comp=False)
+        return np.asarray(transport.species_conductivities(
+            self._transport_mech(), self._T))
+
+    @property
+    def species_Diffusion_Coeffs(self) -> np.ndarray:
+        """Binary diffusion matrix [KK, KK], cm^2/s (reference:
+        mixture.py:1910)."""
+        self._require_state(need_comp=False)
+        return np.asarray(transport.binary_diffusion_coefficients(
+            self._transport_mech(), self._T, self._P))
+
+    @property
+    def mixture_viscosity(self) -> float:
+        """Mixture-averaged viscosity [g/(cm s)] (reference:
+        mixture.py:1943)."""
+        self._require_state(need_P=False)
+        return float(transport.mixture_viscosity(
+            self._transport_mech(), self._T, jnp.asarray(self.X)))
+
+    @property
+    def mixture_conductivity(self) -> float:
+        """Mixture-averaged conductivity [erg/(cm K s)] (reference:
+        mixture.py:1979)."""
+        self._require_state(need_P=False)
+        return float(transport.mixture_conductivity(
+            self._transport_mech(), self._T, jnp.asarray(self.X)))
+
+    @property
+    def mixture_diffusion_coeffs(self) -> np.ndarray:
+        """Mixture-averaged diffusion coefficients [KK], cm^2/s
+        (reference: mixture.py:2015)."""
+        self._require_state()
+        return np.asarray(transport.mixture_diffusion_coefficients(
+            self._transport_mech(), self._T, self._P, jnp.asarray(self.X)))
+
+    @property
+    def mixture_binary_diffusion_coeffs(self) -> np.ndarray:
+        """Binary diffusion matrix at this state (reference:
+        mixture.py:2066)."""
+        return self.species_Diffusion_Coeffs
+
+    @property
+    def mixture_thermal_diffusion_coeffs(self) -> np.ndarray:
+        """Thermal diffusion ratios [KK] (reference: mixture.py:2119)."""
+        self._require_state(need_P=False)
+        return np.asarray(transport.thermal_diffusion_ratios(
+            self._transport_mech(), self._T, jnp.asarray(self.X)))
+
+    @property
+    def volHRR(self) -> float:
+        """Volumetric heat release rate [erg/(cm^3 s)]
+        (reference: mixture.py:2172)."""
+        self._require_state()
+        return float(kinetics.volumetric_heat_release_rate(
+            self.mech, self._T, self._P, jnp.asarray(self.Y)))
+
+    @property
+    def massROP(self) -> np.ndarray:
+        """Mass production rates [g/(cm^3 s)] (reference:
+        mixture.py:2204)."""
+        self._require_state()
+        return np.asarray(kinetics.mass_production_rates(
+            self.mech, self._T, self._P, jnp.asarray(self.Y)))
+
+    def list_ROP(self, bound: float = 0.0):
+        """Print nonzero net production rates (reference:
+        mixture.py:2219)."""
+        rop = self.ROP
+        names = self.species_symbols
+        for k in np.argsort(np.abs(rop))[::-1]:
+            if abs(rop[k]) > bound:
+                print(f"  {names[k]:<16s} {rop[k]: .6e} mol/cm3-s")
+
+    def list_massROP(self, bound: float = 0.0):
+        """(reference: mixture.py:2272)."""
+        rop = self.massROP
+        names = self.species_symbols
+        for k in np.argsort(np.abs(rop))[::-1]:
+            if abs(rop[k]) > bound:
+                print(f"  {names[k]:<16s} {rop[k]: .6e} g/cm3-s")
+
+    def list_reaction_rates(self, bound: float = 0.0):
+        """(reference: mixture.py:2325)."""
+        qf, qr = self.RxnRates
+        for i in range(len(qf)):
+            if abs(qf[i] - qr[i]) > bound:
+                print(f"  rxn {i + 1:<5d} qf={qf[i]: .4e} qr={qr[i]: .4e}")
+
+    # --- equivalence-ratio composition setters (mixture.py:2383-2607) ------
+    def X_by_Equivalence_Ratio(self, chemistryset: Chemistry, fuel_molefrac,
+                               oxid_molefrac, add_molefrac, products,
+                               equivalenceratio: float,
+                               threshold: float = 1.0e-10) -> int:
+        """Set this mixture's mole fractions from an equivalence ratio,
+        fuel/oxidizer/additive compositions and the complete-combustion
+        product list (reference: mixture.py:2383).
+
+        phi = (F/O) / (F/O)_stoich; the stoichiometric ratio comes from
+        :func:`pychemkin_tpu.utilities.calculate_stoichiometrics`."""
+        from .utilities import calculate_stoichiometrics
+        mech = chemistryset.mech
+        fuel = np.asarray(fuel_molefrac, dtype=np.double)
+        oxid = np.asarray(oxid_molefrac, dtype=np.double)
+        add = np.asarray(add_molefrac, dtype=np.double)
+        fuel = np.where(fuel > threshold, fuel, 0.0)
+        oxid = np.where(oxid > threshold, oxid, 0.0)
+        prod_index = np.array([mech.species_index(s) for s in products],
+                              dtype=np.int64)
+        alpha, _nu = calculate_stoichiometrics(chemistryset,
+                                               fuel / fuel.sum(),
+                                               oxid / oxid.sum(), prod_index)
+        mix = (equivalenceratio * fuel / fuel.sum()
+               + alpha * oxid / oxid.sum())
+        mix = mix / mix.sum()
+        if add.sum() > 0.0:
+            # additives occupy their given mole-fraction share of the final
+            # mixture; fuel+oxidizer fill the remainder
+            mix = (1.0 - add.sum()) * mix + add
+        self.X = mix / mix.sum()
+        return 0
+
+    def Y_by_Equivalence_Ratio(self, chemistryset: Chemistry, fuel_massfrac,
+                               oxid_massfrac, add_massfrac, products,
+                               equivalenceratio: float,
+                               threshold: float = 1.0e-10) -> int:
+        """Mass-fraction variant (reference: mixture.py:2541)."""
+        wt = chemistryset.WT
+        def to_x(y):
+            y = np.asarray(y, dtype=np.double)
+            if y.sum() <= 0.0:
+                return y
+            return Mixture.mass_fraction_to_mole_fraction(y, wt)
+        return self.X_by_Equivalence_Ratio(
+            chemistryset, to_x(fuel_massfrac), to_x(oxid_massfrac),
+            to_x(add_massfrac), products, equivalenceratio, threshold)
+
+    def get_EGR_mole_fraction(self, EGRratio: float,
+                              threshold: float = 1.0e-8) -> np.ndarray:
+        """EGR (burnt-gas recirculation) stream composition: EGRratio times
+        the equilibrium composition of this mixture, thresholded
+        (reference: mixture.py:2608)."""
+        burned = self.Find_Equilibrium()
+        x = burned.X
+        return np.where(x > threshold, EGRratio * x, 0.0)
+
+    # --- validation (reference: mixture.py:2637) ---------------------------
+    def validate(self) -> int:
+        """0 if fully defined; 1/2/3 for missing T/P/composition."""
+        if not self._Tset:
+            logger.error("mixture temperature is not provided")
+            return 1
+        if not self._Pset:
+            logger.error("mixture pressure is not provided")
+            return 2
+        if not (self._Xset or self._Yset):
+            logger.error("mixture composition is not provided")
+            return 3
+        return 0
+
+    def _require_state(self, need_P: bool = True, need_comp: bool = True):
+        if not self._Tset:
+            raise RuntimeError("mixture temperature is not set")
+        if need_P and not self._Pset:
+            raise RuntimeError("mixture pressure is not set")
+        if need_comp and not (self._Xset or self._Yset):
+            raise RuntimeError("mixture composition is not set")
+
+    def _transport_mech(self):
+        mech = self.mech
+        if not mech.has_transport:
+            raise RuntimeError("mechanism has no transport data")
+        return mech
+
+    # --- real-gas API shims (reference: mixture.py:2664-2801) --------------
+    def use_realgas_cubicEOS(self):
+        logger.warning("real-gas cubic EOS not implemented; ideal gas law "
+                       "remains in effect")
+
+    def use_idealgas_law(self):
+        pass
+
+    def set_realgas_mixing_rule(self, rule: int = 0):
+        logger.warning("real-gas mixing rules not implemented")
+
+
+# ---------------------------------------------------------------------------
+# module-level mixing / equilibrium functions
+
+
+def _combined_composition(recipe, mode: str):
+    """Shared mixing bookkeeping: total mass-weighted Y and per-component
+    mass weights. ``recipe`` is [(Mixture, amount), ...]."""
+    if len(recipe) == 0:
+        raise ValueError("the mixing recipe is empty")
+    chem = recipe[0][0]._chem
+    wt = np.asarray(chem.WT, dtype=np.double)
+    mass_w = []
+    Ys = []
+    for mix, amount in recipe:
+        if mix.chemID != chem.chemID:
+            raise ValueError("all mixtures must share one chemistry set "
+                             "(reference: mixture.py:2860)")
+        if mode.lower() == "mole":
+            m = amount * mix.WTM
+        else:
+            m = amount
+        mass_w.append(m)
+        Ys.append(mix.Y)
+    mass_w = np.asarray(mass_w)
+    mass_w = mass_w / mass_w.sum()
+    Y = sum(w * y for w, y in zip(mass_w, Ys))
+    return chem, mass_w, np.asarray(Y)
+
+
+def isothermal_mixing(recipe, mode: str, finaltemperature: float) -> Mixture:
+    """Mix streams of mixtures to a prescribed final temperature
+    (reference: mixture.py:2802). Pressure of the result is the first
+    mixture's pressure."""
+    chem, _, Y = _combined_composition(recipe, mode)
+    out = Mixture(chem)
+    out.pressure = recipe[0][0].pressure
+    out.temperature = float(finaltemperature)
+    out.Y = Y
+    return out
+
+
+def adiabatic_mixing(recipe, mode: str) -> Mixture:
+    """Mix at constant total enthalpy; the final temperature solves
+    h_mix(T) = sum_i w_i h_i(T_i) (reference: mixture.py:2990)."""
+    chem, mass_w, Y = _combined_composition(recipe, mode)
+    h_target = sum(
+        w * float(thermo.mixture_enthalpy_mass(chem.mech, mix.temperature,
+                                               jnp.asarray(mix.Y)))
+        for w, (mix, _) in zip(mass_w, recipe))
+    out = Mixture(chem)
+    out.pressure = recipe[0][0].pressure
+    out.Y = Y
+    T0 = sum(w * mix.temperature for w, (mix, _) in zip(mass_w, recipe))
+    out.temperature = _solve_T_from_h(chem, Y, h_target, T0)
+    return out
+
+
+def _solve_T_from_h(chem, Y, h_target: float, T_guess: float) -> float:
+    """Newton on h(T) = h_target with cp as the exact slope."""
+    mech = chem.mech
+    Yj = jnp.asarray(Y)
+    T = float(np.clip(T_guess, 200.0, 5500.0))
+    for _ in range(100):
+        h = float(thermo.mixture_enthalpy_mass(mech, T, Yj))
+        cp = float(thermo.mixture_cp_mass(mech, T, Yj))
+        dT = (h_target - h) / max(cp, 1e-300)
+        T = float(np.clip(T + np.clip(dT, -500.0, 500.0), 150.0, 6000.0))
+        if abs(dT) < 1e-10 * max(T, 1.0):
+            break
+    return T
+
+
+def calculate_mixture_temperature_from_enthalpy(
+        mixture: Mixture, mixtureH: float,
+        guesstemperature: float = 0.0) -> int:
+    """Set ``mixture.temperature`` so its molar enthalpy equals
+    ``mixtureH`` [erg/mol] (reference: mixture.py:3179; converges to
+    0.1 K there, exactly here). Returns 0 on success."""
+    if not isinstance(mixture, Mixture):
+        raise TypeError("the first argument must be a Mixture object")
+    wbar = mixture.WTM
+    h_mass = mixtureH / wbar
+    T0 = guesstemperature if guesstemperature > 0.0 else (
+        mixture._T if mixture._Tset else 1000.0)
+    T = _solve_T_from_h(mixture._chem, mixture.Y, h_mass, T0)
+    mixture.temperature = T
+    return 0
+
+
+def interpolate_mixtures(mixtureleft: Mixture, mixtureright: Mixture,
+                         ratio: float) -> Mixture:
+    """(1-ratio) * left + ratio * right in T, P and mass fractions
+    (reference: mixture.py:3268)."""
+    if not 0.0 <= ratio <= 1.0:
+        raise ValueError("ratio must be in [0, 1]")
+    out = Mixture(mixtureleft._chem)
+    out.temperature = ((1.0 - ratio) * mixtureleft.temperature
+                       + ratio * mixtureright.temperature)
+    out.pressure = ((1.0 - ratio) * mixtureleft.pressure
+                    + ratio * mixtureright.pressure)
+    Y = (1.0 - ratio) * mixtureleft.Y + ratio * mixtureright.Y
+    out.Y = Y / Y.sum()
+    return out
+
+
+def compare_mixtures(mixtureA: Mixture, mixtureB: Mixture,
+                     atol: float = 1.0e-10, rtol: float = 1.0e-3,
+                     mode: str = "mass") -> Tuple[bool, float, float]:
+    """Compare P [atm], T [K] and fractions of B against A
+    (reference: mixture.py:3386). Returns (same, max_abs_diff,
+    max_rel_diff)."""
+    vals_a = np.concatenate([[mixtureA.pressure / P_ATM,
+                              mixtureA.temperature],
+                             mixtureA.Y if mode == "mass" else mixtureA.X])
+    vals_b = np.concatenate([[mixtureB.pressure / P_ATM,
+                              mixtureB.temperature],
+                             mixtureB.Y if mode == "mass" else mixtureB.X])
+    diff = np.abs(vals_b - vals_a)
+    denom = np.maximum(np.abs(vals_a), 1e-300)
+    amax = float(diff.max())
+    rmax = float((diff / denom).max())
+    issame = bool(np.all((diff <= atol) | (diff / denom <= rtol)))
+    return issame, amax, rmax
+
+
+def calculate_equilibrium(chemID: int, p: float, t: float, frac, wt,
+                          mode_in: str, mode_out: str, EQOption: int = 1,
+                          useRealGas: int = 0):
+    """Equilibrium state from (p, t, composition)
+    (reference: mixture.py:3574 -> KINCalculateEqGasWithOption :3746).
+
+    Returns ([P_eq, T_eq, sound_speed, detonation_speed], composition)
+    with the speeds nonzero only for the Chapman-Jouguet option (10)."""
+    chem = get_chemistryset(chemID)
+    mech = chem.mech
+    frac = np.asarray(frac, dtype=np.double)
+    if mode_in.lower() == "mole":
+        Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
+    else:
+        Y = frac / frac.sum()
+    if EQOption == 10:
+        det = eq_ops.chapman_jouguet(mech, t, p, jnp.asarray(Y))
+        if not bool(det.converged):
+            logger.warning("Chapman-Jouguet solve did not converge")
+        state = [float(det.P), float(det.T), float(det.sound_speed),
+                 float(det.detonation_speed)]
+        comp = det.X if mode_out.lower() == "mole" else det.Y
+        return state, np.asarray(comp)
+    res = eq_ops.equilibrate(mech, t, p, jnp.asarray(Y), option=EQOption)
+    if not bool(res.converged):
+        logger.warning("equilibrium solve did not converge (option %d, "
+                       "residual %.2e)", EQOption, float(res.residual))
+    state = [float(res.P), float(res.T), 0.0, 0.0]
+    comp = res.X if mode_out.lower() == "mole" else res.Y
+    return state, np.asarray(comp)
+
+
+def equilibrium(mixture: Mixture, opt: int = 1) -> Mixture:
+    """Equilibrium mixture from an initial mixture (reference:
+    mixture.py:3800). All 9 constraint options are available here (the
+    reference disables 3/6/9)."""
+    if not isinstance(mixture, Mixture):
+        raise TypeError("the argument must be a Mixture object")
+    if mixture.validate() != 0:
+        raise RuntimeError("mixture is not fully defined")
+    state, comp = calculate_equilibrium(
+        mixture.chemID, mixture.pressure, mixture.temperature, mixture.Y,
+        mixture.WT, "mass", "mass", EQOption=opt)
+    out = Mixture(mixture._chem)
+    out.pressure = state[0]
+    out.temperature = state[1]
+    out.Y = comp
+    return out
+
+
+def detonation(mixture: Mixture):
+    """Chapman-Jouguet detonation state and speeds (reference:
+    mixture.py:3897). Returns ([sound_speed, detonation_speed],
+    burnt_mixture)."""
+    if not isinstance(mixture, Mixture):
+        raise TypeError("the argument must be a Mixture object")
+    if mixture.validate() != 0:
+        raise RuntimeError("mixture is not fully defined")
+    state, comp = calculate_equilibrium(
+        mixture.chemID, mixture.pressure, mixture.temperature, mixture.Y,
+        mixture.WT, "mass", "mass", EQOption=10)
+    out = Mixture(mixture._chem)
+    out.pressure = state[0]
+    out.temperature = state[1]
+    out.Y = comp
+    return [state[2], state[3]], out

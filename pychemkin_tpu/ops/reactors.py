@@ -310,7 +310,12 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
 
     All inputs broadcast along the leading batch axis.
     """
-    B = jnp.asarray(T0s).shape[0]
+    # batch size = largest leading axis among the inputs (scalars count 1)
+    sizes = [jnp.asarray(a).shape[0] for a in (T0s, P0s, t_ends)
+             if jnp.asarray(a).ndim > 0]
+    if jnp.asarray(Y0s).ndim > 1:
+        sizes.append(jnp.asarray(Y0s).shape[0])
+    B = max(sizes) if sizes else 1
     T0s = jnp.broadcast_to(jnp.asarray(T0s, jnp.float64), (B,))
     P0s = jnp.broadcast_to(jnp.asarray(P0s, jnp.float64), (B,))
     Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64),

@@ -1,0 +1,227 @@
+"""Transport-property kernels (JAX) — pure-species and mixture-averaged.
+
+TPU-native replacement for the reference's native transport entry points:
+species viscosity/conductivity/diffusion (chemkin_wrapper.py:407-425) and
+mixture-averaged viscosity/conductivity/diffusion/binary/thermal-diffusion
+(chemkin_wrapper.py:442-480), surfaced through ``Chemistry.SpeciesVisc/
+Cond/DiffusionCoeffs`` (chemistry.py:1316-1471) and the ``Mixture``
+transport properties (mixture.py:1943-2170).
+
+Standard-kinetic-theory (TRANLIB-class) formulation:
+- Lennard-Jones/Stockmayer collision integrals from the Neufeld et al.
+  fits with the Brokaw dipole correction ``+ 0.2 delta*^2 / T*``.
+- Pure-species viscosity: Chapman-Enskog.
+- Pure-species conductivity: Warnatz translational/rotational/vibrational
+  split with Parker Zrot temperature dependence and self-diffusion.
+- Binary diffusion with polar/nonpolar induction correction ``xi``.
+- Mixture rules: Wilke (viscosity), combination average (conductivity),
+  mixture-averaged diffusion with the (1 - Y_k) correction, and
+  light-species thermal-diffusion ratios.
+
+All functions are jit/vmap-transparent; [KK] / [KK, KK] shapes; CGS units
+(viscosity g/(cm s) = poise, conductivity erg/(cm K s), diffusion cm^2/s).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..constants import AVOGADRO, BOLTZMANN, R_GAS
+from ..mechanism.record import GEOM_LINEAR, GEOM_NONLINEAR
+from . import thermo
+
+_PI = jnp.pi
+_DEBYE = 1.0e-18         # esu cm per Debye
+_ANGSTROM = 1.0e-8       # cm per Angstrom
+
+
+def _omega22(t_star, delta_star):
+    """Collision integral Omega^(2,2)* (Neufeld fit + Brokaw dipole term)."""
+    ts = jnp.maximum(t_star, 1e-3)
+    base = (1.16145 * ts ** -0.14874 + 0.52487 * jnp.exp(-0.77320 * ts)
+            + 2.16178 * jnp.exp(-2.43787 * ts))
+    return base + 0.2 * delta_star ** 2 / ts
+
+
+def _omega11(t_star, delta_star):
+    """Collision integral Omega^(1,1)* (Neufeld fit + Brokaw dipole term)."""
+    ts = jnp.maximum(t_star, 1e-3)
+    base = (1.06036 * ts ** -0.15610 + 0.19300 * jnp.exp(-0.47635 * ts)
+            + 1.03587 * jnp.exp(-1.52996 * ts)
+            + 1.76474 * jnp.exp(-3.89411 * ts))
+    return base + 0.19 * delta_star ** 2 / ts
+
+
+def _reduced_dipole(mech):
+    """delta*_k = mu_k^2 / (2 eps_k sigma_k^3), dimensionless, [KK]."""
+    mu2 = (mech.dipole * _DEBYE) ** 2
+    eps = mech.eps_k * BOLTZMANN
+    sig3 = (mech.sigma * _ANGSTROM) ** 3
+    return mu2 / jnp.maximum(2.0 * eps * sig3, 1e-300)
+
+
+def species_viscosities(mech, T):
+    """Pure-species dynamic viscosities [KK], g/(cm s)
+    (reference SpeciesVisc, chemistry.py:1316)."""
+    m = mech.wt / AVOGADRO                    # g per molecule
+    sigma = mech.sigma * _ANGSTROM
+    t_star = T / mech.eps_k
+    om22 = _omega22(t_star, _reduced_dipole(mech))
+    return (5.0 / 16.0) * jnp.sqrt(_PI * m * BOLTZMANN * T) / (
+        _PI * sigma ** 2 * om22)
+
+
+def _parker_zrot(mech, T):
+    """Parker rotational-relaxation temperature dependence:
+    Zrot(T) = Zrot(298) * F(298) / F(T)."""
+    def F(Tq):
+        e = mech.eps_k / Tq
+        return (1.0 + 0.5 * _PI ** 1.5 * jnp.sqrt(e)
+                + (0.25 * _PI ** 2 + 2.0) * e + _PI ** 1.5 * e ** 1.5)
+    return mech.zrot * F(298.0) / F(T)
+
+
+def species_conductivities(mech, T):
+    """Pure-species thermal conductivities [KK], erg/(cm K s)
+    (reference SpeciesCond, chemistry.py:1361).
+
+    Warnatz/TRANLIB internal-mode split: translational, rotational and
+    vibrational contributions with self-diffusion coupling."""
+    mu = species_viscosities(mech, T)
+    m = mech.wt / AVOGADRO
+    sigma = mech.sigma * _ANGSTROM
+    t_star = T / mech.eps_k
+    delta = _reduced_dipole(mech)
+    om11 = _omega11(t_star, delta)
+    # rho * D_kk (self-diffusion, reduced mass m/2):
+    rhoD = (3.0 / 8.0) * jnp.sqrt(_PI * m * BOLTZMANN * T) / (
+        _PI * sigma ** 2 * om11)
+
+    cv_R = thermo.cv_R(mech, T)                       # [KK] total Cv/R
+    cv_rot_R = jnp.where(mech.geom == GEOM_LINEAR, 1.0,
+                         jnp.where(mech.geom == GEOM_NONLINEAR, 1.5, 0.0))
+    cv_tr_R = 1.5
+    cv_vib_R = jnp.maximum(cv_R - cv_tr_R - cv_rot_R, 0.0)
+
+    f_vib = rhoD / jnp.maximum(mu, 1e-300)
+    A = 2.5 - f_vib
+    zrot = _parker_zrot(mech, T)
+    B = zrot + (2.0 / _PI) * ((5.0 / 3.0) * cv_rot_R + f_vib)
+    f_tr = 2.5 * (1.0 - (2.0 / _PI) * (cv_rot_R / cv_tr_R) * (A / B))
+    f_rot = f_vib * (1.0 + (2.0 / _PI) * (A / B))
+    has_rot = cv_rot_R > 0.0
+    f_tr = jnp.where(has_rot, f_tr, 2.5)
+    f_rot = jnp.where(has_rot, f_rot, 0.0)
+    return (mu / mech.wt) * R_GAS * (
+        f_tr * cv_tr_R + f_rot * cv_rot_R + f_vib * cv_vib_R)
+
+
+def _pair_params(mech):
+    """Combined pair LJ parameters with the TRANLIB polar/nonpolar
+    induction correction xi: returns (sigma_jk [KK,KK] cm,
+    eps_jk [KK,KK] K, m_red [KK,KK] g)."""
+    sigma = mech.sigma * _ANGSTROM
+    eps = mech.eps_k                        # in K
+    polar = mech.dipole > 0.0
+    alpha_r = (mech.polar / jnp.maximum(mech.sigma, 1e-30) ** 3)   # [KK]
+    mu_r2 = ((mech.dipole * _DEBYE) ** 2
+             / jnp.maximum(eps * BOLTZMANN * sigma ** 3, 1e-300))  # [KK]
+
+    pj = polar[:, None]
+    pk = polar[None, :]
+    # polar j with nonpolar k: xi = 1 + alpha_r_k mu_r2_j sqrt(eps_j/eps_k)/4
+    xi_jk = 1.0 + 0.25 * alpha_r[None, :] * mu_r2[:, None] * jnp.sqrt(
+        eps[:, None] / jnp.maximum(eps[None, :], 1e-30))
+    xi_kj = 1.0 + 0.25 * alpha_r[:, None] * mu_r2[None, :] * jnp.sqrt(
+        eps[None, :] / jnp.maximum(eps[:, None], 1e-30))
+    xi = jnp.where(pj & ~pk, xi_jk, jnp.where(~pj & pk, xi_kj, 1.0))
+
+    eps_jk = jnp.sqrt(eps[:, None] * eps[None, :]) * xi ** 2
+    sigma_jk = 0.5 * (sigma[:, None] + sigma[None, :]) * xi ** (-1.0 / 6.0)
+    m = mech.wt / AVOGADRO
+    m_red = m[:, None] * m[None, :] / (m[:, None] + m[None, :])
+    return sigma_jk, eps_jk, m_red
+
+
+def binary_diffusion_coefficients(mech, T, P):
+    """Binary diffusion coefficient matrix [KK, KK], cm^2/s (reference
+    mixture_binary_diffusion_coeffs, mixture.py:2066)."""
+    sigma_jk, eps_jk, m_red = _pair_params(mech)
+    t_star = T / eps_jk
+    # pair reduced dipole: zero unless both polar (standard TRANLIB rule)
+    delta = _reduced_dipole(mech)
+    delta_jk = jnp.sqrt(jnp.maximum(delta[:, None] * delta[None, :], 0.0))
+    om11 = _omega11(t_star, delta_jk)
+    return (3.0 / 16.0) * jnp.sqrt(
+        2.0 * _PI * (BOLTZMANN * T) ** 3 / m_red) / (
+        P * _PI * sigma_jk ** 2 * om11)
+
+
+def mixture_viscosity(mech, T, X):
+    """Wilke mixture-averaged viscosity, g/(cm s) (reference
+    mixture_viscosity, mixture.py:1943)."""
+    mu = species_viscosities(mech, T)
+    w = mech.wt
+    ratio_mu = mu[:, None] / jnp.maximum(mu[None, :], 1e-300)
+    ratio_w = w[None, :] / w[:, None]
+    phi = (1.0 + jnp.sqrt(ratio_mu) * ratio_w ** 0.25) ** 2 / jnp.sqrt(
+        8.0 * (1.0 + 1.0 / ratio_w))
+    x = jnp.maximum(X, 1e-30)
+    denom = phi @ x                      # [KK]
+    return jnp.sum(x * mu / jnp.maximum(denom, 1e-300))
+
+
+def mixture_conductivity(mech, T, X):
+    """Combination-averaged mixture conductivity, erg/(cm K s)
+    (reference mixture_conductivity, mixture.py:1979):
+    lambda = 0.5 (sum x_k lam_k + 1/sum(x_k/lam_k))."""
+    lam = species_conductivities(mech, T)
+    x = jnp.maximum(X, 1e-30)
+    x = x / jnp.sum(x)
+    return 0.5 * (jnp.dot(x, lam) + 1.0 / jnp.dot(x, 1.0 / jnp.maximum(
+        lam, 1e-300)))
+
+
+def mixture_diffusion_coefficients(mech, T, P, X):
+    """Mixture-averaged diffusion coefficients D_km [KK], cm^2/s
+    (reference mixture_diffusion_coeffs, mixture.py:2015):
+    D_km = (1 - Y_k) / sum_{j != k} (x_j / D_jk)."""
+    Djk = binary_diffusion_coefficients(mech, T, P)
+    x = jnp.maximum(X, 1e-30)
+    x = x / jnp.sum(x)
+    Y = thermo.X_to_Y(mech, x)
+    inv = x[None, :] / Djk
+    # exclude the self term from the sum
+    off_sum = inv.sum(axis=1) - jnp.diagonal(inv)
+    # pure-species limit: D_km -> D_kk (self-diffusion)
+    return jnp.where(off_sum > 1e-30, (1.0 - Y) / jnp.maximum(
+        off_sum, 1e-300), jnp.diagonal(Djk))
+
+
+def thermal_diffusion_ratios(mech, T, X):
+    """Light-species thermal diffusion ratios Theta_k [KK] (reference
+    mixture_thermal_diffusion_coeffs, mixture.py:2119).
+
+    First-order Chapman-Enskog form over binary pairs; significant only
+    for light species (H, H2, He), the regime the reference's native
+    library also restricts to."""
+    sigma_jk, eps_jk, _ = _pair_params(mech)
+    t_star = T / eps_jk
+    delta = _reduced_dipole(mech)
+    delta_jk = jnp.sqrt(jnp.maximum(delta[:, None] * delta[None, :], 0.0))
+    om11 = _omega11(t_star, delta_jk)
+    om22 = _omega22(t_star, delta_jk)
+    a_star = om22 / om11
+    # B* and C* vary slowly over the combustion-relevant T* range (1-10);
+    # use their LJ plateau values (A* is computed exactly from the fits)
+    b_star = 1.11
+    c_star = 0.93
+    w = mech.wt
+    factor = (15.0 / 2.0) * (2.0 * a_star + 5.0) * (6.0 * c_star - 5.0) / (
+        a_star * (16.0 * a_star - 12.0 * b_star + 55.0))
+    dm = (w[:, None] - w[None, :]) / (w[:, None] + w[None, :])
+    x = jnp.maximum(X, 1e-30)
+    x = x / jnp.sum(x)
+    theta = (factor * dm * x[None, :]).sum(axis=1) * x
+    # restrict to light species as the native library does
+    return jnp.where(w <= 5.0, theta, 0.0)

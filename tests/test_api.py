@@ -1,0 +1,299 @@
+"""API-parity-layer tests: Chemistry / Mixture / Stream / utilities.
+
+Covers the reference's object-model semantics (set-flags, recipe setters,
+unit conventions, flow-mode conversions, stoichiometry solver, mixing
+functions) with numeric oracles from hand calculation where the reference
+has none (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import pychemkin_tpu as ck
+from pychemkin_tpu import utilities
+from pychemkin_tpu.constants import P_ATM, R_GAS
+from pychemkin_tpu.mechanism import load_embedded
+
+
+@pytest.fixture(scope="module")
+def chem():
+    return ck.Chemistry.from_mechanism(load_embedded("h2o2"), label="h2o2")
+
+
+@pytest.fixture()
+def h2_air_mix(chem):
+    mix = ck.Mixture(chem)
+    mix.pressure = P_ATM
+    mix.temperature = 298.15
+    mix.X = [("H2", 2.0), ("O2", 1.0), ("N2", 3.76)]
+    return mix
+
+
+class TestChemistry:
+    def test_sizes_and_symbols(self, chem):
+        assert chem.KK == 10
+        assert chem.MM == 4
+        assert chem.IIGas > 0
+        assert "H2O" in chem.species_symbols
+        assert set(chem.element_symbols) >= {"H", "O", "N"}
+        assert chem.get_specindex("h2o") == chem.species_symbols.index("H2O")
+        assert chem.get_specindex("XYZ") == -1
+
+    def test_weights(self, chem):
+        wt = chem.WT
+        i_h2 = chem.get_specindex("H2")
+        assert abs(wt[i_h2] - 2.016) < 0.01
+        i_n2 = chem.get_specindex("N2")
+        assert abs(wt[i_n2] - 28.014) < 0.02
+
+    def test_species_properties(self, chem):
+        cp = chem.SpeciesCp(300.0)
+        cv = chem.SpeciesCv(300.0)
+        # cp - cv = R/W for ideal gas
+        np.testing.assert_allclose(cp - cv, R_GAS / chem.WT, rtol=1e-10)
+        # N2 cp at 300 K ~ 1.04 J/(g K) = 1.04e7 erg/(g K)
+        assert abs(cp[chem.get_specindex("N2")] - 1.04e7) < 0.02e7
+
+    def test_reaction_parameters_roundtrip(self, chem):
+        A, beta, EaR = chem.get_reaction_parameters()
+        assert len(A) == chem.IIGas
+        chem.set_reaction_AFactor(1, 2.0 * A[0])
+        A2, _, _ = chem.get_reaction_parameters()
+        assert abs(A2[0] - 2.0 * A[0]) < 1e-6 * abs(A[0])
+        chem.set_reaction_AFactor(1, A[0])  # restore
+
+    def test_reaction_string(self, chem):
+        s = chem.get_gas_reaction_string(1)
+        assert "=" in s or "<=>" in s
+
+    def test_composition_matrix(self, chem):
+        ncf = chem.SpeciesComposition()
+        i_h2o = chem.get_specindex("H2O")
+        j_h = chem.element_symbols.index("H")
+        j_o = chem.element_symbols.index("O")
+        assert ncf[i_h2o, j_h] == 2
+        assert ncf[i_h2o, j_o] == 1
+        assert chem.SpeciesComposition(j_h, i_h2o) == 2
+
+    def test_registry(self, chem):
+        assert ck.chemistry.check_chemistryset(chem.chemID)
+        assert ck.chemistry.activate_chemistryset(chem.chemID) == 0
+        assert ck.chemkin_version() >= 252
+
+
+class TestMixture:
+    def test_validate_flags(self, chem):
+        mix = ck.Mixture(chem)
+        assert mix.validate() == 1
+        mix.temperature = 300.0
+        assert mix.validate() == 2
+        mix.pressure = P_ATM
+        assert mix.validate() == 3
+        mix.X = [("H2", 1.0)]
+        assert mix.validate() == 0
+
+    def test_recipe_and_array_setters(self, chem, h2_air_mix):
+        x = h2_air_mix.X
+        assert abs(x.sum() - 1.0) < 1e-12
+        assert abs(x[chem.get_specindex("H2")] - 2.0 / 6.76) < 1e-10
+        mix2 = ck.Mixture(chem)
+        mix2.temperature = 298.15
+        mix2.pressure = P_ATM
+        mix2.X = x                      # full-array form
+        np.testing.assert_allclose(mix2.X, x)
+
+    def test_xy_roundtrip(self, h2_air_mix):
+        y = h2_air_mix.Y
+        mixY = ck.Mixture(h2_air_mix.chemistry)
+        mixY.temperature = 298.15
+        mixY.pressure = P_ATM
+        mixY.Y = y
+        np.testing.assert_allclose(mixY.X, h2_air_mix.X, atol=1e-12)
+
+    def test_density_ideal_gas(self, h2_air_mix):
+        # rho = P Wbar / (R T)
+        expected = P_ATM * h2_air_mix.WTM / (R_GAS * 298.15)
+        assert abs(h2_air_mix.RHO - expected) < 1e-12
+
+    def test_concentration_sums_to_total(self, h2_air_mix):
+        c = h2_air_mix.concentration
+        assert abs(c.sum() - P_ATM / (R_GAS * 298.15)) < 1e-15
+
+    def test_static_helpers_match_instance(self, chem, h2_air_mix):
+        rho = ck.Mixture.density(chem.chemID, P_ATM, 298.15, h2_air_mix.X,
+                                 chem.WT, "mole")
+        assert abs(rho - h2_air_mix.RHO) < 1e-15
+        h = ck.Mixture.mixture_enthalpy(chem.chemID, P_ATM, 298.15,
+                                        h2_air_mix.Y, chem.WT, "mass")
+        assert abs(h * h2_air_mix.WTM - h2_air_mix.HML) < 1e-4 * abs(
+            h2_air_mix.HML)
+
+    def test_rop_balances_elements(self, chem, h2_air_mix):
+        """Element conservation of the kinetics through the API path."""
+        h2_air_mix.temperature = 1500.0
+        rop = h2_air_mix.ROP
+        ncf = chem.SpeciesComposition()
+        elem_rates = ncf.T @ rop
+        assert np.max(np.abs(elem_rates)) < 1e-12 * np.max(np.abs(rop))
+
+    def test_equivalence_ratio_h2(self, chem):
+        names = chem.species_symbols
+        fuel = np.zeros(chem.KK)
+        fuel[names.index("H2")] = 1.0
+        oxid = np.zeros(chem.KK)
+        oxid[names.index("O2")] = 0.21
+        oxid[names.index("N2")] = 0.79
+        mix = ck.Mixture(chem)
+        mix.pressure = P_ATM
+        mix.temperature = 298.15
+        mix.X_by_Equivalence_Ratio(chem, fuel, oxid, np.zeros(chem.KK),
+                                   ["H2O", "N2"], 1.0)
+        x = mix.X
+        # stoich: 1 H2 + 0.5 O2 -> alpha = 0.5/0.21 of 'air'
+        # X_H2 = 1 / (1 + 0.5/0.21) = 0.2958
+        assert abs(x[names.index("H2")] - 0.29578) < 1e-4
+        assert abs(x[names.index("O2")] - 0.5 * 0.29578) < 1e-4
+
+    def test_egr_composition(self, chem, h2_air_mix):
+        egr = h2_air_mix.get_EGR_mole_fraction(0.3)
+        names = chem.species_symbols
+        assert egr[names.index("H2O")] > 0.05   # burnt gas is mostly H2O/N2
+        assert egr.max() <= 0.3 + 1e-12
+
+
+class TestMixing:
+    def test_isothermal_mixing(self, chem):
+        a = ck.Mixture(chem)
+        a.temperature, a.pressure = 300.0, P_ATM
+        a.X = [("H2", 1.0)]
+        b = ck.Mixture(chem)
+        b.temperature, b.pressure = 300.0, P_ATM
+        b.X = [("O2", 1.0)]
+        out = ck.isothermal_mixing([(a, 2.0), (b, 1.0)], "mole", 350.0)
+        assert out.temperature == 350.0
+        x = out.X
+        assert abs(x[chem.get_specindex("H2")] - 2.0 / 3.0) < 1e-10
+
+    def test_adiabatic_mixing_temperature_between(self, chem):
+        a = ck.Mixture(chem)
+        a.temperature, a.pressure = 300.0, P_ATM
+        a.X = [("N2", 1.0)]
+        b = ck.Mixture(chem)
+        b.temperature, b.pressure = 900.0, P_ATM
+        b.X = [("N2", 1.0)]
+        out = ck.adiabatic_mixing([(a, 1.0), (b, 1.0)], "mass")
+        assert 590.0 < out.temperature < 610.0   # cp(N2) mildly T-dependent
+
+    def test_temperature_from_enthalpy(self, chem, h2_air_mix):
+        h_molar = h2_air_mix.HML
+        mix = ck.Mixture(chem)
+        mix.pressure = P_ATM
+        mix.temperature = 500.0   # wrong on purpose
+        mix.X = h2_air_mix.X
+        ck.calculate_mixture_temperature_from_enthalpy(mix, h_molar)
+        assert abs(mix.temperature - 298.15) < 0.05
+
+    def test_interpolate_and_compare(self, chem):
+        a = ck.Mixture(chem)
+        a.temperature, a.pressure = 300.0, P_ATM
+        a.X = [("H2", 1.0)]
+        b = ck.Mixture(chem)
+        b.temperature, b.pressure = 500.0, 2.0 * P_ATM
+        b.X = [("O2", 1.0)]
+        mid = ck.interpolate_mixtures(a, b, 0.5)
+        assert abs(mid.temperature - 400.0) < 1e-10
+        same, _, _ = ck.compare_mixtures(a, a)
+        assert same
+        diff, _, _ = ck.compare_mixtures(a, b)
+        assert not diff
+
+
+class TestStream:
+    def test_flow_mode_conversions(self, chem):
+        s = ck.Stream(chem, label="inlet-1")
+        s.temperature = 298.15
+        s.pressure = P_ATM
+        s.X = [("N2", 1.0)]
+        s.mass_flowrate = 10.0
+        rho = s.RHO
+        assert abs(s.vol_flowrate - 10.0 / rho) < 1e-8
+        # round-trip through SCCM (standard state == stream state here)
+        assert abs(s.sccm - 10.0 / rho * 60.0) < 1e-6
+        s.flowarea = 2.0
+        assert abs(s.velocity - 10.0 / rho / 2.0) < 1e-8
+        # switching specification preserves the mass flow
+        s.vol_flowrate = 10.0 / rho
+        assert abs(s.convert_to_mass_flowrate() - 10.0) < 1e-8
+
+    def test_clone_and_compare(self, chem):
+        s = ck.Stream(chem)
+        s.temperature, s.pressure = 400.0, P_ATM
+        s.X = [("H2", 1.0), ("N2", 3.0)]
+        s.mass_flowrate = 5.0
+        t = ck.Stream(chem)
+        ck.clone_stream(s, t)
+        same, _, _ = ck.compare_streams(s, t)
+        assert same
+
+    def test_adiabatic_mixing_streams(self, chem):
+        a = ck.Stream(chem)
+        a.temperature, a.pressure = 300.0, P_ATM
+        a.X = [("N2", 1.0)]
+        a.mass_flowrate = 1.0
+        b = ck.Stream(chem)
+        b.temperature, b.pressure = 900.0, P_ATM
+        b.X = [("N2", 1.0)]
+        b.mass_flowrate = 3.0
+        out = ck.adiabatic_mixing_streams(a, b)
+        assert abs(out.mass_flowrate - 4.0) < 1e-12
+        assert 700.0 < out.temperature < 780.0  # mass-weighted toward b
+
+    def test_create_from_mixture(self, chem, h2_air_mix):
+        s = ck.create_stream_from_mixture(h2_air_mix, label="from-mix")
+        assert s.label == "from-mix"
+        np.testing.assert_allclose(s.X, h2_air_mix.X)
+
+
+class TestUtilities:
+    def test_bisect_and_interpolation(self):
+        xs = [0.0, 1.0, 2.0, 4.0]
+        assert utilities.bisect(1.5, xs) == 1
+        assert utilities.bisect(-1.0, xs) == -1
+        i, f = utilities.find_interpolate_parameters(3.0, xs)
+        assert i == 2 and abs(f - 0.5) < 1e-12
+        y = utilities.interpolate_array(xs, [0.0, 10.0, 20.0, 40.0], 3.0)
+        assert abs(y - 30.0) < 1e-12
+
+    def test_stoichiometry_h2(self, chem):
+        names = chem.species_symbols
+        fuel = np.zeros(chem.KK)
+        fuel[names.index("H2")] = 1.0
+        oxid = np.zeros(chem.KK)
+        oxid[names.index("O2")] = 0.21
+        oxid[names.index("N2")] = 0.79
+        prods = np.array([names.index("H2O"), names.index("N2")])
+        alpha, nu = utilities.calculate_stoichiometrics(chem, fuel, oxid,
+                                                        prods)
+        # H2 + 0.5 O2: alpha * 0.21 = 0.5 -> alpha = 2.381
+        assert abs(alpha - 0.5 / 0.21) < 1e-10
+        assert abs(nu[0] - 1.0) < 1e-10            # 1 H2O
+        assert abs(nu[1] - alpha * 0.79) < 1e-10   # inert N2 passthrough
+
+    def test_recipe_from_fractions(self, chem):
+        frac = np.zeros(chem.KK)
+        frac[chem.get_specindex("H2")] = 0.3
+        frac[chem.get_specindex("O2")] = 0.7
+        recipe = utilities.create_mixture_recipe_from_fractions(chem, frac)
+        assert ("H2", 0.3) in recipe and ("O2", 0.7) in recipe
+        assert len(recipe) == 2
+
+
+class TestConstants:
+    def test_air_recipes(self):
+        assert ("O2", 0.21) in ck.Air.X()
+        assert ("o2", 0.23) in ck.air.Y()
+
+    def test_water_heat_vaporization(self):
+        # ~2257 J/g at the normal boiling point
+        h = ck.water_heat_vaporization(373.15)
+        assert abs(h - 2.2564e10) < 0.03e10
+        assert ck.water_heat_vaporization(650.0) == 0.0

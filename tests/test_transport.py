@@ -1,0 +1,91 @@
+"""Transport-kernel tests against literature values at 300 K, 1 atm.
+
+The reference computes these in the licensed native library
+(chemkin_wrapper.py:407-480) with no unit tests; oracles here are standard
+handbook values (CRC / NIST) for N2, O2, H2, H2O and air."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu.constants import P_ATM
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import transport as tr
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+def _idx(mech, name):
+    return mech.species_index(name)
+
+
+class TestPureSpecies:
+    def test_viscosities_300K(self, mech):
+        mu = np.asarray(tr.species_viscosities(mech, 300.0))
+        # handbook: N2 1.78e-4, O2 2.07e-4, H2 0.89e-4 g/(cm s) (+-3%)
+        assert abs(mu[_idx(mech, "N2")] - 1.78e-4) < 0.06e-4
+        assert abs(mu[_idx(mech, "O2")] - 2.07e-4) < 0.07e-4
+        assert abs(mu[_idx(mech, "H2")] - 0.89e-4) < 0.04e-4
+
+    def test_conductivities_300K(self, mech):
+        lam = np.asarray(tr.species_conductivities(mech, 300.0))
+        # W/(m K): N2 0.0259, O2 0.0266, H2 0.186 (+-8%)
+        assert abs(lam[_idx(mech, "N2")] * 1e-5 - 0.0259) < 0.002
+        assert abs(lam[_idx(mech, "O2")] * 1e-5 - 0.0266) < 0.002
+        assert abs(lam[_idx(mech, "H2")] * 1e-5 - 0.186) < 0.015
+
+    def test_temperature_scaling(self, mech):
+        """Viscosity grows roughly as T^0.7 for simple gases."""
+        mu300 = np.asarray(tr.species_viscosities(mech, 300.0))
+        mu900 = np.asarray(tr.species_viscosities(mech, 900.0))
+        ratio = mu900[_idx(mech, "N2")] / mu300[_idx(mech, "N2")]
+        assert 1.9 < ratio < 2.4   # (900/300)^0.7 = 2.16
+
+
+class TestBinaryDiffusion:
+    def test_known_pairs_300K(self, mech):
+        D = np.asarray(tr.binary_diffusion_coefficients(mech, 300.0, P_ATM))
+        # cm^2/s: O2-N2 ~0.21, H2-N2 ~0.77 (+-8%)
+        assert abs(D[_idx(mech, "O2"), _idx(mech, "N2")] - 0.21) < 0.02
+        assert abs(D[_idx(mech, "H2"), _idx(mech, "N2")] - 0.77) < 0.06
+
+    def test_symmetry_and_pressure_scaling(self, mech):
+        D1 = np.asarray(tr.binary_diffusion_coefficients(mech, 300.0, P_ATM))
+        np.testing.assert_allclose(D1, D1.T, rtol=1e-12)
+        D2 = np.asarray(
+            tr.binary_diffusion_coefficients(mech, 300.0, 2 * P_ATM))
+        np.testing.assert_allclose(D2, D1 / 2.0, rtol=1e-12)
+
+
+class TestMixtureRules:
+    def test_air_viscosity_conductivity(self, mech):
+        X = np.zeros(mech.n_species)
+        X[_idx(mech, "O2")] = 0.21
+        X[_idx(mech, "N2")] = 0.79
+        mu = float(tr.mixture_viscosity(mech, 300.0, jnp.asarray(X)))
+        lam = float(tr.mixture_conductivity(mech, 300.0, jnp.asarray(X)))
+        assert abs(mu - 1.85e-4) < 0.06e-4        # air ~1.85e-4 g/(cm s)
+        assert abs(lam * 1e-5 - 0.026) < 0.002    # air ~0.026 W/(m K)
+
+    def test_mixture_diffusion_h2_in_air(self, mech):
+        X = np.full(mech.n_species, 1e-10)
+        X[_idx(mech, "O2")] = 0.21
+        X[_idx(mech, "N2")] = 0.79
+        Dm = np.asarray(tr.mixture_diffusion_coefficients(
+            mech, 300.0, P_ATM, jnp.asarray(X / X.sum())))
+        # trace H2 in air ~ 0.76-0.82 cm^2/s
+        assert 0.70 < Dm[_idx(mech, "H2")] < 0.88
+
+    def test_thermal_diffusion_light_species_only(self, mech):
+        X = np.full(mech.n_species, 0.01)
+        X[_idx(mech, "N2")] = 0.9
+        th = np.asarray(tr.thermal_diffusion_ratios(mech, 1000.0,
+                                                    jnp.asarray(X)))
+        w = np.asarray(mech.wt)
+        assert np.all(th[w > 5.0] == 0.0)
+        # light species (H, H2) get negative ratios (drift toward hot)
+        assert th[_idx(mech, "H2")] < 0.0
+        assert np.all(np.isfinite(th))
