@@ -22,6 +22,7 @@ Error style: exceptions instead of the reference's ``exit()``.
 
 from __future__ import annotations
 
+import copy
 from typing import List, Sequence, Tuple, Union
 
 import jax.numpy as jnp
@@ -74,6 +75,24 @@ class Mixture:
         self._Yset = 0
         self._X = np.zeros(self._KK, dtype=np.double)
         self._Y = np.zeros(self._KK, dtype=np.double)
+
+    def __deepcopy__(self, memo):
+        """Deep-copy the (small) state arrays but SHARE the Chemistry and
+        its immutable MechanismRecord — copying megabytes of mechanism
+        tables per reactor instance (the reference deep-copies the whole
+        object, reactormodel.py:690) would defeat the records-are-values
+        design."""
+        cls = self.__class__
+        out = cls.__new__(cls)
+        memo[id(self)] = out
+        for k, v in self.__dict__.items():
+            if k == "_chem":
+                out._chem = v
+            elif isinstance(v, np.ndarray):
+                setattr(out, k, v.copy())
+            else:
+                setattr(out, k, copy.deepcopy(v, memo))
+        return out
 
     # --- identity ----------------------------------------------------------
     @property
@@ -710,12 +729,13 @@ def compare_mixtures(mixtureA: Mixture, mixtureB: Mixture,
     """Compare P [atm], T [K] and fractions of B against A
     (reference: mixture.py:3386). Returns (same, max_abs_diff,
     max_rel_diff)."""
+    use_mass = mode.lower() == "mass"
     vals_a = np.concatenate([[mixtureA.pressure / P_ATM,
                               mixtureA.temperature],
-                             mixtureA.Y if mode == "mass" else mixtureA.X])
+                             mixtureA.Y if use_mass else mixtureA.X])
     vals_b = np.concatenate([[mixtureB.pressure / P_ATM,
                               mixtureB.temperature],
-                             mixtureB.Y if mode == "mass" else mixtureB.X])
+                             mixtureB.Y if use_mass else mixtureB.X])
     diff = np.abs(vals_b - vals_a)
     denom = np.maximum(np.abs(vals_a), 1e-300)
     amax = float(diff.max())

@@ -1,0 +1,35 @@
+"""Reactor and flame model classes (the reference's L3/L4 layers,
+SURVEY.md §1): the Keyword/Profile/ReactorModel framework plus the
+concrete user-facing simulation classes."""
+
+from .batch import (
+    BatchReactors,
+    GivenPressureBatchReactor_EnergyConservation,
+    GivenPressureBatchReactor_FixedTemperature,
+    GivenVolumeBatchReactor_EnergyConservation,
+    GivenVolumeBatchReactor_FixedTemperature,
+)
+from .reactormodel import (
+    BooleanKeyword,
+    IntegerKeyword,
+    Keyword,
+    Profile,
+    ReactorModel,
+    RealKeyword,
+    StringKeyword,
+)
+
+__all__ = [
+    "BatchReactors",
+    "BooleanKeyword",
+    "GivenPressureBatchReactor_EnergyConservation",
+    "GivenPressureBatchReactor_FixedTemperature",
+    "GivenVolumeBatchReactor_EnergyConservation",
+    "GivenVolumeBatchReactor_FixedTemperature",
+    "IntegerKeyword",
+    "Keyword",
+    "Profile",
+    "ReactorModel",
+    "RealKeyword",
+    "StringKeyword",
+]

@@ -1,0 +1,534 @@
+"""0-D batch (closed homogeneous) reactor models.
+
+TPU-native re-implementation of the reference's batch-reactor family
+(reference: src/ansys/chemkin/batchreactors/batchreactor.py): the
+``BatchReactors`` base plus the four concrete problem types
+
+- ``GivenPressureBatchReactor_FixedTemperature``   (CONP + TGIV, :1649)
+- ``GivenPressureBatchReactor_EnergyConservation`` (CONP + ENRG, :1775)
+- ``GivenVolumeBatchReactor_FixedTemperature``     (CONV + TGIV, :2070)
+- ``GivenVolumeBatchReactor_EnergyConservation``   (CONV + ENRG, :2196)
+
+Where the reference's ``run()`` marshals keywords into the native library
+and blocks in ``KINAll0D_Calculate`` (batchreactor.py:1161, 1149-1158),
+here ``run()`` assembles a pure solve with
+:func:`pychemkin_tpu.ops.reactors.solve_batch` — jitted, and reusable
+under ``vmap``/``shard_map`` for parameter sweeps via
+:meth:`BatchReactors.run_sweep`.
+
+Units CGS; ignition delay is returned in MILLISECONDS, matching the
+reference's sec -> msec conversion (batchreactor.py:613).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..logger import logger
+from ..mixture import Mixture
+from ..ops import reactors as reactor_ops
+from .reactormodel import (
+    STATUS_FAILED,
+    STATUS_NOT_RUN,
+    STATUS_SUCCESS,
+    ReactorModel,
+)
+
+#: default solver tolerances (reference: batchreactor.py:91-92)
+DEFAULT_ATOL = 1.0e-12
+DEFAULT_RTOL = 1.0e-6
+
+
+class BatchReactors(ReactorModel):
+    """Base 0-D transient closed-reactor model
+    (reference: batchreactor.py:52)."""
+
+    #: problem/energy types, set by subclasses
+    problem_type = "CONP"
+    energy_type = "ENRG"
+
+    def __init__(self, reactor_condition: Mixture, label: str):
+        super().__init__(reactor_condition, label)
+        self._atol = DEFAULT_ATOL
+        self._rtol = DEFAULT_RTOL
+        self._time = 0.0
+        self._timeset = False
+        self._volume = reactor_condition.volume
+        self._area = 0.0
+        self._qloss = 0.0
+        self._htc = 0.0
+        self._tamb = 298.15
+        self._htarea = 0.0
+        self._force_nonneg = False
+        self._save_dt: Optional[float] = None
+        self._ignition_mode = reactor_ops.IGN_T_INFLECTION
+        self._ignition_kwargs: Dict = {}
+        self._stop_after_ignition = False
+        self._ignition_delay_ms = np.nan
+        self._solution = None
+        self._max_steps = 100_000
+
+    # --- geometry (reference: batchreactor.py:110-176) ---------------------
+    @property
+    def volume(self) -> float:
+        """Reactor volume [cm^3] (reference: batchreactor.py:110)."""
+        return self._volume
+
+    @volume.setter
+    def volume(self, value: float):
+        if value <= 0.0:
+            raise ValueError("volume must be positive")
+        self._volume = float(value)
+
+    @property
+    def area(self) -> float:
+        """Internal surface area [cm^2] (reference:
+        batchreactor.py:142)."""
+        return self._area
+
+    @area.setter
+    def area(self, value: float = 0.0):
+        if value < 0.0:
+            raise ValueError("area must be non-negative")
+        self._area = float(value)
+
+    # --- solver controls (reference: batchreactor.py:177-372) --------------
+    @property
+    def tolerances(self) -> Tuple[float, float]:
+        """(atol, rtol), defaults (1e-12, 1e-6)
+        (reference: batchreactor.py:177-215)."""
+        return self._atol, self._rtol
+
+    @tolerances.setter
+    def tolerances(self, tolerances: Tuple[float, float]):
+        atol, rtol = tolerances
+        if atol <= 0.0 or rtol <= 0.0:
+            raise ValueError("tolerances must be positive")
+        self._atol = float(atol)
+        self._rtol = float(rtol)
+        self.setkeyword("ATOL", float(atol))
+        self.setkeyword("RTOL", float(rtol))
+
+    @property
+    def force_nonnegative(self) -> bool:
+        """(reference: batchreactor.py:216; the SDIRK integrator keeps
+        fractions near-nonnegative by construction — the flag is accepted
+        and recorded)."""
+        return self._force_nonneg
+
+    @force_nonnegative.setter
+    def force_nonnegative(self, mode: bool = False):
+        self._force_nonneg = bool(mode)
+        self.setkeyword("NNEG", bool(mode))
+
+    def set_solver_initial_timestep_size(self, size: float):
+        """(reference: batchreactor.py:247)."""
+        self.setkeyword("ISTP", float(size))
+
+    def set_solver_max_timestep_size(self, size: float):
+        """(reference: batchreactor.py:263)."""
+        self.setkeyword("MAXDT", float(size))
+
+    @property
+    def timestep_for_saving_solution(self) -> Optional[float]:
+        """Output-grid spacing [s] (reference: batchreactor.py:279);
+        defaults to end_time/100 when unset."""
+        return self._save_dt
+
+    @timestep_for_saving_solution.setter
+    def timestep_for_saving_solution(self, delta_time: float):
+        if delta_time <= 0.0:
+            raise ValueError("saving timestep must be positive")
+        self._save_dt = float(delta_time)
+        self.setkeyword("DELT", float(delta_time))
+
+    @property
+    def timestep_for_printing_solution(self) -> Optional[float]:
+        return self.getkeyword("DTSV")
+
+    @timestep_for_printing_solution.setter
+    def timestep_for_printing_solution(self, delta_time: float):
+        self.setkeyword("DTSV", float(delta_time))
+
+    def adaptive_solution_saving(self, mode: bool = True,
+                                 delta_temperature: float = 10.0,
+                                 delta_species: float = 0.05):
+        """The reference's event-driven save refinement
+        (batchreactor.py:373, ADAP/DTMN/DXMN keywords). The TPU build
+        integrates with in-step event accumulators instead of dense
+        output, so ignition timing does not depend on the save grid; the
+        keywords are recorded for deck parity."""
+        self.setkeyword("ADAP", bool(mode))
+        self.setkeyword("DTMN", float(delta_temperature))
+        self.setkeyword("DXMN", float(delta_species))
+
+    # --- ignition delay (reference: batchreactor.py:462-643) ---------------
+    def set_ignition_delay(self, method: str = "T_inflection",
+                           val: float = 0.0, target: str = ""):
+        """Choose the ignition-delay definition (reference:
+        batchreactor.py:462): 'T_inflection' (TIFP, max dT/dt),
+        'T_rise' (DTIGN, rise of ``val`` K over the initial T),
+        'T_ignition' (TLIM, absolute T of ``val`` K),
+        'Species_peak' (KLIM, peak of species ``target``)."""
+        if method == "T_inflection":
+            self._ignition_mode = reactor_ops.IGN_T_INFLECTION
+            self._ignition_kwargs = {}
+            self.setkeyword("TIFP", True)
+        elif method == "T_rise":
+            if val <= 0.0:
+                raise ValueError("temperature rise value must be > 0")
+            self._ignition_mode = reactor_ops.IGN_T_RISE
+            self._ignition_kwargs = {"delta_T": float(val)}
+            self.setkeyword("DTIGN", float(val))
+        elif method == "T_ignition":
+            if val <= 0.0:
+                raise ValueError("ignition temperature must be > 0")
+            self._ignition_mode = reactor_ops.IGN_T_IGNITION
+            self._ignition_kwargs = {"T_limit": float(val)}
+            self.setkeyword("TLIM", float(val))
+        elif method == "Species_peak":
+            if target not in self._specieslist:
+                raise ValueError(
+                    "target species is assigned as a string, e.g. 'OH'")
+            self._ignition_mode = reactor_ops.IGN_SPECIES_PEAK
+            self._ignition_kwargs = {
+                "species_index": self._specieslist.index(target)}
+            self.setkeyword("KLIM", target)
+        else:
+            raise ValueError(f"ignition definition {method!r} is not "
+                             "recognized")
+
+    def stop_after_ignition(self):
+        """(reference: batchreactor.py:538, ISTOP keyword). Recorded; the
+        batched integrator always runs to end time so that one compiled
+        program serves every sweep element."""
+        self._stop_after_ignition = True
+        self.setkeyword("ISTOP", True)
+
+    def get_ignition_delay(self) -> float:
+        """Ignition delay in MILLISECONDS (reference:
+        batchreactor.py:545-643, sec->msec at :613); nan if not detected."""
+        if self.runstatus == STATUS_NOT_RUN:
+            logger.warning("reactor has not been run")
+            return np.nan
+        if not np.isfinite(self._ignition_delay_ms):
+            logger.warning("no ignition detected "
+                           "(reference: batchreactor.py:583-609)")
+        return self._ignition_delay_ms
+
+    # --- profiles (reference: batchreactor.py:644-733, 2005-2069) ----------
+    def set_volume_profile(self, time, volume):
+        """VPRO (reference: batchreactor.py:644)."""
+        self.setprofile("VPRO", time, volume)
+
+    def set_pressure_profile(self, time, pressure):
+        """PPRO (reference: batchreactor.py:679)."""
+        self.setprofile("PPRO", time, pressure)
+
+    def set_surfacearea_profile(self, time, area):
+        """AINT — internal surface area for surface chemistry (reference:
+        batchreactor.py:714). Recorded for deck parity only: surface
+        mechanisms are unsupported in this build, so the profile has no
+        effect on the gas-phase solve."""
+        self.setprofile("AINT", time, area)
+
+    def set_temperature_profile(self, time, temperature):
+        """TPRO (reference: batchreactor.py:1753). Only honored by the
+        fixed-temperature (TGIV) variants."""
+        self.setprofile("TPRO", time, temperature)
+
+    def set_heat_loss_profile(self, time, qloss):
+        """QPRO (reference: batchreactor.py:2037)."""
+        self.setprofile("QPRO", time, qloss)
+
+    def set_heat_transfer_area_profile(self, time, area):
+        """Heat-transfer-area A(t) profile, honored by the Q = HTC * A(t) *
+        (Tamb - T) wall term (reference: batchreactor.py:2005)."""
+        self.setprofile("AREA", time, area)
+
+    # --- end time ----------------------------------------------------------
+    @property
+    def time(self) -> float:
+        """Simulation end time [s] (reference: batchreactor.py:1722)."""
+        return self._time
+
+    @time.setter
+    def time(self, value: float = 0.0):
+        if value <= 0.0:
+            raise ValueError("end time must be positive")
+        self._time = float(value)
+        self._timeset = True
+        self._record_keyword("TIME", float(value))
+
+    def validate_inputs(self) -> int:
+        """(reference: batchreactor.py:794): end time is required."""
+        if not self._timeset:
+            logger.error("simulation end time is required (TIME)")
+            return 1
+        return 0
+
+    # --- solve assembly ----------------------------------------------------
+    def _profile_or_none(self, key: str):
+        prof = self.getprofile(key)
+        if prof is None:
+            return None
+        # device arrays: the profile is indexed with traced values inside
+        # the jitted integrator
+        return reactor_ops.Profile(x=jnp.asarray(prof.pos),
+                                   y=jnp.asarray(prof.value))
+
+    def _build_solve_kwargs(self, n_out: int) -> Dict:
+        mech = self._effective_mech()
+        constraint = None
+        if self.problem_type == "CONP":
+            constraint = self._profile_or_none("PPRO")
+        else:
+            constraint = self._profile_or_none("VPRO")
+        tprof = self._profile_or_none("TPRO")
+        qprof = self._profile_or_none("QPRO")
+        if qprof is None and self._qloss != 0.0:
+            qprof = reactor_ops.constant_profile(self._qloss)
+        return dict(
+            mech=mech,
+            problem=self.problem_type,
+            energy=self.energy_type,
+            n_out=n_out,
+            rtol=self._rtol,
+            atol=self._atol,
+            constraint_profile=constraint,
+            t_profile=tprof,
+            qloss_profile=qprof,
+            area_profile=self._profile_or_none("AREA"),
+            volume=self._volume,
+            htc=self._htc,
+            tamb=self._tamb,
+            area=self._htarea,
+            ignition_mode=self._ignition_mode,
+            ignition_kwargs=self._ignition_kwargs,
+            max_steps_per_segment=self._max_steps,
+        )
+
+    def run(self) -> int:
+        """Integrate the reactor (reference: batchreactor.py:1161 runs the
+        whole problem in one blocking native call; here one jitted
+        solve)."""
+        if self.validate_inputs() != 0:
+            self.runstatus = STATUS_FAILED
+            return self.runstatus
+        cond = self._condition
+        # a re-run invalidates any previously processed solution
+        self._numbsolutionpoints = 0
+        self._solution_rawarray = {}
+        self._solution_mixturearray = []
+        n_out = 101
+        if self._save_dt is not None:
+            n_out = max(int(round(self._time / self._save_dt)) + 1, 2)
+        kwargs = self._build_solve_kwargs(n_out)
+        sol = reactor_ops.solve_batch(
+            T0=cond.temperature, P0=cond.pressure, Y0=cond.Y,
+            t_end=self._time, **kwargs)
+        self._solution = jax.device_get(sol)
+        ign_s = float(self._solution.ignition_time)
+        self._ignition_delay_ms = ign_s * 1.0e3
+        ok = bool(self._solution.success)
+        self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        if not ok:
+            logger.error("batch-reactor integration failed (stalled or "
+                         "step budget exhausted)")
+        return self.runstatus
+
+    def run_sweep(self, T0s=None, P0s=None, Y0s=None, t_ends=None):
+        """Batched ignition-delay sweep over initial conditions — the TPU
+        replacement for the reference's serial Python loops (SURVEY.md
+        §2.3; tests/integration_tests/ignitiondelay.py:127-144). Any
+        argument left None takes this reactor's configured value; the
+        reactor's profiles, heat-transfer settings, and tolerances apply
+        to every sweep element exactly as in :meth:`run`.
+
+        Returns (ignition_delays_ms [B], success [B])."""
+        cond = self._condition
+        if T0s is None:
+            T0s = np.asarray([cond.temperature])
+        if P0s is None:
+            P0s = cond.pressure
+        if Y0s is None:
+            Y0s = cond.Y
+        if t_ends is None:
+            if not self._timeset:
+                raise ValueError("end time required (set .time)")
+            t_ends = self._time
+
+        sizes = [np.asarray(a).shape[0] for a in (T0s, P0s, t_ends)
+                 if np.asarray(a).ndim > 0]
+        if np.asarray(Y0s).ndim > 1:
+            sizes.append(np.asarray(Y0s).shape[0])
+        B = max(sizes) if sizes else 1
+        T0s = jnp.broadcast_to(jnp.asarray(T0s, jnp.float64), (B,))
+        P0s = jnp.broadcast_to(jnp.asarray(P0s, jnp.float64), (B,))
+        KK = np.asarray(Y0s).shape[-1]
+        Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64), (B, KK))
+        t_ends = jnp.broadcast_to(jnp.asarray(t_ends, jnp.float64), (B,))
+
+        kwargs = self._build_solve_kwargs(n_out=2)
+
+        def one(T0, P0, Y0, t_end):
+            sol = reactor_ops.solve_batch(T0=T0, P0=P0, Y0=Y0, t_end=t_end,
+                                          **kwargs)
+            return sol.ignition_time, sol.success
+
+        times, ok = jax.vmap(one)(T0s, P0s, Y0s, t_ends)
+        return np.asarray(times) * 1.0e3, np.asarray(ok)
+
+    # --- solution retrieval (reference: batchreactor.py:1263-1648) ---------
+    def get_solution_size(self) -> Tuple[int, int]:
+        """(n_reactors, n_solution_points)
+        (reference: batchreactor.py:1263)."""
+        if self._solution is None:
+            return 1, 0
+        return 1, len(self._solution.times)
+
+    def process_solution(self):
+        """Unpack the solve result into the raw-array store
+        (reference: batchreactor.py:1335 copies the arrays out of the
+        native library; here they are already arrays)."""
+        if self._solution is None:
+            raise RuntimeError("run() the reactor first")
+        sol = self._solution
+        self._numbsolutionpoints = len(sol.times)
+        raw = {
+            "time": np.asarray(sol.times),
+            "temperature": np.asarray(sol.T),
+            "pressure": np.asarray(sol.P),
+            "volume": np.asarray(sol.volume),
+        }
+        Y = np.asarray(sol.Y)
+        for k, name in enumerate(self._specieslist):
+            raw[name] = Y[:, k]
+        self._solution_rawarray = raw
+        self._solution_Y = Y
+        self._solution_mixturearray = []
+        return 0
+
+    def create_solution_mixtures(self) -> int:
+        """Materialize a Mixture per solution point
+        (reference: batchreactor.py:1487)."""
+        if not self.getrawsolutionstatus():
+            self.process_solution()
+        self._solution_mixturearray = []
+        raw = self._solution_rawarray
+        for i in range(self._numbsolutionpoints):
+            mix = Mixture(self.chemistry)
+            mix.temperature = float(raw["temperature"][i])
+            mix.pressure = float(raw["pressure"][i])
+            mix.Y = self._solution_Y[i]
+            mix.volume = float(raw["volume"][i])
+            self._solution_mixturearray.append(mix)
+        return 0
+
+    def get_solution_mixture(self, time: float) -> Mixture:
+        """Mixture at the solution point closest to ``time``
+        (reference: batchreactor.py:1550)."""
+        if not self._solution_mixturearray:
+            self.create_solution_mixtures()
+        idx = int(np.argmin(np.abs(self._solution_rawarray["time"] - time)))
+        return self._solution_mixturearray[idx]
+
+    def get_solution_mixture_at_index(self, solution_index: int) -> Mixture:
+        """(reference: batchreactor.py:1599)."""
+        if not self._solution_mixturearray:
+            self.create_solution_mixtures()
+        return self._solution_mixturearray[solution_index]
+
+
+class GivenPressureBatchReactor_FixedTemperature(BatchReactors):
+    """CONP + TGIV (reference: batchreactor.py:1649)."""
+
+    problem_type = "CONP"
+    energy_type = "TGIV"
+
+    def __init__(self, reactor_condition: Mixture, label: str = "CONPT"):
+        super().__init__(reactor_condition, label)
+
+
+class GivenPressureBatchReactor_EnergyConservation(BatchReactors):
+    """CONP + ENRG (reference: batchreactor.py:1775) — the north-star
+    configuration of the rebuild (SURVEY.md §3.3)."""
+
+    problem_type = "CONP"
+    energy_type = "ENRG"
+
+    def __init__(self, reactor_condition: Mixture, label: str = "CONP"):
+        super().__init__(reactor_condition, label)
+
+    # heat-transfer options (reference: batchreactor.py:1883-2004)
+    @property
+    def heat_loss_rate(self) -> float:
+        """QLOS [erg/s] (positive = loss)."""
+        return self._qloss
+
+    @heat_loss_rate.setter
+    def heat_loss_rate(self, value: float):
+        self._qloss = float(value)
+        self._record_keyword("QLOS", float(value))
+
+    @property
+    def heat_transfer_coefficient(self) -> float:
+        """HTC [erg/(cm^2 K s)]."""
+        return self._htc
+
+    @heat_transfer_coefficient.setter
+    def heat_transfer_coefficient(self, value: float = 0.0):
+        if value < 0.0:
+            raise ValueError("heat transfer coefficient must be >= 0")
+        self._htc = float(value)
+        self._record_keyword("HTC", float(value))
+
+    @property
+    def ambient_temperature(self) -> float:
+        """TAMB [K]."""
+        return self._tamb
+
+    @ambient_temperature.setter
+    def ambient_temperature(self, value: float = 0.0):
+        if value <= 0.0:
+            raise ValueError("ambient temperature must be positive")
+        self._tamb = float(value)
+        self._record_keyword("TAMB", float(value))
+
+    @property
+    def heat_transfer_area(self) -> float:
+        """AREAQ [cm^2]."""
+        return self._htarea
+
+    @heat_transfer_area.setter
+    def heat_transfer_area(self, value: float = 0.0):
+        if value < 0.0:
+            raise ValueError("heat transfer area must be >= 0")
+        self._htarea = float(value)
+        self._record_keyword("AREAQ", float(value))
+
+
+class GivenVolumeBatchReactor_FixedTemperature(BatchReactors):
+    """CONV + TGIV (reference: batchreactor.py:2070)."""
+
+    problem_type = "CONV"
+    energy_type = "TGIV"
+
+    def __init__(self, reactor_condition: Mixture, label: str = "CONVT"):
+        super().__init__(reactor_condition, label)
+
+
+class GivenVolumeBatchReactor_EnergyConservation(
+        GivenPressureBatchReactor_EnergyConservation):
+    """CONV + ENRG (reference: batchreactor.py:2196). Inherits the
+    heat-transfer surface of the ENRG family."""
+
+    problem_type = "CONV"
+    energy_type = "ENRG"
+
+    def __init__(self, reactor_condition: Mixture, label: str = "CONV"):
+        BatchReactors.__init__(self, reactor_condition, label)

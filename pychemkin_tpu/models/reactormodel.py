@@ -1,0 +1,443 @@
+"""Reactor-model framework: Keyword / Profile / ReactorModel base classes.
+
+TPU-native re-implementation of the reference's configuration backbone
+(reference: src/ansys/chemkin/reactormodel.py). The reference assembles
+keyword text lines and marshals them into the native solver
+(``KINAll0D_SetUserKeyword``, reactormodel.py:966-1292); here keywords are
+a typed, introspectable dict that the reactor models read directly when
+they build the (pure, jittable) solve calls in
+:mod:`pychemkin_tpu.ops`. The keyword names, defaults, and the
+keyword-line rendering are preserved so decks written for the reference
+read the same.
+
+Run-status convention preserved (reference: reactormodel.py:769-773):
+-100 = not yet run, 0 = success, other = failed — but a failed batched
+solve reports per-element status instead of aborting (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..logger import logger
+from ..mixture import Mixture
+
+KeywordValue = Union[bool, int, float, str]
+
+#: run-status codes (reference: reactormodel.py:769-773)
+STATUS_NOT_RUN = -100
+STATUS_SUCCESS = 0
+STATUS_FAILED = 1
+
+
+class Keyword:
+    """One typed solver keyword (reference: reactormodel.py:50-377).
+
+    ``protected`` keywords are managed by property setters / dedicated
+    methods and rejected by the generic ``setkeyword`` in API mode
+    (reference: reactormodel.py:60-93)."""
+
+    #: keywords only settable through dedicated APIs
+    PROTECTED = (
+        "TIME", "PRES", "TEMP", "VOL", "QLOS", "HTC", "TAMB", "AREAQ",
+        "TAU", "FLRT", "XEND",
+    )
+    #: profile-carrying keywords (reference: reactormodel.py:94-110)
+    PROFILE_KEYS = ("TPRO", "PPRO", "VPRO", "QPRO", "AINT", "AREA", "DPRO",
+                    "GRID", "MBPRO")
+
+    def __init__(self, phrase: str, value: KeywordValue,
+                 protected: bool = False):
+        self._phrase = str(phrase).upper()
+        self._value = value
+        self._type = type(value)
+        self._protected = protected
+
+    def resetvalue(self, value: KeywordValue):
+        """(reference: reactormodel.py:258)."""
+        if not isinstance(value, self._type) and not (
+                self._type is float and isinstance(value, int)):
+            raise TypeError(
+                f"keyword {self._phrase} expects {self._type.__name__}")
+        self._value = self._type(value)
+
+    @property
+    def parametertype(self) -> type:
+        return self._type
+
+    @property
+    def value(self) -> KeywordValue:
+        return self._value
+
+    @property
+    def keyphrase(self) -> str:
+        return self._phrase
+
+    @property
+    def protected(self) -> bool:
+        return self._protected
+
+    def getvalue_as_string(self) -> Tuple[int, str]:
+        """Render the keyword input line (reference:
+        reactormodel.py:349-377). Booleans render as the bare keyword
+        (present = on); other types as 'KEY value'."""
+        if self._type is bool:
+            return (0, self._phrase) if self._value else (1, "")
+        return 0, f"{self._phrase} {self._value}"
+
+    def show(self):
+        print(self.getvalue_as_string()[1])
+
+
+class BooleanKeyword(Keyword):
+    """(reference: reactormodel.py:378)."""
+
+    def __init__(self, phrase: str, value: bool = True):
+        super().__init__(phrase, bool(value))
+
+
+class IntegerKeyword(Keyword):
+    """(reference: reactormodel.py:399)."""
+
+    def __init__(self, phrase: str, value: int = 0):
+        super().__init__(phrase, int(value))
+
+
+class RealKeyword(Keyword):
+    """(reference: reactormodel.py:421)."""
+
+    def __init__(self, phrase: str, value: float = 0.0):
+        super().__init__(phrase, float(value))
+
+
+class StringKeyword(Keyword):
+    """(reference: reactormodel.py:443)."""
+
+    def __init__(self, phrase: str, value: str = ""):
+        super().__init__(phrase, str(value))
+
+
+class Profile:
+    """Piecewise-linear (x, y) profile keyword
+    (reference: reactormodel.py:467-671)."""
+
+    def __init__(self, key: str, x, y):
+        x = np.asarray(x, dtype=np.double)
+        y = np.asarray(y, dtype=np.double)
+        if x.ndim != 1 or x.shape != y.shape:
+            raise ValueError("profile x and y must be equal-length 1-D")
+        if len(x) < 2:
+            raise ValueError("profile needs at least two points")
+        if np.any(np.diff(x) <= 0.0):
+            raise ValueError("profile x values must be strictly increasing")
+        self._key = str(key).upper()
+        self._x = x
+        self._y = y
+
+    @property
+    def size(self) -> int:
+        return len(self._x)
+
+    @property
+    def pos(self) -> np.ndarray:
+        return self._x
+
+    @property
+    def value(self) -> np.ndarray:
+        return self._y
+
+    @property
+    def profilekey(self) -> str:
+        return self._key
+
+    def resetprofile(self, x, y):
+        """(reference: reactormodel.py:602)."""
+        self.__init__(self._key, x, y)
+
+    def getprofile_as_string_list(self) -> Tuple[int, List[str]]:
+        """Render as 'KEY x y' input lines (reference:
+        reactormodel.py:632)."""
+        return 0, [f"{self._key} {x} {y}" for x, y in zip(self._x, self._y)]
+
+    def show(self):
+        for line in self.getprofile_as_string_list()[1]:
+            print(line)
+
+
+class ReactorModel:
+    """Base class of every reactor model (reference:
+    reactormodel.py:672).
+
+    Holds a deep copy of the reactor-condition mixture/stream (the
+    reference deep-copies too, reactormodel.py:690), the keyword and
+    profile dicts, the rate multiplier, analysis toggles, and run status.
+    """
+
+    def __init__(self, reactor_condition: Mixture, label: str):
+        if not isinstance(reactor_condition, Mixture):
+            raise TypeError("reactor condition must be a Mixture or Stream "
+                            "(reference: reactormodel.py:682)")
+        err = reactor_condition.validate()
+        if err != 0:
+            raise ValueError(
+                f"reactor-condition mixture is incomplete (code {err})")
+        self._condition = copy.deepcopy(reactor_condition)
+        self.label = label
+        self._keywords: Dict[str, Keyword] = {}
+        self._profiles: Dict[str, Profile] = {}
+        self._gasratemultiplier = 1.0
+        self._TextOut = False
+        self._XMLOut = False
+        self.runstatus = STATUS_NOT_RUN
+        self._speciesmode = "mass"
+        # sensitivity / ROP analysis configuration
+        # (reference: reactormodel.py:1522-1621)
+        self._sensitivity = False
+        self._sensitivity_opts: Dict[str, float] = {}
+        self._rop_analysis = False
+        self._rop_threshold = 0.0
+        # raw solution store (reference: reactormodel.py:775-788)
+        self._solution_tags = ["time", "distance", "temperature", "pressure",
+                               "volume", "velocity", "flowrate"]
+        self._numbsolutionpoints = 0
+        self._solution_rawarray: Dict[str, np.ndarray] = {}
+        self._solution_mixturearray: List[Mixture] = []
+
+    # --- chemistry plumbing -------------------------------------------------
+    @property
+    def chemID(self) -> int:
+        return self._condition.chemID
+
+    @property
+    def chemistry(self):
+        return self._condition.chemistry
+
+    @property
+    def mech(self):
+        return self._condition.mech
+
+    @property
+    def numbspecies(self) -> int:
+        return self._condition.KK
+
+    @property
+    def _specieslist(self) -> list:
+        return self._condition.species_symbols
+
+    @property
+    def reactor_condition(self) -> Mixture:
+        return self._condition
+
+    # --- state passthroughs (reference: reactormodel.py:1293-1423) ---------
+    @property
+    def temperature(self) -> float:
+        return self._condition.temperature
+
+    @temperature.setter
+    def temperature(self, t: float):
+        self._condition.temperature = t
+
+    @property
+    def pressure(self) -> float:
+        return self._condition.pressure
+
+    @pressure.setter
+    def pressure(self, p: float):
+        self._condition.pressure = p
+
+    @property
+    def X(self) -> np.ndarray:
+        return self._condition.X
+
+    @X.setter
+    def X(self, recipe):
+        self._condition.X = recipe
+
+    @property
+    def Y(self) -> np.ndarray:
+        return self._condition.Y
+
+    @Y.setter
+    def Y(self, recipe):
+        self._condition.Y = recipe
+
+    # --- keyword management (reference: reactormodel.py:835-1056) ----------
+    def setkeyword(self, key: str, value: KeywordValue):
+        """Set or update a keyword (reference: reactormodel.py:861).
+        Protected keywords (TIME, PRES, QLOS, ...) must be set through
+        their dedicated property setters, matching the reference's API
+        mode (reference: reactormodel.py:60-93)."""
+        phrase = str(key).upper()
+        if phrase in Keyword.PROTECTED:
+            raise ValueError(
+                f"keyword {phrase} is protected; use its dedicated "
+                "property/method (reference: reactormodel.py:60-93)")
+        self._record_keyword(phrase, value)
+
+    def _record_keyword(self, key: str, value: KeywordValue):
+        """Store a keyword without the protected-list check — the path the
+        dedicated property setters use."""
+        phrase = str(key).upper()
+        if phrase in self._keywords:
+            self._keywords[phrase].resetvalue(value)
+            return
+        if isinstance(value, bool):
+            kw: Keyword = BooleanKeyword(phrase, value)
+        elif isinstance(value, int):
+            kw = IntegerKeyword(phrase, value)
+        elif isinstance(value, float):
+            kw = RealKeyword(phrase, value)
+        else:
+            kw = StringKeyword(phrase, str(value))
+        self._keywords[phrase] = kw
+
+    def getkeyword(self, key: str) -> Optional[KeywordValue]:
+        """Value of a set keyword, else None."""
+        kw = self._keywords.get(str(key).upper())
+        return None if kw is None else kw.value
+
+    def removekeyword(self, key: str):
+        """(reference: reactormodel.py:916)."""
+        self._keywords.pop(str(key).upper(), None)
+
+    def createkeywordinputlines(self) -> Tuple[int, List[str]]:
+        """Render all keywords as deck lines (reference:
+        reactormodel.py:966); profiles render after scalars."""
+        lines = []
+        for kw in self._keywords.values():
+            err, line = kw.getvalue_as_string()
+            if err == 0 and line:
+                lines.append(line)
+        for prof in self._profiles.values():
+            lines.extend(prof.getprofile_as_string_list()[1])
+        return 0, lines
+
+    def showkeywordinputlines(self):
+        for line in self.createkeywordinputlines()[1]:
+            print(line)
+
+    # --- profiles (reference: reactormodel.py:1057-1187) -------------------
+    def setprofile(self, key: str, x, y):
+        """Attach or replace a piecewise-linear profile
+        (reference: reactormodel.py:1083)."""
+        phrase = str(key).upper()
+        if phrase in self._profiles:
+            self._profiles[phrase].resetprofile(x, y)
+        else:
+            self._profiles[phrase] = Profile(phrase, x, y)
+
+    def getprofile(self, key: str) -> Optional[Profile]:
+        return self._profiles.get(str(key).upper())
+
+    def removeprofile(self, key: str):
+        self._profiles.pop(str(key).upper(), None)
+
+    # --- rate multiplier (reference: reactormodel.py:1440) -----------------
+    @property
+    def gasratemultiplier(self) -> float:
+        return self._gasratemultiplier
+
+    @gasratemultiplier.setter
+    def gasratemultiplier(self, value: float):
+        if value < 0.0:
+            raise ValueError("reaction rate multiplier must be >= 0")
+        self._gasratemultiplier = float(value)
+        self.setkeyword("GFAC", float(value))
+
+    def _effective_mech(self):
+        """Mechanism with the gas rate multiplier folded in."""
+        mech = self.mech
+        if self._gasratemultiplier != 1.0:
+            mech = mech.with_rate_multipliers(self._gasratemultiplier)
+        return mech
+
+    # --- output toggles (reference: reactormodel.py:1471-1521) -------------
+    @property
+    def STD_Output(self) -> bool:
+        return self._TextOut
+
+    @STD_Output.setter
+    def STD_Output(self, mode: bool):
+        self._TextOut = bool(mode)
+        self.setkeyword("NO_SDOUTPUT_WRITE", not mode)
+
+    @property
+    def XML_Output(self) -> bool:
+        return self._XMLOut
+
+    @XML_Output.setter
+    def XML_Output(self, mode: bool):
+        self._XMLOut = bool(mode)
+        self.setkeyword("NO_XMLOUTPUT_WRITE", not mode)
+
+    # --- analyses (reference: reactormodel.py:1522-1621) -------------------
+    def setsensitivityanalysis(self, mode: bool = True,
+                               absolute_tolerance: Optional[float] = None,
+                               relative_tolerance: Optional[float] = None,
+                               temperature_threshold: Optional[float] = None,
+                               species_threshold: Optional[float] = None):
+        """Enable A-factor sensitivity analysis (reference:
+        reactormodel.py:1522, keywords ASEN/ATLS/RTLS/EPST/EPSS). The
+        TPU build computes sensitivities by forward-mode AD at run time."""
+        self._sensitivity = bool(mode)
+        self.setkeyword("ASEN", bool(mode))
+        if absolute_tolerance is not None:
+            self._sensitivity_opts["atol"] = float(absolute_tolerance)
+            self.setkeyword("ATLS", float(absolute_tolerance))
+        if relative_tolerance is not None:
+            self._sensitivity_opts["rtol"] = float(relative_tolerance)
+            self.setkeyword("RTLS", float(relative_tolerance))
+        if temperature_threshold is not None:
+            self._sensitivity_opts["temp_threshold"] = float(
+                temperature_threshold)
+            self.setkeyword("EPST", float(temperature_threshold))
+        if species_threshold is not None:
+            self._sensitivity_opts["spec_threshold"] = float(
+                species_threshold)
+            self.setkeyword("EPSS", float(species_threshold))
+
+    def setROPanalysis(self, mode: bool = True,
+                       threshold: Optional[float] = None):
+        """Enable rate-of-production analysis (reference:
+        reactormodel.py:1585, keywords AROP/EPSR)."""
+        self._rop_analysis = bool(mode)
+        self.setkeyword("AROP", bool(mode))
+        if threshold is not None:
+            self._rop_threshold = float(threshold)
+            self.setkeyword("EPSR", float(threshold))
+
+    # --- run status (reference: reactormodel.py:1720-1764) -----------------
+    def getrunstatus(self) -> int:
+        return self.runstatus
+
+    def checkrunstatus(self) -> bool:
+        return self.runstatus == STATUS_SUCCESS
+
+    def getrawsolutionstatus(self) -> bool:
+        return self._numbsolutionpoints > 0
+
+    def run(self) -> int:  # pragma: no cover - abstract template
+        """Template method; concrete reactors override
+        (reference: reactormodel.py:1792)."""
+        raise NotImplementedError
+
+    # --- solution plumbing (reference: reactormodel.py:1816-1919) ----------
+    def get_solution_variable_profile(self, varname: str) -> np.ndarray:
+        """Profile of a state variable ('time', 'temperature', ...) or a
+        species symbol (reference: batchreactor.py:1437)."""
+        if not self.getrawsolutionstatus():
+            raise RuntimeError("no solution available; run() and "
+                               "process_solution() first")
+        vname = varname.strip()
+        if vname.lower() in self._solution_tags:
+            return self._solution_rawarray[vname.lower()]
+        if vname in self._specieslist:
+            return self._solution_rawarray[vname]
+        # case-insensitive species fallback
+        for s in self._specieslist:
+            if s.upper() == vname.upper():
+                return self._solution_rawarray[s]
+        raise KeyError(f"unknown solution variable {varname!r}")

@@ -72,15 +72,16 @@ class BatchArgs(NamedTuple):
     constraint: Profile
     tprof: Profile
     qloss: Profile         # heat-loss rate profile, erg/s (QLOS/QPRO)
+    area: Profile          # heat-transfer area profile, cm^2 (AREAQ/AREA)
     mass: Any = 1.0
     htc: Any = 0.0         # erg/(cm^2 K s)
     tamb: Any = 298.15     # K
-    area: Any = 0.0        # cm^2
 
 
 def _heat_rate(args, T, t):
     ql, _ = profile_value_slope(args.qloss, t)
-    return -ql + args.htc * args.area * (args.tamb - T)
+    ar, _ = profile_value_slope(args.area, t)
+    return -ql + args.htc * ar * (args.tamb - T)
 
 
 def _split(y):
@@ -215,9 +216,10 @@ class BatchSolution(NamedTuple):
 def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
                 n_out=101, rtol=1e-6, atol=1e-12,
                 constraint_profile=None, t_profile=None, qloss_profile=None,
-                volume=1.0, htc=0.0, tamb=298.15, area=0.0,
-                ignition_mode=IGN_T_INFLECTION, ignition_kwargs=None,
-                t_start=0.0, max_steps_per_segment=20_000):
+                area_profile=None, volume=1.0, htc=0.0, tamb=298.15,
+                area=0.0, ignition_mode=IGN_T_INFLECTION,
+                ignition_kwargs=None, t_start=0.0,
+                max_steps_per_segment=20_000):
     """Solve one 0-D batch reactor; jit/vmap-safe core of the reference's
     ``BatchReactors.run()`` (batchreactor.py:1161).
 
@@ -240,6 +242,8 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
         t_profile = constant_profile(T0)
     if qloss_profile is None:
         qloss_profile = constant_profile(0.0)
+    if area_profile is None:
+        area_profile = constant_profile(area)
 
     if problem == "CONP":
         # initial density from the profile's own P(t_start), so an explicit
@@ -254,8 +258,8 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
         mass = rho0 * v0
 
     args = BatchArgs(mech=mech, constraint=constraint_profile,
-                     tprof=t_profile, qloss=qloss_profile, mass=mass,
-                     htc=htc, tamb=tamb, area=area)
+                     tprof=t_profile, qloss=qloss_profile,
+                     area=area_profile, mass=mass, htc=htc, tamb=tamb)
 
     events = ignition_events(ignition_mode, T0=T0,
                              **(ignition_kwargs or {}))

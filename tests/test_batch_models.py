@@ -1,0 +1,243 @@
+"""Batch-reactor model-class tests (the reference's L3/L4 layers).
+
+Mirrors the reference's integration-script protocol (SURVEY.md §4): build
+a reactor from a Mixture, set keywords through the property API, run, and
+check solution profiles + ignition delay. Oracles are physical
+consistency and cross-checks against the ops-layer solves."""
+
+import numpy as np
+import pytest
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.constants import P_ATM
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.models import (
+    GivenPressureBatchReactor_EnergyConservation,
+    GivenPressureBatchReactor_FixedTemperature,
+    GivenVolumeBatchReactor_EnergyConservation,
+    GivenVolumeBatchReactor_FixedTemperature,
+    Keyword,
+    Profile,
+    ReactorModel,
+)
+from pychemkin_tpu.models.reactormodel import STATUS_NOT_RUN
+
+
+@pytest.fixture(scope="module")
+def chem():
+    return ck.Chemistry.from_mechanism(load_embedded("h2o2"))
+
+
+def h2_air(chem, T=1100.0, P=P_ATM):
+    mix = ck.Mixture(chem)
+    mix.pressure = P
+    mix.temperature = T
+    mix.X = [("H2", 2.0), ("O2", 1.0), ("N2", 3.76)]
+    return mix
+
+
+class TestKeywordFramework:
+    def test_typed_keywords(self):
+        kw = Keyword("ATOL", 1e-10)
+        assert kw.value == 1e-10
+        kw.resetvalue(1e-9)
+        assert kw.value == 1e-9
+        with pytest.raises(TypeError):
+            kw.resetvalue("not-a-float")
+        assert Keyword("TIFP", True).getvalue_as_string() == (0, "TIFP")
+        assert Keyword("X", False).getvalue_as_string() == (1, "")
+        assert Keyword("DTIGN", 400.0).getvalue_as_string()[1] == \
+            "DTIGN 400.0"
+
+    def test_profile_validation(self):
+        with pytest.raises(ValueError):
+            Profile("TPRO", [0.0, 1.0], [300.0])
+        with pytest.raises(ValueError):
+            Profile("TPRO", [1.0, 0.0], [300.0, 400.0])
+        p = Profile("TPRO", [0.0, 1.0], [300.0, 400.0])
+        _, lines = p.getprofile_as_string_list()
+        assert lines[0] == "TPRO 0.0 300.0"
+
+    def test_reactor_model_keyword_dict(self, chem):
+        r = ReactorModel(h2_air(chem), "test")
+        r.setkeyword("ATOL", 1e-10)
+        assert r.getkeyword("atol") == 1e-10
+        r.setkeyword("ATOL", 1e-9)
+        assert r.getkeyword("ATOL") == 1e-9
+        r.removekeyword("ATOL")
+        assert r.getkeyword("ATOL") is None
+        r.setkeyword("TIFP", True)
+        r.setprofile("TPRO", [0.0, 1.0], [300.0, 400.0])
+        _, lines = r.createkeywordinputlines()
+        assert "TIFP" in lines
+        assert "TPRO 0.0 300.0" in lines
+
+    def test_requires_complete_mixture(self, chem):
+        mix = ck.Mixture(chem)
+        mix.temperature = 300.0   # P, composition missing
+        with pytest.raises(ValueError):
+            ReactorModel(mix, "incomplete")
+
+    def test_condition_deepcopy(self, chem):
+        mix = h2_air(chem)
+        r = ReactorModel(mix, "copy-test")
+        mix.temperature = 2222.0
+        assert r.temperature == 1100.0   # reference deep-copies too
+
+    def test_rate_multiplier_guard(self, chem):
+        r = ReactorModel(h2_air(chem), "gfac")
+        r.gasratemultiplier = 0.5
+        assert r.getkeyword("GFAC") == 0.5
+        with pytest.raises(ValueError):
+            r.gasratemultiplier = -1.0
+
+
+class TestConpEnergyReactor:
+    def test_run_and_solution(self, chem):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        r.time = 0.01
+        assert r.runstatus == STATUS_NOT_RUN
+        assert r.run() == 0
+        tau = r.get_ignition_delay()
+        assert 0.01 < tau < 1.0          # ms, H2/air at 1100 K / 1 atm
+        r.process_solution()
+        T = r.get_solution_variable_profile("temperature")
+        P = r.get_solution_variable_profile("pressure")
+        assert T[-1] > 2600.0            # burnt adiabatic CONP temperature
+        np.testing.assert_allclose(P, P_ATM, rtol=1e-10)  # constant P
+        y_h2o = r.get_solution_variable_profile("H2O")
+        assert y_h2o[-1] > 0.15
+        mix_end = r.get_solution_mixture(0.01)
+        assert abs(mix_end.temperature - T[-1]) < 1e-6
+
+    def test_requires_end_time(self, chem):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        assert r.run() != 0              # TIME missing -> failed status
+
+    def test_heat_loss_cools_reactor(self, chem):
+        hot = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        hot.time = 0.01
+        hot.run()
+        hot.process_solution()
+        cooled = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        cooled.time = 0.01
+        cooled.heat_transfer_coefficient = 5.0e6   # erg/(cm^2 K s)
+        cooled.ambient_temperature = 300.0
+        cooled.heat_transfer_area = 100.0
+        cooled.run()
+        cooled.process_solution()
+        T_hot = hot.get_solution_variable_profile("temperature")[-1]
+        T_cool = cooled.get_solution_variable_profile("temperature")[-1]
+        assert T_cool < T_hot - 50.0
+
+    def test_ignition_modes_agree(self, chem):
+        """T_inflection and T_rise ignition times agree within ~20% for a
+        sharp thermal runaway."""
+        a = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        a.time = 0.01
+        a.set_ignition_delay("T_inflection")
+        a.run()
+        b = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        b.time = 0.01
+        b.set_ignition_delay("T_rise", val=400.0)
+        b.run()
+        ta, tb = a.get_ignition_delay(), b.get_ignition_delay()
+        assert abs(ta - tb) < 0.25 * ta
+
+    def test_sweep_monotone_in_temperature(self, chem):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        r.time = 0.02
+        taus, ok = r.run_sweep(T0s=np.array([1000.0, 1100.0, 1200.0]))
+        assert ok.all()
+        assert np.all(np.diff(taus) < 0.0)   # hotter ignites faster
+
+    def test_sweep_honors_heat_transfer(self, chem):
+        """run_sweep must integrate the same configured problem as run():
+        strong wall cooling delays ignition in the sweep too."""
+        adiabatic = GivenPressureBatchReactor_EnergyConservation(
+            h2_air(chem))
+        adiabatic.time = 0.02
+        tau_a, ok_a = adiabatic.run_sweep(T0s=np.array([1000.0]))
+        cooled = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        cooled.time = 0.02
+        cooled.heat_transfer_coefficient = 2.0e7
+        cooled.ambient_temperature = 300.0
+        cooled.heat_transfer_area = 100.0
+        tau_c, _ = cooled.run_sweep(T0s=np.array([1000.0]))
+        assert ok_a.all()
+        # cooling either delays ignition or suppresses it entirely (nan)
+        assert (not np.isfinite(tau_c[0])) or tau_c[0] > 1.05 * tau_a[0]
+
+    def test_rerun_invalidates_solution_cache(self, chem):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        r.time = 0.005
+        r.run()
+        r.process_solution()
+        t1 = r.get_solution_variable_profile("time")
+        assert abs(t1[-1] - 0.005) < 1e-12
+        r.time = 0.01
+        r.run()
+        mix = r.get_solution_mixture(0.01)   # triggers re-processing
+        t2 = r.get_solution_variable_profile("time")
+        assert abs(t2[-1] - 0.01) < 1e-12
+        assert mix.temperature > 2000.0
+
+    def test_protected_keywords_rejected(self, chem):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        with pytest.raises(ValueError):
+            r.setkeyword("TIME", 0.01)
+        with pytest.raises(ValueError):
+            r.setkeyword("QLOS", 1.0)
+        r.time = 0.01                       # dedicated setter path works
+        assert r.getkeyword("TIME") == 0.01
+
+    def test_deepcopy_shares_mechanism(self, chem):
+        mix = h2_air(chem)
+        r = GivenPressureBatchReactor_EnergyConservation(mix)
+        assert r.reactor_condition is not mix
+        assert r.reactor_condition.chemistry is mix.chemistry
+        assert r.mech is mix.mech
+
+
+class TestOtherVariants:
+    def test_conv_pressure_rises(self, chem):
+        r = GivenVolumeBatchReactor_EnergyConservation(h2_air(chem))
+        r.time = 0.01
+        r.run()
+        r.process_solution()
+        P = r.get_solution_variable_profile("pressure")
+        V = r.get_solution_variable_profile("volume")
+        # P2/P1 = (T2/T1)(n2/n1) ~ (2900/1100)*0.9 ~ 2.4 from a 1100 K start
+        assert P[-1] > 2.0 * P_ATM
+        np.testing.assert_allclose(V, V[0], rtol=1e-10)
+
+    def test_tgiv_follows_temperature_profile(self, chem):
+        r = GivenPressureBatchReactor_FixedTemperature(
+            h2_air(chem, T=900.0))
+        r.time = 0.01
+        r.set_temperature_profile([0.0, 0.01], [900.0, 1400.0])
+        r.run()
+        r.process_solution()
+        T = r.get_solution_variable_profile("temperature")
+        assert abs(T[0] - 900.0) < 1.0
+        assert abs(T[-1] - 1400.0) < 1.0
+
+    def test_conv_tgiv_isothermal_consumes_fuel(self, chem):
+        r = GivenVolumeBatchReactor_FixedTemperature(h2_air(chem, T=1400.0))
+        r.time = 0.005
+        r.run()
+        r.process_solution()
+        h2 = r.get_solution_variable_profile("H2")
+        assert h2[-1] < 0.1 * h2[0]
+
+    def test_pressure_profile_drives_conp(self, chem):
+        r = GivenPressureBatchReactor_EnergyConservation(
+            h2_air(chem, T=800.0))
+        r.time = 0.004
+        # compression: 1 -> 20 atm ramp ignites the cold mixture
+        r.set_pressure_profile([0.0, 0.002, 0.004],
+                               [P_ATM, 20 * P_ATM, 20 * P_ATM])
+        r.run()
+        r.process_solution()
+        P = r.get_solution_variable_profile("pressure")
+        assert abs(P[-1] - 20 * P_ATM) < 1e-6 * P_ATM

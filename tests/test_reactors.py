@@ -67,6 +67,7 @@ def test_conp_matches_scipy(mech):
         constraint=reactors.constant_profile(P0),
         tprof=reactors.constant_profile(T0),
         qloss=reactors.constant_profile(0.0),
+        area=reactors.constant_profile(0.0),
         mass=1.0)
     y0 = np.concatenate([Y0, [T0]])
 
