@@ -1,0 +1,107 @@
+"""Headline benchmark: batched 0-D ignition-delay throughput.
+
+Config #2 of BASELINE.json: a GRI-3.0-sized CH4/air-class ignition-delay
+sweep — here the 53-species / 325-reaction ``grisyn`` fixture (real H2/O2
+subsystem + GRI-shaped synthetic channels; real GRI-3.0 data is not
+redistributable from the reference install) — integrated as ONE compiled
+batched stiff solve on the available chip(s).
+
+Metric: 0-D ignitions/sec/chip (BASELINE.json "metric"). The reference
+publishes no throughput numbers (BASELINE.md); its execution model is one
+blocking licensed-Fortran integration per reactor, single process. The
+``vs_baseline`` denominator is therefore an ESTIMATED single-node
+reference throughput of 2.0 ignitions/sec for a GRI-sized 0-D problem
+(~0.5 s per DASPK-class integration — generous to the reference), so
+vs_baseline = (ignitions/sec/chip) / 2.0 and the north-star 50x target
+corresponds to vs_baseline >= 50.
+
+Prints ONE JSON line on stdout. Environment knobs:
+  BENCH_B        batch width (default 1024 on TPU, 16 on CPU)
+  BENCH_REPEATS  timed repetitions (default 1)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+#: estimated reference (licensed Chemkin, single CPU node) throughput for
+#: a GRI-sized 0-D ignition integration, ignitions/sec
+REFERENCE_IGNITIONS_PER_SEC = 2.0
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from pychemkin_tpu import parallel
+    from pychemkin_tpu.mechanism import load_embedded
+    from pychemkin_tpu.ops import thermo
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    n_chips = len(devices)
+    on_accel = platform not in ("cpu",)
+    B = int(os.environ.get("BENCH_B", 1024 if on_accel else 16))
+    repeats = int(os.environ.get("BENCH_REPEATS", 1))
+    print(f"# bench: platform={platform} chips={n_chips} B={B}",
+          file=sys.stderr)
+
+    mech = load_embedded("grisyn")
+    names = list(mech.species_names)
+    X = np.zeros(len(names))
+    X[names.index("H2")] = 2.0
+    X[names.index("O2")] = 1.0
+    X[names.index("N2")] = 3.76
+    Y0 = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+
+    mesh = parallel.make_mesh()
+    # (T0, P) sweep grid — the reference's ignitiondelay.py protocol
+    # (tests/integration_tests/ignitiondelay.py:119-144) scaled out
+    rng = np.random.default_rng(0)
+    T0s = np.linspace(1000.0, 1400.0, B)
+    P0s = 1.01325e6 * (1.0 + rng.uniform(0.0, 1.0, B))  # 1-2 atm spread
+
+    def sweep(T0s_, P0s_):
+        return parallel.sharded_ignition_sweep(
+            mech, "CONP", "ENRG", T0s_, P0s_, Y0, 0.05, mesh=mesh,
+            rtol=1e-6, atol=1e-12, max_steps_per_segment=20_000)
+
+    # warm-up / compile at FULL batch shape (the jitted program is cached
+    # per shape, so the timed calls below are pure cache hits)
+    t0 = time.time()
+    times, ok = sweep(T0s, P0s)
+    print(f"# compile+warmup: {time.time() - t0:.1f}s", file=sys.stderr)
+
+    wall = []
+    for _ in range(repeats):
+        t0 = time.time()
+        times, ok = sweep(T0s, P0s)
+        wall.append(time.time() - t0)
+    wall_s = min(wall)
+    n_ok = int(np.sum(ok))
+    n_ignited = int(np.sum(np.isfinite(times) & ok))
+    throughput = B / wall_s / n_chips
+
+    print(f"# wall={wall_s:.2f}s ok={n_ok}/{B} ignited={n_ignited} "
+          f"tau_range=[{np.nanmin(times)*1e3:.3f}, "
+          f"{np.nanmax(times)*1e3:.3f}] ms", file=sys.stderr)
+
+    result = {
+        "metric": "0-D ignitions/sec/chip (53-species GRI-sized mech, "
+                  "CONP/ENRG, rtol 1e-6/atol 1e-12)",
+        "value": round(throughput, 3),
+        "unit": "ignitions/sec/chip",
+        "vs_baseline": round(throughput / REFERENCE_IGNITIONS_PER_SEC, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import P_ATM, R_GAS
-from . import thermo
+from . import linalg, thermo
 
 # constraint codes (internal; wrapper maps the reference's EQOption 1-10)
 CON_T = "T"
@@ -187,7 +187,7 @@ def _solve(mech, b, con1, con2, target1, target2, T_init, P_init, X_init,
     W = jnp.maximum(x0, 0.01)
     AtWA = mech.ncf.T @ (W[:, None] * mech.ncf) + 1e-8 * jnp.eye(MM)
     AtWt = mech.ncf.T @ (W * t_k)
-    lam0 = jnp.linalg.solve(AtWA, AtWt)
+    lam0 = linalg.solve(AtWA, AtWt)
     ln_n0 = jnp.log(jnp.maximum(b_tot, _TINY))  # ~ total atom moles; O(1/W)
     z0 = jnp.concatenate([lam0, jnp.stack([ln_n0, lnT0, lnP0])])
 
@@ -199,7 +199,7 @@ def _solve(mech, b, con1, con2, target1, target2, T_init, P_init, X_init,
             J = jax.jacfwd(rfn)(z)
             J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-12 * eye
             r = jnp.where(jnp.isfinite(r), r, 1e3)
-            dz = jnp.linalg.solve(J, -r)
+            dz = linalg.solve(J, -r)
             dz = jnp.where(jnp.isfinite(dz), dz, 0.0)
             # damping: cap potential steps at 8, lnT at 0.3, lnP at 0.5
             mx = jnp.max(jnp.abs(dz))
@@ -401,7 +401,7 @@ def chapman_jouguet(mech, T1, P1, Y1, n_outer=25, n_iter=50):
         r, _aux = resid(z)
         J = jax.jacfwd(lambda zz: resid(zz)[0])(z)
         J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-10 * jnp.eye(2)
-        dz = jnp.linalg.solve(J, -jnp.where(jnp.isfinite(r), r, 1e3))
+        dz = linalg.solve(J, -jnp.where(jnp.isfinite(r), r, 1e3))
         dz = jnp.clip(jnp.where(jnp.isfinite(dz), dz, 0.0), -0.2, 0.2)
         z = z + dz
         z = z.at[0].set(jnp.clip(z[0], jnp.log(500.0), jnp.log(6000.0)))

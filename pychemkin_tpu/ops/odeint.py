@@ -45,6 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import linalg
+
 # ---------------------------------------------------------------------------
 # SDIRK3 (Alexander 1977): gamma is the root of
 #   g^3 - 3 g^2 + (3/2) g - 1/6 = 0  in (1/6, 1/2)  -> L-stable.
@@ -125,7 +127,7 @@ def _norm(x, w):
     return jnp.sqrt(jnp.mean((x / w) ** 2))
 
 
-def _newton_stage(rhs, t_stage, y_base, z0, h, lu, piv, args, weights):
+def _newton_stage(rhs, t_stage, y_base, z0, h, fac, args, weights):
     """Solve the SDIRK stage equation z = h * f(t_stage, y_base + gamma*z)
     by modified Newton with the factored M = I - h*gamma*J.
 
@@ -133,7 +135,7 @@ def _newton_stage(rhs, t_stage, y_base, z0, h, lu, piv, args, weights):
     def body(carry):
         z, _, it, prev_dn, _ = carry
         g = z - h * rhs(t_stage, y_base + _GAMMA * z, args)
-        dz = jax.scipy.linalg.lu_solve((lu, piv), -g)
+        dz = linalg.solve_factored(fac, -g)
         z_new = z + dz
         dn = _norm(dz, weights)
         dn = jnp.where(jnp.isfinite(dn), dn, jnp.inf)
@@ -263,24 +265,24 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
 
         J = jac_fn(s.t, s.y, args)
         M = jnp.eye(n, dtype=dtype) - (h * _GAMMA) * J
-        lu, piv = jax.scipy.linalg.lu_factor(M)
+        fac = linalg.factor(M)
 
         w = ctrl.atol + ctrl.rtol * jnp.abs(s.y)
 
         z0 = h * s.f
-        z1, ok1 = _newton_stage(rhs, s.t + _C[0] * h, s.y, z0, h, lu, piv,
+        z1, ok1 = _newton_stage(rhs, s.t + _C[0] * h, s.y, z0, h, fac,
                                 args, w)
         y_base2 = s.y + _A21 * z1
-        z2, ok2 = _newton_stage(rhs, s.t + _C[1] * h, y_base2, z1, h, lu, piv,
+        z2, ok2 = _newton_stage(rhs, s.t + _C[1] * h, y_base2, z1, h, fac,
                                 args, w)
         y_base3 = s.y + _B1 * z1 + _B2 * z2
-        z3, ok3 = _newton_stage(rhs, s.t + h, y_base3, z2, h, lu, piv,
+        z3, ok3 = _newton_stage(rhs, s.t + h, y_base3, z2, h, fac,
                                 args, w)
         newton_ok = ok1 & ok2 & ok3
 
         y_new = y_base3 + _B3 * z3        # stiffly accurate
         e_raw = _ERR_W[0] * z1 + _ERR_W[1] * z2 + _ERR_W[2] * z3
-        e = jax.scipy.linalg.lu_solve((lu, piv), e_raw)
+        e = linalg.solve_factored(fac, e_raw)
         w_new = ctrl.atol + ctrl.rtol * jnp.maximum(jnp.abs(s.y),
                                                     jnp.abs(y_new))
         err = _norm(e, w_new)

@@ -1,0 +1,19 @@
+"""Device-mesh parallelism: sharded sweeps over TPU slices
+(SURVEY.md §2.3 — the TPU-native replacement for the reference's serial
+Python parameter loops; there is no distributed backend to port)."""
+
+from .sharding import (
+    BATCH_AXIS,
+    distributed_initialize,
+    make_mesh,
+    sharded_ignition_sweep,
+    sharded_sweep_summary,
+)
+
+__all__ = [
+    "BATCH_AXIS",
+    "distributed_initialize",
+    "make_mesh",
+    "sharded_ignition_sweep",
+    "sharded_sweep_summary",
+]

@@ -1,0 +1,180 @@
+"""Device-mesh sharding for batched reactor sweeps.
+
+The reference is a single-process, single-threaded, sequential-FFI design
+with NO distributed backend (SURVEY.md §2.3: no NCCL/MPI/Gloo anywhere in
+its tree); its only concurrency construct is the serial Python parameter
+sweep. The TPU-native equivalent is data parallelism over the batch axis
+of initial conditions: one compiled integrator, ``shard_map``-ped over a
+``jax.sharding.Mesh``, with XLA collectives over ICI (within a slice) and
+DCN (across hosts, via ``jax.distributed``) handling the few cross-device
+reductions (sweep summaries).
+
+Design notes:
+- The batch axis is padded to a multiple of the mesh size; padding
+  elements integrate a copy of element 0 and are masked out of results.
+- Per-element failure isolation: a diverging reactor reports
+  ``success=False`` for its element only (SURVEY.md §5 — vmapped solves
+  must not abort the whole batch); the integrator body is masked, so a
+  stalled element idles while the rest of its shard finishes.
+- Everything here also runs on a virtual CPU mesh
+  (``--xla_force_host_platform_device_count=N``), which is how the unit
+  tests and the multi-chip dry-run exercise the sharded path without N
+  real chips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import reactors as reactor_ops
+
+#: canonical mesh-axis name for the batch (data-parallel) axis
+BATCH_AXIS = "batch"
+
+#: jitted sweep programs keyed by (mech, problem, mesh, solver config)
+_sweep_program_cache: dict = {}
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None,
+              axis_name: str = BATCH_AXIS) -> Mesh:
+    """1-D device mesh over the batch axis.
+
+    With no arguments, uses every visible device — the whole v5e slice on
+    TPU, or the virtual CPU devices under
+    ``xla_force_host_platform_device_count``."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def distributed_initialize(**kwargs):
+    """Multi-host entry: wraps ``jax.distributed.initialize`` so sweeps
+    scale over DCN exactly like a multi-host ML job. No-op if already
+    initialized; any other failure (bad coordinator address, timeout)
+    propagates — silently falling back to single-process would let a
+    'multi-host' sweep compute on one host."""
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already initialized" not in str(e).lower():
+            raise
+
+
+def _pad_to_multiple(arr, multiple, axis=0):
+    n = arr.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad_idx = jnp.zeros((rem,), dtype=jnp.int32)
+    pad = jnp.take(arr, pad_idx, axis=axis)
+    return jnp.concatenate([arr, pad], axis=axis), n
+
+
+def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
+                           mesh: Optional[Mesh] = None, rtol=1e-6,
+                           atol=1e-12,
+                           ignition_mode=reactor_ops.IGN_T_INFLECTION,
+                           ignition_kwargs=None,
+                           max_steps_per_segment=20_000,
+                           solve_kwargs=None):
+    """Ignition-delay sweep sharded over a device mesh — the scaled-out
+    form of :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`.
+
+    Each device integrates its shard of initial conditions with the same
+    compiled program (SPMD); the mechanism record is replicated. Returns
+    (ignition_times [B] in seconds, success [B]) gathered to the host.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = mesh.devices.size
+    axis = mesh.axis_names[0]
+
+    T0s = jnp.atleast_1d(jnp.asarray(T0s, jnp.float64))
+    B = T0s.shape[0]
+    P0s = jnp.broadcast_to(jnp.asarray(P0s, jnp.float64), (B,))
+    Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64),
+                           (B, jnp.asarray(Y0s).shape[-1]))
+    t_ends = jnp.broadcast_to(jnp.asarray(t_ends, jnp.float64), (B,))
+
+    T0s, n_real = _pad_to_multiple(T0s, n_dev)
+    P0s, _ = _pad_to_multiple(P0s, n_dev)
+    Y0s, _ = _pad_to_multiple(Y0s, n_dev)
+    t_ends, _ = _pad_to_multiple(t_ends, n_dev)
+
+    kwargs = dict(rtol=rtol, atol=atol, n_out=2,
+                  ignition_mode=ignition_mode,
+                  ignition_kwargs=ignition_kwargs,
+                  max_steps_per_segment=max_steps_per_segment)
+    kwargs.update(solve_kwargs or {})
+
+    # cache the jitted program per configuration: a fresh jax.jit wrapper
+    # per call would miss the tracing cache and recompile the whole stiff
+    # integrator on EVERY sweep (same-shape repeat calls included)
+    cache_key = (id(mech), problem, energy, mesh.axis_names,
+                 tuple(d.id for d in mesh.devices.flat),
+                 tuple(sorted((k, repr(v)) for k, v in kwargs.items())))
+    mapped = _sweep_program_cache.get(cache_key)
+    if mapped is None:
+        def one(T0, P0, Y0, t_end):
+            sol = reactor_ops.solve_batch(mech, problem, energy, T0, P0, Y0,
+                                          t_end, **kwargs)
+            return sol.ignition_time, sol.success
+
+        def shard_fn(T0c, P0c, Y0c, tc):
+            return jax.vmap(one)(T0c, P0c, Y0c, tc)
+
+        spec_ = P(axis)
+        # check_vma=False: the integrator's while_loop carries are seeded
+        # with scalar literals, which the varying-axis type checker rejects
+        mapped = jax.jit(shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec_, spec_, spec_, spec_),
+            out_specs=(spec_, spec_), check_vma=False))
+        _sweep_program_cache[cache_key] = mapped
+
+    spec = P(axis)
+    in_sharding = NamedSharding(mesh, spec)
+    T0s, P0s, Y0s, t_ends = (
+        jax.device_put(T0s, in_sharding),
+        jax.device_put(P0s, in_sharding),
+        jax.device_put(Y0s, NamedSharding(mesh, P(axis, None))),
+        jax.device_put(t_ends, in_sharding))
+    times, ok = mapped(T0s, P0s, Y0s, t_ends)
+    return np.asarray(times)[:n_real], np.asarray(ok)[:n_real]
+
+
+def sharded_sweep_summary(mesh: Mesh, times, ok):
+    """Cross-device reduction example: fraction ignited + fastest ignition
+    via ``psum``/``pmin`` collectives inside ``shard_map`` (the only
+    cross-device communication a sweep needs — SURVEY.md §2.3)."""
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    times = jnp.asarray(times)
+    ok = jnp.asarray(ok)
+    # pad with non-igniting sentinels so padding never enters the reduction
+    rem = (-times.shape[0]) % n_dev
+    if rem:
+        times = jnp.concatenate([times, jnp.full((rem,), jnp.nan)])
+        ok = jnp.concatenate([ok, jnp.zeros((rem,), dtype=bool)])
+
+    def reduce_fn(t_c, ok_c):
+        finite = jnp.isfinite(t_c) & ok_c
+        n_ign = jax.lax.psum(jnp.sum(finite.astype(jnp.int32)), axis)
+        t_min = jax.lax.pmin(
+            jnp.min(jnp.where(finite, t_c, jnp.inf)), axis)
+        return n_ign, t_min
+
+    spec = P(axis)
+    f = shard_map(reduce_fn, mesh=mesh, in_specs=(spec, spec),
+                  out_specs=(P(), P()), check_vma=False)
+    n_ign, t_min = jax.jit(f)(times, ok)
+    return int(n_ign), float(t_min)
