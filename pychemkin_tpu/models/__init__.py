@@ -9,6 +9,14 @@ from .batch import (
     GivenVolumeBatchReactor_EnergyConservation,
     GivenVolumeBatchReactor_FixedTemperature,
 )
+from .psr import (
+    PSR_SetResTime_EnergyConservation,
+    PSR_SetResTime_FixedTemperature,
+    PSR_SetVolume_EnergyConservation,
+    PSR_SetVolume_FixedTemperature,
+    openreactor,
+    perfectlystirredreactor,
+)
 from .reactormodel import (
     BooleanKeyword,
     IntegerKeyword,
@@ -18,6 +26,7 @@ from .reactormodel import (
     RealKeyword,
     StringKeyword,
 )
+from .steadystatesolver import SteadyStateSolver
 
 __all__ = [
     "BatchReactors",
@@ -28,8 +37,15 @@ __all__ = [
     "GivenVolumeBatchReactor_FixedTemperature",
     "IntegerKeyword",
     "Keyword",
+    "PSR_SetResTime_EnergyConservation",
+    "PSR_SetResTime_FixedTemperature",
+    "PSR_SetVolume_EnergyConservation",
+    "PSR_SetVolume_FixedTemperature",
     "Profile",
     "ReactorModel",
     "RealKeyword",
+    "SteadyStateSolver",
     "StringKeyword",
+    "openreactor",
+    "perfectlystirredreactor",
 ]

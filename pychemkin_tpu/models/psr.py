@@ -1,0 +1,380 @@
+"""Open-reactor base and the perfectly-stirred-reactor (PSR) family.
+
+TPU-native re-implementation of the reference's steady-state stirred
+reactors (reference: src/ansys/chemkin/stirreactors/openreactor.py and
+stirreactors/PSR.py): the multi-inlet registry, the
+``perfectlystirredreactor`` base with equilibrium-based initial
+estimates, and the four concrete variants
+
+- ``PSR_SetResTime_EnergyConservation``   (PSR.py:866)
+- ``PSR_SetVolume_EnergyConservation``    (PSR.py:1021)
+- ``PSR_SetResTime_FixedTemperature``     (PSR.py:1176)
+- ``PSR_SetVolume_FixedTemperature``      (PSR.py:1205)
+
+The reference marshals inlets and reactor state into the native library
+and blocks in a TWOPNT-class solve (PSR.py:233/:523/:640); here ``run()``
+combines the inlets on the host (mass-flow-weighted composition and
+enthalpy — the same mixing the native solver performs) and calls the
+batched Newton/pseudo-transient kernel
+:func:`pychemkin_tpu.ops.psr.solve_psr`. ``run_sweep`` evaluates a whole
+residence-time S-curve as one vmapped solve.
+
+``process_solution()`` returns the exit :class:`Stream`
+(reference: PSR.py:787-865, KINAll0D_GetExitMassFlowRate).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inlet import Stream
+from ..logger import logger
+from ..mixture import Mixture, equilibrium
+from ..ops import psr as psr_ops
+from ..ops import thermo
+from .reactormodel import (
+    STATUS_FAILED,
+    STATUS_SUCCESS,
+    ReactorModel,
+)
+from .steadystatesolver import SteadyStateSolver
+
+
+class openreactor(ReactorModel, SteadyStateSolver):
+    """Steady-state open reactor: external-inlet registry
+    (reference: stirreactors/openreactor.py:38)."""
+
+    def __init__(self, reactor_condition: Mixture, label: str):
+        ReactorModel.__init__(self, reactor_condition, label)
+        SteadyStateSolver.__init__(self)
+        self._inlets: Dict[str, Stream] = {}
+
+    def set_inlet(self, inlet: Stream, name: Optional[str] = None):
+        """Register an inlet stream. Re-using a name REPLACES that inlet;
+        distinct names accumulate mass flow
+        (reference: openreactor.py:90-165)."""
+        if not isinstance(inlet, Stream):
+            raise TypeError("inlet must be a Stream")
+        if inlet.chemID != self.chemID:
+            raise ValueError("inlet must share the reactor's chemistry set")
+        key = name if name else (inlet.label or f"inlet{len(self._inlets)}")
+        if key in self._inlets:
+            logger.warning("inlet %r replaced", key)
+        self._inlets[key] = inlet
+
+    def reset_inlet(self, inlet: Stream, name: str):
+        """Replace a registered inlet (reference: openreactor.py:166)."""
+        if name not in self._inlets:
+            raise KeyError(f"no inlet named {name!r}")
+        self._inlets[name] = inlet
+
+    def remove_inlet(self, name: str):
+        """(reference: openreactor.py:203)."""
+        if name not in self._inlets:
+            raise KeyError(f"no inlet named {name!r}")
+        del self._inlets[name]
+
+    @property
+    def inlet_names(self) -> List[str]:
+        return list(self._inlets.keys())
+
+    @property
+    def numbinlets(self) -> int:
+        return len(self._inlets)
+
+    def net_mass_flowrate(self) -> float:
+        """Total inlet mass flow [g/s] (reference: openreactor.py:259)."""
+        return sum(s.convert_to_mass_flowrate()
+                   for s in self._inlets.values())
+
+    def combined_inlet(self) -> Tuple[np.ndarray, float, float]:
+        """(Y_in [KK], h_in [erg/g], mdot [g/s]) — mass-flow-weighted
+        mixture of all inlets, the stream mixing the native solver performs
+        from its per-inlet inputs (reference: PSR.py:203-285)."""
+        if not self._inlets:
+            raise RuntimeError("no inlet streams registered")
+        mdots = np.array([s.convert_to_mass_flowrate()
+                          for s in self._inlets.values()])
+        total = mdots.sum()
+        if total <= 0.0:
+            raise RuntimeError("total inlet mass flow is zero")
+        w = mdots / total
+        Y_in = np.zeros(self.numbspecies)
+        h_in = 0.0
+        for wi, s in zip(w, self._inlets.values()):
+            Y_in += wi * s.Y
+            h_in += wi * float(thermo.mixture_enthalpy_mass(
+                self.mech, s.temperature, jnp.asarray(s.Y)))
+        return Y_in, h_in, float(total)
+
+
+class perfectlystirredreactor(openreactor):
+    """Steady-state PSR base (reference: PSR.py:48). Constructed from a
+    GUESSED mixture/stream — its state seeds the Newton iteration, as in
+    the reference where the construction mixture provides the initial
+    solution estimate."""
+
+    #: specification mode ("tau" | "vol") and energy ("ENRG" | "TGIV")
+    mode = psr_ops.MODE_TAU
+    energy_type = "ENRG"
+
+    def __init__(self, guessedmixture: Mixture, label: Optional[str] = None):
+        super().__init__(guessedmixture, label or "PSR")
+        self._tau = 0.0
+        self._tauset = False
+        self._volume = guessedmixture.volume
+        self._volumeset = False
+        self._qloss = 0.0
+        self._reactor_index = 1
+        self._estimate_T: Optional[float] = None
+        self._estimate_Y: Optional[np.ndarray] = None
+        self._solution: Optional[psr_ops.PSRSolution] = None
+
+    # --- specification (reference: PSR.py:173-202) -------------------------
+    @property
+    def residence_time(self) -> float:
+        """tau [s] (reference: PSR.py:173)."""
+        return self._tau
+
+    @residence_time.setter
+    def residence_time(self, value: float):
+        if value <= 0.0:
+            raise ValueError("residence time must be positive")
+        self._tau = float(value)
+        self._tauset = True
+        self._record_keyword("TAU", float(value))
+
+    @property
+    def volume(self) -> float:
+        return self._volume
+
+    @volume.setter
+    def volume(self, value: float):
+        if value <= 0.0:
+            raise ValueError("volume must be positive")
+        self._volume = float(value)
+        self._volumeset = True
+        self._record_keyword("VOL", float(value))
+
+    @property
+    def heat_loss_rate(self) -> float:
+        """QLOS [erg/s]."""
+        return self._qloss
+
+    @heat_loss_rate.setter
+    def heat_loss_rate(self, value: float):
+        self._qloss = float(value)
+        self._record_keyword("QLOS", float(value))
+
+    def set_reactor_index(self, index: int):
+        """Cluster position for reactor networks
+        (reference: PSR.py:286)."""
+        self._reactor_index = int(index)
+
+    # --- initial estimates (reference: PSR.py:301-426) ---------------------
+    def set_estimate_conditions(self, temperature: Optional[float] = None,
+                                mixture: Optional[Mixture] = None,
+                                use_equilibrium: bool = True):
+        """Set the Newton initial estimate: an explicit (T, mixture), or
+        the constant-pressure equilibrium of the combined inlet
+        (reference: PSR.py:301 uses the native equilibrium the same way)."""
+        if mixture is not None:
+            self._estimate_Y = mixture.Y
+            self._estimate_T = (temperature if temperature
+                                else mixture.temperature)
+            return
+        if temperature is not None:
+            self._estimate_T = float(temperature)
+        if use_equilibrium and self._inlets:
+            Y_in, _, _ = self.combined_inlet()
+            first = next(iter(self._inlets.values()))
+            guess = Mixture(self.chemistry)
+            guess.pressure = self.pressure
+            guess.temperature = first.temperature
+            guess.Y = Y_in
+            eq = equilibrium(guess, opt=5)
+            self._estimate_Y = eq.Y
+            if temperature is None:
+                self._estimate_T = eq.temperature
+
+    def reset_estimate_temperature(self, temperature: float):
+        """(reference: PSR.py:367)."""
+        self._estimate_T = float(temperature)
+
+    def reset_estimate_composition(self, mixture: Mixture):
+        """(reference: PSR.py:394)."""
+        self._estimate_Y = mixture.Y
+
+    def _guess(self) -> Tuple[float, np.ndarray]:
+        T = (self._estimate_T if self._estimate_T is not None
+             else self._condition.temperature)
+        Y = (self._estimate_Y if self._estimate_Y is not None
+             else self._condition.Y)
+        return float(T), np.asarray(Y)
+
+    # --- solve -------------------------------------------------------------
+    def validate_inputs(self) -> int:
+        if self.mode == psr_ops.MODE_TAU and not self._tauset:
+            logger.error("residence time is required (TAU)")
+            return 1
+        if self.mode == psr_ops.MODE_VOLUME and not self._volumeset:
+            logger.error("reactor volume is required (VOL)")
+            return 2
+        if not self._inlets:
+            logger.error("at least one inlet stream is required")
+            return 3
+        return 0
+
+    def _solve_kwargs(self):
+        Y_in, h_in, mdot = self.combined_inlet()
+        return dict(
+            mech=self._effective_mech(),
+            mode=self.mode,
+            energy=self.energy_type,
+            P=self.pressure,
+            Y_in=jnp.asarray(Y_in),
+            h_in=h_in,
+            mdot=mdot,
+            qloss=self._qloss,
+            T_fixed=self._condition.temperature,
+            ss_atol=self.SSabsolute_tolerance,
+            ss_rtol=self.SSrelative_tolerance,
+            n_newton=self.SSmaxiteration // 2,
+            n_pseudo=self.TRnumbsteps_ENRG if self.energy_type == "ENRG"
+            else self.TRnumbsteps_fixT,
+            pseudo_dt0=self.TRstride_ENRG if self.energy_type == "ENRG"
+            else self.TRstride_fixT,
+            pseudo_up=self.TRupfactor,
+            pseudo_down=self.TRdownfactor,
+            pseudo_dt_min=self.TRminstepsize,
+            pseudo_dt_max=self.TRmaxstepsize,
+            T_max=self.maxTbound,
+            species_floor=self.speciesfloor,
+        )
+
+    def run(self) -> int:
+        """Solve the steady state (reference: PSR.py:643-786)."""
+        if self.validate_inputs() != 0:
+            self.runstatus = STATUS_FAILED
+            return self.runstatus
+        T_g, Y_g = self._guess()
+        sol = psr_ops.solve_psr(
+            tau=self._tau, volume=self._volume,
+            T_guess=jnp.asarray(T_g), Y_guess=jnp.asarray(Y_g),
+            **self._solve_kwargs())
+        self._solution = jax.device_get(sol)
+        ok = bool(self._solution.converged)
+        self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        if not ok:
+            logger.error("PSR steady-state solve did not converge "
+                         "(residual %.2e)", float(self._solution.residual))
+        else:
+            # warm-start the next run from this solution, as the
+            # reference's continuation workflows do (PSR.py:367-426)
+            self._estimate_T = float(self._solution.T)
+            self._estimate_Y = np.asarray(self._solution.Y)
+        return self.runstatus
+
+    def run_sweep(self, taus=None, volumes=None):
+        """Whole S-curve in ONE vmapped solve — the TPU replacement for
+        the reference's serial continuation loop
+        (examples/PSR/PSRgas.py:252-255). All elements share this
+        reactor's inlets and estimate. Returns (T [B], Y [B, KK],
+        converged [B])."""
+        T_g, Y_g = self._guess()
+        kwargs = self._solve_kwargs()
+        if self.mode == psr_ops.MODE_TAU:
+            if taus is None:
+                raise ValueError("taus required for SetResTime sweeps")
+            params = jnp.asarray(taus, jnp.float64)
+
+            def one(p):
+                return psr_ops.solve_psr(
+                    tau=p, volume=self._volume,
+                    T_guess=jnp.asarray(T_g), Y_guess=jnp.asarray(Y_g),
+                    **kwargs)
+        else:
+            if volumes is None:
+                raise ValueError("volumes required for SetVolume sweeps")
+            params = jnp.asarray(volumes, jnp.float64)
+
+            def one(p):
+                return psr_ops.solve_psr(
+                    tau=self._tau, volume=p,
+                    T_guess=jnp.asarray(T_g), Y_guess=jnp.asarray(Y_g),
+                    **kwargs)
+
+        sol = jax.vmap(one)(params)
+        return (np.asarray(sol.T), np.asarray(sol.Y),
+                np.asarray(sol.converged))
+
+    # --- solution (reference: PSR.py:787-865) ------------------------------
+    def process_solution(self) -> Stream:
+        """Exit stream at the solved state; carries the exit mass flow
+        (== total inlet flow at steady state,
+        reference: KINAll0D_GetExitMassFlowRate, PSR.py:845)."""
+        if self._solution is None:
+            raise RuntimeError("run() the reactor first")
+        sol = self._solution
+        out = Stream(self.chemistry, label=f"{self.label}-exit")
+        out.pressure = self.pressure
+        out.temperature = float(sol.T)
+        out.Y = np.asarray(sol.Y)
+        out.mass_flowrate = self.net_mass_flowrate()
+        self._numbsolutionpoints = 1
+        self._solution_rawarray = {
+            "temperature": np.asarray([sol.T]),
+            "pressure": np.asarray([self.pressure]),
+            "volume": np.asarray([sol.volume]),
+            "flowrate": np.asarray([self.net_mass_flowrate()]),
+        }
+        Y = np.asarray(sol.Y)
+        for k, name in enumerate(self._specieslist):
+            self._solution_rawarray[name] = Y[k:k + 1]
+        return out
+
+    @property
+    def exit_residence_time(self) -> float:
+        """Actual residence time of the solved state."""
+        if self._solution is None:
+            raise RuntimeError("run() the reactor first")
+        return float(self._solution.tau)
+
+    @property
+    def solved_volume(self) -> float:
+        if self._solution is None:
+            raise RuntimeError("run() the reactor first")
+        return float(self._solution.volume)
+
+
+class PSR_SetResTime_EnergyConservation(perfectlystirredreactor):
+    """Given residence time + energy equation (reference: PSR.py:866)."""
+
+    mode = psr_ops.MODE_TAU
+    energy_type = "ENRG"
+
+
+class PSR_SetVolume_EnergyConservation(perfectlystirredreactor):
+    """Given volume + energy equation (reference: PSR.py:1021)."""
+
+    mode = psr_ops.MODE_VOLUME
+    energy_type = "ENRG"
+
+
+class PSR_SetResTime_FixedTemperature(perfectlystirredreactor):
+    """Given residence time + given temperature
+    (reference: PSR.py:1176)."""
+
+    mode = psr_ops.MODE_TAU
+    energy_type = "TGIV"
+
+
+class PSR_SetVolume_FixedTemperature(perfectlystirredreactor):
+    """Given volume + given temperature (reference: PSR.py:1205)."""
+
+    mode = psr_ops.MODE_VOLUME
+    energy_type = "TGIV"

@@ -1,0 +1,280 @@
+"""Steady-state perfectly-stirred-reactor (PSR) solver (JAX).
+
+TPU-native replacement for the reference's native PSR path:
+``KINAll0D_SetupPSRReactorInputs`` / ``KINAll0D_SetupPSRInletInputs`` +
+``KINAll0D_Calculate`` (reference: stirreactors/PSR.py:233/:523/:640),
+which runs a TWOPNT-class damped Newton with pseudo-transient continuation
+inside the licensed Fortran library, one reactor per blocking call.
+
+Here the solve is a pure function built from the same strategy
+(reference defaults in steadystatesolver.py:40-99):
+
+1. damped Newton on the steady residual from the initial guess;
+2. for unconverged elements, pseudo-transient continuation — implicit
+   Euler steps with a growing step size (stride defaults TRstride 1e-6 s,
+   up-factor 2.0 / down via damping) — followed by a second Newton polish.
+
+All three phases are fixed-iteration ``lax`` loops with masked updates,
+so the solver is jit/vmap/shard_map-transparent: an extinction S-curve
+evaluates as ONE compiled program over the whole batch of residence
+times, and a diverged element flags itself without aborting the batch
+(SURVEY.md §5).
+
+Governing equations (per unit reactor volume; CGS):
+  species:  (rho/tau) (Y_k,in - Y_k) + wdot_k W_k            = 0
+  energy:   (rho/tau) (h_in - h(T)) ... written per-mass as
+            sum_k [ (rho/tau)(Y_in,k h_k,in... ] — implemented as
+            (rho/tau) (h_in - h) - Qloss/V = 0  with h the mixture
+            specific enthalpy at (T, Y).
+with tau = rho V / mdot the nominal residence time. For SetResTime
+problems tau is given (V adjusts); for SetVolume problems
+tau = rho(T,P,Y) V / mdot varies with the solution state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import kinetics, linalg, thermo
+
+_TINY = 1e-30
+
+MODE_TAU = "tau"      # residence time given (SetResTime)
+MODE_VOLUME = "vol"   # volume given (SetVolume)
+
+
+class PSRArgs(NamedTuple):
+    """Static-shape arguments of the PSR residual."""
+    mech: Any
+    P: Any            # reactor pressure, dyne/cm^2
+    Y_in: Any         # [KK] combined-inlet mass fractions
+    h_in: Any         # combined-inlet specific enthalpy, erg/g
+    tau: Any          # residence time, s (MODE_TAU) or 0
+    volume: Any       # reactor volume, cm^3 (MODE_VOLUME) or 0
+    mdot: Any         # total inlet mass flow, g/s (MODE_VOLUME)
+    qloss: Any        # heat-loss rate, erg/s (ENRG)
+    T_fixed: Any      # reactor temperature (TGIV)
+
+
+class PSRSolution(NamedTuple):
+    T: Any
+    Y: Any            # [KK]
+    rho: Any
+    tau: Any          # actual residence time
+    volume: Any       # actual volume
+    residual: Any     # final weighted residual norm
+    converged: Any
+    n_newton: Any
+
+
+def _split(y):
+    return y[:-1], jnp.maximum(y[-1], 50.0)
+
+
+def _tau_volume(args: PSRArgs, rho, mode):
+    """(tau, V) consistent with the specification mode."""
+    if mode == MODE_TAU:
+        tau = args.tau
+        # V = tau * mdot / rho; mdot may be 0 for pure-tau problems
+        V = tau * jnp.maximum(args.mdot, _TINY) / rho
+        return tau, V
+    V = args.volume
+    tau = rho * V / jnp.maximum(args.mdot, _TINY)
+    return tau, V
+
+
+def make_rhs(mode, energy):
+    """Transient PSR RHS d[Y,T]/dt — the steady state is its root, and the
+    pseudo-transient phase integrates it (reference TWOPNT strategy)."""
+
+    def rhs(t, y, args: PSRArgs):
+        mech = args.mech
+        Y, T = _split(y)
+        if energy == "TGIV":
+            T = args.T_fixed
+        rho = thermo.density(mech, T, args.P, Y)
+        tau, V = _tau_volume(args, rho, mode)
+        tau = jnp.maximum(tau, _TINY)
+        C = thermo.Y_to_C(mech, Y, rho)
+        wdot = kinetics.net_production_rates(mech, T, C, args.P)
+        dY = (args.Y_in - Y) / tau + wdot * mech.wt / rho
+        if energy == "TGIV":
+            dT = jnp.zeros(())
+        else:
+            # cp dT/dt = (h_in - sum_k Y_in,k h_k(T))/tau
+            #            - sum_k h_k wdot_k W_k / rho + Qdot/m
+            # (flow term uses the INLET composition with current-T species
+            # enthalpies — substituting the species equation into
+            # dh/dt = cp dT/dt + sum h_k dY_k/dt; the steady state is then
+            # exactly h(T, Y) = h_in + Q tau / m)
+            cp = thermo.mixture_cp_mass(mech, T, Y)
+            h_k = thermo.species_enthalpy_mass(mech, T)  # [KK] erg/g
+            h_in_term = args.h_in - jnp.dot(args.Y_in, h_k)
+            q_mass = args.qloss / jnp.maximum(rho * V, _TINY)  # erg/(g s)
+            dT = (h_in_term / tau
+                  - jnp.dot(h_k, wdot * mech.wt) / rho
+                  - q_mass) / cp
+        return jnp.concatenate([dY, dT[None]])
+
+    return rhs
+
+
+def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
+                  species_floor, damping=True):
+    """Damped Newton with masked convergence; returns (y, converged, n)."""
+    n = y0.shape[0]
+
+    def norm(r, y):
+        w = weights[0] + weights[1] * jnp.abs(y)
+        return jnp.sqrt(jnp.mean((r / w) ** 2))
+
+    def body(carry):
+        y, _, it = carry
+        r = resid_fn(y, args)
+        J = jax.jacfwd(lambda yy: resid_fn(yy, args))(y)
+        J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-14 * jnp.eye(n)
+        dy = linalg.solve(J, -jnp.where(jnp.isfinite(r), r, 1e6))
+        dy = jnp.where(jnp.isfinite(dy), dy, 0.0)
+        if damping:
+            # cap temperature moves at 150 K and fraction moves at 0.2
+            aT = 150.0 / jnp.maximum(jnp.abs(dy[-1]), _TINY)
+            aY = 0.2 / jnp.maximum(jnp.max(jnp.abs(dy[:-1])), _TINY)
+            alpha = jnp.minimum(1.0, jnp.minimum(aT, aY))
+        else:
+            alpha = 1.0
+        y_new = y + alpha * dy
+        # clamp into physical bounds (reference: maxTbound / speciesfloor,
+        # steadystatesolver.py:56-60)
+        y_new = y_new.at[:-1].set(jnp.clip(y_new[:-1], species_floor, 1.0))
+        y_new = y_new.at[-1].set(jnp.clip(y_new[-1], 150.0, T_max))
+        # 0.05: quadratic convergence makes the last factor-20 cheap, and
+        # the slack of a 1.0 threshold shows up as multi-K enthalpy error
+        conv = norm(resid_fn(y_new, args), y_new) < 0.05
+        return y_new, conv, it + 1
+
+    def cond(carry):
+        _, conv, it = carry
+        return (~conv) & (it < n_iter)
+
+    y, conv, it = jax.lax.while_loop(cond, body,
+                                     (y0, jnp.array(False), jnp.array(0)))
+    return y, conv, it
+
+
+def _pseudo_transient_phase(rhs_fn, y0, args, n_steps, dt0, up_factor,
+                            down_factor, dt_min, dt_max, T_max,
+                            species_floor):
+    """Implicit-Euler continuation with bounded, adaptive step size
+    (reference strategy and defaults: steadystatesolver.py:79-87 —
+    TRminstepsize/TRmaxstepsize bounds, up/down factors 2.0/2.2); each
+    step does a few Newton iterations on G(y) = y - y_prev - dt*R(y)."""
+    n = y0.shape[0]
+
+    def step(carry, _):
+        y, dt = carry
+        J = jax.jacfwd(lambda yy: rhs_fn(0.0, yy, args))(y)
+        M = jnp.eye(n) - dt * J
+        fac = linalg.factor(jnp.where(jnp.isfinite(M), M, 0.0))
+
+        def inner(carry_i, _):
+            yc, bad = carry_i
+            g = yc - y - dt * rhs_fn(0.0, yc, args)
+            dy = linalg.solve_factored(fac, -g)
+            bad = bad | ~jnp.all(jnp.isfinite(dy))
+            yc = yc + jnp.where(jnp.isfinite(dy), dy, 0.0)
+            yc = yc.at[:-1].set(jnp.clip(yc[:-1], species_floor, 1.0))
+            yc = yc.at[-1].set(jnp.clip(yc[-1], 150.0, T_max))
+            return (yc, bad), None
+
+        (y_new, bad), _ = jax.lax.scan(inner, (y, jnp.array(False)), None,
+                                       length=6)
+        # inexactly-solved steps drift off the sum(Y)=1 manifold; project
+        # back so accepted states stay physical
+        ysum = jnp.maximum(jnp.sum(jnp.clip(y_new[:-1], 0.0, 1.0)), _TINY)
+        y_new = y_new.at[:-1].set(jnp.clip(y_new[:-1], 0.0, 1.0) / ysum)
+        # accept any finite step: with dt bounded, an inexactly-solved
+        # implicit-Euler step still contracts toward the steady manifold;
+        # a non-finite Newton direction shrinks dt instead
+        ok = jnp.all(jnp.isfinite(y_new)) & ~bad
+        y = jnp.where(jnp.all(jnp.isfinite(y_new)), y_new, y)
+        dt = jnp.where(ok, dt * up_factor, dt / down_factor)
+        dt = jnp.clip(dt, dt_min, dt_max)
+        return (y, dt), None
+
+    (y, _), _ = jax.lax.scan(step, (y0, jnp.asarray(dt0)), None,
+                             length=n_steps)
+    return y
+
+
+def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
+              tau=0.0, volume=0.0, mdot=0.0, qloss=0.0, T_fixed=0.0,
+              ss_atol=1e-9, ss_rtol=1e-4, n_newton=50,
+              n_pseudo=100, pseudo_dt0=1e-6, pseudo_up=2.0,
+              pseudo_down=2.2, pseudo_dt_min=1e-10, pseudo_dt_max=1e-2,
+              T_max=5000.0, species_floor=-1e-14):
+    """Solve one PSR steady state; jit/vmap-safe.
+
+    mode: "tau" (SetResTime) | "vol" (SetVolume);
+    energy: "ENRG" | "TGIV". Defaults follow the reference's
+    steady-state solver controls (steadystatesolver.py:40-99: atol 1e-9,
+    rtol 1e-4, pseudo-transient stride 1e-6 s x 100 steps, up-factor 2.0).
+    """
+    mech_args = PSRArgs(
+        mech=mech, P=jnp.asarray(P, jnp.float64),
+        Y_in=jnp.asarray(Y_in, jnp.float64),
+        h_in=jnp.asarray(h_in, jnp.float64),
+        tau=jnp.asarray(tau, jnp.float64),
+        volume=jnp.asarray(volume, jnp.float64),
+        mdot=jnp.asarray(mdot, jnp.float64),
+        qloss=jnp.asarray(qloss, jnp.float64),
+        T_fixed=jnp.asarray(T_fixed, jnp.float64))
+    rhs = make_rhs(mode, energy)
+
+    def resid(y, args):
+        # scale the transient RHS by tau so the residual is O(1) in
+        # fraction units (the reference's weighted-norm convention)
+        Y, T = _split(y)
+        if energy == "TGIV":
+            T = args.T_fixed
+        rho = thermo.density(args.mech, T, args.P, Y)
+        tau_eff, _ = _tau_volume(args, rho, mode)
+        return rhs(0.0, y, args) * jnp.maximum(tau_eff, _TINY)
+
+    # convergence weights in the tau-scaled (fraction-unit) residual:
+    # |r_k| < atol' + rtol |y_k| with atol' = 1e3 * ss_atol (ss_atol is
+    # quoted for the unscaled rate residual; tau ~ 1e-3 s typical)
+    weights = (1e3 * jnp.asarray(ss_atol), jnp.asarray(ss_rtol))
+
+    y0 = jnp.concatenate([jnp.asarray(Y_guess, jnp.float64),
+                          jnp.asarray(T_guess, jnp.float64)[None]])
+
+    y1, conv1, n1 = _newton_phase(resid, y0, mech_args, weights, n_newton,
+                                  T_max, species_floor)
+
+    # pseudo-transient rescue for unconverged elements; a no-op (masked)
+    # when phase 1 already converged
+    y_pt = _pseudo_transient_phase(rhs, y1, mech_args, n_pseudo, pseudo_dt0,
+                                   pseudo_up, pseudo_down, pseudo_dt_min,
+                                   pseudo_dt_max, T_max, species_floor)
+    y_pt = jnp.where(conv1, y1, y_pt)
+    y2, conv2, n2 = _newton_phase(resid, y_pt, mech_args, weights, n_newton,
+                                  T_max, species_floor)
+    y = jnp.where(conv1, y1, y2)
+    converged = conv1 | conv2
+
+    Y, T = _split(y)
+    Y = jnp.clip(Y, 0.0, 1.0)
+    Y = Y / jnp.maximum(jnp.sum(Y), _TINY)
+    if energy == "TGIV":
+        T = mech_args.T_fixed
+    rho = thermo.density(mech, T, mech_args.P, Y)
+    tau_eff, V_eff = _tau_volume(mech_args, rho, mode)
+    w = weights[0] + weights[1] * jnp.abs(y)
+    rfin = resid(y, mech_args)
+    rnorm = jnp.sqrt(jnp.mean((rfin / w) ** 2))
+    return PSRSolution(T=T, Y=Y, rho=rho, tau=tau_eff, volume=V_eff,
+                       residual=rnorm, converged=converged,
+                       n_newton=n1 + n2)

@@ -1,0 +1,247 @@
+"""PSR tests: steady-state kernel physics + model-class workflow.
+
+Oracles (the reference has no numeric unit tests, SURVEY.md §4):
+- adiabatic PSR exit enthalpy equals inlet enthalpy exactly;
+- long-residence-time limit approaches the inlet's constant-pressure
+  equilibrium (flame) state;
+- extinction: below a critical residence time only the cold branch
+  remains;
+- TGIV / SetVolume variants satisfy their own defining relations;
+- model classes reproduce the kernel through the reference workflow
+  (inlet registry, estimates, exit Stream).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.constants import P_ATM
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.models import (
+    PSR_SetResTime_EnergyConservation,
+    PSR_SetResTime_FixedTemperature,
+    PSR_SetVolume_EnergyConservation,
+)
+from pychemkin_tpu.ops import equilibrium as eq_ops
+from pychemkin_tpu.ops import psr as psr_ops
+from pychemkin_tpu.ops import thermo
+
+
+@pytest.fixture(scope="module")
+def chem():
+    return ck.Chemistry.from_mechanism(load_embedded("h2o2"))
+
+
+@pytest.fixture(scope="module")
+def mech(chem):
+    return chem.mech
+
+
+@pytest.fixture(scope="module")
+def inlet_state(mech):
+    names = list(mech.species_names)
+    X = np.zeros(len(names))
+    X[names.index("H2")] = 2.0
+    X[names.index("O2")] = 1.0
+    X[names.index("N2")] = 3.76
+    Y = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+    h_in = float(thermo.mixture_enthalpy_mass(mech, 298.15, jnp.asarray(Y)))
+    return Y, h_in
+
+
+@pytest.fixture(scope="module")
+def hot_guess(mech, inlet_state):
+    Y_in, _ = inlet_state
+    g = eq_ops.equilibrate(mech, 298.15, P_ATM, Y_in, option=5)
+    return float(g.T), np.asarray(g.Y)
+
+
+class TestPSRKernel:
+    def test_enthalpy_conservation_burning_branch(self, mech, inlet_state,
+                                                  hot_guess):
+        Y_in, h_in = inlet_state
+        T_g, Y_g = hot_guess
+        sol = psr_ops.solve_psr(mech, "tau", "ENRG", P=P_ATM, Y_in=Y_in,
+                                h_in=h_in, T_guess=T_g, Y_guess=Y_g,
+                                tau=1e-3, mdot=10.0)
+        assert bool(sol.converged)
+        h_out = float(thermo.mixture_enthalpy_mass(mech, sol.T, sol.Y))
+        cp = float(thermo.mixture_cp_mass(mech, sol.T, sol.Y))
+        assert abs(h_out - h_in) / cp < 0.01      # < 0.01 K equivalent
+        assert 2000.0 < float(sol.T) < 2386.0     # below inlet AFT
+
+    def test_long_tau_approaches_equilibrium(self, mech, inlet_state,
+                                             hot_guess):
+        Y_in, h_in = inlet_state
+        T_g, Y_g = hot_guess
+        sol = psr_ops.solve_psr(mech, "tau", "ENRG", P=P_ATM, Y_in=Y_in,
+                                h_in=h_in, T_guess=T_g, Y_guess=Y_g,
+                                tau=10.0, mdot=10.0)
+        assert bool(sol.converged)
+        # HP equilibrium of the inlet = 2386.7 K
+        assert abs(float(sol.T) - 2386.7) < 5.0
+
+    def test_extinction_cold_branch(self, mech, inlet_state):
+        """Below the extinction residence time, the solution from a cold
+        guess is the non-reacting state (exit == inlet)."""
+        Y_in, h_in = inlet_state
+        sol = psr_ops.solve_psr(mech, "tau", "ENRG", P=P_ATM, Y_in=Y_in,
+                                h_in=h_in, T_guess=jnp.asarray(298.15),
+                                Y_guess=jnp.asarray(Y_in), tau=1e-6,
+                                mdot=10.0)
+        assert bool(sol.converged)
+        assert abs(float(sol.T) - 298.15) < 1.0
+        np.testing.assert_allclose(np.asarray(sol.Y), Y_in, atol=1e-6)
+
+    def test_tgiv_species_balance(self, mech, inlet_state):
+        """Fixed-T PSR: per-species balance (Y_in - Y)/tau + wdot W/rho
+        must vanish at the solution."""
+        Y_in, h_in = inlet_state
+        T_fix = 1500.0
+        sol = psr_ops.solve_psr(mech, "tau", "TGIV", P=P_ATM, Y_in=Y_in,
+                                h_in=h_in, T_guess=jnp.asarray(T_fix),
+                                Y_guess=jnp.asarray(Y_in), tau=1e-3,
+                                mdot=10.0, T_fixed=T_fix)
+        assert bool(sol.converged)
+        assert float(sol.T) == T_fix
+        from pychemkin_tpu.ops import kinetics
+        rho = float(thermo.density(mech, sol.T, P_ATM, sol.Y))
+        C = thermo.Y_to_C(mech, sol.Y, rho)
+        wdot = np.asarray(kinetics.net_production_rates(mech, sol.T, C))
+        resid = (Y_in - np.asarray(sol.Y)) / 1e-3 + \
+            wdot * np.asarray(mech.wt) / rho
+        assert np.max(np.abs(resid)) < 1e-4      # 1/s units
+
+    def test_set_volume_mode_relation(self, mech, inlet_state, hot_guess):
+        """SetVolume: tau = rho V / mdot at the solution."""
+        Y_in, h_in = inlet_state
+        T_g, Y_g = hot_guess
+        V, mdot = 50.0, 20.0
+        sol = psr_ops.solve_psr(mech, "vol", "ENRG", P=P_ATM, Y_in=Y_in,
+                                h_in=h_in, T_guess=T_g, Y_guess=Y_g,
+                                volume=V, mdot=mdot)
+        assert bool(sol.converged)
+        rho = float(thermo.density(mech, sol.T, P_ATM, sol.Y))
+        assert abs(float(sol.tau) - rho * V / mdot) < 1e-12
+
+    def test_vmapped_s_curve(self, mech, inlet_state, hot_guess):
+        Y_in, h_in = inlet_state
+        T_g, Y_g = hot_guess
+
+        def one(tau):
+            s = psr_ops.solve_psr(mech, "tau", "ENRG", P=P_ATM, Y_in=Y_in,
+                                  h_in=h_in, T_guess=jnp.asarray(T_g),
+                                  Y_guess=jnp.asarray(Y_g), tau=tau,
+                                  mdot=10.0)
+            return s.T, s.converged
+
+        taus = jnp.asarray(np.logspace(-2, -4, 9))
+        Ts, ok = jax.vmap(one)(taus)
+        assert bool(jnp.all(ok))
+        # burning branch: T decreases monotonically as tau shrinks
+        assert bool(jnp.all(jnp.diff(Ts) < 0.0))
+
+
+class TestPSRModels:
+    def _make_inlet(self, chem, mdot=10.0):
+        s = ck.Stream(chem, label="fuel-air")
+        s.temperature = 298.15
+        s.pressure = P_ATM
+        s.X = [("H2", 2.0), ("O2", 1.0), ("N2", 3.76)]
+        s.mass_flowrate = mdot
+        return s
+
+    def _make_guess(self, chem):
+        g = ck.Mixture(chem)
+        g.pressure = P_ATM
+        g.temperature = 2300.0
+        g.X = [("H2O", 0.25), ("N2", 0.65), ("OH", 0.05), ("O2", 0.05)]
+        return g
+
+    def test_full_workflow(self, chem):
+        psr = PSR_SetResTime_EnergyConservation(self._make_guess(chem),
+                                                label="psr1")
+        psr.set_inlet(self._make_inlet(chem))
+        psr.residence_time = 1e-3
+        psr.set_estimate_conditions()     # equilibrium-based estimate
+        assert psr.run() == 0
+        out = psr.process_solution()
+        assert isinstance(out, ck.Stream)
+        assert 2000.0 < out.temperature < 2386.0
+        assert abs(out.mass_flowrate - 10.0) < 1e-10
+        # exit stream enthalpy == inlet enthalpy (adiabatic steady state)
+        h_in = ck.Mixture.mixture_enthalpy(chem.chemID, P_ATM, 298.15,
+                                           self._make_inlet(chem).Y,
+                                           chem.WT, "mass")
+        h_out = ck.Mixture.mixture_enthalpy(chem.chemID, out.pressure,
+                                            out.temperature, out.Y,
+                                            chem.WT, "mass")
+        cp = ck.Mixture.mixture_specific_heat(chem.chemID, out.pressure,
+                                              out.temperature, out.Y,
+                                              chem.WT, "mass")
+        assert abs(h_out - h_in) / cp < 0.05
+
+    def test_inlet_registry(self, chem):
+        psr = PSR_SetResTime_EnergyConservation(self._make_guess(chem))
+        a = self._make_inlet(chem, mdot=4.0)
+        b = self._make_inlet(chem, mdot=6.0)
+        psr.set_inlet(a, name="a")
+        psr.set_inlet(b, name="b")
+        assert psr.numbinlets == 2
+        assert abs(psr.net_mass_flowrate() - 10.0) < 1e-12
+        psr.set_inlet(self._make_inlet(chem, mdot=1.0), name="a")  # replace
+        assert abs(psr.net_mass_flowrate() - 7.0) < 1e-12
+        psr.remove_inlet("b")
+        assert psr.inlet_names == ["a"]
+        with pytest.raises(KeyError):
+            psr.remove_inlet("zzz")
+
+    def test_requires_tau_and_inlet(self, chem):
+        psr = PSR_SetResTime_EnergyConservation(self._make_guess(chem))
+        assert psr.run() != 0             # no tau, no inlet
+        psr.residence_time = 1e-3
+        assert psr.run() != 0             # still no inlet
+
+    def test_set_volume_variant(self, chem):
+        psr = PSR_SetVolume_EnergyConservation(self._make_guess(chem))
+        psr.set_inlet(self._make_inlet(chem, mdot=20.0))
+        psr.volume = 50.0
+        psr.set_estimate_conditions()
+        assert psr.run() == 0
+        out = psr.process_solution()
+        # tau = rho V/mdot ~ 2.5e-4 s -> burning branch around 1950-2000 K
+        assert out.temperature > 1900.0
+        assert psr.exit_residence_time > 0.0
+
+    def test_fixed_temperature_variant(self, chem):
+        guess = self._make_guess(chem)
+        guess.temperature = 1500.0
+        psr = PSR_SetResTime_FixedTemperature(guess)
+        psr.set_inlet(self._make_inlet(chem))
+        psr.residence_time = 1e-3
+        assert psr.run() == 0
+        out = psr.process_solution()
+        assert abs(out.temperature - 1500.0) < 1e-9
+        # fuel partially consumed at 1500 K / 1 ms
+        names = chem.species_symbols
+        assert out.Y[names.index("H2O")] > 1e-3
+
+    def test_sweep_s_curve(self, chem):
+        psr = PSR_SetResTime_EnergyConservation(self._make_guess(chem))
+        psr.set_inlet(self._make_inlet(chem))
+        psr.residence_time = 1e-3
+        psr.set_estimate_conditions()
+        T, Y, ok = psr.run_sweep(taus=np.logspace(-2, -4, 7))
+        assert ok.all()
+        assert np.all(np.diff(T) < 0.0)
+
+    def test_ss_solver_keyword_surface(self, chem):
+        psr = PSR_SetResTime_EnergyConservation(self._make_guess(chem))
+        psr.steady_state_tolerances = (1e-10, 1e-5)
+        assert psr.SSsolverkeywords["ATOL"] == 1e-10
+        psr.set_temperature_ceiling(4000.0)
+        assert psr.maxTbound == 4000.0
+        with pytest.raises(ValueError):
+            psr.steady_state_tolerances = (-1.0, 1e-5)
