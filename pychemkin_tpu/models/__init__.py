@@ -9,6 +9,11 @@ from .batch import (
     GivenVolumeBatchReactor_EnergyConservation,
     GivenVolumeBatchReactor_FixedTemperature,
 )
+from .pfr import (
+    PlugFlowReactor,
+    PlugFlowReactor_EnergyConservation,
+    PlugFlowReactor_FixedTemperature,
+)
 from .psr import (
     PSR_SetResTime_EnergyConservation,
     PSR_SetResTime_FixedTemperature,
@@ -41,6 +46,9 @@ __all__ = [
     "PSR_SetResTime_FixedTemperature",
     "PSR_SetVolume_EnergyConservation",
     "PSR_SetVolume_FixedTemperature",
+    "PlugFlowReactor",
+    "PlugFlowReactor_EnergyConservation",
+    "PlugFlowReactor_FixedTemperature",
     "Profile",
     "ReactorModel",
     "RealKeyword",

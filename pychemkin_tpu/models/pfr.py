@@ -1,0 +1,282 @@
+"""Plug-flow reactor models.
+
+TPU-native re-implementation of the reference's PFR family
+(reference: src/ansys/chemkin/flowreactors/PFR.py): ``PlugFlowReactor``
+(subclasses the batch-reactor base, as the reference does — PFR.py:46)
+plus the ``PlugFlowReactor_EnergyConservation`` (:730) and
+``PlugFlowReactor_FixedTemperature`` (:983) variants. The constructor
+takes a :class:`Stream` inlet and pulls its flow rate and flow area
+(reference: PFR.py:98-135); the momentum equation is ON by default
+(reference: PFR.py:147). ``run()`` assembles one jitted
+:func:`pychemkin_tpu.ops.pfr.solve_pfr` marching integration; the
+ignition "delay" is reported as a distance in cm
+(reference: batchreactor.py:623-640).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inlet import Stream
+from ..logger import logger
+from ..ops import pfr as pfr_ops
+from ..ops import reactors as reactor_ops
+from .batch import BatchReactors
+from .reactormodel import STATUS_FAILED, STATUS_SUCCESS
+
+
+class PlugFlowReactor(BatchReactors):
+    """Base plug-flow reactor (reference: PFR.py:46)."""
+
+    energy_type = "ENRG"
+    problem_type = "PFR"
+
+    def __init__(self, inlet: Stream, label: str = "PFR"):
+        if not isinstance(inlet, Stream):
+            raise TypeError("PFR requires a Stream inlet "
+                            "(reference: PFR.py:51)")
+        super().__init__(inlet, label)
+        self._mdot = inlet.convert_to_mass_flowrate()
+        self._flowarea = inlet.flowarea if inlet.flowarea > 0 else 1.0
+        self._length = 0.0
+        self._lengthset = False
+        self._x_start = 0.0
+        self._momentum = True       # ON by default (reference: PFR.py:147)
+        self._pfr_solution = None
+
+    # --- geometry (reference: PFR.py:151-337) ------------------------------
+    @property
+    def length(self) -> float:
+        """Reactor length XEND [cm] (reference: PFR.py:151)."""
+        return self._length
+
+    @length.setter
+    def length(self, length: float = 0.0):
+        if length <= 0.0:
+            raise ValueError("length must be positive")
+        self._length = float(length)
+        self._lengthset = True
+        self._record_keyword("XEND", float(length))
+
+    def set_start_position(self, x0: float):
+        """XSTR (reference: PFR.py:182)."""
+        self._x_start = float(x0)
+        self.setkeyword("XSTR", float(x0))
+
+    @property
+    def diameter(self) -> float:
+        """Duct diameter [cm] (reference: PFR.py:205)."""
+        return 2.0 * np.sqrt(self._flowarea / np.pi)
+
+    @diameter.setter
+    def diameter(self, diam: float):
+        if diam <= 0.0:
+            raise ValueError("diameter must be positive")
+        self._flowarea = np.pi * (diam / 2.0) ** 2
+        self.setkeyword("DIAM", float(diam))
+
+    def set_diameter_profile(self, x, diameter):
+        """DPRO (reference: PFR.py:241) — stored as the equivalent area
+        profile."""
+        d = np.asarray(diameter, dtype=np.double)
+        self.setprofile("AREA", x, np.pi * (d / 2.0) ** 2)
+
+    @property
+    def flowarea(self) -> float:
+        """Flow area [cm^2] (reference: PFR.py:270)."""
+        return self._flowarea
+
+    @flowarea.setter
+    def flowarea(self, area: float):
+        if area <= 0.0:
+            raise ValueError("flow area must be positive")
+        self._flowarea = float(area)
+        self.setkeyword("AREA", float(area))
+
+    def set_flowarea_profile(self, x, area):
+        """(reference: PFR.py:308)."""
+        self.setprofile("AREA", x, area)
+
+    @property
+    def momentum_equation(self) -> bool:
+        """Momentum equation toggle, ON by default
+        (reference: PFR.py:147)."""
+        return self._momentum
+
+    @momentum_equation.setter
+    def momentum_equation(self, on: bool):
+        self._momentum = bool(on)
+        self.setkeyword("MOMEN", bool(on))
+
+    def set_inlet_viscosity(self, visc: float):
+        """Accepted for deck parity (reference: PFR.py:338); the
+        frictionless momentum equation does not use it."""
+        self.setkeyword("VISC", float(visc))
+
+    def set_pseudo_surface_velocity(self, vel: float):
+        """Surface-chemistry option (reference: PFR.py:373); surface
+        mechanisms are unsupported — recorded only."""
+        self.setkeyword("PSV", float(vel))
+
+    # --- inlet passthroughs (reference: PFR.py:392-439) --------------------
+    @property
+    def mass_flowrate(self) -> float:
+        return self._mdot
+
+    @property
+    def inlet_velocity(self) -> float:
+        rho = self._condition.RHO
+        return self._mdot / (rho * self._flowarea)
+
+    @property
+    def vol_flowrate(self) -> float:
+        return self._mdot / self._condition.RHO
+
+    # --- solve -------------------------------------------------------------
+    def validate_inputs(self) -> int:
+        if not self._lengthset:
+            logger.error("reactor length is required (XEND)")
+            return 1
+        if self._mdot <= 0.0:
+            logger.error("inlet stream must carry a positive flow rate")
+            return 2
+        return 0
+
+    def run(self) -> int:
+        """March the plug-flow equations over the length
+        (reference: PFR.py:627)."""
+        if self.validate_inputs() != 0:
+            self.runstatus = STATUS_FAILED
+            return self.runstatus
+        self._numbsolutionpoints = 0
+        self._solution_rawarray = {}
+        self._solution_mixturearray = []
+        cond = self._condition
+        n_out = 101
+        if self._save_dt is not None:
+            n_out = max(int(round(self._length / self._save_dt)) + 1, 2)
+        sol = pfr_ops.solve_pfr(
+            self._effective_mech(), self.energy_type,
+            mdot=self._mdot, T0=cond.temperature, P0=cond.pressure,
+            Y0=cond.Y, length=self._length, area=self._flowarea,
+            x_start=self._x_start, n_out=n_out, rtol=self._rtol,
+            atol=self._atol, momentum=self._momentum,
+            area_profile=self._profile_or_none("AREA"),
+            t_profile=self._profile_or_none("TPRO"),
+            qloss_profile=self._profile_or_none("QPRO"),
+            htc=self._htc, tamb=self._tamb,
+            max_steps_per_segment=self._max_steps)
+        self._pfr_solution = jax.device_get(sol)
+        # ignition "delay" is the distance in cm (reference:
+        # batchreactor.py:623-640); stored unscaled in the ms slot
+        self._ignition_delay_ms = float(sol.ignition_distance)
+        ok = bool(sol.success)
+        self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        if not ok:
+            logger.error("PFR integration failed")
+        return self.runstatus
+
+    def get_ignition_delay(self) -> float:
+        """Ignition DISTANCE in cm for a PFR (reference:
+        batchreactor.py:623-640 reports distance, not time)."""
+        if self._pfr_solution is None:
+            logger.warning("reactor has not been run")
+            return np.nan
+        return float(self._pfr_solution.ignition_distance)
+
+    def process_solution(self):
+        """Axial profiles into the raw-array store (keys: distance,
+        temperature, pressure, velocity, plus species)."""
+        if self._pfr_solution is None:
+            raise RuntimeError("run() the reactor first")
+        sol = self._pfr_solution
+        self._numbsolutionpoints = len(sol.x)
+        raw = {
+            "distance": np.asarray(sol.x),
+            "time": np.asarray(sol.residence_time),
+            "temperature": np.asarray(sol.T),
+            "pressure": np.asarray(sol.P),
+            "velocity": np.asarray(sol.u),
+            "volume": np.asarray(1.0 / sol.rho),   # specific volume
+        }
+        Y = np.asarray(sol.Y)
+        for k, name in enumerate(self._specieslist):
+            raw[name] = Y[:, k]
+        self._solution_rawarray = raw
+        self._solution_Y = Y
+        return 0
+
+    @property
+    def exit_stream(self) -> Stream:
+        """Outlet stream at the last grid point."""
+        if self._pfr_solution is None:
+            raise RuntimeError("run() the reactor first")
+        sol = self._pfr_solution
+        out = Stream(self.chemistry, label=f"{self.label}-exit")
+        out.temperature = float(sol.T[-1])
+        out.pressure = float(sol.P[-1])
+        out.Y = np.asarray(sol.Y[-1])
+        out.mass_flowrate = self._mdot
+        return out
+
+
+class PlugFlowReactor_EnergyConservation(PlugFlowReactor):
+    """PFR with the energy equation (reference: PFR.py:730). Inherits the
+    wall-heat-transfer property surface of the ENRG batch family
+    (heat_loss_rate / heat_transfer_coefficient / ambient_temperature —
+    reference: PFR.py:797-960)."""
+
+    energy_type = "ENRG"
+
+    # heat-transfer surface identical to the batch ENRG variants
+    @property
+    def heat_loss_rate(self) -> float:
+        """QLOS per unit length [erg/(cm s)]."""
+        return self._qloss
+
+    @heat_loss_rate.setter
+    def heat_loss_rate(self, value: float):
+        self._qloss = float(value)
+        self._record_keyword("QLOS", float(value))
+        self.setprofile("QPRO", [0.0, 1e12], [value, value])
+
+    @property
+    def heat_transfer_coefficient(self) -> float:
+        return self._htc
+
+    @heat_transfer_coefficient.setter
+    def heat_transfer_coefficient(self, value: float = 0.0):
+        if value < 0.0:
+            raise ValueError("heat transfer coefficient must be >= 0")
+        self._htc = float(value)
+        self._record_keyword("HTC", float(value))
+
+    @property
+    def ambient_temperature(self) -> float:
+        return self._tamb
+
+    @ambient_temperature.setter
+    def ambient_temperature(self, value: float = 0.0):
+        if value <= 0.0:
+            raise ValueError("ambient temperature must be positive")
+        self._tamb = float(value)
+        self._record_keyword("TAMB", float(value))
+
+    def set_velocity_profile(self, x, velocity):
+        """Accepted for deck parity (reference: PFR.py:961); velocity
+        follows from continuity+momentum here."""
+        self.setprofile("VPROX", x, velocity)
+
+
+class PlugFlowReactor_FixedTemperature(PlugFlowReactor):
+    """PFR with prescribed T(x) (reference: PFR.py:983)."""
+
+    energy_type = "TGIV"
+
+    def set_temperature_profile(self, x, temperature):
+        """T(x) profile over distance (reference: PFR.py:1048)."""
+        self.setprofile("TPRO", x, temperature)

@@ -1,0 +1,202 @@
+"""Plug-flow-reactor physics (JAX): stiff marching along reactor length.
+
+TPU-native replacement for the reference's native PFR path
+(``KINAll0D_SetupPFRInputs`` + ``KINAll0D_Calculate``, reference:
+flowreactors/PFR.py:498/:627-729): the steady 1-D plug-flow equations
+integrated in distance x with the same SDIRK3 stiff integrator the batch
+reactors use (the independent variable is x instead of t), jit/vmap-safe
+for batched sweeps over inlet conditions.
+
+Governing equations (CGS; mass flux mdot = rho u A conserved):
+  species:    rho u dY_k/dx = wdot_k W_k
+  energy:     rho u (cp dT/dx + u du/dx) = -sum_k h_k wdot_k W_k + q'(x)
+  momentum:   rho u du/dx = -dP/dx          (ON by default, PFR.py:147)
+  state:      P = rho R T / Wbar,  rho = mdot/(u A(x))
+Momentum ON: (dT/dx, du/dx) come from the 2x2 linear system obtained by
+substituting d lnP/dx = d lnT/dx - d lnu/dx - d lnA/dx - d lnWbar/dx.
+Momentum OFF: P is held at the inlet value and u follows continuity.
+TGIV: T(x) follows its profile; only species (+u) are integrated.
+
+Residence time is tracked as an extra state (dt_res/dx = 1/u), matching
+the reference's residence-time output (PFR.py:143). The ignition "delay"
+of a PFR is a DISTANCE in cm (reference: batchreactor.py:623-640).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import R_GAS
+from . import kinetics, thermo
+from .odeint import Event, odeint
+from .reactors import Profile, constant_profile, profile_value_slope
+
+_TINY = 1e-30
+
+
+class PFRArgs(NamedTuple):
+    mech: Any
+    mdot: Any        # mass flow rate, g/s
+    area: Profile    # flow area A(x), cm^2
+    tprof: Profile   # T(x) for TGIV
+    qloss: Profile   # heat-loss rate per unit length, erg/(cm s)
+    htc: Any         # wall heat-transfer coefficient, erg/(cm^2 K s)
+    tamb: Any        # ambient temperature, K
+    momentum: Any    # bool (static via closure)
+
+
+def _perimeter(A):
+    """Circular-duct perimeter from area."""
+    return jnp.sqrt(4.0 * jnp.pi * jnp.maximum(A, _TINY))
+
+
+def make_pfr_rhs(energy: str, momentum: bool):
+    """RHS d[Y, T, u, t_res]/dx. ``energy``: "ENRG" | "TGIV"."""
+
+    def rhs(x, y, args: PFRArgs):
+        mech = args.mech
+        KK = mech.n_species
+        Y = y[:KK]
+        T = jnp.maximum(y[KK], 50.0)
+        u = jnp.maximum(y[KK + 1], 1e-6)
+        A, dAdx = profile_value_slope(args.area, x)
+        if energy == "TGIV":
+            T, dTdx_given = profile_value_slope(args.tprof, x)
+
+        rho = args.mdot / (u * A)
+        wbar = thermo.mean_molecular_weight_Y(mech, Y)
+        P = rho * R_GAS * T / wbar
+        C = thermo.Y_to_C(mech, Y, rho)
+        wdot = kinetics.net_production_rates(mech, T, C, P)
+
+        dY = wdot * mech.wt / (rho * u)                       # [KK]
+        dlnWbar = -wbar * jnp.dot(dY, 1.0 / mech.wt)
+        dlnA = dAdx / jnp.maximum(A, _TINY)
+
+        ql, _ = profile_value_slope(args.qloss, x)
+        q_len = -ql + args.htc * _perimeter(A) * (args.tamb - T)
+        h_k = thermo.species_enthalpy_mass(mech, T)
+        S_h = (-jnp.dot(h_k, wdot * mech.wt) + q_len / A) / (rho * u)
+        cp = thermo.mixture_cp_mass(mech, T, Y)
+
+        if energy == "TGIV":
+            dT = dTdx_given
+            if momentum:
+                # momentum alone fixes du/dx given dT/dx
+                # (rho u - P/u) u' = P (dlnA + dlnWbar - dlnT)
+                dlnT = dT / T
+                denom = rho * u - P / u
+                denom = jnp.where(jnp.abs(denom) > _TINY, denom,
+                                  jnp.sign(denom) * _TINY + _TINY)
+                du = P * (dlnA + dlnWbar - dlnT) / denom
+            else:
+                # constant P: dln rho = dlnWbar - dlnT, and continuity
+                # u = mdot/(rho A) gives dlnu = dlnT - dlnWbar - dlnA
+                du = u * (dT / T - dlnWbar - dlnA)
+        else:
+            if momentum:
+                # | cp      u            | |dT|   | S_h                    |
+                # | P/T   rho u - P/u    | |du| = | P (dlnA + dlnWbar)     |
+                a11, a12 = cp, u
+                a21, a22 = P / T, rho * u - P / u
+                b1 = S_h
+                b2 = P * (dlnA + dlnWbar)
+                det = a11 * a22 - a12 * a21
+                det = jnp.where(jnp.abs(det) > _TINY, det, _TINY)
+                dT = (b1 * a22 - a12 * b2) / det
+                du = (a11 * b2 - a21 * b1) / det
+            else:
+                dT = S_h / cp
+                # constant P + continuity: dlnu = dlnT - dlnWbar - dlnA
+                du = u * (dT / T - dlnWbar - dlnA)
+
+        dtres = 1.0 / u
+        if energy == "TGIV":
+            dT_state = dTdx_given
+        else:
+            dT_state = dT
+        return jnp.concatenate([dY, jnp.stack([dT_state, du, dtres])])
+
+    return rhs
+
+
+class PFRSolution(NamedTuple):
+    x: Any             # [n_out] axial positions, cm
+    T: Any
+    P: Any
+    u: Any             # velocity, cm/s
+    rho: Any
+    Y: Any             # [n_out, KK]
+    residence_time: Any  # [n_out] cumulative, s
+    ignition_distance: Any  # cm (nan if none)
+    n_steps: Any
+    success: Any
+
+
+def solve_pfr(mech, energy, *, mdot, T0, P0, Y0, length, area=1.0,
+              x_start=0.0, n_out=101, rtol=1e-6, atol=1e-12,
+              momentum=True, area_profile=None, t_profile=None,
+              qloss_profile=None, htc=0.0, tamb=298.15,
+              max_steps_per_segment=20_000):
+    """Integrate a plug-flow reactor from x_start to x_start+length.
+
+    jit/vmap-safe core of the reference's ``PlugFlowReactor.run()``
+    (PFR.py:627). The inlet velocity follows from continuity:
+    u0 = mdot / (rho0 A(x_start)).
+    """
+    dtype = jnp.float64
+    Y0 = jnp.asarray(Y0, dtype)
+    T0 = jnp.asarray(T0, dtype)
+    P0 = jnp.asarray(P0, dtype)
+    if area_profile is None:
+        area_profile = constant_profile(area)
+    if t_profile is None:
+        t_profile = constant_profile(T0)
+    if qloss_profile is None:
+        qloss_profile = constant_profile(0.0)
+
+    A0, _ = profile_value_slope(area_profile, jnp.asarray(x_start))
+    rho0 = thermo.density(mech, T0, P0, Y0)
+    u0 = mdot / (rho0 * A0)
+
+    args = PFRArgs(mech=mech, mdot=jnp.asarray(mdot, dtype),
+                   area=area_profile, tprof=t_profile,
+                   qloss=qloss_profile, htc=jnp.asarray(htc, dtype),
+                   tamb=jnp.asarray(tamb, dtype), momentum=momentum)
+    rhs = make_pfr_rhs(energy, momentum)
+
+    y0 = jnp.concatenate([Y0, jnp.stack([T0, u0, jnp.asarray(0.0, dtype)])])
+    xs = jnp.linspace(x_start, x_start + length, n_out)
+    KK = mech.n_species
+    atol_vec = jnp.full(y0.shape, atol, dtype=dtype)
+    atol_vec = atol_vec.at[KK].set(jnp.maximum(atol * 1e6, 1e-8))    # T
+    atol_vec = atol_vec.at[KK + 1].set(jnp.maximum(atol * 1e6, 1e-8))  # u
+    atol_vec = atol_vec.at[KK + 2].set(jnp.maximum(atol * 1e6, 1e-10))
+
+    # ignition position: peak dT/dx (reference reports PFR ignition as a
+    # distance, batchreactor.py:623-640)
+    events = (Event(fn=lambda x, y, f: f[KK], kind="max"),)
+
+    sol = odeint(rhs, y0, xs, args, rtol=rtol, atol=atol_vec, events=events,
+                 max_steps_per_segment=max_steps_per_segment)
+
+    Ys = sol.ys[:, :KK]
+    Ts = sol.ys[:, KK]
+    us = sol.ys[:, KK + 1]
+    tres = sol.ys[:, KK + 2]
+    if energy == "TGIV":
+        Ts = jax.vmap(lambda x: profile_value_slope(t_profile, x)[0])(xs)
+    As = jax.vmap(lambda x: profile_value_slope(area_profile, x)[0])(xs)
+    rhos = args.mdot / (us * As)
+    wbars = jax.vmap(lambda Y: thermo.mean_molecular_weight_Y(mech, Y))(Ys)
+    Ps = rhos * R_GAS * Ts / wbars
+
+    ign_x = sol.event_times[0]
+    ign_x = jnp.where(sol.event_values[0] >= 1.0, ign_x, jnp.nan)
+
+    return PFRSolution(x=xs, T=Ts, P=Ps, u=us, rho=rhos, Y=Ys,
+                       residence_time=tres, ignition_distance=ign_x,
+                       n_steps=sol.n_steps, success=sol.success)
