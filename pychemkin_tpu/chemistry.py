@@ -277,28 +277,37 @@ class Chemistry:
         return np.asarray(self._require_mech().wt)
 
     # --- species thermodynamic properties (chemistry.py:1069-1314) ---------
+    # The reference returns MOLAR units from these (it converts the native
+    # library's mass-based values by multiplying with WT — chemistry.py:1124
+    # "convert [ergs/g-K] to [ergs/mol-K]"). The mass-based kernels stay
+    # internal (ops.thermo); the API boundary is molar.
     def SpeciesCp(self, temp: float) -> np.ndarray:
-        """Species specific heats Cp [KK] at ``temp``, erg/(g K)
-        (reference: chemistry.py:1069)."""
-        return np.asarray(thermo.species_cp_mass(self._require_mech(),
-                                                 float(temp)))
+        """Species specific heats Cp [KK] at ``temp``, erg/(mol K)
+        (reference: chemistry.py:1069, molar conversion :1124)."""
+        mech = self._require_mech()
+        return np.asarray(thermo.species_cp_mass(mech, float(temp))) \
+            * np.asarray(mech.wt)
 
     def SpeciesCv(self, temp: float) -> np.ndarray:
-        """Species Cv [KK], erg/(g K) (reference: chemistry.py:1137)."""
-        return np.asarray(thermo.species_cv_mass(self._require_mech(),
-                                                 float(temp)))
+        """Species Cv [KK], erg/(mol K) (reference: chemistry.py:1137)."""
+        mech = self._require_mech()
+        return np.asarray(thermo.species_cv_mass(mech, float(temp))) \
+            * np.asarray(mech.wt)
 
     def SpeciesH(self, temp: float) -> np.ndarray:
-        """Species enthalpies [KK], erg/g (reference: chemistry.py:1176)."""
-        return np.asarray(thermo.species_enthalpy_mass(self._require_mech(),
-                                                       float(temp)))
+        """Species enthalpies [KK], erg/mol
+        (reference: chemistry.py:1176)."""
+        mech = self._require_mech()
+        return np.asarray(thermo.species_enthalpy_mass(mech, float(temp))) \
+            * np.asarray(mech.wt)
 
     def SpeciesU(self, temp: float) -> np.ndarray:
-        """Species internal energies [KK], erg/g
+        """Species internal energies [KK], erg/mol
         (reference: chemistry.py:1243)."""
+        mech = self._require_mech()
         return np.asarray(
-            thermo.species_internal_energy_mass(self._require_mech(),
-                                                float(temp)))
+            thermo.species_internal_energy_mass(mech, float(temp))) \
+            * np.asarray(mech.wt)
 
     # --- species transport properties (chemistry.py:1316-1471) -------------
     def _require_transport(self) -> MechanismRecord:

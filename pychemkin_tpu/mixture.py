@@ -379,30 +379,29 @@ class Mixture:
         (reference: mixture.py:1569)."""
         return equilibrium(self, opt=1)
 
-    # --- instance property shortcuts (reference: mixture.py:1599-2217) -----
-    @property
+    # --- instance accessor methods (reference: mixture.py:1599-2217) -------
+    # These are plain METHODS in the reference (no @property) — user code
+    # calls mix.HML(), mix.ROP(), etc.; exposing them as properties would
+    # break every ported script with "'float' object is not callable".
     def HML(self) -> float:
         """Mixture molar enthalpy [erg/mol] (reference: mixture.py:1599)."""
         self._require_state(need_P=False)
         return float(thermo.mixture_enthalpy_molar(
             self.mech, self._T, jnp.asarray(self.X)))
 
-    @property
     def CPBL(self) -> float:
         """Mixture molar Cp [erg/(mol K)] (reference: mixture.py:1646)."""
         self._require_state(need_P=False)
         return float(thermo.mixture_cp_molar(self.mech, self._T,
                                              jnp.asarray(self.X)))
 
-    @property
     def ROP(self) -> np.ndarray:
-        """Net production rates at this state (reference:
-        mixture.py:1693)."""
+        """Net production rates at this state, mol/(cm^3 s)
+        (reference: mixture.py:1693)."""
         self._require_state()
         return np.asarray(kinetics.rop(self.mech, self._T, self._P,
                                        jnp.asarray(self.Y)))
 
-    @property
     def RxnRates(self) -> Tuple[np.ndarray, np.ndarray]:
         """(qf, qr) at this state (reference: mixture.py:1748)."""
         self._require_state()
@@ -410,33 +409,32 @@ class Mixture:
                                          jnp.asarray(self.Y))
         return np.asarray(qf), np.asarray(qr)
 
-    @property
     def species_Cp(self) -> np.ndarray:
-        """[KK] erg/(g K) at this T (reference: mixture.py:1810)."""
+        """[KK] erg/(mol K) at this T (reference: mixture.py:1810 — molar,
+        converted from the mass-based kernel by WT exactly as the reference
+        converts the native library's values)."""
         self._require_state(need_P=False, need_comp=False)
-        return np.asarray(thermo.species_cp_mass(self.mech, self._T))
+        return np.asarray(thermo.species_cp_mass(self.mech, self._T)) \
+            * self.WT
 
-    @property
     def species_H(self) -> np.ndarray:
-        """[KK] erg/g at this T (reference: mixture.py:1837)."""
+        """[KK] erg/mol at this T (reference: mixture.py:1837)."""
         self._require_state(need_P=False, need_comp=False)
-        return np.asarray(thermo.species_enthalpy_mass(self.mech, self._T))
+        return np.asarray(thermo.species_enthalpy_mass(self.mech, self._T)) \
+            * self.WT
 
-    @property
     def species_Visc(self) -> np.ndarray:
         """[KK] g/(cm s) at this T (reference: mixture.py:1860)."""
         self._require_state(need_P=False, need_comp=False)
         return np.asarray(transport.species_viscosities(
             self._transport_mech(), self._T))
 
-    @property
     def species_Cond(self) -> np.ndarray:
         """[KK] erg/(cm K s) (reference: mixture.py:1885)."""
         self._require_state(need_P=False, need_comp=False)
         return np.asarray(transport.species_conductivities(
             self._transport_mech(), self._T))
 
-    @property
     def species_Diffusion_Coeffs(self) -> np.ndarray:
         """Binary diffusion matrix [KK, KK], cm^2/s (reference:
         mixture.py:1910)."""
@@ -444,7 +442,6 @@ class Mixture:
         return np.asarray(transport.binary_diffusion_coefficients(
             self._transport_mech(), self._T, self._P))
 
-    @property
     def mixture_viscosity(self) -> float:
         """Mixture-averaged viscosity [g/(cm s)] (reference:
         mixture.py:1943)."""
@@ -452,7 +449,6 @@ class Mixture:
         return float(transport.mixture_viscosity(
             self._transport_mech(), self._T, jnp.asarray(self.X)))
 
-    @property
     def mixture_conductivity(self) -> float:
         """Mixture-averaged conductivity [erg/(cm K s)] (reference:
         mixture.py:1979)."""
@@ -460,7 +456,6 @@ class Mixture:
         return float(transport.mixture_conductivity(
             self._transport_mech(), self._T, jnp.asarray(self.X)))
 
-    @property
     def mixture_diffusion_coeffs(self) -> np.ndarray:
         """Mixture-averaged diffusion coefficients [KK], cm^2/s
         (reference: mixture.py:2015)."""
@@ -468,28 +463,26 @@ class Mixture:
         return np.asarray(transport.mixture_diffusion_coefficients(
             self._transport_mech(), self._T, self._P, jnp.asarray(self.X)))
 
-    @property
     def mixture_binary_diffusion_coeffs(self) -> np.ndarray:
         """Binary diffusion matrix at this state (reference:
         mixture.py:2066)."""
-        return self.species_Diffusion_Coeffs
+        return self.species_Diffusion_Coeffs()
 
-    @property
     def mixture_thermal_diffusion_coeffs(self) -> np.ndarray:
         """Thermal diffusion ratios [KK] (reference: mixture.py:2119)."""
         self._require_state(need_P=False)
         return np.asarray(transport.thermal_diffusion_ratios(
             self._transport_mech(), self._T, jnp.asarray(self.X)))
 
-    @property
     def volHRR(self) -> float:
         """Volumetric heat release rate [erg/(cm^3 s)]
-        (reference: mixture.py:2172)."""
+        (reference: mixture.py:2172): volHRR = +sum_k H_k(molar) * ROP_k,
+        the reference's exact dot product — negative while an exothermic
+        mixture is releasing heat."""
         self._require_state()
         return float(kinetics.volumetric_heat_release_rate(
             self.mech, self._T, self._P, jnp.asarray(self.Y)))
 
-    @property
     def massROP(self) -> np.ndarray:
         """Mass production rates [g/(cm^3 s)] (reference:
         mixture.py:2204)."""
@@ -500,7 +493,7 @@ class Mixture:
     def list_ROP(self, bound: float = 0.0):
         """Print nonzero net production rates (reference:
         mixture.py:2219)."""
-        rop = self.ROP
+        rop = self.ROP()
         names = self.species_symbols
         for k in np.argsort(np.abs(rop))[::-1]:
             if abs(rop[k]) > bound:
@@ -508,7 +501,7 @@ class Mixture:
 
     def list_massROP(self, bound: float = 0.0):
         """(reference: mixture.py:2272)."""
-        rop = self.massROP
+        rop = self.massROP()
         names = self.species_symbols
         for k in np.argsort(np.abs(rop))[::-1]:
             if abs(rop[k]) > bound:
@@ -516,7 +509,7 @@ class Mixture:
 
     def list_reaction_rates(self, bound: float = 0.0):
         """(reference: mixture.py:2325)."""
-        qf, qr = self.RxnRates
+        qf, qr = self.RxnRates()
         for i in range(len(qf)):
             if abs(qf[i] - qr[i]) > bound:
                 print(f"  rxn {i + 1:<5d} qf={qf[i]: .4e} qr={qr[i]: .4e}")

@@ -112,6 +112,18 @@ class PlugFlowReactor(BatchReactors):
         self._momentum = bool(on)
         self.setkeyword("MOMEN", bool(on))
 
+    def set_volume_profile(self, time, volume):
+        """Batch-only profile — meaningless for a PFR; fail loudly instead
+        of being silently ignored by the PFR solve."""
+        raise NotImplementedError("a PFR has no volume profile; use the "
+                                  "area/diameter profiles")
+
+    def set_pressure_profile(self, time, pressure):
+        """Batch-only profile — PFR pressure follows the momentum
+        equation (or stays at the inlet value with momentum off)."""
+        raise NotImplementedError("a PFR has no pressure profile; pressure "
+                                  "comes from the momentum equation")
+
     def set_inlet_viscosity(self, visc: float):
         """Accepted for deck parity (reference: PFR.py:338); the
         frictionless momentum equation does not use it."""
@@ -209,6 +221,57 @@ class PlugFlowReactor(BatchReactors):
         self._solution_rawarray = raw
         self._solution_Y = Y
         return 0
+
+    def run_sweep(self, T0s=None, P0s=None, Y0s=None, lengths=None, *,
+                  min_slope=1.0):
+        """Batched PFR sweep over inlet conditions (vmap over
+        :func:`pychemkin_tpu.ops.pfr.solve_pfr`).
+
+        Overrides the batch-reactor sweep, whose solver table has no PFR
+        entry — inheriting it would crash with a bare KeyError. Any
+        argument left None takes this reactor's configured value.
+        Returns (ignition_distances_cm [B], success [B])."""
+        if self.validate_inputs() != 0:
+            raise ValueError("PFR is not fully configured (length, inlet)")
+        cond = self._condition
+        if T0s is None:
+            T0s = np.asarray([cond.temperature])
+        if P0s is None:
+            P0s = cond.pressure
+        if Y0s is None:
+            Y0s = cond.Y
+        if lengths is None:
+            lengths = self._length
+
+        sizes = [np.asarray(a).shape[0] for a in (T0s, P0s, lengths)
+                 if np.asarray(a).ndim > 0]
+        if np.asarray(Y0s).ndim > 1:
+            sizes.append(np.asarray(Y0s).shape[0])
+        B = max(sizes) if sizes else 1
+        T0s = jnp.broadcast_to(jnp.asarray(T0s, jnp.float64), (B,))
+        P0s = jnp.broadcast_to(jnp.asarray(P0s, jnp.float64), (B,))
+        KK = np.asarray(Y0s).shape[-1]
+        Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64), (B, KK))
+        lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.float64), (B,))
+
+        mech = self._effective_mech()
+
+        def one(T0, P0, Y0, length):
+            sol = pfr_ops.solve_pfr(
+                mech, self.energy_type, mdot=self._mdot, T0=T0, P0=P0,
+                Y0=Y0, length=length, area=self._flowarea,
+                x_start=self._x_start, n_out=2, rtol=self._rtol,
+                atol=self._atol, momentum=self._momentum,
+                area_profile=self._profile_or_none("AREA"),
+                t_profile=self._profile_or_none("TPRO"),
+                qloss_profile=self._profile_or_none("QPRO"),
+                htc=self._htc, tamb=self._tamb,
+                max_steps_per_segment=self._max_steps,
+                min_slope=min_slope)
+            return sol.ignition_distance, sol.success
+
+        dists, ok = jax.vmap(one)(T0s, P0s, Y0s, lengths)
+        return np.asarray(dists), np.asarray(ok)
 
     @property
     def exit_stream(self) -> Stream:

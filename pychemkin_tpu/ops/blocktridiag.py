@@ -1,0 +1,53 @@
+"""Block-tridiagonal linear solves (JAX) for 1-D flame Newton systems.
+
+The discretized steady flame equations couple each grid point only to its
+two neighbors, so the Newton matrix is block tridiagonal with [M, M]
+blocks (M = KK + 2 unknowns per point). The reference solves this inside
+the licensed Fortran TWOPNT core (SURVEY.md §2.2, Premix block); here it
+is a block Thomas factorization expressed as ``lax.scan`` over the grid
+axis — the per-step [M, M] factor/solve ops batch cleanly under vmap and
+keep memory at O(N M^2) instead of the O(N^2 M^2) dense matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import linalg
+
+
+def solve(B, A, C, d):
+    """Solve the block-tridiagonal system
+
+        B_i x_{i-1} + A_i x_i + C_i x_{i+1} = d_i,   i = 0..N-1
+
+    with B_0 = C_{N-1} = 0 (their entries are ignored).
+
+    Shapes: B, A, C are [N, M, M]; d is [N, M]. Returns x [N, M].
+    """
+    N = A.shape[0]
+
+    def fwd(carry, inp):
+        Cp_prev, dp_prev = carry
+        A_i, B_i, C_i, d_i = inp
+        Ahat = A_i - B_i @ Cp_prev
+        fac = linalg.factor(Ahat)
+        # solve for the modified upper block and RHS in one pass
+        Cp = linalg.solve_factored(fac, C_i)
+        dp = linalg.solve_factored(fac, d_i - B_i @ dp_prev)
+        return (Cp, dp), (Cp, dp)
+
+    M = A.shape[1]
+    zero_blk = jnp.zeros((M, M), dtype=A.dtype)
+    zero_vec = jnp.zeros((M,), dtype=A.dtype)
+    (_, _), (Cps, dps) = jax.lax.scan(fwd, (zero_blk, zero_vec),
+                                      (A, B, C, d))
+
+    def bwd(x_next, inp):
+        Cp_i, dp_i = inp
+        x_i = dp_i - Cp_i @ x_next
+        return x_i, x_i
+
+    _, xs_rev = jax.lax.scan(bwd, zero_vec, (Cps, dps), reverse=True)
+    return xs_rev

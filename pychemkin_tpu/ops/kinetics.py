@@ -257,10 +257,12 @@ def reaction_rates(mech, T, P, Y):
 
 def volumetric_heat_release_rate(mech, T, P, Y):
     """Volumetric heat release rate [erg/(cm^3 s)] (reference volHRR,
-    mixture.py:2172): -sum_k h_k(molar) * omega_dot_k."""
+    mixture.py:2201): +sum_k h_k(molar) * omega_dot_k — the reference's
+    sign convention (negative while an exothermic mixture releases
+    heat)."""
     wdot = rop(mech, T, P, Y)
     h_molar = thermo.h_RT(mech, T) * R_GAS * T
-    return -jnp.dot(h_molar, wdot)
+    return jnp.dot(h_molar, wdot)
 
 
 def mass_production_rates(mech, T, P, Y):

@@ -140,12 +140,16 @@ def solve_pfr(mech, energy, *, mdot, T0, P0, Y0, length, area=1.0,
               x_start=0.0, n_out=101, rtol=1e-6, atol=1e-12,
               momentum=True, area_profile=None, t_profile=None,
               qloss_profile=None, htc=0.0, tamb=298.15,
-              max_steps_per_segment=20_000):
+              max_steps_per_segment=20_000, min_slope=1.0):
     """Integrate a plug-flow reactor from x_start to x_start+length.
 
     jit/vmap-safe core of the reference's ``PlugFlowReactor.run()``
     (PFR.py:627). The inlet velocity follows from continuity:
     u0 = mdot / (rho0 A(x_start)).
+
+    ``min_slope`` [K/cm]: a peak dT/dx below it is slow oxidation, not
+    ignition, and the ignition distance is reported as nan (mirrors the
+    batch path's configurable ``min_slope``).
     """
     dtype = jnp.float64
     Y0 = jnp.asarray(Y0, dtype)
@@ -195,7 +199,7 @@ def solve_pfr(mech, energy, *, mdot, T0, P0, Y0, length, area=1.0,
     Ps = rhos * R_GAS * Ts / wbars
 
     ign_x = sol.event_times[0]
-    ign_x = jnp.where(sol.event_values[0] >= 1.0, ign_x, jnp.nan)
+    ign_x = jnp.where(sol.event_values[0] >= min_slope, ign_x, jnp.nan)
 
     return PFRSolution(x=xs, T=Ts, P=Ps, u=us, rho=rhos, Y=Ys,
                        residence_time=tres, ignition_distance=ign_x,

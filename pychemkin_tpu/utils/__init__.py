@@ -1,0 +1,5 @@
+"""Utility subpackage: compilation-cache management."""
+
+from .cache import enable_compilation_cache
+
+__all__ = ["enable_compilation_cache"]

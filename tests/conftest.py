@@ -23,3 +23,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# persistent XLA compilation cache: the suite's runtime is dominated by
+# compiles; warm-cache reruns are several times faster
+from pychemkin_tpu.utils import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
