@@ -14,6 +14,13 @@ Fortran library. Here the same algorithm is built from JAX pieces:
   is T(x_fix) - T_fix = 0 (the classical PREMIX formulation — it keeps
   the Jacobian block tridiagonal). Flame speed = Mdot / rho_unburnt
   (reference premixedflame.py:605 GetFlameMassFlux -> :1004).
+- Residual rows are expressed in TIME-DERIVATIVE form — the energy row
+  is divided by rho*cp (units K/s) and the species rows by rho (1/s).
+  In raw CGS the energy row is ~1e11 erg/cm^3-s while species rows are
+  O(1) g/cm^3-s, which makes the unscaled Newton matrix condition-number
+  ~1e23 and the unpivoted block-Thomas elimination numerically singular;
+  the per-second scaling brings all rows within a few decades and is
+  also exactly the backward-Euler form the pseudo-transient needs.
 - Residual is assembled per point from a 3-point stencil; the Jacobian
   blocks come from ``jax.jacfwd`` of the stencil function vmapped over
   the grid — 3M-wide tangents instead of the N*M dense matrix.
@@ -21,6 +28,11 @@ Fortran library. Here the same algorithm is built from JAX pieces:
   Newton step shrinks — the Jacobian is already factored, so the probe
   solve is cheap), with a backward-Euler pseudo-transient fallback using
   the same machinery (steadystatesolver.py:40-99 defaults).
+- The solve is STAGED like the reference Premix run (premixedflame.py:957
+  ``skip_fix_T_solution`` — the fixed-temperature intermediate solution
+  is the default): first a given-temperature burner solve relaxes the
+  species profiles on the initial ramp, then the full energy + eigenvalue
+  problem starts from that solution.
 - Adaptive regridding happens OUTSIDE jit (grid.py:201 GRAD/CURV
   semantics); each grid size compiles once and the persistent
   compilation cache amortizes repeats.
@@ -34,13 +46,12 @@ reference flame.py:134.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..constants import R_GAS
 from . import blocktridiag, kinetics, thermo, transport
 from . import equilibrium as eq_ops
 
@@ -129,8 +140,9 @@ def _face(mech, cfg: FlameConfig, P, u_l, u_r, x_l, x_r):
 def make_residual(mech, cfg: FlameConfig):
     """Build residual_fn(u [N, M], data) -> F [N, M] and its
     block-Jacobian companion. Residual rows are ordered like u:
-    [energy/T-row, continuity/M-row, species rows]."""
-    KK = mech.n_species
+    [energy/T-row, continuity/M-row, species rows]. The T row is in K/s
+    and the Y rows in 1/s (see module docstring: this row scaling is what
+    makes the block-Thomas elimination well-conditioned)."""
 
     def interior(i, u_m, u_c, u_p, x_m, x_c, x_p, data: FlameData):
         T_c, M_c, Y_c = unpack(u_c)
@@ -154,10 +166,10 @@ def make_residual(mech, cfg: FlameConfig):
             dTdx = (T_p - T_m) / (x_p - x_m)
             dYdx = (Y_p - Y_m) / (x_p - x_m)
 
-        # species: M dY/dx + d(j)/dx - wdot W = 0
-        F_Y = M_c * dYdx + (j_r - j_l) / dxc - wdot * mech.wt
+        # species: (M dY/dx + d(j)/dx - wdot W) / rho = 0   [1/s]
+        F_Y = (M_c * dYdx + (j_r - j_l) / dxc - wdot * mech.wt) / rho
 
-        # energy
+        # energy [K/s]
         if cfg.energy == "TGIV":
             F_T = T_c - data.T_given[i]
         else:
@@ -168,7 +180,7 @@ def make_residual(mech, cfg: FlameConfig):
             F_T = (M_c * cp * dTdx
                    + (q_r - q_l) / dxc
                    + jnp.dot(j_avg, cp_k) * dTdx
-                   + jnp.dot(h_k, wdot * mech.wt))
+                   + jnp.dot(h_k, wdot * mech.wt)) / (rho * cp)
 
         # continuity / eigenvalue
         if cfg.free_flame:
@@ -186,9 +198,10 @@ def make_residual(mech, cfg: FlameConfig):
         T_0, M_0, Y_0 = unpack(u_0)
         F_T = T_0 - data.T_in
         if cfg.species_flux_bc:
-            # flux balance: M (Y_k - Y_k,in) + j_k = 0 at the inlet face
+            # flux balance: M (Y_k - Y_k,in) + j_k = 0 at the inlet face,
+            # scaled by 1/M so the row is O(Y) like the other species rows
             _, j_r = _face(mech, cfg, data.P, u_0, u_1, x_0, x_1)
-            F_Y = M_0 * (Y_0 - data.Y_in) + j_r
+            F_Y = (Y_0 - data.Y_in) + j_r / jnp.maximum(M_0, _M_MIN)
         else:
             F_Y = Y_0 - data.Y_in
         if cfg.free_flame:
@@ -258,14 +271,69 @@ def _clip_state(u):
                 jnp.clip(Y, _Y_FLOOR, 1.0))
 
 
-def make_newton(mech, cfg: FlameConfig, transient_coeff=None):
+#: per-iteration caps: max temperature change [K] and max relative change
+#: of the mass-flux eigenvalue — the classical TWOPNT-style trust limits
+#: that keep the eigenvalue from running away on an inconsistent guess
+_DT_CAP = 250.0
+_DM_REL_CAP = 0.5
+_M_MAX = 1.0e3
+
+
+def _lambda_bound(u, du):
+    """Largest damping factor that keeps u + lam*du inside the physical
+    bounds AND within the per-iteration trust caps. Clipping the state
+    AFTER a full step (the previous policy) destroys the Newton direction
+    — the state slams into the T=5000 K wall and the iteration wanders;
+    bounding lam preserves the direction."""
+    T, M, Y = unpack(u)
+    dT, dM, dY = unpack(du)
+    big = jnp.asarray(1e30, dtype=u.dtype)
+
+    def ratio(uv, dv, lo, hi):
+        # components already parked AT a bound (headroom ~ 0) moving
+        # outward are excluded — _clip_state absorbs them; including
+        # them would return lam ~ 0 and wedge the whole iteration
+        eps = 1e-9 * (hi - lo)
+        head_hi = hi - uv
+        head_lo = uv - lo
+        r_hi = jnp.where((dv > 0) & (head_hi > eps),
+                         head_hi / jnp.where(dv > 0, dv, 1.0), big)
+        r_lo = jnp.where((dv < 0) & (head_lo > eps),
+                         -head_lo / jnp.where(dv < 0, dv, -1.0), big)
+        return jnp.minimum(r_hi, r_lo)
+
+    lam = jnp.minimum(jnp.min(ratio(T, dT, _T_MIN, _T_MAX)),
+                      jnp.min(ratio(Y, dY, _Y_FLOOR, 1.0)))
+    lam = jnp.minimum(lam, jnp.min(ratio(M, dM, _M_MIN, _M_MAX)))
+    lam = jnp.minimum(lam, _DT_CAP / jnp.maximum(jnp.max(jnp.abs(dT)),
+                                                 1e-300))
+    rel_M = jnp.max(jnp.abs(dM) / (jnp.abs(M) + 1e-6))
+    lam = jnp.minimum(lam, _DM_REL_CAP / jnp.maximum(rel_M, 1e-300))
+    return jnp.clip(lam, 1e-6, 1.0)
+
+
+def make_newton(mech, cfg: FlameConfig, transient=False):
     """Damped-Newton solver over a fixed grid (jit-able per grid size).
 
-    ``transient_coeff(u, data) -> [N, M]``: when given, solves the
-    backward-Euler system F(u) + c*(u - u_old)/dt = 0 instead (the
-    pseudo-transient fallback; c = rho for species rows, rho*cp for the
-    energy row, 0 for algebraic rows)."""
+    With ``transient=True`` the solver handles the backward-Euler system
+    F(u) + c*(u - u_old)/dt = 0 instead (the pseudo-transient fallback),
+    where c is 1 for the differential rows (T when ENRG, all Y) and 0 for
+    the algebraic rows (M/eigenvalue, and T under TGIV) — the residual's
+    per-second row scaling makes these coefficients exactly 1."""
     residual, jacobian_blocks = make_residual(mech, cfg)
+
+    # differential-row mask for the BE transient term: the T row (unless
+    # TGIV) and the Y rows are differential at INTERIOR points; the M /
+    # eigenvalue rows and the boundary-condition rows (first & last grid
+    # point) are algebraic and must stay exact during time stepping
+    c_T = 0.0 if cfg.energy == "TGIV" else 1.0
+
+    def _c_row(u):
+        T, M, Y = unpack(u)
+        interior = jnp.ones(T.shape[0], dtype=u.dtype
+                            ).at[0].set(0.0).at[-1].set(0.0)
+        return pack(c_T * interior, jnp.zeros_like(M),
+                    interior[:, None] * jnp.ones_like(Y))
 
     def weights(u):
         return cfg.ss_atol + cfg.ss_rtol * jnp.abs(u)
@@ -274,18 +342,13 @@ def make_newton(mech, cfg: FlameConfig, transient_coeff=None):
         return jnp.sqrt(jnp.mean((du / weights(u)) ** 2))
 
     def newton(u0, data: FlameData, u_old=None, dt=None):
-        if transient_coeff is not None:
-            c_fn = transient_coeff
-
+        if transient:
             def F(u):
-                return residual(u, data) + c_fn(u, data) * (u - u_old) / dt
+                return residual(u, data) + _c_row(u) * (u - u_old) / dt
 
             def Jblocks(u):
                 B, A, C = jacobian_blocks(u, data)
-                # dF/du gains c/dt on the diagonal of the diagonal block
-                # (treat c as frozen — standard simplified BE Newton)
-                c = c_fn(u, data)
-                A = A + jax.vmap(jnp.diag)(c / dt)
+                A = A + jax.vmap(jnp.diag)(_c_row(u) / dt)
                 return B, A, C
         else:
             def F(u):
@@ -299,17 +362,23 @@ def make_newton(mech, cfg: FlameConfig, transient_coeff=None):
             return blocktridiag.solve(B, A, C, -F(u))
 
         def body(carry):
-            u, _, it, prev_norm, stalled = carry
+            u, _, it, _, stalled = carry
             du = solve_step(u)
             n0 = step_norm(du, u)
+            n0 = jnp.where(jnp.isfinite(n0), n0, jnp.inf)
+            converged = n0 < 1.0
 
-            # damped line search: accept the first lambda whose NEXT
-            # Newton step is smaller (Jacobian refreshed each iteration;
-            # the probe uses the new point's own step norm)
+            # damped line search from the bound-respecting lambda: accept
+            # the first lambda whose NEXT Newton step is smaller (Jacobian
+            # refreshed each iteration; the probe uses the new point's own
+            # step norm)
+            lam0 = _lambda_bound(u, du)
+
             def damp_body(dcarry):
                 lam, best_u, best_n, found, k = dcarry
                 u_try = _clip_state(u + lam * du)
                 n_try = step_norm(solve_step(u_try), u_try)
+                n_try = jnp.where(jnp.isfinite(n_try), n_try, jnp.inf)
                 ok = n_try < n0
                 best_u = jnp.where(ok & ~found, u_try, best_u)
                 best_n = jnp.where(ok & ~found, n_try, best_n)
@@ -319,21 +388,19 @@ def make_newton(mech, cfg: FlameConfig, transient_coeff=None):
                 _, _, _, found, k = dcarry
                 return (~found) & (k < cfg.n_damp)
 
-            lam0 = jnp.asarray(1.0, dtype=u.dtype)
             _, u_acc, n_acc, found, _ = jax.lax.while_loop(
                 damp_cond, damp_body,
-                (lam0, _clip_state(u + du), n0, jnp.array(False),
-                 jnp.array(0)))
+                (lam0, u, n0, jnp.array(False), jnp.array(0)))
 
-            # no damping factor reduced the step: take the full step
-            # anyway unless it is diverging hard
-            u_next = jnp.where(found, u_acc, _clip_state(u + du))
+            # no acceptable damping: the Newton has failed (TWOPNT policy)
+            # — hand control back to the pseudo-transient rather than
+            # taking an undamped leap out of the basin
+            u_next = jnp.where(found, u_acc, u)
             n_next = jnp.where(found, n_acc, n0)
-            diverged = (~found) & (it > 0) & (n0 > 4.0 * prev_norm)
-            converged = n0 < 1.0
-            finite = jnp.all(jnp.isfinite(u_next))
-            return (u_next, converged, it + 1, n0,
-                    stalled | diverged | (~finite))
+            finite = jnp.all(jnp.isfinite(u_next)) & jnp.isfinite(n0)
+            failed = (~found) & (~converged)
+            return (jnp.where(finite, u_next, u), converged, it + 1,
+                    n_next, stalled | failed | (~finite))
 
         def cond(carry):
             _, converged, it, _, stalled = carry
@@ -349,25 +416,6 @@ def make_newton(mech, cfg: FlameConfig, transient_coeff=None):
     return newton
 
 
-def _transient_coeff_factory(mech, cfg: FlameConfig):
-    """Backward-Euler transient coefficients per row."""
-    def coeff(u, data: FlameData):
-        T, _, Y = unpack(u)
-        Yc = jnp.clip(Y, 0.0, 1.0)
-        rho = jax.vmap(lambda t, y: thermo.density(mech, t, data.P, y))(
-            T, Yc)
-        if cfg.energy == "TGIV":
-            c_T = jnp.zeros_like(T)
-        else:
-            cp = jax.vmap(lambda t, y: thermo.mixture_cp_mass(mech, t, y))(
-                T, Yc)
-            c_T = rho * cp
-        c_M = jnp.zeros_like(T)
-        c_Y = rho[:, None] * jnp.ones_like(Y)
-        return pack(c_T, c_M, c_Y)
-    return coeff
-
-
 class _Programs:
     """Per-(mech, cfg, N) jitted newton/timestep programs."""
     _cache: dict = {}
@@ -378,10 +426,14 @@ class _Programs:
         progs = cls._cache.get(key)
         if progs is None:
             newton = make_newton(mech, cfg)
-            # BE steps need fewer Newton iterations than the steady solve
+            # BE steps need fewer Newton iterations than the steady solve.
+            # The transient keeps the FULL residual — eigenvalue/pin rows
+            # stay active as algebraic constraints — so the mass-flux
+            # eigenvalue relaxes along with the profiles (the Premix
+            # pseudo-transient); freezing it in burner mode would leave
+            # the final Newton a 5x eigenvalue jump it cannot damp.
             ts_cfg = dataclasses.replace(cfg, n_newton=12)
-            ts_newton = make_newton(mech, ts_cfg,
-                                    _transient_coeff_factory(mech, cfg))
+            ts_newton = make_newton(mech, ts_cfg, transient=True)
 
             def timestep(u, data, dt, n_steps):
                 def body(i, carry):
@@ -404,7 +456,8 @@ class FlameSolution(NamedTuple):
     T: Any           # [N]
     Y: Any           # [N, KK]
     mdot: Any        # mass flux eigenvalue / burner flux, g/cm^2-s
-    flame_speed: Any  # cm/s = mdot / rho_unburnt (free flame)
+    flame_speed: Any  # cm/s = mdot / rho_unburnt (free flame); nan unless
+    #                  converged — an unconverged "speed" is fiction
     converged: Any
     n_points: int
     n_regrids: int
@@ -485,19 +538,74 @@ def refine_grid(x, u, *, grad=0.1, curv=0.5, nadp=10, ntot=250,
     return x_new
 
 
+def _pin_index(x, T_prof, T_fix):
+    """Interior grid index whose initial temperature is closest to T_fix.
+    Clamped to [1, N-2]: at a boundary point the interior pin row never
+    applies and the eigenvalue would be left without a defining equation
+    (singular Jacobian)."""
+    N = len(x)
+    return int(np.clip(np.argmin(np.abs(np.asarray(T_prof) - T_fix)),
+                       1, N - 2))
+
+
+def _march(newton_j, timestep_j, u, data, *, dt0, ts_steps, max_rounds,
+           verbose=False):
+    """Newton with pseudo-transient rescue rounds; returns
+    (u, converged, total_newton, dt_last)."""
+    total_newton = 0
+    dt = dt0
+    for round_i in range(max_rounds):
+        u_new, ok_j, n_it, last_norm = newton_j(u, data)
+        total_newton += int(n_it)
+        if verbose:
+            print(f"  [flame] newton round {round_i}: ok={bool(ok_j)} "
+                  f"its={int(n_it)} norm={float(last_norm):.3e} "
+                  f"Tmax={float(jnp.max(u_new[:, 0])):.0f}")
+        if bool(ok_j):
+            return u_new, True, total_newton, dt
+        u, n_ok = timestep_j(u, data, dt, n_steps=ts_steps)
+        u = jnp.asarray(jax.device_get(u))
+        n_ok = int(n_ok)
+        if verbose:
+            print(f"  [flame] transient round {round_i}: dt={dt:.2e} "
+                  f"ok {n_ok}/{ts_steps} Tmax={float(jnp.max(u[:, 0])):.0f}"
+                  f" M={float(u[0, 1]):.4f}")
+        # adapt dt: grow when the march is healthy, shrink when it stalls
+        # (PREMIX-style ladder; the cap keeps BE steps inside the damped
+        # Newton's reach even near ignition fronts)
+        if n_ok >= int(0.8 * ts_steps):
+            dt = min(dt * 5.0, 1e-3)
+        elif n_ok <= int(0.2 * ts_steps):
+            dt = max(dt * 0.2, 1e-9)
+    u_new, ok_j, n_it, last_norm = newton_j(u, data)
+    total_newton += int(n_it)
+    if verbose:
+        print(f"  [flame] final newton: ok={bool(ok_j)} "
+              f"norm={float(last_norm):.3e}")
+    return (u_new if bool(ok_j) else u), bool(ok_j), total_newton, dt
+
+
 def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
                 free_flame=True, mdot=None, T_fix=400.0, su_guess=40.0,
                 T_given_fn=None, n_initial=12, xcen=None, wmix=None,
                 grad=0.1, curv=0.5, nadp=10, ntot=250, max_regrids=12,
                 upwind=True, transport_model="MIX", lewis=1.0,
                 soret=False, species_flux_bc=True, ss_rtol=1e-4,
-                ss_atol=1e-9, ts_dt=1e-6, ts_steps=60, max_ts_rounds=4):
+                ss_atol=1e-9, ts_dt=1e-6, ts_steps=30, max_ts_rounds=12,
+                skip_fixed_T=False, u0=None, x0=None, verbose=False):
     """Solve a premixed 1-D flame with adaptive regridding.
 
     Host-level driver: jitted damped-Newton solves per grid size, with
     GRAD/CURV refinement between solves (reference Premix algorithm,
     SURVEY.md §2.2). For ``free_flame`` the returned ``flame_speed`` is
-    the laminar burning velocity Su = mdot / rho_unburnt.
+    the laminar burning velocity Su = mdot / rho_unburnt — and is nan
+    unless ``converged`` (an unconverged eigenvalue is not a result).
+
+    ``skip_fixed_T`` mirrors the reference's NOFT keyword
+    (premixedflame.py:937-946): by default a given-temperature burner
+    solve on the initial ramp precedes the full problem.
+    ``u0``/``x0`` restart from a previous solution (CNTN continuation,
+    premixedflame.py:430).
     """
     cfg = FlameConfig(energy=energy, free_flame=free_flame, upwind=upwind,
                       transport=transport_model, lewis=lewis, soret=soret,
@@ -512,62 +620,92 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
     if wmix is None:
         wmix = 0.5 * L
 
-    # initial grid: uniform + extra points through the ramp zone
-    x = np.linspace(x_start, x_end, n_initial)
-    ramp = np.linspace(xcen - 0.5 * wmix, xcen + 0.5 * wmix, 9)
-    x = np.sort(np.unique(np.concatenate([x, ramp])))
-
     T_given = None
-    if energy == "TGIV":
-        if T_given_fn is None:
-            raise ValueError("TGIV flame needs a temperature profile")
-        T_given = np.asarray([T_given_fn(xi) for xi in x])
+    if energy == "TGIV" and T_given_fn is None:
+        raise ValueError("TGIV flame needs a temperature profile")
 
     rho_u = float(thermo.density(mech, T_in, P, jnp.asarray(Y_in)))
     mdot_in = float(mdot) if mdot is not None else rho_u * su_guess
 
-    u = initial_profile(mech, jnp.asarray(x), P, T_in, Y_in, xcen, wmix,
-                        energy=energy, T_given=T_given,
-                        mdot_guess=mdot_in, su_guess=su_guess)
-
-    # pin location: where the initial profile crosses T_fix (free flame);
-    # that x value is kept in every refined grid
-    T_prof = np.asarray(u[:, 0])
-    if free_flame:
-        i_fix = int(np.argmin(np.abs(T_prof - T_fix)))
-        x_fix = float(x[i_fix])
+    if u0 is not None:
+        # continuation restart from a previous solution
+        if x0 is None:
+            raise ValueError("continuation restart needs x0 alongside u0")
+        x = np.asarray(x0, dtype=np.float64)
+        u = jnp.asarray(u0)
     else:
-        i_fix = 0
-        x_fix = float(x[0])
-
-    total_newton = 0
-    n_regrids = 0
-    converged = False
-    for round_i in range(max_regrids + 1):
-        N = x.shape[0]
+        # initial grid: uniform + extra points through the ramp zone
+        x = np.linspace(x_start, x_end, n_initial)
+        ramp = np.linspace(xcen - 0.5 * wmix, xcen + 0.5 * wmix, 9)
+        x = np.sort(np.unique(np.concatenate([x, ramp])))
         if energy == "TGIV":
             T_given = np.asarray([T_given_fn(xi) for xi in x])
-        data = FlameData(
-            x=jnp.asarray(x), P=P, T_in=T_in, Y_in=jnp.asarray(Y_in),
-            mdot_in=mdot_in, T_fix=T_fix,
-            i_fix=jnp.asarray(i_fix, jnp.int32),
-            T_given=(jnp.asarray(T_given) if T_given is not None
-                     else jnp.zeros(N)))
-        newton_j, timestep_j = _Programs.get(mech, cfg, N)
+        u = initial_profile(mech, jnp.asarray(x), P, T_in, Y_in, xcen,
+                            wmix, energy=energy, T_given=T_given,
+                            mdot_guess=mdot_in, su_guess=su_guess)
+        if free_flame:
+            # make the starting guess CONSISTENT with the pin condition:
+            # insert a grid point exactly where the initial ramp crosses
+            # T_fix (the T profile is a monotone ramp, so interpolate
+            # x(T)); an inconsistent pin (T(x_fix) != T_fix) forces the
+            # first Newton step to relocate the whole flame and blows up
+            # the eigenvalue
+            T_prof0 = np.asarray(u[:, 0])
+            if T_prof0[-1] > T_fix > T_prof0[0]:
+                x_cross = float(np.interp(T_fix, T_prof0, x))
+                x = np.sort(np.unique(np.append(x, x_cross)))
+                if energy == "TGIV":
+                    T_given = np.asarray([T_given_fn(xi) for xi in x])
+                u = initial_profile(mech, jnp.asarray(x), P, T_in, Y_in,
+                                    xcen, wmix, energy=energy,
+                                    T_given=T_given, mdot_guess=mdot_in,
+                                    su_guess=su_guess)
 
-        ok = False
-        for attempt in range(max_ts_rounds):
-            u_new, ok_j, n_it, _ = newton_j(u, data)
-            total_newton += int(n_it)
-            ok = bool(ok_j)
-            if ok:
-                u = u_new
-                break
-            # pseudo-transient rescue: march BE steps, then retry
-            u, n_ok = timestep_j(u, data, ts_dt * (2.0 ** attempt),
-                                 n_steps=ts_steps)
-            u = jax.device_get(u)
-            u = jnp.asarray(u)
+    T_prof = np.asarray(u[:, 0])
+    if free_flame:
+        i_fix = _pin_index(x, T_prof, T_fix)
+        x_fix = float(x[i_fix])
+    else:
+        i_fix = 1
+        x_fix = float(x[0])
+
+    def make_data(x_arr, i_fix_v, T_given_arr):
+        N = len(x_arr)
+        return FlameData(
+            x=jnp.asarray(x_arr), P=P, T_in=T_in, Y_in=jnp.asarray(Y_in),
+            mdot_in=mdot_in, T_fix=T_fix,
+            i_fix=jnp.asarray(i_fix_v, jnp.int32),
+            T_given=(jnp.asarray(T_given_arr) if T_given_arr is not None
+                     else jnp.zeros(N)))
+
+    total_newton = 0
+
+    # --- Stage A: fixed-temperature burner solve on the initial ramp
+    # (reference default; NOFT / skip_fix_T_solution turns it off)
+    if energy == "ENRG" and not skip_fixed_T and u0 is None:
+        cfg_ft = dataclasses.replace(cfg, energy="TGIV", free_flame=False)
+        newton_ft, timestep_ft = _Programs.get(mech, cfg_ft, len(x))
+        data_ft = make_data(x, i_fix, np.asarray(u[:, 0]))
+        u_ft, ok, n_it, _ = _march(newton_ft, timestep_ft, u, data_ft,
+                                   dt0=ts_dt, ts_steps=ts_steps,
+                                   max_rounds=2, verbose=verbose)
+        total_newton += n_it
+        if ok:
+            u = u_ft      # species relaxed on the frozen ramp
+
+    # --- Stage B: the target problem, with regridding
+    n_regrids = 0
+    converged = False
+    for _round in range(max_regrids + 1):
+        if energy == "TGIV":
+            T_given = np.asarray([T_given_fn(xi) for xi in x])
+        data = make_data(x, i_fix, T_given)
+        newton_j, timestep_j = _Programs.get(mech, cfg, len(x))
+        u, ok, n_it, ts_dt = _march(newton_j, timestep_j, u, data,
+                                    dt0=ts_dt, ts_steps=ts_steps,
+                                    max_rounds=max_ts_rounds,
+                                    verbose=verbose)
+        total_newton += n_it
         if not ok:
             converged = False
             break
@@ -581,13 +719,16 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         x = x_new
         n_regrids += 1
         if free_flame:
-            i_fix = int(np.argmin(np.abs(x - x_fix)))
+            # keep the pin anchored at the same PHYSICAL location
+            i_fix = int(np.clip(np.argmin(np.abs(x - x_fix)), 1,
+                                len(x) - 2))
 
     T_out, M_out, Y_out = unpack(u)
     mdot_out = float(M_out[0]) if free_flame else mdot_in
+    su = mdot_out / rho_u if converged else float("nan")
     return FlameSolution(
         x=np.asarray(x), T=np.asarray(T_out),
         Y=np.clip(np.asarray(Y_out), 0.0, 1.0), mdot=mdot_out,
-        flame_speed=mdot_out / rho_u,
+        flame_speed=su,
         converged=converged, n_points=int(x.shape[0]),
         n_regrids=n_regrids, n_newton=total_newton)
