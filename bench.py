@@ -1,287 +1,16 @@
-"""Headline benchmark: batched 0-D ignition-delay throughput.
+"""Headline benchmark driver — prints ONE JSON line.
 
-Config #2 of BASELINE.json: a GRI-3.0-sized ignition-delay sweep — the
-53-species / 325-reaction ``grisyn`` fixture on accelerators (real H2/O2
-subsystem + GRI-shaped synthetic channels; real GRI-3.0 data is not
-redistributable from the reference install and the build env has no
-network) — integrated as ONE compiled batched stiff solve.
-
-Metric: 0-D ignitions/sec/chip. The reference publishes no throughput
-numbers (BASELINE.md); its execution model is one blocking licensed-
-Fortran integration per reactor on a single CPU core. The ``vs_baseline``
-denominator is therefore MEASURED here, not assumed: the same mechanism /
-protocol integrated serially on one CPU core by scipy's BDF with an
-analytic (AD) Jacobian — a faithful stand-in for the reference's
-DASPK-class serial execution model (reference call stack: SURVEY.md §3.3,
-one KINAll0D_Calculate per reactor).
-
-Robustness contract (round-1 failure was rc=1 with no JSON): the TPU
-backend is probed in a SUBPROCESS with a hard timeout so a hung tunnel
-can never hang the bench; on any accelerator failure the bench falls
-back to CPU with a guaranteed-small config. One JSON line is always
-printed to stdout.
-
-Environment knobs:
-  BENCH_B           batch width (default 1024 on TPU, 16 on CPU)
-  BENCH_REPEATS     timed repetitions (default 1)
-  BENCH_MECH        mechanism fixture (default grisyn on TPU, h2o2 on CPU)
-  BENCH_BASELINE_N  serial-baseline sample points (default 2; 0 disables)
-  BENCH_PROBE_TIMEOUT  backend-probe timeout in seconds (default 180)
+Thin wrapper: the implementation lives in pychemkin_tpu.benchmarks (also
+exposed as the ``pychemkin-tpu-bench`` console script). See that module's
+docstring for the robustness contract and environment knobs.
 """
 
-from __future__ import annotations
-
-import json
 import os
-import subprocess
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: fallback denominator when the serial baseline is disabled; an ESTIMATE
-#: (generous to the reference) of licensed-Chemkin single-core throughput
-FALLBACK_REFERENCE_IGNITIONS_PER_SEC = 2.0
-
-
-def _probe_platform(timeout: float):
-    """Initialize the JAX backend in a subprocess with a hard timeout and
-    report its platform, or None if init fails/hangs (round-1 failure
-    mode: the axon TPU tunnel hung ``jax.devices()`` indefinitely)."""
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        print(f"# backend probe timed out after {timeout:.0f}s",
-              file=sys.stderr)
-        return None
-    for line in r.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1].strip()
-    tail = (r.stderr or "").strip().splitlines()
-    print("# backend probe failed: "
-          + (tail[-1] if tail else f"rc={r.returncode}"), file=sys.stderr)
-    return None
-
-
-def _stoich_h2_air_Y(mech):
-    import jax.numpy as jnp
-
-    from pychemkin_tpu.ops import thermo
-
-    names = list(mech.species_names)
-    X = np.zeros(len(names))
-    X[names.index("H2")] = 2.0
-    X[names.index("O2")] = 1.0
-    X[names.index("N2")] = 3.76
-    return np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
-
-
-class _BaselineTimeout(Exception):
-    pass
-
-
-def _measure_serial_baseline(mech, Y0, T0s, t_end, n_points, budget_s,
-                             rtol, atol):
-    """Serial single-core throughput of the same problem: scipy BDF with
-    an AD Jacobian, one state per integration (the reference's execution
-    model). Returns ignitions/sec, or None if disabled/failed.
-
-    The wall-clock budget is enforced INSIDE the integration (the RHS
-    callback raises past the deadline), so a pathologically stiff point
-    can never stall the bench past ``budget_s``."""
-    if n_points <= 0:
-        return None
-    import jax
-    import jax.numpy as jnp
-    from scipy.integrate import solve_ivp
-
-    from pychemkin_tpu.ops import reactors, thermo
-
-    deadline = time.time() + budget_s
-    idx = np.linspace(0, len(T0s) - 1, n_points).astype(int)
-    walls = []
-    for i in idx:
-        T0 = float(T0s[i])
-        P0 = 1.01325e6
-        args = reactors.BatchArgs(
-            mech=mech,
-            constraint=reactors.constant_profile(P0),
-            tprof=reactors.constant_profile(T0),
-            qloss=reactors.constant_profile(0.0),
-            area=reactors.constant_profile(0.0),
-            mass=float(thermo.density(mech, T0, P0, jnp.asarray(Y0))))
-        rhs = jax.jit(lambda t, y, a=args: reactors.conp_enrg_rhs(t, y, a))
-        jac = jax.jit(lambda t, y, a=args: jax.jacfwd(
-            lambda yy: reactors.conp_enrg_rhs(t, yy, a))(y))
-        y0 = np.concatenate([Y0, [T0]])
-        # warm the jits so compile time doesn't count against the baseline
-        np.asarray(rhs(0.0, jnp.asarray(y0)))
-        np.asarray(jac(0.0, jnp.asarray(y0)))
-
-        def rhs_np(t, y):
-            if time.time() > deadline:
-                raise _BaselineTimeout
-            return np.asarray(rhs(t, jnp.asarray(y)))
-
-        t0 = time.time()
-        try:
-            sol = solve_ivp(rhs_np, (0.0, t_end), y0, method="BDF",
-                            jac=lambda t, y: np.asarray(
-                                jac(t, jnp.asarray(y))),
-                            rtol=rtol, atol=atol)
-        except _BaselineTimeout:
-            print(f"# baseline budget ({budget_s:.0f}s) exhausted mid-"
-                  "integration", file=sys.stderr)
-            break
-        walls.append(time.time() - t0)
-        if not sol.success:
-            print(f"# baseline point T0={T0:.0f} failed: {sol.message}",
-                  file=sys.stderr)
-            return None
-        if time.time() > deadline:
-            break
-    if not walls:
-        return None
-    per_ign = float(np.mean(walls))
-    print(f"# serial baseline: {len(walls)} pts, {per_ign:.2f} s/ignition",
-          file=sys.stderr)
-    return 1.0 / per_ign
-
-
-def _run_config(mech_name, B, repeats, rtol, atol, max_steps, t_end):
-    """Compile + time one sweep config; returns a result dict."""
-    import jax
-
-    from pychemkin_tpu import parallel
-    from pychemkin_tpu.mechanism import load_embedded
-
-    devices = jax.devices()
-    platform = devices[0].platform
-    n_chips = len(devices)
-    mech = load_embedded(mech_name)
-    Y0 = _stoich_h2_air_Y(mech)
-    mesh = parallel.make_mesh()
-
-    rng = np.random.default_rng(0)
-    T0s = np.linspace(1000.0, 1400.0, B)
-    P0s = 1.01325e6 * (1.0 + rng.uniform(0.0, 1.0, B))  # 1-2 atm spread
-
-    def sweep():
-        return parallel.sharded_ignition_sweep(
-            mech, "CONP", "ENRG", T0s, P0s, Y0, t_end, mesh=mesh,
-            rtol=rtol, atol=atol, max_steps_per_segment=max_steps)
-
-    t0 = time.time()
-    times, ok = sweep()            # compile + warm-up at full batch shape
-    compile_s = time.time() - t0
-    print(f"# compile+warmup: {compile_s:.1f}s", file=sys.stderr)
-
-    wall = []
-    for _ in range(repeats):
-        t0 = time.time()
-        times, ok = sweep()
-        wall.append(time.time() - t0)
-    run_s = min(wall)
-    n_ok = int(np.sum(ok))
-    n_ignited = int(np.sum(np.isfinite(times) & ok))
-    print(f"# wall={run_s:.2f}s ok={n_ok}/{B} ignited={n_ignited}",
-          file=sys.stderr)
-    return dict(platform=platform, n_chips=n_chips, mech=mech_name, B=B,
-                compile_s=round(compile_s, 1), run_s=round(run_s, 3),
-                throughput=B / run_s / n_chips,
-                T0s=T0s, Y0=Y0, mech_obj=mech, t_end=t_end,
-                rtol=rtol, atol=atol, n_ok=n_ok, n_ignited=n_ignited)
-
-
-def main():
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
-    platform = _probe_platform(probe_timeout)
-    on_accel = platform is not None and platform != "cpu"
-
-    import jax
-
-    if not on_accel:
-        # never touch the (hung/absent) accelerator backend in-process
-        jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
-
-    from pychemkin_tpu.utils import enable_compilation_cache
-    enable_compilation_cache()
-
-    mech_name = os.environ.get("BENCH_MECH",
-                               "grisyn" if on_accel else "h2o2")
-    B = int(os.environ.get("BENCH_B", 1024 if on_accel else 16))
-    repeats = int(os.environ.get("BENCH_REPEATS", 1))
-    rtol, atol = 1e-6, 1e-12
-    t_end = 0.05
-    print(f"# bench: platform={platform or 'cpu(fallback)'} "
-          f"mech={mech_name} B={B}", file=sys.stderr)
-
-    result = None
-    err = None
-    is_fallback = False
-    try:
-        result = _run_config(mech_name, B, repeats, rtol, atol,
-                             max_steps=20_000, t_end=t_end)
-    except Exception as e:                       # noqa: BLE001
-        err = f"{type(e).__name__}: {e}"
-        print(f"# primary config failed: {err}", file=sys.stderr)
-        # guaranteed-small fallback: tiny mech, tiny batch, looser tols
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:                        # noqa: BLE001
-            pass
-        try:
-            result = _run_config("h2o2", 4, 1, 1e-5, 1e-10,
-                                 max_steps=5_000, t_end=2e-3)
-            is_fallback = True
-        except Exception as e2:                  # noqa: BLE001
-            err = f"{err}; fallback: {type(e2).__name__}: {e2}"
-            print(f"# fallback config failed too: {e2}", file=sys.stderr)
-
-    if result is None:
-        # still print the one JSON line the driver parses
-        print(json.dumps({
-            "metric": "0-D ignitions/sec/chip",
-            "value": 0.0, "unit": "ignitions/sec/chip",
-            "vs_baseline": 0.0, "error": err}))
-        return
-
-    # the baseline uses the EXACT tolerances/mech/protocol of whichever
-    # config actually ran (primary or fallback)
-    n_base = int(os.environ.get("BENCH_BASELINE_N", 2))
-    baseline_ips = _measure_serial_baseline(
-        result["mech_obj"], result["Y0"], result["T0s"], result["t_end"],
-        n_base, budget_s=240.0, rtol=result["rtol"], atol=result["atol"])
-    if baseline_ips is None:
-        baseline_ips = FALLBACK_REFERENCE_IGNITIONS_PER_SEC
-        baseline_kind = "estimated"
-    else:
-        baseline_kind = "measured scipy-BDF single-core, same mech/tols"
-
-    out = {
-        "metric": f"0-D ignitions/sec/chip ({result['mech']}, CONP/ENRG, "
-                  f"rtol {result['rtol']:g}/atol {result['atol']:g})",
-        "value": round(result["throughput"], 3),
-        "unit": "ignitions/sec/chip",
-        "vs_baseline": round(result["throughput"] / baseline_ips, 2),
-        "platform": result["platform"],
-        "n_chips": result["n_chips"],
-        "B": result["B"],
-        "compile_s": result["compile_s"],
-        "run_s": result["run_s"],
-        "baseline_ignitions_per_sec": round(baseline_ips, 4),
-        "baseline_kind": baseline_kind,
-        "n_ok": result["n_ok"],
-        "n_ignited": result["n_ignited"],
-    }
-    if is_fallback:
-        out["fallback"] = True
-        out["error"] = err
-    print(json.dumps(out))
-
+from pychemkin_tpu.benchmarks import main  # noqa: E402
 
 if __name__ == "__main__":
     main()
