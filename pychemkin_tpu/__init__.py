@@ -20,7 +20,24 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
-from . import constants, mechanism, models, ops, parallel  # noqa: E402
+# persistent XLA compilation cache: compile latency is this framework's
+# dominant fixed cost (regridding flame solves compile one program per
+# grid size; sweeps compile large batched integrators), so every user of
+# the package gets disk-cached compiles, not just the bench/test entry
+# points. Opt out with PYCHEMKIN_NO_CACHE=1.
+import os as _os
+
+if not _os.environ.get("PYCHEMKIN_NO_CACHE"):
+    from .utils import enable_compilation_cache as _enable_cache
+
+    try:
+        _enable_cache()
+    except OSError:
+        # an unwritable cache location must never break `import
+        # pychemkin_tpu` — caching is an optimization, not a dependency
+        pass
+
+from . import constants, info, mechanism, models, ops, parallel  # noqa: E402
 from .chemistry import (  # noqa: E402
     Chemistry,
     chemkin_version,

@@ -33,8 +33,9 @@ Environment knobs:
   BENCH_REPEATS     timed repetitions per config (default 1)
   BENCH_BASELINE_N  serial-baseline sample points (default 2; 0 disables)
   BENCH_PROBE_TIMEOUT    backend-probe timeout in s (default 120)
-  BENCH_CONFIG_TIMEOUT   per-config timeout in s (default 600; the first
-                         config of each mechanism gets 1.5x for compile)
+  BENCH_CONFIG_TIMEOUT   per-config timeout in s (default 900; every
+                         rung is a fresh XLA program shape, so each one
+                         gets the full compile budget)
 """
 
 from __future__ import annotations
@@ -102,10 +103,9 @@ def _child_probe():
 
 def _child_config(mech_name: str, B: int, repeats: int):
     """Compile + time one sweep config; prints one JSON line."""
+    # x64 + the persistent compilation cache are enabled by the package
+    # import itself (pychemkin_tpu/__init__.py)
     import jax
-    jax.config.update("jax_enable_x64", True)
-    from .utils import enable_compilation_cache
-    enable_compilation_cache()
 
     from . import parallel
     from .mechanism import load_embedded
@@ -114,6 +114,12 @@ def _child_config(mech_name: str, B: int, repeats: int):
     devices = jax.devices()
     platform = devices[0].platform
     n_chips = len(devices)
+    if platform != "cpu":
+        # backend confirmed as the accelerator: TPU executables are safe
+        # to cache (compile target == execution target); the import-time
+        # path refused because the platform was not yet known
+        from .utils import enable_compilation_cache
+        enable_compilation_cache(partition="axon")
     mech = load_embedded(mech_name)
     Y0 = _stoich_Y0(mech, mech_name)
     mesh = parallel.make_mesh()
@@ -152,7 +158,6 @@ def _child_baseline(mech_name: str, n_points: int, budget_s: float):
     model). Prints one JSON line. The wall-clock budget is enforced
     INSIDE the integration (the RHS callback raises past the deadline)."""
     import jax
-    jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
     from scipy.integrate import solve_ivp
 
@@ -276,14 +281,14 @@ def _run_ladder(ladder, repeats, cfg_timeout, env=None):
     kind of event that poisons the backend."""
     results = []
     err = None
-    seen_mech = set()
     for mech_name, B in ladder:
-        # first config of each mechanism pays the big compile
-        tmo = cfg_timeout * (1.5 if mech_name not in seen_mech else 1.0)
-        seen_mech.add(mech_name)
+        # every (mech, B) rung compiles its own XLA program shape, so
+        # each gets the full budget — a per-mechanism "compile bonus"
+        # would starve the largest (headline) configs
         t0 = time.time()
         rc, parsed, tail = _run_child(
-            ["config", mech_name, str(B), str(repeats)], tmo, env=env)
+            ["config", mech_name, str(B), str(repeats)], cfg_timeout,
+            env=env)
         status = ("ok" if parsed is not None and rc == 0 else
                   "timeout" if rc == -2 else f"rc={rc}")
         print(f"# config {mech_name}:B={B}: {status} "
@@ -319,7 +324,7 @@ def main():
 
 def _main_guarded():
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
-    cfg_timeout = float(os.environ.get("BENCH_CONFIG_TIMEOUT", 600))
+    cfg_timeout = float(os.environ.get("BENCH_CONFIG_TIMEOUT", 900))
     repeats = int(os.environ.get("BENCH_REPEATS", 1))
     ladder = [
         (p.split(":")[0], int(p.split(":")[1]))

@@ -1,0 +1,119 @@
+"""Keyword help system (reference info.py:28-313 +
+data/ChemkinKeywordTips.yaml).
+
+Loads the YAML dictionary of {KEYWORD: {Description, DefaultValue,
+Units}} shipped with the package and serves keyword lookups, free-text
+search over descriptions, and topical help — the same surface as the
+reference's ``setup_hints`` (:40) / ``keyword_hints`` (:66) /
+``phrase_hints`` (:92) / ``help`` (:127). The data file documents the
+keywords THIS framework's models consume, with this build's defaults.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import yaml
+
+from .logger import logger
+
+#: keyword hints dictionary (loaded lazily)
+CKdict: dict = {}
+_help_loaded = False
+
+_HELP_FILE = os.path.join(os.path.dirname(__file__), "data",
+                          "keyword_tips.yaml")
+
+_TOPICS = {
+    "solver": ("ATOL", "RTOL", "NNEG", "STPT", "HO", "SSATOL", "SSRTOL",
+               "ATIM", "RTIM", "TJAC", "ISTP", "IRET", "SFLR"),
+    "reactor": ("CONP", "CONV", "ENRG", "TGIV", "PRES", "TEMP", "VOL",
+                "TAU", "TIME", "DELT"),
+    "heat": ("QLOS", "QPRO", "HTC", "TAMB", "AREAQ", "ICHX", "GVEL"),
+    "ignition": ("TIFP", "DTIGN", "TLIM", "KLIM"),
+    "flame": ("FREE", "BURN", "TFIX", "TUNB", "NOFT", "TPROF", "CNTN",
+              "MIX", "MULT", "LEWIS", "TDIF", "CDIF", "WDIF", "COMP",
+              "FLUX"),
+    "grid": ("NPTS", "NTOT", "NADP", "XSTR", "XEND", "XCEN", "WMIX",
+             "GRAD", "CURV", "GRID"),
+    "engine": ("BORE", "STRK", "CRLEN", "CMPR", "RPM", "DEG0", "DEGE",
+               "DEGSAVE", "DEGPRINT", "POLEN", "BEFF", "EQMN"),
+    "analysis": ("ASEN", "ATLS", "RTLS", "EPST", "EPSS", "AROP",
+                 "EPSR"),
+}
+
+
+def setup_hints():
+    """Load the keyword dictionary (reference info.py:40)."""
+    global _help_loaded, CKdict
+    if not _help_loaded:
+        with open(_HELP_FILE) as hints:
+            CKdict = yaml.safe_load(hints)
+        _help_loaded = True
+
+
+def clear_hints():
+    """(reference info.py:56)."""
+    global _help_loaded
+    if _help_loaded:
+        CKdict.clear()
+        _help_loaded = False
+
+
+def keyword_hints(mykey: str):
+    """Print hints for one keyword (reference info.py:66)."""
+    setup_hints()
+    key = CKdict.get(mykey.upper())
+    if key is None:
+        logger.error("keyword %s is not found.", mykey)
+        return
+    print(f"** tips about keyword '{mykey}'")
+    print(f"     Description: {key.get('Description')}")
+    print(f"     Default Value: {key.get('DefaultValue')}")
+    print(f"     Units: {key.get('Units')}")
+
+
+def phrase_hints(phrase: str):
+    """Find keywords whose description contains ``phrase``
+    (reference info.py:92)."""
+    setup_hints()
+    keys = [k for k, v in CKdict.items()
+            if phrase.lower() in str(v.get("Description", "")).lower()]
+    if not keys:
+        logger.error("no keyword description containing the phrase %s "
+                     "can be found.", phrase)
+        return
+    for this_key in keys:
+        keyword_hints(this_key)
+
+
+def help(topic: Optional[str] = None):     # noqa: A001 - reference name
+    """Topical keyword help (reference info.py:127): with no argument,
+    list the topics; with a topic name, show its keywords; with a
+    keyword, show its hints."""
+    setup_hints()
+    if topic is None:
+        print("keyword help topics:")
+        for name, keys in _TOPICS.items():
+            print(f"  {name:<10s} ({len(keys)} keywords)")
+        print("usage: info.help('flame') or info.keyword_hints('GRAD') "
+              "or info.phrase_hints('tolerance')")
+        return
+    t = topic.lower()
+    if t in _TOPICS:
+        print(f"** keywords in topic '{t}':")
+        for k in _TOPICS[t]:
+            entry = CKdict.get(k, {})
+            print(f"  {k:<10s} {entry.get('Description', '')}")
+        return
+    if topic.upper() in CKdict:
+        keyword_hints(topic)
+        return
+    logger.error("unknown help topic or keyword %r", topic)
+
+
+def list_keywords() -> list:
+    """All documented keywords (sorted)."""
+    setup_hints()
+    return sorted(CKdict.keys())

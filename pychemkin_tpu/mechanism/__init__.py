@@ -25,7 +25,24 @@ def load_embedded(name: str) -> MechanismRecord:
     """Load an embedded mechanism fixture by name.
 
     Available: ``"h2o2"`` (GRI-3.0-derived H2/O2/N2/AR subsystem, with
-    transport data), ``"grisyn"`` (synthetic GRI-3.0-sized perf fixture).
+    transport data), ``"grisyn"`` (synthetic GRI-3.0-sized perf fixture:
+    a real H2/O2 core padded with GRI-shaped pseudo-species/reactions to
+    53 species / 325 reactions).
+
+    Real GRI-3.0 is deliberately NOT embedded: this build environment
+    has no network egress and ships no copy of the mechanism (verified:
+    neither the reference checkout nor the Python environment contains
+    chem/therm/tran data), and reconstructing 325 reaction rate fits +
+    53 NASA-7 polynomial sets from memory would produce data that
+    CLAIMS to be GRI-3.0 but is not — strictly worse than the honestly
+    labeled synthetic fixture. Users with the published GRI-3.0 files
+    load them directly::
+
+        load_mechanism("gri30.inp", thermo_path="thermo30.dat",
+                       transport_path="transport.dat")
+
+    The parser covers the full grammar GRI-3.0 uses (third bodies,
+    Troe falloff, DUP, REV) — see tests/test_parser.py.
     """
     if name == "h2o2":
         return load_mechanism(

@@ -41,6 +41,8 @@ def _as_fraction_array(mech, value, what: str) -> np.ndarray:
     """Accept a recipe (list of (symbol, fraction)) or a full [KK] array
     (the reference's setter polymorphism, mixture.py:272)."""
     KK = mech.n_species
+    if isinstance(value, dict):
+        value = list(value.items())
     if isinstance(value, (list, tuple)) and len(value) > 0 and isinstance(
             value[0], (list, tuple)) and isinstance(value[0][0], str):
         frac = np.zeros(KK, dtype=np.double)

@@ -2,6 +2,9 @@
 SURVEY.md §1): the Keyword/Profile/ReactorModel framework plus the
 concrete user-facing simulation classes."""
 
+from .engine import Engine
+from .hcci import HCCIengine
+from .si import SIengine
 from .batch import (
     BatchReactors,
     GivenPressureBatchReactor_EnergyConservation,
@@ -9,10 +12,18 @@ from .batch import (
     GivenVolumeBatchReactor_EnergyConservation,
     GivenVolumeBatchReactor_FixedTemperature,
 )
+from .flame import Flame
+from .grid import Grid
 from .pfr import (
     PlugFlowReactor,
     PlugFlowReactor_EnergyConservation,
     PlugFlowReactor_FixedTemperature,
+)
+from .premixedflame import (
+    BurnedStabilized_EnergyEquation,
+    BurnedStabilized_GivenTemperature,
+    FreelyPropagating,
+    PremixedFlame,
 )
 from .psr import (
     PSR_SetResTime_EnergyConservation,
@@ -22,6 +33,7 @@ from .psr import (
     openreactor,
     perfectlystirredreactor,
 )
+from .reactornetwork import ReactorNetwork
 from .reactormodel import (
     BooleanKeyword,
     IntegerKeyword,
@@ -36,6 +48,16 @@ from .steadystatesolver import SteadyStateSolver
 __all__ = [
     "BatchReactors",
     "BooleanKeyword",
+    "BurnedStabilized_EnergyEquation",
+    "BurnedStabilized_GivenTemperature",
+    "Engine",
+    "Flame",
+    "FreelyPropagating",
+    "Grid",
+    "HCCIengine",
+    "SIengine",
+    "PremixedFlame",
+    "ReactorNetwork",
     "GivenPressureBatchReactor_EnergyConservation",
     "GivenPressureBatchReactor_FixedTemperature",
     "GivenVolumeBatchReactor_EnergyConservation",

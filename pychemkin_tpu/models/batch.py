@@ -31,6 +31,7 @@ import numpy as np
 from ..logger import logger
 from ..mixture import Mixture
 from ..ops import reactors as reactor_ops
+from ..ops import sensitivity as sens_ops
 from .reactormodel import (
     STATUS_FAILED,
     STATUS_NOT_RUN,
@@ -340,6 +341,63 @@ class BatchReactors(ReactorModel):
             logger.error("batch-reactor integration failed (stalled or "
                          "step budget exhausted)")
         return self.runstatus
+
+    # --- sensitivity & ROP analysis (ASEN / AROP consumption) ----------
+
+    def _require_asen(self):
+        if not self._sensitivity:
+            raise RuntimeError(
+                "sensitivity analysis is not enabled; call "
+                "setsensitivityanalysis() before run() "
+                "(reference ASEN keyword, reactormodel.py:1522)")
+
+    def get_ignition_sensitivity(self, *, eps=0.05):
+        """Normalized ignition-delay sensitivities d ln(tau)/d ln(A_i)
+        for every reaction, computed as ONE vmapped batch of perturbed
+        integrations (the ASEN output of the ignition workflow). Returns
+        :class:`pychemkin_tpu.ops.sensitivity.IgnitionSensitivity`."""
+        self._require_asen()
+        cond = self._condition
+        return sens_ops.ignition_delay_sensitivity(
+            self._effective_mech(), self.problem_type, self.energy_type,
+            cond.temperature, cond.pressure, np.asarray(cond.Y),
+            self._time, eps=eps)
+
+    def get_sensitivity_profile(self, *, eps=0.05, n_out=51):
+        """Normalized T/species profile sensitivities (ASEN profile
+        output). Returns
+        :class:`pychemkin_tpu.ops.sensitivity.ProfileSensitivity`."""
+        self._require_asen()
+        cond = self._condition
+        return sens_ops.profile_sensitivity(
+            self._effective_mech(), self.problem_type, self.energy_type,
+            cond.temperature, cond.pressure, np.asarray(cond.Y),
+            self._time, eps=eps, n_out=n_out)
+
+    def get_ROP_table(self):
+        """Rate-of-production table over the saved solution profiles
+        (AROP output, reference reactormodel.py:1585). Requires a
+        successful run(); returns
+        :class:`pychemkin_tpu.ops.sensitivity.ROPTable`."""
+        if not self._rop_analysis:
+            raise RuntimeError(
+                "ROP analysis is not enabled; call setROPanalysis() "
+                "before run() (reference AROP keyword)")
+        if self._solution is None or not self.checkrunstatus():
+            raise RuntimeError("run() the reactor successfully first")
+        sol = self._solution
+        return sens_ops.rop_analysis(self._effective_mech(), sol.times,
+                                     sol.T, sol.P, sol.Y)
+
+    def get_dominant_reactions(self, species_name: str):
+        """Reactions dominating production/destruction of a species,
+        filtered by the EPSR threshold (reference reactormodel.py:1614).
+        Returns (reaction indices, peak |contribution| values)."""
+        table = self.get_ROP_table()
+        mech = self._effective_mech()
+        k = mech.species_index(species_name)
+        return sens_ops.dominant_reactions(
+            table, mech, k, threshold=self._rop_threshold)
 
     def run_sweep(self, T0s=None, P0s=None, Y0s=None, t_ends=None):
         """Batched ignition-delay sweep over initial conditions — the TPU

@@ -1,0 +1,407 @@
+"""IC-engine model base class (reference engines/engine.py:41).
+
+``Engine`` carries the cylinder geometry, CA<->time conversion, wall
+heat-transfer configuration and CA-based output controls; the concrete
+engine cycles (HCCI, SI) drive the JAX engine kernels in
+:mod:`pychemkin_tpu.ops.engine` where the reference blocks in the native
+``KINAll0D_Calculate`` engine problem types.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..logger import logger
+from ..mixture import Mixture
+from ..ops import engine as engine_ops
+from .batch import BatchReactors
+
+
+class Engine(BatchReactors):
+    """Generic engine cylinder model (reference engine.py:41)."""
+
+    #: valid wall heat-transfer correlation keywords
+    #: (reference engine.py:96-99)
+    _WallHeatTransferModels = ["ICHX", "ICHW", "ICHH"]
+
+    def __init__(self, reactor_condition: Mixture, label: str):
+        super().__init__(reactor_condition, label)
+        self._numstroke = 4
+        self.borediam = 0.0            # [cm]
+        self.borearea = 0.0            # [cm2]
+        self.enginestroke = 0.0        # [cm]
+        self.crankradius = 0.0         # [cm]
+        self.connectrodlength = 0.0    # [cm]
+        self.pistonoffset = 0.0        # [cm]
+        self.cylinderheadarea = 0.0    # [cm2]
+        self.pistonheadarea = 0.0      # [cm2]
+        self.headareas = 0.0
+        self.compressratio = 1.0
+        self.enginespeed = 1.0         # RPM
+        self.IVCCA = -180.0
+        self.EVOCA = 180.0
+        self.rundurationCA = 360.0
+        self.numbHTmodelparameters = [3, 3, 5]
+        self.heattransfermodel: int = -1
+        self.heattransferparameters: List[float] = []
+        self.cylinderwalltemperature = 298.15
+        self.gasvelocity: List[float] = []
+        self.HuberIMEP: Optional[float] = None
+        self._wallheattransfer = False
+        self._engine_solution: Optional[engine_ops.EngineSolution] = None
+
+    # --- CA <-> time (reference engine.py:128-224) ----------------------
+
+    @staticmethod
+    def convert_CA_to_Time(CA: float, startCA: float, RPM: float) -> float:
+        """t = (CA - CA0)/RPM/6 (reference engine.py:128)."""
+        if RPM <= 0.0:
+            logger.error("engine speed RPM must > 0.")
+            return 0.0
+        t = (CA - startCA) / RPM / 6.0
+        if t < 0.0:
+            logger.error("given CA is less than the starting CA @ IVC.")
+            return 0.0
+        return t
+
+    @staticmethod
+    def convert_Time_to_CA(time: float, startCA: float,
+                           RPM: float) -> float:
+        """CA = CA0 + 6*RPM*t (reference engine.py:166)."""
+        if time < 0.0:
+            logger.error("simulation time must > 0.")
+            return 0.0
+        return startCA + time * RPM * 6.0
+
+    def get_Time(self, CA: float) -> float:
+        """(reference engine.py:193)."""
+        return self.convert_CA_to_Time(CA, self.IVCCA, self.enginespeed)
+
+    def get_CA(self, time: float) -> float:
+        """(reference engine.py:209)."""
+        return self.convert_Time_to_CA(time, self.IVCCA, self.enginespeed)
+
+    # --- crank-angle window (reference engine.py:226-330) ---------------
+
+    @property
+    def starting_CA(self) -> float:
+        """IVC crank angle [deg]."""
+        return self.IVCCA
+
+    @starting_CA.setter
+    def starting_CA(self, startCA: float):
+        self.IVCCA = float(startCA)
+        self.rundurationCA = self.EVOCA - self.IVCCA
+        self.setkeyword("DEG0", float(startCA))
+
+    @property
+    def ending_CA(self) -> float:
+        """EVO crank angle [deg]."""
+        return self.EVOCA
+
+    @ending_CA.setter
+    def ending_CA(self, endCA: float):
+        if endCA <= self.IVCCA:
+            logger.error("ending CA must exceed the starting CA")
+            return
+        self.EVOCA = float(endCA)
+        self.rundurationCA = self.EVOCA - self.IVCCA
+        self.setkeyword("DEGE", float(endCA))
+
+    @property
+    def duration_CA(self) -> float:
+        return self.rundurationCA
+
+    @duration_CA.setter
+    def duration_CA(self, CA: float):
+        if CA <= 0.0:
+            logger.error("duration must > 0")
+            return
+        self.rundurationCA = float(CA)
+        self.EVOCA = self.IVCCA + float(CA)
+
+    # --- geometry (reference engine.py:332-470) -------------------------
+
+    @property
+    def bore(self) -> float:
+        """Bore diameter [cm]."""
+        return self.borediam
+
+    @bore.setter
+    def bore(self, diameter: float):
+        if diameter <= 0.0:
+            logger.error("bore diameter must > 0")
+            return
+        self.borediam = float(diameter)
+        self.borearea = 0.25 * np.pi * diameter ** 2
+        self.setkeyword("BORE", float(diameter))
+
+    @property
+    def stroke(self) -> float:
+        """Stroke [cm]."""
+        return self.enginestroke
+
+    @stroke.setter
+    def stroke(self, s: float):
+        if s <= 0.0:
+            logger.error("stroke must > 0")
+            return
+        self.enginestroke = float(s)
+        self.crankradius = 0.5 * float(s)
+        self.setkeyword("STRK", float(s))
+
+    @property
+    def connecting_rod_length(self) -> float:
+        return self.connectrodlength
+
+    @connecting_rod_length.setter
+    def connecting_rod_length(self, s: float):
+        if s <= 0.0:
+            logger.error("connecting rod length must > 0")
+            return
+        self.connectrodlength = float(s)
+        self.setkeyword("CRLEN", float(s))
+
+    @property
+    def compression_ratio(self) -> float:
+        return self.compressratio
+
+    @compression_ratio.setter
+    def compression_ratio(self, cratio: float):
+        if cratio <= 1.0:
+            logger.error("compression ratio must > 1")
+            return
+        self.compressratio = float(cratio)
+        self.setkeyword("CMPR", float(cratio))
+
+    @property
+    def RPM(self) -> float:
+        return self.enginespeed
+
+    @RPM.setter
+    def RPM(self, speed: float):
+        if speed <= 0.0:
+            logger.error("engine speed must > 0")
+            return
+        self.enginespeed = float(speed)
+        self.setkeyword("RPM", float(speed))
+
+    def set_cylinder_head_area(self, area: float):
+        """Extra head area beyond the bore cross-section [cm2]
+        (reference engine.py:490)."""
+        self.cylinderheadarea = max(float(area), 0.0)
+        self.headareas = self.cylinderheadarea + self.pistonheadarea
+
+    def set_piston_head_area(self, area: float):
+        """(reference engine.py:518)."""
+        self.pistonheadarea = max(float(area), 0.0)
+        self.headareas = self.cylinderheadarea + self.pistonheadarea
+
+    def set_piston_pin_offset(self, offset: float):
+        """(reference engine.py:546)."""
+        if abs(offset) >= max(self.crankradius, 1e-12):
+            logger.error("piston pin offset distance must < crank radius")
+            return
+        self.pistonoffset = float(offset)
+
+    def get_clearance_volume(self) -> float:
+        """[cm3] (reference engine.py:570)."""
+        if self.compressratio <= 1.0:
+            logger.error("please set engine compression ratio first.")
+            return 0.0
+        return self.get_displacement_volume() / (self.compressratio - 1.0)
+
+    def get_displacement_volume(self) -> float:
+        """[cm3] (reference engine.py:593)."""
+        return self.enginestroke * self.borearea
+
+    def list_engine_parameters(self):
+        """(reference engine.py:604)."""
+        print("      === engine parameters ===")
+        print(f"bore diameter         = {self.borediam} [cm]")
+        print(f"stroke                = {self.enginestroke} [cm]")
+        print(f"connecting rod length = {self.connectrodlength} [cm]")
+        print(f"compression ratio     = {self.compressratio} [-]")
+        print(f"engine speed          = {self.enginespeed} [RPM]")
+        print(f"IVC crank angle       = {self.IVCCA} [degree]")
+        print(f"EVO crank angle       = {self.EVOCA} [degree]")
+
+    # --- CA output controls (reference engine.py:621-713) ---------------
+
+    @property
+    def CAstep_for_saving_solution(self) -> float:
+        kw = self.getkeyword("DEGSAVE")
+        if kw is not None:
+            return kw
+        return self.rundurationCA / 100.0 if self.rundurationCA > 0 else 0.0
+
+    @CAstep_for_saving_solution.setter
+    def CAstep_for_saving_solution(self, delta_CA: float):
+        if delta_CA > 0.0:
+            self.setkeyword("DEGSAVE", float(delta_CA))
+        else:
+            logger.error("solution saving CA interval must > 0.")
+
+    @property
+    def CAstep_for_printing_solution(self) -> float:
+        kw = self.getkeyword("DEGPRINT")
+        if kw is not None:
+            return kw
+        return self.rundurationCA / 100.0 if self.rundurationCA > 0 else 0.0
+
+    @CAstep_for_printing_solution.setter
+    def CAstep_for_printing_solution(self, delta_CA: float):
+        if delta_CA > 0.0:
+            self.setkeyword("DEGPRINT", float(delta_CA))
+        else:
+            logger.error("solution printing CA interval must > 0.")
+
+    # --- wall heat transfer (reference engine.py:766-924) ---------------
+
+    def set_wall_heat_transfer(self, model: str,
+                               HTparameters: List[float],
+                               walltemperature: float):
+        """Wall heat-transfer correlation (reference engine.py:766):
+        'dimensionless' (ICHX: Nu = a Re^b Pr^c), 'dimensional' (ICHW),
+        'hohenburg' (ICHH). The TPU build implements the dimensionless
+        Nusselt correlation; the other two are accepted and mapped onto
+        it with a warning (their leading constants differ)."""
+        if self.heattransfermodel >= 0:
+            logger.info("previously defined wall heat transfer model "
+                        "will be overridden.")
+        mymodel = model.lower().rstrip()
+        if mymodel == "dimensionless":
+            model_id = 0
+        elif mymodel in ("dimensional", "dimensioless"):
+            model_id = 1
+            logger.warning("dimensional correlation is mapped onto the "
+                           "dimensionless Nu = a Re^b Pr^c form")
+        elif mymodel == "hohenburg":
+            model_id = 2
+            logger.warning("Hohenburg correlation is mapped onto the "
+                           "dimensionless Nu = a Re^b Pr^c form using "
+                           "its first three parameters")
+        else:
+            raise ValueError(
+                f"engine wall heat transfer model {model!r} is not "
+                "valid; options: 'dimensional', 'dimensionless', "
+                "'hohenburg'")
+        n_req = self.numbHTmodelparameters[model_id]
+        if len(HTparameters) != n_req:
+            # validate BEFORE mutating: a failed call must not leave the
+            # model half-configured
+            raise ValueError(f"{model} requires {n_req} parameters")
+        self.heattransfermodel = model_id
+        self.heattransferparameters = list(HTparameters)
+        self.cylinderwalltemperature = float(walltemperature)
+        self._wallheattransfer = True
+
+    def set_gas_velocity_correlation(self, gasvelparameters: List[float],
+                                     IMEP: Optional[float] = None):
+        """Woschni gas-velocity parameters <C11> <C12> <C2> <swirl>
+        (reference engine.py:841)."""
+        if self.heattransfermodel < 0:
+            raise ValueError(
+                "please specify the wall heat transfer model first.")
+        if len(gasvelparameters) != 4:
+            raise ValueError("gas velocity correlation requires 4 "
+                             "parameters: <C11> <C12> <C2> <swirl>")
+        if self.gasvelocity:
+            logger.info("previously defined gas velocity correlation "
+                        "will be overridden.")
+        self.gasvelocity = list(gasvelparameters)
+        if IMEP is not None:
+            self.HuberIMEP = float(IMEP)
+
+    # --- solver-core assembly -------------------------------------------
+
+    def _require_geometry(self):
+        missing = []
+        if self.borediam <= 0:
+            missing.append("bore")
+        if self.enginestroke <= 0:
+            missing.append("stroke")
+        if self.connectrodlength <= 0:
+            missing.append("connecting_rod_length")
+        if self.compressratio <= 1.0:
+            missing.append("compression_ratio")
+        if self.enginespeed <= 0:
+            missing.append("RPM")
+        if missing:
+            raise ValueError("engine geometry incomplete; set: "
+                             + ", ".join(missing))
+
+    def _geometry(self) -> engine_ops.EngineGeometry:
+        self._require_geometry()
+        return engine_ops.EngineGeometry(
+            bore=self.borediam, stroke=self.enginestroke,
+            conrod=self.connectrodlength,
+            compression_ratio=self.compressratio,
+            rpm=self.enginespeed, piston_offset=self.pistonoffset,
+            head_area=self.headareas)
+
+    def _heat_transfer(self):
+        if not self._wallheattransfer:
+            return None
+        p = self.heattransferparameters
+        a, b, c = p[0], p[1], p[2]
+        kwargs = dict(a=a, b=b, c=c, T_wall=self.cylinderwalltemperature)
+        if self.gasvelocity:
+            C11, C12, C2, swirl = self.gasvelocity
+            kwargs.update(C11=C11, C12=C12, C2=C2, swirl=swirl)
+        return engine_ops.WallHeatTransfer(**kwargs)
+
+    # --- solution access -------------------------------------------------
+
+    def get_engine_heat_release_CAs(self) -> Tuple[float, float, float]:
+        """CA10/CA50/CA90 of cumulative heat release
+        (reference engine.py:953)."""
+        if self._engine_solution is None:
+            raise RuntimeError("please run the engine simulation first.")
+        return engine_ops.heat_release_CAs(self._engine_solution)
+
+    def process_engine_solution(self,
+                                zoneID: Union[int, None] = None):
+        """Per-zone (or zone-0) solution arrays
+        (reference engine.py:1067): dict of CA, time, T, P, V, Y."""
+        sol = self._engine_solution
+        if sol is None:
+            raise RuntimeError("please run the engine simulation first.")
+        z = 0 if zoneID is None else int(zoneID)
+        return {
+            "CA": np.asarray(sol.CA),
+            "time": np.asarray(sol.times),
+            "temperature": np.asarray(sol.T[:, z]),
+            "pressure": np.asarray(sol.P),
+            "volume": np.asarray(sol.V),
+            "mass_fractions": np.asarray(sol.Y[:, z]),
+        }
+
+    def process_average_engine_solution(self):
+        """Mass-averaged solution across zones
+        (reference engine.py:1195)."""
+        sol = self._engine_solution
+        if sol is None:
+            raise RuntimeError("please run the engine simulation first.")
+        m_b = np.asarray(sol.burned_mass)
+        if np.all(np.isfinite(m_b)):
+            # SI: the burned-zone mass grows in time — weight each saved
+            # point by the instantaneous (unburned, burned) masses
+            m_tot = float(np.asarray(sol.zone_mass).sum())
+            w = np.stack([m_tot - m_b, m_b], axis=1) / m_tot  # [n, 2]
+        else:
+            m = np.asarray(sol.zone_mass)
+            w = np.broadcast_to(m / m.sum(),
+                                (np.asarray(sol.T).shape[0], m.size))
+        T_avg = np.einsum("nz,nz->n", np.asarray(sol.T), w)
+        Y_avg = np.einsum("nzk,nz->nk", np.asarray(sol.Y), w)
+        return {
+            "CA": np.asarray(sol.CA),
+            "time": np.asarray(sol.times),
+            "temperature": T_avg,
+            "pressure": np.asarray(sol.P),
+            "volume": np.asarray(sol.V),
+            "mass_fractions": Y_avg,
+        }

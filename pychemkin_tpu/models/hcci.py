@@ -1,0 +1,207 @@
+"""Single/multi-zone HCCI engine model (reference engines/HCCI.py:48).
+
+``HCCIengine`` mirrors the reference's zonal configuration surface —
+per-zone temperature / volume fraction / mass fraction / heat-transfer
+area / composition or equivalence-ratio setup (HCCI.py:172-557) and the
+energy-equation switch CA (HCCI.py:559) — and drives the multi-zone
+uniform-pressure kernel :func:`pychemkin_tpu.ops.engine.solve_hcci`
+where the reference blocks in ``KINAll0D_SetupHCCIInputs`` /
+``SetupHCCIZoneInputs`` (chemkin_wrapper.py:668-672). The zone axis is
+the SURVEY §2.3 second parallel dimension: zones integrate as one
+stacked state and an (RPM, CR, phi, T) sweep vmaps over engines.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..logger import logger
+from ..mixture import Mixture
+from ..ops import engine as engine_ops
+from .engine import Engine
+from .reactormodel import STATUS_FAILED, STATUS_SUCCESS
+
+
+class HCCIengine(Engine):
+    """Single- or multi-zone homogeneous-charge compression-ignition
+    engine (reference HCCI.py:48)."""
+
+    def __init__(self, reactor_condition: Mixture, label: str = "",
+                 nzones: Optional[int] = None):
+        if nzones is None:
+            nzones = 1
+        if label == "":
+            label = "HCCI" if nzones == 1 else "Multi-Zone HCCI"
+        super().__init__(reactor_condition, label)
+        self._nzones = int(nzones)
+        # zonal setup mode (reference HCCI.py:98-101):
+        # 0 uniform, 1 raw mole fractions, 2 equivalence ratio
+        self._zonalsetupmode = 0
+        self.zonetemperature: List[float] = []
+        self.zonevolume: List[float] = []
+        self.usezonemass = False
+        self.zonemass: List[float] = []
+        self.zoneHTarea: List[float] = []
+        self.zonemolefractions: List[np.ndarray] = []
+        self._fuel_recipe = None
+        self._oxid_recipe = None
+        self._product_names: List[str] = []
+        self.zonephi: List[float] = []
+        self._energy_switch_CA: Optional[float] = None
+
+    def get_number_of_zones(self) -> int:
+        """(reference HCCI.py:161)."""
+        return self._nzones
+
+    def _check_zonal(self, values, what: str) -> bool:
+        if len(values) != self._nzones:
+            logger.error("%s needs one value per zone (%d)", what,
+                         self._nzones)
+            return False
+        return True
+
+    def set_zonal_temperature(self, zonetemp: List[float]):
+        """(reference HCCI.py:172)."""
+        if self._check_zonal(zonetemp, "zonal temperature"):
+            self.zonetemperature = [float(t) for t in zonetemp]
+
+    def set_zonal_volume_fraction(self, zonevol: List[float]):
+        """(reference HCCI.py:211)."""
+        if self._check_zonal(zonevol, "zonal volume fraction"):
+            self.zonevolume = [float(v) for v in zonevol]
+
+    def set_zonal_mass_fraction(self, zonemass: List[float]):
+        """(reference HCCI.py:251). Overrides any volume-fraction split:
+        the volume partition follows from the zonal ideal-gas states at
+        IVC (V_i = m_i / rho_i at the shared pressure)."""
+        if self._check_zonal(zonemass, "zonal mass fraction"):
+            self.usezonemass = True
+            self.zonemass = [float(m) for m in zonemass]
+
+    def set_zonal_heat_transfer_area_fraction(self, zonearea: List[float]):
+        """(reference HCCI.py:293)."""
+        if self._check_zonal(zonearea, "zonal HT area fraction"):
+            self.zoneHTarea = [float(a) for a in zonearea]
+
+    def set_zonal_gas_mole_fractions(self, zonemolefrac):
+        """Per-zone raw mole fractions [NZ, KK]
+        (reference HCCI.py:333)."""
+        arr = [np.asarray(z, dtype=np.float64) for z in zonemolefrac]
+        if self._check_zonal(arr, "zonal mole fractions"):
+            self.zonemolefractions = arr
+            self._zonalsetupmode = 1
+
+    def define_fuel_composition(self, recipe):
+        """(reference HCCI.py:377)."""
+        self._fuel_recipe = recipe
+
+    def define_oxid_composition(self, recipe):
+        """(reference HCCI.py:396)."""
+        self._oxid_recipe = recipe
+
+    def define_product_composition(self, products: List[str]):
+        """(reference HCCI.py:415)."""
+        self._product_names = list(products)
+
+    def set_zonal_equivalence_ratio(self, zonephi: List[float]):
+        """(reference HCCI.py:471). Needs fuel/oxidizer compositions
+        defined first; zone compositions come from the stoichiometric
+        balance at each phi."""
+        if self._fuel_recipe is None or self._oxid_recipe is None:
+            logger.error("define fuel and oxidizer compositions first")
+            return
+        if self._check_zonal(zonephi, "zonal equivalence ratio"):
+            self.zonephi = [float(p) for p in zonephi]
+            self._zonalsetupmode = 2
+
+    def set_energy_equation_switch_ON_CA(self, switchCA: float):
+        """Suppress chemistry until this CA (reference HCCI.py:559)."""
+        if not self.IVCCA < switchCA < self.EVOCA:
+            logger.error("switch CA must lie inside (IVC, EVO)")
+            return
+        self._energy_switch_CA = float(switchCA)
+
+    # ------------------------------------------------------------------
+
+    def _zone_initials(self):
+        mech = self._effective_mech()
+        KK = mech.n_species
+        NZ = self._nzones
+        T0 = self.reactor_condition.temperature
+        zone_T = (np.asarray(self.zonetemperature)
+                  if self.zonetemperature else np.full(NZ, T0))
+        vol = (np.asarray(self.zonevolume)
+               if self.zonevolume else np.full(NZ, 1.0 / NZ))
+        if self._zonalsetupmode == 1 and self.zonemolefractions:
+            from ..ops import thermo
+            import jax.numpy as jnp
+            zone_Y = np.stack([
+                np.asarray(thermo.X_to_Y(
+                    mech, jnp.asarray(x / np.sum(x))))
+                for x in self.zonemolefractions])
+        elif self._zonalsetupmode == 2 and self.zonephi:
+            zone_Y = np.stack([
+                self._mixture_from_phi(phi) for phi in self.zonephi])
+        else:
+            zone_Y = np.broadcast_to(np.asarray(self.reactor_condition.Y),
+                                     (NZ, KK)).copy()
+        return zone_T, vol, zone_Y
+
+    def _recipe_to_x(self, recipe) -> np.ndarray:
+        mech = self._effective_mech()
+        x = np.zeros(mech.n_species)
+        items = recipe.items() if isinstance(recipe, dict) else recipe
+        for name, f in items:
+            x[mech.species_index(name)] += float(f)
+        return x
+
+    def _mixture_from_phi(self, phi: float) -> np.ndarray:
+        """Mass fractions for one zone at equivalence ratio phi using the
+        fuel/oxidizer recipes (reference HCCI.py:728 keyword path)."""
+        from ..mixture import Mixture as Mix
+
+        if not self._product_names:
+            raise ValueError("define_product_composition must list the "
+                             "complete-combustion products first")
+        mix = Mix(self.chemistry)
+        mix.temperature = self.reactor_condition.temperature
+        mix.pressure = self.reactor_condition.pressure
+        fuel = self._recipe_to_x(self._fuel_recipe)
+        oxid = self._recipe_to_x(self._oxid_recipe)
+        mix.X_by_Equivalence_Ratio(self.chemistry, fuel, oxid,
+                                   np.zeros_like(fuel),
+                                   self._product_names, float(phi))
+        return np.asarray(mix.Y)
+
+    def run(self) -> int:
+        """Integrate IVC -> EVO (reference HCCI.py:1241)."""
+        zone_T, vol, zone_Y = self._zone_initials()
+        geo = self._geometry()
+        ht = self._heat_transfer()
+        rtol, atol = self.tolerances
+        sol = engine_ops.solve_hcci(
+            self._effective_mech(), geo,
+            T0=self.reactor_condition.temperature,
+            P0=self.reactor_condition.pressure,
+            Y0=np.asarray(self.reactor_condition.Y),
+            start_CA=self.IVCCA, end_CA=self.EVOCA,
+            ht=ht, zone_T=zone_T, zone_vol_frac=vol, zone_Y=zone_Y,
+            zone_mass_frac=(np.asarray(self.zonemass)
+                            if self.usezonemass else None),
+            zone_ht_frac=(np.asarray(self.zoneHTarea)
+                          if self.zoneHTarea else None),
+            n_zones=self._nzones,
+            energy_switch_CA=self._energy_switch_CA,
+            rtol=max(rtol, 1e-9), atol=atol)
+        self._engine_solution = sol
+        ok = bool(sol.success)
+        self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        return 0 if ok else 1
+
+    def get_ignition_CA(self) -> float:
+        """CA of peak mass-averaged dT/dt (nan if no ignition)."""
+        if self._engine_solution is None:
+            raise RuntimeError("please run the engine simulation first.")
+        return float(self._engine_solution.ignition_CA)

@@ -21,8 +21,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..inlet import Stream
+from ..inlet import Stream, create_stream_from_mixture
 from ..logger import logger
+from ..mixture import Mixture
 from ..ops import pfr as pfr_ops
 from ..ops import reactors as reactor_ops
 from .batch import BatchReactors
@@ -222,6 +223,32 @@ class PlugFlowReactor(BatchReactors):
         self._solution_Y = Y
         return 0
 
+    def set_inlet_stream(self, stream: Stream):
+        """Replace the feed stream (state + mass flow) — used by the
+        reactor network when synthesizing the internal inlet
+        (reference network usage: hybridreactornetwork.py:1148)."""
+        import copy as _copy
+        if not isinstance(stream, Stream):
+            raise TypeError("inlet must be a Stream")
+        self._condition = _copy.deepcopy(stream)
+        self._mdot = stream.convert_to_mass_flowrate()
+
+    def get_exit_stream(self) -> "Stream":
+        """Exit state as a Stream carrying the (constant) mass flow rate
+        — what the reactor hands to a downstream network node
+        (reference network usage: hybridreactornetwork.py:1061)."""
+        if self._pfr_solution is None:
+            raise RuntimeError("run() the reactor first")
+        sol = self._pfr_solution
+        mix = Mixture(self.chemistry)
+        mix.temperature = float(np.asarray(sol.T)[-1])
+        mix.pressure = float(np.asarray(sol.P)[-1])
+        mix.Y = np.clip(np.asarray(sol.Y)[-1], 0.0, None)
+        out = create_stream_from_mixture(mix, label=f"{self.label}.exit")
+        out.mass_flowrate = self._mdot * 1.0
+        out.flowarea = self._flowarea
+        return out
+
     def run_sweep(self, T0s=None, P0s=None, Y0s=None, lengths=None, *,
                   min_slope=1.0):
         """Batched PFR sweep over inlet conditions (vmap over
@@ -275,16 +302,9 @@ class PlugFlowReactor(BatchReactors):
 
     @property
     def exit_stream(self) -> Stream:
-        """Outlet stream at the last grid point."""
-        if self._pfr_solution is None:
-            raise RuntimeError("run() the reactor first")
-        sol = self._pfr_solution
-        out = Stream(self.chemistry, label=f"{self.label}-exit")
-        out.temperature = float(sol.T[-1])
-        out.pressure = float(sol.P[-1])
-        out.Y = np.asarray(sol.Y[-1])
-        out.mass_flowrate = self._mdot
-        return out
+        """Outlet stream at the last grid point (alias of
+        :meth:`get_exit_stream`)."""
+        return self.get_exit_stream()
 
 
 class PlugFlowReactor_EnergyConservation(PlugFlowReactor):

@@ -1,0 +1,249 @@
+"""Spark-ignition engine model with prescribed burn (reference
+engines/SI.py:47).
+
+``SIengine`` mirrors the reference's burn-profile surface — Wiebe
+parameters (SI.py:141), SOC/duration timing (:180), CA10/50/90 anchor
+points (:210), tabulated mass-burned profile (:266), combustion
+efficiency (:303) — and drives the two-zone Wiebe-burn kernel
+:func:`pychemkin_tpu.ops.engine.solve_si`. The burned-zone inflow is the
+complete-combustion product composition from the stoichiometry solver
+(the reference computes a burned-product equilibrium inside the native
+solver; active burned-zone chemistry here relaxes the products toward
+that same equilibrium).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..logger import logger
+from ..mixture import Mixture
+from ..ops import engine as engine_ops
+from .engine import Engine
+from .reactormodel import STATUS_FAILED, STATUS_SUCCESS
+
+#: Wiebe defaults (classic SI values)
+_DEFAULT_WIEBE_N = 2.0
+_DEFAULT_WIEBE_B = 5.0
+
+
+class SIengine(Engine):
+    """Spark-ignition engine with a prescribed mass-burned profile
+    (reference SI.py:47)."""
+
+    def __init__(self, reactor_condition: Mixture,
+                 label: Optional[str] = None):
+        super().__init__(reactor_condition, label or "SI")
+        # burn-profile mode (reference SI.py:95):
+        # 0 unset, 1 Wiebe, 2 anchor points, 3 tabulated profile
+        self._burnmode = 0
+        self.wieben = _DEFAULT_WIEBE_N
+        self.wiebeb = _DEFAULT_WIEBE_B
+        self.sparktiming = 0.0       # SOC [deg]
+        self.burnduration = 0.0      # [deg]
+        self.MBpoints = 0
+        self.MBangles: Optional[np.ndarray] = None
+        self.MBfractions: Optional[np.ndarray] = None
+        self.burnefficiency = 1.0
+        self._product_names: List[str] = []
+        self._fuel_recipe = None
+        self._oxid_recipe = None
+
+    # --- burn profile configuration (reference SI.py:141-301) ----------
+
+    def wiebe_parameters(self, n: float, b: float):
+        """Wiebe x_b = 1 - exp(-b ((CA-SOC)/dur)^(n+1))
+        (reference SI.py:141)."""
+        if n <= 0.0 or b <= 0.0:
+            raise ValueError("Wiebe function parameters n and b must "
+                             "> 0.0.")
+        if self._burnmode > 0:
+            logger.info("previous burned mass profile setup will be "
+                        "overridden.")
+        self._burnmode = 1
+        self.wieben = float(n)
+        self.wiebeb = float(b)
+
+    def set_burn_timing(self, SOC: float, duration: float = 0.0):
+        """Start of combustion + burn duration [deg]
+        (reference SI.py:180)."""
+        if SOC <= self.IVCCA:
+            raise ValueError("start of combustion CA must > IVC CA "
+                             f"{self.IVCCA}")
+        if duration <= 0.0:
+            raise ValueError("mass burned duration must > 0.0.")
+        self.sparktiming = float(SOC)
+        self.burnduration = float(duration)
+
+    def set_burn_anchor_points(self, CA10: float, CA50: float,
+                               CA90: float):
+        """Fit the Wiebe parameters to the CA10/50/90 anchors
+        (reference SI.py:210). With s(x) = -ln(1 - x) the Wiebe curve
+        gives s_i = b ((CA_i - SOC)/d)^(n+1); the two anchor RATIOS are
+        independent of b and d, so SOC solves a 1-D root problem and
+        (n, b, d) follow in closed form (b is pinned by x_b = 0.999 at
+        the end of the burn window)."""
+        if not CA10 < CA50 < CA90:
+            raise ValueError(
+                "the anchor points must be given in ascending order.")
+        s10, s50, s90 = (-np.log(1 - x) for x in (0.10, 0.50, 0.90))
+        r_target = np.log(s50 / s10) / np.log(s90 / s50)
+
+        def ratio(soc):
+            m_a = np.log((CA50 - soc) / (CA10 - soc))
+            m_b = np.log((CA90 - soc) / (CA50 - soc))
+            return m_a / m_b
+
+        # ratio(soc) is monotone in soc: bisect on (far-left, CA10)
+        lo = CA10 - 50.0 * (CA90 - CA10)
+        hi = CA10 - 1e-9 * (CA90 - CA10)
+        f_lo = ratio(lo) - r_target
+        for _ in range(200):
+            mid = 0.5 * (lo + hi)
+            f_mid = ratio(mid) - r_target
+            if f_lo * f_mid <= 0:
+                hi = mid
+            else:
+                lo = mid
+                f_lo = f_mid
+            if hi - lo < 1e-12 * (CA90 - CA10):
+                break
+        soc = 0.5 * (lo + hi)
+        m1 = np.log(s50 / s10) / np.log((CA50 - soc) / (CA10 - soc))
+        b = np.log(1000.0)              # x_b = 0.999 at xi = 1
+        d = (CA50 - soc) * (b / s50) ** (1.0 / m1)
+        self._burnmode = 2
+        self.wieben = float(m1 - 1.0)
+        self.wiebeb = float(b)
+        self.sparktiming = float(soc)
+        self.burnduration = float(d)
+
+    def set_mass_burned_profile(self, crankangles, fractions) -> int:
+        """Tabulated mass-burned profile (reference SI.py:266): the
+        crank angles are NORMALIZED to [0, 1] over the burn window set
+        by ``set_burn_timing`` (the reference's own contract: "the crank
+        angles must 0 <= and <= 1")."""
+        crankangles = np.asarray(crankangles, dtype=np.float64)
+        fractions = np.asarray(fractions, dtype=np.float64)
+        self.MBpoints = len(crankangles)
+        if len(fractions) != self.MBpoints:
+            logger.error("data arrays must have the same size.")
+            return 1
+        if self.MBpoints <= 1:
+            logger.error("profile must have more than 1 data pair.")
+            return 2
+        if crankangles.min() < 0.0 or crankangles.max() > 1.0:
+            logger.error("profile crank angles must be normalized to "
+                         "[0, 1] over the burn window (reference "
+                         "SI.py:266)")
+            return 3
+        self.MBangles = crankangles
+        self.MBfractions = fractions
+        self._burnmode = 3
+        return 0
+
+    def set_combustion_efficiency(self, efficiency: float):
+        """(reference SI.py:303)."""
+        if efficiency < 0.0 or efficiency > 1.0:
+            raise ValueError("efficiency must > 0.0 and <= 1.0.")
+        self.burnefficiency = float(efficiency)
+        self.setkeyword("BEFF", float(efficiency))
+
+    def define_fuel_composition(self, recipe):
+        """Fuel recipe for the burned-product stoichiometry."""
+        self._fuel_recipe = recipe
+
+    def define_oxid_composition(self, recipe):
+        self._oxid_recipe = recipe
+
+    def define_product_composition(self, products: List[str]):
+        """Complete-combustion product species entering the burned zone."""
+        self._product_names = list(products)
+
+    # ------------------------------------------------------------------
+
+    def _burned_products_Y(self) -> np.ndarray:
+        """Complete-combustion product mass fractions for the burned-zone
+        inflow, from the element-conservation stoichiometry solver
+        (utilities.calculate_stoichiometrics) applied to the cylinder
+        charge."""
+        from ..ops import thermo
+        from ..utilities import calculate_stoichiometrics
+        import jax.numpy as jnp
+
+        mech = self._effective_mech()
+        if not self._product_names:
+            raise ValueError(
+                "define_product_composition must list the burned "
+                "product species (e.g. ['CO2', 'H2O', 'N2'])")
+        X0 = np.asarray(self.reactor_condition.X)
+        # split the charge into fuel (C/H-bearing) and the rest; the
+        # product coefficients come from element conservation
+        prod_index = np.array(
+            [mech.species_index(s) for s in self._product_names],
+            dtype=np.int64)
+        # element totals of the whole charge must be carried by products
+        ncf = np.asarray(mech.ncf)           # [KK, MM]
+        b = ncf.T @ X0                       # element totals
+        A = ncf[prod_index].T                # [MM, NP]
+        nu, *_ = np.linalg.lstsq(A, b, rcond=None)
+        nu = np.clip(nu, 0.0, None)
+        Xp = np.zeros(mech.n_species)
+        Xp[prod_index] = nu
+        if Xp.sum() <= 0:
+            raise ValueError("product composition solve failed; check "
+                             "the product species list")
+        return np.asarray(thermo.X_to_Y(mech, jnp.asarray(Xp / Xp.sum())))
+
+    def _wiebe_tuple(self):
+        if self._burnmode == 0:
+            raise ValueError("set the burn profile first "
+                             "(wiebe_parameters / set_burn_anchor_points"
+                             " + set_burn_timing)")
+        if self._burnmode in (1, 3) and self.burnduration <= 0.0:
+            raise ValueError("set_burn_timing must set SOC and duration")
+        if self._burnmode == 3:
+            # fit a Wiebe curve to the tabulated profile (least squares
+            # in the log-survival domain)
+            xi = np.clip(self.MBangles, 1e-6, 1.0)
+            xb = np.clip(self.MBfractions, 1e-9, 1.0 - 1e-9)
+            mask = (xb > 0.01) & (xb < 0.99)
+            if mask.sum() >= 2:
+                lx = np.log(xi[mask])
+                ls = np.log(-np.log(1.0 - xb[mask]))
+                m1, lnb = np.polyfit(lx, ls, 1)
+                self.wieben = float(m1 - 1.0)
+                self.wiebeb = float(np.exp(lnb))
+        return (self.sparktiming, self.burnduration, self.wiebeb,
+                self.wieben)
+
+    def run(self) -> int:
+        """Integrate IVC -> EVO (reference SI.py run path)."""
+        geo = self._geometry()
+        ht = self._heat_transfer()
+        wiebe = self._wiebe_tuple()
+        Yp = self._burned_products_Y()
+        rtol, atol = self.tolerances
+        sol = engine_ops.solve_si(
+            self._effective_mech(), geo,
+            T0=self.reactor_condition.temperature,
+            P0=self.reactor_condition.pressure,
+            Y0=np.asarray(self.reactor_condition.Y),
+            start_CA=self.IVCCA, end_CA=self.EVOCA,
+            wiebe=wiebe, Y_products=Yp, ht=ht,
+            comb_eff=self.burnefficiency,
+            rtol=max(rtol, 1e-9), atol=atol)
+        self._engine_solution = sol
+        ok = bool(sol.success)
+        self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        return 0 if ok else 1
+
+    def get_mass_burned_fraction(self) -> np.ndarray:
+        """x_b(CA) over the saved solution grid."""
+        sol = self._engine_solution
+        if sol is None:
+            raise RuntimeError("please run the engine simulation first.")
+        m_tot = float(np.asarray(sol.zone_mass).sum())
+        return np.asarray(sol.burned_mass) / m_tot

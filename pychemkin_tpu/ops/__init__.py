@@ -1,0 +1,45 @@
+"""Numerical kernels (JAX) — the TPU-native replacement for the
+reference's native Chemkin-CFD-API blocks (SURVEY.md §2.2):
+
+- :mod:`.thermo`       NASA-7 thermodynamics, ideal-gas EOS, X/Y/C
+- :mod:`.transport`    pure-species + mixture-averaged transport
+- :mod:`.kinetics`     reaction rates / ROP (the hot kernel)
+- :mod:`.equilibrium`  element-potential Gibbs minimization + CJ
+- :mod:`.odeint`       SDIRK3 stiff integrator (vmap-able)
+- :mod:`.reactors`     0-D batch-reactor RHS + batched solves
+- :mod:`.psr`          steady-state PSR Newton/pseudo-transient
+- :mod:`.pfr`          plug-flow axial integration
+- :mod:`.flame1d`      1-D premixed flame damped-Newton solver
+- :mod:`.blocktridiag` block-Thomas solve for flame Newton systems
+- :mod:`.linalg`       platform-adaptive LU (f32+refinement on TPU)
+"""
+
+from . import (
+    blocktridiag,
+    equilibrium,
+    flame1d,
+    kinetics,
+    linalg,
+    odeint,
+    pfr,
+    psr,
+    reactors,
+    sensitivity,
+    thermo,
+    transport,
+)
+
+__all__ = [
+    "blocktridiag",
+    "equilibrium",
+    "flame1d",
+    "kinetics",
+    "linalg",
+    "odeint",
+    "pfr",
+    "psr",
+    "reactors",
+    "sensitivity",
+    "thermo",
+    "transport",
+]

@@ -1,0 +1,599 @@
+"""IC-engine physics kernels (JAX): slider-crank kinematics, in-cylinder
+wall heat transfer, single/multi-zone HCCI, and the Wiebe-burn SI model.
+
+TPU-native replacement for the reference's native engine problem types
+(``KINAll0D_SetupHCCIInputs`` / ``SetupHCCIZoneInputs`` / ``SetupSIInputs``,
+reference chemkin_wrapper.py:668-687, driven from engines/engine.py,
+engines/HCCI.py and engines/SI.py). The reference marshals engine
+geometry into the licensed Fortran library and blocks for the whole
+IVC→EVO integration; here the engine RHS is a pure JAX function over the
+zone-stacked state, so a parameter sweep (RPM × CR × phi × T_ivc) runs
+as ONE vmapped integration and the multi-zone coupling is a couple of
+axis reductions.
+
+Models:
+
+- Kinematics: slider-crank volume/area vs crank angle
+  (reference engine.py:128-166 CA<->time, :570-603 volumes).
+- Wall heat transfer: Nusselt-correlation film coefficient
+  h = a*(lambda/B)*Re^b*Pr^c with the Woschni gas-velocity correlation
+  w = (C11 + C12*swirl)*Sp + C2*(Vd*T_ivc)/(P_ivc*V_ivc)*(P - P_motored)
+  (reference engine.py:766-897 ICHX/GVEL keywords); the motored pressure
+  uses the isentropic closed-cylinder estimate P_ivc*(V_ivc/V)^gamma.
+- HCCI: single zone = CONV energy equation with V(theta(t)); multi-zone
+  = N zones at uniform pressure sharing the cylinder volume, coupled
+  through the pressure-rate closure (reference HCCI.py:89-96 zones).
+- SI: two zones (unburned/burned) with Wiebe mass-burned transfer;
+  the transferred parcel enters the burned zone as complete-combustion
+  products at the unburned-gas enthalpy and the burned-zone chemistry
+  (active) relaxes it toward equilibrium — the reference computes the
+  burned-product equilibrium inside the native solver (SI.py:47);
+  chemistry stays active in the unburned zone for knock prediction.
+
+Units CGS; angles in degrees, time in seconds.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import R_GAS
+from . import kinetics, thermo
+from .odeint import Event, odeint
+
+_DEG2RAD = jnp.pi / 180.0
+
+
+class EngineGeometry(NamedTuple):
+    """Slider-crank geometry (reference engine.py:332-470 properties).
+
+    All lengths cm, areas cm^2; ``rpm`` rev/min; CA in degrees with
+    TDC = 0 (IVC is typically negative)."""
+    bore: Any
+    stroke: Any
+    conrod: Any          # connecting rod length
+    compression_ratio: Any
+    rpm: Any
+    piston_offset: Any = 0.0
+    head_area: Any = 0.0      # extra (cylinder head + piston crown) area
+    #                           beyond the two bore cross-sections
+
+
+def ca_to_time(CA, start_CA, rpm):
+    """Crank angle [deg] -> time since IVC [s]
+    (reference engine.py:128: t = (CA - CA0) / RPM / 6)."""
+    return (CA - start_CA) / rpm / 6.0
+
+
+def time_to_ca(t, start_CA, rpm):
+    """Time since IVC [s] -> crank angle [deg]
+    (reference engine.py:166)."""
+    return start_CA + t * rpm * 6.0
+
+
+def displacement_volume(geo: EngineGeometry):
+    """Swept volume [cm^3] (reference engine.py:593)."""
+    return 0.25 * jnp.pi * geo.bore ** 2 * geo.stroke
+
+
+def clearance_volume(geo: EngineGeometry):
+    """Minimum volume [cm^3] (reference engine.py:570)."""
+    return displacement_volume(geo) / (geo.compression_ratio - 1.0)
+
+
+def cylinder_volume(geo: EngineGeometry, CA):
+    """Instantaneous cylinder volume [cm^3] at crank angle CA [deg],
+    slider-crank with optional piston-pin offset."""
+    a = 0.5 * geo.stroke                      # crank radius
+    th = CA * _DEG2RAD
+    ell = geo.conrod
+    off = geo.piston_offset
+    # piston position from crank center along the cylinder axis
+    s = a * jnp.cos(th) + jnp.sqrt(ell ** 2 - (a * jnp.sin(th) - off) ** 2)
+    s_tdc = jnp.sqrt((ell + a) ** 2 - off ** 2)
+    x = s_tdc - s                             # distance from TDC
+    return clearance_volume(geo) + 0.25 * jnp.pi * geo.bore ** 2 * x
+
+
+def cylinder_wall_area(geo: EngineGeometry, V):
+    """Heat-transfer area [cm^2]: two bore cross-sections (+ any extra
+    head/crown area) plus the exposed liner 4V/B."""
+    bore_area = 0.25 * jnp.pi * geo.bore ** 2
+    return 2.0 * bore_area + geo.head_area + 4.0 * V / geo.bore
+
+
+def mean_piston_speed(geo: EngineGeometry):
+    """[cm/s]: 2 * stroke * RPM / 60."""
+    return 2.0 * geo.stroke * geo.rpm / 60.0
+
+
+class WallHeatTransfer(NamedTuple):
+    """Nusselt-correlation wall heat transfer (reference
+    engine.py:766 ICHX 'dimensionless correlation': Nu = a Re^b Pr^c)
+    with the Woschni gas-velocity correlation (reference engine.py:841
+    GVEL parameters C11, C12, C2, swirl ratio)."""
+    a: Any
+    b: Any
+    c: Any
+    T_wall: Any
+    C11: Any = 2.28
+    C12: Any = 0.308
+    C2: Any = 3.24e-3         # combustion-term coefficient (Woschni, SI
+    #                           units 3.24e-3 m/(s K); value here is used
+    #                           with the CGS group which preserves it)
+    swirl: Any = 0.0
+    gamma_motored: Any = 1.33
+
+
+def woschni_velocity(ht: WallHeatTransfer, geo: EngineGeometry, P, V,
+                     P_ivc, V_ivc, T_ivc):
+    """Characteristic gas velocity w [cm/s]."""
+    Sp = mean_piston_speed(geo)
+    Vd = displacement_volume(geo)
+    P_mot = P_ivc * (V_ivc / V) ** ht.gamma_motored
+    # Woschni's combustion term is dimensional: C2 [m/(s K)] * the group
+    # (Vd T_ivc)/(P_ivc V_ivc) * (P - P_mot) which has units K * P-units
+    # /P-units -> K; convert m/s -> cm/s with 100x
+    w_comb = 100.0 * ht.C2 * (Vd * T_ivc) / (P_ivc * V_ivc) * (
+        jnp.maximum(P - P_mot, 0.0))
+    return (ht.C11 + ht.C12 * ht.swirl) * Sp + w_comb
+
+
+def wall_heat_rate(ht: WallHeatTransfer, geo: EngineGeometry, mech,
+                   T, P, Y, V, P_ivc, V_ivc, T_ivc):
+    """Qdot_wall [erg/s] OUT of the gas (positive = losing heat)."""
+    from . import transport as tr
+
+    X = thermo.Y_to_X(mech, Y)
+    lam = tr.mixture_conductivity(mech, T, X)      # erg/cm-K-s
+    mu = tr.mixture_viscosity(mech, T, X)          # g/cm-s
+    rho = thermo.density(mech, T, P, Y)
+    cp = thermo.mixture_cp_mass(mech, T, Y)
+    w = woschni_velocity(ht, geo, P, V, P_ivc, V_ivc, T_ivc)
+    Re = rho * w * geo.bore / mu
+    Pr = cp * mu / lam
+    h = ht.a * (lam / geo.bore) * jnp.maximum(Re, 1.0) ** ht.b \
+        * Pr ** ht.c
+    A = cylinder_wall_area(geo, V)
+    return h * A * (T - ht.T_wall)
+
+
+class EngineArgs(NamedTuple):
+    """Static-per-solve engine data for the RHS closures."""
+    mech: Any
+    geo: EngineGeometry
+    ht: Any                  # WallHeatTransfer or None (adiabatic)
+    start_CA: Any
+    P_ivc: Any
+    V_ivc: Any
+    T_ivc: Any
+    zone_mass: Any           # [NZ] zone masses, g
+    # chemistry is suppressed below this crank angle (HCCI energy
+    # switch, reference HCCI.py:559); -1e9 = always on
+    chem_on_CA: Any = -1.0e9
+    # per-zone wall heat-transfer area fractions (reference
+    # HCCI.py:293); None = apportion by instantaneous volume fraction
+    zone_ht_frac: Any = None
+    # SI-only fields
+    wiebe: Any = None        # (theta0, duration, a, m) or None
+    Y_products: Any = None   # [KK] complete-combustion product mass fracs
+    comb_eff: Any = 1.0
+
+
+# ---------------------------------------------------------------------------
+# multi-zone HCCI RHS (single zone == NZ=1)
+
+
+def hcci_rhs(t, y, args: EngineArgs):
+    """Multi-zone HCCI at uniform pressure (reference HCCI.py zones):
+
+    state y = [NZ, KK+1] flattened — per-zone mass fractions + T.
+    Zones share the cylinder pressure; their volumes partition V(theta).
+    Pressure is algebraic: P = sum_i m_i Rbar_i T_i / V(t). The energy
+    equation per zone (constant zone mass, cp form):
+        m_i cp_i dT_i/dt = V_i dP/dt - Qdot_i - sum_k h_k wdot_ik W_k V_i
+    and dP/dt follows from differentiating the volume constraint."""
+    mech = args.mech
+    NZ = args.zone_mass.shape[0]
+    KK = mech.n_species
+    yz = y.reshape(NZ, KK + 1)
+    Y = jnp.clip(yz[:, :KK], 0.0, 1.0)
+    T = jnp.maximum(yz[:, KK], 200.0)
+    m = args.zone_mass
+
+    CA = time_to_ca(t, args.start_CA, args.geo.rpm)
+    V_cyl = cylinder_volume(args.geo, CA)
+    # dV/dt by AD of the kinematics
+    dVdt = jax.grad(
+        lambda tt: cylinder_volume(args.geo,
+                                   time_to_ca(tt, args.start_CA,
+                                              args.geo.rpm)))(t)
+
+    wbar = jax.vmap(lambda Yi: thermo.mean_molecular_weight_Y(mech, Yi))(Y)
+    Rbar = R_GAS / wbar                                   # erg/g-K
+    P = jnp.sum(m * Rbar * T) / V_cyl
+    V_i = m * Rbar * T / P
+    rho_i = m / V_i
+
+    # chemistry gate: zeroing wdot suppresses BOTH the composition
+    # change and the heat-release term consistently (the HCCI energy
+    # switch must not release enthalpy from frozen composition)
+    chem_gate = jnp.where(CA >= args.chem_on_CA, 1.0, 0.0)
+
+    def zone_chem(Ti, Yi, rhoi):
+        C = thermo.Y_to_C(mech, Yi, rhoi)
+        wdot = kinetics.net_production_rates(mech, Ti, C, P) * chem_gate
+        cp = thermo.mixture_cp_mass(mech, Ti, Yi)
+        h_k = thermo.h_RT(mech, Ti) * (R_GAS * Ti)        # erg/mol
+        return wdot, cp, h_k
+
+    wdot, cp, h_k = jax.vmap(zone_chem)(T, Y, rho_i)
+    dY = wdot * mech.wt[None, :] / rho_i[:, None]         # [NZ, KK] 1/s
+
+    # chemistry heat source per zone [erg/s]
+    S = -jnp.einsum("zk,zk->z", h_k, wdot) * V_i
+    # wall heat loss, apportioned by zone volume fraction
+    if args.ht is not None:
+        T_mass_avg = jnp.sum(m * T) / jnp.sum(m)
+        Y_avg = jnp.sum(m[:, None] * Y, axis=0) / jnp.sum(m)
+        Q_wall = wall_heat_rate(args.ht, args.geo, mech, T_mass_avg, P,
+                                Y_avg, V_cyl, args.P_ivc, args.V_ivc,
+                                args.T_ivc)
+        if args.zone_ht_frac is not None:
+            Q_i = -Q_wall * args.zone_ht_frac
+        else:
+            Q_i = -Q_wall * V_i / V_cyl
+    else:
+        Q_i = jnp.zeros(NZ)
+
+    # Rbar rate from composition change
+    dwbar = -wbar ** 2 * jnp.einsum(
+        "zk,k->z", dY, 1.0 / mech.wt)                     # dWbar/dt
+    dRbar = -Rbar / wbar * dwbar
+
+    # dP/dt closure from d/dt [ sum m_i Rbar_i T_i / P ] = dV/dt
+    mcp = m * cp
+    A = jnp.sum(m * Rbar * V_i / mcp) / P - V_cyl / P
+    B = (jnp.sum(m * Rbar * (Q_i + S) / mcp)
+         + jnp.sum(m * T * dRbar)) / P
+    dPdt = (dVdt - B) / A
+
+    dT = (V_i * dPdt + Q_i + S) / mcp
+    return jnp.concatenate([dY, dT[:, None]], axis=1).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# SI two-zone Wiebe-burn RHS
+
+
+def wiebe_fraction(CA, theta0, duration, a, m):
+    """Cumulative mass-burned fraction x_b(CA)
+    (reference SI.py:141 wiebe_parameters):
+        x_b = 1 - exp(-a ((CA - theta0)/duration)^(m+1))."""
+    xi = jnp.clip((CA - theta0) / duration, 0.0, 1.0)
+    return jnp.where(CA < theta0, 0.0, 1.0 - jnp.exp(-a * xi ** (m + 1.0)))
+
+
+def si_rhs(t, y, args: EngineArgs):
+    """Two-zone SI: unburned (zone 0) and burned (zone 1) at uniform
+    pressure; the Wiebe profile transfers mass from unburned to burned.
+    The transferred parcel arrives in the burned zone as
+    complete-combustion products (composition args.Y_products, scaled by
+    the combustion efficiency) carrying its unburned enthalpy; active
+    burned-zone chemistry relaxes it to equilibrium. State:
+    y = [2, KK+1] flattened + [m_b] (burned mass)."""
+    mech = args.mech
+    KK = mech.n_species
+    yz = y[:2 * (KK + 1)].reshape(2, KK + 1)
+    m_b = jnp.clip(y[-1], 1e-9 * jnp.sum(args.zone_mass),
+                   jnp.sum(args.zone_mass))
+    m_tot = jnp.sum(args.zone_mass)
+    m_u = jnp.maximum(m_tot - m_b, 1e-9 * m_tot)
+    m = jnp.stack([m_u, m_b])
+
+    Y = jnp.clip(yz[:, :KK], 0.0, 1.0)
+    T = jnp.maximum(yz[:, KK], 200.0)
+
+    CA = time_to_ca(t, args.start_CA, args.geo.rpm)
+    V_cyl = cylinder_volume(args.geo, CA)
+    dVdt = jax.grad(
+        lambda tt: cylinder_volume(args.geo,
+                                   time_to_ca(tt, args.start_CA,
+                                              args.geo.rpm)))(t)
+
+    theta0, dur, a_w, m_w = args.wiebe
+    # burn rate from the Wiebe profile [g/s]
+    dxb = jax.grad(lambda ca: wiebe_fraction(ca, theta0, dur, a_w, m_w))(
+        CA) * args.geo.rpm * 6.0
+    mdot_b = m_tot * jnp.maximum(dxb, 0.0)
+
+    wbar = jax.vmap(lambda Yi: thermo.mean_molecular_weight_Y(mech, Yi))(Y)
+    Rbar = R_GAS / wbar
+    P = jnp.sum(m * Rbar * T) / V_cyl
+    V_i = m * Rbar * T / P
+    rho_i = m / V_i
+
+    def zone_chem(Ti, Yi, rhoi):
+        C = thermo.Y_to_C(mech, Yi, rhoi)
+        wdot = kinetics.net_production_rates(mech, Ti, C, P)
+        cp = thermo.mixture_cp_mass(mech, Ti, Yi)
+        h_k = thermo.h_RT(mech, Ti) * (R_GAS * Ti)
+        return wdot, cp, h_k
+
+    wdot, cp, h_k = jax.vmap(zone_chem)(T, Y, rho_i)
+    dY_chem = wdot * mech.wt[None, :] / rho_i[:, None]
+
+    # composition of the parcel entering the burned zone
+    Y_in = (args.comb_eff * args.Y_products
+            + (1.0 - args.comb_eff) * Y[0])
+    dY_transfer_b = mdot_b / m_b * (Y_in - Y[1])
+    dY = dY_chem.at[1].add(dY_transfer_b)
+
+    # chemistry + transfer heat terms
+    S = -jnp.einsum("zk,zk->z", h_k, wdot) * V_i          # erg/s
+    # burned-zone open-system enthalpy balance: the parcel arrives
+    # carrying its unburned total enthalpy h_u but with product
+    # composition Y_in, so after the composition-change part of dh is
+    # booked by dY_transfer_b, the remaining source on the T-equation is
+    # mdot * (h_u(T_u, Y_u) - h(T_b, Y_in)) — the heat of combustion of
+    # the parcel plus its sensible-enthalpy mismatch with the zone
+    h_u_mass = jnp.dot(thermo.h_RT(mech, T[0]) * (R_GAS * T[0]) / mech.wt,
+                       Y[0])
+    h_in_mass = jnp.dot(thermo.h_RT(mech, T[1]) * (R_GAS * T[1])
+                        / mech.wt, Y_in)
+    Q_transfer_b = mdot_b * (h_u_mass - h_in_mass)
+
+    if args.ht is not None:
+        T_avg = jnp.sum(m * T) / m_tot
+        Y_avg = jnp.sum(m[:, None] * Y, axis=0) / m_tot
+        Q_wall = wall_heat_rate(args.ht, args.geo, mech, T_avg, P, Y_avg,
+                                V_cyl, args.P_ivc, args.V_ivc, args.T_ivc)
+        Q_i = -Q_wall * V_i / V_cyl
+    else:
+        Q_i = jnp.zeros(2)
+    Q_i = Q_i.at[1].add(Q_transfer_b)
+
+    dwbar_dY = jnp.stack([dY[0], dY[1]])
+    dwbar = -wbar ** 2 * jnp.einsum("zk,k->z", dwbar_dY, 1.0 / mech.wt)
+    dRbar = -Rbar / wbar * dwbar
+
+    mcp = m * cp
+    # volume-constraint closure including the mass-transfer terms:
+    # d/dt [ (m_u Rbar_u T_u + m_b Rbar_b T_b)/P ] = dV/dt
+    dm = jnp.stack([-mdot_b, mdot_b])
+    A = jnp.sum(m * Rbar * V_i / mcp) / P - V_cyl / P
+    B = (jnp.sum(dm * Rbar * T)
+         + jnp.sum(Rbar * (Q_i + S) / cp)
+         + jnp.sum(m * T * dRbar)) / P
+    dPdt = (dVdt - B) / A
+
+    dT = (V_i * dPdt + Q_i + S) / mcp
+    return jnp.concatenate(
+        [jnp.concatenate([dY, dT[:, None]], axis=1).reshape(-1),
+         mdot_b[None]])
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+
+class EngineSolution(NamedTuple):
+    CA: Any              # [n_out] crank angles
+    times: Any           # [n_out] seconds since IVC
+    T: Any               # [n_out, NZ] zone temperatures
+    P: Any               # [n_out] cylinder pressure
+    V: Any               # [n_out] cylinder volume
+    Y: Any               # [n_out, NZ, KK]
+    heat_release: Any    # [n_out] cumulative chemical heat release, erg
+    ignition_CA: Any     # CA of peak dT/dt (nan if none)
+    burned_mass: Any     # [n_out] burned-zone mass (SI) or nan
+    zone_mass: Any       # [NZ] zone masses (initial; constant for HCCI)
+    n_steps: Any
+    success: Any
+
+
+def solve_hcci(mech, geo: EngineGeometry, *, T0, P0, Y0, start_CA,
+               end_CA, ht=None, zone_T=None, zone_vol_frac=None,
+               zone_Y=None, zone_mass_frac=None, zone_ht_frac=None,
+               n_zones=1, n_out=181, rtol=1e-8, atol=1e-12,
+               energy_switch_CA=None, max_steps_per_segment=40_000):
+    """Integrate a single- or multi-zone HCCI engine from IVC to EVO.
+
+    ``zone_T``/``zone_vol_frac``/``zone_Y`` set per-zone initial state
+    (reference HCCI.py:172-332 zonal setters); scalars broadcast.
+    ``energy_switch_CA`` holds temperatures fixed (compression by
+    kinematics only) until that CA (reference HCCI.py:559) — modeled by
+    zeroing chemistry below the switch angle via a smooth gate.
+    """
+    KK = mech.n_species
+    NZ = int(n_zones)
+    T0 = jnp.broadcast_to(jnp.asarray(T0, jnp.float64), (NZ,))
+    if zone_T is not None:
+        T0 = jnp.asarray(zone_T, jnp.float64)
+    Y0 = jnp.asarray(Y0, jnp.float64)
+    if zone_Y is not None:
+        Yz = jnp.asarray(zone_Y, jnp.float64)
+    else:
+        Yz = jnp.broadcast_to(Y0, (NZ, KK))
+    V_ivc = cylinder_volume(geo, jnp.asarray(start_CA, jnp.float64))
+    rho_z = jax.vmap(lambda T, Y: thermo.density(mech, T, P0, Y))(T0, Yz)
+    if zone_mass_frac is not None:
+        # mass split given (reference HCCI.py:251): the volume partition
+        # follows from the zonal ideal-gas states at the shared IVC
+        # pressure, V_i = m_i / rho_i(T_i, P0, Y_i)
+        mf = jnp.asarray(zone_mass_frac, jnp.float64)
+        mf = mf / jnp.sum(mf)
+        V_unit = mf / rho_z
+        m_tot = V_ivc / jnp.sum(V_unit)
+        m_z = mf * m_tot
+    else:
+        if zone_vol_frac is None:
+            vf = jnp.full((NZ,), 1.0 / NZ)
+        else:
+            vf = jnp.asarray(zone_vol_frac, jnp.float64)
+            vf = vf / jnp.sum(vf)
+        m_z = rho_z * (vf * V_ivc)
+
+    args = EngineArgs(mech=mech, geo=geo, ht=ht,
+                      start_CA=jnp.asarray(start_CA, jnp.float64),
+                      P_ivc=jnp.asarray(P0, jnp.float64), V_ivc=V_ivc,
+                      T_ivc=jnp.sum(m_z * T0) / jnp.sum(m_z),
+                      zone_mass=m_z,
+                      chem_on_CA=jnp.asarray(
+                          energy_switch_CA if energy_switch_CA
+                          is not None else -1.0e9, jnp.float64),
+                      zone_ht_frac=(
+                          jnp.asarray(zone_ht_frac, jnp.float64)
+                          / jnp.sum(jnp.asarray(zone_ht_frac,
+                                                jnp.float64))
+                          if zone_ht_frac is not None else None))
+
+    rhs = hcci_rhs
+
+    y0 = jnp.concatenate([Yz, T0[:, None]], axis=1).reshape(-1)
+    t_end = ca_to_time(end_CA, start_CA, geo.rpm)
+    ts = jnp.linspace(0.0, t_end, n_out)
+
+    # ignition event: peak mass-averaged dT/dt
+    mfrac = m_z / jnp.sum(m_z)
+
+    def dtdt_avg(t, y, f):
+        fz = f.reshape(NZ, KK + 1)
+        return jnp.dot(mfrac, fz[:, KK])
+
+    events = (Event(fn=dtdt_avg, kind="max"),)
+    atol_vec = jnp.full(y0.shape, atol)
+    atol_vec = atol_vec.reshape(NZ, KK + 1).at[:, KK].set(1e-6).reshape(-1)
+    sol = odeint(rhs, y0, ts, args, rtol=rtol, atol=atol_vec,
+                 events=events,
+                 max_steps_per_segment=max_steps_per_segment)
+
+    yz = sol.ys.reshape(-1, NZ, KK + 1)
+    Ys = yz[:, :, :KK]
+    Ts = yz[:, :, KK]
+    CAs = time_to_ca(ts, start_CA, geo.rpm)
+    Vs = jax.vmap(lambda ca: cylinder_volume(geo, ca))(CAs)
+    wbars = jax.vmap(lambda Yt: jax.vmap(
+        lambda Yi: thermo.mean_molecular_weight_Y(mech, Yi))(Yt))(Ys)
+    Ps = jnp.einsum("nz,nz->n", m_z[None, :] * (R_GAS / wbars), Ts) / Vs
+
+    hr = _cumulative_heat_release(mech, m_z, Ys, Ts)
+    ign_CA = time_to_ca(sol.event_times[0], start_CA, geo.rpm)
+    ign_CA = jnp.where(jnp.isfinite(sol.event_times[0]), ign_CA, jnp.nan)
+    return EngineSolution(CA=CAs, times=ts, T=Ts, P=Ps, V=Vs, Y=Ys,
+                          heat_release=hr, ignition_CA=ign_CA,
+                          burned_mass=jnp.full(ts.shape, jnp.nan),
+                          zone_mass=m_z,
+                          n_steps=sol.n_steps, success=sol.success)
+
+
+def solve_si(mech, geo: EngineGeometry, *, T0, P0, Y0, start_CA, end_CA,
+             wiebe, Y_products, ht=None, comb_eff=1.0, n_out=181,
+             rtol=1e-8, atol=1e-12, max_steps_per_segment=40_000):
+    """Integrate the two-zone Wiebe-burn SI engine from IVC to EVO.
+
+    ``wiebe`` = (theta0 [deg], duration [deg], a, m) — reference
+    SI.py:141 wiebe_parameters. ``Y_products`` is the complete-combustion
+    product composition entering the burned zone."""
+    KK = mech.n_species
+    T0 = jnp.asarray(T0, jnp.float64)
+    Y0 = jnp.asarray(Y0, jnp.float64)
+    V_ivc = cylinder_volume(geo, jnp.asarray(start_CA, jnp.float64))
+    rho0 = thermo.density(mech, T0, P0, Y0)
+    m_tot = rho0 * V_ivc
+    # the burned zone starts as a tiny kernel of products
+    m_b0 = 1e-6 * m_tot
+    zone_mass = jnp.stack([m_tot - m_b0, m_b0])
+
+    args = EngineArgs(mech=mech, geo=geo, ht=ht,
+                      start_CA=jnp.asarray(start_CA, jnp.float64),
+                      P_ivc=jnp.asarray(P0, jnp.float64), V_ivc=V_ivc,
+                      T_ivc=T0, zone_mass=zone_mass,
+                      wiebe=tuple(jnp.asarray(w, jnp.float64)
+                                  for w in wiebe),
+                      Y_products=jnp.asarray(Y_products, jnp.float64),
+                      comb_eff=jnp.asarray(comb_eff, jnp.float64))
+
+    T_b0 = T0 + 1500.0        # hot kernel estimate; chemistry relaxes it
+    y0 = jnp.concatenate([
+        jnp.concatenate([Y0, T0[None]]),
+        jnp.concatenate([jnp.asarray(Y_products, jnp.float64),
+                         T_b0[None]]),
+        m_b0[None]])
+
+    t_end = ca_to_time(end_CA, start_CA, geo.rpm)
+    ts = jnp.linspace(0.0, t_end, n_out)
+
+    def dtdt_unburned(t, y, f):
+        return f[KK]          # unburned-zone temperature rate (knock)
+
+    events = (Event(fn=dtdt_unburned, kind="max"),)
+    atol_vec = jnp.full(y0.shape, atol)
+    atol_vec = atol_vec.at[KK].set(1e-6).at[2 * KK + 1].set(1e-6)
+    atol_vec = atol_vec.at[-1].set(1e-10 * float(m_tot))
+    sol = odeint(si_rhs, y0, ts, args, rtol=rtol, atol=atol_vec,
+                 events=events,
+                 max_steps_per_segment=max_steps_per_segment)
+
+    yz = sol.ys[:, :2 * (KK + 1)].reshape(-1, 2, KK + 1)
+    m_b = sol.ys[:, -1]
+    Ys = yz[:, :, :KK]
+    Ts = yz[:, :, KK]
+    CAs = time_to_ca(ts, start_CA, geo.rpm)
+    Vs = jax.vmap(lambda ca: cylinder_volume(geo, ca))(CAs)
+    m_u = m_tot - m_b
+    m_t = jnp.stack([m_u, m_b], axis=1)
+    wbars = jax.vmap(lambda Yt: jax.vmap(
+        lambda Yi: thermo.mean_molecular_weight_Y(mech, Yi))(Yt))(Ys)
+    Ps = jnp.einsum("nz,nz->n", m_t * (R_GAS / wbars), Ts) / Vs
+
+    hr = _cumulative_heat_release(mech, None, Ys, Ts, zone_mass_t=m_t)
+    ign_CA = time_to_ca(sol.event_times[0], start_CA, geo.rpm)
+    ign_CA = jnp.where(jnp.isfinite(sol.event_times[0]), ign_CA, jnp.nan)
+    return EngineSolution(CA=CAs, times=ts, T=Ts, P=Ps, V=Vs, Y=Ys,
+                          heat_release=hr, ignition_CA=ign_CA,
+                          burned_mass=m_b, zone_mass=zone_mass,
+                          n_steps=sol.n_steps, success=sol.success)
+
+
+def _cumulative_heat_release(mech, zone_mass, Ys, Ts, zone_mass_t=None):
+    """Cumulative chemical heat release [erg] from the drop in the
+    mixture's enthalpy of formation (evaluated at 298.15 K so sensible
+    enthalpy does not contaminate the total) — the quantity behind the
+    reference's CA10/50/90 outputs (engine.py:953)."""
+    T_ref = 298.15
+
+    def mix_h0(Y):
+        h0 = thermo.h_RT(mech, T_ref) * (R_GAS * T_ref) / mech.wt
+        return jnp.dot(h0, Y)
+
+    h0 = jax.vmap(jax.vmap(mix_h0))(Ys)                  # [n, NZ]
+    m = zone_mass_t if zone_mass_t is not None else zone_mass[None, :]
+    total = jnp.sum(m * h0, axis=1)
+    return total[0] - total
+
+
+def heat_release_CAs(sol: EngineSolution, fractions=(0.1, 0.5, 0.9)):
+    """CA at the given cumulative heat-release fractions (reference
+    engine.py:953 get_engine_heat_release_CAs: CA10/CA50/CA90)."""
+    import numpy as np
+
+    hr = np.asarray(sol.heat_release)
+    CA = np.asarray(sol.CA)
+    total = hr[-1]
+    out = []
+    for f in fractions:
+        if total <= 0:
+            out.append(float("nan"))
+            continue
+        target = f * total
+        i = int(np.searchsorted(hr, target))
+        if i == 0 or i >= len(hr):
+            out.append(float("nan"))
+            continue
+        frac = (target - hr[i - 1]) / max(hr[i] - hr[i - 1], 1e-300)
+        out.append(float(CA[i - 1] + frac * (CA[i] - CA[i - 1])))
+    return tuple(out)

@@ -476,7 +476,9 @@ def initial_profile(mech, x, P, T_in, Y_in, xcen, wmix, *,
     Y_b = eq.Y
 
     xi = jnp.clip((jnp.asarray(x) - (xcen - 0.5 * wmix)) / wmix, 0.0, 1.0)
-    if energy == "TGIV" and T_given is not None:
+    if T_given is not None:
+        # an imposed/estimated temperature profile (TGIV, or TPRO used
+        # as the ENRG starting estimate — reference flame.py:100)
         T = jnp.asarray(T_given)
     else:
         T = T_in + (T_b - T_in) * xi
@@ -520,7 +522,10 @@ def refine_grid(x, u, *, grad=0.1, curv=0.5, nadp=10, ntot=250,
         score = np.maximum(score, jump / (grad * rng))
         d = np.diff(phi) / np.diff(x)
         drng = np.ptp(d)
-        if drng > 0 and N > 2:
+        # a slope range at rounding-noise level (linear profile) must not
+        # trigger curvature refinement — require it to be a meaningful
+        # fraction of the slope magnitude
+        if drng > 1e-8 * max(np.max(np.abs(d)), 1e-300) and N > 2:
             djump = np.abs(np.diff(d))
             s2 = djump / (curv * drng)
             # a slope jump lives at the shared point; flag both intervals
@@ -592,7 +597,8 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
                 upwind=True, transport_model="MIX", lewis=1.0,
                 soret=False, species_flux_bc=True, ss_rtol=1e-4,
                 ss_atol=1e-9, ts_dt=1e-6, ts_steps=30, max_ts_rounds=12,
-                skip_fixed_T=False, u0=None, x0=None, verbose=False):
+                skip_fixed_T=False, u0=None, x0=None, x_init=None,
+                T_init_fn=None, verbose=False):
     """Solve a premixed 1-D flame with adaptive regridding.
 
     Host-level driver: jitted damped-Newton solves per grid size, with
@@ -605,7 +611,10 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
     (premixedflame.py:937-946): by default a given-temperature burner
     solve on the initial ramp precedes the full problem.
     ``u0``/``x0`` restart from a previous solution (CNTN continuation,
-    premixedflame.py:430).
+    premixedflame.py:430). ``x_init`` imposes an explicit initial mesh
+    (the Grid mixin's GRID profile, reference grid.py:239) and
+    ``T_init_fn`` an initial temperature estimate for ENRG solves (the
+    reference's TPRO-as-estimate semantics, flame.py:100).
     """
     cfg = FlameConfig(energy=energy, free_flame=free_flame, upwind=upwind,
                       transport=transport_model, lewis=lewis, soret=soret,
@@ -627,6 +636,13 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
     rho_u = float(thermo.density(mech, T_in, P, jnp.asarray(Y_in)))
     mdot_in = float(mdot) if mdot is not None else rho_u * su_guess
 
+    def _estimate(x_arr):
+        if energy == "TGIV":
+            return np.asarray([T_given_fn(xi) for xi in x_arr])
+        if T_init_fn is not None:
+            return np.asarray([T_init_fn(xi) for xi in x_arr])
+        return None
+
     if u0 is not None:
         # continuation restart from a previous solution
         if x0 is None:
@@ -634,12 +650,15 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         x = np.asarray(x0, dtype=np.float64)
         u = jnp.asarray(u0)
     else:
-        # initial grid: uniform + extra points through the ramp zone
-        x = np.linspace(x_start, x_end, n_initial)
-        ramp = np.linspace(xcen - 0.5 * wmix, xcen + 0.5 * wmix, 9)
-        x = np.sort(np.unique(np.concatenate([x, ramp])))
-        if energy == "TGIV":
-            T_given = np.asarray([T_given_fn(xi) for xi in x])
+        if x_init is not None:
+            x = np.asarray(x_init, dtype=np.float64)
+        else:
+            # initial grid: uniform + extra points through the ramp zone
+            x = np.linspace(x_start, x_end, n_initial)
+            ramp = np.linspace(xcen - 0.5 * wmix, xcen + 0.5 * wmix, 9)
+            x = np.sort(np.unique(np.concatenate([x, ramp])))
+
+        T_given = _estimate(x)
         u = initial_profile(mech, jnp.asarray(x), P, T_in, Y_in, xcen,
                             wmix, energy=energy, T_given=T_given,
                             mdot_guess=mdot_in, su_guess=su_guess)
@@ -654,8 +673,7 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
             if T_prof0[-1] > T_fix > T_prof0[0]:
                 x_cross = float(np.interp(T_fix, T_prof0, x))
                 x = np.sort(np.unique(np.append(x, x_cross)))
-                if energy == "TGIV":
-                    T_given = np.asarray([T_given_fn(xi) for xi in x])
+                T_given = _estimate(x)
                 u = initial_profile(mech, jnp.asarray(x), P, T_in, Y_in,
                                     xcen, wmix, energy=energy,
                                     T_given=T_given, mdot_guess=mdot_in,
@@ -697,8 +715,12 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
     n_regrids = 0
     converged = False
     for _round in range(max_regrids + 1):
-        if energy == "TGIV":
-            T_given = np.asarray([T_given_fn(xi) for xi in x])
+        # keep T_given sized to the CURRENT grid — for TGIV it is the
+        # imposed profile (also on continuation restarts, where skipping
+        # this would pin the temperature to zeros); for
+        # ENRG-with-estimate a stale old-grid array would silently
+        # change the jit signature and force a recompile per regrid
+        T_given = _estimate(x)
         data = make_data(x, i_fix, T_given)
         newton_j, timestep_j = _Programs.get(mech, cfg, len(x))
         u, ok, n_it, ts_dt = _march(newton_j, timestep_j, u, data,

@@ -42,6 +42,10 @@ from . import kinetics, linalg, thermo
 
 _TINY = 1e-30
 
+#: temperature is normalized by this scale inside the convergence norm so the
+#: reference SS tolerances (quoted for fraction-like variables) apply uniformly
+T_SCALE = 1.0e3
+
 MODE_TAU = "tau"      # residence time given (SetResTime)
 MODE_VOLUME = "vol"   # volume given (SetVolume)
 
@@ -127,9 +131,17 @@ def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
     """Damped Newton with masked convergence; returns (y, converged, n)."""
     n = y0.shape[0]
 
-    def norm(r, y):
-        w = weights[0] + weights[1] * jnp.abs(y)
-        return jnp.sqrt(jnp.mean((r / w) ** 2))
+    def step_norm(dy, y):
+        # TWOPNT's convergence semantics (reference steadystatesolver.py
+        # :40-67 SS atol/rtol): the damped Newton CORRECTION, weighted by
+        # atol + rtol*|y| on the SOLUTION variables, must fall below 1.
+        # The temperature entry is scaled into fraction-like units so one
+        # (atol, rtol) pair governs the whole vector, as in the native
+        # solver's normalized workspace.
+        y_s = y.at[-1].set(y[-1] / T_SCALE)
+        dy_s = dy.at[-1].set(dy[-1] / T_SCALE)
+        w = weights[0] + weights[1] * jnp.abs(y_s)
+        return jnp.sqrt(jnp.mean((dy_s / w) ** 2))
 
     def body(carry):
         y, _, it = carry
@@ -150,9 +162,7 @@ def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
         # steadystatesolver.py:56-60)
         y_new = y_new.at[:-1].set(jnp.clip(y_new[:-1], species_floor, 1.0))
         y_new = y_new.at[-1].set(jnp.clip(y_new[-1], 150.0, T_max))
-        # 0.05: quadratic convergence makes the last factor-20 cheap, and
-        # the slack of a 1.0 threshold shows up as multi-K enthalpy error
-        conv = norm(resid_fn(y_new, args), y_new) < 0.05
+        conv = (alpha >= 1.0 - 1e-12) & (step_norm(dy, y_new) < 1.0)
         return y_new, conv, it + 1
 
     def cond(carry):
@@ -243,10 +253,10 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
         tau_eff, _ = _tau_volume(args, rho, mode)
         return rhs(0.0, y, args) * jnp.maximum(tau_eff, _TINY)
 
-    # convergence weights in the tau-scaled (fraction-unit) residual:
-    # |r_k| < atol' + rtol |y_k| with atol' = 1e3 * ss_atol (ss_atol is
-    # quoted for the unscaled rate residual; tau ~ 1e-3 s typical)
-    weights = (1e3 * jnp.asarray(ss_atol), jnp.asarray(ss_rtol))
+    # the reference's SS tolerances apply verbatim to the weighted
+    # Newton-step norm (TWOPNT semantics; defaults atol 1e-9 / rtol 1e-4,
+    # steadystatesolver.py:40-67)
+    weights = (jnp.asarray(ss_atol), jnp.asarray(ss_rtol))
 
     y0 = jnp.concatenate([jnp.asarray(Y_guess, jnp.float64),
                           jnp.asarray(T_guess, jnp.float64)[None]])

@@ -1,0 +1,162 @@
+"""A-factor sensitivity (ASEN) and rate-of-production (AROP) analysis.
+
+TPU-native replacement for the reference's keyword-driven native
+sensitivity machinery (reference reactormodel.py:1522 setsensitivity-
+analysis -> ASEN/ATLS/RTLS keywords consumed inside the Fortran DASPK
+adjoint; :1585 setROPanalysis -> AROP/EPSR).
+
+Design: instead of the reference's staged adjoint integration, the
+sensitivity of any solution functional to the II pre-exponential factors
+is computed from ONE batched solve over perturbed mechanisms — the
+mechanism is a pytree whose ``A`` vector is data, so ``vmap`` over a
+[II+1] stack of rate-multiplier vectors integrates the nominal and all
+perturbed reactors simultaneously (the same data parallelism the sweeps
+use; SURVEY.md §2.3). Central-difference coefficients in log-space give
+the normalized sensitivities d ln(out) / d ln(A_i) directly.
+
+ROP analysis needs no extra solves at all: the per-reaction rates of
+progress are re-evaluated from the saved (T, P, Y) profiles with the
+same kinetics kernel the integration used.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import kinetics, reactors, thermo
+
+
+class IgnitionSensitivity(NamedTuple):
+    """Normalized ignition-delay sensitivities."""
+    s: Any               # [II] d ln(tau) / d ln(A_i)
+    tau0: Any            # nominal ignition delay, s
+    success: Any         # [II] per-perturbation integrator success
+
+
+def _perturbed_mechs_axis(mech, eps: float):
+    """[2*II] stack of rate-multiplier vectors: +eps and -eps per
+    reaction (log-space central differences)."""
+    II = mech.n_reactions
+    up = jnp.ones((II, II)).at[jnp.arange(II), jnp.arange(II)].set(
+        jnp.exp(eps))
+    dn = jnp.ones((II, II)).at[jnp.arange(II), jnp.arange(II)].set(
+        jnp.exp(-eps))
+    return jnp.concatenate([up, dn], axis=0)          # [2*II, II]
+
+
+def ignition_delay_sensitivity(mech, problem, energy, T0, P0, Y0, t_end,
+                               *, eps=0.05, rtol=1e-8, atol=1e-13,
+                               ignition_mode=reactors.IGN_T_INFLECTION,
+                               max_steps_per_segment=20_000):
+    """Normalized ignition-delay sensitivity d ln(tau)/d ln(A_i) for all
+    II reactions from one vmapped batch of 2*II+1 integrations
+    (reference ASEN output for the ignition-delay workflow)."""
+    A0 = jnp.asarray(mech.A)
+    mults = _perturbed_mechs_axis(mech, eps)
+    II = mech.n_reactions
+
+    def solve_with_mult(m):
+        pert = dataclasses.replace(mech, A=A0 * m)
+        sol = reactors.solve_batch(
+            pert, problem, energy, T0, P0, jnp.asarray(Y0), t_end,
+            n_out=2, rtol=rtol, atol=atol, ignition_mode=ignition_mode,
+            max_steps_per_segment=max_steps_per_segment)
+        return sol.ignition_time, sol.success
+
+    taus, ok = jax.vmap(solve_with_mult)(mults)
+    tau0, ok0 = solve_with_mult(jnp.ones(II))
+    # central difference in log space
+    s = (jnp.log(taus[:II]) - jnp.log(taus[II:])) / (2.0 * eps)
+    # a perturbed case that never ignited within t_end yields a nan
+    # delay with a "successful" integration — that sensitivity is
+    # meaningless and must not be flagged usable
+    finite = jnp.isfinite(taus[:II]) & jnp.isfinite(taus[II:]) \
+        & jnp.isfinite(tau0)
+    return IgnitionSensitivity(s=s, tau0=tau0,
+                               success=ok[:II] & ok[II:] & ok0 & finite)
+
+
+class ProfileSensitivity(NamedTuple):
+    """Normalized profile sensitivities at the saved output times."""
+    times: Any           # [n_out]
+    s_T: Any             # [n_out, II] (A_i/T) dT/dA_i
+    s_Y: Any             # [n_out, KK, II] (A_i/max(Y_k, floor)) dY/dA_i
+    success: Any
+
+
+def profile_sensitivity(mech, problem, energy, T0, P0, Y0, t_end, *,
+                        eps=0.05, n_out=51, rtol=1e-7, atol=1e-12,
+                        y_floor=1e-10, max_steps_per_segment=20_000):
+    """Normalized temperature / species-profile sensitivities
+    (reference ASEN profile output, reactormodel.py:1522): one vmapped
+    batch of 2*II perturbed integrations, central-differenced."""
+    A0 = jnp.asarray(mech.A)
+    II = mech.n_reactions
+    mults = _perturbed_mechs_axis(mech, eps)
+
+    def solve_with_mult(m):
+        pert = dataclasses.replace(mech, A=A0 * m)
+        sol = reactors.solve_batch(
+            pert, problem, energy, T0, P0, jnp.asarray(Y0), t_end,
+            n_out=n_out, rtol=rtol, atol=atol,
+            max_steps_per_segment=max_steps_per_segment)
+        return sol.times, sol.T, sol.Y, sol.success
+
+    ts, Ts, Ys, ok = jax.vmap(solve_with_mult)(mults)
+    dT = (Ts[:II] - Ts[II:]) / (2.0 * eps)            # [II, n_out]
+    dY = (Ys[:II] - Ys[II:]) / (2.0 * eps)            # [II, n_out, KK]
+    T_ref = 0.5 * (Ts[:II] + Ts[II:])
+    Y_ref = jnp.maximum(0.5 * (Ys[:II] + Ys[II:]), y_floor)
+    s_T = (dT / T_ref).transpose(1, 0)                # [n_out, II]
+    s_Y = (dY / Y_ref).transpose(1, 2, 0)             # [n_out, KK, II]
+    return ProfileSensitivity(times=ts[0], s_T=s_T, s_Y=s_Y,
+                              success=ok[:II] & ok[II:])
+
+
+class ROPTable(NamedTuple):
+    """Rate-of-production analysis at the saved output times
+    (reference AROP, reactormodel.py:1585)."""
+    times: Any           # [n_out]
+    q: Any               # [n_out, II] net rates of progress, mol/cm^3-s
+    contributions: Any   # [n_out, KK, II] nu_ki * q_i per species
+    wdot: Any            # [n_out, KK] net production rates
+
+
+def rop_analysis(mech, times, T, P, Y):
+    """Per-reaction ROP table from saved solution profiles — no extra
+    integration needed; uses the exact kinetics kernel of the solve."""
+    nu = jnp.asarray(mech.nu_r) - jnp.asarray(mech.nu_f)   # [II, KK]
+
+    def point(Ti, Pi, Yi):
+        Yc = jnp.clip(Yi, 0.0, 1.0)
+        rho = thermo.density(mech, Ti, Pi, Yc)
+        C = thermo.Y_to_C(mech, Yc, rho)
+        q, _, _ = kinetics.rates_of_progress(mech, Ti, C, Pi)
+        contrib = nu.T * q[None, :]               # [KK, II]
+        return q, contrib, contrib.sum(axis=1)
+
+    q, contributions, wdot = jax.vmap(point)(
+        jnp.asarray(T), jnp.broadcast_to(jnp.asarray(P),
+                                         jnp.asarray(T).shape),
+        jnp.asarray(Y))
+    return ROPTable(times=jnp.asarray(times), q=q,
+                    contributions=contributions, wdot=wdot)
+
+
+def dominant_reactions(table: ROPTable, mech, species: int, *,
+                       threshold=0.01):
+    """Reactions whose peak |contribution| to ``species`` exceeds
+    ``threshold`` of the peak total |wdot| (the reference's EPSR
+    filtering, reactormodel.py:1614). Returns (indices, peak values)."""
+    contrib = np.asarray(table.contributions)[:, species, :]   # [n, II]
+    peak = np.abs(contrib).max(axis=0)
+    scale = max(np.abs(np.asarray(table.wdot)[:, species]).max(), 1e-300)
+    idx = np.where(peak > threshold * scale)[0]
+    order = np.argsort(peak[idx])[::-1]
+    idx = idx[order]
+    return idx, peak[idx]

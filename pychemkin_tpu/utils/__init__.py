@@ -1,5 +1,7 @@
-"""Utility subpackage: compilation-cache management."""
+"""Utility subpackage: compilation-cache management and the
+``.result``/``.baseline`` numeric-comparison harness."""
 
+from . import baseline
 from .cache import enable_compilation_cache
 
-__all__ = ["enable_compilation_cache"]
+__all__ = ["baseline", "enable_compilation_cache"]

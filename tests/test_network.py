@@ -1,0 +1,152 @@
+"""ReactorNetwork tests: graph construction, sequential substitution
+vs the declustered serial chain, and tear-stream recycle convergence."""
+
+import os
+
+import numpy as np
+import pytest
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.constants import P_ATM
+from pychemkin_tpu.inlet import Stream
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import (
+    PSR_SetResTime_EnergyConservation as PSR_E,
+    ReactorNetwork,
+)
+
+
+@pytest.fixture(scope="module")
+def chem():
+    c = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"),
+                     tran=os.path.join(DATA_DIR, "tran_h2o2.dat"))
+    c.preprocess()
+    return c
+
+
+def make_feed(chem, mdot=10.0):
+    s = Stream(chem, label="feed")
+    s.pressure = P_ATM
+    s.temperature = 298.15
+    s.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+    s.mass_flowrate = mdot
+    return s
+
+
+def make_psr(chem, name, tau=1e-3):
+    g = ck.Mixture(chem)
+    g.pressure = P_ATM
+    g.temperature = 2300.0
+    g.X = {"H2O": 0.25, "N2": 0.65, "OH": 0.05, "O2": 0.05}
+    p = PSR_E(g, label=name)
+    p.residence_time = tau
+    return p
+
+
+class TestGraph:
+    def test_membership_and_validation(self, chem):
+        net = ReactorNetwork(chem)
+        with pytest.raises(TypeError):
+            ReactorNetwork("not a chemistry")
+        p = make_psr(chem, "a")
+        net.add_reactor(p)
+        assert net.number_reactors == 1
+        with pytest.raises(ValueError, match="already"):
+            net.add_reactor(make_psr(chem, "a"))
+        with pytest.raises(TypeError):
+            net.add_reactor("not a reactor")
+
+    def test_outflow_split_validation(self, chem):
+        net = ReactorNetwork(chem)
+        net.add_reactor_list([make_psr(chem, "a"), make_psr(chem, "b")])
+        with pytest.raises(ValueError, match="NOT in the network"):
+            net.add_outflow_connections("zzz", [("a", 1.0)])
+        with pytest.raises(ValueError, match="self"):
+            net.add_outflow_connections("a", [("a", 0.5)])
+        with pytest.raises(ValueError, match="sum"):
+            net.add_outflow_connections("a", [("b", 0.7),
+                                              ("EXIT>>", 0.7)])
+        # remainder auto-assigned to the downstream reactor
+        net.add_outflow_connections("a", [("EXIT>>", 0.25)])
+        net.set_reactor_outflow()
+        table = dict(net.outflow_targets[1])
+        assert table[net._exit_index] == pytest.approx(0.25)
+        assert table[2] == pytest.approx(0.75)
+        # inflow graph inverted correctly
+        assert net.inflow_sources[2] == [(1, 0.75)]
+
+    def test_tear_utilities(self, chem):
+        net = ReactorNetwork(chem)
+        net.add_reactor(make_psr(chem, "a"))
+        net.add_tearingpoint("a")
+        assert net.numb_tearpoints == 1
+        net.add_tearingpoint("a")          # idempotent
+        assert net.numb_tearpoints == 1
+        net.remove_tearpoint("a")
+        assert net.numb_tearpoints == 0
+        with pytest.raises(ValueError):
+            net.set_relaxation_factor(1.5)
+        with pytest.raises(ValueError):
+            net.set_tear_tolerance(-1.0)
+
+
+class TestRuns:
+    def test_chain_matches_declustered(self, chem):
+        """3-PSR chain through the network must reproduce the manually
+        chained serial solve (reference test PSRChain_network vs
+        PSRChain_declustered)."""
+        net = ReactorNetwork(chem)
+        psrs = [make_psr(chem, f"psr{i}") for i in range(3)]
+        psrs[0].set_inlet(make_feed(chem))
+        net.add_reactor_list(psrs)
+        net.add_outflow_connections("psr2", [("EXIT>>", 1.0)])
+        assert net.run() == 0
+        out_net = net.get_reactor_stream("psr2")
+
+        stream = make_feed(chem)
+        for i in range(3):
+            p = make_psr(chem, f"solo{i}")
+            p.set_inlet(stream)
+            p.set_estimate_conditions()
+            assert p.run() == 0
+            stream = p.process_solution()
+
+        assert out_net.temperature == pytest.approx(stream.temperature,
+                                                    abs=0.5)
+        iH2O = chem.species_symbols.index("H2O")
+        assert out_net.Y[iH2O] == pytest.approx(stream.Y[iH2O],
+                                                abs=1e-5)
+        assert out_net.mass_flowrate == pytest.approx(10.0, rel=1e-10)
+        # temperature rises along the burning chain
+        T0 = net.get_reactor_stream("psr0").temperature
+        T2 = net.get_reactor_stream("psr2").temperature
+        assert T2 > T0 > 1500.0
+
+    def test_recycle_with_tear_stream(self, chem):
+        """psr0 -> psr1 with 30% of psr1 recycled to psr0: the tear loop
+        must converge and the external exit must carry the feed flow
+        (steady-state mass balance)."""
+        net = ReactorNetwork(chem)
+        p0, p1 = make_psr(chem, "psr0"), make_psr(chem, "psr1")
+        p0.set_inlet(make_feed(chem))
+        net.add_reactor_list([p0, p1])
+        net.add_outflow_connections("psr1", [("psr0", 0.3),
+                                             ("EXIT>>", 0.7)])
+        net.add_tearingpoint("psr1")
+        net.set_relaxation_factor(0.7)
+        assert net.run() == 0
+        assert net.tear_converged
+        out = net.get_external_stream(1)
+        # steady state: exit flow == feed flow (to tear tolerance)
+        assert out.mass_flowrate == pytest.approx(10.0, rel=1e-3)
+        # recycle of hot products preheats psr0: it burns hotter than
+        # a feed-only reactor would at the same tau
+        assert net.get_reactor_stream("psr0").temperature > 2100.0
+        assert out.temperature > 2100.0
+
+    def test_unconnected_reactor_raises(self, chem):
+        net = ReactorNetwork(chem)
+        # psr with no external inlet and no internal sources
+        net.add_reactor(make_psr(chem, "orphan"))
+        with pytest.raises(RuntimeError, match="not connected"):
+            net.run()

@@ -1,0 +1,117 @@
+"""Device-mesh sharding tests on the 8-device virtual CPU mesh
+(round-1/2 debt: parallel/ had zero in-repo tests).
+
+conftest.py sets --xla_force_host_platform_device_count=8, so these
+tests exercise the REAL shard_map/NamedSharding path the TPU slice
+uses — padding, per-element failure isolation, cross-device summary
+collectives, and the jitted-program cache."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pychemkin_tpu import parallel
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import thermo
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def stoich_Y(mech):
+    names = list(mech.species_names)
+    X = np.zeros(len(names))
+    X[names.index("H2")] = 2.0
+    X[names.index("O2")] = 1.0
+    X[names.index("N2")] = 3.76
+    return np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+
+
+def test_virtual_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    mesh = parallel.make_mesh()
+    assert mesh.devices.size == 8
+    sub = parallel.make_mesh(n_devices=4)
+    assert sub.devices.size == 4
+
+
+def test_sweep_padding_odd_batch(mech, stoich_Y):
+    """B=13 on an 8-device mesh: the batch pads to 16 internally but
+    exactly 13 results come back, matching the unsharded reference."""
+    mesh = parallel.make_mesh()
+    T0s = np.linspace(1000.0, 1400.0, 13)
+    times, ok = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        mesh=mesh, rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
+    assert times.shape == (13,) and ok.shape == (13,)
+    assert bool(np.all(ok))
+    # hotter initial temperature ignites faster
+    finite = np.isfinite(times)
+    assert finite.sum() >= 12
+    assert np.all(np.diff(times[finite]) < 0)
+
+
+def test_sweep_matches_unsharded(mech, stoich_Y):
+    """The sharded sweep must agree with the plain vmapped sweep."""
+    from pychemkin_tpu.ops import reactors
+
+    T0s = np.linspace(1050.0, 1350.0, 8)
+    mesh = parallel.make_mesh()
+    t_sh, ok_sh = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        mesh=mesh, rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
+    t_ref, ok_ref = reactors.ignition_delay_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
+    assert np.array_equal(np.asarray(ok_sh), np.asarray(ok_ref))
+    np.testing.assert_allclose(t_sh, np.asarray(t_ref), rtol=1e-10)
+
+
+def test_failure_isolation(mech, stoich_Y):
+    """A deliberately impossible element (absurd step budget) must flag
+    itself without corrupting its shard-mates' results."""
+    mesh = parallel.make_mesh()
+    T0s = np.full(8, 1200.0)
+    # element 3 gets t_end so long the tiny step budget cannot reach it
+    t_ends = np.full(8, 2e-3)
+    t_ends[3] = 1e4
+    times, ok = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, t_ends,
+        mesh=mesh, rtol=1e-6, atol=1e-12, max_steps_per_segment=300)
+    assert not ok[3]
+    others = np.ones(8, dtype=bool)
+    others[3] = False
+    assert np.all(ok[others])
+    # the healthy elements still report the correct ignition time
+    assert np.all(np.isfinite(times[others]))
+
+
+def test_summary_collectives(mech, stoich_Y):
+    """sharded_sweep_summary reduces with psum/pmin across the mesh."""
+    mesh = parallel.make_mesh()
+    times = np.array([1e-4, 2e-4, np.nan, 5e-5, 3e-4, np.nan, 1e-3,
+                      2e-3, 4e-4, 6e-4])           # B=10: pads to 16
+    ok = np.array([True, True, False, True, True, False, True, True,
+                   True, True])
+    n_ign, t_min = parallel.sharded_sweep_summary(mesh, times, ok)
+    assert n_ign == 8
+    assert t_min == pytest.approx(5e-5)
+
+
+def test_program_cache_hit(mech, stoich_Y):
+    """Repeat same-shape sweeps must reuse the cached jitted program."""
+    mesh = parallel.make_mesh()
+    n0 = len(parallel._sweep_program_cache)
+    T0s = np.linspace(1100.0, 1300.0, 8)
+    for _ in range(2):
+        parallel.sharded_ignition_sweep(
+            mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+            mesh=mesh, rtol=1e-5, atol=1e-10,
+            max_steps_per_segment=4000)
+    n1 = len(parallel._sweep_program_cache)
+    assert n1 == n0 + 1          # one new program, reused on the rerun
