@@ -1,0 +1,97 @@
+"""Full-suite runner: one fresh process per test file.
+
+Why this exists: jaxlib 0.9.0's XLA:CPU backend segfaults (rc=139)
+sporadically in LONG many-program processes — both with the persistent
+compilation cache (AOT deserialization in
+``compilation_cache.get_executable_and_time``) and without it (plain
+``backend_compile_and_load`` mid-suite), while every test file passes
+standalone. The suite therefore runs each file in its own short-lived
+process, mirroring the subprocess-isolation pattern of
+``pychemkin_tpu/benchmarks.py`` (whose robustness contract was learned
+from the same class of backend crashes).
+
+Usage::
+
+    python tests/run_suite.py [pytest args...]
+
+Behaviour:
+- each ``tests/test_*.py`` file runs as ``python -m pytest <file> <args>``
+  in a fresh process with the axon TPU tunnel env removed (children
+  compile locally on CPU) and the per-file persistent cache enabled
+  (short processes load few programs — the crashy regime is many
+  programs in one process, see conftest.py);
+- ``-x`` / ``--exitfirst`` stops at the first failing FILE;
+- exit code is 0 iff every file's pytest exited 0;
+- a per-file line and a final summary are printed.
+
+``pytest tests/`` (the driver's command) is re-exec'ed into this runner
+by ``tests/conftest.py`` whenever the session spans more than one file,
+so the one-command contract stays green without anyone needing to know
+about this module.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import subprocess
+import sys
+import time
+
+
+def _child_env():
+    env = dict(os.environ)
+    # never dial the TPU tunnel from test children (hung-tunnel hazard;
+    # tests are pinned to the virtual-CPU mesh anyway)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # tell the child conftest it is already isolated: no re-exec, and
+    # the persistent cache is safe in a short single-file process
+    env["_PYCHEMKIN_TEST_REEXEC"] = "1"
+    env["_PYCHEMKIN_SUITE_CHILD"] = "1"
+    return env
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    stop_on_fail = any(a in ("-x", "--exitfirst") for a in argv)
+    # strip file/dir selectors; the runner supplies one file per child
+    passthrough = [a for a in argv if not (
+        os.path.exists(a) and (a.endswith(".py") or os.path.isdir(a)))]
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    files = sorted(glob.glob(os.path.join(here, "test_*.py")))
+    if not files:
+        print("run_suite: no test files found", file=sys.stderr)
+        return 2
+
+    env = _child_env()
+    results = []
+    t_suite = time.time()
+    for f in files:
+        name = os.path.basename(f)
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", f] + passthrough, env=env)
+        dt = time.time() - t0
+        ok = r.returncode == 0
+        results.append((name, r.returncode, dt))
+        print(f"# run_suite: {name}: "
+              f"{'ok' if ok else f'FAIL rc={r.returncode}'} ({dt:.0f}s)",
+              flush=True)
+        if not ok and stop_on_fail:
+            break
+
+    n_fail = sum(1 for _, rc, _ in results if rc != 0)
+    total = time.time() - t_suite
+    print(f"# run_suite: {len(results)} files, {n_fail} failed, "
+          f"{total:.0f}s total", flush=True)
+    if n_fail:
+        for name, rc, _ in results:
+            if rc != 0:
+                print(f"# run_suite:   FAILED {name} rc={rc}", flush=True)
+    return 1 if n_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
