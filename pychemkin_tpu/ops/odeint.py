@@ -112,6 +112,8 @@ class ODESolution(NamedTuple):
     n_steps: Any
     n_rejected: Any
     success: Any      # bool: reached ts[-1] without stalling
+    t_final: Any = None   # diagnostic: integrator time at exit
+    stalled: Any = None   # diagnostic: True if the step loop gave up
 
 
 @dataclasses.dataclass(frozen=True)
@@ -400,4 +402,5 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
     return ODESolution(ts=ts, ys=ys, event_times=ev_t,
                        event_values=state.acc_v,
                        n_steps=state.n_steps, n_rejected=state.n_rejected,
-                       success=success)
+                       success=success, t_final=state.t,
+                       stalled=state.stalled)

@@ -4,6 +4,7 @@ Python parameter loops; there is no distributed backend to port)."""
 
 from .sharding import (
     BATCH_AXIS,
+    _sweep_program_cache,
     distributed_initialize,
     make_mesh,
     sharded_ignition_sweep,
@@ -12,6 +13,7 @@ from .sharding import (
 
 __all__ = [
     "BATCH_AXIS",
+    "_sweep_program_cache",
     "distributed_initialize",
     "make_mesh",
     "sharded_ignition_sweep",

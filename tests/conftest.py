@@ -4,19 +4,30 @@ Multi-chip TPU hardware is not available in CI; sharding tests run on a
 virtual 8-device CPU mesh (the driver separately dry-runs the multi-chip
 path via __graft_entry__.dryrun_multichip).
 
-Axon-tunnel handling: this image injects a sitecustomize that registers
-a remote TPU backend at interpreter startup whenever
-``PALLAS_AXON_POOL_IPS`` is set, and with it a REMOTE compile service —
-XLA:CPU executables then target the remote machine's CPU and SIGSEGV
-this host when reloaded from the persistent compilation cache (observed:
-full-suite rc=139 inside compilation_cache.get_executable_and_time). So
-``pytest_configure`` re-execs pytest ONCE with the variable removed: the
-fresh process never dials the tunnel, compiles locally, and can safely
-use the warm persistent cache that dominates the suite's runtime. The
-re-exec happens inside the capture manager's disabled context so the
-child inherits the real stdout/stderr fds.
+Two process-level safeguards, both implemented as re-execs inside
+``pytest_configure``:
+
+1. Axon-tunnel handling: this image injects a sitecustomize that
+   registers a remote TPU backend at interpreter startup whenever
+   ``PALLAS_AXON_POOL_IPS`` is set (it overrides ``JAX_PLATFORMS=cpu``),
+   and with it a REMOTE compile service — XLA:CPU executables then
+   target the remote machine's CPU. So the session re-execs ONCE with
+   the variable removed: the fresh process never dials the tunnel and
+   compiles locally.
+
+2. Multi-file sessions re-exec into ``tests/run_suite.py``, which runs
+   each test file in its own short-lived process. jaxlib 0.9.0's
+   XLA:CPU backend segfaults (rc=139) sporadically in long many-program
+   processes; per-file processes sidestep that while keeping the
+   one-command ``pytest tests/`` contract green. Children set
+   ``_PYCHEMKIN_SUITE_CHILD`` so they skip this step.
+
+The persistent compilation cache stays ENABLED: its historical segfault
+(AOT entries compiled for a foreign host's CPU features) is fixed by the
+host-fingerprinted cache partition in pychemkin_tpu/utils/cache.py.
 """
 
+import glob
 import os
 import sys
 
@@ -27,28 +38,51 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 
+def _session_test_files(config) -> set:
+    """Test files this pytest invocation will collect."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    files = set()
+    args = config.args or [here]
+    for a in args:
+        base = os.path.abspath(str(a).split("::", 1)[0])
+        if os.path.isdir(base):
+            # recursive: bare `pytest` from the repo root names the root
+            # dir, but collection descends into tests/
+            files.update(glob.glob(os.path.join(base, "**", "test_*.py"),
+                                   recursive=True))
+        elif os.path.isfile(base):
+            files.add(base)
+    return files
+
+
+def _reexec(argv, env, config):
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        with capman.global_and_fixture_disabled():
+            os.execvpe(argv[0], argv, env)
+    os.execvpe(argv[0], argv, env)
+
+
 def pytest_configure(config):
     if os.environ.get("PALLAS_AXON_POOL_IPS") and \
             not os.environ.get("_PYCHEMKIN_TEST_REEXEC"):
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["_PYCHEMKIN_TEST_REEXEC"] = "1"
-        capman = config.pluginmanager.getplugin("capturemanager")
         argv = [sys.executable, "-m", "pytest"] + sys.argv[1:]
-        if capman is not None:
-            with capman.global_and_fixture_disabled():
-                os.execvpe(sys.executable, argv, env)
-        os.execvpe(sys.executable, argv, env)
+        _reexec(argv, env, config)
 
+    # multi-file session -> per-file subprocess isolation via run_suite
+    if not os.environ.get("_PYCHEMKIN_SUITE_CHILD") and \
+            not os.environ.get("_PYCHEMKIN_NO_SUITE_REEXEC"):
+        if len(_session_test_files(config)) > 1:
+            here = os.path.dirname(os.path.abspath(__file__))
+            runner = os.path.join(here, "run_suite.py")
+            env = dict(os.environ)
+            env["_PYCHEMKIN_NO_SUITE_REEXEC"] = "1"   # belt and braces
+            argv = [sys.executable, runner] + sys.argv[1:]
+            _reexec(argv, env, config)
 
-# NO persistent compilation cache for the suite: jaxlib 0.9.0's CPU
-# AOT deserialization segfaults sporadically in long many-program
-# processes (three full-suite runs died with rc=139 inside
-# compilation_cache.get_executable_and_time, each on a different cached
-# program, while every per-file run passes) — a stable cold suite beats
-# a fast suite that segfaults one run in three. Bench/dryrun processes
-# keep their caches: they load only a handful of programs each.
-os.environ["PYCHEMKIN_NO_CACHE"] = "1"
 
 import jax  # noqa: E402
 

@@ -3,12 +3,13 @@
 Why this exists: jaxlib 0.9.0's XLA:CPU backend segfaults (rc=139)
 sporadically in LONG many-program processes — both with the persistent
 compilation cache (AOT deserialization in
-``compilation_cache.get_executable_and_time``) and without it (plain
-``backend_compile_and_load`` mid-suite), while every test file passes
-standalone. The suite therefore runs each file in its own short-lived
-process, mirroring the subprocess-isolation pattern of
-``pychemkin_tpu/benchmarks.py`` (whose robustness contract was learned
-from the same class of backend crashes).
+``compilation_cache.get_executable_and_time``; root cause since found:
+cache entries compiled on a foreign host's CPU feature set, now fixed by
+host-fingerprinted cache partitions in pychemkin_tpu/utils/cache.py) and
+without it (plain ``backend_compile_and_load`` mid-suite), while every
+test file passes standalone. The suite therefore runs each file in its
+own short-lived process, mirroring the subprocess-isolation pattern of
+``pychemkin_tpu/benchmarks.py``.
 
 Usage::
 
@@ -17,15 +18,21 @@ Usage::
 Behaviour:
 - each ``tests/test_*.py`` file runs as ``python -m pytest <file> <args>``
   in a fresh process with the axon TPU tunnel env removed (children
-  compile locally on CPU) and the per-file persistent cache enabled
-  (short processes load few programs — the crashy regime is many
-  programs in one process, see conftest.py);
+  compile locally on CPU) and the persistent compilation cache enabled
+  (the cache is host-fingerprinted, so entries are always native code
+  for this machine);
+- explicit file/dir arguments restrict the run to those files; node-id
+  selectors (``tests/test_x.py::test_y``) run only their file with the
+  selector forwarded;
+- each child gets a per-file timeout (``RUN_SUITE_FILE_TIMEOUT`` seconds,
+  default 2400) so one hung child cannot wedge the suite — a timeout is
+  recorded as that file failing with rc=124;
 - ``-x`` / ``--exitfirst`` stops at the first failing FILE;
 - exit code is 0 iff every file's pytest exited 0;
 - a per-file line and a final summary are printed.
 
 ``pytest tests/`` (the driver's command) is re-exec'ed into this runner
-by ``tests/conftest.py`` whenever the session spans more than one file,
+by the multi-file branch of ``pytest_configure`` in ``tests/conftest.py``,
 so the one-command contract stays green without anyone needing to know
 about this module.
 """
@@ -38,6 +45,8 @@ import subprocess
 import sys
 import time
 
+FILE_TIMEOUT = int(os.environ.get("RUN_SUITE_FILE_TIMEOUT", "2400"))
+
 
 def _child_env():
     env = dict(os.environ)
@@ -45,22 +54,50 @@ def _child_env():
     # tests are pinned to the virtual-CPU mesh anyway)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    # tell the child conftest it is already isolated: no re-exec, and
-    # the persistent cache is safe in a short single-file process
+    # tell the child conftest it is already isolated: no re-exec needed
     env["_PYCHEMKIN_TEST_REEXEC"] = "1"
     env["_PYCHEMKIN_SUITE_CHILD"] = "1"
     return env
 
 
+def _split_args(argv):
+    """Partition argv into (selected files, per-file selectors, flags).
+
+    ``selected``: test files named directly or contained in named dirs.
+    ``selectors``: node-ids ``path::name`` keyed by resolved path.
+    ``flags``: everything else, passed to every child verbatim.
+    """
+    selected, flags = [], []
+    selectors: dict[str, list[str]] = {}
+    for a in argv:
+        base = a.split("::", 1)[0]
+        if "::" in a and os.path.exists(base):
+            path = os.path.abspath(base)
+            selectors.setdefault(path, []).append(
+                "::".join([path] + a.split("::")[1:]))
+        elif os.path.isdir(a):
+            selected.extend(sorted(
+                glob.glob(os.path.join(os.path.abspath(a), "test_*.py"))))
+        elif os.path.exists(a) and a.endswith(".py"):
+            selected.append(os.path.abspath(a))
+        else:
+            flags.append(a)
+    return selected, selectors, flags
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     stop_on_fail = any(a in ("-x", "--exitfirst") for a in argv)
-    # strip file/dir selectors; the runner supplies one file per child
-    passthrough = [a for a in argv if not (
-        os.path.exists(a) and (a.endswith(".py") or os.path.isdir(a)))]
 
     here = os.path.dirname(os.path.abspath(__file__))
-    files = sorted(glob.glob(os.path.join(here, "test_*.py")))
+    selected, selectors, flags = _split_args(argv)
+    if selected or selectors:
+        files = list(selected)
+        for path in selectors:
+            if path not in files:
+                files.append(path)
+    else:
+        files = sorted(glob.glob(os.path.join(here, "test_*.py")))
     if not files:
         print("run_suite: no test files found", file=sys.stderr)
         return 2
@@ -70,14 +107,23 @@ def main(argv=None):
     t_suite = time.time()
     for f in files:
         name = os.path.basename(f)
+        # a file selected as a whole (directly or via a dir) runs whole;
+        # node-id selectors only narrow files not otherwise selected
+        targets = [f] if f in selected else selectors.get(f, [f])
         t0 = time.time()
-        r = subprocess.run(
-            [sys.executable, "-m", "pytest", f] + passthrough, env=env)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "pytest"] + targets + flags,
+                env=env, timeout=FILE_TIMEOUT)
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            rc = 124
         dt = time.time() - t0
-        ok = r.returncode == 0
-        results.append((name, r.returncode, dt))
+        ok = rc == 0
+        results.append((name, rc, dt))
         print(f"# run_suite: {name}: "
-              f"{'ok' if ok else f'FAIL rc={r.returncode}'} ({dt:.0f}s)",
+              f"{'ok' if ok else f'FAIL rc={rc}'}"
+              f"{' (timeout)' if rc == 124 else ''} ({dt:.0f}s)",
               flush=True)
         if not ok and stop_on_fail:
             break

@@ -41,7 +41,10 @@ def stoich_Y(mech):
 
 class TestPFRKernel:
     def test_ignition_distance_hot_inlet(self, mech, stoich_Y):
-        sol = pfr_ops.solve_pfr(mech, "ENRG", mdot=20.0, T0=1100.0,
+        # mdot=2 g/s over 1 cm^2 -> u0 ~ 86 m/s, comfortably subsonic
+        # (a supersonic inlet chokes the momentum equation — see
+        # test_supersonic_inlet_choking_is_flagged)
+        sol = pfr_ops.solve_pfr(mech, "ENRG", mdot=2.0, T0=1100.0,
                                 P0=P_ATM, Y0=stoich_Y, length=50.0,
                                 area=1.0)
         assert bool(sol.success)
@@ -64,28 +67,46 @@ class TestPFRKernel:
         np.testing.assert_allclose(flux, 15.0, rtol=1e-10)
 
     def test_momentum_off_constant_pressure(self, mech, stoich_Y):
-        sol = pfr_ops.solve_pfr(mech, "ENRG", mdot=20.0, T0=1100.0,
+        sol = pfr_ops.solve_pfr(mech, "ENRG", mdot=2.0, T0=1100.0,
                                 P0=P_ATM, Y0=stoich_Y, length=30.0,
                                 momentum=False)
         assert bool(sol.success)
-        np.testing.assert_allclose(np.asarray(sol.P), P_ATM, rtol=1e-9)
+        # P is reconstructed through the integrated velocity (u, rho ->
+        # ideal gas), which accumulates ~1e-9 relative error over the
+        # full duct; ppm-level constancy is the physical claim
+        np.testing.assert_allclose(np.asarray(sol.P), P_ATM, rtol=1e-6)
 
     def test_momentum_on_pressure_drops_through_front(self, mech,
                                                       stoich_Y):
         """With the momentum equation on, gas acceleration through the
-        heat-release front costs pressure."""
-        sol = pfr_ops.solve_pfr(mech, "ENRG", mdot=20.0, T0=1100.0,
+        heat-release front costs pressure (subsonic Rayleigh flow)."""
+        sol = pfr_ops.solve_pfr(mech, "ENRG", mdot=5.0, T0=1100.0,
                                 P0=P_ATM, Y0=stoich_Y, length=30.0,
                                 momentum=True)
         assert bool(sol.success)
         assert float(sol.P[-1]) < P_ATM
         assert float(sol.u[-1]) > float(sol.u[0])
 
+    def test_supersonic_inlet_choking_is_flagged(self, mech, stoich_Y):
+        """mdot=20 g/s over 1 cm^2 puts the inlet above the isothermal
+        sound speed; heat release then drives the momentum-on flow to
+        the Rayleigh choking singularity (rho*u - P/u -> 0), where no
+        steady solution exists past the choke point. The solver must
+        REPORT failure, not silently return a wrong profile."""
+        sol = pfr_ops.solve_pfr(mech, "ENRG", mdot=20.0, T0=1100.0,
+                                P0=P_ATM, Y0=stoich_Y, length=50.0,
+                                area=1.0, momentum=True)
+        assert not bool(sol.success)
+        rho0 = float(thermo.density(mech, 1100.0, P_ATM,
+                                    jnp.asarray(stoich_Y)))
+        u0 = 20.0 / rho0
+        assert u0 > float(np.sqrt(P_ATM / rho0))   # indeed supersonic
+
     def test_tgiv_follows_profile(self, mech, stoich_Y):
         xs = np.array([0.0, 30.0])
         Ts = np.array([900.0, 1500.0])
         prof = pfr_ops.Profile(x=jnp.asarray(xs), y=jnp.asarray(Ts))
-        sol = pfr_ops.solve_pfr(mech, "TGIV", mdot=20.0, T0=900.0,
+        sol = pfr_ops.solve_pfr(mech, "TGIV", mdot=2.0, T0=900.0,
                                 P0=P_ATM, Y0=stoich_Y, length=30.0,
                                 t_profile=prof)
         assert bool(sol.success)
@@ -124,7 +145,7 @@ class TestPFRKernel:
 
 
 class TestPFRModels:
-    def _inlet(self, chem, mdot=20.0):
+    def _inlet(self, chem, mdot=2.0):
         s = Stream(chem, label="pfr-feed")
         s.temperature = 1100.0
         s.pressure = P_ATM
@@ -152,7 +173,7 @@ class TestPFRModels:
         assert "distance" in raw and "velocity" in raw
         exit_stream = r.get_exit_stream()
         assert exit_stream.temperature > 2300.0
-        assert exit_stream.mass_flowrate == pytest.approx(20.0)
+        assert exit_stream.mass_flowrate == pytest.approx(2.0)
 
     def test_model_run_sweep(self, chem):
         r = PlugFlowReactor_EnergyConservation(self._inlet(chem))

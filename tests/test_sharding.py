@@ -73,22 +73,29 @@ def test_sweep_matches_unsharded(mech, stoich_Y):
 
 
 def test_failure_isolation(mech, stoich_Y):
-    """A deliberately impossible element (absurd step budget) must flag
-    itself without corrupting its shard-mates' results."""
+    """A deliberately poisoned element (NaN initial temperature, which
+    stalls the stiff integrator via consecutive Newton rejections) must
+    flag itself without corrupting its shard-mates' results (SURVEY §5:
+    vmapped solves must not abort the whole batch)."""
     mesh = parallel.make_mesh()
     T0s = np.full(8, 1200.0)
-    # element 3 gets t_end so long the tiny step budget cannot reach it
-    t_ends = np.full(8, 2e-3)
-    t_ends[3] = 1e4
+    T0s[3] = np.nan
     times, ok = parallel.sharded_ignition_sweep(
-        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, t_ends,
-        mesh=mesh, rtol=1e-6, atol=1e-12, max_steps_per_segment=300)
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        mesh=mesh, rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
     assert not ok[3]
     others = np.ones(8, dtype=bool)
     others[3] = False
     assert np.all(ok[others])
     # the healthy elements still report the correct ignition time
     assert np.all(np.isfinite(times[others]))
+    t_ref, ok_ref = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", np.full(8, 1200.0), 1.01325e6, stoich_Y,
+        2e-3, mesh=mesh, rtol=1e-6, atol=1e-12,
+        max_steps_per_segment=8000)
+    assert np.all(ok_ref)
+    np.testing.assert_allclose(times[others],
+                               np.asarray(t_ref)[others], rtol=1e-10)
 
 
 def test_summary_collectives(mech, stoich_Y):
