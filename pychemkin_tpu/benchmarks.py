@@ -29,13 +29,18 @@ stderr so a failure is bisectable from the bench artifact alone.
 
 Environment knobs:
   BENCH_LADDER      comma list of mech:B pairs (default
-                    "h2o2:16,h2o2:256,h2o2:1024,grisyn:64,grisyn:512,grisyn:1024")
+                    "h2o2:16,h2o2:256,h2o2:1024,h2o2:4096,
+                     grisyn:64,grisyn:256,grisyn:1024,grisyn:4096")
+  BENCH_CHUNK       max batch elements per compiled call (default 256).
+                    Larger B runs as sequential chunks of ONE cached
+                    program, so compile time is flat in B, and a single
+                    giant program cannot crash the TPU worker (observed
+                    at grisyn B=512 in one unchunked call).
   BENCH_REPEATS     timed repetitions per config (default 1)
-  BENCH_BASELINE_N  serial-baseline sample points (default 2; 0 disables)
+  BENCH_BASELINE_N  serial-baseline sample points per mechanism
+                    (default 5; 0 disables)
   BENCH_PROBE_TIMEOUT    backend-probe timeout in s (default 120)
-  BENCH_CONFIG_TIMEOUT   per-config timeout in s (default 900; every
-                         rung is a fresh XLA program shape, so each one
-                         gets the full compile budget)
+  BENCH_CONFIG_TIMEOUT   per-config timeout in s (default 900)
 """
 
 from __future__ import annotations
@@ -52,7 +57,8 @@ import numpy as np
 #: (generous to the reference) of licensed-Chemkin single-core throughput
 FALLBACK_REFERENCE_IGNITIONS_PER_SEC = 2.0
 
-_DEFAULT_LADDER = "h2o2:16,h2o2:256,h2o2:1024,grisyn:64,grisyn:512,grisyn:1024"
+_DEFAULT_LADDER = ("h2o2:16,h2o2:256,h2o2:1024,h2o2:4096,"
+                   "grisyn:64,grisyn:256,grisyn:1024,grisyn:4096")
 
 #: per-mechanism sweep protocol: (T0 range [K], t_end [s], rtol, atol)
 _PROTOCOL = {
@@ -60,6 +66,31 @@ _PROTOCOL = {
     "grisyn": ((1000.0, 1400.0), 0.05, 1e-6, 1e-12),
     "gri30": ((1000.0, 1400.0), 0.05, 1e-6, 1e-12),
 }
+
+#: quoted per-chip peak for the MFU figure: v5e (v5 lite) bf16 systolic
+#: peak. MFU is conservative by construction — only the FLOPs of the
+#: numerical algorithm itself are counted (see _flop_model), not padding
+#: or masked lockstep work, and they are divided by the full bf16 peak
+#: although part of the algorithm runs as f64 software emulation.
+PEAK_FLOPS_PER_CHIP = 197e12
+
+
+def _flop_model(mech, n_steps, n_rejected, n_newton):
+    """Measured-counter FLOP model of the SDIRK3 integrator.
+
+    Per step attempt: one batched Jacobian (N forward tangents through
+    the RHS), one pivot-free LU (2/3 N^3), the error-filter solve; per
+    Newton iteration: one f64 RHS evaluation and one triangular solve
+    pair. The RHS cost model is the [II,KK] stoichiometry matmuls
+    (forward + reverse + assembly ~ 3 GEMV pairs) plus ~60 flops per
+    reaction of transcendental/falloff work and ~30 per species of
+    thermo polynomial work."""
+    KK, II, N = mech.n_species, mech.n_reactions, mech.n_species + 1
+    c_rhs = 6 * II * KK + 60 * II + 30 * KK
+    attempts = n_steps + n_rejected
+    f32 = attempts * (N * c_rhs + (2.0 / 3.0) * N ** 3 + 4 * N * N)
+    f64 = (n_newton + attempts) * c_rhs + n_newton * 2 * N * N
+    return f32, f64
 
 
 def _cpu_env():
@@ -126,30 +157,45 @@ def _child_config(mech_name: str, B: int, repeats: int):
     T0s = np.linspace(t_lo, t_hi, B)
     rng = np.random.default_rng(0)
     P0s = 1.01325e6 * (1.0 + rng.uniform(0.0, 1.0, B))  # 1-2 atm spread
+    chunk = int(os.environ.get("BENCH_CHUNK", 256))
 
-    def sweep():
+    def sweep(stats=None):
         return parallel.sharded_ignition_sweep(
             mech, "CONP", "ENRG", T0s, P0s, Y0, t_end, mesh=mesh,
-            rtol=rtol, atol=atol, max_steps_per_segment=20_000)
+            rtol=rtol, atol=atol, max_steps_per_segment=20_000,
+            chunk_size=chunk, stats=stats)
 
     t0 = time.time()
-    times, ok = sweep()            # compile + warm-up at full batch shape
+    times, ok = sweep()            # compile + warm-up (chunk-sized shape)
     compile_s = time.time() - t0
     print(f"# compile+warmup: {compile_s:.1f}s", file=sys.stderr)
 
     wall = []
+    stats = None
     for _ in range(repeats):
+        stats = parallel.SweepStats()
         t0 = time.time()
-        times, ok = sweep()
+        times, ok = sweep(stats)
         wall.append(time.time() - t0)
     run_s = min(wall)
     n_ok = int(np.sum(ok))
     n_ignited = int(np.sum(np.isfinite(times) & ok))
+    f32_flops, f64_flops = _flop_model(mech, stats.n_steps,
+                                       stats.n_rejected, stats.n_newton)
+    mfu = (f32_flops + f64_flops) / run_s / (
+        PEAK_FLOPS_PER_CHIP * n_chips)
     print(json.dumps(dict(
         platform=platform, n_chips=n_chips, mech=mech_name, B=B,
+        chunk=min(chunk, B),
         compile_s=round(compile_s, 1), run_s=round(run_s, 3),
         throughput=B / run_s / n_chips, rtol=rtol, atol=atol,
-        t_end=t_end, n_ok=n_ok, n_ignited=n_ignited)), flush=True)
+        t_end=t_end, n_ok=n_ok, n_ignited=n_ignited,
+        n_steps=stats.n_steps, n_rejected=stats.n_rejected,
+        n_newton=stats.n_newton,
+        steps_per_sec=round(stats.n_steps / run_s, 1),
+        model_f32_gflop=round(f32_flops / 1e9, 2),
+        model_f64_gflop=round(f64_flops / 1e9, 2),
+        mfu_pct=round(100.0 * mfu, 4))), flush=True)
 
 
 def _child_baseline(mech_name: str, n_points: int, budget_s: float):
@@ -340,11 +386,11 @@ def _main_guarded():
     if on_accel:
         results, accel_err = _run_ladder(ladder, repeats, cfg_timeout)
     else:
-        # no accelerator: run the same ladder on CPU in clean processes
-        # (no tunnel dial), truncated to its two smallest configs so a
-        # CPU-only host still finishes promptly
+        # no accelerator: run the same full ladder on CPU in clean
+        # processes (no tunnel dial); each rung still has its own
+        # timeout, so a slow CPU stops climbing on its own
         accel_err = f"no usable accelerator (probe={platform!r})"
-        results, cpu_err = _run_ladder(ladder[:2], repeats, cfg_timeout,
+        results, cpu_err = _run_ladder(ladder, repeats, cfg_timeout,
                                        env=_cpu_env())
         if cpu_err:
             accel_err += "; " + cpu_err
@@ -365,27 +411,49 @@ def _main_guarded():
 
     best = max(results, key=lambda r: r["throughput"])
 
-    # serial single-core baseline, same mechanism/protocol as `best`,
-    # in a CPU-only subprocess (immune to a poisoned accelerator client)
-    n_base = int(os.environ.get("BENCH_BASELINE_N", 2))
-    baseline_ips = None
+    # serial single-core baselines, one per mechanism that ran, in
+    # CPU-only subprocesses (immune to a poisoned accelerator client)
+    n_base = int(os.environ.get("BENCH_BASELINE_N", 5))
+    baselines = {}
     if n_base > 0:
-        rc, parsed, tail = _run_child(
-            ["baseline", best["mech"], str(n_base), "240"], 400,
-            env=_cpu_env())
-        if parsed and parsed.get("ignitions_per_sec"):
-            baseline_ips = parsed["ignitions_per_sec"]
-            print(f"# serial baseline: {parsed['n_points']} pts, "
-                  f"{parsed['s_per_ignition']:.2f} s/ignition",
-                  file=sys.stderr)
-        elif tail:
-            print("# baseline failed:\n#   "
-                  + tail.replace("\n", "\n#   "), file=sys.stderr)
-    if baseline_ips is None:
+        for mech_name in dict.fromkeys(r["mech"] for r in results):
+            rc, parsed, tail = _run_child(
+                ["baseline", mech_name, str(n_base), "300"], 460,
+                env=_cpu_env())
+            if parsed and parsed.get("ignitions_per_sec"):
+                baselines[mech_name] = {
+                    "ignitions_per_sec": round(
+                        parsed["ignitions_per_sec"], 4),
+                    "n_points": parsed["n_points"]}
+                print(f"# serial baseline {mech_name}: "
+                      f"{parsed['n_points']} pts, "
+                      f"{parsed['s_per_ignition']:.2f} s/ignition",
+                      file=sys.stderr)
+            elif tail:
+                print(f"# baseline {mech_name} failed:\n#   "
+                      + tail.replace("\n", "\n#   "), file=sys.stderr)
+    if best["mech"] in baselines:
+        baseline_ips = baselines[best["mech"]]["ignitions_per_sec"]
+        baseline_kind = "measured scipy-BDF single-core, same mech/tols"
+    else:
         baseline_ips = FALLBACK_REFERENCE_IGNITIONS_PER_SEC
         baseline_kind = "estimated"
-    else:
-        baseline_kind = "measured scipy-BDF single-core, same mech/tols"
+
+    # same-(mech,B) host-CPU comparison for the headline config: the
+    # honest TPU-vs-this-host number (the sweep code itself, not scipy)
+    host_cpu = None
+    if on_accel and os.environ.get("BENCH_CPU_COMPARE", "1") != "0":
+        rc, parsed, tail = _run_child(
+            ["config", best["mech"], str(best["B"]), "1"], cfg_timeout,
+            env=_cpu_env())
+        if parsed:
+            host_cpu = {k: parsed[k] for k in (
+                "throughput", "compile_s", "run_s")}
+            print(f"# host-CPU same config: "
+                  f"{parsed['throughput']:.2f}/s", file=sys.stderr)
+        elif tail:
+            print("# host-CPU compare failed:\n#   "
+                  + tail.replace("\n", "\n#   "), file=sys.stderr)
 
     out = {
         "metric": f"0-D ignitions/sec/chip ({best['mech']}, CONP/ENRG, "
@@ -396,17 +464,27 @@ def _main_guarded():
         "platform": best["platform"],
         "n_chips": best["n_chips"],
         "B": best["B"],
+        "chunk": best.get("chunk"),
         "compile_s": best["compile_s"],
         "run_s": best["run_s"],
         "n_ok": best["n_ok"],
         "n_ignited": best["n_ignited"],
+        "mfu_pct": best.get("mfu_pct"),
+        "steps_per_sec": best.get("steps_per_sec"),
         "baseline_ignitions_per_sec": round(baseline_ips, 4),
         "baseline_kind": baseline_kind,
+        "baselines": baselines,
         "configs_run": [
-            {k: r[k] for k in ("mech", "B", "throughput", "compile_s",
-                               "run_s", "platform")}
+            {k: r.get(k) for k in ("mech", "B", "chunk", "throughput",
+                                   "compile_s", "run_s", "mfu_pct",
+                                   "steps_per_sec", "n_steps",
+                                   "n_rejected", "n_newton", "platform")}
             for r in results],
     }
+    if host_cpu is not None:
+        out["host_cpu_same_config"] = host_cpu
+        out["vs_host_cpu"] = round(
+            best["throughput"] / host_cpu["throughput"], 2)
     if is_fallback:
         out["fallback"] = True
     if accel_err:

@@ -25,7 +25,7 @@ import numpy as np
 
 from .logger import logger
 from .mechanism import MechanismRecord, load_mechanism
-from .ops import thermo, transport
+from .ops import realgas, thermo, transport
 
 # ---------------------------------------------------------------------------
 # module-level verbosity + registry (parity with reference chemistry.py:46-51)
@@ -120,6 +120,10 @@ class Chemistry:
         self.mech: Optional[MechanismRecord] = None
         self.userealgas = False
         self._EOS = 0
+        self._realgas_eos = realgas.PR       # default model when enabled
+        self._realgas_mixing_rule = realgas.MIX_VDW
+        self._critical_overrides = {}
+        self._critical_cache = None
         if surf and os.path.isfile(surf):
             logger.warning("surface mechanisms are not supported; "
                            "ignoring %s", surf)
@@ -382,20 +386,75 @@ class Chemistry:
                 f"reaction index must be in [1, {mech.n_reactions}]")
         return mech.reaction_equations[reaction_index - 1]
 
-    # --- real-gas toggles (chemistry.py:1535-1603): API kept, ideal only ---
+    # --- real-gas cubic EOS (reference: chemistry.py:1535-1603) -----------
+    # The reference reads the EOS selection and critical data from the
+    # mechanism's native real-gas block; here critical constants come
+    # from the built-in table in ops/realgas.py plus per-species user
+    # overrides, and the model is selected by name/index.
+
+    realgas_CuEOS = list(realgas.EOS_NAMES)
+    realgas_mixing_rules = list(realgas.MIXING_RULE_NAMES)
+
+    def set_critical_properties(self, species: str, Tc: float, Pc: float,
+                                omega: float):
+        """Provide (or override) critical constants for ``species``:
+        Tc [K], Pc [bar], acentric factor."""
+        self._critical_overrides[species.upper()] = (Tc, Pc, omega)
+        self._critical_cache = None
+
+    def critical_set(self):
+        """Per-species critical data aligned to this mechanism."""
+        if self._critical_cache is None:
+            mech = self._require_mech()
+            self._critical_cache = realgas.critical_set_for(
+                mech.species_names, self._critical_overrides)
+        return self._critical_cache
+
+    def set_realgas_eos_model(self, model):
+        """Select the cubic EOS by index 1-5 or name from
+        ``Chemistry.realgas_CuEOS`` (reference selects it from the
+        mechanism's real-gas data block)."""
+        if isinstance(model, str):
+            names = [n.lower() for n in self.realgas_CuEOS]
+            model = names.index(model.lower())
+        if not 1 <= int(model) <= 5:
+            raise ValueError("EOS model index must be 1..5 "
+                             f"({self.realgas_CuEOS[1:]})")
+        self._realgas_eos = int(model)
+
     def use_realgas_cubicEOS(self):
-        """Real-gas cubic EOS is not implemented in this build; the flag is
-        accepted for API parity and ignored with a warning
-        (reference: chemistry.py:1535)."""
-        logger.warning("real-gas cubic EOS not implemented; staying with "
-                       "ideal-gas law")
-        self.userealgas = False
+        """Turn ON the real-gas cubic EOS for mixture properties
+        (reference: chemistry.py:1535). Requires critical data for at
+        least one species; species without data contribute ideally."""
+        mech = self._require_mech()
+        with_data = realgas.species_with_data(mech.species_names,
+                                              self._critical_overrides)
+        if not with_data:
+            logger.info("mechanism is for ideal gas law only.")
+            self.userealgas = False
+            return
+        missing = [s for s in mech.species_names if s not in with_data]
+        if missing:
+            logger.info("no critical data for %s; they contribute "
+                        "ideally", ", ".join(missing[:8]))
+        logger.info("real-gas cubic EOS model %s is turned ON.",
+                    self.realgas_CuEOS[self._realgas_eos])
+        self.userealgas = True
 
     def use_idealgas_law(self):
+        """Back to the ideal-gas law (reference: chemistry.py:1573)."""
         self.userealgas = False
 
+    def set_realgas_mixing_rule(self, rule: int = 0):
+        """0 = Van der Waals, 1 = pseudocritical
+        (reference: mixture.py:2737)."""
+        if rule not in (0, 1):
+            raise ValueError("mixing rule must be 0 (Van der Waals) or "
+                             "1 (pseudocritical)")
+        self._realgas_mixing_rule = int(rule)
+
     def verify_realgas_model(self):
-        return 0
+        return self._realgas_eos if self.userealgas else 0
 
     # --- registry shims (chemistry.py:1782-1822) ---------------------------
     def save(self):

@@ -32,7 +32,16 @@ from .chemistry import Chemistry, get_chemistryset
 from .constants import P_ATM, R_GAS
 from .logger import logger
 from .ops import equilibrium as eq_ops
-from .ops import kinetics, thermo, transport
+from .ops import kinetics, realgas, thermo, transport
+
+
+def _realgas_cfg(chem):
+    """(eos, mixing_rule, critical_set) when the chemistry has the
+    real-gas cubic EOS enabled, else None (ideal gas)."""
+    if chem is not None and getattr(chem, "userealgas", False):
+        return (chem._realgas_eos, chem._realgas_mixing_rule,
+                chem.critical_set())
+    return None
 
 Recipe = List[Tuple[str, float]]
 
@@ -300,20 +309,32 @@ class Mixture:
     @staticmethod
     def density(chemID: int, p: float, t: float, frac, wt,
                 mode: str) -> float:
-        """Mass density [g/cm^3] (reference: mixture.py:992)."""
-        mech = get_chemistryset(chemID).mech
-        frac = np.asarray(frac, dtype=np.double)
-        if mode.lower() == "mole":
-            Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
-        else:
-            Y = frac / frac.sum()
+        """Mass density [g/cm^3] (reference: mixture.py:992). Uses the
+        cubic EOS when the chemistry set has real gas enabled."""
+        chem = get_chemistryset(chemID)
+        mech = chem.mech
+        X, Y = Mixture._frac_to_XY(frac, wt, mode)
+        cfg = _realgas_cfg(chem)
+        if cfg is not None:
+            eos, rule, crit = cfg
+            wbar = float(np.sum(X * np.asarray(wt)))
+            return float(realgas.density(eos, rule, t, p,
+                                         jnp.asarray(X), wbar, crit))
         return float(thermo.density(mech, t, p, jnp.asarray(Y)))
 
     @property
     def RHO(self) -> float:
         """Mass density of this mixture [g/cm^3] (reference:
-        mixture.py:1091)."""
+        mixture.py:1091). Routed through the cubic EOS when the
+        chemistry set has the real-gas model enabled
+        (reference: mixture.py:2664)."""
         self._require_state()
+        cfg = _realgas_cfg(self._chem)
+        if cfg is not None:
+            eos, rule, crit = cfg
+            return float(realgas.density(eos, rule, self._T, self._P,
+                                         jnp.asarray(self.X), self.WTM,
+                                         crit))
         return float(thermo.density(self.mech, self._T, self._P,
                                     jnp.asarray(self.Y)))
 
@@ -324,28 +345,48 @@ class Mixture:
 
     # --- mixture thermo properties (reference: mixture.py:1149-1352) -------
     @staticmethod
-    def mixture_specific_heat(chemID: int, p: float, t: float, frac, wt,
-                              mode: str) -> float:
-        """Mixture Cp [erg/(g K)] (reference: mixture.py:1149)."""
-        mech = get_chemistryset(chemID).mech
+    def _frac_to_XY(frac, wt, mode):
         frac = np.asarray(frac, dtype=np.double)
         if mode.lower() == "mole":
             Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
+            X = frac / frac.sum()
         else:
             Y = frac / frac.sum()
-        return float(thermo.mixture_cp_mass(mech, t, jnp.asarray(Y)))
+            X = Mixture.mass_fraction_to_mole_fraction(Y, wt)
+        return X, Y
+
+    @staticmethod
+    def mixture_specific_heat(chemID: int, p: float, t: float, frac, wt,
+                              mode: str) -> float:
+        """Mixture Cp [erg/(g K)] (reference: mixture.py:1149); includes
+        the cubic-EOS departure when real gas is enabled."""
+        chem = get_chemistryset(chemID)
+        X, Y = Mixture._frac_to_XY(frac, wt, mode)
+        cp = float(thermo.mixture_cp_mass(chem.mech, t, jnp.asarray(Y)))
+        cfg = _realgas_cfg(chem)
+        if cfg is not None:
+            eos, rule, crit = cfg
+            wbar = float(np.sum(X * np.asarray(wt)))
+            cp += float(realgas.cp_departure(
+                eos, rule, t, p, jnp.asarray(X), crit)) / wbar
+        return cp
 
     @staticmethod
     def mixture_enthalpy(chemID: int, p: float, t: float, frac, wt,
                          mode: str) -> float:
-        """Mixture specific enthalpy [erg/g] (reference: mixture.py:1254)."""
-        mech = get_chemistryset(chemID).mech
-        frac = np.asarray(frac, dtype=np.double)
-        if mode.lower() == "mole":
-            Y = Mixture.mole_fraction_to_mass_fraction(frac, wt)
-        else:
-            Y = frac / frac.sum()
-        return float(thermo.mixture_enthalpy_mass(mech, t, jnp.asarray(Y)))
+        """Mixture specific enthalpy [erg/g] (reference: mixture.py:1254);
+        includes the cubic-EOS departure when real gas is enabled."""
+        chem = get_chemistryset(chemID)
+        X, Y = Mixture._frac_to_XY(frac, wt, mode)
+        h = float(thermo.mixture_enthalpy_mass(chem.mech, t,
+                                               jnp.asarray(Y)))
+        cfg = _realgas_cfg(chem)
+        if cfg is not None:
+            eos, rule, crit = cfg
+            wbar = float(np.sum(X * np.asarray(wt)))
+            h += float(realgas.enthalpy_departure(
+                eos, rule, t, p, jnp.asarray(X), crit)) / wbar
+        return h
 
     # --- kinetics (reference: mixture.py:1353-1568) ------------------------
     @staticmethod
@@ -386,16 +427,30 @@ class Mixture:
     # calls mix.HML(), mix.ROP(), etc.; exposing them as properties would
     # break every ported script with "'float' object is not callable".
     def HML(self) -> float:
-        """Mixture molar enthalpy [erg/mol] (reference: mixture.py:1599)."""
+        """Mixture molar enthalpy [erg/mol] (reference: mixture.py:1599).
+        Includes the cubic-EOS departure when real gas is enabled."""
         self._require_state(need_P=False)
-        return float(thermo.mixture_enthalpy_molar(
+        h = float(thermo.mixture_enthalpy_molar(
             self.mech, self._T, jnp.asarray(self.X)))
+        cfg = _realgas_cfg(self._chem)
+        if cfg is not None and self._Pset:
+            eos, rule, crit = cfg
+            h += float(realgas.enthalpy_departure(
+                eos, rule, self._T, self._P, jnp.asarray(self.X), crit))
+        return h
 
     def CPBL(self) -> float:
-        """Mixture molar Cp [erg/(mol K)] (reference: mixture.py:1646)."""
+        """Mixture molar Cp [erg/(mol K)] (reference: mixture.py:1646).
+        Includes the cubic-EOS departure when real gas is enabled."""
         self._require_state(need_P=False)
-        return float(thermo.mixture_cp_molar(self.mech, self._T,
-                                             jnp.asarray(self.X)))
+        cp = float(thermo.mixture_cp_molar(self.mech, self._T,
+                                           jnp.asarray(self.X)))
+        cfg = _realgas_cfg(self._chem)
+        if cfg is not None and self._Pset:
+            eos, rule, crit = cfg
+            cp += float(realgas.cp_departure(
+                eos, rule, self._T, self._P, jnp.asarray(self.X), crit))
+        return cp
 
     def ROP(self) -> np.ndarray:
         """Net production rates at this state, mol/(cm^3 s)
@@ -601,16 +656,23 @@ class Mixture:
             raise RuntimeError("mechanism has no transport data")
         return mech
 
-    # --- real-gas API shims (reference: mixture.py:2664-2801) --------------
+    # --- real-gas toggles (reference: mixture.py:2664-2801) ----------------
+    # Delegated to the chemistry set: like the reference's native
+    # workspace, the EOS selection is a chemistry-level state shared by
+    # every mixture of that chemistry.
     def use_realgas_cubicEOS(self):
-        logger.warning("real-gas cubic EOS not implemented; ideal gas law "
-                       "remains in effect")
+        """Enable the cubic EOS for this mixture's chemistry set
+        (reference: mixture.py:2664)."""
+        self._chem.use_realgas_cubicEOS()
 
     def use_idealgas_law(self):
-        pass
+        """Back to the ideal-gas law (reference: mixture.py:2706)."""
+        self._chem.use_idealgas_law()
 
     def set_realgas_mixing_rule(self, rule: int = 0):
-        logger.warning("real-gas mixing rules not implemented")
+        """0 = Van der Waals, 1 = pseudocritical mixing
+        (reference: mixture.py:2737)."""
+        self._chem.set_realgas_mixing_rule(rule)
 
 
 # ---------------------------------------------------------------------------

@@ -115,13 +115,9 @@ class Flame(ReactorModel, SteadyStateSolver, Grid):
         self._set_transport_keyword("MIX")
 
     def use_multicomponent_transport(self):
-        """MULT (reference flame.py:267). The TPU build's multicomponent
-        path is the mixture-averaged formulation with the correction
-        velocity already enforcing zero net diffusive mass flux; full
-        Stefan-Maxwell is not implemented, so this selects MIX with a
-        warning rather than silently differing."""
-        logger.warning("multicomponent transport falls back to "
-                       "mixture-averaged with correction velocity")
+        """MULT (reference flame.py:267): ordinary diffusion from a
+        Stefan-Maxwell solve at every grid face
+        (:func:`pychemkin_tpu.ops.transport.stefan_maxwell_fluxes`)."""
         self.transport_mode = 2
         self._set_transport_keyword("MULT")
 
@@ -159,7 +155,7 @@ class Flame(ReactorModel, SteadyStateSolver, Grid):
     # --- solver-core option assembly ---------------------------------------
 
     def _transport_model_name(self) -> str:
-        return "LEWIS" if self.transport_mode == 3 else "MIX"
+        return {2: "MULT", 3: "LEWIS"}.get(self.transport_mode, "MIX")
 
     def _flame_solver_options(self) -> dict:
         """Options dict for ops.flame1d.solve_flame shared by every

@@ -118,20 +118,28 @@ def _face(mech, cfg: FlameConfig, P, u_l, u_r, x_l, x_r):
     dTdx = (T_r - T_l) / h
     dXdx = (X_r - X_l) / h
 
-    if cfg.transport == "LEWIS":
-        cp_f = thermo.mixture_cp_mass(mech, T_f, Y_f_c)
-        D_k = jnp.full(mech.n_species,
-                       lam / (rho_f * cp_f * cfg.lewis))
+    if cfg.transport == "MULT":
+        # full multicomponent: Stefan-Maxwell solve at the face
+        # (reference flame.py:267 MULT; one [KK,KK] solve per face)
+        j = transport.stefan_maxwell_fluxes(
+            mech, T_f, P, X_f, Y_f_c, dXdx, rho_f,
+            dTdx=dTdx, soret=cfg.soret)
     else:
-        D_k = transport.mixture_diffusion_coefficients(mech, T_f, P, X_f)
+        if cfg.transport == "LEWIS":
+            cp_f = thermo.mixture_cp_mass(mech, T_f, Y_f_c)
+            D_k = jnp.full(mech.n_species,
+                           lam / (rho_f * cp_f * cfg.lewis))
+        else:
+            D_k = transport.mixture_diffusion_coefficients(mech, T_f, P,
+                                                           X_f)
 
-    # mixture-averaged Fickian flux j_k = -rho (W_k/Wbar) D_k dX_k/dx
-    j = -rho_f * (mech.wt / wbar) * D_k * dXdx
-    if cfg.soret:
-        theta = transport.thermal_diffusion_ratios(mech, T_f, X_f)
-        j = j - rho_f * (mech.wt / wbar) * D_k * theta * dTdx / T_f
-    # correction flux: enforce sum_k j_k = 0 exactly
-    j = j - Y_f_c * jnp.sum(j)
+        # mixture-averaged Fickian flux j_k = -rho (W_k/Wbar) D_k dX_k/dx
+        j = -rho_f * (mech.wt / wbar) * D_k * dXdx
+        if cfg.soret:
+            theta = transport.thermal_diffusion_ratios(mech, T_f, X_f)
+            j = j - rho_f * (mech.wt / wbar) * D_k * theta * dTdx / T_f
+        # correction flux: enforce sum_k j_k = 0 exactly
+        j = j - Y_f_c * jnp.sum(j)
 
     q_cond = -lam * dTdx
     return q_cond, j
@@ -462,6 +470,7 @@ class FlameSolution(NamedTuple):
     n_points: int
     n_regrids: int
     n_newton: Any
+    u: Any = None    # packed state [N, M] for CNTN continuation restarts
 
 
 def initial_profile(mech, x, P, T_in, Y_in, xcen, wmix, *,
@@ -753,4 +762,5 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         Y=np.clip(np.asarray(Y_out), 0.0, 1.0), mdot=mdot_out,
         flame_speed=su,
         converged=converged, n_points=int(x.shape[0]),
-        n_regrids=n_regrids, n_newton=total_newton)
+        n_regrids=n_regrids, n_newton=total_newton,
+        u=np.asarray(u))

@@ -114,6 +114,7 @@ class ODESolution(NamedTuple):
     success: Any      # bool: reached ts[-1] without stalling
     t_final: Any = None   # diagnostic: integrator time at exit
     stalled: Any = None   # diagnostic: True if the step loop gave up
+    n_newton: Any = None  # total Newton iterations (for FLOP accounting)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -129,15 +130,52 @@ def _norm(x, w):
     return jnp.sqrt(jnp.mean((x / w) ** 2))
 
 
+def _cast_floats(tree, dtype):
+    """Cast every floating-point leaf of a pytree to ``dtype``."""
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def _make_jac_fn(rhs):
+    """Platform-appropriate Jacobian of the RHS.
+
+    The Jacobian only builds the modified-Newton matrix M = I - h*g*J —
+    a preconditioner, not part of the converged answer (the stage
+    residuals stay f64). On TPU, where f64 is software-emulated, the
+    whole jacfwd pass — N tangents pushed through the [II, KK]
+    stoichiometry matmuls — therefore runs in f32: the tangent matmuls
+    land on the MXU natively and the dominant per-step cost drops from
+    emulated-f64 to hardware f32. An f32-accurate J costs at most an
+    extra Newton iteration; the integration accuracy is set by the f64
+    residuals and error estimate, not by J. CPU keeps exact f64 (unit
+    tests cross-check against scipy at tight tolerances there)."""
+    if linalg.use_mixed_precision():
+        def jac_fn(t, y, args):
+            args32 = _cast_floats(args, jnp.float32)
+            t32 = jnp.asarray(t, jnp.float32)
+
+            def rhs32(yy):
+                return rhs(t32, yy, args32)
+
+            return jax.jacfwd(rhs32)(y.astype(jnp.float32))
+        return jac_fn
+    return lambda t, y, a: jax.jacfwd(lambda yy: rhs(t, yy, a))(y)
+
+
 def _newton_stage(rhs, t_stage, y_base, z0, h, fac, args, weights):
     """Solve the SDIRK stage equation z = h * f(t_stage, y_base + gamma*z)
     by modified Newton with the factored M = I - h*gamma*J.
 
-    Returns (z, converged)."""
+    Returns (z, converged, n_iters)."""
     def body(carry):
         z, _, it, prev_dn, _ = carry
         g = z - h * rhs(t_stage, y_base + _GAMMA * z, args)
-        dz = linalg.solve_factored(fac, -g)
+        # refine=0: a Newton direction only needs f32 solve accuracy
+        # (far below the 3e-2 weighted Newton tolerance)
+        dz = linalg.solve_factored(fac, -g, refine=0)
         z_new = z + dz
         dn = _norm(dz, weights)
         dn = jnp.where(jnp.isfinite(dn), dn, jnp.inf)
@@ -151,8 +189,8 @@ def _newton_stage(rhs, t_stage, y_base, z0, h, fac, args, weights):
 
     init = (z0, jnp.array(False), jnp.array(0), jnp.array(jnp.inf),
             jnp.array(False))
-    z, converged, _, _, _ = jax.lax.while_loop(cond, body, init)
-    return z, converged
+    z, converged, n_it, _, _ = jax.lax.while_loop(cond, body, init)
+    return z, converged, n_it
 
 
 def _quad_peak(tq, gq):
@@ -238,6 +276,7 @@ class _StepState(NamedTuple):
     h: Any
     n_steps: Any
     n_rejected: Any
+    n_newton: Any   # total Newton iterations across all stage solves
     consec_rej: Any
     acc_t: Any
     acc_v: Any
@@ -266,25 +305,28 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
         clipped = s.h > remaining
 
         J = jac_fn(s.t, s.y, args)
-        M = jnp.eye(n, dtype=dtype) - (h * _GAMMA) * J
+        # build M in J's dtype: on TPU J is f32 (see _make_jac_fn) and
+        # the factorization consumes f32 anyway
+        M = jnp.eye(n, dtype=J.dtype) - (h * _GAMMA).astype(J.dtype) * J
         fac = linalg.factor(M)
 
         w = ctrl.atol + ctrl.rtol * jnp.abs(s.y)
 
         z0 = h * s.f
-        z1, ok1 = _newton_stage(rhs, s.t + _C[0] * h, s.y, z0, h, fac,
-                                args, w)
+        z1, ok1, it1 = _newton_stage(rhs, s.t + _C[0] * h, s.y, z0, h,
+                                     fac, args, w)
         y_base2 = s.y + _A21 * z1
-        z2, ok2 = _newton_stage(rhs, s.t + _C[1] * h, y_base2, z1, h, fac,
-                                args, w)
+        z2, ok2, it2 = _newton_stage(rhs, s.t + _C[1] * h, y_base2, z1, h,
+                                     fac, args, w)
         y_base3 = s.y + _B1 * z1 + _B2 * z2
-        z3, ok3 = _newton_stage(rhs, s.t + h, y_base3, z2, h, fac,
-                                args, w)
+        z3, ok3, it3 = _newton_stage(rhs, s.t + h, y_base3, z2, h, fac,
+                                     args, w)
         newton_ok = ok1 & ok2 & ok3
 
         y_new = y_base3 + _B3 * z3        # stiffly accurate
         e_raw = _ERR_W[0] * z1 + _ERR_W[1] * z2 + _ERR_W[2] * z3
-        e = linalg.solve_factored(fac, e_raw)
+        # the (I - h*g*J)^-1 error filter is a smoother; f32 is plenty
+        e = linalg.solve_factored(fac, e_raw, refine=0)
         w_new = ctrl.atol + ctrl.rtol * jnp.maximum(jnp.abs(s.y),
                                                     jnp.abs(y_new))
         err = _norm(e, w_new)
@@ -323,6 +365,7 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
             h=jnp.where(active, h_next, s.h),
             n_steps=s.n_steps + jnp.where(accept, 1, 0),
             n_rejected=s.n_rejected + jnp.where(active & ~accept, 1, 0),
+            n_newton=s.n_newton + jnp.where(active, it1 + it2 + it3, 0),
             consec_rej=consec,
             acc_t=acc_t, acc_v=acc_v,
             stalled=s.stalled | stalled,
@@ -360,7 +403,7 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
                  max_steps_per_segment=max_steps_per_segment, h0=h0)
 
     if jac is None:
-        jac_fn = lambda t, y, a: jax.jacfwd(lambda yy: rhs(t, yy, a))(y)
+        jac_fn = _make_jac_fn(rhs)
     else:
         jac_fn = jac
 
@@ -380,6 +423,7 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
     state = _StepState(
         t=t0, y=y0, f=f0, h=h_init,
         n_steps=jnp.array(0), n_rejected=jnp.array(0),
+        n_newton=jnp.array(0),
         consec_rej=jnp.array(0),
         acc_t=acc_t0,
         acc_v=jnp.full((n_ev,), -jnp.inf, dtype=y0.dtype),
@@ -403,4 +447,4 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
                        event_values=state.acc_v,
                        n_steps=state.n_steps, n_rejected=state.n_rejected,
                        success=success, t_final=state.t,
-                       stalled=state.stalled)
+                       stalled=state.stalled, n_newton=state.n_newton)

@@ -211,6 +211,8 @@ class BatchSolution(NamedTuple):
     ignition_time: Any  # scalar (s); nan if not detected
     n_steps: Any
     success: Any
+    n_rejected: Any = None   # solver stats (FLOP/MFU accounting)
+    n_newton: Any = None
 
 
 def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
@@ -298,7 +300,8 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
 
     return BatchSolution(times=ts, T=Ts, P=Ps, volume=Vs, Y=Ys,
                          ignition_time=ignition_time,
-                         n_steps=sol.n_steps, success=sol.success)
+                         n_steps=sol.n_steps, success=sol.success,
+                         n_rejected=sol.n_rejected, n_newton=sol.n_newton)
 
 
 def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,

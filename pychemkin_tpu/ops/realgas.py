@@ -1,0 +1,293 @@
+"""Real-gas cubic equations of state (JAX kernels).
+
+TPU-native replacement for the reference's real-gas module
+(reference: realgaseos.py:30-74 — thin ctypes glue over the native
+``KINRealGas_*`` entry points; chemistry.py:273-281 for the model list;
+mixture.py:2664-2801 for the mixture-level toggles). The five cubic
+models the reference exposes are implemented in one generalized form
+
+    P = RT/(v - b) - a(T) / (v^2 + u*b*v + w*b^2)
+
+with per-model (u, w, Omega_a, Omega_b, alpha(T)):
+
+  index 1  Van der Waals   u=0 w=0   27/64    1/8     alpha = 1
+  index 2  Redlich-Kwong   u=1 w=0   0.42748  0.08664 alpha = Tr^-1/2
+  index 3  Soave (SRK)     u=1 w=0   0.42748  0.08664 alpha = [1+m(1-sqrt(Tr))]^2
+  index 4  Aungier         u=1 w=0   0.42748  0.08664 alpha = Tr^-n(omega)
+  index 5  Peng-Robinson   u=2 w=-1  0.45724  0.07780 alpha = [1+m(1-sqrt(Tr))]^2
+
+(Aungier 1995's modified RK exponent n = 0.4986 + 1.1735*omega +
+0.4754*omega^2; the volume-translation constant of the full Aungier
+model is omitted.) Mixing rules match the reference's two options
+(chemistry.py:280): Van der Waals one-fluid (quadratic in a, linear in
+b) and pseudocritical (Kay's rule on Tc/Pc/omega).
+
+Everything is a pure jit/vmap/grad-transparent function of
+(T, P, X, Tc, Pc, omega); temperature derivatives for Cp and the
+departure functions come from ``jax.grad`` instead of hand-coded
+d(a*alpha)/dT. Units are CGS throughout (dyne/cm^2, erg, mol, K).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import R_GAS
+
+# EOS model indices (= reference Chemistry.realgas_CuEOS positions)
+IDEAL, VDW, RK, SOAVE, AUNGIER, PR = 0, 1, 2, 3, 4, 5
+EOS_NAMES = ("ideal gas", "Van der Waals", "Redlich-Kwong", "Soave",
+             "Aungier", "Peng-Robinson")
+MIX_VDW, MIX_PSEUDOCRITICAL = 0, 1
+MIXING_RULE_NAMES = ("Van der Waals", "pseudocritical")
+
+#: (u, w, Omega_a, Omega_b) per model index (index 0 unused).
+#: Full-precision Omega constants matter: at the critical point the
+#: cubic has a TRIPLE root, and an Omega rounded at 1e-5 splits it by
+#: O(1e-5)^(1/3) ~ 2% in Z.
+_RK_OA = 1.0 / (9.0 * (2.0 ** (1.0 / 3.0) - 1.0))       # 0.42748023...
+_RK_OB = (2.0 ** (1.0 / 3.0) - 1.0) / 3.0               # 0.08664035...
+_EOS_UW = {
+    VDW: (0.0, 0.0, 27.0 / 64.0, 1.0 / 8.0),
+    RK: (1.0, 0.0, _RK_OA, _RK_OB),
+    SOAVE: (1.0, 0.0, _RK_OA, _RK_OB),
+    AUNGIER: (1.0, 0.0, _RK_OA, _RK_OB),
+    PR: (2.0, -1.0, 0.4572355289213822, 0.07779607390388846),
+}
+
+#: critical constants (Tc [K], Pc [bar], acentric factor) for common
+#: species; Pc converted to dyne/cm^2 (x1e6) on use. Sources: standard
+#: tabulations (Poling/Prausnitz/O'Connell App. A values).
+CRITICAL_DATA = {
+    "H2": (33.15, 12.96, -0.219),
+    "H2O": (647.10, 220.64, 0.3443),
+    "O2": (154.58, 50.43, 0.0222),
+    "N2": (126.19, 33.96, 0.0372),
+    "CO": (132.86, 34.94, 0.0497),
+    "CO2": (304.13, 73.77, 0.2239),
+    "CH4": (190.56, 45.99, 0.0115),
+    "C2H6": (305.32, 48.72, 0.0995),
+    "C3H8": (369.83, 42.48, 0.1523),
+    "AR": (150.69, 48.63, -0.0022),
+    "HE": (5.19, 2.27, -0.390),
+    "NH3": (405.40, 113.53, 0.2560),
+    "N2O": (309.52, 72.45, 0.1613),
+    "NO": (180.00, 64.80, 0.5820),
+    "SO2": (430.64, 78.84, 0.2562),
+    "H2S": (373.40, 89.63, 0.0942),
+    "C2H4": (282.34, 50.41, 0.0862),
+    "C2H2": (308.30, 61.14, 0.1912),
+}
+
+
+class CriticalSet(NamedTuple):
+    """Per-species critical data aligned to a mechanism's species order.
+    Species without data carry Tc=0, which zeroes their a/b contribution
+    (they behave ideally inside the mixture — the right limit for trace
+    radicals that have no tabulated critical constants)."""
+    Tc: jnp.ndarray      # [KK] K (0 = no data)
+    Pc: jnp.ndarray      # [KK] dyne/cm^2
+    omega: jnp.ndarray   # [KK]
+
+
+def critical_set_for(species_names, overrides=None) -> CriticalSet:
+    """Build a :class:`CriticalSet` from the built-in table plus
+    per-species ``overrides`` {name: (Tc[K], Pc[bar], omega)}."""
+    table = dict(CRITICAL_DATA)
+    if overrides:
+        table.update({k.upper(): v for k, v in overrides.items()})
+    Tc, Pc, om = [], [], []
+    for name in species_names:
+        tc, pc, w = table.get(name.upper(), (0.0, 0.0, 0.0))
+        Tc.append(tc)
+        Pc.append(pc * 1e6)     # bar -> dyne/cm^2
+        om.append(w)
+    return CriticalSet(Tc=jnp.asarray(Tc), Pc=jnp.asarray(Pc),
+                       omega=jnp.asarray(om))
+
+
+def species_with_data(species_names, overrides=None):
+    crit = critical_set_for(species_names, overrides)
+    import numpy as np
+    return [n for n, tc in zip(species_names, np.asarray(crit.Tc))
+            if tc > 0.0]
+
+
+def _alpha(eos: int, Tr, omega):
+    if eos == VDW:
+        return jnp.ones_like(Tr)
+    if eos == RK:
+        return 1.0 / jnp.sqrt(Tr)
+    if eos == SOAVE:
+        m = 0.480 + 1.574 * omega - 0.176 * omega ** 2
+        return (1.0 + m * (1.0 - jnp.sqrt(Tr))) ** 2
+    if eos == AUNGIER:
+        n = 0.4986 + 1.1735 * omega + 0.4754 * omega ** 2
+        return Tr ** (-n)
+    if eos == PR:
+        m = 0.37464 + 1.54226 * omega - 0.26992 * omega ** 2
+        return (1.0 + m * (1.0 - jnp.sqrt(Tr))) ** 2
+    raise ValueError(f"unknown cubic EOS index {eos}")
+
+
+def _ab_mix(eos: int, mixing_rule: int, T, X, crit: CriticalSet):
+    """Mixture a(T) [erg cm^3 / mol^2] and b [cm^3/mol]."""
+    u, w, oa, ob = _EOS_UW[eos]
+    has = crit.Tc > 0.0
+    Tc = jnp.where(has, crit.Tc, 1.0)         # avoid 0-division
+    Pc = jnp.where(has, crit.Pc, 1.0)
+    if mixing_rule == MIX_PSEUDOCRITICAL:
+        # Kay's rule over the species WITH data, weighted by their
+        # normalized mole fractions; the data-less remainder contributes
+        # ideally (a=b=0 share)
+        xs = jnp.where(has, X, 0.0)
+        s = jnp.maximum(xs.sum(), 1e-300)
+        Tcm = jnp.sum(xs * Tc) / s
+        Pcm = jnp.sum(xs * Pc) / s
+        omm = jnp.sum(xs * crit.omega) / s
+        Trm = T / jnp.maximum(Tcm, 1e-300)
+        a_m = oa * (R_GAS * Tcm) ** 2 / Pcm * _alpha(eos, Trm, omm)
+        b_m = ob * R_GAS * Tcm / Pcm
+        return a_m * s ** 2, b_m * s
+    # Van der Waals one-fluid
+    ai = oa * (R_GAS * Tc) ** 2 / Pc * _alpha(eos, T / Tc, crit.omega)
+    bi = ob * R_GAS * Tc / Pc
+    ai = jnp.where(has, ai, 0.0)
+    bi = jnp.where(has, bi, 0.0)
+    # double-where: sqrt'(0) is infinite, and a data-less species'
+    # 0 * inf would NaN the jax.grad used for d(a)/dT
+    pos = ai > 0.0
+    sqa = jnp.where(pos, jnp.sqrt(jnp.where(pos, ai, 1.0)), 0.0)
+    a_m = jnp.sum(X * sqa) ** 2          # sum_ij x_i x_j sqrt(a_i a_j)
+    b_m = jnp.sum(X * bi)
+    return a_m, b_m
+
+
+def _largest_real_cubic_root(c2, c1, c0):
+    """Largest real root of z^3 + c2 z^2 + c1 z + c0 (Cardano, branch-
+    selected with masks — fixed op count, jit/vmap safe).
+
+    Both branches are evaluated on SAFE inputs (the classic
+    double-``where``): without the guards, ``sqrt(max(disc,0))`` has an
+    infinite derivative at disc=0 and ``arccos(+-1)`` likewise, and the
+    resulting NaN poisons ``jax.grad`` through the selected branch even
+    when the primal value is fine."""
+    p = c1 - c2 * c2 / 3.0
+    q = 2.0 * c2 ** 3 / 27.0 - c2 * c1 / 3.0 + c0
+    disc = (q / 2.0) ** 2 + (p / 3.0) ** 3
+    pos = disc > 0.0
+
+    # one-real-root branch (disc > 0)
+    sd = jnp.sqrt(jnp.where(pos, disc, 1.0))
+    t1 = jnp.cbrt(-q / 2.0 + sd) + jnp.cbrt(-q / 2.0 - sd)
+
+    # three-real-roots branch (disc <= 0, so p < 0): largest is k=0
+    pm = jnp.where(pos, -1.0, jnp.minimum(p, -1e-300))
+    r = 2.0 * jnp.sqrt(-pm / 3.0)
+    # divide in two stages: pm*r can underflow to -0.0 when p == 0
+    # exactly (a triple root), and 0/-0 would be NaN
+    arg = jnp.clip((3.0 * q / pm) / jnp.maximum(r, 1e-150),
+                   -1.0 + 1e-12, 1.0 - 1e-12)
+    t3 = r * jnp.cos(jnp.arccos(arg) / 3.0)
+
+    t = jnp.where(pos, t1, t3)
+    return t - c2 / 3.0
+
+
+def compressibility(eos: int, mixing_rule: int, T, P, X,
+                    crit: CriticalSet):
+    """Gas-phase compressibility factor Z(T, P, X)."""
+    if eos == IDEAL:
+        return jnp.ones_like(jnp.asarray(T, jnp.result_type(float)))
+    u, w, _, _ = _EOS_UW[eos]
+    a_m, b_m = _ab_mix(eos, mixing_rule, T, X, crit)
+    RT = R_GAS * T
+    A = a_m * P / RT ** 2
+    B = b_m * P / RT
+    c2 = -(1.0 + B - u * B)
+    c1 = A + w * B * B - u * B - u * B * B
+    c0 = -(A * B + w * B * B + w * B ** 3)
+    Z = _largest_real_cubic_root(c2, c1, c0)
+    # the gas root must exceed the covolume
+    return jnp.maximum(Z, B * (1.0 + 1e-9) + 1e-12)
+
+
+def density(eos, mixing_rule, T, P, X, wbar, crit: CriticalSet):
+    """Mass density [g/cm^3] via the gas root."""
+    Z = compressibility(eos, mixing_rule, T, P, X, crit)
+    return P * wbar / (Z * R_GAS * T)
+
+
+def enthalpy_departure(eos: int, mixing_rule: int, T, P, X,
+                       crit: CriticalSet):
+    """H - H_ideal per MOLE of mixture [erg/mol]."""
+    if eos == IDEAL:
+        return jnp.zeros_like(jnp.asarray(T, jnp.result_type(float)))
+    u, w, _, _ = _EOS_UW[eos]
+    T = jnp.asarray(T, jnp.result_type(float))
+
+    def a_of_T(TT):
+        return _ab_mix(eos, mixing_rule, TT, X, crit)[0]
+
+    a_m, b_m = _ab_mix(eos, mixing_rule, T, X, crit)
+    dadT = jax.grad(a_of_T)(T)
+    Z = compressibility(eos, mixing_rule, T, P, X, crit)
+    RT = R_GAS * T
+    B = b_m * P / RT
+    Bs = jnp.maximum(B, 1e-300)
+    if eos == VDW:
+        A = a_m * P / RT ** 2
+        # H_dep = RT(Z-1) - a/v ; a/v = A*RT/Z (alpha'=0 for VdW)
+        return RT * (Z - 1.0) - A * RT / jnp.maximum(Z, 1e-300)
+    # F(v) = int_inf^v dv'/(v'^2 + u b v' + w b^2)
+    #      = ln[(2Z + B(u-D)) / (2Z + B(u+D))] / (b D),  D = sqrt(u^2-4w)
+    # H_dep = RT(Z-1) + (a - T a') F  (residual-enthalpy integral of the
+    # generalized cubic; reduces to the textbook PR/SRK forms)
+    D = math.sqrt(u * u - 4.0 * w)      # static per model (>0 here)
+    F = jnp.log(jnp.maximum(
+        (2.0 * Z + Bs * (u - D)) / (2.0 * Z + Bs * (u + D)), 1e-300)) / (
+            b_m * D)
+    return RT * (Z - 1.0) + (a_m - T * dadT) * F
+
+
+def entropy_departure(eos: int, mixing_rule: int, T, P, X,
+                      crit: CriticalSet):
+    """S - S_ideal per mole of mixture [erg/(mol K)] at the same (T,P)."""
+    if eos == IDEAL:
+        return jnp.zeros_like(jnp.asarray(T, jnp.result_type(float)))
+    u, w, _, _ = _EOS_UW[eos]
+    T = jnp.asarray(T, jnp.result_type(float))
+
+    def a_of_T(TT):
+        return _ab_mix(eos, mixing_rule, TT, X, crit)[0]
+
+    a_m, b_m = _ab_mix(eos, mixing_rule, T, X, crit)
+    dadT = jax.grad(a_of_T)(T)
+    Z = compressibility(eos, mixing_rule, T, P, X, crit)
+    B = b_m * P / (R_GAS * T)
+    core = R_GAS * jnp.log(jnp.maximum(Z - B, 1e-300))
+    if eos == VDW:
+        return core
+    # S_dep = R ln(Z-B) - a' F (same F as the enthalpy departure)
+    D = math.sqrt(u * u - 4.0 * w)
+    Bs = jnp.maximum(B, 1e-300)
+    F = jnp.log(jnp.maximum(
+        (2.0 * Z + Bs * (u - D)) / (2.0 * Z + Bs * (u + D)), 1e-300)) / (
+            b_m * D)
+    return core - dadT * F
+
+
+def cp_departure(eos: int, mixing_rule: int, T, P, X, crit: CriticalSet):
+    """Cp - Cp_ideal per mole [erg/(mol K)] = d(H_dep)/dT at constant P
+    — obtained by AD through the departure function AND the cubic root
+    (the root is differentiated implicitly through Cardano)."""
+    if eos == IDEAL:
+        return jnp.zeros_like(jnp.asarray(T, jnp.result_type(float)))
+    T = jnp.asarray(T, jnp.result_type(float))
+    return jax.grad(
+        lambda TT: enthalpy_departure(eos, mixing_rule, TT, P, X, crit)
+    )(T)

@@ -225,3 +225,55 @@ def thermal_diffusion_ratios(mech, T, X):
     theta = (factor * dm * x[None, :]).sum(axis=1) * x
     # restrict to light species as the native library does
     return jnp.where(w <= 5.0, theta, 0.0)
+
+
+def stefan_maxwell_fluxes(mech, T, P, X, Y, dXdx, rho, *,
+                          dTdx=None, soret=False):
+    """Multicomponent (MULT) diffusive mass fluxes j_k [KK, g/cm^2-s]
+    by direct inversion of the Stefan-Maxwell equations.
+
+    TPU-native replacement for the reference's MULT transport option
+    (reference flame.py:267-318, served by the native TRANLIB
+    multicomponent module): instead of assembling the L-matrix and
+    extracting multicomponent diffusion COEFFICIENTS, the velocities are
+    obtained directly from the Stefan-Maxwell system
+
+        dX_i/dx = sum_{j != i} (X_i X_j / D_ij) (V_j - V_i)
+
+    closed by the mass-conservation constraint ``sum_k Y_k V_k = 0``
+    (added as a rank-1 bordering ``M + 1 (x) Y``, the standard
+    regularization of the singular SM matrix). One dense [KK, KK] solve
+    per face — under vmap over grid faces this is exactly the batched
+    small-matrix work the TPU path is optimized for.
+
+    Thermal diffusion (``soret=True``) adds the mixture-averaged
+    light-species Soret flux (:func:`thermal_diffusion_ratios`) on top
+    of the ordinary SM fluxes; the zero-net-flux correction is then
+    re-applied.
+    """
+    from . import linalg
+
+    KK = mech.n_species
+    Dij = binary_diffusion_coefficients(mech, T, P)
+    x = jnp.clip(X, 1e-16, 1.0)
+    x = x / jnp.sum(x)
+    A = x[:, None] * x[None, :] / Dij
+    off = A - jnp.diag(jnp.diagonal(A))
+    M = off - jnp.diag(off.sum(axis=1))
+    Mb = M + jnp.ones((KK, 1)) * Y[None, :]       # border: sum Y_k V_k = 0
+    # row equilibration: the bordered SM matrix is NOT of the
+    # I - c*J form whose conditioning the pivot-free TPU factorization
+    # is argued safe for; scaling each row to unit max restores
+    # headroom for the f32 factor (the f64 refinement inside
+    # linalg.solve then polishes the solve)
+    scale = 1.0 / jnp.maximum(jnp.max(jnp.abs(Mb), axis=1), 1e-300)
+    V = linalg.solve(Mb * scale[:, None], dXdx * scale)
+    j = rho * Y * V
+    if soret and dTdx is not None:
+        wbar = thermo.mean_molecular_weight_X(mech, x)
+        D_k = mixture_diffusion_coefficients(mech, T, P, x)
+        theta = thermal_diffusion_ratios(mech, T, x)
+        j = j - rho * (mech.wt / wbar) * D_k * theta * dTdx / T
+    # enforce zero net diffusive mass flux exactly
+    j = j - Y * jnp.sum(j)
+    return j

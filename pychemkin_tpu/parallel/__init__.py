@@ -4,6 +4,7 @@ Python parameter loops; there is no distributed backend to port)."""
 
 from .sharding import (
     BATCH_AXIS,
+    SweepStats,
     _sweep_program_cache,
     distributed_initialize,
     make_mesh,
@@ -13,6 +14,7 @@ from .sharding import (
 
 __all__ = [
     "BATCH_AXIS",
+    "SweepStats",
     "_sweep_program_cache",
     "distributed_initialize",
     "make_mesh",

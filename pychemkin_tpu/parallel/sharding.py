@@ -80,19 +80,48 @@ def _pad_to_multiple(arr, multiple, axis=0):
     return jnp.concatenate([arr, pad], axis=axis), n
 
 
+class SweepStats:
+    """Aggregate solver statistics for a sweep (host-side ints)."""
+
+    def __init__(self):
+        self.n_steps = 0
+        self.n_rejected = 0
+        self.n_newton = 0
+
+    def add(self, steps, rejected, newton):
+        self.n_steps += int(steps)
+        self.n_rejected += int(rejected)
+        self.n_newton += int(newton)
+
+
 def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                            mesh: Optional[Mesh] = None, rtol=1e-6,
                            atol=1e-12,
                            ignition_mode=reactor_ops.IGN_T_INFLECTION,
                            ignition_kwargs=None,
                            max_steps_per_segment=20_000,
-                           solve_kwargs=None):
+                           solve_kwargs=None, chunk_size=None,
+                           stats: Optional[SweepStats] = None,
+                           _stats_n_real=None):
     """Ignition-delay sweep sharded over a device mesh — the scaled-out
     form of :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`.
 
     Each device integrates its shard of initial conditions with the same
     compiled program (SPMD); the mechanism record is replicated. Returns
     (ignition_times [B] in seconds, success [B]) gathered to the host.
+
+    ``chunk_size``: process the batch as sequential jitted calls of this
+    size (rounded up to a mesh multiple). One compiled program serves
+    every chunk, so compile time is set by the CHUNK size, flat in total
+    B; a contiguous chunk of a sorted sweep also groups elements of
+    similar stiffness, so fast chunks are not held in lockstep by the
+    batch's slowest element. This is also the overload guard for very
+    large B (a single giant program crashed the TPU worker at B=512 on
+    a 54-state mechanism; 4x128 chunks run fine).
+
+    ``stats``: optional :class:`SweepStats` accumulating total accepted
+    steps / rejected attempts / Newton iterations across the sweep (the
+    measured inputs of the bench's FLOP/MFU model).
     """
     if mesh is None:
         mesh = make_mesh()
@@ -105,6 +134,31 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64),
                            (B, jnp.asarray(Y0s).shape[-1]))
     t_ends = jnp.broadcast_to(jnp.asarray(t_ends, jnp.float64), (B,))
+
+    if chunk_size is not None and chunk_size < B:
+        chunk = max(n_dev, (chunk_size // n_dev) * n_dev)
+        times_parts, ok_parts = [], []
+        for lo in range(0, B, chunk):
+            hi = min(lo + chunk, B)
+            # re-enter with exactly one chunk (padded inside); same
+            # shapes -> same cached program for every full chunk
+            tpart, okpart = sharded_ignition_sweep(
+                mech, problem, energy,
+                jnp.pad(T0s[lo:hi], (0, chunk - (hi - lo)), mode="edge"),
+                jnp.pad(P0s[lo:hi], (0, chunk - (hi - lo)), mode="edge"),
+                jnp.pad(Y0s[lo:hi], ((0, chunk - (hi - lo)), (0, 0)),
+                        mode="edge"),
+                jnp.pad(t_ends[lo:hi], (0, chunk - (hi - lo)),
+                        mode="edge"),
+                mesh=mesh, rtol=rtol, atol=atol,
+                ignition_mode=ignition_mode,
+                ignition_kwargs=ignition_kwargs,
+                max_steps_per_segment=max_steps_per_segment,
+                solve_kwargs=solve_kwargs, stats=stats,
+                _stats_n_real=hi - lo)   # edge-padding is not real work
+            times_parts.append(tpart[:hi - lo])
+            ok_parts.append(okpart[:hi - lo])
+        return (np.concatenate(times_parts), np.concatenate(ok_parts))
 
     T0s, n_real = _pad_to_multiple(T0s, n_dev)
     P0s, _ = _pad_to_multiple(P0s, n_dev)
@@ -128,7 +182,8 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         def one(T0, P0, Y0, t_end):
             sol = reactor_ops.solve_batch(mech, problem, energy, T0, P0, Y0,
                                           t_end, **kwargs)
-            return sol.ignition_time, sol.success
+            return (sol.ignition_time, sol.success, sol.n_steps,
+                    sol.n_rejected, sol.n_newton)
 
         def shard_fn(T0c, P0c, Y0c, tc):
             return jax.vmap(one)(T0c, P0c, Y0c, tc)
@@ -138,7 +193,7 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         # with scalar literals, which the varying-axis type checker rejects
         mapped = jax.jit(shard_map(
             shard_fn, mesh=mesh, in_specs=(spec_, spec_, spec_, spec_),
-            out_specs=(spec_, spec_), check_vma=False))
+            out_specs=(spec_,) * 5, check_vma=False))
         _sweep_program_cache[cache_key] = mapped
 
     spec = P(axis)
@@ -148,7 +203,17 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         jax.device_put(P0s, in_sharding),
         jax.device_put(Y0s, NamedSharding(mesh, P(axis, None))),
         jax.device_put(t_ends, in_sharding))
-    times, ok = mapped(T0s, P0s, Y0s, t_ends)
+    times, ok, n_steps, n_rej, n_newt = mapped(T0s, P0s, Y0s, t_ends)
+    if stats is not None:
+        # count only genuinely distinct elements: chunked callers pad
+        # the tail chunk with edge duplicates whose solver work would
+        # otherwise inflate the bench's steps/s and MFU figures
+        n_count = n_real if _stats_n_real is None else min(
+            n_real, _stats_n_real)
+        real = np.arange(n_count)
+        stats.add(np.asarray(n_steps)[real].sum(),
+                  np.asarray(n_rej)[real].sum(),
+                  np.asarray(n_newt)[real].sum())
     return np.asarray(times)[:n_real], np.asarray(ok)[:n_real]
 
 

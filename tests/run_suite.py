@@ -76,8 +76,11 @@ def _split_args(argv):
             selectors.setdefault(path, []).append(
                 "::".join([path] + a.split("::")[1:]))
         elif os.path.isdir(a):
+            # recursive, matching conftest's _session_test_files — a dir
+            # with nested test files must not fall through to "run all"
             selected.extend(sorted(
-                glob.glob(os.path.join(os.path.abspath(a), "test_*.py"))))
+                glob.glob(os.path.join(os.path.abspath(a), "**",
+                                       "test_*.py"), recursive=True)))
         elif os.path.exists(a) and a.endswith(".py"):
             selected.append(os.path.abspath(a))
         else:

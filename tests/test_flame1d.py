@@ -283,3 +283,25 @@ def test_burner_tgiv_model_runs(h2_air_inlet):
     assert exit_stream.temperature > 1400.0
     mid = fl.get_solution_stream(0.6)
     assert 298.0 < mid.temperature <= 1500.0
+
+
+@pytest.mark.slow
+def test_mult_vs_mix_flame_speed(h2o2, stoich_h2_air):
+    """MULT (Stefan-Maxwell) vs MIX flame speed on H2/air: both modes
+    converge to a physical speed, and the multicomponent correction is
+    the expected few-percent effect, not a rewrite of the answer
+    (reference flame.py:267 — MULT is first-class there too)."""
+    common = dict(P=1.01325e6, T_in=298.0, Y_in=stoich_h2_air,
+                  x_start=0.0, x_end=2.0)
+    mix = flame1d.solve_flame(h2o2, transport_model="MIX", **common)
+    assert mix.converged
+    # switch transport models by continuation from the MIX solution —
+    # the reference's CNTN workflow (premixedflame.py:430)
+    mult = flame1d.solve_flame(h2o2, transport_model="MULT",
+                               u0=mix.u, x0=mix.x, **common)
+    assert mult.converged
+    assert 150.0 < mult.flame_speed < 280.0
+    delta = abs(mult.flame_speed - mix.flame_speed) / mix.flame_speed
+    print(f"MIX {mix.flame_speed:.1f} vs MULT {mult.flame_speed:.1f} "
+          f"cm/s (delta {100*delta:.2f}%)")
+    assert delta < 0.12

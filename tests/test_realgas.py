@@ -1,0 +1,212 @@
+"""Real-gas cubic EOS tests (reference parity: realgaseos.py,
+chemistry.py:1535-1603, mixture.py:2664-2801).
+
+Anchors:
+- exact model invariants: at (Tc, Pc) every cubic reproduces its
+  analytic critical compressibility (PR 0.3074, RK/SRK 1/3, VdW 3/8);
+- the ideal-gas limit (Z -> 1, departures -> 0 as P -> 0);
+- thermodynamic self-consistency: the AD-derived Cp departure equals a
+  finite difference of the enthalpy departure;
+- literature spot checks: PR critical density vs NIST experimental
+  values for CO2 and propane (PR's known ~10% underprediction), and
+  N2 at ambient conditions staying ideal to <1%.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.constants import R_GAS
+from pychemkin_tpu.ops import realgas
+
+
+def _crit(names):
+    return realgas.critical_set_for(names)
+
+
+class TestCubicInvariants:
+    @pytest.mark.parametrize("eos,zc", [
+        (realgas.PR, 0.30740),
+        (realgas.SOAVE, 1.0 / 3.0),
+        (realgas.RK, 1.0 / 3.0),
+        (realgas.AUNGIER, 1.0 / 3.0),
+        (realgas.VDW, 0.375),
+    ])
+    def test_critical_compressibility(self, eos, zc):
+        crit = _crit(["CO2"])
+        Tc, Pc = 304.13, 73.77e6
+        X = jnp.asarray([1.0])
+        Z = float(realgas.compressibility(eos, realgas.MIX_VDW,
+                                          Tc, Pc, X, crit))
+        # the cubic has a TRIPLE root at the critical point, so the
+        # root's sensitivity to float noise in the coefficients is
+        # O(eps^(1/3)) — percent-level agreement is the attainable bound
+        assert Z == pytest.approx(zc, rel=2e-2)
+
+    @pytest.mark.parametrize("eos", [realgas.PR, realgas.SOAVE,
+                                     realgas.RK, realgas.VDW,
+                                     realgas.AUNGIER])
+    def test_ideal_limit(self, eos):
+        crit = _crit(["CO2"])
+        X = jnp.asarray([1.0])
+        Z = float(realgas.compressibility(eos, realgas.MIX_VDW,
+                                          400.0, 1e3, X, crit))
+        assert Z == pytest.approx(1.0, abs=1e-4)
+        h = float(realgas.enthalpy_departure(eos, realgas.MIX_VDW,
+                                             400.0, 1e3, X, crit))
+        # |H_dep| -> 0 (erg/mol; ideal molar enthalpy is ~1e11)
+        assert abs(h) < 1e6
+
+    @pytest.mark.parametrize("eos", [realgas.PR, realgas.SOAVE,
+                                     realgas.AUNGIER])
+    def test_cp_departure_is_dhdT(self, eos):
+        crit = _crit(["CO2"])
+        X = jnp.asarray([1.0])
+        T, P = 350.0, 60e6
+        cp = float(realgas.cp_departure(eos, realgas.MIX_VDW, T, P, X,
+                                        crit))
+        dT = 1e-3
+        hp = float(realgas.enthalpy_departure(eos, realgas.MIX_VDW,
+                                              T + dT, P, X, crit))
+        hm = float(realgas.enthalpy_departure(eos, realgas.MIX_VDW,
+                                              T - dT, P, X, crit))
+        assert cp == pytest.approx((hp - hm) / (2 * dT), rel=1e-5)
+
+
+class TestLiteratureAnchors:
+    def test_pr_co2_critical_density(self):
+        """PR at CO2's critical point: rho = Pc*W/(Zc*R*Tc) ~ 0.418
+        g/cm^3; NIST experimental rho_c = 0.4676 g/cm^3 — PR's known
+        ~11% underprediction."""
+        crit = _crit(["CO2"])
+        rho = float(realgas.density(realgas.PR, realgas.MIX_VDW,
+                                    304.13, 73.77e6, jnp.asarray([1.0]),
+                                    44.0095, crit))
+        assert rho == pytest.approx(0.4676, rel=0.15)
+        assert rho < 0.4676          # the bias has a known sign
+
+    def test_pr_propane_critical_density(self):
+        """NIST rho_c(C3H8) = 0.2200 g/cm^3."""
+        crit = _crit(["C3H8"])
+        rho = float(realgas.density(realgas.PR, realgas.MIX_VDW,
+                                    369.83, 42.48e6, jnp.asarray([1.0]),
+                                    44.0956, crit))
+        assert rho == pytest.approx(0.220, rel=0.15)
+
+    def test_n2_ambient_nearly_ideal(self):
+        crit = _crit(["N2"])
+        Z = float(realgas.compressibility(realgas.PR, realgas.MIX_VDW,
+                                          300.0, 1.01325e6,
+                                          jnp.asarray([1.0]), crit))
+        assert Z == pytest.approx(1.0, abs=0.01)
+
+    def test_co2_supercritical_compressibility(self):
+        """CO2 at 350 K, 100 bar: NIST Z ~ 0.70; PR within ~5%."""
+        crit = _crit(["CO2"])
+        Z = float(realgas.compressibility(realgas.PR, realgas.MIX_VDW,
+                                          350.0, 100e6,
+                                          jnp.asarray([1.0]), crit))
+        assert 0.55 < Z < 0.85
+
+
+class TestMixingRules:
+    def test_pure_species_limit_rules_agree(self):
+        """For a pure species both mixing rules must coincide."""
+        crit = _crit(["CO2"])
+        X = jnp.asarray([1.0])
+        for rule in (realgas.MIX_VDW, realgas.MIX_PSEUDOCRITICAL):
+            Z = float(realgas.compressibility(realgas.PR, rule, 320.0,
+                                              80e6, X, crit))
+            assert 0.3 < Z < 1.0
+        z1 = float(realgas.compressibility(realgas.PR, realgas.MIX_VDW,
+                                           320.0, 80e6, X, crit))
+        z2 = float(realgas.compressibility(
+            realgas.PR, realgas.MIX_PSEUDOCRITICAL, 320.0, 80e6, X,
+            crit))
+        assert z1 == pytest.approx(z2, rel=1e-10)
+
+    def test_mixture_between_pures(self):
+        """An equimolar CO2/CH4 mix's Z lies between the pure-species
+        values at the same (T, P) for the VdW rule."""
+        crit = _crit(["CO2", "CH4"])
+        T, P = 350.0, 80e6
+        z_mix = float(realgas.compressibility(
+            realgas.PR, realgas.MIX_VDW, T, P,
+            jnp.asarray([0.5, 0.5]), crit))
+        z_co2 = float(realgas.compressibility(
+            realgas.PR, realgas.MIX_VDW, T, P,
+            jnp.asarray([1.0, 0.0]), crit))
+        z_ch4 = float(realgas.compressibility(
+            realgas.PR, realgas.MIX_VDW, T, P,
+            jnp.asarray([0.0, 1.0]), crit))
+        lo, hi = sorted([z_co2, z_ch4])
+        assert lo - 0.02 <= z_mix <= hi + 0.02
+
+    def test_dataless_species_contribute_ideally(self):
+        """A species with no critical data must not blow up the mix;
+        diluting CO2 with it pushes Z toward 1."""
+        crit = realgas.critical_set_for(["CO2", "XFAKE"])
+        z_pure = float(realgas.compressibility(
+            realgas.PR, realgas.MIX_VDW, 320.0, 80e6,
+            jnp.asarray([1.0, 0.0]), crit))
+        z_dil = float(realgas.compressibility(
+            realgas.PR, realgas.MIX_VDW, 320.0, 80e6,
+            jnp.asarray([0.3, 0.7]), crit))
+        assert z_pure < z_dil <= 1.05
+
+
+class TestChemistryMixtureAPI:
+    @pytest.fixture(scope="class")
+    def chem(self):
+        import os
+        from pychemkin_tpu.mechanism import DATA_DIR
+        c = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+        c.preprocess()
+        return c
+
+    def test_toggle_and_density_route(self, chem):
+        mix = ck.Mixture(chem)
+        mix.temperature = 700.0
+        mix.pressure = 250e6          # 250 bar steam
+        mix.X = {"H2O": 1.0}
+        rho_ideal = mix.RHO
+        mix.use_realgas_cubicEOS()
+        assert chem.userealgas
+        rho_pr = mix.RHO
+        # dense supercritical steam is well off ideal (NIST Z ~ 0.75;
+        # PR, mistuned for polar water, gives Z ~ 0.62) — the routing
+        # claim here is direction + magnitude, not PR's water accuracy
+        assert rho_pr > rho_ideal * 1.15
+        assert rho_pr < rho_ideal * 2.0
+        mix.use_idealgas_law()
+        assert mix.RHO == pytest.approx(rho_ideal, rel=1e-12)
+
+    def test_departures_enter_hml_cpbl(self, chem):
+        mix = ck.Mixture(chem)
+        mix.temperature = 700.0
+        mix.pressure = 250e6
+        mix.X = {"H2O": 1.0}
+        h_ideal, cp_ideal = mix.HML(), mix.CPBL()
+        mix.use_realgas_cubicEOS()
+        h_rg, cp_rg = mix.HML(), mix.CPBL()
+        mix.use_idealgas_law()
+        assert h_rg < h_ideal          # attraction lowers enthalpy
+        assert cp_rg > cp_ideal        # Cp rises toward the critical
+        assert abs(h_rg - h_ideal) > 1e8   # erg/mol, noticeable
+
+    def test_eos_model_selection(self, chem):
+        chem.set_realgas_eos_model("Peng-Robinson")
+        assert chem._realgas_eos == realgas.PR
+        chem.set_realgas_eos_model(3)
+        assert chem._realgas_eos == realgas.SOAVE
+        with pytest.raises(ValueError):
+            chem.set_realgas_eos_model(0)
+        chem.set_realgas_eos_model(realgas.PR)
+
+    def test_mixing_rule_validation(self, chem):
+        chem.set_realgas_mixing_rule(1)
+        chem.set_realgas_mixing_rule(0)
+        with pytest.raises(ValueError):
+            chem.set_realgas_mixing_rule(7)

@@ -10,6 +10,7 @@ import pytest
 
 from pychemkin_tpu.constants import P_ATM
 from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import thermo
 from pychemkin_tpu.ops import transport as tr
 
 
@@ -89,3 +90,85 @@ class TestMixtureRules:
         # light species (H, H2) get negative ratios (drift toward hot)
         assert th[_idx(mech, "H2")] < 0.0
         assert np.all(np.isfinite(th))
+
+
+class TestStefanMaxwell:
+    """Multicomponent (MULT) Stefan-Maxwell flux kernel
+    (reference flame.py:267-318)."""
+
+    def _setup(self, mech):
+        import numpy as np
+        names = list(mech.species_names)
+        X = np.full(len(names), 1e-8)
+        X[names.index("H2")] = 0.3
+        X[names.index("O2")] = 0.2
+        X[names.index("N2")] = 0.5
+        X = X / X.sum()
+        return jnp.asarray(X)
+
+    def test_zero_gradient_zero_flux(self, mech):
+        X = self._setup(mech)
+        Y = thermo.X_to_Y(mech, X)
+        rho = thermo.density(mech, 800.0, 1.01325e6, Y)
+        j = tr.stefan_maxwell_fluxes(
+            mech, 800.0, 1.01325e6, X, Y, jnp.zeros_like(X), rho)
+        np.testing.assert_allclose(np.asarray(j), 0.0, atol=1e-20)
+
+    def test_zero_net_mass_flux(self, mech):
+        X = self._setup(mech)
+        Y = thermo.X_to_Y(mech, X)
+        rho = thermo.density(mech, 800.0, 1.01325e6, Y)
+        rng = np.random.default_rng(0)
+        dXdx = rng.normal(size=X.shape) * 0.1
+        dXdx -= dXdx.mean()
+        j = tr.stefan_maxwell_fluxes(
+            mech, 800.0, 1.01325e6, X, Y, jnp.asarray(dXdx), rho)
+        assert abs(float(jnp.sum(j))) < 1e-18
+
+    def test_binary_limit_matches_fick(self, mech):
+        """For a two-species mixture the SM solution must reduce to the
+        exact binary Fick law j1 = -rho D12 (W1 W2/Wbar^2) dX1/dx."""
+        names = list(mech.species_names)
+        i1, i2 = names.index("H2"), names.index("N2")
+        X = np.full(len(names), 1e-14)
+        X[i1], X[i2] = 0.4, 0.6
+        X = jnp.asarray(X / X.sum())
+        Y = thermo.X_to_Y(mech, X)
+        T, P = 700.0, 1.01325e6
+        rho = thermo.density(mech, T, P, Y)
+        dX = np.zeros(len(names))
+        dX[i1], dX[i2] = 0.05, -0.05
+        j = np.asarray(tr.stefan_maxwell_fluxes(
+            mech, T, P, X, Y, jnp.asarray(dX), rho))
+        D12 = float(tr.binary_diffusion_coefficients(
+            mech, T, P)[i1, i2])
+        wbar = float(thermo.mean_molecular_weight_X(mech, X))
+        w = np.asarray(mech.wt)
+        j1_fick = -float(rho) * D12 * w[i1] * w[i2] / wbar ** 2 * 0.05
+        np.testing.assert_allclose(j[i1], j1_fick, rtol=1e-6)
+        np.testing.assert_allclose(j[i2], -j1_fick, rtol=1e-6)
+
+    def test_trace_species_matches_mixture_averaged(self, mech):
+        """A trace species diffusing through a fixed background: SM and
+        the mixture-averaged model agree to a few percent."""
+        names = list(mech.species_names)
+        itr = names.index("H2O")
+        X = np.full(len(names), 1e-12)
+        X[names.index("N2")] = 0.78
+        X[names.index("O2")] = 0.21
+        X[itr] = 0.01
+        X = jnp.asarray(X / X.sum())
+        Y = thermo.X_to_Y(mech, X)
+        T, P = 600.0, 1.01325e6
+        rho = thermo.density(mech, T, P, Y)
+        dX = np.zeros(len(names))
+        dX[itr] = 0.02
+        dX[names.index("N2")] = -0.02
+        j_sm = np.asarray(tr.stefan_maxwell_fluxes(
+            mech, T, P, X, Y, jnp.asarray(dX), rho))
+        D_k = np.asarray(tr.mixture_diffusion_coefficients(
+            mech, T, P, X))
+        wbar = float(thermo.mean_molecular_weight_X(mech, X))
+        j_ma = -float(rho) * np.asarray(mech.wt) / wbar * D_k * dX
+        j_ma -= np.asarray(Y) * j_ma.sum()
+        np.testing.assert_allclose(j_sm[itr], j_ma[itr], rtol=0.05)
