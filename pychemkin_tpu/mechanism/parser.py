@@ -84,6 +84,7 @@ class _ReactionDraft:
     plog: list = field(default_factory=list)  # [(P_atm, A, beta, Ea)]
     duplicate: bool = False
     ford: dict = field(default_factory=dict)  # species_index -> order override
+    rord: dict = field(default_factory=dict)  # reverse-order override
 
 
 def _strip_comment(line: str) -> str:
@@ -642,7 +643,7 @@ class MechanismParser:
             if head == "FORD":
                 rxn.ford[self.species_map[name]] = _to_float(vals[1])
             else:
-                logger.warning("RORD not supported; ignoring %r", line)
+                rxn.rord[self.species_map[name]] = _to_float(vals[1])
             return
         if head in ("LT", "RLT", "XSMI", "MOME", "EXCI", "TDEP", "CHEB",
                     "PCHEB", "TCHEB"):
@@ -696,6 +697,8 @@ class MechanismParser:
 
         nu_f = np.zeros((II, KK))
         nu_r = np.zeros((II, KK))
+        ford_overrides: list = []     # (i, k, order) FORD entries
+        rord_overrides: list = []
         A = np.zeros(II)
         beta = np.zeros(II)
         Ea_R = np.zeros(II)
@@ -779,13 +782,39 @@ class MechanismParser:
                 sri[i] = rx.sri
             if rx.plog:
                 plog_rows.append((i, rx.plog))
-            if rx.ford:
-                raise MechanismError(
-                    f"FORD orders not yet supported: {rx.equation!r}")
+            if rx.ford or rx.rord:
+                # FORD/RORD concentration-exponent overrides (global
+                # mechanisms): a reversible reaction with FORD but no
+                # explicit REV parameters has no thermodynamically
+                # defined reverse rate
+                if rx.reversible and rx.ford and rx.rev is None \
+                        and not rx.rord:
+                    raise MechanismError(
+                        "FORD on a reversible reaction needs explicit "
+                        f"REV (or RORD) parameters: {rx.equation!r}")
+                for k, v in rx.ford.items():
+                    ford_overrides.append((i, k, v))
+                for k, v in rx.rord.items():
+                    rord_overrides.append((i, k, v))
             equations.append(rx.equation)
 
         self._check_balance(nu_f, nu_r, ncf, equations)
         self._check_duplicates(equations)
+
+        # concentration-exponent matrices: stoichiometric orders except
+        # where FORD/RORD overrode them; fractional entries are ALSO
+        # recorded statically for the kinetics kernel (trace-safe)
+        ord_f = nu_f.copy()
+        ord_r = nu_r.copy()
+        for i, k, v in ford_overrides:
+            ord_f[i, k] = v
+        for i, k, v in rord_overrides:
+            ord_r[i, k] = v
+        ford_frac = tuple(sorted(
+            (i, k) for i, k, v in ford_overrides if v != round(v)))
+        rord_frac = tuple(sorted(
+            (i, k) for i, k, v in rord_overrides if v != round(v)))
+        has_overrides = bool(ford_overrides or rord_overrides)
 
         # ---- PLOG compaction -------------------------------------------------
         plog_arrays = _build_plog_arrays(plog_rows, self.e_factor, cal_to_K,
@@ -817,6 +846,9 @@ class MechanismParser:
             awt=awt, wt=wt, ncf=ncf,
             nasa_coeffs=nasa_coeffs, nasa_T=nasa_T,
             nu_f=nu_f, nu_r=nu_r,
+            order_f=ord_f, order_r=ord_r,
+            ford_frac_entries=ford_frac, rord_frac_entries=rord_frac,
+            has_order_overrides=has_overrides,
             A=A, beta=beta, Ea_R=Ea_R,
             reversible=reversible, has_rev_params=has_rev,
             rev_A=rev_A, rev_beta=rev_beta, rev_Ea_R=rev_Ea_R,

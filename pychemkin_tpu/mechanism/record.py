@@ -70,6 +70,23 @@ class MechanismRecord:
     nu_r: Any = None       # [II, KK] reverse (product) stoichiometric coeffs
     # nu = nu_r - nu_f is derived in kernels
 
+    # concentration-exponent overrides (CHEMKIN FORD/RORD): equal to
+    # nu_f/nu_r except on reactions that declared explicit orders —
+    # global mechanisms (Westbrook-Dryer, Jones-Lindstedt) live here
+    order_f: Any = None    # [II, KK]
+    order_r: Any = None    # [II, KK]
+    # STATIC mirror of which (reaction, species) entries carry a
+    # FRACTIONAL override: parse-time facts, kept out of the traced
+    # leaves so the kinetics kernel's structure choice survives jit over
+    # the mechanism itself (a per-call numpy probe of traced leaves
+    # would silently fall back to stoichiometric orders)
+    ford_frac_entries: tuple = dataclasses.field(
+        default=(), metadata={"static": True})   # ((i, k), ...)
+    rord_frac_entries: tuple = dataclasses.field(
+        default=(), metadata={"static": True})
+    has_order_overrides: bool = dataclasses.field(
+        default=False, metadata={"static": True})
+
     # ---- Arrhenius ----------------------------------------------------------
     A: Any = None          # [II] pre-exponential (cgs mole units)
     beta: Any = None       # [II] temperature exponent

@@ -317,6 +317,9 @@ class BatchReactors(ReactorModel):
         """Integrate the reactor (reference: batchreactor.py:1161 runs the
         whole problem in one blocking native call; here one jitted
         solve)."""
+        # full-keyword decks route TIME/TEMP/PRES/VOL/ATOL/RTOL here
+        # (reference: batchreactor.py:822 __process_keywords_withFullInputs)
+        self.consume_protected_keywords()
         if self.validate_inputs() != 0:
             self.runstatus = STATUS_FAILED
             return self.runstatus
@@ -469,6 +472,8 @@ class BatchReactors(ReactorModel):
         self._solution_rawarray = raw
         self._solution_Y = Y
         self._solution_mixturearray = []
+        if self._TextOut or self._XMLOut:
+            self.write_solution_files()
         return 0
 
     def create_solution_mixtures(self) -> int:

@@ -21,7 +21,7 @@ from ..logger import logger
 from ..mixture import Mixture
 from ..ops import engine as engine_ops
 from .engine import Engine
-from .reactormodel import STATUS_FAILED, STATUS_SUCCESS
+from .reactormodel import STATUS_FAILED, STATUS_SUCCESS, Keyword
 
 
 class HCCIengine(Engine):
@@ -36,6 +36,12 @@ class HCCIengine(Engine):
             label = "HCCI" if nzones == 1 else "Multi-Zone HCCI"
         super().__init__(reactor_condition, label)
         self._nzones = int(nzones)
+        if self._nzones > 1:
+            # the reference REQUIRES full-keyword mode for multi-zone
+            # simulations and flips the class-level flag itself
+            # (HCCI.py:95-96); mirrored for deck parity — the typed
+            # zonal API keeps working either way
+            Keyword.setfullkeywords(True)
         # zonal setup mode (reference HCCI.py:98-101):
         # 0 uniform, 1 raw mole fractions, 2 equivalence ratio
         self._zonalsetupmode = 0
@@ -177,6 +183,7 @@ class HCCIengine(Engine):
 
     def run(self) -> int:
         """Integrate IVC -> EVO (reference HCCI.py:1241)."""
+        self.consume_protected_keywords()
         zone_T, vol, zone_Y = self._zone_initials()
         geo = self._geometry()
         ht = self._heat_transfer()

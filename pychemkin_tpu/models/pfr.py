@@ -162,6 +162,7 @@ class PlugFlowReactor(BatchReactors):
     def run(self) -> int:
         """March the plug-flow equations over the length
         (reference: PFR.py:627)."""
+        self.consume_protected_keywords()
         if self.validate_inputs() != 0:
             self.runstatus = STATUS_FAILED
             return self.runstatus

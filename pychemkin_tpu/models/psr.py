@@ -258,6 +258,7 @@ class perfectlystirredreactor(openreactor):
 
     def run(self) -> int:
         """Solve the steady state (reference: PSR.py:643-786)."""
+        self.consume_protected_keywords()
         if self.validate_inputs() != 0:
             self.runstatus = STATUS_FAILED
             return self.runstatus

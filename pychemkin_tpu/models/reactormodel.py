@@ -49,6 +49,19 @@ class Keyword:
     PROFILE_KEYS = ("TPRO", "PPRO", "VPRO", "QPRO", "AINT", "AREA", "DPRO",
                     "GRID", "MBPRO")
 
+    #: API-call mode (True) vs full-keyword mode (False): under the
+    #: full-keyword mode the entire input deck — protected keywords
+    #: included — is supplied as keyword lines (reference:
+    #: reactormodel.py:116; required there for multi-zone HCCI,
+    #: HCCI.py:95-96). Class-level, like the reference.
+    noFullKeyword = True
+
+    @staticmethod
+    def setfullkeywords(mode: bool):
+        """Turn the full-keyword input mode ON/OFF
+        (reference: reactormodel.py:183)."""
+        Keyword.noFullKeyword = not mode
+
     def __init__(self, phrase: str, value: KeywordValue,
                  protected: bool = False):
         self._phrase = str(phrase).upper()
@@ -267,11 +280,13 @@ class ReactorModel:
     # --- keyword management (reference: reactormodel.py:835-1056) ----------
     def setkeyword(self, key: str, value: KeywordValue):
         """Set or update a keyword (reference: reactormodel.py:861).
-        Protected keywords (TIME, PRES, QLOS, ...) must be set through
-        their dedicated property setters, matching the reference's API
-        mode (reference: reactormodel.py:60-93)."""
+        In API mode, protected keywords (TIME, PRES, QLOS, ...) must be
+        set through their dedicated property setters; under the
+        full-keyword mode (``Keyword.setfullkeywords(True)``) the whole
+        deck — protected keywords included — arrives as keyword lines
+        (reference: reactormodel.py:116-183)."""
         phrase = str(key).upper()
-        if phrase in Keyword.PROTECTED:
+        if Keyword.noFullKeyword and phrase in Keyword.PROTECTED:
             raise ValueError(
                 f"keyword {phrase} is protected; use its dedicated "
                 "property/method (reference: reactormodel.py:60-93)")
@@ -408,6 +423,171 @@ class ReactorModel:
         if threshold is not None:
             self._rop_threshold = float(threshold)
             self.setkeyword("EPSR", float(threshold))
+
+    # --- full-keyword deck input (reference: reactormodel.py:116-183) ------
+    def apply_keyword_deck(self, deck):
+        """Apply a text input deck: one 'KEY value...' line per keyword,
+        CHEMKIN comment ('!') and END conventions. Repeated
+        profile-keyword lines (TPRO/VPRO/...) accumulate into profiles;
+        REAC lines set the reactor-condition composition in the current
+        species mode. Requires the full-keyword mode to already be ON
+        (``Keyword.setfullkeywords(True)``) because the deck may carry
+        protected keywords — the exact contract of the reference's
+        full-keyword path (batchreactor.py:822).
+        """
+        if Keyword.noFullKeyword:
+            raise RuntimeError(
+                "apply_keyword_deck requires the full-keyword mode: "
+                "call Keyword.setfullkeywords(True) first "
+                "(reference: reactormodel.py:116)")
+        if isinstance(deck, str):
+            lines = deck.splitlines()
+        else:
+            lines = list(deck)
+        prof_acc: Dict[str, List[Tuple[float, float]]] = {}
+        reac: Dict[str, float] = {}
+        for raw in lines:
+            line = raw.split("!", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            key = parts[0].upper()
+            if key == "END":
+                break
+            if key in Keyword.PROFILE_KEYS and len(parts) >= 3:
+                prof_acc.setdefault(key, []).append(
+                    (float(parts[1]), float(parts[2])))
+                continue
+            if key == "REAC" and len(parts) >= 3:
+                reac[parts[1]] = float(parts[2])
+                continue
+            if len(parts) == 1:
+                self._record_keyword(key, True)
+            else:
+                val_s = parts[1]
+                try:
+                    value: KeywordValue = int(val_s)
+                except ValueError:
+                    try:
+                        value = float(val_s)
+                    except ValueError:
+                        value = " ".join(parts[1:])
+                self._record_keyword(key, value)
+        for key, pts in prof_acc.items():
+            xs, ys = zip(*pts)
+            self.setprofile(key, xs, ys)
+        if reac:
+            if self._speciesmode == "mole":
+                self._condition.X = reac
+            else:
+                self._condition.Y = reac
+
+    def consume_protected_keywords(self):
+        """Route protected keywords captured from a full-keyword deck
+        into the typed model state. Every concrete ``run()`` calls this
+        first, so deck-configured reactors behave like API-configured
+        ones (the reference routes them inside
+        __process_keywords_withFullInputs, batchreactor.py:822). Units
+        follow the reference's keyword conventions: PRES in atm, TEMP
+        K, TIME s, VOL cm^3, heat-transfer keywords CGS."""
+        if Keyword.noFullKeyword:
+            return
+        from ..constants import P_ATM
+
+        v = self.getkeyword("TEMP")
+        if v is not None:
+            self._condition.temperature = float(v)
+        v = self.getkeyword("PRES")
+        if v is not None:
+            self._condition.pressure = float(v) * P_ATM
+        # model-level scalars: keyword -> (attribute, scale); applied
+        # only where the concrete model has the attribute
+        for key, attr, scale in (
+                ("TIME", "time", 1.0),
+                ("VOL", "volume", 1.0),
+                ("TAU", "residence_time", 1.0),
+                ("XEND", "length", 1.0),
+                ("FLRT", "mass_flowrate", 1.0),
+                ("QLOS", "heat_loss_rate", 1.0),
+                ("HTC", "heat_transfer_coefficient", 1.0),
+                ("TAMB", "ambient_temperature", 1.0),
+                ("AREAQ", "area", 1.0)):
+            v = self.getkeyword(key)
+            if v is not None:
+                if not hasattr(self, attr):
+                    logger.warning(
+                        "deck keyword %s has no effect on %s", key,
+                        type(self).__name__)
+                    continue
+                setattr(self, attr, float(v) * scale)
+        atol, rtol = self.getkeyword("ATOL"), self.getkeyword("RTOL")
+        if (atol is not None or rtol is not None) and hasattr(
+                self, "tolerances"):
+            a0, r0 = self.tolerances
+            self.tolerances = (float(atol) if atol is not None else a0,
+                               float(rtol) if rtol is not None else r0)
+
+    # --- solution writers (reference: reactormodel.py:1471-1521 ------------
+    # STD_Output / XML_Output; the reference's native library writes
+    # these during the run, here they are written by process_solution)
+    def write_solution_files(self, basename: Optional[str] = None):
+        """Write the processed solution as a text file (STD_Output) and
+        an XML file (XML_Output), whichever toggles are on. Returns the
+        list of paths written."""
+        if not self.getrawsolutionstatus():
+            raise RuntimeError("no solution available; run() and "
+                               "process_solution() first")
+        base = basename or (self.label.strip().replace(" ", "_") or
+                            "solution")
+        written = []
+        cols = [t for t in self._solution_tags
+                if t in self._solution_rawarray]
+        cols += [s for s in self._specieslist
+                 if s in self._solution_rawarray]
+        n = self._numbsolutionpoints
+        if self._TextOut:
+            path = base + ".out"
+            with open(path, "w") as f:
+                f.write("! pychemkin_tpu solution: %s\n" % self.label)
+                f.write(" ".join(f"{c:>16s}" for c in cols) + "\n")
+                for i in range(n):
+                    f.write(" ".join(
+                        f"{float(self._solution_rawarray[c][i]):16.8e}"
+                        for c in cols) + "\n")
+            written.append(path)
+        if self._XMLOut:
+            import xml.etree.ElementTree as ET
+
+            root = ET.Element("chemkin_solution", label=self.label,
+                              points=str(n))
+            for c in cols:
+                var = ET.SubElement(root, "variable", name=c)
+                var.text = " ".join(
+                    repr(float(v)) for v in self._solution_rawarray[c])
+            path = base + ".xml"
+            ET.ElementTree(root).write(path)
+            written.append(path)
+        return written
+
+    @staticmethod
+    def read_solution_file(path: str) -> Dict[str, np.ndarray]:
+        """Re-parse a solution file written by
+        :meth:`write_solution_files` (text or XML) back into
+        {variable: array} — the round-trip the output tests use."""
+        if path.endswith(".xml"):
+            import xml.etree.ElementTree as ET
+
+            root = ET.parse(path).getroot()
+            return {v.get("name"): np.asarray(
+                [float(t) for t in (v.text or "").split()])
+                for v in root.findall("variable")}
+        out: Dict[str, list] = {}
+        with open(path) as f:
+            rows = [ln for ln in f if not ln.startswith("!")]
+        header = rows[0].split()
+        data = np.asarray([[float(v) for v in ln.split()]
+                           for ln in rows[1:]])
+        return {h: data[:, i] for i, h in enumerate(header)}
 
     # --- run status (reference: reactormodel.py:1720-1764) -----------------
     def getrunstatus(self) -> int:

@@ -221,6 +221,7 @@ class SIengine(Engine):
 
     def run(self) -> int:
         """Integrate IVC -> EVO (reference SI.py run path)."""
+        self.consume_protected_keywords()
         geo = self._geometry()
         ht = self._heat_transfer()
         wiebe = self._wiebe_tuple()

@@ -217,9 +217,38 @@ def rates_of_progress(mech, T, C, P=None):
     kf = forward_rate_constants(mech, T, C, P)
     kr = reverse_rate_constants(mech, T, kf)
     lnC = jnp.log(jnp.maximum(C, _TINY))
-    # MXU-friendly concentration products
-    prod_f = _safe_exp(mech.nu_f @ lnC)
-    prod_r = _safe_exp(mech.nu_r @ lnC)
+    # MXU-friendly concentration products; FORD/RORD overrides live in
+    # order_f/order_r (== nu_f/nu_r except on global-mechanism rows)
+    ord_f = mech.order_f if mech.order_f is not None else mech.nu_f
+    ord_r = mech.order_r if mech.order_r is not None else mech.nu_r
+    # structure choice from STATIC record metadata (parse-time facts),
+    # so it is identical under jit-over-the-mechanism and eager calls
+    if getattr(mech, "has_order_overrides", False):
+        # fractional orders (global mechanisms: [H2]^0.25 etc.) have an
+        # INFINITE concentration derivative at C -> 0, which destroys
+        # the stiff solvers' Newton iterations on the unburnt side.
+        # Those entries get a physically negligible floor (1e-16
+        # mol/cm^3 ~ 4e-6 ppm at 1 atm) that bounds the Jacobian;
+        # integer-order entries keep the exact tiny floor so absent
+        # species still shut their reactions off completely.
+        KK = len(mech.species_names)
+        II = len(mech.reaction_equations)
+        frac_f = np.zeros((II, KK), dtype=bool)
+        frac_r = np.zeros((II, KK), dtype=bool)
+        for i, k in mech.ford_frac_entries:
+            frac_f[i, k] = True
+        for i, k in mech.rord_frac_entries:
+            frac_r[i, k] = True
+        lnC_hi = jnp.log(jnp.maximum(C, 1e-16))
+        prod_f = _safe_exp(jnp.sum(
+            ord_f * jnp.where(frac_f, lnC_hi[None, :], lnC[None, :]),
+            axis=1))
+        prod_r = _safe_exp(jnp.sum(
+            ord_r * jnp.where(frac_r, lnC_hi[None, :], lnC[None, :]),
+            axis=1))
+    else:
+        prod_f = _safe_exp(ord_f @ lnC)
+        prod_r = _safe_exp(ord_r @ lnC)
     qf = kf * prod_f
     qr = kr * prod_r
     plain_tb = (mech.tb_type == TB_MIXTURE) & (mech.falloff_type == FALLOFF_NONE)

@@ -1,0 +1,249 @@
+"""Wide baseline validation: every major workload family runs through
+the PUBLIC API and is diffed against its stored oracle in
+tests/baseline/ (the reference's 26-baseline protocol, SURVEY.md §4;
+generators: tools/gen_baselines.py — scipy/fsolve independent paths
+where one exists, regression pins otherwise, with literature anchors on
+the headline numbers here)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.constants import P_ATM
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.models import (
+    GivenVolumeBatchReactor_EnergyConservation,
+    HCCIengine,
+    PlugFlowReactor_EnergyConservation,
+    PSR_SetResTime_EnergyConservation,
+    SIengine,
+)
+from pychemkin_tpu.inlet import Stream
+from pychemkin_tpu.ops import thermo
+from pychemkin_tpu.utils import baseline as bl
+
+BASELINE_DIR = os.path.join(os.path.dirname(__file__), "baseline")
+MAJORS = ["H2", "O2", "H2O", "OH", "N2"]
+
+
+def _baseline(name):
+    path = os.path.join(BASELINE_DIR, name + ".baseline")
+    if not os.path.exists(path):
+        pytest.skip(f"baseline {name} not generated")
+    return bl.load_results(path)
+
+
+def _check(result, base):
+    failures = bl.compare_results(result, base)
+    assert not failures, failures
+
+
+@pytest.fixture(scope="module")
+def chem():
+    return ck.Chemistry.from_mechanism(load_embedded("h2o2"))
+
+
+@pytest.fixture(scope="module")
+def stoich_mix(chem):
+    m = ck.Mixture(chem)
+    m.temperature = 298.15
+    m.pressure = P_ATM
+    m.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+    return m
+
+
+def _species_block(names_all, Y):
+    return {f"species-{s}": [float(Y[names_all.index(s)])]
+            for s in MAJORS}
+
+
+def test_conv_batch_vs_scipy(chem):
+    base = _baseline("conv_batch")
+    m = ck.Mixture(chem)
+    m.temperature = 1150.0
+    m.pressure = P_ATM
+    m.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+    r = GivenVolumeBatchReactor_EnergyConservation(m)
+    r.time = 2e-3
+    r.tolerances = (1e-14, 1e-9)
+    assert r.run() == 0
+    r.process_solution()
+    raw = r._solution_rawarray
+    names = chem.species_symbols
+    result = {
+        "state-temperature": [float(raw["temperature"][-1])],
+        "state-pressure": [float(raw["pressure"][-1])],
+        **{f"species-{s}": [float(raw[s][-1])] for s in MAJORS},
+    }
+    _check(result, base)
+
+
+def test_pfr_exit_vs_scipy(chem):
+    base = _baseline("pfr_exit")
+    s = Stream(chem, label="feed")
+    s.temperature = 1100.0
+    s.pressure = P_ATM
+    s.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+    s.mass_flowrate = 2.0
+    s.flowarea = 1.0
+    r = PlugFlowReactor_EnergyConservation(s)
+    r.length = 30.0
+    r.momentum_equation = False
+    r.tolerances = (1e-14, 1e-9)
+    assert r.run() == 0
+    r.process_solution()
+    raw = r._solution_rawarray
+    result = {
+        "state-temperature": [float(raw["temperature"][-1])],
+        "state-velocity": [float(raw["velocity"][-1])],
+        **{f"species-{s_}": [float(raw[s_][-1])] for s_ in MAJORS},
+    }
+    _check(result, base)
+
+
+def test_psr_scurve_vs_fsolve(chem):
+    base = _baseline("psr_scurve")
+    taus = base["state-residence_time"]
+    inlet = Stream(chem, label="inlet")
+    inlet.temperature = 298.15
+    inlet.pressure = P_ATM
+    inlet.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+    inlet.mass_flowrate = 10.0
+    T_out = []
+    guess = None
+    for tau in taus:
+        g = ck.Mixture(chem)
+        g.temperature = 298.15
+        g.pressure = P_ATM
+        g.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+        psr = PSR_SetResTime_EnergyConservation(g)
+        psr.set_inlet(inlet)
+        psr.residence_time = float(tau)
+        if guess is not None:
+            psr.set_estimate_conditions(temperature=guess.temperature,
+                                        mixture=guess)
+        else:
+            # burning branch: start from the inlet equilibrium, the
+            # reference's own estimate workflow (PSR.py:301)
+            psr.set_estimate_conditions(use_equilibrium=True)
+        assert psr.run() == 0
+        out = psr.process_solution()
+        T_out.append(float(out.temperature))
+        guess = out
+    result = {
+        "state-residence_time": [float(t) for t in taus],
+        "state-exit_temperature": T_out,
+    }
+    _check(result, base)
+
+
+def test_equilibrium_composition(chem, stoich_mix):
+    base = _baseline("equilibrium_composition")
+    eqm = ck.equilibrium(stoich_mix, opt=5)
+    names = chem.species_symbols
+    X = np.asarray(eqm.X)
+    # literature anchor: T_ad(H2/air, phi=1, 298 K, 1 atm) ~ 2380 K
+    assert float(eqm.temperature) == pytest.approx(2380.0, abs=50.0)
+    result = {
+        "state-temperature": [float(eqm.temperature)],
+        **{f"species-{s}": [float(X[names.index(s)])]
+           for s in MAJORS + ["H", "O"]},
+    }
+    _check(result, base)
+
+
+def test_cj_detonation(chem, stoich_mix):
+    base = _baseline("cj_detonation")
+    speeds, burnt = ck.detonation(stoich_mix)
+    # literature anchor: D_CJ(H2/air, phi=1, 1 atm) ~ 1.97e5 cm/s
+    assert float(speeds[1]) == pytest.approx(1.97e5, rel=0.04)
+    result = {
+        "state-sound_speed": [float(speeds[0])],
+        "state-detonation_speed": [float(speeds[1])],
+        "state-burnt_temperature": [float(burnt.temperature)],
+        "state-burnt_pressure": [float(burnt.pressure)],
+    }
+    _check(result, base)
+
+
+@pytest.mark.slow
+def test_flame_speed_regression(chem):
+    base = _baseline("flame_speed")
+    from pychemkin_tpu.ops import flame1d
+
+    mech = chem.mech
+    names = list(mech.species_names)
+    X = np.zeros(len(names))
+    X[names.index("H2")] = 2.0
+    X[names.index("O2")] = 1.0
+    X[names.index("N2")] = 3.76
+    Y0 = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+    sol = flame1d.solve_flame(mech, P=P_ATM, T_in=298.0, Y_in=Y0,
+                              x_start=0.0, x_end=2.0)
+    assert sol.converged
+    result = {
+        "state-flame_speed": [float(sol.flame_speed)],
+        "state-max_temperature": [float(np.max(sol.T))],
+    }
+    _check(result, base)
+
+
+def _engine_mix(chem):
+    m = ck.Mixture(chem)
+    m.temperature = 420.0
+    m.pressure = P_ATM
+    m.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76 * 2}
+    return m
+
+
+def _set_geometry(e):
+    e.bore = 8.0
+    e.stroke = 9.0
+    e.connecting_rod_length = 15.0
+    e.compression_ratio = 16.0
+    e.RPM = 1500.0
+    e.starting_CA = -142.0
+    e.ending_CA = 116.0
+
+
+def test_hcci_ca50_regression(chem):
+    base = _baseline("hcci_ca50")
+    e = HCCIengine(_engine_mix(chem))
+    _set_geometry(e)
+    assert e.run() == 0
+    ca10, ca50, ca90 = e.get_engine_heat_release_CAs()
+    avg = e.process_average_engine_solution()
+    result = {
+        "state-CA10": [float(ca10)],
+        "state-CA50": [float(ca50)],
+        "state-CA90": [float(ca90)],
+        "state-peak_pressure_atm": [float(np.max(avg["pressure"]) /
+                                          P_ATM)],
+    }
+    _check(result, base)
+
+
+def test_si_heat_release_regression(chem):
+    base = _baseline("si_heat_release")
+    si = SIengine(_engine_mix(chem))
+    _set_geometry(si)
+    si.compression_ratio = 9.5
+    si.RPM = 2000.0
+    si.wiebe_parameters(2.0, 5.0)
+    si.set_burn_timing(-10.0, 40.0)
+    si.define_product_composition(["H2O", "N2"])
+    assert si.run() == 0
+    ca10, ca50, ca90 = si.get_engine_heat_release_CAs()
+    avg = si.process_average_engine_solution()
+    result = {
+        "state-CA10": [float(ca10)],
+        "state-CA50": [float(ca50)],
+        "state-CA90": [float(ca90)],
+        "state-peak_pressure_atm": [float(np.max(avg["pressure"]) /
+                                          P_ATM)],
+    }
+    _check(result, base)

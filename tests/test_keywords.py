@@ -1,0 +1,165 @@
+"""Full-keyword deck mode + solution writers
+(reference: reactormodel.py:116-183 full-keyword flag;
+reactormodel.py:1471-1521 STD/XML output; HCCI.py:95-96 multi-zone
+requires full-keyword mode)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import (
+    GivenPressureBatchReactor_EnergyConservation,
+    HCCIengine,
+    Keyword,
+)
+from pychemkin_tpu.constants import P_ATM
+
+
+@pytest.fixture(scope="module")
+def chem():
+    c = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+    c.preprocess()
+    return c
+
+
+@pytest.fixture(scope="module")
+def h2_mix(chem):
+    m = ck.Mixture(chem)
+    m.temperature = 1200.0
+    m.pressure = P_ATM
+    m.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _restore_keyword_mode():
+    yield
+    Keyword.setfullkeywords(False)
+
+
+DECK = """
+! CONP ignition deck (CHEMKIN keyword conventions: PRES atm, TIME s)
+TEMP 1200.0
+PRES 1.0
+TIME 2.0E-3
+ATOL 1.0E-12
+RTOL 1.0E-6
+END
+TEMP 9999.0  ! after END: must be ignored
+"""
+
+
+class TestFullKeywordMode:
+    def test_protected_rejected_in_api_mode(self, h2_mix):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_mix)
+        with pytest.raises(ValueError):
+            r.setkeyword("TIME", 1e-3)
+
+    def test_protected_allowed_in_full_mode(self, h2_mix):
+        Keyword.setfullkeywords(True)
+        r = GivenPressureBatchReactor_EnergyConservation(h2_mix)
+        r.setkeyword("TIME", 1e-3)          # no raise
+        assert r.getkeyword("TIME") == 1e-3
+
+    def test_deck_requires_full_mode(self, h2_mix):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_mix)
+        with pytest.raises(RuntimeError):
+            r.apply_keyword_deck(DECK)
+
+    def test_deck_parses_and_drives_run(self, h2_mix):
+        """A text deck configures the whole run: same answer as the
+        typed-API configuration of the identical problem."""
+        ref = GivenPressureBatchReactor_EnergyConservation(h2_mix)
+        ref.time = 2.0e-3
+        assert ref.run() == 0
+        tau_ref = ref.get_ignition_delay()
+
+        Keyword.setfullkeywords(True)
+        r = GivenPressureBatchReactor_EnergyConservation(h2_mix)
+        r.apply_keyword_deck(DECK)
+        assert r.getkeyword("TEMP") == 1200.0      # END honored
+        assert r.run() == 0
+        assert r.get_ignition_delay() == pytest.approx(tau_ref,
+                                                       rel=1e-10)
+        # the deck's PRES is in atm and must land in CGS internally
+        assert r.pressure == pytest.approx(P_ATM)
+
+    def test_deck_profiles_and_reac(self, h2_mix):
+        Keyword.setfullkeywords(True)
+        r = GivenPressureBatchReactor_EnergyConservation(h2_mix)
+        r.apply_keyword_deck([
+            "TPRO 0.0 1200.0",
+            "TPRO 1.0E-3 1500.0",
+            "REAC H2 0.295",
+            "REAC O2 0.148",
+            "REAC N2 0.557",
+            "LOBO",                      # bare boolean keyword
+        ])
+        prof = r.getprofile("TPRO")
+        assert prof is not None and prof.size == 2
+        assert r.getkeyword("LOBO") is True
+        np.testing.assert_allclose(np.asarray(r.Y).sum(), 1.0)
+
+    def test_multizone_hcci_from_deck(self, h2_mix):
+        """Multi-zone HCCI: the constructor flips the class-level
+        full-keyword flag exactly like the reference (HCCI.py:95-96),
+        and the deck supplies the shared state."""
+        Keyword.setfullkeywords(False)
+        m3 = HCCIengine(h2_mix, nzones=3)
+        assert not Keyword.noFullKeyword       # auto-flipped
+        m3.apply_keyword_deck(["TEMP 410.0", "PRES 1.0"])
+        m3.bore = 8.0
+        m3.stroke = 9.0
+        m3.connecting_rod_length = 15.0
+        m3.compression_ratio = 16.0
+        m3.RPM = 1500.0
+        m3.starting_CA = -142.0
+        m3.ending_CA = 116.0
+        m3.consume_protected_keywords()
+        assert m3.temperature == pytest.approx(410.0)
+        m3.set_zonal_temperature([400.0, 420.0, 440.0])
+        m3.set_zonal_volume_fraction([0.2, 0.5, 0.3])
+        assert m3.run() == 0
+
+
+class TestSolutionWriters:
+    def test_std_and_xml_roundtrip(self, h2_mix, tmp_path):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_mix)
+        r.time = 2.0e-3
+        r.STD_Output = True
+        r.XML_Output = True
+        assert r.run() == 0
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            r.process_solution()
+            base = r.label.strip().replace(" ", "_") or "solution"
+            txt, xml = base + ".out", base + ".xml"
+            assert os.path.exists(txt) and os.path.exists(xml)
+            for path in (txt, xml):
+                data = r.read_solution_file(path)
+                np.testing.assert_allclose(
+                    data["temperature"],
+                    r._solution_rawarray["temperature"], rtol=1e-7)
+                np.testing.assert_allclose(
+                    data["H2"], r._solution_rawarray["H2"], rtol=1e-6,
+                    atol=1e-12)
+        finally:
+            os.chdir(cwd)
+
+    def test_no_files_without_toggles(self, h2_mix, tmp_path):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_mix)
+        r.time = 1.0e-3
+        assert r.run() == 0
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            r.process_solution()
+            assert not list(tmp_path.iterdir())
+        finally:
+            os.chdir(cwd)
