@@ -222,6 +222,8 @@ class PlugFlowReactor(BatchReactors):
             raw[name] = Y[:, k]
         self._solution_rawarray = raw
         self._solution_Y = Y
+        if self._TextOut or self._XMLOut:
+            self.write_solution_files()
         return 0
 
     def set_inlet_stream(self, stream: Stream):

@@ -194,6 +194,15 @@ class PremixedFlame(Flame):
             # reactor condition would report a wrong speed if the user
             # tweaked T/P/Y between run() and process_solution()
             self.flamespeed = float(sol.flame_speed)
+        if self._TextOut or self._XMLOut:
+            self._numbsolutionpoints = len(np.asarray(sol.x))
+            raw = {"distance": np.asarray(sol.x),
+                   "temperature": np.asarray(sol.T)}
+            Y = np.asarray(sol.Y)
+            for k, name in enumerate(self._specieslist):
+                raw[name] = Y[:, k]
+            self._solution_rawarray = raw
+            self.write_solution_files()
         return sol
 
     def getsolution(self):

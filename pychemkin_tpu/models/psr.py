@@ -336,6 +336,8 @@ class perfectlystirredreactor(openreactor):
         Y = np.asarray(sol.Y)
         for k, name in enumerate(self._specieslist):
             self._solution_rawarray[name] = Y[k:k + 1]
+        if self._TextOut or self._XMLOut:
+            self.write_solution_files()
         return out
 
     @property

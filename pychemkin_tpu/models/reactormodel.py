@@ -514,7 +514,10 @@ class ReactorModel:
                 ("AREAQ", "area", 1.0)):
             v = self.getkeyword(key)
             if v is not None:
-                if not hasattr(self, attr):
+                prop = getattr(type(self), attr, None)
+                settable = hasattr(self, attr) and not (
+                    isinstance(prop, property) and prop.fset is None)
+                if not settable:
                     logger.warning(
                         "deck keyword %s has no effect on %s", key,
                         type(self).__name__)

@@ -378,6 +378,101 @@ class ReactorNetwork:
         self._run_status = status
         return status
 
+    # --- PSR cluster mode (reference PSR.py:286/:464) -------------------
+    def _linear_psr_chain(self) -> Optional[List[int]]:
+        """The reactor indices as a linear PSR chain (each reactor's
+        whole outflow feeds the next; only the first has external
+        inlets), or None when the topology/types don't qualify."""
+        idxs = sorted(self.reactor_objects)
+        from .psr import PSR_SetResTime_EnergyConservation
+
+        for pos, idx in enumerate(idxs):
+            r = self.reactor_objects[idx]
+            if not isinstance(r, PSR_SetResTime_EnergyConservation):
+                return None
+            targets = self.outflow_targets.get(idx, [])
+            if pos < len(idxs) - 1:
+                if len(targets) != 1 or targets[0][0] != idxs[pos + 1] \
+                        or abs(targets[0][1] - 1.0) > 1e-12:
+                    return None
+            if pos > 0 and r.numbinlets > 0:
+                return None
+        if not idxs or self.reactor_objects[idxs[0]].numbinlets == 0:
+            return None
+        return idxs
+
+    def run_cluster(self) -> int:
+        """Solve a linear PSR chain as ONE coupled Newton system — the
+        TPU-native form of the reference's cluster mode, where
+        clustered PSRs solve in a single native call (reference
+        PSR.py:286 set_reactor_index, :464 cluster_process_keywords;
+        exercised by its PSRChain_network example) instead of the
+        sequential substitution of :meth:`run`. Falls back with an
+        error for topologies that are not a pure SetResTime/ENRG
+        chain."""
+        import jax.numpy as jnp
+
+        from ..ops import psr as psr_ops_mod
+
+        if self.outflow_altered:
+            self.set_reactor_outflow()
+        chain = self._linear_psr_chain()
+        if chain is None:
+            raise RuntimeError(
+                "run_cluster needs a linear chain of "
+                "PSR_SetResTime_EnergyConservation reactors; use run() "
+                "for general networks")
+        head = self.reactor_objects[chain[0]]
+        for i in chain[1:]:
+            if abs(self.reactor_objects[i].pressure
+                   - head.pressure) > 1e-9 * head.pressure:
+                raise RuntimeError(
+                    "run_cluster solves the chain at one pressure; "
+                    "reactor pressures differ — use run()")
+        Y_in0, h_in0, mdot = head.combined_inlet()
+        taus = [self.reactor_objects[i].residence_time for i in chain]
+        qloss = [self.reactor_objects[i].heat_loss_rate for i in chain]
+        T_g, Y_g = [], []
+        for i in chain:
+            r = self.reactor_objects[i]
+            if r._estimate_T is None:
+                r.set_estimate_conditions()    # equilibrium estimate
+            tg, yg = r._guess()
+            T_g.append(tg)
+            Y_g.append(yg)
+        mech = head._effective_mech()
+        sol = psr_ops_mod.solve_psr_chain(
+            mech, "ENRG", P=head.pressure, Y_in0=Y_in0, h_in0=h_in0,
+            taus=taus, T_guess=np.asarray(T_g), Y_guess=np.asarray(Y_g),
+            qloss=np.asarray(qloss), mdot=mdot)
+        if not bool(sol.converged):
+            logger.error("PSR cluster solve did not converge "
+                         "(residual %.2e)", float(sol.residual))
+            self._run_status = 1
+            return 1
+        # store per-reactor solutions exactly like the sequential path;
+        # downstream reactors also get their internal inlet registered
+        # (flow bookkeeping for process_solution / exit streams)
+        for pos, idx in enumerate(chain):
+            r = self.reactor_objects[idx]
+            vol = float(taus[pos]) * mdot / float(sol.rho[pos])
+            r._solution = psr_ops_mod.PSRSolution(
+                T=jnp.asarray(sol.T[pos]), Y=jnp.asarray(sol.Y[pos]),
+                rho=jnp.asarray(sol.rho[pos]),
+                tau=jnp.asarray(taus[pos]),
+                volume=jnp.asarray(vol),
+                residual=sol.residual, converged=sol.converged,
+                n_newton=sol.n_newton)
+            r.runstatus = 0
+            r._estimate_T = float(sol.T[pos])
+            r._estimate_Y = np.asarray(sol.Y[pos])
+            if pos > 0:
+                self.create_internal_inlet(idx)
+            self.reactor_solutions[idx] = r.process_solution()
+        self.set_external_streams()
+        self._run_status = 0
+        return 0
+
     def _run_one(self, idx: int) -> Stream:
         rxtor = self.reactor_objects[idx]
         if isinstance(rxtor, PSR) and not rxtor.checkrunstatus():

@@ -227,25 +227,26 @@ def rates_of_progress(mech, T, C, P=None):
         # fractional orders (global mechanisms: [H2]^0.25 etc.) have an
         # INFINITE concentration derivative at C -> 0, which destroys
         # the stiff solvers' Newton iterations on the unburnt side.
-        # Those entries get a physically negligible floor (1e-16
-        # mol/cm^3 ~ 4e-6 ppm at 1 atm) that bounds the Jacobian;
+        # Those few entries get a physically negligible floor (1e-16
+        # mol/cm^3 ~ 4e-6 ppm at 1 atm) that bounds the Jacobian,
+        # applied as a sparse CORRECTION on top of the dense matmul so
+        # every reaction keeps the MXU-friendly ord @ lnC path;
         # integer-order entries keep the exact tiny floor so absent
         # species still shut their reactions off completely.
-        KK = len(mech.species_names)
-        II = len(mech.reaction_equations)
-        frac_f = np.zeros((II, KK), dtype=bool)
-        frac_r = np.zeros((II, KK), dtype=bool)
-        for i, k in mech.ford_frac_entries:
-            frac_f[i, k] = True
-        for i, k in mech.rord_frac_entries:
-            frac_r[i, k] = True
         lnC_hi = jnp.log(jnp.maximum(C, 1e-16))
-        prod_f = _safe_exp(jnp.sum(
-            ord_f * jnp.where(frac_f, lnC_hi[None, :], lnC[None, :]),
-            axis=1))
-        prod_r = _safe_exp(jnp.sum(
-            ord_r * jnp.where(frac_r, lnC_hi[None, :], lnC[None, :]),
-            axis=1))
+
+        def _with_floor(ord_mat, entries):
+            base = ord_mat @ lnC
+            if not entries:
+                return _safe_exp(base)
+            rows = np.array([i for i, _ in entries])
+            cols = np.array([k for _, k in entries])
+            delta = jnp.zeros(base.shape, base.dtype).at[rows].add(
+                ord_mat[rows, cols] * (lnC_hi[cols] - lnC[cols]))
+            return _safe_exp(base + delta)
+
+        prod_f = _with_floor(ord_f, mech.ford_frac_entries)
+        prod_r = _with_floor(ord_r, mech.rord_frac_entries)
     else:
         prod_f = _safe_exp(ord_f @ lnC)
         prod_r = _safe_exp(ord_r @ lnC)

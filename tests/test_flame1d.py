@@ -305,3 +305,27 @@ def test_mult_vs_mix_flame_speed(h2o2, stoich_h2_air):
     print(f"MIX {mix.flame_speed:.1f} vs MULT {mult.flame_speed:.1f} "
           f"cm/s (delta {100*delta:.2f}%)")
     assert delta < 0.12
+
+
+@pytest.mark.slow
+def test_flame_speed_phi_dependence(h2o2):
+    """Su(H2/air) must INCREASE from phi=1.0 toward the rich peak
+    (phi~1.8 in experiments) — a shape check on the flame physics
+    beyond the single-point magnitude anchor."""
+    names = list(h2o2.species_names)
+
+    def Yphi(phi):
+        X = np.zeros(len(names))
+        X[names.index("H2")] = 2.0 * phi
+        X[names.index("O2")] = 1.0
+        X[names.index("N2")] = 3.76
+        return np.asarray(thermo.X_to_Y(h2o2, jnp.asarray(X / X.sum())))
+
+    sols = {}
+    for phi in (1.0, 1.4):
+        s = flame1d.solve_flame(h2o2, P=1.01325e6, T_in=298.0,
+                                Y_in=Yphi(phi), x_start=0.0, x_end=2.0,
+                                su_guess=230.0)
+        assert s.converged, phi
+        sols[phi] = s.flame_speed
+    assert sols[1.4] > sols[1.0] * 1.05, sols

@@ -150,3 +150,4 @@ class TestRuns:
         net.add_reactor(make_psr(chem, "orphan"))
         with pytest.raises(RuntimeError, match="not connected"):
             net.run()
+

@@ -1,0 +1,88 @@
+"""PSR cluster-mode tests (reference PSR.py:286/:464), in their own
+file: the network suite plus the cluster solves exceed the program
+count at which jaxlib 0.9's CPU backend sporadically aborts in one
+process (the same crash class tests/run_suite.py isolates per file)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.constants import P_ATM
+from pychemkin_tpu.inlet import Stream
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import (
+    PSR_SetResTime_EnergyConservation as PSR_E,
+    ReactorNetwork,
+)
+
+
+@pytest.fixture(scope="module")
+def chem():
+    c = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"),
+                     tran=os.path.join(DATA_DIR, "tran_h2o2.dat"))
+    c.preprocess()
+    return c
+
+
+def make_feed(chem, mdot=10.0):
+    s = Stream(chem, label="feed")
+    s.pressure = P_ATM
+    s.temperature = 298.15
+    s.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+    s.mass_flowrate = mdot
+    return s
+
+
+def make_psr(chem, name, tau=1e-3):
+    g = ck.Mixture(chem)
+    g.pressure = P_ATM
+    g.temperature = 2300.0
+    g.X = {"H2O": 0.25, "N2": 0.65, "OH": 0.05, "O2": 0.05}
+    p = PSR_E(g, label=name)
+    p.residence_time = tau
+    return p
+
+
+class TestClusterMode:
+
+    def test_cluster_matches_sequential(self, chem):
+        """Cluster mode (one coupled Newton over the whole chain —
+        reference PSR.py:286/:464) must land on the same solution as
+        sequential substitution (the PSRChain_network vs
+        PSRChain_declustered example pair)."""
+        def build():
+            net = ReactorNetwork(chem)
+            psrs = [make_psr(chem, f"c{i}") for i in range(3)]
+            psrs[0].set_inlet(make_feed(chem))
+            net.add_reactor_list(psrs)
+            net.add_outflow_connections("c2", [("EXIT>>", 1.0)])
+            return net
+
+        seq = build()
+        assert seq.run() == 0
+
+        clu = build()
+        assert clu.run_cluster() == 0
+
+        for name in ("c0", "c1", "c2"):
+            s_seq = seq.get_reactor_stream(name)
+            s_clu = clu.get_reactor_stream(name)
+            assert s_clu.temperature == pytest.approx(
+                s_seq.temperature, abs=0.5), name
+            iH2O = chem.species_symbols.index("H2O")
+            assert s_clu.Y[iH2O] == pytest.approx(s_seq.Y[iH2O],
+                                                  abs=1e-5)
+        # exit flow bookkeeping matches the sequential path
+        assert clu.get_reactor_stream("c2").mass_flowrate == \
+            pytest.approx(10.0, rel=1e-10)
+
+    def test_cluster_rejects_nonchain(self, chem):
+        net = ReactorNetwork(chem)
+        psrs = [make_psr(chem, f"n{i}") for i in range(2)]
+        psrs[0].set_inlet(make_feed(chem))
+        psrs[1].set_inlet(make_feed(chem))     # second external inlet
+        net.add_reactor_list(psrs)
+        with pytest.raises(RuntimeError):
+            net.run_cluster()

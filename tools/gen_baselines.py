@@ -206,7 +206,8 @@ def gen_psr_scurve():
         "state-residence_time": taus,
         "state-exit_temperature": T_out,
     }
-    _write("psr_scurve", data, "scipy-fsolve on algebraic PSR system")
+    _write("psr_scurve", data,
+           "scipy-BDF transient CSTR marched to steady state")
 
 
 # ---------------------------------------------------------------------------
