@@ -395,6 +395,13 @@ class ReactorNetwork:
                 if len(targets) != 1 or targets[0][0] != idxs[pos + 1] \
                         or abs(targets[0][1] - 1.0) > 1e-12:
                     return None
+            else:
+                # the LAST reactor must flow only to the exit — a
+                # recycle split back into the chain is NOT a linear
+                # chain and needs run()'s tear-stream machinery
+                if len(targets) != 1 \
+                        or targets[0][0] != self._exit_index:
+                    return None
             if pos > 0 and r.numbinlets > 0:
                 return None
         if not idxs or self.reactor_objects[idxs[0]].numbinlets == 0:

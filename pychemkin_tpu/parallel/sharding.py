@@ -27,6 +27,8 @@ from __future__ import annotations
 from functools import partial
 from typing import Optional, Sequence
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -102,6 +104,7 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                            max_steps_per_segment=20_000,
                            solve_kwargs=None, chunk_size=None,
                            stats: Optional[SweepStats] = None,
+                           checkpoint_path: Optional[str] = None,
                            _stats_n_real=None):
     """Ignition-delay sweep sharded over a device mesh — the scaled-out
     form of :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`.
@@ -122,6 +125,15 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     ``stats``: optional :class:`SweepStats` accumulating total accepted
     steps / rejected attempts / Newton iterations across the sweep (the
     measured inputs of the bench's FLOP/MFU model).
+
+    ``checkpoint_path``: an ``.npz`` file updated after every completed
+    chunk (or once, for an unchunked sweep); re-running the same sweep
+    with the same path resumes after the last completed chunk. The file
+    records a hash of the FULL sweep configuration, so a stale file
+    from a different sweep is ignored, never returned. This is the
+    on-disk checkpoint/resume for long sweeps that SURVEY §5 calls for
+    (the reference has only in-memory warm starts) — a preempted
+    10k-point overnight sweep loses one chunk, not the night.
     """
     if mesh is None:
         mesh = make_mesh()
@@ -135,10 +147,46 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                            (B, jnp.asarray(Y0s).shape[-1]))
     t_ends = jnp.broadcast_to(jnp.asarray(t_ends, jnp.float64), (B,))
 
+    # checkpoint identity: EVERYTHING that determines the answer, so a
+    # stale file from a different sweep at the same path can never be
+    # returned as this sweep's results
+    ck_sig = None
+    if checkpoint_path is not None:
+        import hashlib
+
+        h = hashlib.sha256()
+        for part in (problem, energy, str(ignition_mode),
+                     repr(ignition_kwargs), repr(rtol), repr(atol),
+                     repr(max_steps_per_segment), repr(solve_kwargs)):
+            h.update(part.encode())
+        for arr in (T0s, P0s, Y0s, t_ends):
+            h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+        ck_sig = h.hexdigest()
+
+    def _load_ck(expect_chunk):
+        if checkpoint_path is None or not os.path.exists(
+                checkpoint_path):
+            return 0, [], []
+        with np.load(checkpoint_path, allow_pickle=False) as ck:
+            if (str(ck["sig"]) == ck_sig
+                    and int(ck["chunk"]) == expect_chunk):
+                return (int(ck["done_upto"]),
+                        [np.asarray(ck["times"])],
+                        [np.asarray(ck["ok"])])
+        return 0, [], []
+
+    def _save_ck(expect_chunk, done_upto, times_parts, ok_parts):
+        tmp = checkpoint_path + ".tmp.npz"
+        np.savez(tmp, sig=ck_sig, B=B, chunk=expect_chunk,
+                 done_upto=done_upto,
+                 times=np.concatenate(times_parts),
+                 ok=np.concatenate(ok_parts))
+        os.replace(tmp, checkpoint_path)
+
     if chunk_size is not None and chunk_size < B:
         chunk = max(n_dev, (chunk_size // n_dev) * n_dev)
-        times_parts, ok_parts = [], []
-        for lo in range(0, B, chunk):
+        done_upto, times_parts, ok_parts = _load_ck(chunk)
+        for lo in range(done_upto, B, chunk):
             hi = min(lo + chunk, B)
             # re-enter with exactly one chunk (padded inside); same
             # shapes -> same cached program for every full chunk
@@ -158,7 +206,16 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                 _stats_n_real=hi - lo)   # edge-padding is not real work
             times_parts.append(tpart[:hi - lo])
             ok_parts.append(okpart[:hi - lo])
+            if checkpoint_path is not None:
+                _save_ck(chunk, hi, times_parts, ok_parts)
         return (np.concatenate(times_parts), np.concatenate(ok_parts))
+
+    if checkpoint_path is not None:
+        # unchunked sweep: all-or-nothing — a completed matching
+        # checkpoint short-circuits; otherwise solve and save one
+        done_upto, times_parts, ok_parts = _load_ck(0)
+        if done_upto >= B:
+            return times_parts[0][:B], ok_parts[0][:B]
 
     T0s, n_real = _pad_to_multiple(T0s, n_dev)
     P0s, _ = _pad_to_multiple(P0s, n_dev)
@@ -204,6 +261,9 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         jax.device_put(Y0s, NamedSharding(mesh, P(axis, None))),
         jax.device_put(t_ends, in_sharding))
     times, ok, n_steps, n_rej, n_newt = mapped(T0s, P0s, Y0s, t_ends)
+    if checkpoint_path is not None:
+        _save_ck(0, B, [np.asarray(times)[:n_real]],
+                 [np.asarray(ok)[:n_real]])
     if stats is not None:
         # count only genuinely distinct elements: chunked callers pad
         # the tail chunk with edge duplicates whose solver work would

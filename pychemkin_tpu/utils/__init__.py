@@ -2,6 +2,7 @@
 ``.result``/``.baseline`` numeric-comparison harness."""
 
 from . import baseline
+from . import profiling
 from .cache import enable_compilation_cache
 
-__all__ = ["baseline", "enable_compilation_cache"]
+__all__ = ["baseline", "enable_compilation_cache", "profiling"]

@@ -1,0 +1,68 @@
+"""Lightweight tracing/profiling hooks (SURVEY.md §5: the reference has
+none — only wall-clock prints in example scripts; the rebuild adds
+first-class hooks).
+
+Two layers:
+
+- :func:`trace`: a context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace of everything run inside it (device ops,
+  compilation, transfers). Use it to see where a sweep's time goes::
+
+      with profiling.trace("/tmp/ck_trace"):
+          parallel.sharded_ignition_sweep(...)
+
+- :class:`Timings`: named wall-clock sections with jax
+  ``block_until_ready`` fencing, so a section's time is the DEVICE time
+  of the work launched inside it, not just the Python dispatch time.
+  The bench and solver drivers report these next to the measured
+  step/Newton counters (see ``benchmarks._flop_model``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_trace: bool = False):
+    """Write a ``jax.profiler`` trace for the enclosed block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_trace=create_perfetto_trace)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timings:
+    """Named wall-clock sections with device fencing."""
+
+    def __init__(self):
+        self.sections: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def section(self, name: str, fence: Optional[Any] = None):
+        """Time a block; if the block returns device arrays through
+        ``fence`` (a list the block appends to), block on them first so
+        asynchronous dispatch does not hide the device time."""
+        import jax
+
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if fence:
+                jax.block_until_ready(fence)
+            self.sections[name] = self.sections.get(name, 0.0) + (
+                time.perf_counter() - t0)
+
+    def report(self) -> str:
+        total = sum(self.sections.values())
+        lines = [f"{name:<24s} {dt:9.3f}s {100*dt/max(total,1e-30):5.1f}%"
+                 for name, dt in sorted(self.sections.items(),
+                                        key=lambda kv: -kv[1])]
+        return "\n".join(lines)

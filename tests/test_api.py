@@ -303,3 +303,24 @@ class TestConstants:
         h = ck.water_heat_vaporization(373.15)
         assert abs(h - 2.2564e10) < 0.03e10
         assert ck.water_heat_vaporization(650.0) == 0.0
+
+
+def test_profiling_hooks(tmp_path):
+    """SURVEY §5 tracing: the jax.profiler context writes a trace dir
+    and Timings fences device work."""
+    import jax.numpy as jnp
+
+    from pychemkin_tpu.utils import profiling
+
+    tm = profiling.Timings()
+    out = []
+    with tm.section("matmul", fence=out):
+        x = jnp.ones((64, 64))
+        out.append(x @ x)
+    assert tm.sections["matmul"] > 0.0
+    assert "matmul" in tm.report()
+
+    with profiling.trace(str(tmp_path / "trace")):
+        _ = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    import os
+    assert any(os.scandir(str(tmp_path / "trace")))

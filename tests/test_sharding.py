@@ -122,3 +122,60 @@ def test_program_cache_hit(mech, stoich_Y):
             max_steps_per_segment=4000)
     n1 = len(parallel._sweep_program_cache)
     assert n1 == n0 + 1          # one new program, reused on the rerun
+
+
+def test_checkpointed_sweep_resumes(mech, stoich_Y, tmp_path):
+    """On-disk checkpoint/resume for long sweeps (SURVEY §5): a sweep
+    interrupted after some chunks resumes from the checkpoint and
+    reproduces the uninterrupted answer; completed chunks are not
+    re-solved (verified via the stats counters)."""
+    mesh = parallel.make_mesh()
+    T0s = np.linspace(1050.0, 1350.0, 24)
+    kw = dict(mesh=mesh, rtol=1e-6, atol=1e-12,
+              max_steps_per_segment=8000, chunk_size=8)
+    ref_t, ref_ok = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3, **kw)
+
+    ck = str(tmp_path / "sweep.ck.npz")
+    full_stats = parallel.SweepStats()
+    t1, ok1 = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        checkpoint_path=ck, stats=full_stats, **kw)
+    np.testing.assert_allclose(t1, ref_t, rtol=1e-12)
+
+    # simulate a preemption after 2 of 3 chunks: rewind the marker
+    with np.load(ck) as data:
+        saved = {k: data[k] for k in data.files}
+    saved["done_upto"] = np.asarray(16)
+    saved["times"] = saved["times"][:16]
+    saved["ok"] = saved["ok"][:16]
+    np.savez(ck, **saved)
+
+    resume_stats = parallel.SweepStats()
+    t2, ok2 = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        checkpoint_path=ck, stats=resume_stats, **kw)
+    np.testing.assert_allclose(t2, ref_t, rtol=1e-12)
+    assert np.array_equal(ok2, ref_ok)
+    # only the last chunk re-ran
+    assert 0 < resume_stats.n_steps < 0.6 * full_stats.n_steps
+
+
+def test_checkpoint_ignores_stale_file(mech, stoich_Y, tmp_path):
+    """A checkpoint written by a DIFFERENT sweep configuration at the
+    same path must be ignored, not returned as results."""
+    mesh = parallel.make_mesh()
+    T0s = np.linspace(1100.0, 1300.0, 16)
+    ck = str(tmp_path / "sweep.ck.npz")
+    kw = dict(mesh=mesh, rtol=1e-6, atol=1e-12,
+              max_steps_per_segment=8000, chunk_size=8,
+              checkpoint_path=ck)
+    t1, _ = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3, **kw)
+    # same T0 grid, different pressure: delays must differ, and the
+    # stale checkpoint must not short-circuit the solve
+    t2, ok2 = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 3.0 * 1.01325e6, stoich_Y, 2e-3,
+        **kw)
+    assert np.all(ok2)
+    assert not np.allclose(t1, t2, rtol=1e-3)
