@@ -1,0 +1,25 @@
+"""Batched ignition-delay sweep — the TPU answer to the reference's
+serial 20-point loop (examples/batch/ignitiondelay.py): every initial
+condition integrates in ONE compiled program."""
+import os
+
+import numpy as np
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import GivenPressureBatchReactor_EnergyConservation
+
+chem = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+chem.preprocess()
+
+mix = ck.Mixture(chem)
+mix.temperature = 1200.0
+mix.pressure = ck.P_ATM
+mix.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+
+r = GivenPressureBatchReactor_EnergyConservation(mix)
+r.time = 2.0e-3
+T0s = np.linspace(1000.0, 1400.0, 20)
+delays_ms, ok = r.run_sweep(T0s=T0s)
+for T0, d, o in zip(T0s, delays_ms, ok):
+    print("T0=%6.1f K  tau=%9.4f ms  %s" % (T0, d, "ok" if o else "FAIL"))

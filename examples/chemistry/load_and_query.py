@@ -1,0 +1,18 @@
+"""Load a mechanism and query chemistry data (reference
+examples/chemistry/simple.py + speciesproperties.py)."""
+import os
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.mechanism import DATA_DIR
+
+chem = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"),
+                    tran=os.path.join(DATA_DIR, "tran_h2o2.dat"))
+chem.preprocess()
+
+print("species:", chem.KK, chem.species_symbols)
+print("elements:", chem.MM, chem.element_symbols)
+print("reactions:", chem.IIGas)
+print("WT[H2O] =", chem.WT[chem.species_symbols.index("H2O")], "g/mol")
+print("R5:", chem.get_gas_reaction_string(5))
+A, beta, Ea_R = chem.get_reaction_parameters()
+print("  A=%.3e beta=%.2f Ea/R=%.0f K" % (A[4], beta[4], Ea_R[4]))

@@ -1,0 +1,33 @@
+"""Single-zone HCCI engine cycle with heat-release CAs (reference
+examples/engine/hcciengine.py)."""
+import os
+
+import numpy as np
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import HCCIengine
+
+chem = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+chem.preprocess()
+
+charge = ck.Mixture(chem)
+charge.temperature = 420.0
+charge.pressure = ck.P_ATM
+charge.X = {"H2": 2.0, "O2": 1.0, "N2": 7.52}
+
+eng = HCCIengine(charge)
+eng.bore = 8.0
+eng.stroke = 9.0
+eng.connecting_rod_length = 15.0
+eng.compression_ratio = 16.0
+eng.RPM = 1500.0
+eng.starting_CA = -142.0
+eng.ending_CA = 116.0
+assert eng.run() == 0
+ca10, ca50, ca90 = eng.get_engine_heat_release_CAs()
+print("CA10/50/90 = %.1f / %.1f / %.1f deg" % (ca10, ca50, ca90))
+avg = eng.process_average_engine_solution()
+print("peak pressure = %.1f atm at CA = %.1f deg" % (
+    np.max(avg["pressure"]) / ck.P_ATM,
+    avg["CA"][int(np.argmax(avg["pressure"]))]))

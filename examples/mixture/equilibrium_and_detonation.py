@@ -1,0 +1,22 @@
+"""Adiabatic flame temperature and CJ detonation (reference
+examples/mixture + equilibrium galleries)."""
+import os
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.mechanism import DATA_DIR
+
+chem = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+chem.preprocess()
+
+mix = ck.Mixture(chem)
+mix.temperature = 298.15
+mix.pressure = ck.P_ATM
+mix.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+
+eqm = ck.equilibrium(mix, opt=5)          # HP: adiabatic flame
+print("T_ad = %.1f K" % eqm.temperature)
+
+speeds, burnt = ck.detonation(mix)
+print("CJ detonation speed = %.0f m/s" % (speeds[1] / 100.0))
+print("CJ burnt state: %.1f K, %.2f atm"
+      % (burnt.temperature, burnt.pressure / ck.P_ATM))

@@ -1,0 +1,31 @@
+"""Plug-flow reactor axial profiles (reference examples/PFR/plugflow.py)."""
+import os
+
+import numpy as np
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.inlet import Stream
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import PlugFlowReactor_EnergyConservation
+
+chem = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+chem.preprocess()
+
+feed = Stream(chem, label="feed")
+feed.temperature = 1100.0
+feed.pressure = ck.P_ATM
+feed.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+feed.mass_flowrate = 2.0
+feed.flowarea = 1.0
+
+pfr = PlugFlowReactor_EnergyConservation(feed)
+pfr.length = 50.0
+assert pfr.run() == 0
+print("ignition distance = %.3f cm" % pfr.get_ignition_delay())
+pfr.process_solution()
+x = pfr.get_solution_variable_profile("distance")
+T = pfr.get_solution_variable_profile("temperature")
+for i in range(0, len(x), 20):
+    print("x=%6.2f cm  T=%7.1f K" % (x[i], T[i]))
+print("exit: T=%.1f K, u=%.0f cm/s" % (
+    T[-1], pfr.get_solution_variable_profile("velocity")[-1]))

@@ -1,0 +1,25 @@
+"""Freely-propagating H2/air laminar flame speed (reference
+examples/premixed_flame/flamespeed.py). Takes a few minutes on CPU."""
+import os
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.inlet import Stream
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import FreelyPropagating
+
+chem = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"),
+                    tran=os.path.join(DATA_DIR, "tran_h2o2.dat"))
+chem.preprocess()
+
+unburnt = Stream(chem, label="unburnt")
+unburnt.temperature = 298.0
+unburnt.pressure = ck.P_ATM
+unburnt.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+unburnt.mass_flowrate = 1.0
+
+flame = FreelyPropagating(unburnt)
+flame.starting_position = 0.0
+flame.ending_position = 2.0
+assert flame.run() == 0
+flame.process_solution()
+print("Su = %.1f cm/s" % flame.get_flame_speed())

@@ -1,0 +1,35 @@
+"""PSR residence-time S-curve in one vmapped solve (reference
+examples/PSR/PSRgas.py runs a serial continuation loop)."""
+import os
+
+import numpy as np
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.inlet import Stream
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import PSR_SetResTime_EnergyConservation
+
+chem = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+chem.preprocess()
+
+inlet = Stream(chem, label="feed")
+inlet.temperature = 298.15
+inlet.pressure = ck.P_ATM
+inlet.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+inlet.mass_flowrate = 10.0
+
+guess = ck.Mixture(chem)
+guess.temperature = 2300.0
+guess.pressure = ck.P_ATM
+guess.X = {"H2O": 0.3, "N2": 0.7}
+
+psr = PSR_SetResTime_EnergyConservation(guess)
+psr.set_inlet(inlet)
+psr.residence_time = 1e-3
+psr.set_estimate_conditions()          # equilibrium estimate
+
+taus = np.geomspace(3e-4, 1e-1, 12)
+T, Y, converged = psr.run_sweep(taus=taus)
+for tau, t, c in zip(taus, np.asarray(T), np.asarray(converged)):
+    print("tau=%9.2e s  T_exit=%7.1f K  %s"
+          % (tau, t, "ok" if c else "unconverged"))

@@ -1,0 +1,44 @@
+"""3-PSR chain, solved both by sequential substitution and as one
+coupled cluster (reference examples/reactor_network/PSRnetwork.py and
+the PSRChain_network vs PSRChain_declustered pair)."""
+import os
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.inlet import Stream
+from pychemkin_tpu.mechanism import DATA_DIR
+from pychemkin_tpu.models import (
+    PSR_SetResTime_EnergyConservation,
+    ReactorNetwork,
+)
+
+chem = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+chem.preprocess()
+
+
+def build():
+    net = ReactorNetwork(chem)
+    for i in range(3):
+        g = ck.Mixture(chem)
+        g.temperature = 2300.0
+        g.pressure = ck.P_ATM
+        g.X = {"H2O": 0.25, "N2": 0.65, "OH": 0.05, "O2": 0.05}
+        p = PSR_SetResTime_EnergyConservation(g, label=f"psr{i}")
+        p.residence_time = 1e-3
+        net.add_reactor(p)
+    feed = Stream(chem, label="feed")
+    feed.temperature = 298.15
+    feed.pressure = ck.P_ATM
+    feed.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
+    feed.mass_flowrate = 10.0
+    net.reactor_objects[1].set_inlet(feed)
+    net.add_outflow_connections("psr2", [("EXIT>>", 1.0)])
+    return net
+
+seq = build()
+assert seq.run() == 0
+clu = build()
+assert clu.run_cluster() == 0
+for name in ("psr0", "psr1", "psr2"):
+    print("%s: sequential %7.1f K   cluster %7.1f K" % (
+        name, seq.get_reactor_stream(name).temperature,
+        clu.get_reactor_stream(name).temperature))

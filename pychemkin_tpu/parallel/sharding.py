@@ -161,18 +161,30 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
             h.update(part.encode())
         for arr in (T0s, P0s, Y0s, t_ends):
             h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+        # the MECHANISM determines the answer too: hash every floating
+        # leaf (rates, thermo, stoichiometry) plus the species list, so
+        # e.g. a retuned-A-factor variant cannot reuse the file
+        h.update(",".join(mech.species_names).encode())
+        for leaf in jax.tree_util.tree_leaves(mech):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
         ck_sig = h.hexdigest()
 
     def _load_ck(expect_chunk):
         if checkpoint_path is None or not os.path.exists(
                 checkpoint_path):
             return 0, [], []
-        with np.load(checkpoint_path, allow_pickle=False) as ck:
-            if (str(ck["sig"]) == ck_sig
-                    and int(ck["chunk"]) == expect_chunk):
-                return (int(ck["done_upto"]),
-                        [np.asarray(ck["times"])],
-                        [np.asarray(ck["ok"])])
+        try:
+            with np.load(checkpoint_path, allow_pickle=False) as ck:
+                if (str(ck["sig"]) == ck_sig
+                        and int(ck["chunk"]) == expect_chunk):
+                    return (int(ck["done_upto"]),
+                            [np.asarray(ck["times"])],
+                            [np.asarray(ck["ok"])])
+        except Exception:            # noqa: BLE001 — corrupt/foreign
+            # file: a checkpoint is an optimization; recompute instead
+            # of dying on exactly the stale-file case we promise to
+            # tolerate
+            pass
         return 0, [], []
 
     def _save_ck(expect_chunk, done_upto, times_parts, ok_parts):

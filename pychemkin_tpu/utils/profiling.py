@@ -27,15 +27,14 @@ from typing import Any, Dict, Optional
 
 @contextlib.contextmanager
 def trace(log_dir: str, *, create_perfetto_trace: bool = False):
-    """Write a ``jax.profiler`` trace for the enclosed block."""
+    """Write a ``jax.profiler`` trace for the enclosed block (thin
+    package-level alias of ``jax.profiler.trace`` so user code imports
+    one profiling surface)."""
     import jax
 
-    jax.profiler.start_trace(log_dir,
-                             create_perfetto_trace=create_perfetto_trace)
-    try:
+    with jax.profiler.trace(log_dir,
+                            create_perfetto_trace=create_perfetto_trace):
         yield log_dir
-    finally:
-        jax.profiler.stop_trace()
 
 
 class Timings:

@@ -1,0 +1,38 @@
+"""Run the examples gallery as subprocesses — the reference's own test
+harness model (SURVEY §4: tests launch examples/ scripts in
+subprocesses and assert exit code 0). The slow flame example is
+excluded here; its physics is covered by tests/test_flame1d.py."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+FAST = [
+    "chemistry/load_and_query.py",
+    "mixture/equilibrium_and_detonation.py",
+    "batch/ignition_delay_sweep.py",
+    "psr/psr_s_curve.py",
+    "pfr/plugflow.py",
+    "engine/hcci_engine.py",
+    "reactor_network/psr_chain_cluster.py",
+]
+
+
+@pytest.mark.parametrize("script", FAST)
+def test_example_runs(script, tmp_path):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(EXAMPLES)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=900)
+    assert r.returncode == 0, (script, r.stdout[-800:], r.stderr[-800:])
+    assert r.stdout.strip()          # every example prints results
