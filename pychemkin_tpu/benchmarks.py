@@ -386,11 +386,21 @@ def _main_guarded():
     if on_accel:
         results, accel_err = _run_ladder(ladder, repeats, cfg_timeout)
     else:
-        # no accelerator: run the same full ladder on CPU in clean
-        # processes (no tunnel dial); each rung still has its own
-        # timeout, so a slow CPU stops climbing on its own
+        # no accelerator: run the ladder on CPU in clean processes (no
+        # tunnel dial), capped at B<=1024 per rung — the 4096 rungs
+        # exist to show TPU batch scaling and would only burn the
+        # fallback's wall clock; each rung still has its own timeout
         accel_err = f"no usable accelerator (probe={platform!r})"
-        results, cpu_err = _run_ladder(ladder, repeats, cfg_timeout,
+        cpu_ladder = [(m, B) for m, B in ladder if B <= 1024]
+        if not cpu_ladder:
+            # never let the cap empty the ladder: clamp instead
+            cpu_ladder = [(m, min(B, 1024)) for m, B in ladder]
+            print("# CPU fallback: all rungs exceeded B=1024; clamped",
+                  file=sys.stderr)
+        elif len(cpu_ladder) < len(ladder):
+            print(f"# CPU fallback: dropped {len(ladder)-len(cpu_ladder)}"
+                  " rung(s) with B>1024", file=sys.stderr)
+        results, cpu_err = _run_ladder(cpu_ladder, repeats, cfg_timeout,
                                        env=_cpu_env())
         if cpu_err:
             accel_err += "; " + cpu_err

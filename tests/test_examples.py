@@ -9,6 +9,9 @@ import sys
 
 import pytest
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from run_suite import _child_env  # noqa: E402 — the one CPU-env scrub
+
 EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples")
 
@@ -25,9 +28,7 @@ FAST = [
 
 @pytest.mark.parametrize("script", FAST)
 def test_example_runs(script, tmp_path):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    env = _child_env()
     repo = os.path.dirname(EXAMPLES)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run(
