@@ -33,6 +33,7 @@ from .ops import realgas, thermo, transport
 _verbose = False
 #: registry of preprocessed chemistry sets, chemID -> Chemistry
 _chemset_registry: dict[int, "Chemistry"] = {}
+_chemset_init_flags: dict[int, bool] = {}
 _next_chem_id = [0]
 
 
@@ -68,6 +69,20 @@ def done():
     KINFinish and releases the license; here it just clears the
     registry)."""
     _chemset_registry.clear()
+
+
+def chemistryset_new(chem_index: int):
+    """Mark a chemistry set as freshly preprocessed / not yet
+    initialized (reference: chemistry.py:222 — there it clears a
+    module-level native-init flag; mechanisms are values here, so only
+    the flag bookkeeping remains)."""
+    _chemset_init_flags[chem_index] = False
+
+
+def chemistryset_initialized(chem_index: int):
+    """Flag a chemistry set's solver workspace as initialized
+    (reference: chemistry.py:236)."""
+    _chemset_init_flags[chem_index] = True
 
 
 def check_chemistryset(chem_index: int) -> bool:
@@ -124,6 +139,7 @@ class Chemistry:
         self._realgas_mixing_rule = realgas.MIX_VDW
         self._critical_overrides = {}
         self._critical_cache = None
+        self._want_transport = bool(tran)
         if surf and os.path.isfile(surf):
             logger.warning("surface mechanisms are not supported; "
                            "ignoring %s", surf)
@@ -394,6 +410,53 @@ class Chemistry:
 
     realgas_CuEOS = list(realgas.EOS_NAMES)
     realgas_mixing_rules = list(realgas.MIXING_RULE_NAMES)
+
+    @property
+    def EOS(self) -> int:
+        """Number of available cubic EOS models
+        (reference: chemistry.py:1524 — there it reports what the
+        native library's real-gas module offers; all five are
+        implemented here)."""
+        return len(self.realgas_CuEOS) - 1      # minus 'ideal gas'
+
+    def get_reaction_AFactor(self, reaction_index: int) -> float:
+        """Arrhenius A-factor of one reaction, 1-based index
+        (reference: chemistry.py:1680)."""
+        mech = self._require_mech()
+        if not 1 <= reaction_index <= mech.n_reactions:
+            raise ValueError(
+                f"reaction index must be in [1, {mech.n_reactions}]")
+        return float(np.asarray(mech.A)[reaction_index - 1])
+
+    def preprocess_transportdata(self):
+        """Ask the preprocessor to include transport data
+        (reference: chemistry.py:451). Here transport parses whenever a
+        ``tran`` file was given; absent one, warn exactly like the
+        reference does for a mechanism without a TRANSPORT block."""
+        if not self._tran_file:
+            logger.warning("make sure the gas mechanism contains the "
+                           "'TRANSPORT ALL' block.")
+        self._want_transport = True
+
+    @property
+    def summaryfile(self) -> str:
+        """Path of the preprocessing summary file
+        (reference: chemistry.py:440 returns the native preprocessor's
+        Summary.out; here the summary is written on first access)."""
+        mech = self._require_mech()
+        path = os.path.abspath(f"Summary_{self.chemID}.out")
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("pychemkin_tpu preprocessing summary\n")
+                f.write(f"mechanism: {self._chem_file}\n")
+                f.write(f"elements ({mech.n_elements}): "
+                        + " ".join(mech.element_names) + "\n")
+                f.write(f"species ({mech.n_species}): "
+                        + " ".join(mech.species_names) + "\n")
+                f.write(f"gas reactions: {mech.n_reactions}\n")
+                f.write("transport data: "
+                        + ("yes" if mech.has_transport else "no") + "\n")
+        return path
 
     def set_critical_properties(self, species: str, Tc: float, Pc: float,
                                 omega: float):

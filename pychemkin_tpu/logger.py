@@ -85,3 +85,8 @@ class ChemkinLogger(metaclass=SingletonType):
 
 #: module-level singleton, mirroring ``from ansys.chemkin.logger import logger``
 logger = ChemkinLogger()
+
+
+def get_logger():
+    """The singleton logger instance (reference logger.py get_logger)."""
+    return logger

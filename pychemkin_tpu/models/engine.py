@@ -355,6 +355,16 @@ class Engine(BatchReactors):
 
     # --- solution access -------------------------------------------------
 
+
+    def get_engine_solution_size(self) -> int:
+        """Number of saved solution points in the engine cycle
+        (reference engine.py:get_engine_solution_size)."""
+        if getattr(self, "_engine_solution", None) is None:
+            return 0
+        import numpy as np
+
+        return int(len(np.asarray(self._engine_solution.CA)))
+
     def get_engine_heat_release_CAs(self) -> Tuple[float, float, float]:
         """CA10/CA50/CA90 of cumulative heat release
         (reference engine.py:953)."""

@@ -85,6 +85,25 @@ class Flame(ReactorModel, SteadyStateSolver, Grid):
 
     # --- differencing (reference flame.py:134-152) -------------------------
 
+    # reference flame.py:122 spells the method with a typo; keep the
+    # misspelled alias so reference scripts run unchanged
+    use_temp_profiel_initial_mesh = None  # assigned after class body
+
+    def set_mesh_keywords(self) -> int:
+        """Mirror the Grid mixin's mesh parameters into the keyword
+        table (reference flame.py:154); the typed solve reads the
+        attributes directly."""
+        for key, val in (("NPTS", self.numb_grid_points),
+                         ("NTOT", self.max_numb_grid_points),
+                         ("NADP", self.max_numb_adapt_points),
+                         ("GRAD", self.gradient),
+                         ("CURV", self.curvature),
+                         ("XSTR", self.starting_x),
+                         ("XEND", self.ending_x)):
+            if val is not None:
+                self._record_keyword(key, val)
+        return 0
+
     def set_convection_differencing_type(self, mode: str):
         """'central' (CDIF) or 'upwind' (WDIF, default)."""
         mode = mode.lower()
@@ -174,3 +193,6 @@ class Flame(ReactorModel, SteadyStateSolver, Grid):
             ntot=self.max_numb_grid_points,
             n_initial=max(self.numb_grid_points, 2),
         )
+
+
+Flame.use_temp_profiel_initial_mesh = Flame.use_temp_profile_initial_mesh

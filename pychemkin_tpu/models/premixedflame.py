@@ -205,6 +205,57 @@ class PremixedFlame(Flame):
             self.write_solution_files()
         return sol
 
+
+    # --- keyword-surface completions (reference premixedflame.py) -------
+    def use_TPRO_grids(self, mode: bool = True):
+        """Use the TPRO profile's positions as the initial grid
+        (reference premixedflame.py:167 USE_TPRO_GRID)."""
+        self.setkeyword("USE_TPRO_GRID", bool(mode))
+        self.grid_T_profile = bool(mode)
+
+    def lump_diffusion_imbalance(self, mode: bool = True):
+        """Reference premixedflame.py:110: lump the diffusive mass-flux
+        imbalance into the LAST species instead of the correction
+        velocity. This build's flux assembly enforces sum_k j_k = 0 by
+        the correction velocity (the reference's own default); the
+        lumping alternative is not implemented, so turning it on warns
+        and keeps the correction-velocity formulation."""
+        self.setkeyword("LUMP", bool(mode))
+        if mode:
+            logger.warning("lumped-imbalance closure not implemented; "
+                           "keeping the correction-velocity default")
+
+    def set_profilekeywords(self) -> int:
+        """Render held profiles into keyword lines (reference
+        premixedflame.py:127; the typed solve consumes the profile
+        objects directly — this keeps deck rendering in sync)."""
+        return self.createkeywordinputlines()[0]
+
+    def set_gridkeywords(self) -> int:
+        """(reference premixedflame.py:180)."""
+        return self.set_mesh_keywords()
+
+    def create_solution_streams(self):
+        """Stream objects for every solution grid point
+        (reference premixedflame.py:696). Each carries the local state
+        and the flame's mass flux per unit area as its flow rate."""
+        self._require_solution()
+        sol = self._solution
+        from ..inlet import Stream
+
+        streams = []
+        Y = np.asarray(sol.Y)
+        for i in range(len(np.asarray(sol.x))):
+            st = Stream(self.chemistry,
+                        label=f"{self.label}-pt{i}")
+            st.pressure = self.pressure
+            st.temperature = float(np.asarray(sol.T)[i])
+            st.Y = Y[i]
+            st.mass_flowrate = float(sol.mdot)
+            streams.append(st)
+        self._solution_mixturearray = streams
+        return streams
+
     def getsolution(self):
         """Alias used throughout the reference docs."""
         return self.process_solution()

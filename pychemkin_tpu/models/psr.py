@@ -86,6 +86,17 @@ class openreactor(ReactorModel, SteadyStateSolver):
     def numbinlets(self) -> int:
         return len(self._inlets)
 
+
+    def number_external_inlets(self) -> int:
+        """(reference openreactor.py: count of registered inlets)."""
+        return len(self._inlets)
+
+    def net_vol_flowrate(self) -> float:
+        """Net external volumetric inflow [cm^3/s]
+        (reference openreactor.py:271)."""
+        return float(sum(s.convert_to_vol_flowrate()
+                         for s in self._inlets.values()))
+
     def net_mass_flowrate(self) -> float:
         """Total inlet mass flow [g/s] (reference: openreactor.py:259)."""
         return sum(s.convert_to_mass_flowrate()
@@ -176,6 +187,25 @@ class perfectlystirredreactor(openreactor):
         self._reactor_index = int(index)
 
     # --- initial estimates (reference: PSR.py:301-426) ---------------------
+
+    def set_inlet_keywords(self) -> int:
+        """Render the inlet registry into keyword lines (reference
+        PSR.py:203 -> KINAll0D_SetupPSRInletInputs; the typed solve
+        mixes the inlets directly — this keeps decks in sync)."""
+        for name, st in self._inlets.items():
+            self._record_keyword(f"INLET_{name}".upper(),
+                                 float(st.convert_to_mass_flowrate()))
+        return 0
+
+    def cluster_process_keywords(self) -> int:
+        """Prepare this reactor for a cluster solve (reference
+        PSR.py:464): route any full-keyword deck state and render the
+        keyword tables; the coupled solve itself happens in
+        ReactorNetwork.run_cluster."""
+        self.consume_protected_keywords()
+        self.set_SSsolver_keywords()
+        return self.set_inlet_keywords()
+
     def set_estimate_conditions(self, temperature: Optional[float] = None,
                                 mixture: Optional[Mixture] = None,
                                 use_equilibrium: bool = True):

@@ -68,6 +68,7 @@ class Keyword:
         self._value = value
         self._type = type(value)
         self._protected = protected
+        self._prefix = ""           # '!' disables (reference :313-347)
 
     def resetvalue(self, value: KeywordValue):
         """(reference: reactormodel.py:258)."""
@@ -96,10 +97,28 @@ class Keyword:
     def getvalue_as_string(self) -> Tuple[int, str]:
         """Render the keyword input line (reference:
         reactormodel.py:349-377). Booleans render as the bare keyword
-        (present = on); other types as 'KEY value'."""
+        (present = on); other types as 'KEY value'. A '!'-disabled
+        keyword (see :meth:`keyprefix`) renders commented out."""
         if self._type is bool:
-            return (0, self._phrase) if self._value else (1, "")
-        return 0, f"{self._phrase} {self._value}"
+            line = self._phrase if self._value else ""
+            err = 0 if self._value else 1
+        else:
+            err, line = 0, f"{self._phrase} {self._value}"
+        if line and self._prefix:
+            line = self._prefix + line
+        return err, line
+
+    @property
+    def keyprefix(self) -> bool:
+        """True when the keyword is active, False when disabled by the
+        '!' comment prefix (reference: reactormodel.py:335)."""
+        return self._prefix != "!"
+
+    @keyprefix.setter
+    def keyprefix(self, on: bool):
+        """Enable/disable the keyword by toggling the '!' prefix
+        (reference: reactormodel.py:313)."""
+        self._prefix = "" if on else "!"
 
     def show(self):
         print(self.getvalue_as_string()[1])
@@ -592,15 +611,83 @@ class ReactorModel:
                            for ln in rows[1:]])
         return {h: data[:, i] for i, h in enumerate(header)}
 
+    # --- composition accessors (reference: reactormodel.py:1330-1423) ------
+    @property
+    def molefraction(self) -> np.ndarray:
+        """Reactor-condition mole fractions (reference:
+        reactormodel.py:1330)."""
+        return self._condition.X
+
+    @molefraction.setter
+    def molefraction(self, recipe):
+        self._condition.X = recipe
+
+    @property
+    def massfraction(self) -> np.ndarray:
+        """Reactor-condition mass fractions (reference:
+        reactormodel.py:1365)."""
+        return self._condition.Y
+
+    @massfraction.setter
+    def massfraction(self, recipe):
+        self._condition.Y = recipe
+
+    @property
+    def concentration(self) -> np.ndarray:
+        """Reactor-condition molar concentrations [mol/cm^3]
+        (reference: reactormodel.py:1400)."""
+        return self._condition.concentration
+
+    def list_composition(self, mode: str = "mole", bound: float = 0.0):
+        """(reference: reactormodel.py:1424)."""
+        self._condition.list_composition(mode=mode, bound=bound)
+
+    def setsolutionspeciesfracmode(self, mode: str = "mass"):
+        """Species-fraction type for post-processed solutions
+        (reference: reactormodel.py:1816)."""
+        if mode.lower() not in ("mole", "mass"):
+            raise ValueError("species fraction mode must be 'mass' or "
+                             "'mole'")
+        self._speciesmode = mode.lower()
+
+    # --- reactor-level real-gas toggles (reference: 1622-1719) -------------
+    def userealgasEOS(self, mode: bool = True):
+        """Enable/disable the cubic EOS for this reactor's chemistry
+        set (reference: reactormodel.py:1622)."""
+        if mode:
+            self.chemistry.use_realgas_cubicEOS()
+        else:
+            self.chemistry.use_idealgas_law()
+
+    def realgas(self) -> bool:
+        """(reference: reactormodel.py:1680)."""
+        return bool(self.chemistry.userealgas)
+
+    def setrealgasmixingmodel(self, rule: int = 0):
+        """(reference: reactormodel.py:1700)."""
+        self.chemistry.set_realgas_mixing_rule(rule)
+
     # --- run status (reference: reactormodel.py:1720-1764) -----------------
     def getrunstatus(self) -> int:
         return self.runstatus
+
+    def setrunstatus(self, status: int):
+        """(reference: reactormodel.py:1745)."""
+        self.runstatus = int(status)
 
     def checkrunstatus(self) -> bool:
         return self.runstatus == STATUS_SUCCESS
 
     def getrawsolutionstatus(self) -> bool:
         return self._numbsolutionpoints > 0
+
+    def getnumbersolutionpoints(self) -> int:
+        """(reference: reactormodel.py:1836)."""
+        return self._numbsolutionpoints
+
+    def getmixturesolutionstatus(self) -> bool:
+        """(reference: reactormodel.py:1848)."""
+        return len(self._solution_mixturearray) > 0
 
     def run(self) -> int:  # pragma: no cover - abstract template
         """Template method; concrete reactors override

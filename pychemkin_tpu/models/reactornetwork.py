@@ -603,6 +603,12 @@ class ReactorNetwork:
 
     # --- tear-stream utilities (reference :1246-1463) -------------------
 
+
+    def check_iteration_count(self, count: int) -> bool:
+        """True while the tear-loop count is under the limit
+        (reference hybridreactornetwork.py:1362)."""
+        return count < self.max_tearloop_count
+
     def add_tearingpoint(self, reactor_name: str):
         """(reference :1277)."""
         if reactor_name not in self.reactor_map:

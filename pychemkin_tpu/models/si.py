@@ -47,6 +47,7 @@ class SIengine(Engine):
         self.MBangles: Optional[np.ndarray] = None
         self.MBfractions: Optional[np.ndarray] = None
         self.burnefficiency = 1.0
+        self._product_min_x = 1e-8
         self._product_names: List[str] = []
         self._fuel_recipe = None
         self._oxid_recipe = None
@@ -158,6 +159,14 @@ class SIengine(Engine):
     def define_oxid_composition(self, recipe):
         self._oxid_recipe = recipe
 
+
+    def set_burned_products_minimum_mole_fraction(self, x: float = 1e-8):
+        """Drop burned-product species below this mole fraction from
+        the prescribed product composition (reference SI.py)."""
+        if not 0.0 <= x < 1.0:
+            raise ValueError("threshold must be in [0, 1)")
+        self._product_min_x = float(x)
+
     def define_product_composition(self, products: List[str]):
         """Complete-combustion product species entering the burned zone."""
         self._product_names = list(products)
@@ -195,6 +204,10 @@ class SIengine(Engine):
         if Xp.sum() <= 0:
             raise ValueError("product composition solve failed; check "
                              "the product species list")
+        Xp = Xp / Xp.sum()
+        # drop trace products below the configured threshold
+        # (set_burned_products_minimum_mole_fraction, reference SI.py)
+        Xp = np.where(Xp >= self._product_min_x, Xp, 0.0)
         return np.asarray(thermo.X_to_Y(mech, jnp.asarray(Xp / Xp.sum())))
 
     def _wiebe_tuple(self):

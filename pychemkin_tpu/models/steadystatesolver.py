@@ -156,3 +156,13 @@ class SteadyStateSolver:
             self.TRstride_fixT = float(stride)
         self.SSsolverkeywords["TIME" if not energymode else "TIM2"] = (
             int(numbsteps), float(stride))
+
+
+    def set_SSsolver_keywords(self):
+        """Mirror the accumulated steady-state solver parameters into
+        the model's keyword table (reference flame.py:245 /
+        PSR.py keyword marshalling; here the typed solve consumes the
+        attributes directly, so this keeps decks and
+        createkeywordinputlines in sync)."""
+        for k, v in self.SSsolverkeywords.items():
+            self._record_keyword(k, v)

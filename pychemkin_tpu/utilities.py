@@ -124,3 +124,32 @@ def find_file(filename: str, search_paths: Sequence[str] = ()) -> str:
         if os.path.isfile(cand):
             return os.path.abspath(cand)
     return ""
+
+
+def where_element_in_array_1D(arr, target):
+    """Occurrence count and indices of ``target`` in a 1-D array
+    (reference: utilities.py:40). Vectorized instead of the
+    reference's Python loop."""
+    arr = np.asarray(arr)
+    if arr.size == 0:
+        return 0, []
+    idx = np.nonzero(arr == type(arr.flat[0])(target))[0].astype(np.int32)
+    if idx.size == 0:
+        return 0, []
+    return int(idx.size), idx
+
+
+_ck_rng = None
+
+
+def random(range=None):            # noqa: A002 — reference signature
+    """Random float in [0, 1) or [a, b) from a lazily seeded numpy
+    generator (reference: utilities.py:491)."""
+    global _ck_rng
+    if _ck_rng is None:
+        import secrets
+
+        _ck_rng = np.random.default_rng(secrets.randbits(128) - 54231)
+    if range is None:
+        return _ck_rng.random()
+    return range[0] + _ck_rng.random() * (range[1] - range[0])

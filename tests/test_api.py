@@ -324,3 +324,33 @@ def test_profiling_hooks(tmp_path):
         _ = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
     import os
     assert any(os.scandir(str(tmp_path / "trace")))
+
+
+def test_chemistry_surface_completions(tmp_path, monkeypatch):
+    """Round-5 parity sweep: EOS count, per-reaction A-factor getter,
+    transport preprocessing hint, summary file, and the registry
+    init-flag shims (reference chemistry.py:222-247, :440-463,
+    :1524, :1680)."""
+    import os
+
+    import pychemkin_tpu as ck
+    from pychemkin_tpu import chemistry as chem_mod
+    from pychemkin_tpu.mechanism import DATA_DIR
+
+    c = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+    c.preprocess()
+    assert c.EOS == 5                       # all five cubic models
+    A_all, _, _ = c.get_reaction_parameters()
+    assert c.get_reaction_AFactor(3) == A_all[2]
+    with pytest.raises(ValueError):
+        c.get_reaction_AFactor(0)
+    c.preprocess_transportdata()            # warns (no tran file), no raise
+
+    monkeypatch.chdir(tmp_path)
+    path = c.summaryfile
+    assert os.path.exists(path)
+    text = open(path).read()
+    assert "species (10)" in text and "gas reactions: " in text
+
+    chem_mod.chemistryset_new(c.chemID)
+    chem_mod.chemistryset_initialized(c.chemID)
