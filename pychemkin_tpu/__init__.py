@@ -37,7 +37,15 @@ if not _os.environ.get("PYCHEMKIN_NO_CACHE"):
         # pychemkin_tpu` — caching is an optimization, not a dependency
         pass
 
-from . import constants, info, mechanism, models, ops, parallel  # noqa: E402
+from . import (  # noqa: E402
+    constants,
+    info,
+    mechanism,
+    models,
+    ops,
+    parallel,
+    telemetry,
+)
 from .chemistry import (  # noqa: E402
     Chemistry,
     chemkin_version,
@@ -119,6 +127,7 @@ __all__ = [
     "ops",
     "parallel",
     "set_verbose",
+    "telemetry",
     "verbose",
     "water_heat_vaporization",
 ]

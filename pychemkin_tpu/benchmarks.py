@@ -23,9 +23,20 @@ Robustness contract, learned the hard way across rounds 1-3:
   does not expire promptly) → configs run SMALLEST-FIRST so a number is
   banked before any risky config, and the ladder STOPS at the first
   failure instead of retrying into a poisoned backend.
+- Round 5: the round-5 artifact landed as ``rc=124, parsed: null`` —
+  the summary was only printed at process exit, so the driver's kill
+  erased every completed rung → INCREMENTAL BANKING: a full summary
+  JSON line (marked ``"partial": true``) is printed and flushed after
+  EVERY completed rung, and atomically rewritten to ``BENCH_BANK_PATH``
+  when set, so a SIGKILL at any point still leaves the last completed
+  rung's numbers parseable; plus a GLOBAL wall-clock budget
+  (``BENCH_TOTAL_TIMEOUT``) that stops the ladder with enough time left
+  to land the final summary instead of being killed mid-rung.
 
-One JSON line is always printed to stdout; per-config diagnostics go to
-stderr so a failure is bisectable from the bench artifact alone.
+A summary JSON line is printed to stdout after every completed rung and
+once at the end; consumers take the LAST parseable line (exactly what
+``_run_child`` itself does). Per-config diagnostics go to stderr so a
+failure is bisectable from the bench artifact alone.
 
 Environment knobs:
   BENCH_LADDER      comma list of mech:B pairs (default
@@ -41,6 +52,15 @@ Environment knobs:
                     (default 5; 0 disables)
   BENCH_PROBE_TIMEOUT    backend-probe timeout in s (default 120)
   BENCH_CONFIG_TIMEOUT   per-config timeout in s (default 900)
+  BENCH_TOTAL_TIMEOUT    global wall-clock budget in s (default 0 =
+                         unlimited): no new rung starts unless it could
+                         finish inside the budget minus the banking
+                         reserve, so the artifact lands BEFORE any
+                         driver-side kill
+  BENCH_BANK_PATH        bank the running summary to this file
+                         (atomic tmp+rename rewrite after every rung);
+                         a sibling ``<path>.events.jsonl`` gets the
+                         crash-safe telemetry event stream
 """
 
 from __future__ import annotations
@@ -182,8 +202,13 @@ def _child_config(mech_name: str, B: int, repeats: int):
     n_ignited = int(np.sum(np.isfinite(times) & ok))
     f32_flops, f64_flops = _flop_model(mech, stats.n_steps,
                                        stats.n_rejected, stats.n_newton)
-    mfu = (f32_flops + f64_flops) / run_s / (
-        PEAK_FLOPS_PER_CHIP * n_chips)
+    # MFU is quoted against the accelerator peak; on the CPU fallback
+    # the ratio would be against the WRONG peak, so it is null there
+    # (the FLOP model itself is still emitted for both)
+    mfu = None
+    if platform != "cpu":
+        mfu = round(100.0 * (f32_flops + f64_flops) / run_s / (
+            PEAK_FLOPS_PER_CHIP * n_chips), 4)
     print(json.dumps(dict(
         platform=platform, n_chips=n_chips, mech=mech_name, B=B,
         chunk=min(chunk, B),
@@ -195,7 +220,7 @@ def _child_config(mech_name: str, B: int, repeats: int):
         steps_per_sec=round(stats.n_steps / run_s, 1),
         model_f32_gflop=round(f32_flops / 1e9, 2),
         model_f64_gflop=round(f64_flops / 1e9, 2),
-        mfu_pct=round(100.0 * mfu, 4))), flush=True)
+        mfu_pct=mfu)), flush=True)
 
 
 def _child_baseline(mech_name: str, n_points: int, budget_s: float):
@@ -318,22 +343,57 @@ def _probe_platform(timeout):
     return raw
 
 
-def _run_ladder(ladder, repeats, cfg_timeout, env=None):
+#: seconds held back from the global budget so banking, baselines, and
+#: the final summary land BEFORE the driver's kill
+_BUDGET_RESERVE_S = 30.0
+
+#: smallest budget window worth starting a rung in: less than this and
+#: the child would be killed inside XLA compile — spawning it wastes
+#: budget AND risks the very mid-kill tunnel poisoning the ladder
+#: protects against
+_MIN_RUNG_WINDOW_S = 60.0
+
+
+def _remaining(deadline):
+    return None if deadline is None else deadline - time.time()
+
+
+def _run_ladder(ladder, repeats, cfg_timeout, env=None, deadline=None,
+                on_result=None):
     """Run configs smallest-first, banking each result; stop at the
     first failure (a failed/killed TPU client can poison the tunnel for
     every later process — keep the bank rather than retry into it).
     A child that prints a result but exits nonzero counts as a failure
     for ladder-continuation purposes: its teardown crash is exactly the
-    kind of event that poisons the backend."""
+    kind of event that poisons the backend.
+
+    ``deadline`` (absolute ``time.time()``): a rung only starts with at
+    least a minimum viable window beyond the banking reserve; its
+    timeout is clamped to the remaining budget, and a clamped rung that
+    times out is reported as budget exhaustion (not a spurious rung
+    failure) — the ladder stops itself with time to spare instead of
+    being killed mid-rung. ``on_result(parsed)`` fires after every
+    banked rung (incremental summary banking)."""
     results = []
     err = None
     for mech_name, B in ladder:
         # every (mech, B) rung compiles its own XLA program shape, so
         # each gets the full budget — a per-mechanism "compile bonus"
         # would starve the largest (headline) configs
+        timeout = cfg_timeout
+        rem = _remaining(deadline)
+        budget_clamped = False
+        if rem is not None:
+            if rem <= _BUDGET_RESERVE_S + _MIN_RUNG_WINDOW_S:
+                err = (f"total budget exhausted before config "
+                       f"{mech_name}:B={B} ({rem:.0f}s left)")
+                print(f"# stopping ladder: {err}", file=sys.stderr)
+                break
+            timeout = min(cfg_timeout, rem - _BUDGET_RESERVE_S)
+            budget_clamped = timeout < cfg_timeout
         t0 = time.time()
         rc, parsed, tail = _run_child(
-            ["config", mech_name, str(B), str(repeats)], cfg_timeout,
+            ["config", mech_name, str(B), str(repeats)], timeout,
             env=env)
         status = ("ok" if parsed is not None and rc == 0 else
                   "timeout" if rc == -2 else f"rc={rc}")
@@ -343,12 +403,17 @@ def _run_ladder(ladder, repeats, cfg_timeout, env=None):
                  else ""), file=sys.stderr)
         if parsed is not None:
             results.append(parsed)
+            if on_result is not None:
+                on_result(parsed)
         if parsed is None or rc != 0:
             if tail:
                 print("#   " + tail.replace("\n", "\n#   "),
                       file=sys.stderr)
             err = (f"config {mech_name}:B={B} "
-                   + ("timed out" if rc == -2 else f"failed rc={rc}")
+                   + ("timed out (total budget exhausted)"
+                      if rc == -2 and budget_clamped
+                      else "timed out" if rc == -2
+                      else f"failed rc={rc}")
                    + (f": {tail[-300:]}" if tail else ""))
             print("# stopping ladder (failure may poison backend)",
                   file=sys.stderr)
@@ -368,103 +433,19 @@ def main():
             "error": f"bench orchestrator: {type(e).__name__}: {e}"}))
 
 
-def _main_guarded():
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
-    cfg_timeout = float(os.environ.get("BENCH_CONFIG_TIMEOUT", 900))
-    repeats = int(os.environ.get("BENCH_REPEATS", 1))
-    ladder = [
-        (p.split(":")[0], int(p.split(":")[1]))
-        for p in os.environ.get("BENCH_LADDER", _DEFAULT_LADDER).split(",")
-        if p.strip()]
-
-    platform = _probe_platform(probe_timeout)
-    on_accel = platform is not None and platform != "cpu"
-    print(f"# bench: probed platform={platform or 'none'}",
-          file=sys.stderr)
-
-    accel_err = None
-    if on_accel:
-        results, accel_err = _run_ladder(ladder, repeats, cfg_timeout)
-    else:
-        # no accelerator: run the ladder on CPU in clean processes (no
-        # tunnel dial), capped at B<=1024 per rung — the 4096 rungs
-        # exist to show TPU batch scaling and would only burn the
-        # fallback's wall clock; each rung still has its own timeout
-        accel_err = f"no usable accelerator (probe={platform!r})"
-        cpu_ladder = [(m, B) for m, B in ladder if B <= 1024]
-        if not cpu_ladder:
-            # never let the cap empty the ladder: clamp instead
-            cpu_ladder = [(m, min(B, 1024)) for m, B in ladder]
-            print("# CPU fallback: all rungs exceeded B=1024; clamped",
-                  file=sys.stderr)
-        elif len(cpu_ladder) < len(ladder):
-            print(f"# CPU fallback: dropped {len(ladder)-len(cpu_ladder)}"
-                  " rung(s) with B>1024", file=sys.stderr)
-        results, cpu_err = _run_ladder(cpu_ladder, repeats, cfg_timeout,
-                                       env=_cpu_env())
-        if cpu_err:
-            accel_err += "; " + cpu_err
-    is_fallback = not on_accel
-    if on_accel and not results:
-        # accelerator completely failed: bank a small clean CPU number
-        is_fallback = True
-        results, cpu_err = _run_ladder(ladder[:1], repeats, cfg_timeout,
-                                       env=_cpu_env())
-        if cpu_err:
-            accel_err += "; cpu fallback: " + cpu_err
-    if not results:
-        print(json.dumps({
-            "metric": "0-D ignitions/sec/chip",
-            "value": 0.0, "unit": "ignitions/sec/chip",
-            "vs_baseline": 0.0, "error": accel_err}))
-        return
-
+def _build_summary(results, baselines, *, is_fallback, accel_err,
+                   host_cpu=None, partial=False):
+    """The one summary-JSON shape, built from whatever has completed so
+    far — the same function serves the per-rung partial banking lines
+    and the final summary, so a killed run's last banked line is
+    structurally identical to a finished run's."""
     best = max(results, key=lambda r: r["throughput"])
-
-    # serial single-core baselines, one per mechanism that ran, in
-    # CPU-only subprocesses (immune to a poisoned accelerator client)
-    n_base = int(os.environ.get("BENCH_BASELINE_N", 5))
-    baselines = {}
-    if n_base > 0:
-        for mech_name in dict.fromkeys(r["mech"] for r in results):
-            rc, parsed, tail = _run_child(
-                ["baseline", mech_name, str(n_base), "300"], 460,
-                env=_cpu_env())
-            if parsed and parsed.get("ignitions_per_sec"):
-                baselines[mech_name] = {
-                    "ignitions_per_sec": round(
-                        parsed["ignitions_per_sec"], 4),
-                    "n_points": parsed["n_points"]}
-                print(f"# serial baseline {mech_name}: "
-                      f"{parsed['n_points']} pts, "
-                      f"{parsed['s_per_ignition']:.2f} s/ignition",
-                      file=sys.stderr)
-            elif tail:
-                print(f"# baseline {mech_name} failed:\n#   "
-                      + tail.replace("\n", "\n#   "), file=sys.stderr)
     if best["mech"] in baselines:
         baseline_ips = baselines[best["mech"]]["ignitions_per_sec"]
         baseline_kind = "measured scipy-BDF single-core, same mech/tols"
     else:
         baseline_ips = FALLBACK_REFERENCE_IGNITIONS_PER_SEC
         baseline_kind = "estimated"
-
-    # same-(mech,B) host-CPU comparison for the headline config: the
-    # honest TPU-vs-this-host number (the sweep code itself, not scipy)
-    host_cpu = None
-    if on_accel and os.environ.get("BENCH_CPU_COMPARE", "1") != "0":
-        rc, parsed, tail = _run_child(
-            ["config", best["mech"], str(best["B"]), "1"], cfg_timeout,
-            env=_cpu_env())
-        if parsed:
-            host_cpu = {k: parsed[k] for k in (
-                "throughput", "compile_s", "run_s")}
-            print(f"# host-CPU same config: "
-                  f"{parsed['throughput']:.2f}/s", file=sys.stderr)
-        elif tail:
-            print("# host-CPU compare failed:\n#   "
-                  + tail.replace("\n", "\n#   "), file=sys.stderr)
-
     out = {
         "metric": f"0-D ignitions/sec/chip ({best['mech']}, CONP/ENRG, "
                   f"rtol {best['rtol']:g}/atol {best['atol']:g})",
@@ -491,6 +472,8 @@ def _main_guarded():
                                    "n_rejected", "n_newton", "platform")}
             for r in results],
     }
+    if partial:
+        out["partial"] = True
     if host_cpu is not None:
         out["host_cpu_same_config"] = host_cpu
         out["vs_host_cpu"] = round(
@@ -499,6 +482,156 @@ def _main_guarded():
         out["fallback"] = True
     if accel_err:
         out["error"] = accel_err
+    return out
+
+
+def _main_guarded():
+    from . import telemetry
+
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    cfg_timeout = float(os.environ.get("BENCH_CONFIG_TIMEOUT", 900))
+    repeats = int(os.environ.get("BENCH_REPEATS", 1))
+    total_budget = float(os.environ.get("BENCH_TOTAL_TIMEOUT", 0))
+    deadline = time.time() + total_budget if total_budget > 0 else None
+    bank_path = os.environ.get("BENCH_BANK_PATH") or None
+    # crash-safe event stream alongside the banked summary (detached
+    # when banking is off, so repeated in-process runs don't leak a
+    # sink into an already-deleted directory)
+    telemetry.configure((bank_path + ".events.jsonl") if bank_path
+                        else None)
+    ladder = [
+        (p.split(":")[0], int(p.split(":")[1]))
+        for p in os.environ.get("BENCH_LADDER", _DEFAULT_LADDER).split(",")
+        if p.strip()]
+
+    platform = _probe_platform(probe_timeout)
+    on_accel = platform is not None and platform != "cpu"
+    print(f"# bench: probed platform={platform or 'none'}",
+          file=sys.stderr)
+    telemetry.record_event("bench_start", platform=platform,
+                           ladder=[f"{m}:{B}" for m, B in ladder],
+                           total_budget_s=total_budget or None)
+
+    # incremental banking: after EVERY completed rung, print one full
+    # (partial-marked) summary line and atomically rewrite the bank
+    # file, so a kill at any later moment still leaves this rung's
+    # numbers parseable (the round-5 rc=124 lesson)
+    banked: list = []
+    fallback_flag = [not on_accel]
+
+    def _bank(parsed):
+        banked.append(parsed)
+        telemetry.record_event("bench_config", **parsed)
+        summary = _build_summary(
+            banked, {}, is_fallback=fallback_flag[0], accel_err=None,
+            partial=True)
+        print(json.dumps(summary), flush=True)
+        if bank_path:
+            telemetry.atomic_write_json(bank_path, summary)
+
+    accel_err = None
+    if on_accel:
+        results, accel_err = _run_ladder(ladder, repeats, cfg_timeout,
+                                         deadline=deadline,
+                                         on_result=_bank)
+    else:
+        # no accelerator: run the ladder on CPU in clean processes (no
+        # tunnel dial), capped at B<=1024 per rung — the 4096 rungs
+        # exist to show TPU batch scaling and would only burn the
+        # fallback's wall clock; each rung still has its own timeout
+        accel_err = f"no usable accelerator (probe={platform!r})"
+        cpu_ladder = [(m, B) for m, B in ladder if B <= 1024]
+        if not cpu_ladder:
+            # never let the cap empty the ladder: clamp instead
+            cpu_ladder = [(m, min(B, 1024)) for m, B in ladder]
+            print("# CPU fallback: all rungs exceeded B=1024; clamped",
+                  file=sys.stderr)
+        elif len(cpu_ladder) < len(ladder):
+            print(f"# CPU fallback: dropped {len(ladder)-len(cpu_ladder)}"
+                  " rung(s) with B>1024", file=sys.stderr)
+        results, cpu_err = _run_ladder(cpu_ladder, repeats, cfg_timeout,
+                                       env=_cpu_env(), deadline=deadline,
+                                       on_result=_bank)
+        if cpu_err:
+            accel_err += "; " + cpu_err
+    is_fallback = not on_accel
+    if on_accel and not results:
+        # accelerator completely failed: bank a small clean CPU number
+        is_fallback = True
+        fallback_flag[0] = True
+        results, cpu_err = _run_ladder(ladder[:1], repeats, cfg_timeout,
+                                       env=_cpu_env(), deadline=deadline,
+                                       on_result=_bank)
+        if cpu_err:
+            accel_err += "; cpu fallback: " + cpu_err
+    if not results:
+        out = {
+            "metric": "0-D ignitions/sec/chip",
+            "value": 0.0, "unit": "ignitions/sec/chip",
+            "vs_baseline": 0.0, "configs_run": [], "error": accel_err}
+        telemetry.record_event("bench_summary", **out)
+        if bank_path:
+            telemetry.atomic_write_json(bank_path, out)
+        print(json.dumps(out))
+        return
+
+    best = max(results, key=lambda r: r["throughput"])
+
+    # serial single-core baselines, one per mechanism that ran, in
+    # CPU-only subprocesses (immune to a poisoned accelerator client);
+    # skipped when the global budget has no room left for them
+    n_base = int(os.environ.get("BENCH_BASELINE_N", 5))
+    baselines = {}
+    if n_base > 0:
+        for mech_name in dict.fromkeys(r["mech"] for r in results):
+            rem = _remaining(deadline)
+            if rem is not None and rem <= _BUDGET_RESERVE_S:
+                print("# skipping remaining baselines (budget)",
+                      file=sys.stderr)
+                break
+            timeout = 460 if rem is None else min(
+                460, rem - _BUDGET_RESERVE_S / 2)
+            rc, parsed, tail = _run_child(
+                ["baseline", mech_name, str(n_base),
+                 str(min(300, timeout))], timeout, env=_cpu_env())
+            if parsed and parsed.get("ignitions_per_sec"):
+                baselines[mech_name] = {
+                    "ignitions_per_sec": round(
+                        parsed["ignitions_per_sec"], 4),
+                    "n_points": parsed["n_points"]}
+                print(f"# serial baseline {mech_name}: "
+                      f"{parsed['n_points']} pts, "
+                      f"{parsed['s_per_ignition']:.2f} s/ignition",
+                      file=sys.stderr)
+            elif tail:
+                print(f"# baseline {mech_name} failed:\n#   "
+                      + tail.replace("\n", "\n#   "), file=sys.stderr)
+
+    # same-(mech,B) host-CPU comparison for the headline config: the
+    # honest TPU-vs-this-host number (the sweep code itself, not scipy)
+    host_cpu = None
+    rem = _remaining(deadline)
+    if on_accel and os.environ.get("BENCH_CPU_COMPARE", "1") != "0" \
+            and (rem is None or rem > _BUDGET_RESERVE_S):
+        rc, parsed, tail = _run_child(
+            ["config", best["mech"], str(best["B"]), "1"],
+            cfg_timeout if rem is None else min(
+                cfg_timeout, rem - _BUDGET_RESERVE_S / 2),
+            env=_cpu_env())
+        if parsed:
+            host_cpu = {k: parsed[k] for k in (
+                "throughput", "compile_s", "run_s")}
+            print(f"# host-CPU same config: "
+                  f"{parsed['throughput']:.2f}/s", file=sys.stderr)
+        elif tail:
+            print("# host-CPU compare failed:\n#   "
+                  + tail.replace("\n", "\n#   "), file=sys.stderr)
+
+    out = _build_summary(results, baselines, is_fallback=is_fallback,
+                         accel_err=accel_err, host_cpu=host_cpu)
+    telemetry.record_event("bench_summary", **out)
+    if bank_path:
+        telemetry.atomic_write_json(bank_path, out)
     print(json.dumps(out))
 
 

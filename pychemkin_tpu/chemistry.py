@@ -442,20 +442,28 @@ class Chemistry:
     def summaryfile(self) -> str:
         """Path of the preprocessing summary file
         (reference: chemistry.py:440 returns the native preprocessor's
-        Summary.out; here the summary is written on first access)."""
+        Summary.out; here the summary is written on access).
+
+        Regenerated UNCONDITIONALLY via tmp+rename: chemIDs restart
+        from 0 in every process, so a ``Summary_<chemID>.out`` left in
+        the cwd by an earlier run may describe a DIFFERENT mechanism —
+        returning it verbatim (the old behavior) served stale data. The
+        atomic rename also means a concurrent reader never sees a
+        half-written file."""
         mech = self._require_mech()
         path = os.path.abspath(f"Summary_{self.chemID}.out")
-        if not os.path.exists(path):
-            with open(path, "w") as f:
-                f.write("pychemkin_tpu preprocessing summary\n")
-                f.write(f"mechanism: {self._chem_file}\n")
-                f.write(f"elements ({mech.n_elements}): "
-                        + " ".join(mech.element_names) + "\n")
-                f.write(f"species ({mech.n_species}): "
-                        + " ".join(mech.species_names) + "\n")
-                f.write(f"gas reactions: {mech.n_reactions}\n")
-                f.write("transport data: "
-                        + ("yes" if mech.has_transport else "no") + "\n")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write("pychemkin_tpu preprocessing summary\n")
+            f.write(f"mechanism: {self._chem_file}\n")
+            f.write(f"elements ({mech.n_elements}): "
+                    + " ".join(mech.element_names) + "\n")
+            f.write(f"species ({mech.n_species}): "
+                    + " ".join(mech.species_names) + "\n")
+            f.write(f"gas reactions: {mech.n_reactions}\n")
+            f.write("transport data: "
+                    + ("yes" if mech.has_transport else "no") + "\n")
+        os.replace(tmp, path)
         return path
 
     def set_critical_properties(self, species: str, Tc: float, Pc: float,

@@ -792,6 +792,18 @@ class MechanismParser:
                     raise MechanismError(
                         "FORD on a reversible reaction needs explicit "
                         f"REV (or RORD) parameters: {rx.equation!r}")
+                if rx.reversible and rx.rev is None:
+                    # remaining combos (RORD-only, FORD+RORD) still
+                    # compute kr = kf/Kc, which assumes MASS-ACTION
+                    # stoichiometric orders: with overridden orders the
+                    # forward/reverse pair no longer satisfies detailed
+                    # balance at equilibrium — thermodynamically
+                    # inconsistent unless REV is given explicitly
+                    logger.warning(
+                        "FORD/RORD on reversible reaction %r without "
+                        "explicit REV: equilibrium-derived reverse "
+                        "rates are inconsistent with order overrides "
+                        "(detailed balance is broken)", rx.equation)
                 for k, v in rx.ford.items():
                     ford_overrides.append((i, k, v))
                 for k, v in rx.rord.items():

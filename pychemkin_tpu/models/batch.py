@@ -22,6 +22,7 @@ reference's sec -> msec conversion (batchreactor.py:613).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -332,14 +333,24 @@ class BatchReactors(ReactorModel):
         if self._save_dt is not None:
             n_out = max(int(round(self._time / self._save_dt)) + 1, 2)
         kwargs = self._build_solve_kwargs(n_out)
+        t0 = time.perf_counter()
         sol = reactor_ops.solve_batch(
             T0=cond.temperature, P0=cond.pressure, Y0=cond.Y,
             t_end=self._time, **kwargs)
         self._solution = jax.device_get(sol)
+        wall_s = time.perf_counter() - t0
         ign_s = float(self._solution.ignition_time)
         self._ignition_delay_ms = ign_s * 1.0e3
         ok = bool(self._solution.success)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        self._record_solve(
+            wall_s=round(wall_s, 6), success=ok,
+            n_steps=int(self._solution.n_steps),
+            n_rejected=int(self._solution.n_rejected),
+            n_newton=int(self._solution.n_newton),
+            ignition_delay_ms=(ign_s * 1e3 if np.isfinite(ign_s)
+                               else None),
+            t_end=self._time)
         if not ok:
             logger.error("batch-reactor integration failed (stalled or "
                          "step budget exhausted)")

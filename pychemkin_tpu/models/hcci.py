@@ -183,11 +183,14 @@ class HCCIengine(Engine):
 
     def run(self) -> int:
         """Integrate IVC -> EVO (reference HCCI.py:1241)."""
+        import time as _time
+
         self.consume_protected_keywords()
         zone_T, vol, zone_Y = self._zone_initials()
         geo = self._geometry()
         ht = self._heat_transfer()
         rtol, atol = self.tolerances
+        t0 = _time.perf_counter()
         sol = engine_ops.solve_hcci(
             self._effective_mech(), geo,
             T0=self.reactor_condition.temperature,
@@ -205,6 +208,10 @@ class HCCIengine(Engine):
         self._engine_solution = sol
         ok = bool(sol.success)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        self._record_solve(
+            wall_s=round(_time.perf_counter() - t0, 6), success=ok,
+            n_steps=int(sol.n_steps), n_zones=self._nzones,
+            start_CA=self.IVCCA, end_CA=self.EVOCA)
         return 0 if ok else 1
 
     def get_ignition_CA(self) -> float:

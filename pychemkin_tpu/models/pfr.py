@@ -15,6 +15,7 @@ ignition "delay" is reported as a distance in cm
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -173,6 +174,7 @@ class PlugFlowReactor(BatchReactors):
         n_out = 101
         if self._save_dt is not None:
             n_out = max(int(round(self._length / self._save_dt)) + 1, 2)
+        t0 = time.perf_counter()
         sol = pfr_ops.solve_pfr(
             self._effective_mech(), self.energy_type,
             mdot=self._mdot, T0=cond.temperature, P0=cond.pressure,
@@ -190,6 +192,10 @@ class PlugFlowReactor(BatchReactors):
         self._ignition_delay_ms = float(sol.ignition_distance)
         ok = bool(sol.success)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        self._record_solve(
+            wall_s=round(time.perf_counter() - t0, 6), success=ok,
+            n_steps=int(self._pfr_solution.n_steps),
+            length=self._length, energy=self.energy_type)
         if not ok:
             logger.error("PFR integration failed")
         return self.runstatus

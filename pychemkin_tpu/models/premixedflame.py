@@ -137,6 +137,10 @@ class PremixedFlame(Flame):
         sol = self._solve(energy, self._free_flame)
         self._solution = sol
         self._raw_ok = False
+        self._record_solve(success=bool(sol.converged),
+                           flame_speed=(float(sol.flame_speed)
+                                        if sol.converged else None),
+                           **(sol.report or {}))
         if sol.converged:
             self.runstatus = STATUS_SUCCESS
             self._numbsolutionpoints = sol.n_points

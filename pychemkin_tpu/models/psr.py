@@ -25,6 +25,7 @@ residence-time S-curve as one vmapped solve.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -293,6 +294,7 @@ class perfectlystirredreactor(openreactor):
             self.runstatus = STATUS_FAILED
             return self.runstatus
         T_g, Y_g = self._guess()
+        t0 = time.perf_counter()
         sol = psr_ops.solve_psr(
             tau=self._tau, volume=self._volume,
             T_guess=jnp.asarray(T_g), Y_guess=jnp.asarray(Y_g),
@@ -300,6 +302,17 @@ class perfectlystirredreactor(openreactor):
         self._solution = jax.device_get(sol)
         ok = bool(self._solution.converged)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        self._record_solve(
+            wall_s=round(time.perf_counter() - t0, 6), success=ok,
+            n_newton=int(self._solution.n_newton),
+            n_newton_direct=(int(self._solution.n_newton_direct)
+                             if self._solution.n_newton_direct is not None
+                             else None),
+            n_newton_polish=(int(self._solution.n_newton_polish)
+                             if self._solution.n_newton_polish is not None
+                             else None),
+            residual=float(self._solution.residual),
+            energy=self.energy_type, mode=self.mode)
         if not ok:
             logger.error("PSR steady-state solve did not converge "
                          "(residual %.2e)", float(self._solution.residual))

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from .. import telemetry
 from ..logger import logger
 from ..mixture import Mixture
 
@@ -231,6 +232,9 @@ class ReactorModel:
         self._sensitivity_opts: Dict[str, float] = {}
         self._rop_analysis = False
         self._rop_threshold = 0.0
+        # per-solve telemetry filled by concrete run() implementations
+        # (see solve_report)
+        self._solve_report: Dict = {}
         # raw solution store (reference: reactormodel.py:775-788)
         self._solution_tags = ["time", "distance", "temperature", "pressure",
                                "volume", "velocity", "flowrate"]
@@ -693,6 +697,32 @@ class ReactorModel:
         """Template method; concrete reactors override
         (reference: reactormodel.py:1792)."""
         raise NotImplementedError
+
+    # --- per-solve telemetry ------------------------------------------------
+    def solve_report(self) -> Dict:
+        """Per-solve counters of the LAST run(): wall_s, solver work
+        (n_steps / n_rejected / n_newton as applicable), success, plus
+        model-specific fields. Empty dict before any run. The same dict
+        is emitted as a ``solve`` telemetry event and logged through
+        :data:`ChemkinLogger` at INFO when the run records it."""
+        return dict(self._solve_report)
+
+    def _record_solve(self, **fields) -> Dict:
+        """Store + emit this run's telemetry (concrete ``run()``s call
+        this once per solve)."""
+        report: Dict = {"model": type(self).__name__, "label": self.label}
+        report.update(fields)
+        self._solve_report = report
+        rec = telemetry.get_recorder()
+        rec.event("solve", **report)
+        rec.inc("model.solves")
+        if not report.get("success", True):
+            rec.inc("model.failed_solves")
+        logger.info(
+            "solve_report %s(%s): %s", type(self).__name__, self.label,
+            " ".join(f"{k}={v}" for k, v in report.items()
+                     if k not in ("model", "label")))
+        return report
 
     # --- solution plumbing (reference: reactormodel.py:1816-1919) ----------
     def get_solution_variable_profile(self, varname: str) -> np.ndarray:

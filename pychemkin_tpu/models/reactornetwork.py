@@ -440,10 +440,22 @@ class ReactorNetwork:
         taus = [self.reactor_objects[i].residence_time for i in chain]
         qloss = [self.reactor_objects[i].heat_loss_rate for i in chain]
         T_g, Y_g = [], []
-        for i in chain:
+        for pos, i in enumerate(chain):
             r = self.reactor_objects[i]
             if r._estimate_T is None:
                 r.set_estimate_conditions()    # equilibrium estimate
+            if r._estimate_T is None and pos > 0:
+                # downstream reactors have no external inlet to
+                # equilibrate from; their construction mixture can sit
+                # far enough off the ignited branch that the coupled
+                # damped Newton rides its per-iteration trust caps into
+                # the wrong basin. Warm-start from the HEAD's
+                # equilibrium estimate — every reactor of an ignited
+                # chain lies near that state. An explicitly-set user
+                # composition estimate is kept.
+                r.reset_estimate_temperature(T_g[0])
+                if r._estimate_Y is None:
+                    r._estimate_Y = np.asarray(Y_g[0])
             tg, yg = r._guess()
             T_g.append(tg)
             Y_g.append(yg)

@@ -234,12 +234,15 @@ class SIengine(Engine):
 
     def run(self) -> int:
         """Integrate IVC -> EVO (reference SI.py run path)."""
+        import time as _time
+
         self.consume_protected_keywords()
         geo = self._geometry()
         ht = self._heat_transfer()
         wiebe = self._wiebe_tuple()
         Yp = self._burned_products_Y()
         rtol, atol = self.tolerances
+        t0 = _time.perf_counter()
         sol = engine_ops.solve_si(
             self._effective_mech(), geo,
             T0=self.reactor_condition.temperature,
@@ -252,6 +255,10 @@ class SIengine(Engine):
         self._engine_solution = sol
         ok = bool(sol.success)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
+        self._record_solve(
+            wall_s=round(_time.perf_counter() - t0, 6), success=ok,
+            n_steps=int(sol.n_steps),
+            start_CA=self.IVCCA, end_CA=self.EVOCA)
         return 0 if ok else 1
 
     def get_mass_burned_fraction(self) -> np.ndarray:

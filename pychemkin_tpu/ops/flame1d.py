@@ -46,12 +46,14 @@ reference flame.py:134.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from . import blocktridiag, kinetics, thermo, transport
 from . import equilibrium as eq_ops
 
@@ -456,6 +458,9 @@ class _Programs:
             timestep_j = jax.jit(timestep, static_argnames=("n_steps",))
             progs = (newton_j, timestep_j)
             cls._cache[key] = progs
+            # counted so solve_flame can report how much of its wall
+            # time was compile tax (one program pair per grid size)
+            telemetry.get_recorder().inc("flame.programs_built")
         return progs
 
 
@@ -471,6 +476,8 @@ class FlameSolution(NamedTuple):
     n_regrids: int
     n_newton: Any
     u: Any = None    # packed state [N, M] for CNTN continuation restarts
+    report: Any = None   # per-solve telemetry dict (stage wall times,
+    #                      programs compiled, counters) — see solve_flame
 
 
 def initial_profile(mech, x, P, T_in, Y_in, xcen, wmix, *,
@@ -563,23 +570,38 @@ def _pin_index(x, T_prof, T_fix):
 
 
 def _march(newton_j, timestep_j, u, data, *, dt0, ts_steps, max_rounds,
-           verbose=False):
+           verbose=False, timers=None, prefix=""):
     """Newton with pseudo-transient rescue rounds; returns
-    (u, converged, total_newton, dt_last)."""
+    (u, converged, total_newton, dt_last).
+
+    ``timers``: optional dict accumulating device-fenced wall time into
+    ``<prefix>newton_s`` / ``<prefix>transient_s`` (the int()/bool()
+    conversions below block on the device result, so the sections
+    charge real device time, not dispatch time)."""
+    def _charge(name, t0):
+        if timers is not None:
+            key = prefix + name
+            timers[key] = timers.get(key, 0.0) + (
+                time.perf_counter() - t0)
+
     total_newton = 0
     dt = dt0
     for round_i in range(max_rounds):
+        t0 = time.perf_counter()
         u_new, ok_j, n_it, last_norm = newton_j(u, data)
         total_newton += int(n_it)
+        _charge("newton_s", t0)
         if verbose:
             print(f"  [flame] newton round {round_i}: ok={bool(ok_j)} "
                   f"its={int(n_it)} norm={float(last_norm):.3e} "
                   f"Tmax={float(jnp.max(u_new[:, 0])):.0f}")
         if bool(ok_j):
             return u_new, True, total_newton, dt
+        t0 = time.perf_counter()
         u, n_ok = timestep_j(u, data, dt, n_steps=ts_steps)
         u = jnp.asarray(jax.device_get(u))
         n_ok = int(n_ok)
+        _charge("transient_s", t0)
         if verbose:
             print(f"  [flame] transient round {round_i}: dt={dt:.2e} "
                   f"ok {n_ok}/{ts_steps} Tmax={float(jnp.max(u[:, 0])):.0f}"
@@ -591,8 +613,10 @@ def _march(newton_j, timestep_j, u, data, *, dt0, ts_steps, max_rounds,
             dt = min(dt * 5.0, 1e-3)
         elif n_ok <= int(0.2 * ts_steps):
             dt = max(dt * 0.2, 1e-9)
+    t0 = time.perf_counter()
     u_new, ok_j, n_it, last_norm = newton_j(u, data)
     total_newton += int(n_it)
+    _charge("newton_s", t0)
     if verbose:
         print(f"  [flame] final newton: ok={bool(ok_j)} "
               f"norm={float(last_norm):.3e}")
@@ -706,6 +730,10 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
                      else jnp.zeros(N)))
 
     total_newton = 0
+    recorder = telemetry.get_recorder()
+    timers: dict = {}
+    t_solve0 = time.perf_counter()
+    programs0 = recorder.counters.get("flame.programs_built", 0)
 
     # --- Stage A: fixed-temperature burner solve on the initial ramp
     # (reference default; NOFT / skip_fix_T_solution turns it off)
@@ -715,7 +743,8 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         data_ft = make_data(x, i_fix, np.asarray(u[:, 0]))
         u_ft, ok, n_it, _ = _march(newton_ft, timestep_ft, u, data_ft,
                                    dt0=ts_dt, ts_steps=ts_steps,
-                                   max_rounds=2, verbose=verbose)
+                                   max_rounds=2, verbose=verbose,
+                                   timers=timers, prefix="fixT_")
         total_newton += n_it
         if ok:
             u = u_ft      # species relaxed on the frozen ramp
@@ -735,7 +764,7 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         u, ok, n_it, ts_dt = _march(newton_j, timestep_j, u, data,
                                     dt0=ts_dt, ts_steps=ts_steps,
                                     max_rounds=max_ts_rounds,
-                                    verbose=verbose)
+                                    verbose=verbose, timers=timers)
         total_newton += n_it
         if not ok:
             converged = False
@@ -757,10 +786,25 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
     T_out, M_out, Y_out = unpack(u)
     mdot_out = float(M_out[0]) if free_flame else mdot_in
     su = mdot_out / rho_u if converged else float("nan")
+
+    report = {
+        "wall_s": round(time.perf_counter() - t_solve0, 6),
+        "n_newton": int(total_newton),
+        "n_regrids": int(n_regrids),
+        "n_points": int(x.shape[0]),
+        "programs_built": recorder.counters.get(
+            "flame.programs_built", 0) - programs0,
+        "converged": bool(converged),
+    }
+    report.update({k: round(v, 6) for k, v in sorted(timers.items())})
+    recorder.event("flame", energy=energy, free_flame=bool(free_flame),
+                   **report)
+    recorder.inc("flame.solves")
+
     return FlameSolution(
         x=np.asarray(x), T=np.asarray(T_out),
         Y=np.clip(np.asarray(Y_out), 0.0, 1.0), mdot=mdot_out,
         flame_speed=su,
         converged=converged, n_points=int(x.shape[0]),
         n_regrids=n_regrids, n_newton=total_newton,
-        u=np.asarray(u))
+        u=np.asarray(u), report=report)

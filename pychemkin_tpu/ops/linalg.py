@@ -28,6 +28,21 @@ build. The TPU-first answer has two parts:
    passes ``refine=0``; equilibrium / steady-state solves that converge
    to 1e-9 keep the default two refinement sweeps.
 
+3. **Post-solve residual check + pivoted fallback**: the pivot-free
+   factorization is provably safe only for the M = I - c*J matrices
+   whose failed factorizations self-heal through the step controller;
+   it ALSO serves general Newton Jacobians (equilibrium, the coupled
+   PSR-chain system, bordered Stefan-Maxwell), where a bad pivot-free
+   factor would degrade results silently. So every refined solve ends
+   with a cheap O(N^2) residual check — ``norm(b - A x)`` vs
+   ``norm(b)`` — and falls back to XLA's pivoted f32 LU (slow but
+   growth-stable) when refinement stagnated. Both outcomes are counted
+   on the telemetry recorder (``linalg.refine_stagnated`` /
+   ``linalg.pivot_fallback``), bridged from device via
+   ``telemetry.device_increment``. Newton-direction solves
+   (``refine=0``) skip the check: their accuracy is policed by the
+   Newton convergence test itself.
+
 On CPU (unit tests, debugging) the exact f64 scipy factorization is
 used. The choice is made at trace time from ``jax.default_backend()`` —
 a static Python-level switch, so each platform gets a clean compiled
@@ -42,12 +57,19 @@ import jax
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 
+from .. import telemetry
+
 #: default number of iterative-refinement sweeps on the mixed-precision
 #: path when the caller does not say (conservative: full f64 recovery)
 _REFINE_STEPS = 2
 
 #: diagonal clamp for the pivot-free factorization
 _DIAG_EPS = 1e-30
+
+#: relative residual above which post-refinement is declared stagnated
+#: (an f32 factor + 2 f64 refinement sweeps on a healthy system lands
+#: many decades below this; a growth-destroyed factor cannot reach it)
+_FALLBACK_RTOL = 1e-6
 
 
 def use_mixed_precision() -> bool:
@@ -119,22 +141,66 @@ def _solve_nopivot(lu, b):
     return x
 
 
-def factor(A) -> Factorization:
-    """LU-factor A for later :func:`solve_factored` calls."""
-    if use_mixed_precision():
+def factor(A, mixed: bool | None = None) -> Factorization:
+    """LU-factor A for later :func:`solve_factored` calls.
+
+    ``mixed`` forces the pivot-free f32 path on (True) or off (False)
+    regardless of platform — the hook CI uses to exercise the TPU path
+    on CPU; default None keeps the platform switch."""
+    if use_mixed_precision() if mixed is None else mixed:
         return Factorization(lu=_lu_nopivot(A.astype(jnp.float32)),
                              piv=None, A=A)
     lu, piv = jsl.lu_factor(A)
     return Factorization(lu=lu, piv=piv, A=None)
 
 
-def solve_factored(fac: Factorization, b, refine: int | None = None):
+def _matvec(A, x):
+    """A x for matrix RHS (``x.ndim == A.ndim``) and batched/unbatched
+    vector RHS alike (plain ``@`` rejects [B, N, N] @ [B, N])."""
+    if x.ndim == A.ndim:
+        return A @ x
+    return jnp.einsum("...ij,...j->...i", A, x)
+
+
+def _pivoted_resolve(A, b, n_ref):
+    """Growth-stable fallback: XLA's pivoted f32 LU + the same f64
+    refinement sweeps. Sequential/gather-heavy on TPU — only reached
+    when the vectorized pivot-free factor demonstrably failed."""
+    lu32, piv = jsl.lu_factor(A.astype(jnp.float32))
+    vec = b.ndim == A.ndim - 1
+
+    def ptri(bb):
+        bb32 = bb.astype(jnp.float32)
+        if vec:
+            return jsl.lu_solve((lu32, piv),
+                                bb32[..., None])[..., 0].astype(b.dtype)
+        return jsl.lu_solve((lu32, piv), bb32).astype(b.dtype)
+
+    x = ptri(b)
+    for _ in range(n_ref):
+        x = x + ptri(b - _matvec(A, x))
+    return x
+
+
+def solve_factored(fac: Factorization, b, refine: int | None = None,
+                   residual_check: bool = False):
     """Solve A x = b from a :func:`factor` result.
 
     ``refine``: number of f64 iterative-refinement sweeps on the
     mixed-precision path (default ``_REFINE_STEPS``); pass 0 for Newton
     directions, where f32 solve accuracy is already far below the
-    Newton tolerance."""
+    Newton tolerance.
+
+    ``residual_check``: verify ``norm(b - A x) <= 1e-6 * norm(b)``
+    PER SYSTEM after refinement and fall back to the pivoted LU for the
+    systems that stagnated. OFF by default here: factored-reuse call
+    sites live inside scan/vmap hot loops (the flame block-Thomas
+    sweep, stage-Newton directions) where the embedded ``lax.cond``
+    lowers to select under vmap — the pivoted branch would then execute
+    unconditionally — and the telemetry callbacks cost a host round
+    trip per element. One-shot :func:`solve` — the entry the general
+    Newton Jacobians (equilibrium, PSR chains, Stefan-Maxwell) use —
+    checks by default instead."""
     if fac.A is None:
         return jsl.lu_solve((fac.lu, fac.piv), b)
     n_ref = _REFINE_STEPS if refine is None else refine
@@ -149,12 +215,46 @@ def solve_factored(fac: Factorization, b, refine: int | None = None):
         tri = lambda bb: _solve_nopivot(fac.lu, bb)
     x = tri(b.astype(jnp.float32)).astype(b.dtype)
     for _ in range(n_ref):
-        r = b - fac.A @ x
+        r = b - _matvec(fac.A, x)
         dx = tri(r.astype(jnp.float32)).astype(b.dtype)
         x = x + dx
+    if residual_check and n_ref > 0:
+        r = b - _matvec(fac.A, x)
+        # per-system norms: a batch-global norm would let one healthy
+        # large-||b|| element mask a stagnated small-||b|| element
+        n_sys_axes = 2 if b.ndim == fac.lu.ndim else 1
+        axes = tuple(range(b.ndim - n_sys_axes, b.ndim))
+        rn = jnp.sqrt(jnp.sum(jnp.square(r), axis=axes))
+        bn = jnp.sqrt(jnp.sum(jnp.square(b), axis=axes))
+        # non-finite x (zero/denormal clamped pivot blew up) must also
+        # trigger the fallback, not satisfy `not (rn > ...)` via nan
+        stagnated = ~(rn <= _FALLBACK_RTOL * bn + 1e-300)
+        any_stagnated = jnp.any(stagnated)
+        # refine_stagnated counts SYSTEMS that failed the check;
+        # pivot_fallback counts SOLVES that took the pivoted branch
+        telemetry.device_increment("linalg.refine_stagnated", stagnated)
+        telemetry.device_increment("linalg.pivot_fallback",
+                                   any_stagnated)
+        x_fb = jax.lax.cond(any_stagnated,
+                            lambda: _pivoted_resolve(fac.A, b, n_ref),
+                            lambda: x)
+        mask = stagnated.reshape(
+            stagnated.shape + (1,) * (b.ndim - stagnated.ndim))
+        x = jnp.where(mask, x_fb, x)
     return x
 
 
-def solve(A, b, refine: int | None = None):
-    """One-shot A x = b with the platform-appropriate path."""
-    return solve_factored(factor(A), b, refine=refine)
+def solve(A, b, refine: int | None = None,
+          residual_check: bool | None = None):
+    """One-shot A x = b with the platform-appropriate path.
+
+    ``residual_check`` defaults to ON whenever refinement runs: the
+    one-shot entry is what the general (non-``I - c*J``) Newton
+    Jacobians use — equilibrium, the coupled PSR chain, the bordered
+    Stefan-Maxwell system — exactly the call sites where a silently bad
+    pivot-free factor would corrupt results."""
+    n_ref = _REFINE_STEPS if refine is None else refine
+    if residual_check is None:
+        residual_check = n_ref > 0
+    return solve_factored(factor(A), b, refine=n_ref,
+                          residual_check=residual_check)

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from . import linalg
 
 # ---------------------------------------------------------------------------
@@ -115,6 +116,43 @@ class ODESolution(NamedTuple):
     t_final: Any = None   # diagnostic: integrator time at exit
     stalled: Any = None   # diagnostic: True if the step loop gave up
     n_newton: Any = None  # total Newton iterations (for FLOP accounting)
+
+
+def solution_stats(sol: "ODESolution", *, label: str = "",
+                   wall_s: float | None = None, recorder=None,
+                   emit: bool = True) -> dict:
+    """Host-side aggregate of a (possibly vmapped) :class:`ODESolution`
+    into one JSON-ready dict of per-solve counters; recorded as an
+    ``odeint`` telemetry event on ``recorder`` (default recorder) when
+    ``emit``. This is the counter surface the FLOP/MFU model and
+    ``solve_report()`` consume."""
+    n_elems = int(np.asarray(sol.n_steps).size)
+    stats = {
+        "n_elements": n_elems,
+        "n_steps": int(np.sum(np.asarray(sol.n_steps))),
+        "n_rejected": int(np.sum(np.asarray(sol.n_rejected))),
+        "n_newton": (int(np.sum(np.asarray(sol.n_newton)))
+                     if sol.n_newton is not None else None),
+        "n_success": int(np.sum(np.asarray(sol.success))),
+        "n_stalled": (int(np.sum(np.asarray(sol.stalled)))
+                      if sol.stalled is not None else None),
+    }
+    if wall_s is not None:
+        stats["wall_s"] = round(float(wall_s), 6)
+        if wall_s > 0:
+            stats["steps_per_sec"] = round(stats["n_steps"] / wall_s, 2)
+    if emit:
+        rec = recorder if recorder is not None else \
+            telemetry.get_recorder()
+        rec.event("odeint", label=label, **stats)
+        rec.inc("odeint.solves")
+        rec.inc("odeint.steps", stats["n_steps"])
+        rec.inc("odeint.rejected", stats["n_rejected"])
+        if stats["n_newton"] is not None:
+            rec.inc("odeint.newton", stats["n_newton"])
+        if stats["n_stalled"]:
+            rec.inc("odeint.stalled", stats["n_stalled"])
+    return stats
 
 
 @dataclasses.dataclass(frozen=True)

@@ -72,6 +72,11 @@ class PSRSolution(NamedTuple):
     residual: Any     # final weighted residual norm
     converged: Any
     n_newton: Any
+    # telemetry split of n_newton: direct-Newton phase vs the polish
+    # after pseudo-transient rescue (a nonzero polish count means the
+    # rescue path actually ran for this element)
+    n_newton_direct: Any = None
+    n_newton_polish: Any = None
 
 
 def _split(y):
@@ -285,9 +290,11 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
     w = weights[0] + weights[1] * jnp.abs(y)
     rfin = resid(y, mech_args)
     rnorm = jnp.sqrt(jnp.mean((rfin / w) ** 2))
+    n2 = jnp.where(conv1, 0, n2)    # polish never ran for conv1 elements
     return PSRSolution(T=T, Y=Y, rho=rho, tau=tau_eff, volume=V_eff,
                        residual=rnorm, converged=converged,
-                       n_newton=n1 + n2)
+                       n_newton=n1 + n2, n_newton_direct=n1,
+                       n_newton_polish=n2)
 
 
 class PSRChainSolution(NamedTuple):

@@ -32,8 +32,27 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:                                    # jax >= 0.6: top-level name
+    from jax import shard_map as _shard_map_impl
+except ImportError:                     # jax 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable ``shard_map``: newer jax spells the replication
+    check ``check_vma``, jax 0.4.x spells it ``check_rep`` (and hosts
+    the function under ``jax.experimental``)."""
+    if check_vma is None:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs)
+    try:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=check_vma)
+    except TypeError:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=check_vma)
 
 from ..ops import reactors as reactor_ops
 

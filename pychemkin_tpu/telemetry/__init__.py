@@ -1,0 +1,61 @@
+"""Crash-safe telemetry: structured metrics + JSONL/atomic-snapshot
+sinks.
+
+Event schema (one JSON object per line in the sink):
+
+``{"t": <unix seconds>, "kind": "<event kind>", ...fields}``
+
+Kinds emitted by the framework:
+
+- ``solve``        — one reactor-model solve (model, label, wall_s,
+                     n_steps/n_rejected/n_newton, success, ...); the
+                     same dict :meth:`ReactorModel.solve_report`
+                     returns.
+- ``odeint``       — host-side aggregate of a (possibly batched)
+                     :class:`~pychemkin_tpu.ops.odeint.ODESolution`.
+- ``flame``        — one :func:`~pychemkin_tpu.ops.flame1d.solve_flame`
+                     driver run (per-stage wall time, regrids,
+                     programs compiled).
+- ``bench_config`` / ``bench_summary`` — benchmark ladder progress
+                     (see ``pychemkin_tpu/benchmarks.py``; the summary
+                     is also banked to an atomic snapshot after every
+                     completed rung).
+
+Counters maintained on the default recorder include the pivot-free-LU
+residual-check outcomes, bridged from device via
+:func:`device_increment`: ``linalg.refine_stagnated`` counts SYSTEMS
+whose refined solve failed the per-system residual check, while
+``linalg.pivot_fallback`` counts SOLVES that took the pivoted-LU
+fallback branch (a batched solve with several stagnated elements adds
+several to the former, one to the latter).
+"""
+
+from .recorder import (
+    MetricsRecorder,
+    configure,
+    device_counters_enabled,
+    device_increment,
+    get_recorder,
+    record_event,
+)
+from .sink import (
+    JsonlSink,
+    append_jsonl,
+    atomic_write_json,
+    dumps_line,
+    read_jsonl,
+)
+
+__all__ = [
+    "JsonlSink",
+    "MetricsRecorder",
+    "append_jsonl",
+    "atomic_write_json",
+    "configure",
+    "device_counters_enabled",
+    "device_increment",
+    "dumps_line",
+    "get_recorder",
+    "read_jsonl",
+    "record_event",
+]

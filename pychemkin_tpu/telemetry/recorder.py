@@ -1,0 +1,183 @@
+"""Structured metrics recorder: counters, gauges, device-fenced timers.
+
+The observability core the bench and solver layers thread their
+per-solve statistics through (n_steps / n_rejected / n_newton,
+compile_s, per-stage wall time, LU residual-fallback counts). Three
+surfaces:
+
+- host counters/gauges/timers on :class:`MetricsRecorder`, with
+  ``section(...)`` timing blocks fenced by ``jax.block_until_ready`` so
+  a section charges DEVICE time, not Python dispatch time;
+- structured events: ``event(kind, **fields)`` appends one JSONL line to
+  the attached crash-safe sink (see :mod:`.sink`) and keeps a bounded
+  in-memory tail for ``solve_report()``-style surfaces;
+- a device→host counter bridge (:func:`device_increment`) for counts
+  that only exist inside a jitted program (the pivot-free LU's
+  stagnated-refinement flag): a ``jax.debug.callback`` increments the
+  host counter when the program runs. The bridge is compiled in only
+  when enabled at TRACE time (:func:`device_counters_enabled`), so the
+  hot sweep path carries zero callback nodes unless asked for.
+
+The module-level default recorder is what the ops/model layers use when
+the caller does not pass one; ``configure(path)`` attaches a crash-safe
+sink to it.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import time
+from typing import Any, Dict, Optional
+
+from .sink import JsonlSink, timestamp
+
+#: environment switch for the device→host counter bridge; checked at
+#: trace time so disabling it removes the callback nodes entirely
+_DEVICE_COUNTERS_ENV = "PYCHEMKIN_TELEMETRY_DEVICE"
+
+
+def device_counters_enabled() -> bool:
+    """Whether jitted code should embed device→host counter callbacks
+    (default on; export ``PYCHEMKIN_TELEMETRY_DEVICE=0`` to strip them
+    from compiled programs)."""
+    return os.environ.get(_DEVICE_COUNTERS_ENV, "1") != "0"
+
+
+class MetricsRecorder:
+    """Counters + gauges + device-fenced wall-clock timers + events."""
+
+    def __init__(self, sink: Optional[JsonlSink] = None,
+                 max_events: int = 256):
+        self.counters: Dict[str, int] = collections.defaultdict(int)
+        self.gauges: Dict[str, float] = {}
+        self.timers: Dict[str, float] = collections.defaultdict(float)
+        self._events: collections.deque = collections.deque(
+            maxlen=max_events)
+        self._sink = sink
+
+    # -- sink plumbing ---------------------------------------------------
+    def attach_sink(self, sink: Optional[JsonlSink]) -> None:
+        self._sink = sink
+
+    @property
+    def sink(self) -> Optional[JsonlSink]:
+        return self._sink
+
+    # -- scalars ---------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        self.counters[name] += int(n)
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    @contextlib.contextmanager
+    def section(self, name: str, fence: Any = None):
+        """Time a block into ``timers[name]``. ``fence`` (an array, tree,
+        or list the block appends device arrays to) is blocked on before
+        the clock stops, so asynchronous dispatch cannot hide device
+        time."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if fence is not None and any(
+                    True for _ in _iter_leaves(fence)):
+                import jax
+
+                jax.block_until_ready(fence)
+            self.timers[name] += time.perf_counter() - t0
+
+    # -- events ----------------------------------------------------------
+    def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Record one structured event; appended to the sink (if any) as
+        a crash-safe JSONL line and kept in the in-memory tail."""
+        ev = {"t": timestamp(), "kind": kind}
+        ev.update(fields)
+        self._events.append(ev)
+        if self._sink is not None:
+            self._sink.emit(ev)
+        return ev
+
+    def last_event(self, kind: str) -> Optional[Dict[str, Any]]:
+        for ev in reversed(self._events):
+            if ev["kind"] == kind:
+                return ev
+        return None
+
+    def events(self, kind: Optional[str] = None):
+        return [ev for ev in self._events
+                if kind is None or ev["kind"] == kind]
+
+    # -- aggregate views -------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Aggregate state as one JSON-ready dict; also rewritten
+        atomically to the sink's snapshot file when a sink is attached."""
+        snap = {
+            "t": timestamp(),
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "timers": {k: round(v, 6) for k, v in self.timers.items()},
+        }
+        if self._sink is not None:
+            self._sink.write_snapshot(snap)
+        return snap
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.gauges.clear()
+        self.timers.clear()
+        self._events.clear()
+
+
+def _iter_leaves(x):
+    if isinstance(x, (list, tuple)):
+        for item in x:
+            yield from _iter_leaves(item)
+    elif x is not None:
+        yield x
+
+
+#: process-wide default recorder (ops/model layers fall back to this)
+_default = MetricsRecorder()
+
+
+def get_recorder() -> MetricsRecorder:
+    return _default
+
+
+def configure(path: Optional[str] = None,
+              snapshot_path: Optional[str] = None) -> MetricsRecorder:
+    """Attach a crash-safe JSONL sink at ``path`` to the default
+    recorder (or detach with ``path=None``)."""
+    old = _default.sink
+    if old is not None:
+        old.close()
+    _default.attach_sink(
+        JsonlSink(path, snapshot_path) if path is not None else None)
+    return _default
+
+
+def record_event(kind: str, **fields: Any) -> Dict[str, Any]:
+    return _default.event(kind, **fields)
+
+
+def device_increment(name: str, value) -> None:
+    """Increment a host counter from inside a jitted program.
+
+    Embeds a ``jax.debug.callback`` that adds ``value`` (a traced
+    integer/bool scalar; bools count as 1) to the default recorder's
+    counter when the compiled program executes. No-op — zero graph
+    nodes — when device counters are disabled at trace time, so hot
+    paths pay nothing unless observability is on.
+    """
+    if not device_counters_enabled():
+        return
+    import jax
+    import jax.numpy as jnp
+
+    def _cb(v):
+        _default.inc(name, int(v))
+
+    jax.debug.callback(_cb, jnp.sum(jnp.asarray(value, jnp.int32)))

@@ -1,0 +1,129 @@
+"""Crash-safe on-disk telemetry sinks.
+
+The round-5 bench artifact landed as ``rc=124, parsed: null`` because the
+summary JSON was only printed at process exit — a killed worker left
+nothing. The fix is a write discipline, not a format:
+
+- **JSONL append** (:class:`JsonlSink`): one event per line, written with
+  a single ``write()`` call on a line-buffered stream and flushed to the
+  OS immediately. A SIGKILL can at worst truncate the LAST line; every
+  earlier line stays parseable, so a killed process always leaves a
+  usable event log (:func:`read_jsonl` skips a torn tail line).
+- **Atomic snapshot rewrite** (:func:`atomic_write_json`): aggregate
+  state (bench summaries, counter snapshots) is rewritten tmp+``rename``
+  on every update, so the file on disk is always a COMPLETE JSON
+  document — either the previous snapshot or the new one, never a
+  half-written hybrid.
+
+This module deliberately imports neither jax nor numpy: the sink must be
+usable from orchestrator processes (bench parents, suite runners) that
+never touch an accelerator, and must keep working while the accelerator
+client is wedged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, Optional
+
+
+def _json_default(obj: Any):
+    """Best-effort encoder for numpy/jax scalars and arrays."""
+    for attr in ("item",):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:   # noqa: BLE001 — fall through to repr
+                break
+    tolist = getattr(obj, "tolist", None)
+    if callable(tolist):
+        return tolist()
+    return repr(obj)
+
+
+def dumps_line(obj: Dict[str, Any]) -> str:
+    """One compact JSON line (no embedded newlines)."""
+    return json.dumps(obj, default=_json_default,
+                      separators=(",", ":"))
+
+
+def atomic_write_json(path: str, obj: Any) -> str:
+    """Rewrite ``path`` atomically (tmp + ``os.replace``); the file is
+    always a complete JSON document even across a concurrent kill."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(obj, default=_json_default, indent=1))
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def append_jsonl(path: str, obj: Dict[str, Any]) -> None:
+    """One-shot crash-safe append of a single event (opens/closes the
+    file; use :class:`JsonlSink` for streams of events)."""
+    line = dumps_line(obj) + "\n"
+    with open(path, "a") as f:
+        f.write(line)
+        f.flush()
+
+
+def read_jsonl(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield events from a JSONL file, skipping a torn final line (the
+    one write a SIGKILL can truncate)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue
+
+
+class JsonlSink:
+    """Line-buffered JSONL event sink with an optional atomic snapshot
+    companion.
+
+    ``emit`` writes one event line and flushes; ``write_snapshot``
+    rewrites ``<path>.snapshot.json`` (or ``snapshot_path``) atomically.
+    Safe to ``emit`` after ``close`` (reopens in append mode), so a
+    long-lived recorder survives its sink being rotated.
+    """
+
+    def __init__(self, path: str, snapshot_path: Optional[str] = None):
+        self.path = path
+        self.snapshot_path = snapshot_path or (path + ".snapshot.json")
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        if self._f is None or self._f.closed:
+            self._f = open(self.path, "a", buffering=1)
+        self._f.write(dumps_line(event) + "\n")
+        self._f.flush()
+
+    def write_snapshot(self, obj: Any) -> str:
+        return atomic_write_json(self.snapshot_path, obj)
+
+    def close(self) -> None:
+        if self._f is not None and not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def timestamp() -> float:
+    """Wall-clock seconds; isolated here so tests can monkeypatch one
+    place."""
+    return time.time()

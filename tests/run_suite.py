@@ -27,8 +27,14 @@ Behaviour:
 - each child gets a per-file timeout (``RUN_SUITE_FILE_TIMEOUT`` seconds,
   default 2400) so one hung child cannot wedge the suite — a timeout is
   recorded as that file failing with rc=124;
+- a child exiting rc=5 (pytest: "no tests collected") counts as SKIPPED,
+  not failed — ``pytest tests/ -k <pattern>`` deselects every test in
+  most files, and under the per-file re-exec each such file is its own
+  pytest session; only if EVERY file collected nothing does the suite
+  itself exit 5, mirroring single-session pytest semantics;
 - ``-x`` / ``--exitfirst`` stops at the first failing FILE;
-- exit code is 0 iff every file's pytest exited 0;
+- exit code is 0 iff every file's pytest exited 0 or 5 (with at least
+  one 0);
 - a per-file line and a final summary are printed.
 
 ``pytest tests/`` (the driver's command) is re-exec'ed into this runner
@@ -122,24 +128,31 @@ def main(argv=None):
         except subprocess.TimeoutExpired:
             rc = 124
         dt = time.time() - t0
-        ok = rc == 0
+        # rc=5 = "no tests collected" in this child's session (e.g. a
+        # -k pattern deselecting the whole file): skipped, not failed
+        ok = rc in (0, 5)
         results.append((name, rc, dt))
         print(f"# run_suite: {name}: "
-              f"{'ok' if ok else f'FAIL rc={rc}'}"
+              f"{'no tests' if rc == 5 else 'ok' if ok else f'FAIL rc={rc}'}"
               f"{' (timeout)' if rc == 124 else ''} ({dt:.0f}s)",
               flush=True)
         if not ok and stop_on_fail:
             break
 
-    n_fail = sum(1 for _, rc, _ in results if rc != 0)
+    n_fail = sum(1 for _, rc, _ in results if rc not in (0, 5))
+    n_empty = sum(1 for _, rc, _ in results if rc == 5)
     total = time.time() - t_suite
     print(f"# run_suite: {len(results)} files, {n_fail} failed, "
-          f"{total:.0f}s total", flush=True)
+          f"{n_empty} empty, {total:.0f}s total", flush=True)
     if n_fail:
         for name, rc, _ in results:
-            if rc != 0:
+            if rc not in (0, 5):
                 print(f"# run_suite:   FAILED {name} rc={rc}", flush=True)
-    return 1 if n_fail else 0
+        return 1
+    if n_empty == len(results):
+        # nothing collected anywhere: surface pytest's own signal
+        return 5
+    return 0
 
 
 if __name__ == "__main__":

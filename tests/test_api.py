@@ -354,3 +354,27 @@ def test_chemistry_surface_completions(tmp_path, monkeypatch):
 
     chem_mod.chemistryset_new(c.chemID)
     chem_mod.chemistryset_initialized(c.chemID)
+
+
+def test_summaryfile_never_serves_stale_content(tmp_path, monkeypatch):
+    """chemIDs restart from 0 per process, so a Summary_<id>.out left in
+    the cwd may describe a DIFFERENT mechanism; the property must
+    regenerate (atomic tmp+rename), not return the stale file
+    (ADVICE round-5 #4)."""
+    import os
+
+    import pychemkin_tpu as ck
+    from pychemkin_tpu.mechanism import DATA_DIR
+
+    c = ck.Chemistry(chem=os.path.join(DATA_DIR, "h2o2.inp"))
+    c.preprocess()
+    monkeypatch.chdir(tmp_path)
+    stale = tmp_path / f"Summary_{c.chemID}.out"
+    stale.write_text("summary of a DIFFERENT mechanism from last run\n")
+
+    path = c.summaryfile
+    text = open(path).read()
+    assert "DIFFERENT mechanism" not in text
+    assert "species (10)" in text
+    # no tmp litter left behind by the atomic rewrite
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]

@@ -110,6 +110,23 @@ class TestConpEnergyReactor:
         mix_end = r.get_solution_mixture(0.01)
         assert abs(mix_end.temperature - T[-1]) < 1e-6
 
+        # per-solve telemetry surfaced at the model layer
+        rep = r.solve_report()
+        assert rep["model"] == type(r).__name__
+        assert rep["success"] is True
+        assert rep["n_steps"] > 0
+        assert rep["n_newton"] > 0
+        assert rep["wall_s"] > 0.0
+        assert 0.01 < rep["ignition_delay_ms"] < 1.0
+        # the same dict is on the telemetry event stream
+        from pychemkin_tpu import telemetry
+        ev = telemetry.get_recorder().last_event("solve")
+        assert ev is not None and ev["n_steps"] == rep["n_steps"]
+
+    def test_solve_report_empty_before_run(self, chem):
+        r = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
+        assert r.solve_report() == {}
+
     def test_requires_end_time(self, chem):
         r = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
         assert r.run() != 0              # TIME missing -> failed status

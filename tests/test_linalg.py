@@ -66,6 +66,140 @@ def test_public_solve_matches_numpy():
     np.testing.assert_allclose(x, np.linalg.solve(M, b), rtol=1e-9)
 
 
+class TestResidualCheckFallback:
+    """Post-solve residual check on the pivot-free path: stagnated
+    refinement must be detected (telemetry-counted) and rescued by the
+    pivoted fallback (ADVICE round-5 #1)."""
+
+    @staticmethod
+    def _zero_block_matrix(rng, n=20, k=8):
+        """Structurally-zero leading block: perfectly well-conditioned
+        (cond ~ 3e2) but every leading pivot of an UNPIVOTED
+        factorization is a clamped zero — catastrophic growth that
+        iterative refinement cannot repair."""
+        A = np.zeros((n, n))
+        A[:k, k:] = rng.normal(size=(k, n - k))
+        A[k:, :] = rng.normal(size=(n - k, n))
+        return A
+
+    def test_fallback_rescues_zero_pivot_block(self):
+        import jax
+
+        from pychemkin_tpu import telemetry
+
+        rng = np.random.default_rng(2)
+        A = self._zero_block_matrix(rng)
+        b = A @ rng.normal(size=A.shape[0])
+        fac = linalg.factor(jnp.asarray(A), mixed=True)
+
+        # unchecked: silently garbage (the advisor's exact scenario)
+        x_nc = np.asarray(linalg.solve_factored(fac, jnp.asarray(b)))
+        assert np.linalg.norm(A @ x_nc - b) > 1e3 * np.linalg.norm(b)
+
+        rec = telemetry.get_recorder()
+        base = rec.counters.get("linalg.pivot_fallback", 0)
+        x = np.asarray(linalg.solve_factored(fac, jnp.asarray(b),
+                                             residual_check=True))
+        jax.effects_barrier()
+        np.testing.assert_allclose(A @ x, b, rtol=0,
+                                   atol=1e-9 * np.linalg.norm(b))
+        assert rec.counters["linalg.pivot_fallback"] == base + 1
+        assert rec.counters["linalg.refine_stagnated"] >= base + 1
+
+    def test_one_shot_solve_checks_by_default(self):
+        """linalg.solve — the entry equilibrium / PSR-chain /
+        Stefan-Maxwell Newtons use — carries the residual check without
+        being asked."""
+        rng = np.random.default_rng(3)
+        A = self._zero_block_matrix(rng)
+        b = A @ rng.normal(size=A.shape[0])
+        # force the mixed path through factor() by monkeypatching the
+        # platform switch for this call
+        orig = linalg.use_mixed_precision
+        linalg.use_mixed_precision = lambda: True
+        try:
+            x = np.asarray(linalg.solve(jnp.asarray(A), jnp.asarray(b)))
+        finally:
+            linalg.use_mixed_precision = orig
+        np.testing.assert_allclose(A @ x, b, rtol=0,
+                                   atol=1e-9 * np.linalg.norm(b))
+
+    def test_healthy_solve_does_not_fall_back(self):
+        import jax
+
+        from pychemkin_tpu import telemetry
+
+        rng = np.random.default_rng(5)
+        M = _newton_like(rng, 24)
+        b = rng.normal(size=24)
+        fac = linalg.factor(jnp.asarray(M), mixed=True)
+        rec = telemetry.get_recorder()
+        base = rec.counters.get("linalg.pivot_fallback", 0)
+        x = np.asarray(linalg.solve_factored(fac, jnp.asarray(b),
+                                             residual_check=True))
+        jax.effects_barrier()
+        np.testing.assert_allclose(M @ x, b, rtol=0, atol=1e-9)
+        assert rec.counters.get("linalg.pivot_fallback", 0) == base
+
+    def test_mixed_batch_rescues_only_stagnated_element(self):
+        """Per-system residual norms: one bad element in a batch must
+        be rescued without the healthy element's result changing, and
+        must count ONE stagnated system + ONE fallback solve."""
+        import jax
+
+        from pychemkin_tpu import telemetry
+
+        rng = np.random.default_rng(8)
+        A_bad = self._zero_block_matrix(rng)
+        A_ok = _newton_like(rng, A_bad.shape[0])
+        As = np.stack([A_ok, A_bad])
+        bs = np.stack([A_ok @ rng.normal(size=A_ok.shape[0]),
+                       A_bad @ rng.normal(size=A_bad.shape[0])])
+        fac = linalg.factor(jnp.asarray(As), mixed=True)
+        rec = telemetry.get_recorder()
+        base_sys = rec.counters.get("linalg.refine_stagnated", 0)
+        base_fb = rec.counters.get("linalg.pivot_fallback", 0)
+        xs = np.asarray(linalg.solve_factored(fac, jnp.asarray(bs),
+                                              residual_check=True))
+        jax.effects_barrier()
+        for A, b, x in zip(As, bs, xs):
+            np.testing.assert_allclose(
+                A @ x, b, rtol=0, atol=1e-8 * max(np.linalg.norm(b), 1))
+        assert rec.counters["linalg.refine_stagnated"] == base_sys + 1
+        assert rec.counters["linalg.pivot_fallback"] == base_fb + 1
+
+    def test_factored_hot_paths_carry_no_check_nodes(self):
+        """Both factored-reuse defaults — refine=0 stage-Newton
+        directions AND the refined block-Thomas/pseudo-transient solves
+        — must compile without callback or cond nodes (the flame scan
+        and vmapped sweeps would otherwise execute the pivoted branch
+        unconditionally)."""
+        import jax
+
+        rng = np.random.default_rng(6)
+        M = _newton_like(rng, 8)
+
+        for refine in (0, None):
+            def solve_hot(b, refine=refine):
+                fac = linalg.factor(jnp.asarray(M), mixed=True)
+                return linalg.solve_factored(fac, b, refine=refine)
+
+            jaxpr = str(jax.make_jaxpr(solve_hot)(jnp.ones(8)))
+            assert "callback" not in jaxpr
+            assert "cond" not in jaxpr
+
+    def test_batched_vector_rhs_refinement(self):
+        """[B, N, N] factor with [B, N] RHS: the refinement matvec must
+        broadcast (plain @ rejects this shape pairing)."""
+        rng = np.random.default_rng(7)
+        Ms = np.stack([_newton_like(rng, 11) for _ in range(5)])
+        bs = rng.normal(size=(5, 11))
+        fac = linalg.factor(jnp.asarray(Ms), mixed=True)
+        xs = np.asarray(linalg.solve_factored(fac, jnp.asarray(bs)))
+        for M, b, x in zip(Ms, bs, xs):
+            np.testing.assert_allclose(M @ x, b, rtol=0, atol=1e-9)
+
+
 def test_matrix_rhs_column_semantics():
     """solve_factored with a matrix RHS follows lu_solve semantics
     (each COLUMN is one system) on both code paths."""

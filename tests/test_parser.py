@@ -119,6 +119,46 @@ class TestTinyMechanisms:
         rec = _tiny("A<=>B 1.0E10 0.0 5.0", extra=" KCAL/MOLE")
         np.testing.assert_allclose(rec.Ea_R[0], 5000.0 / R_CAL)
 
+    def test_ford_reversible_without_rev_rejected(self):
+        with pytest.raises(MechanismError, match="REV"):
+            _tiny("A<=>B   1.0E10  0.0  0.0\nFORD /A 1.5/")
+
+    def test_rord_reversible_without_rev_warns(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="pychemkin_tpu"):
+            rec = _tiny("A<=>B   1.0E10  0.0  0.0\nRORD /B 1.5/")
+        assert rec.n_reactions == 1
+        assert any("detailed balance" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_ford_rord_reversible_without_rev_warns(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="pychemkin_tpu"):
+            _tiny("A<=>B   1.0E10  0.0  0.0\n"
+                  "FORD /A 1.5/\nRORD /B 2.0/")
+        assert any("detailed balance" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_ford_with_explicit_rev_is_silent(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="pychemkin_tpu"):
+            rec = _tiny("A<=>B   1.0E10  0.0  0.0\n"
+                        "REV /2.0E10 0.0 0.0/\nFORD /A 1.5/")
+        assert rec.n_reactions == 1
+        assert not any("detailed balance" in r.getMessage()
+                       for r in caplog.records)
+
+    def test_ford_irreversible_is_silent(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="pychemkin_tpu"):
+            rec = _tiny("A=>B   1.0E10  0.0  0.0\nFORD /A 1.5/")
+        assert rec.n_reactions == 1
+        assert not caplog.records
+
     def test_unbalanced_rejected(self):
         with pytest.raises(MechanismError, match="unbalanced"):
             _tiny("A+A<=>B+B+B 1.0E10 0.0 0.0")

@@ -183,6 +183,15 @@ class TestPSRModels:
                                               chem.WT, "mass")
         assert abs(h_out - h_in) / cp < 0.05
 
+        # per-solve telemetry: Newton work split, wall time, residual
+        rep = psr.solve_report()
+        assert rep["success"] is True
+        assert rep["n_newton"] > 0
+        assert rep["n_newton"] == (rep["n_newton_direct"]
+                                   + rep["n_newton_polish"])
+        assert rep["wall_s"] > 0.0
+        assert rep["energy"] == "ENRG"
+
     def test_inlet_registry(self, chem):
         psr = PSR_SetResTime_EnergyConservation(self._make_guess(chem))
         a = self._make_inlet(chem, mdot=4.0)

@@ -1,0 +1,51 @@
+"""Suite-runner semantics: pytest rc=5 ("no tests collected") from a
+child must count as SKIPPED, not failed, so ``pytest tests/ -k pat``
+works again under the per-file re-exec (ADVICE round-5 #2)."""
+
+import os
+import subprocess
+import sys
+
+RUN_SUITE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "run_suite.py")
+
+
+def _dummy_files(tmp_path):
+    f_match = tmp_path / "test_alpha.py"
+    f_match.write_text("def test_wanted_case():\n    assert True\n")
+    f_nomatch = tmp_path / "test_beta.py"
+    f_nomatch.write_text("def test_unrelated():\n    assert True\n")
+    return str(f_match), str(f_nomatch)
+
+
+def _run(args):
+    env = dict(os.environ)
+    env["RUN_SUITE_FILE_TIMEOUT"] = "120"
+    return subprocess.run([sys.executable, RUN_SUITE] + args,
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+
+
+def test_deselected_file_counts_as_skipped(tmp_path):
+    f_match, f_nomatch = _dummy_files(tmp_path)
+    r = _run([f_match, f_nomatch, "-k", "wanted"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no tests" in r.stdout
+    assert "0 failed" in r.stdout
+    assert "1 empty" in r.stdout
+
+
+def test_all_files_empty_returns_5(tmp_path):
+    f_match, f_nomatch = _dummy_files(tmp_path)
+    r = _run([f_match, f_nomatch, "-k", "zz_matches_nothing"])
+    assert r.returncode == 5, r.stdout + r.stderr
+    assert "2 empty" in r.stdout
+
+
+def test_real_failure_still_fails(tmp_path):
+    f_bad = tmp_path / "test_gamma.py"
+    f_bad.write_text("def test_broken():\n    assert False\n")
+    f_match, _ = _dummy_files(tmp_path)
+    r = _run([str(f_bad), f_match])
+    assert r.returncode == 1
+    assert "FAILED test_gamma.py" in r.stdout

@@ -1,0 +1,339 @@
+"""Crash-safe telemetry layer tests: recorder, sinks, device-counter
+bridge, and the bench banking contract (a killed bench run must still
+leave a parseable summary with every completed rung)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pychemkin_tpu import benchmarks, telemetry
+from pychemkin_tpu.telemetry import (
+    JsonlSink,
+    MetricsRecorder,
+    atomic_write_json,
+    read_jsonl,
+)
+
+
+class TestRecorder:
+    def test_counters_gauges_timers(self):
+        rec = MetricsRecorder()
+        rec.inc("a")
+        rec.inc("a", 4)
+        rec.gauge("g", 2.5)
+        with rec.section("s"):
+            pass
+        assert rec.counters["a"] == 5
+        assert rec.gauges["g"] == 2.5
+        assert rec.timers["s"] >= 0.0
+        snap = rec.snapshot()
+        assert snap["counters"]["a"] == 5
+        assert "s" in snap["timers"]
+
+    def test_section_fences_device_values(self):
+        rec = MetricsRecorder()
+        out = []
+        with rec.section("solve", fence=out):
+            out.append(jnp.arange(8) * 2.0)
+        assert rec.timers["solve"] > 0.0
+
+    def test_events_tail_and_filter(self):
+        rec = MetricsRecorder(max_events=3)
+        for i in range(5):
+            rec.event("e", i=i)
+        rec.event("other")
+        assert len(rec.events()) == 3          # bounded tail
+        assert rec.last_event("e")["i"] == 4
+        assert rec.events("other")[0]["kind"] == "other"
+
+    def test_event_written_to_sink(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        rec = MetricsRecorder(sink=JsonlSink(p))
+        rec.event("solve", n_steps=12)
+        rec.event("solve", n_steps=3)
+        evs = list(read_jsonl(p))
+        assert [e["n_steps"] for e in evs] == [12, 3]
+        assert all(e["kind"] == "solve" for e in evs)
+
+
+class TestSinkCrashSafety:
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with JsonlSink(p) as sink:
+            sink.emit({"kind": "a", "i": 1})
+            sink.emit({"kind": "a", "i": 2})
+        with open(p, "a") as f:
+            f.write('{"kind": "a", "i": 3, "tr')   # SIGKILL mid-write
+        evs = list(read_jsonl(p))
+        assert [e["i"] for e in evs] == [1, 2]
+
+    def test_atomic_snapshot_always_complete(self, tmp_path):
+        p = str(tmp_path / "snap.json")
+        atomic_write_json(p, {"v": 1})
+        atomic_write_json(p, {"v": 2, "more": list(range(100))})
+        with open(p) as f:
+            assert json.load(f)["v"] == 2
+
+    def test_sigkilled_writer_leaves_parseable_log(self, tmp_path):
+        """A writer process SIGKILLed mid-stream leaves a JSONL file
+        whose every completed line parses — the crash-safety contract."""
+        p = str(tmp_path / "killed.jsonl")
+        script = textwrap.dedent(f"""
+            import sys, time
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))!r})
+            from pychemkin_tpu.telemetry import JsonlSink
+            sink = JsonlSink({p!r})
+            i = 0
+            while True:
+                sink.emit({{"kind": "tick", "i": i}})
+                i += 1
+                time.sleep(0.01)
+        """)
+        proc = subprocess.Popen([sys.executable, "-c", script])
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if os.path.exists(p) and os.path.getsize(p) > 200:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("writer produced no events in time")
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+        evs = list(read_jsonl(p))
+        assert len(evs) >= 2
+        assert [e["i"] for e in evs] == list(range(len(evs)))
+
+    def test_snapshot_path_alongside(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        rec = MetricsRecorder(sink=JsonlSink(p))
+        rec.inc("n", 7)
+        rec.snapshot()
+        with open(p + ".snapshot.json") as f:
+            assert json.load(f)["counters"]["n"] == 7
+
+
+class TestDeviceCounterBridge:
+    def test_device_increment_from_jit(self):
+        rec = telemetry.get_recorder()
+        base = rec.counters.get("test.dev", 0)
+
+        @jax.jit
+        def f(x):
+            telemetry.device_increment("test.dev", x > 0)
+            return x * 2
+
+        np.testing.assert_allclose(f(jnp.asarray(3.0)), 6.0)
+        jax.effects_barrier()
+        assert rec.counters["test.dev"] == base + 1
+        f(jnp.asarray(-1.0))
+        jax.effects_barrier()
+        assert rec.counters["test.dev"] == base + 1   # pred false: +0
+
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("PYCHEMKIN_TELEMETRY_DEVICE", "0")
+        assert not telemetry.device_counters_enabled()
+        rec = telemetry.get_recorder()
+        base = rec.counters.get("test.dev2", 0)
+
+        @jax.jit
+        def f(x):
+            telemetry.device_increment("test.dev2", x > 0)
+            return x
+
+        f(jnp.asarray(1.0))
+        jax.effects_barrier()
+        assert rec.counters.get("test.dev2", 0) == base
+
+
+# ---------------------------------------------------------------------------
+# bench banking contract
+
+
+def _fake_config_result(mech, B, platform="tpu"):
+    return {
+        "platform": platform, "n_chips": 4, "mech": mech, "B": B,
+        "chunk": min(B, 256), "compile_s": 10.0, "run_s": 1.0,
+        "throughput": float(B), "rtol": 1e-6, "atol": 1e-12,
+        "t_end": 2e-3, "n_ok": B, "n_ignited": B, "n_steps": 100 * B,
+        "n_rejected": B, "n_newton": 400 * B, "steps_per_sec": 1e5,
+        "model_f32_gflop": 1.0, "model_f64_gflop": 0.1, "mfu_pct": 1.5,
+    }
+
+
+def _summary_lines(captured: str):
+    out = []
+    for line in captured.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+class TestBenchBanking:
+    def _patch(self, monkeypatch, results_by_rung, fail_at=None):
+        calls = {"n": 0}
+
+        def fake_run_child(args, timeout, env=None, raw_prefix=None):
+            if args[0] == "probe":
+                return 0, "tpu", ""
+            if args[0] == "baseline":
+                return 0, {"n_points": 2, "s_per_ignition": 0.5,
+                           "ignitions_per_sec": 2.0}, ""
+            assert args[0] == "config"
+            i = calls["n"]
+            calls["n"] += 1
+            if fail_at is not None and i >= fail_at:
+                return -2, None, "simulated hang"
+            return 0, results_by_rung[i], ""
+
+        monkeypatch.setattr(benchmarks, "_run_child", fake_run_child)
+
+    def test_summary_banked_after_every_rung(self, monkeypatch, capfd,
+                                             tmp_path):
+        bank = str(tmp_path / "bank.json")
+        monkeypatch.setenv("BENCH_LADDER", "h2o2:16,h2o2:64")
+        monkeypatch.setenv("BENCH_BASELINE_N", "0")
+        monkeypatch.setenv("BENCH_CPU_COMPARE", "0")
+        monkeypatch.setenv("BENCH_BANK_PATH", bank)
+        self._patch(monkeypatch, [_fake_config_result("h2o2", 16),
+                                  _fake_config_result("h2o2", 64)])
+        benchmarks.main()
+        summaries = _summary_lines(capfd.readouterr().out)
+        # one partial line per completed rung + the final summary
+        assert len(summaries) == 3
+        assert summaries[0]["partial"] is True
+        assert [len(s["configs_run"]) for s in summaries] == [1, 2, 2]
+        assert "partial" not in summaries[-1]
+        assert summaries[-1]["value"] == 64.0
+        assert all(c["mfu_pct"] is not None
+                   for c in summaries[-1]["configs_run"])
+        with open(bank) as f:
+            banked = json.load(f)
+        assert len(banked["configs_run"]) == 2    # final rewrite
+
+    def test_failed_rung_keeps_bank(self, monkeypatch, capfd):
+        monkeypatch.setenv("BENCH_LADDER", "h2o2:16,h2o2:64,h2o2:256")
+        monkeypatch.setenv("BENCH_BASELINE_N", "0")
+        monkeypatch.setenv("BENCH_CPU_COMPARE", "0")
+        monkeypatch.delenv("BENCH_BANK_PATH", raising=False)
+        self._patch(monkeypatch, [_fake_config_result("h2o2", 16)],
+                    fail_at=1)
+        benchmarks.main()
+        summaries = _summary_lines(capfd.readouterr().out)
+        final = summaries[-1]
+        assert final["value"] == 16.0             # first rung banked
+        assert "timed out" in final["error"]
+        assert len(final["configs_run"]) == 1
+
+    def test_total_budget_stops_ladder_with_time_to_spare(
+            self, monkeypatch, capfd):
+        monkeypatch.setenv("BENCH_LADDER", "h2o2:16,h2o2:64")
+        monkeypatch.setenv("BENCH_BASELINE_N", "0")
+        monkeypatch.setenv("BENCH_CPU_COMPARE", "0")
+        # budget already almost exhausted: only banking headroom left
+        monkeypatch.setenv("BENCH_TOTAL_TIMEOUT", "0.5")
+        self._patch(monkeypatch, [_fake_config_result("h2o2", 16),
+                                  _fake_config_result("h2o2", 64)])
+        benchmarks.main()
+        summaries = _summary_lines(capfd.readouterr().out)
+        final = summaries[-1]
+        assert "budget" in final.get("error", "")
+        assert len(final["configs_run"]) < 2
+
+    def test_sigkilled_parent_leaves_parseable_partial(self, tmp_path):
+        """SIGKILL the bench parent mid-ladder: the stdout captured so
+        far must already contain a parseable summary line with the
+        completed rung's throughput and mfu — the exact rc=124
+        post-mortem contract."""
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        bank = str(tmp_path / "bank.json")
+        script = textwrap.dedent(f"""
+            import sys, time
+            sys.path.insert(0, {pkg_root!r})
+            import pychemkin_tpu.benchmarks as b
+
+            def fake_run_child(args, timeout, env=None, raw_prefix=None):
+                if args[0] == "probe":
+                    return 0, "tpu", ""
+                B = int(args[2])
+                if B > 16:
+                    time.sleep(600)     # the rung the kill interrupts
+                return 0, {json.dumps(_fake_config_result("h2o2", 16))}, ""
+
+            b._run_child = fake_run_child
+            b.main()
+        """)
+        env = dict(os.environ)
+        env.update(BENCH_LADDER="h2o2:16,h2o2:64", BENCH_BASELINE_N="0",
+                   BENCH_CPU_COMPARE="0", BENCH_BANK_PATH=bank,
+                   JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        out_path = str(tmp_path / "stdout.txt")
+        with open(out_path, "w") as out_f:
+            proc = subprocess.Popen([sys.executable, "-c", script],
+                                    stdout=out_f,
+                                    stderr=subprocess.DEVNULL, env=env)
+            try:
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    if os.path.exists(bank):
+                        break
+                    time.sleep(0.2)
+                else:
+                    pytest.fail("no banked summary appeared in time")
+                time.sleep(0.5)   # let the stdout line land too
+            finally:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait()
+        with open(out_path) as f:
+            summaries = _summary_lines(f.read())
+        assert summaries, "no parseable summary line before the kill"
+        last = summaries[-1]
+        assert last["partial"] is True
+        assert last["value"] == 16.0
+        assert last["configs_run"][0]["throughput"] == 16.0
+        assert last["configs_run"][0]["mfu_pct"] is not None
+        with open(bank) as f:
+            assert json.load(f)["configs_run"][0]["B"] == 16
+
+
+class TestAblationTool:
+    @pytest.mark.slow
+    def test_emits_valid_artifact_on_cpu(self, tmp_path):
+        from tools import ablate_step_cost
+
+        out = str(tmp_path / "ablate.json")
+        rc = ablate_step_cost.main(["--mech", "h2o2", "--batch", "4",
+                                    "--repeats", "1", "--out", out])
+        assert rc == 0
+        with open(out) as f:
+            art = json.load(f)
+        assert art["platform"] == "cpu"
+        assert art["mech"] == "h2o2"
+        comp = art["components"]
+        for key in ("rhs_f64", "rhs_f32", "jac_f64", "jac_f32",
+                    "lu_nopivot_f32", "lu_pivoted_f32", "tri_solve_f32",
+                    "tri_solve_refine2"):
+            assert comp[key]["run_s"] > 0.0
+        shares = art["attempt_model"]
+        total = (shares["jac_pct"] + shares["lu_pct"]
+                 + shares["newton_rhs_solve_pct"]
+                 + shares["err_filter_pct"])
+        assert abs(total - 100.0) < 0.5
