@@ -20,6 +20,6 @@ mix.X = {"H2": 2.0, "O2": 1.0, "N2": 3.76}
 r = GivenPressureBatchReactor_EnergyConservation(mix)
 r.time = 2.0e-3
 T0s = np.linspace(1000.0, 1400.0, 20)
-delays_ms, ok = r.run_sweep(T0s=T0s)
+delays_ms, ok, status = r.run_sweep(T0s=T0s)
 for T0, d, o in zip(T0s, delays_ms, ok):
     print("T0=%6.1f K  tau=%9.4f ms  %s" % (T0, d, "ok" if o else "FAIL"))

@@ -29,7 +29,7 @@ psr.residence_time = 1e-3
 psr.set_estimate_conditions()          # equilibrium estimate
 
 taus = np.geomspace(3e-4, 1e-1, 12)
-T, Y, converged = psr.run_sweep(taus=taus)
+T, Y, converged, status = psr.run_sweep(taus=taus)
 for tau, t, c in zip(taus, np.asarray(T), np.asarray(converged)):
     print("tau=%9.2e s  T_exit=%7.1f K  %s"
           % (tau, t, "ok" if c else "unconverged"))

@@ -44,6 +44,7 @@ from . import (  # noqa: E402
     models,
     ops,
     parallel,
+    resilience,
     telemetry,
 )
 from .chemistry import (  # noqa: E402
@@ -126,6 +127,7 @@ __all__ = [
     "models",
     "ops",
     "parallel",
+    "resilience",
     "set_verbose",
     "telemetry",
     "verbose",

@@ -38,6 +38,13 @@ once at the end; consumers take the LAST parseable line (exactly what
 ``_run_child`` itself does). Per-config diagnostics go to stderr so a
 failure is bisectable from the bench artifact alone.
 
+Each rung also records the resilience outcome of its sweep —
+``n_failed`` / ``n_rescued`` / ``n_abandoned`` / ``status_counts``
+(see ``pychemkin_tpu/resilience/``): the rescue ladder runs UNTIMED
+after the clean-path measurement, so the headline throughput is
+unchanged while the artifact still carries the per-rung
+partial-results story (schema asserted by tests/test_telemetry.py).
+
 Environment knobs:
   BENCH_LADDER      comma list of mech:B pairs (default
                     "h2o2:16,h2o2:256,h2o2:1024,h2o2:4096,
@@ -186,7 +193,7 @@ def _child_config(mech_name: str, B: int, repeats: int):
             chunk_size=chunk, stats=stats)
 
     t0 = time.time()
-    times, ok = sweep()            # compile + warm-up (chunk-sized shape)
+    times, ok, status = sweep()    # compile + warm-up (chunk-sized shape)
     compile_s = time.time() - t0
     print(f"# compile+warmup: {compile_s:.1f}s", file=sys.stderr)
 
@@ -195,9 +202,20 @@ def _child_config(mech_name: str, B: int, repeats: int):
     for _ in range(repeats):
         stats = parallel.SweepStats()
         t0 = time.time()
-        times, ok = sweep(stats)
+        times, ok, status = sweep(stats)
         wall.append(time.time() - t0)
     run_s = min(wall)
+
+    # resilience pass (untimed — the headline number is the clean-path
+    # throughput): failed elements get the rescue ladder; the rung's
+    # JSON records what rescue did so the bench artifact carries the
+    # production partial-results story per rung
+    from . import resilience
+    times, ok, status, rescue_report = resilience.resilient_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, P0s, Y0, t_end, rtol=rtol, atol=atol,
+        max_steps_per_segment=20_000,
+        base_results={"times": times, "ok": ok, "status": status})
+
     n_ok = int(np.sum(ok))
     n_ignited = int(np.sum(np.isfinite(times) & ok))
     f32_flops, f64_flops = _flop_model(mech, stats.n_steps,
@@ -220,7 +238,11 @@ def _child_config(mech_name: str, B: int, repeats: int):
         steps_per_sec=round(stats.n_steps / run_s, 1),
         model_f32_gflop=round(f32_flops / 1e9, 2),
         model_f64_gflop=round(f64_flops / 1e9, 2),
-        mfu_pct=mfu)), flush=True)
+        mfu_pct=mfu,
+        n_failed=rescue_report.n_failed,
+        n_rescued=rescue_report.n_rescued,
+        n_abandoned=rescue_report.n_abandoned,
+        status_counts=rescue_report.status_counts)), flush=True)
 
 
 def _child_baseline(mech_name: str, n_points: int, budget_s: float):
@@ -469,7 +491,9 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
             {k: r.get(k) for k in ("mech", "B", "chunk", "throughput",
                                    "compile_s", "run_s", "mfu_pct",
                                    "steps_per_sec", "n_steps",
-                                   "n_rejected", "n_newton", "platform")}
+                                   "n_rejected", "n_newton", "platform",
+                                   "n_failed", "n_rescued",
+                                   "n_abandoned", "status_counts")}
             for r in results],
     }
     if partial:

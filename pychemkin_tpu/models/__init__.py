@@ -33,7 +33,7 @@ from .psr import (
     openreactor,
     perfectlystirredreactor,
 )
-from .reactornetwork import ReactorNetwork
+from .reactornetwork import ClusterNotApplicableError, ReactorNetwork
 from .reactormodel import (
     BooleanKeyword,
     IntegerKeyword,
@@ -57,6 +57,7 @@ __all__ = [
     "HCCIengine",
     "SIengine",
     "PremixedFlame",
+    "ClusterNotApplicableError",
     "ReactorNetwork",
     "GivenPressureBatchReactor_EnergyConservation",
     "GivenPressureBatchReactor_FixedTemperature",

@@ -33,6 +33,7 @@ from ..logger import logger
 from ..mixture import Mixture
 from ..ops import reactors as reactor_ops
 from ..ops import sensitivity as sens_ops
+from ..resilience.status import name_of
 from .reactormodel import (
     STATUS_FAILED,
     STATUS_NOT_RUN,
@@ -342,9 +343,11 @@ class BatchReactors(ReactorModel):
         ign_s = float(self._solution.ignition_time)
         self._ignition_delay_ms = ign_s * 1.0e3
         ok = bool(self._solution.success)
+        status = int(self._solution.status)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
         self._record_solve(
             wall_s=round(wall_s, 6), success=ok,
+            status=status, status_name=name_of(status),
             n_steps=int(self._solution.n_steps),
             n_rejected=int(self._solution.n_rejected),
             n_newton=int(self._solution.n_newton),
@@ -352,8 +355,8 @@ class BatchReactors(ReactorModel):
                                else None),
             t_end=self._time)
         if not ok:
-            logger.error("batch-reactor integration failed (stalled or "
-                         "step budget exhausted)")
+            logger.error("batch-reactor integration failed (%s)",
+                         name_of(status))
         return self.runstatus
 
     # --- sensitivity & ROP analysis (ASEN / AROP consumption) ----------
@@ -421,7 +424,8 @@ class BatchReactors(ReactorModel):
         reactor's profiles, heat-transfer settings, and tolerances apply
         to every sweep element exactly as in :meth:`run`.
 
-        Returns (ignition_delays_ms [B], success [B])."""
+        Returns (ignition_delays_ms [B], success [B], status [B]) —
+        ``status`` carries each element's SolveStatus code."""
         cond = self._condition
         if T0s is None:
             T0s = np.asarray([cond.temperature])
@@ -450,10 +454,11 @@ class BatchReactors(ReactorModel):
         def one(T0, P0, Y0, t_end):
             sol = reactor_ops.solve_batch(T0=T0, P0=P0, Y0=Y0, t_end=t_end,
                                           **kwargs)
-            return sol.ignition_time, sol.success
+            return sol.ignition_time, sol.success, sol.status
 
-        times, ok = jax.vmap(one)(T0s, P0s, Y0s, t_ends)
-        return np.asarray(times) * 1.0e3, np.asarray(ok)
+        times, ok, status = jax.vmap(one)(T0s, P0s, Y0s, t_ends)
+        return (np.asarray(times) * 1.0e3, np.asarray(ok),
+                np.asarray(status))
 
     # --- solution retrieval (reference: batchreactor.py:1263-1648) ---------
     def get_solution_size(self) -> Tuple[int, int]:

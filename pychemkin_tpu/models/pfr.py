@@ -27,6 +27,7 @@ from ..logger import logger
 from ..mixture import Mixture
 from ..ops import pfr as pfr_ops
 from ..ops import reactors as reactor_ops
+from ..resilience.status import name_of as status_name_of
 from .batch import BatchReactors
 from .reactormodel import STATUS_FAILED, STATUS_SUCCESS
 
@@ -191,13 +192,16 @@ class PlugFlowReactor(BatchReactors):
         # batchreactor.py:623-640); stored unscaled in the ms slot
         self._ignition_delay_ms = float(sol.ignition_distance)
         ok = bool(sol.success)
+        status = int(self._pfr_solution.status)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
         self._record_solve(
             wall_s=round(time.perf_counter() - t0, 6), success=ok,
+            status=status, status_name=status_name_of(status),
             n_steps=int(self._pfr_solution.n_steps),
             length=self._length, energy=self.energy_type)
         if not ok:
-            logger.error("PFR integration failed")
+            logger.error("PFR integration failed (%s)",
+                         status_name_of(status))
         return self.runstatus
 
     def get_ignition_delay(self) -> float:
@@ -266,7 +270,9 @@ class PlugFlowReactor(BatchReactors):
         Overrides the batch-reactor sweep, whose solver table has no PFR
         entry — inheriting it would crash with a bare KeyError. Any
         argument left None takes this reactor's configured value.
-        Returns (ignition_distances_cm [B], success [B])."""
+        Returns (ignition_distances_cm [B], success [B], status [B]) —
+        the same three-array contract as the batch sweep, with
+        ``status`` the per-element SolveStatus code."""
         if self.validate_inputs() != 0:
             raise ValueError("PFR is not fully configured (length, inlet)")
         cond = self._condition
@@ -304,10 +310,10 @@ class PlugFlowReactor(BatchReactors):
                 htc=self._htc, tamb=self._tamb,
                 max_steps_per_segment=self._max_steps,
                 min_slope=min_slope)
-            return sol.ignition_distance, sol.success
+            return sol.ignition_distance, sol.success, sol.status
 
-        dists, ok = jax.vmap(one)(T0s, P0s, Y0s, lengths)
-        return np.asarray(dists), np.asarray(ok)
+        dists, ok, status = jax.vmap(one)(T0s, P0s, Y0s, lengths)
+        return np.asarray(dists), np.asarray(ok), np.asarray(status)
 
     @property
     def exit_stream(self) -> Stream:

@@ -36,6 +36,7 @@ from ..inlet import Stream
 from ..logger import logger
 from ..mixture import Mixture, equilibrium
 from ..ops import psr as psr_ops
+from ..resilience.status import name_of as status_name_of
 from ..ops import thermo
 from .reactormodel import (
     STATUS_FAILED,
@@ -301,9 +302,11 @@ class perfectlystirredreactor(openreactor):
             **self._solve_kwargs())
         self._solution = jax.device_get(sol)
         ok = bool(self._solution.converged)
+        status = int(self._solution.status)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
         self._record_solve(
             wall_s=round(time.perf_counter() - t0, 6), success=ok,
+            status=status, status_name=status_name_of(status),
             n_newton=int(self._solution.n_newton),
             n_newton_direct=(int(self._solution.n_newton_direct)
                              if self._solution.n_newton_direct is not None
@@ -328,7 +331,7 @@ class perfectlystirredreactor(openreactor):
         the reference's serial continuation loop
         (examples/PSR/PSRgas.py:252-255). All elements share this
         reactor's inlets and estimate. Returns (T [B], Y [B, KK],
-        converged [B])."""
+        converged [B], status [B])."""
         T_g, Y_g = self._guess()
         kwargs = self._solve_kwargs()
         if self.mode == psr_ops.MODE_TAU:
@@ -354,7 +357,7 @@ class perfectlystirredreactor(openreactor):
 
         sol = jax.vmap(one)(params)
         return (np.asarray(sol.T), np.asarray(sol.Y),
-                np.asarray(sol.converged))
+                np.asarray(sol.converged), np.asarray(sol.status))
 
     # --- solution (reference: PSR.py:787-865) ------------------------------
     def process_solution(self) -> Stream:

@@ -707,6 +707,18 @@ class ReactorModel:
         :data:`ChemkinLogger` at INFO when the run records it."""
         return dict(self._solve_report)
 
+    @property
+    def solve_status(self) -> Optional[int]:
+        """Machine-readable :class:`SolveStatus` code of the last
+        ``run()`` (None before any run) — the structured reason behind
+        a failed ``runstatus``, not just that it failed."""
+        return self._solve_report.get("status")
+
+    @property
+    def solve_status_name(self) -> Optional[str]:
+        """Human/telemetry name of :attr:`solve_status`."""
+        return self._solve_report.get("status_name")
+
     def _record_solve(self, **fields) -> Dict:
         """Store + emit this run's telemetry (concrete ``run()``s call
         this once per solve)."""
@@ -718,6 +730,9 @@ class ReactorModel:
         rec.inc("model.solves")
         if not report.get("success", True):
             rec.inc("model.failed_solves")
+        sname = report.get("status_name")
+        if sname and sname != "OK":
+            rec.inc(f"model.status.{sname}")
         logger.info(
             "solve_report %s(%s): %s", type(self).__name__, self.label,
             " ".join(f"{k}={v}" for k, v in report.items()

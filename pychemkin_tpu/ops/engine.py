@@ -393,6 +393,7 @@ class EngineSolution(NamedTuple):
     zone_mass: Any       # [NZ] zone masses (initial; constant for HCCI)
     n_steps: Any
     success: Any
+    status: Any = None   # SolveStatus code (int32)
 
 
 def solve_hcci(mech, geo: EngineGeometry, *, T0, P0, Y0, start_CA,
@@ -487,7 +488,8 @@ def solve_hcci(mech, geo: EngineGeometry, *, T0, P0, Y0, start_CA,
                           heat_release=hr, ignition_CA=ign_CA,
                           burned_mass=jnp.full(ts.shape, jnp.nan),
                           zone_mass=m_z,
-                          n_steps=sol.n_steps, success=sol.success)
+                          n_steps=sol.n_steps, success=sol.success,
+                          status=sol.status)
 
 
 def solve_si(mech, geo: EngineGeometry, *, T0, P0, Y0, start_CA, end_CA,
@@ -556,7 +558,8 @@ def solve_si(mech, geo: EngineGeometry, *, T0, P0, Y0, start_CA, end_CA,
     return EngineSolution(CA=CAs, times=ts, T=Ts, P=Ps, V=Vs, Y=Ys,
                           heat_release=hr, ignition_CA=ign_CA,
                           burned_mass=m_b, zone_mass=zone_mass,
-                          n_steps=sol.n_steps, success=sol.success)
+                          n_steps=sol.n_steps, success=sol.success,
+                          status=sol.status)
 
 
 def _cumulative_heat_release(mech, zone_mass, Ys, Ts, zone_mass_t=None):

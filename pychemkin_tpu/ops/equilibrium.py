@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import P_ATM, R_GAS
+from ..resilience import faultinject
+from ..resilience.status import SolveStatus
 from . import linalg, thermo
 
 # constraint codes (internal; wrapper maps the reference's EQOption 1-10)
@@ -80,6 +82,7 @@ class EquilibriumResult(NamedTuple):
     v: Any            # cm^3/g
     residual: Any     # final scaled residual norm
     converged: Any    # bool
+    status: Any = None  # SolveStatus code (int32)
 
 
 def element_moles(mech, Y):
@@ -145,7 +148,7 @@ def _constraint_residual(kind, props, target, nbar):
 
 
 def _solve(mech, b, con1, con2, target1, target2, T_init, P_init, X_init,
-           n_iter=_N_ITER, n_pre=50):
+           n_iter=_N_ITER, n_pre=50, fault_mask=None):
     """Damped Newton on z = [lam, ln nbar, ln T, ln P]. Static structure
     (constraint kinds are Python strings); all array math is traced.
 
@@ -194,12 +197,17 @@ def _solve(mech, b, con1, con2, target1, target2, T_init, P_init, X_init,
     eye = jnp.eye(MM + 3)
 
     def make_body(rfn):
-        def body(_, z):
+        def body(_, carry):
+            z, _unst = carry
             r = rfn(z)
             J = jax.jacfwd(rfn)(z)
             J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-12 * eye
             r = jnp.where(jnp.isfinite(r), r, 1e3)
-            dz = linalg.solve(J, -r)
+            # row-equilibrated: the element-potential Jacobian is a
+            # general Newton matrix whose rows span decades when trace
+            # elements are present
+            dz, unstable = linalg.solve_with_info(
+                J, -r, fault_mask=fault_mask, row_equilibrate=True)
             dz = jnp.where(jnp.isfinite(dz), dz, 0.0)
             # damping: cap potential steps at 8, lnT at 0.3, lnP at 0.5
             mx = jnp.max(jnp.abs(dz))
@@ -214,13 +222,16 @@ def _solve(mech, b, con1, con2, target1, target2, T_init, P_init, X_init,
                                           jnp.log(6000.0)))
             z = z.at[MM + 2].set(jnp.clip(z[MM + 2], jnp.log(1e-2),
                                           jnp.log(1e12)))
-            return z
+            return z, unstable
         return body
 
+    unst0 = jnp.array(False)
     if n_pre > 0 and not (con1 == CON_T and con2 == CON_P):
         pre_resid = make_resid(CON_T, CON_P, jnp.exp(lnT0), P_init)
-        z0 = jax.lax.fori_loop(0, n_pre, make_body(pre_resid), z0)
-    z = jax.lax.fori_loop(0, n_iter, make_body(resid), z0)
+        z0, unst0 = jax.lax.fori_loop(0, n_pre, make_body(pre_resid),
+                                      (z0, unst0))
+    z, lin_unstable = jax.lax.fori_loop(0, n_iter, make_body(resid),
+                                        (z0, unst0))
 
     lam, ln_n, lnT, lnP = z[:MM], z[MM], z[MM + 1], z[MM + 2]
     props = _mixture_props(mech, lam, ln_n, lnT, lnP)
@@ -231,20 +242,34 @@ def _solve(mech, b, con1, con2, target1, target2, T_init, P_init, X_init,
     x = x / jnp.maximum(jnp.sum(x), _TINY)
     wbar = jnp.dot(x, mech.wt)
     Y = x * mech.wt / jnp.maximum(wbar, _TINY)
+    converged = rnorm < 1e-7
+    status = jnp.where(
+        converged, jnp.int32(SolveStatus.OK),
+        jnp.where(~jnp.isfinite(rnorm), jnp.int32(SolveStatus.NONFINITE),
+                  jnp.where(lin_unstable,
+                            jnp.int32(SolveStatus.LINALG_UNSTABLE),
+                            jnp.int32(SolveStatus.TOL_NOT_MET))))
     return EquilibriumResult(
         T=props["T"], P=props["P"], X=x, Y=Y, nbar=props["nbar"],
         h=props["h"], u=props["u"], s=props["s"], v=props["v"],
-        residual=rnorm, converged=rnorm < 1e-7)
+        residual=rnorm, converged=converged, status=status)
 
 
-def equilibrate(mech, T, P, Y, option=1, n_iter=_N_ITER):
+def equilibrate(mech, T, P, Y, option=1, n_iter=_N_ITER,
+                fault_elem=None, fault_level=0):
     """Equilibrium from initial state (T, P, mass fractions Y) holding the
     pair of state variables selected by ``option`` (reference EQOption
     1-9 table, mixture.py:3607-3617) at their INITIAL-state values.
 
     jit/vmap-safe (``option`` must be static). Returns
-    :class:`EquilibriumResult`.
+    :class:`EquilibriumResult` (with a per-element ``status`` code).
+    ``fault_elem``/``fault_level`` thread fault injection for vmapped
+    batches (inert unless a spec is active at trace time).
     """
+    fault_mask = None
+    if fault_elem is not None and faultinject.enabled():
+        fault_mask = faultinject.linalg_unstable_mask(fault_elem,
+                                                      fault_level)
     con1, con2 = EQ_OPTIONS[int(option)]
     T = jnp.asarray(T, jnp.float64)
     P = jnp.asarray(P, jnp.float64)
@@ -294,13 +319,16 @@ def equilibrate(mech, T, P, Y, option=1, n_iter=_N_ITER):
             outer, (jnp.log(T_init), P, X0), None, length=20)
         Tf = jnp.exp(lnT)
         res = _solve(mech, b, CON_T, con1, Tf, mech_target, Tf, P_ws, X_ws,
-                     n_iter=40, n_pre=30)
+                     n_iter=40, n_pre=30, fault_mask=fault_mask)
         cp = jnp.maximum(thermo.mixture_cp_mass(mech, res.T, res.Y), _TINY)
         s_ok = jnp.abs(res.s - s_target) / cp < 1e-7
-        return res._replace(converged=res.converged & s_ok)
+        status = jnp.where(
+            (res.status == jnp.int32(SolveStatus.OK)) & ~s_ok,
+            jnp.int32(SolveStatus.TOL_NOT_MET), res.status)
+        return res._replace(converged=res.converged & s_ok, status=status)
 
     return _solve(mech, b, con1, con2, targets[con1], targets[con2],
-                  T_init, P, X0, n_iter=n_iter)
+                  T_init, P, X0, n_iter=n_iter, fault_mask=fault_mask)
 
 
 def equilibrium_sound_speed(mech, eq: EquilibriumResult, n_iter=40):

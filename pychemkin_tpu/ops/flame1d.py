@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from ..resilience.status import SolveStatus, name_of
 from . import blocktridiag, kinetics, thermo, transport
 from . import equilibrium as eq_ops
 
@@ -421,7 +422,7 @@ def make_newton(mech, cfg: FlameConfig, transient=False):
             cond, body,
             (u0c, jnp.array(False), jnp.array(0),
              jnp.asarray(jnp.inf, dtype=u0.dtype), jnp.array(False)))
-        return u, converged & ~stalled, n_it, last_norm
+        return u, converged & ~stalled, n_it, last_norm, stalled
 
     return newton
 
@@ -448,7 +449,7 @@ class _Programs:
             def timestep(u, data, dt, n_steps):
                 def body(i, carry):
                     u, n_ok = carry
-                    u_new, ok, _, _ = ts_newton(u, data, u_old=u, dt=dt)
+                    u_new, ok, _, _, _ = ts_newton(u, data, u_old=u, dt=dt)
                     u = jnp.where(ok, u_new, u)
                     return u, n_ok + ok.astype(jnp.int32)
                 return jax.lax.fori_loop(0, n_steps, body,
@@ -476,6 +477,7 @@ class FlameSolution(NamedTuple):
     n_regrids: int
     n_newton: Any
     u: Any = None    # packed state [N, M] for CNTN continuation restarts
+    status: Any = None   # SolveStatus code (host int)
     report: Any = None   # per-solve telemetry dict (stage wall times,
     #                      programs compiled, counters) — see solve_flame
 
@@ -572,7 +574,9 @@ def _pin_index(x, T_prof, T_fix):
 def _march(newton_j, timestep_j, u, data, *, dt0, ts_steps, max_rounds,
            verbose=False, timers=None, prefix=""):
     """Newton with pseudo-transient rescue rounds; returns
-    (u, converged, total_newton, dt_last).
+    (u, converged, total_newton, dt_last, stalled) — ``stalled`` is the
+    FINAL Newton attempt's damped-stall flag, the
+    NEWTON_STALL-vs-TOL_NOT_MET signal of the status taxonomy.
 
     ``timers``: optional dict accumulating device-fenced wall time into
     ``<prefix>newton_s`` / ``<prefix>transient_s`` (the int()/bool()
@@ -588,7 +592,7 @@ def _march(newton_j, timestep_j, u, data, *, dt0, ts_steps, max_rounds,
     dt = dt0
     for round_i in range(max_rounds):
         t0 = time.perf_counter()
-        u_new, ok_j, n_it, last_norm = newton_j(u, data)
+        u_new, ok_j, n_it, last_norm, stalled = newton_j(u, data)
         total_newton += int(n_it)
         _charge("newton_s", t0)
         if verbose:
@@ -596,7 +600,7 @@ def _march(newton_j, timestep_j, u, data, *, dt0, ts_steps, max_rounds,
                   f"its={int(n_it)} norm={float(last_norm):.3e} "
                   f"Tmax={float(jnp.max(u_new[:, 0])):.0f}")
         if bool(ok_j):
-            return u_new, True, total_newton, dt
+            return u_new, True, total_newton, dt, False
         t0 = time.perf_counter()
         u, n_ok = timestep_j(u, data, dt, n_steps=ts_steps)
         u = jnp.asarray(jax.device_get(u))
@@ -614,13 +618,14 @@ def _march(newton_j, timestep_j, u, data, *, dt0, ts_steps, max_rounds,
         elif n_ok <= int(0.2 * ts_steps):
             dt = max(dt * 0.2, 1e-9)
     t0 = time.perf_counter()
-    u_new, ok_j, n_it, last_norm = newton_j(u, data)
+    u_new, ok_j, n_it, last_norm, stalled = newton_j(u, data)
     total_newton += int(n_it)
     _charge("newton_s", t0)
     if verbose:
         print(f"  [flame] final newton: ok={bool(ok_j)} "
               f"norm={float(last_norm):.3e}")
-    return (u_new if bool(ok_j) else u), bool(ok_j), total_newton, dt
+    return ((u_new if bool(ok_j) else u), bool(ok_j), total_newton, dt,
+            bool(stalled))
 
 
 def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
@@ -741,10 +746,10 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         cfg_ft = dataclasses.replace(cfg, energy="TGIV", free_flame=False)
         newton_ft, timestep_ft = _Programs.get(mech, cfg_ft, len(x))
         data_ft = make_data(x, i_fix, np.asarray(u[:, 0]))
-        u_ft, ok, n_it, _ = _march(newton_ft, timestep_ft, u, data_ft,
-                                   dt0=ts_dt, ts_steps=ts_steps,
-                                   max_rounds=2, verbose=verbose,
-                                   timers=timers, prefix="fixT_")
+        u_ft, ok, n_it, _, _ = _march(newton_ft, timestep_ft, u, data_ft,
+                                      dt0=ts_dt, ts_steps=ts_steps,
+                                      max_rounds=2, verbose=verbose,
+                                      timers=timers, prefix="fixT_")
         total_newton += n_it
         if ok:
             u = u_ft      # species relaxed on the frozen ramp
@@ -752,6 +757,7 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
     # --- Stage B: the target problem, with regridding
     n_regrids = 0
     converged = False
+    stalled_last = False
     for _round in range(max_regrids + 1):
         # keep T_given sized to the CURRENT grid — for TGIV it is the
         # imposed profile (also on continuation restarts, where skipping
@@ -761,10 +767,9 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         T_given = _estimate(x)
         data = make_data(x, i_fix, T_given)
         newton_j, timestep_j = _Programs.get(mech, cfg, len(x))
-        u, ok, n_it, ts_dt = _march(newton_j, timestep_j, u, data,
-                                    dt0=ts_dt, ts_steps=ts_steps,
-                                    max_rounds=max_ts_rounds,
-                                    verbose=verbose, timers=timers)
+        u, ok, n_it, ts_dt, stalled_last = _march(
+            newton_j, timestep_j, u, data, dt0=ts_dt, ts_steps=ts_steps,
+            max_rounds=max_ts_rounds, verbose=verbose, timers=timers)
         total_newton += n_it
         if not ok:
             converged = False
@@ -787,6 +792,15 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
     mdot_out = float(M_out[0]) if free_flame else mdot_in
     su = mdot_out / rho_u if converged else float("nan")
 
+    if converged:
+        status = int(SolveStatus.OK)
+    elif not bool(np.all(np.isfinite(np.asarray(u)))):
+        status = int(SolveStatus.NONFINITE)
+    elif stalled_last:
+        status = int(SolveStatus.NEWTON_STALL)
+    else:
+        status = int(SolveStatus.TOL_NOT_MET)
+
     report = {
         "wall_s": round(time.perf_counter() - t_solve0, 6),
         "n_newton": int(total_newton),
@@ -795,6 +809,8 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         "programs_built": recorder.counters.get(
             "flame.programs_built", 0) - programs0,
         "converged": bool(converged),
+        "status": status,
+        "status_name": name_of(status),
     }
     report.update({k: round(v, 6) for k, v in sorted(timers.items())})
     recorder.event("flame", energy=energy, free_flame=bool(free_flame),
@@ -807,4 +823,4 @@ def solve_flame(mech, *, P, T_in, Y_in, x_start, x_end, energy="ENRG",
         flame_speed=su,
         converged=converged, n_points=int(x.shape[0]),
         n_regrids=n_regrids, n_newton=total_newton,
-        u=np.asarray(u), report=report)
+        u=np.asarray(u), status=status, report=report)

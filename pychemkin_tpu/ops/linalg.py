@@ -51,6 +51,7 @@ program.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, NamedTuple
 
 import jax
@@ -71,9 +72,42 @@ _DIAG_EPS = 1e-30
 #: many decades below this; a growth-destroyed factor cannot reach it)
 _FALLBACK_RTOL = 1e-6
 
+#: looser threshold for the LINALG_UNSTABLE escalation signal of
+#: :func:`solve_with_info`: a destroyed factor leaves a relative
+#: residual near O(1); a merely ill-conditioned-but-solved system (eps
+#: times condition number) must NOT be flagged, or convergence of
+#: legitimate stiff Newton solves would be vetoed
+_INFO_RTOL = 1e-4
+
 
 def use_mixed_precision() -> bool:
     return jax.default_backend() == "tpu"
+
+
+#: trace-time escalation flag: when True, :func:`factor` uses the
+#: pivoted LU (growth-stable) instead of the pivot-free fast path —
+#: the last rung of the rescue ladder
+#: (:mod:`pychemkin_tpu.resilience.rescue`)
+_FORCE_PIVOTED = [False]
+
+
+@contextlib.contextmanager
+def forced_pivoted():
+    """Force every :func:`factor` traced inside the block onto the
+    pivoted-LU path (f32 + f64 refinement on TPU, exact f64 on CPU).
+    Slow but partial-pivot growth-stable — the rescue ladder's final
+    escalation for elements whose pivot-free factor is the suspected
+    failure. Trace-time: programs traced outside the block are
+    unaffected."""
+    _FORCE_PIVOTED.append(True)
+    try:
+        yield
+    finally:
+        _FORCE_PIVOTED.pop()
+
+
+def pivoted_forced() -> bool:
+    return _FORCE_PIVOTED[-1]
 
 
 class Factorization(NamedTuple):
@@ -148,6 +182,11 @@ def factor(A, mixed: bool | None = None) -> Factorization:
     regardless of platform — the hook CI uses to exercise the TPU path
     on CPU; default None keeps the platform switch."""
     if use_mixed_precision() if mixed is None else mixed:
+        if pivoted_forced():
+            # rescue-ladder escalation: pivoted f32 LU (growth-stable),
+            # keeping A so the f64 refinement sweeps still apply
+            lu, piv = jsl.lu_factor(A.astype(jnp.float32))
+            return Factorization(lu=lu, piv=piv, A=A)
         return Factorization(lu=_lu_nopivot(A.astype(jnp.float32)),
                              piv=None, A=A)
     lu, piv = jsl.lu_factor(A)
@@ -204,7 +243,15 @@ def solve_factored(fac: Factorization, b, refine: int | None = None,
     if fac.A is None:
         return jsl.lu_solve((fac.lu, fac.piv), b)
     n_ref = _REFINE_STEPS if refine is None else refine
-    if b.ndim == fac.lu.ndim:
+    if fac.piv is not None:
+        # pivoted f32 factor kept with A (forced_pivoted escalation):
+        # triangular sweeps via lu_solve, refinement below as usual
+        def tri(bb):
+            if bb.ndim == fac.lu.ndim - 1:
+                return jsl.lu_solve((fac.lu, fac.piv),
+                                    bb[..., None])[..., 0]
+            return jsl.lu_solve((fac.lu, fac.piv), bb)
+    elif b.ndim == fac.lu.ndim:
         # matrix RHS (lu_solve semantics: each COLUMN is a system);
         # _solve_nopivot vectorizes over leading axes with the vector in
         # the LAST axis, so solve the transposed rows and swap back
@@ -258,3 +305,50 @@ def solve(A, b, refine: int | None = None,
         residual_check = n_ref > 0
     return solve_factored(factor(A), b, refine=n_ref,
                           residual_check=residual_check)
+
+
+def solve_with_info(A, b, refine: int | None = None, fault_mask=None,
+                    row_equilibrate: bool = False):
+    """One-shot solve returning ``(x, unstable)``.
+
+    ``unstable`` is a per-system traced bool: True when the FINAL
+    residual ``b - A x`` still fails the stagnation check after every
+    escalation this module has (f64 refinement, pivoted fallback) — the
+    signal the steady-state Newton solvers escalate into
+    ``SolveStatus.LINALG_UNSTABLE`` when the iteration also failed to
+    converge. On the exact-f64 CPU path the check only fires for
+    genuinely (near-)singular systems. ``fault_mask`` (a traced bool
+    from :mod:`pychemkin_tpu.resilience.faultinject`, or None) is OR-ed
+    in so the escalation path is CI-testable without real instability.
+
+    ``row_equilibrate`` scales each row of (A, b) to unit max first —
+    the :mod:`.transport` bordered-SM idiom for general Newton matrices
+    (NOT of the I - c*J form) whose rows span decades: it restores
+    headroom for the pivot-free f32 factor before the residual check
+    has to bail, and leaves the solution of the original system
+    unchanged.
+    """
+    if row_equilibrate:
+        rs = 1.0 / jnp.maximum(jnp.max(jnp.abs(A), axis=-1), 1e-300)
+        A = A * rs[..., :, None]
+        b = b * (rs[..., :, None] if b.ndim == A.ndim else rs)
+    n_ref = _REFINE_STEPS if refine is None else refine
+    fac = factor(A)
+    x = solve_factored(fac, b, refine=n_ref,
+                       residual_check=(fac.A is not None and n_ref > 0))
+    r = b - _matvec(A, x)
+    n_sys_axes = 2 if b.ndim == A.ndim else 1
+    axes = tuple(range(b.ndim - n_sys_axes, b.ndim))
+    rn = jnp.sqrt(jnp.sum(jnp.square(r), axis=axes))
+    bn = jnp.sqrt(jnp.sum(jnp.square(b), axis=axes))
+    unstable = ~(rn <= _INFO_RTOL * bn + 1e-300)
+    if fault_mask is not None:
+        # an injected "unstable factor" must behave like one: the
+        # returned direction is garbage (scaled far off), not just
+        # flagged, so the consuming Newton genuinely fails to converge
+        # and the caller's LINALG_UNSTABLE escalation path really runs
+        mask = jnp.reshape(fault_mask,
+                           jnp.shape(fault_mask) + (1,) * n_sys_axes)
+        x = jnp.where(mask, x * 1e8, x)
+        unstable = unstable | fault_mask
+    return x, unstable

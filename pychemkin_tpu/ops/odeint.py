@@ -46,6 +46,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from ..resilience import faultinject
+from ..resilience.status import SolveStatus, status_counts
 from . import linalg
 
 # ---------------------------------------------------------------------------
@@ -116,6 +118,7 @@ class ODESolution(NamedTuple):
     t_final: Any = None   # diagnostic: integrator time at exit
     stalled: Any = None   # diagnostic: True if the step loop gave up
     n_newton: Any = None  # total Newton iterations (for FLOP accounting)
+    status: Any = None    # per-element SolveStatus code (int32)
 
 
 def solution_stats(sol: "ODESolution", *, label: str = "",
@@ -137,6 +140,8 @@ def solution_stats(sol: "ODESolution", *, label: str = "",
         "n_stalled": (int(np.sum(np.asarray(sol.stalled)))
                       if sol.stalled is not None else None),
     }
+    if sol.status is not None:
+        stats["status_counts"] = status_counts(sol.status)
     if wall_s is not None:
         stats["wall_s"] = round(float(wall_s), 6)
         if wall_s > 0:
@@ -152,6 +157,9 @@ def solution_stats(sol: "ODESolution", *, label: str = "",
             rec.inc("odeint.newton", stats["n_newton"])
         if stats["n_stalled"]:
             rec.inc("odeint.stalled", stats["n_stalled"])
+        for name, n in (stats.get("status_counts") or {}).items():
+            if name != "OK":
+                rec.inc(f"odeint.status.{name}", n)
     return stats
 
 
@@ -177,8 +185,12 @@ def _cast_floats(tree, dtype):
     return jax.tree_util.tree_map(cast, tree)
 
 
-def _make_jac_fn(rhs):
+def _make_jac_fn(rhs, force_f64=False):
     """Platform-appropriate Jacobian of the RHS.
+
+    ``force_f64`` (a rescue-ladder escalation) keeps the whole jacfwd
+    pass in f64 even on TPU — slow (emulated) but removes the f32
+    Jacobian as a suspect for a failing element.
 
     The Jacobian only builds the modified-Newton matrix M = I - h*g*J —
     a preconditioner, not part of the converged answer (the stage
@@ -190,7 +202,7 @@ def _make_jac_fn(rhs):
     extra Newton iteration; the integration accuracy is set by the f64
     residuals and error estimate, not by J. CPU keeps exact f64 (unit
     tests cross-check against scipy at tight tolerances there)."""
-    if linalg.use_mixed_precision():
+    if linalg.use_mixed_precision() and not force_f64:
         def jac_fn(t, y, args):
             args32 = _cast_floats(args, jnp.float32)
             t32 = jnp.asarray(t, jnp.float32)
@@ -207,7 +219,10 @@ def _newton_stage(rhs, t_stage, y_base, z0, h, fac, args, weights):
     """Solve the SDIRK stage equation z = h * f(t_stage, y_base + gamma*z)
     by modified Newton with the factored M = I - h*gamma*J.
 
-    Returns (z, converged, n_iters)."""
+    Returns (z, converged, n_iters, diverged) — ``diverged`` records a
+    growing correction norm (vs merely failing to reach tolerance), the
+    NEWTON_DIVERGED / NEWTON_STALL distinction of the status
+    taxonomy."""
     def body(carry):
         z, _, it, prev_dn, _ = carry
         g = z - h * rhs(t_stage, y_base + _GAMMA * z, args)
@@ -227,8 +242,8 @@ def _newton_stage(rhs, t_stage, y_base, z0, h, fac, args, weights):
 
     init = (z0, jnp.array(False), jnp.array(0), jnp.array(jnp.inf),
             jnp.array(False))
-    z, converged, n_it, _, _ = jax.lax.while_loop(cond, body, init)
-    return z, converged, n_it
+    z, converged, n_it, _, diverged = jax.lax.while_loop(cond, body, init)
+    return z, converged, n_it, diverged
 
 
 def _quad_peak(tq, gq):
@@ -319,10 +334,15 @@ class _StepState(NamedTuple):
     acc_t: Any
     acc_v: Any
     stalled: Any
+    status: Any     # SolveStatus code, set once on first failure
 
 
-def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
-    """Advance from state.t to t_end with adaptive steps (vmap-safe)."""
+def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
+                   args, stall_inject=None):
+    """Advance from state.t to t_end with adaptive steps (vmap-safe).
+
+    ``stall_inject``: optional traced bool from the fault-injection
+    harness forcing every stage-Newton to report non-convergence."""
     n = state.y.shape[0]
     dtype = state.y.dtype
     dt_min = ctrl.dt_min_rel * jnp.maximum(jnp.abs(t_end), 1e-30)
@@ -351,15 +371,18 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
         w = ctrl.atol + ctrl.rtol * jnp.abs(s.y)
 
         z0 = h * s.f
-        z1, ok1, it1 = _newton_stage(rhs, s.t + _C[0] * h, s.y, z0, h,
-                                     fac, args, w)
+        z1, ok1, it1, dv1 = _newton_stage(rhs, s.t + _C[0] * h, s.y, z0, h,
+                                          fac, args, w)
         y_base2 = s.y + _A21 * z1
-        z2, ok2, it2 = _newton_stage(rhs, s.t + _C[1] * h, y_base2, z1, h,
-                                     fac, args, w)
+        z2, ok2, it2, dv2 = _newton_stage(rhs, s.t + _C[1] * h, y_base2, z1,
+                                          h, fac, args, w)
         y_base3 = s.y + _B1 * z1 + _B2 * z2
-        z3, ok3, it3 = _newton_stage(rhs, s.t + h, y_base3, z2, h, fac,
-                                     args, w)
+        z3, ok3, it3, dv3 = _newton_stage(rhs, s.t + h, y_base3, z2, h, fac,
+                                          args, w)
         newton_ok = ok1 & ok2 & ok3
+        newton_diverged = dv1 | dv2 | dv3
+        if stall_inject is not None:
+            newton_ok = newton_ok & ~stall_inject
 
         y_new = y_base3 + _B3 * z3        # stiffly accurate
         e_raw = _ERR_W[0] * z1 + _ERR_W[1] * z2 + _ERR_W[2] * z3
@@ -396,6 +419,18 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
                                                 s.consec_rej))
         stalled = active & (consec >= _MAX_CONSECUTIVE_REJECTS)
 
+        # status taxonomy: classify the stall by the FINAL failed
+        # attempt — nonfinite state beats a diverging Newton beats a
+        # merely non-contracting one; first failure wins across steps
+        fail_code = jnp.where(
+            ~finite, jnp.int32(SolveStatus.NONFINITE),
+            jnp.where(newton_diverged,
+                      jnp.int32(SolveStatus.NEWTON_DIVERGED),
+                      jnp.int32(SolveStatus.NEWTON_STALL)))
+        status = jnp.where(
+            stalled & (s.status == jnp.int32(SolveStatus.OK)),
+            fail_code, s.status)
+
         return _StepState(
             t=jnp.where(accept, s.t + h, s.t),
             y=jnp.where(accept, y_new, s.y),
@@ -407,17 +442,25 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end, args):
             consec_rej=consec,
             acc_t=acc_t, acc_v=acc_v,
             stalled=s.stalled | stalled,
+            status=status,
         )
 
     out = jax.lax.while_loop(cond, body, state)
     # exiting short of t_end (budget exhausted or stall) is a failure; the
     # output point recorded for this segment would otherwise silently hold
-    # y at the wrong time
-    return out._replace(stalled=out.stalled | (out.t < t_end))
+    # y at the wrong time. Short-of-t_end without a stall means the
+    # step-attempt budget ran out — its own status code, so the rescue
+    # ladder can tell "give it more budget" from "the Newton is sick".
+    short = out.t < t_end
+    status = jnp.where(
+        short & (out.status == jnp.int32(SolveStatus.OK)),
+        jnp.int32(SolveStatus.BUDGET_EXHAUSTED), out.status)
+    return out._replace(stalled=out.stalled | short, status=status)
 
 
 def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
-           events=(), max_steps_per_segment=100_000, h0=0.0, jac=None):
+           events=(), max_steps_per_segment=100_000, h0=0.0, jac=None,
+           f64_jac=False, fault_elem=None, fault_level=0):
     """Integrate dy/dt = rhs(t, y, args) from ts[0] through ts[-1]; return
     the solution on the output grid ``ts`` plus event accumulators.
 
@@ -425,8 +468,20 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
     (reference chemkin_wrapper.py:688, :740-779): array-in/array-out, pure,
     jit/vmap-safe. ``atol`` may be a scalar or an [N] vector (the reference's
     ATOL/RTOL keywords, batchreactor.py:91-92, defaults 1e-12/1e-6).
+
+    The returned ``status`` is this element's
+    :class:`~pychemkin_tpu.resilience.status.SolveStatus` code.
+    ``f64_jac`` forces the f64 Jacobian path (rescue escalation).
+    ``fault_elem``/``fault_level`` thread this element's original batch
+    index and rescue rung into the fault-injection harness; both are
+    inert (no graph nodes) unless injection is active at trace time.
     """
     events = tuple(events)
+    stall_inject = None
+    if fault_elem is not None and faultinject.enabled():
+        rhs = faultinject.wrap_rhs(rhs, fault_elem, fault_level)
+        stall_inject = faultinject.newton_stall_mask(fault_elem,
+                                                     fault_level)
     y0 = jnp.asarray(y0)
     ts = jnp.asarray(ts)
     try:
@@ -441,7 +496,7 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
                  max_steps_per_segment=max_steps_per_segment, h0=h0)
 
     if jac is None:
-        jac_fn = _make_jac_fn(rhs)
+        jac_fn = _make_jac_fn(rhs, force_f64=f64_jac)
     else:
         jac_fn = jac
 
@@ -466,10 +521,12 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
         acc_t=acc_t0,
         acc_v=jnp.full((n_ev,), -jnp.inf, dtype=y0.dtype),
         stalled=jnp.array(False),
+        status=jnp.int32(SolveStatus.OK),
     )
 
     def scan_body(st, t_target):
-        st = _solve_segment(rhs, jac_fn, events, ctrl, st, t_target, args)
+        st = _solve_segment(rhs, jac_fn, events, ctrl, st, t_target, args,
+                            stall_inject)
         return st, st.y
 
     state, ys_tail = jax.lax.scan(scan_body, state, ts[1:])
@@ -485,4 +542,5 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
                        event_values=state.acc_v,
                        n_steps=state.n_steps, n_rejected=state.n_rejected,
                        success=success, t_final=state.t,
-                       stalled=state.stalled, n_newton=state.n_newton)
+                       stalled=state.stalled, n_newton=state.n_newton,
+                       status=state.status)

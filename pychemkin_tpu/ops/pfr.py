@@ -134,6 +134,7 @@ class PFRSolution(NamedTuple):
     ignition_distance: Any  # cm (nan if none)
     n_steps: Any
     success: Any
+    status: Any = None   # SolveStatus code (int32)
 
 
 def solve_pfr(mech, energy, *, mdot, T0, P0, Y0, length, area=1.0,
@@ -203,4 +204,5 @@ def solve_pfr(mech, energy, *, mdot, T0, P0, Y0, length, area=1.0,
 
     return PFRSolution(x=xs, T=Ts, P=Ps, u=us, rho=rhos, Y=Ys,
                        residence_time=tres, ignition_distance=ign_x,
-                       n_steps=sol.n_steps, success=sol.success)
+                       n_steps=sol.n_steps, success=sol.success,
+                       status=sol.status)

@@ -38,6 +38,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..resilience import faultinject
+from ..resilience.status import SolveStatus
 from . import kinetics, linalg, thermo
 
 _TINY = 1e-30
@@ -77,6 +79,7 @@ class PSRSolution(NamedTuple):
     # rescue path actually ran for this element)
     n_newton_direct: Any = None
     n_newton_polish: Any = None
+    status: Any = None          # SolveStatus code (int32)
 
 
 def _split(y):
@@ -132,8 +135,11 @@ def make_rhs(mode, energy):
 
 
 def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
-                  species_floor, damping=True):
-    """Damped Newton with masked convergence; returns (y, converged, n)."""
+                  species_floor, damping=True, fault_mask=None):
+    """Damped Newton with masked convergence; returns
+    (y, converged, n, lin_unstable) — ``lin_unstable`` is the linear
+    solver's stagnation flag from the LAST iteration (the
+    LINALG_UNSTABLE escalation signal when the phase also failed)."""
     n = y0.shape[0]
 
     def step_norm(dy, y):
@@ -149,11 +155,12 @@ def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
         return jnp.sqrt(jnp.mean((dy_s / w) ** 2))
 
     def body(carry):
-        y, _, it = carry
+        y, _, it, _ = carry
         r = resid_fn(y, args)
         J = jax.jacfwd(lambda yy: resid_fn(yy, args))(y)
         J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-14 * jnp.eye(n)
-        dy = linalg.solve(J, -jnp.where(jnp.isfinite(r), r, 1e6))
+        dy, unstable = linalg.solve_with_info(
+            J, -jnp.where(jnp.isfinite(r), r, 1e6), fault_mask=fault_mask)
         dy = jnp.where(jnp.isfinite(dy), dy, 0.0)
         if damping:
             # cap temperature moves at 150 K and fraction moves at 0.2
@@ -168,15 +175,22 @@ def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
         y_new = y_new.at[:-1].set(jnp.clip(y_new[:-1], species_floor, 1.0))
         y_new = y_new.at[-1].set(jnp.clip(y_new[-1], 150.0, T_max))
         conv = (alpha >= 1.0 - 1e-12) & (step_norm(dy, y_new) < 1.0)
-        return y_new, conv, it + 1
+        # an unstable-flagged solve must also veto convergence: near a
+        # spurious fixed point the garbage direction is TINY (b ~ 0),
+        # so the step test alone would certify a state the untrusted
+        # factor never actually checked. The cost of a false veto is
+        # one rescue escalation (pivoted LU), not a wrong answer.
+        conv = conv & ~unstable
+        return y_new, conv, it + 1, unstable
 
     def cond(carry):
-        _, conv, it = carry
+        _, conv, it, _ = carry
         return (~conv) & (it < n_iter)
 
-    y, conv, it = jax.lax.while_loop(cond, body,
-                                     (y0, jnp.array(False), jnp.array(0)))
-    return y, conv, it
+    y, conv, it, unstable = jax.lax.while_loop(
+        cond, body, (y0, jnp.array(False), jnp.array(0),
+                     jnp.array(False)))
+    return y, conv, it, unstable
 
 
 def _pseudo_transient_phase(rhs_fn, y0, args, n_steps, dt0, up_factor,
@@ -229,14 +243,23 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
               ss_atol=1e-9, ss_rtol=1e-4, n_newton=50,
               n_pseudo=100, pseudo_dt0=1e-6, pseudo_up=2.0,
               pseudo_down=2.2, pseudo_dt_min=1e-10, pseudo_dt_max=1e-2,
-              T_max=5000.0, species_floor=-1e-14):
+              T_max=5000.0, species_floor=-1e-14,
+              fault_elem=None, fault_level=0):
     """Solve one PSR steady state; jit/vmap-safe.
 
     mode: "tau" (SetResTime) | "vol" (SetVolume);
     energy: "ENRG" | "TGIV". Defaults follow the reference's
     steady-state solver controls (steadystatesolver.py:40-99: atol 1e-9,
     rtol 1e-4, pseudo-transient stride 1e-6 s x 100 steps, up-factor 2.0).
+
+    The returned ``status`` is the element's SolveStatus code;
+    ``fault_elem``/``fault_level`` thread fault injection (inert unless
+    a spec is active at trace time).
     """
+    fault_mask = None
+    if fault_elem is not None and faultinject.enabled():
+        fault_mask = faultinject.linalg_unstable_mask(fault_elem,
+                                                      fault_level)
     mech_args = PSRArgs(
         mech=mech, P=jnp.asarray(P, jnp.float64),
         Y_in=jnp.asarray(Y_in, jnp.float64),
@@ -266,8 +289,9 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
     y0 = jnp.concatenate([jnp.asarray(Y_guess, jnp.float64),
                           jnp.asarray(T_guess, jnp.float64)[None]])
 
-    y1, conv1, n1 = _newton_phase(resid, y0, mech_args, weights, n_newton,
-                                  T_max, species_floor)
+    y1, conv1, n1, unst1 = _newton_phase(resid, y0, mech_args, weights,
+                                         n_newton, T_max, species_floor,
+                                         fault_mask=fault_mask)
 
     # pseudo-transient rescue for unconverged elements; a no-op (masked)
     # when phase 1 already converged
@@ -275,10 +299,12 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
                                    pseudo_up, pseudo_down, pseudo_dt_min,
                                    pseudo_dt_max, T_max, species_floor)
     y_pt = jnp.where(conv1, y1, y_pt)
-    y2, conv2, n2 = _newton_phase(resid, y_pt, mech_args, weights, n_newton,
-                                  T_max, species_floor)
+    y2, conv2, n2, unst2 = _newton_phase(resid, y_pt, mech_args, weights,
+                                         n_newton, T_max, species_floor,
+                                         fault_mask=fault_mask)
     y = jnp.where(conv1, y1, y2)
     converged = conv1 | conv2
+    lin_unstable = jnp.where(conv1, unst1, unst2)
 
     Y, T = _split(y)
     Y = jnp.clip(Y, 0.0, 1.0)
@@ -291,10 +317,17 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
     rfin = resid(y, mech_args)
     rnorm = jnp.sqrt(jnp.mean((rfin / w) ** 2))
     n2 = jnp.where(conv1, 0, n2)    # polish never ran for conv1 elements
+    finite = jnp.all(jnp.isfinite(y)) & jnp.isfinite(rnorm)
+    status = jnp.where(
+        converged, jnp.int32(SolveStatus.OK),
+        jnp.where(~finite, jnp.int32(SolveStatus.NONFINITE),
+                  jnp.where(lin_unstable,
+                            jnp.int32(SolveStatus.LINALG_UNSTABLE),
+                            jnp.int32(SolveStatus.TOL_NOT_MET))))
     return PSRSolution(T=T, Y=Y, rho=rho, tau=tau_eff, volume=V_eff,
                        residual=rnorm, converged=converged,
                        n_newton=n1 + n2, n_newton_direct=n1,
-                       n_newton_polish=n2)
+                       n_newton_polish=n2, status=status)
 
 
 class PSRChainSolution(NamedTuple):
@@ -305,12 +338,14 @@ class PSRChainSolution(NamedTuple):
     residual: Any     # scalar weighted norm
     converged: Any
     n_newton: Any
+    status: Any = None   # SolveStatus code (int32, whole-chain)
 
 
 def solve_psr_chain(mech, energy="ENRG", *, P, Y_in0, h_in0, taus,
                     T_guess, Y_guess, qloss=None, T_fixed=None,
                     mdot=1.0, ss_atol=1e-9, ss_rtol=1e-4, n_newton=80,
-                    T_max=5000.0, species_floor=-1e-14):
+                    T_max=5000.0, species_floor=-1e-14,
+                    fault_elem=None, fault_level=0):
     """Solve a linear chain of PSRs as ONE coupled damped-Newton system
     — the TPU-native form of the reference's PSR cluster mode
     (reference PSR.py:286 set_reactor_index / :464
@@ -322,8 +357,18 @@ def solve_psr_chain(mech, energy="ENRG", *, P, Y_in0, h_in0, taus,
     enters the Jacobian exactly (block lower-bidiagonal) and the whole
     chain converges quadratically together — including near extinction,
     where sequential substitution creeps. jit/vmap-safe; vmap over
-    chains for clustered S-curve sweeps.
+    chains for clustered S-curve sweeps (``jax.vmap`` of a closure over
+    per-chain ``taus``/guesses — tested by
+    ``tests/test_resilience.py::TestChainVmap``).
+
+    The returned ``status`` is a whole-chain SolveStatus code;
+    ``fault_elem``/``fault_level`` thread fault injection for vmapped
+    chain sweeps (inert unless a spec is active at trace time).
     """
+    fault_mask = None
+    if fault_elem is not None and faultinject.enabled():
+        fault_mask = faultinject.linalg_unstable_mask(fault_elem,
+                                                      fault_level)
     KK = mech.n_species
     dtype = jnp.float64
     taus = jnp.asarray(taus, dtype)
@@ -365,11 +410,16 @@ def solve_psr_chain(mech, energy="ENRG", *, P, Y_in0, h_in0, taus,
         return jnp.sqrt(jnp.mean((dz_s / w) ** 2))
 
     def body(carry):
-        z, _, it = carry
+        z, _, it, _ = carry
         r = chain_resid(z)
         J = jax.jacfwd(chain_resid)(z)
         J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-14 * jnp.eye(M)
-        dz = linalg.solve(J, -jnp.where(jnp.isfinite(r), r, 1e6))
+        # row-equilibrated: the coupled chain Jacobian is NOT of the
+        # I - c*J form the pivot-free f32 factor is argued safe for,
+        # and its energy-coupling rows sit decades above species rows
+        dz, unstable = linalg.solve_with_info(
+            J, -jnp.where(jnp.isfinite(r), r, 1e6),
+            fault_mask=fault_mask, row_equilibrate=True)
         dz = jnp.where(jnp.isfinite(dz), dz, 0.0)
         aT = 150.0 / jnp.maximum(jnp.max(jnp.abs(jnp.where(is_T, dz,
                                                            0.0))), _TINY)
@@ -380,17 +430,20 @@ def solve_psr_chain(mech, energy="ENRG", *, P, Y_in0, h_in0, taus,
         z_new = jnp.where(is_T, jnp.clip(z_new, 150.0, T_max),
                           jnp.clip(z_new, species_floor, 1.0))
         conv = (alpha >= 1.0 - 1e-12) & (step_norm(dz, z_new) < 1.0)
-        return z_new, conv, it + 1
+        # unstable vetoes conv — see the rationale in _newton_phase
+        conv = conv & ~unstable
+        return z_new, conv, it + 1, unstable
 
     def cond(carry):
-        _, conv, it = carry
+        _, conv, it, _ = carry
         return (~conv) & (it < n_newton)
 
     z0 = jnp.concatenate([
         jnp.asarray(Y_guess, dtype).reshape(N, KK),
         jnp.asarray(T_guess, dtype).reshape(N, 1)], axis=1).reshape(-1)
-    z, conv, n_it = jax.lax.while_loop(
-        cond, body, (z0, jnp.array(False), jnp.array(0)))
+    z, conv, n_it, lin_unstable = jax.lax.while_loop(
+        cond, body, (z0, jnp.array(False), jnp.array(0),
+                     jnp.array(False)))
 
     ys = z.reshape(N, KK + 1)
     Y = jnp.clip(ys[:, :-1], 0.0, 1.0)
@@ -399,5 +452,12 @@ def solve_psr_chain(mech, energy="ENRG", *, P, Y_in0, h_in0, taus,
     rho = jax.vmap(lambda t, y: thermo.density(mech, t, P, y))(T, Y)
     w = ss_atol + ss_rtol * jnp.abs(z)
     rnorm = jnp.sqrt(jnp.mean((chain_resid(z) / w) ** 2))
+    finite = jnp.all(jnp.isfinite(z)) & jnp.isfinite(rnorm)
+    status = jnp.where(
+        conv, jnp.int32(SolveStatus.OK),
+        jnp.where(~finite, jnp.int32(SolveStatus.NONFINITE),
+                  jnp.where(lin_unstable,
+                            jnp.int32(SolveStatus.LINALG_UNSTABLE),
+                            jnp.int32(SolveStatus.TOL_NOT_MET))))
     return PSRChainSolution(T=T, Y=Y, rho=rho, residual=rnorm,
-                            converged=conv, n_newton=n_it)
+                            converged=conv, n_newton=n_it, status=status)

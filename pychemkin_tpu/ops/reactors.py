@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import R_GAS
-from . import kinetics, thermo
+from ..resilience import faultinject
+from . import kinetics, linalg, thermo
 from .odeint import Event, odeint
 
 
@@ -213,6 +214,7 @@ class BatchSolution(NamedTuple):
     success: Any
     n_rejected: Any = None   # solver stats (FLOP/MFU accounting)
     n_newton: Any = None
+    status: Any = None       # SolveStatus code (int32)
 
 
 def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
@@ -221,13 +223,19 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
                 area_profile=None, volume=1.0, htc=0.0, tamb=298.15,
                 area=0.0, ignition_mode=IGN_T_INFLECTION,
                 ignition_kwargs=None, t_start=0.0,
-                max_steps_per_segment=20_000):
+                max_steps_per_segment=20_000, h0=0.0, f64_jac=False,
+                fault_elem=None, fault_level=0):
     """Solve one 0-D batch reactor; jit/vmap-safe core of the reference's
     ``BatchReactors.run()`` (batchreactor.py:1161).
 
     problem: "CONP" | "CONV"; energy: "ENRG" | "TGIV".
     For CONP the constraint profile is P(t) [dyne/cm^2] (default: constant
     P0); for CONV it is V(t) [cm^3] (default: constant ``volume``).
+
+    ``h0``/``f64_jac`` are rescue-ladder escalation knobs (explicit
+    initial step, f64 Jacobian); ``fault_elem``/``fault_level`` thread
+    fault injection (see :func:`pychemkin_tpu.ops.odeint.odeint`).
+    The returned ``status`` is the per-element SolveStatus code.
     """
     rhs = _RHS[(problem, energy)]
     dtype = jnp.result_type(jnp.asarray(Y0).dtype, jnp.float64)
@@ -271,7 +279,9 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
     atol_vec = jnp.full(y0.shape, atol, dtype=dtype)
     atol_vec = atol_vec.at[-1].set(jnp.maximum(atol * 1e6, 1e-8))
     sol = odeint(rhs, y0, ts, args, rtol=rtol, atol=atol_vec, events=events,
-                 max_steps_per_segment=max_steps_per_segment)
+                 max_steps_per_segment=max_steps_per_segment, h0=h0,
+                 f64_jac=f64_jac, fault_elem=fault_elem,
+                 fault_level=fault_level)
 
     ignition_time = sol.event_times[0]
     if ignition_mode == IGN_T_INFLECTION:
@@ -301,19 +311,31 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
     return BatchSolution(times=ts, T=Ts, P=Ps, volume=Vs, Y=Ys,
                          ignition_time=ignition_time,
                          n_steps=sol.n_steps, success=sol.success,
-                         n_rejected=sol.n_rejected, n_newton=sol.n_newton)
+                         n_rejected=sol.n_rejected, n_newton=sol.n_newton,
+                         status=sol.status)
 
 
 def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                          rtol=1e-6, atol=1e-12,
                          ignition_mode=IGN_T_INFLECTION,
                          ignition_kwargs=None, n_out=2,
-                         max_steps_per_segment=20_000):
+                         max_steps_per_segment=20_000, h0=0.0,
+                         f64_jac=False, pivoted_lu=False,
+                         elem_ids=None, fault_level=0):
     """Batched ignition-delay computation over [B] initial conditions — the
     TPU answer to the reference's serial Python sweep loop
-    (tests/integration_tests/ignitiondelay.py:127-144). Returns a pair
-    ``(ignition_times, success)``, each [B]: ignition times in seconds
-    (nan where not detected) and per-element integrator success flags.
+    (tests/integration_tests/ignitiondelay.py:127-144). Returns a triple
+    ``(ignition_times, success, status)``, each [B]: ignition times in
+    seconds (nan where not detected), per-element integrator success
+    flags, and per-element SolveStatus codes (the machine-readable
+    failure reason the rescue ladder consumes).
+
+    ``h0``/``f64_jac``/``pivoted_lu`` are the rescue-ladder escalation
+    knobs (explicit initial step, f64 Jacobian, pivoted LU factors).
+    ``elem_ids`` [B] carries each element's ORIGINAL batch index for
+    fault injection — a rescue re-solve of a subset passes the original
+    ids so the same elements stay poisoned; defaults to ``arange(B)``
+    when injection is active, None (inert) otherwise.
 
     All inputs broadcast along the leading batch axis.
     """
@@ -328,13 +350,28 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64),
                            (B, jnp.asarray(Y0s).shape[-1]))
     t_ends = jnp.broadcast_to(jnp.asarray(t_ends, jnp.float64), (B,))
+    if elem_ids is None:
+        elem_ids = faultinject.sweep_elem_ids(B)
 
-    def one(T0, P0, Y0, t_end):
+    def one(T0, P0, Y0, t_end, elem):
         sol = solve_batch(mech, problem, energy, T0, P0, Y0, t_end,
                           n_out=n_out, rtol=rtol, atol=atol,
                           ignition_mode=ignition_mode,
                           ignition_kwargs=ignition_kwargs,
-                          max_steps_per_segment=max_steps_per_segment)
-        return sol.ignition_time, sol.success
+                          max_steps_per_segment=max_steps_per_segment,
+                          h0=h0, f64_jac=f64_jac, fault_elem=elem,
+                          fault_level=fault_level)
+        return sol.ignition_time, sol.success, sol.status
 
-    return jax.vmap(one)(T0s, P0s, Y0s, t_ends)
+    def run():
+        if elem_ids is None:
+            return jax.vmap(
+                lambda T0, P0, Y0, te: one(T0, P0, Y0, te, None))(
+                    T0s, P0s, Y0s, t_ends)
+        return jax.vmap(one)(T0s, P0s, Y0s, t_ends,
+                             jnp.asarray(elem_ids))
+
+    if pivoted_lu:
+        with linalg.forced_pivoted():
+            return run()
+    return run()

@@ -130,7 +130,12 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
 
     Each device integrates its shard of initial conditions with the same
     compiled program (SPMD); the mechanism record is replicated. Returns
-    (ignition_times [B] in seconds, success [B]) gathered to the host.
+    (ignition_times [B] in seconds, success [B], status [B]) gathered to
+    the host — ``status`` carries each element's
+    :class:`~pychemkin_tpu.resilience.status.SolveStatus` code, so a
+    sweep's failures arrive machine-readable (feed them to
+    :func:`pychemkin_tpu.resilience.rescue.run_rescue` to re-solve only
+    the failed subset).
 
     ``chunk_size``: process the batch as sequential jitted calls of this
     size (rounded up to a mesh multiple). One compiled program serves
@@ -191,37 +196,41 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     def _load_ck(expect_chunk):
         if checkpoint_path is None or not os.path.exists(
                 checkpoint_path):
-            return 0, [], []
+            return 0, [], [], []
         try:
             with np.load(checkpoint_path, allow_pickle=False) as ck:
                 if (str(ck["sig"]) == ck_sig
-                        and int(ck["chunk"]) == expect_chunk):
+                        and int(ck["chunk"]) == expect_chunk
+                        and "status" in ck):
                     return (int(ck["done_upto"]),
                             [np.asarray(ck["times"])],
-                            [np.asarray(ck["ok"])])
+                            [np.asarray(ck["ok"])],
+                            [np.asarray(ck["status"])])
         except Exception:            # noqa: BLE001 — corrupt/foreign
             # file: a checkpoint is an optimization; recompute instead
             # of dying on exactly the stale-file case we promise to
             # tolerate
             pass
-        return 0, [], []
+        return 0, [], [], []
 
-    def _save_ck(expect_chunk, done_upto, times_parts, ok_parts):
+    def _save_ck(expect_chunk, done_upto, times_parts, ok_parts,
+                 st_parts):
         tmp = checkpoint_path + ".tmp.npz"
         np.savez(tmp, sig=ck_sig, B=B, chunk=expect_chunk,
                  done_upto=done_upto,
                  times=np.concatenate(times_parts),
-                 ok=np.concatenate(ok_parts))
+                 ok=np.concatenate(ok_parts),
+                 status=np.concatenate(st_parts))
         os.replace(tmp, checkpoint_path)
 
     if chunk_size is not None and chunk_size < B:
         chunk = max(n_dev, (chunk_size // n_dev) * n_dev)
-        done_upto, times_parts, ok_parts = _load_ck(chunk)
+        done_upto, times_parts, ok_parts, st_parts = _load_ck(chunk)
         for lo in range(done_upto, B, chunk):
             hi = min(lo + chunk, B)
             # re-enter with exactly one chunk (padded inside); same
             # shapes -> same cached program for every full chunk
-            tpart, okpart = sharded_ignition_sweep(
+            tpart, okpart, stpart = sharded_ignition_sweep(
                 mech, problem, energy,
                 jnp.pad(T0s[lo:hi], (0, chunk - (hi - lo)), mode="edge"),
                 jnp.pad(P0s[lo:hi], (0, chunk - (hi - lo)), mode="edge"),
@@ -237,16 +246,18 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                 _stats_n_real=hi - lo)   # edge-padding is not real work
             times_parts.append(tpart[:hi - lo])
             ok_parts.append(okpart[:hi - lo])
+            st_parts.append(stpart[:hi - lo])
             if checkpoint_path is not None:
-                _save_ck(chunk, hi, times_parts, ok_parts)
-        return (np.concatenate(times_parts), np.concatenate(ok_parts))
+                _save_ck(chunk, hi, times_parts, ok_parts, st_parts)
+        return (np.concatenate(times_parts), np.concatenate(ok_parts),
+                np.concatenate(st_parts))
 
     if checkpoint_path is not None:
         # unchunked sweep: all-or-nothing — a completed matching
         # checkpoint short-circuits; otherwise solve and save one
-        done_upto, times_parts, ok_parts = _load_ck(0)
+        done_upto, times_parts, ok_parts, st_parts = _load_ck(0)
         if done_upto >= B:
-            return times_parts[0][:B], ok_parts[0][:B]
+            return times_parts[0][:B], ok_parts[0][:B], st_parts[0][:B]
 
     T0s, n_real = _pad_to_multiple(T0s, n_dev)
     P0s, _ = _pad_to_multiple(P0s, n_dev)
@@ -270,8 +281,8 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         def one(T0, P0, Y0, t_end):
             sol = reactor_ops.solve_batch(mech, problem, energy, T0, P0, Y0,
                                           t_end, **kwargs)
-            return (sol.ignition_time, sol.success, sol.n_steps,
-                    sol.n_rejected, sol.n_newton)
+            return (sol.ignition_time, sol.success, sol.status,
+                    sol.n_steps, sol.n_rejected, sol.n_newton)
 
         def shard_fn(T0c, P0c, Y0c, tc):
             return jax.vmap(one)(T0c, P0c, Y0c, tc)
@@ -281,7 +292,7 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         # with scalar literals, which the varying-axis type checker rejects
         mapped = jax.jit(shard_map(
             shard_fn, mesh=mesh, in_specs=(spec_, spec_, spec_, spec_),
-            out_specs=(spec_,) * 5, check_vma=False))
+            out_specs=(spec_,) * 6, check_vma=False))
         _sweep_program_cache[cache_key] = mapped
 
     spec = P(axis)
@@ -291,10 +302,12 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         jax.device_put(P0s, in_sharding),
         jax.device_put(Y0s, NamedSharding(mesh, P(axis, None))),
         jax.device_put(t_ends, in_sharding))
-    times, ok, n_steps, n_rej, n_newt = mapped(T0s, P0s, Y0s, t_ends)
+    times, ok, status, n_steps, n_rej, n_newt = mapped(T0s, P0s, Y0s,
+                                                       t_ends)
     if checkpoint_path is not None:
         _save_ck(0, B, [np.asarray(times)[:n_real]],
-                 [np.asarray(ok)[:n_real]])
+                 [np.asarray(ok)[:n_real]],
+                 [np.asarray(status)[:n_real]])
     if stats is not None:
         # count only genuinely distinct elements: chunked callers pad
         # the tail chunk with edge duplicates whose solver work would
@@ -305,7 +318,8 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         stats.add(np.asarray(n_steps)[real].sum(),
                   np.asarray(n_rej)[real].sum(),
                   np.asarray(n_newt)[real].sum())
-    return np.asarray(times)[:n_real], np.asarray(ok)[:n_real]
+    return (np.asarray(times)[:n_real], np.asarray(ok)[:n_real],
+            np.asarray(status)[:n_real])
 
 
 def sharded_sweep_summary(mesh: Mesh, times, ok):

@@ -1,0 +1,49 @@
+"""Solver resilience layer: failure taxonomy, rescue ladder, fault
+injection.
+
+Production batched chemistry (the B=10k north star) needs three things
+the raw solvers don't give by themselves:
+
+1. a **structured failure status** per batch element
+   (:class:`~pychemkin_tpu.resilience.status.SolveStatus`, carried as
+   int32 arrays out of every jitted solver),
+2. a **rescue ladder** (:mod:`~pychemkin_tpu.resilience.rescue`) that
+   re-solves only the failed subset under escalating policies and
+   returns partial results + status instead of a poisoned batch,
+3. a **fault-injection harness**
+   (:mod:`~pychemkin_tpu.resilience.faultinject`, env/context gated,
+   zero cost when off) so every rescue path is CI-testable on CPU.
+
+See the README section "Failure semantics & rescue ladder" for the
+user-facing contract.
+"""
+
+from . import faultinject, rescue, status
+from .faultinject import FaultSpec, inject
+from .rescue import (
+    DEFAULT_LADDER,
+    EscalationStep,
+    RescueReport,
+    rescue_enabled,
+    resilient_ignition_sweep,
+    run_rescue,
+)
+from .status import SolveStatus, failed_mask, name_of, status_counts
+
+__all__ = [
+    "DEFAULT_LADDER",
+    "EscalationStep",
+    "FaultSpec",
+    "RescueReport",
+    "SolveStatus",
+    "failed_mask",
+    "faultinject",
+    "inject",
+    "name_of",
+    "rescue",
+    "rescue_enabled",
+    "resilient_ignition_sweep",
+    "run_rescue",
+    "status",
+    "status_counts",
+]

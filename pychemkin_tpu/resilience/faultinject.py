@@ -1,0 +1,183 @@
+"""Deterministic fault injection — makes every rescue path CI-testable.
+
+Production rescue code that only runs when real hardware misbehaves is
+untested code. This harness forces the three failure classes the
+resilience layer must handle — NaN RHS returns, Newton stalls, and
+linear-solve instability — on *chosen batch elements* of a batched
+solve, entirely on CPU, so ``tests/test_resilience.py`` can walk the
+whole ladder: detect → classify → escalate → rescue or abandon.
+
+Design contract:
+
+- **Zero cost when off.** :func:`enabled` is checked at TRACE time
+  (plain Python); with no active spec the wrappers return their inputs
+  untouched, so compiled programs carry no injection nodes. (Same
+  pattern as ``telemetry.device_counters_enabled``.)
+- **Element-targeted.** Batched entry points thread each lane's
+  ORIGINAL batch index (``fault_elem``, a traced int scalar under
+  ``vmap``) into the solver; a spec names the element indices it
+  poisons. Untargeted lanes compute through a ``jnp.where`` whose
+  selected branch is the unmodified value — their results bit-match an
+  uninjected run.
+- **Escalation-aware.** A spec may declare ``heal_at``: the rescue
+  rung (``fault_level``, also traced) at or above which the fault
+  clears. This is how tests make an element *rescuable* at a chosen
+  rung versus permanently poisoned (abandoned).
+- **Deterministic.** No randomness anywhere; the same spec always
+  poisons the same elements the same way.
+
+Activation, either source (programmatic wins):
+
+- env var ``PYCHEMKIN_FAULTS`` — a JSON object or list of objects,
+  e.g. ``[{"mode": "nan_rhs", "elements": [3], "heal_at": 1}]``
+  (read per-call, so a test harness can set it for child processes);
+- the :func:`inject` context manager with :class:`FaultSpec` objects.
+
+Modes:
+
+- ``nan_rhs``          the ODE RHS returns NaN for the element once
+                       ``t >= t_min`` → classified NONFINITE.
+- ``newton_stall``     every stage-Newton convergence flag is forced
+                       False for the element → consecutive rejections
+                       → classified NEWTON_STALL.
+- ``linalg_unstable``  the element's linear-solve instability flag is
+                       forced on → classified LINALG_UNSTABLE by the
+                       steady-state solvers that carry it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+_ENV = "PYCHEMKIN_FAULTS"
+
+MODES = ("nan_rhs", "newton_stall", "linalg_unstable")
+
+
+class FaultSpec(NamedTuple):
+    """One deterministic fault. ``heal_at < 0`` means the fault never
+    heals (the element must be reported abandoned)."""
+    mode: str
+    elements: Tuple[int, ...]
+    t_min: float = 0.0       # nan_rhs only: poison for t >= t_min
+    heal_at: int = -1        # rescue level at which the fault clears
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        mode = d["mode"]
+        if mode not in MODES:
+            raise ValueError(f"unknown fault mode {mode!r}; "
+                             f"expected one of {MODES}")
+        return cls(mode=mode, elements=tuple(int(e) for e in d["elements"]),
+                   t_min=float(d.get("t_min", 0.0)),
+                   heal_at=int(d.get("heal_at", -1)))
+
+
+#: programmatic spec stack (the :func:`inject` context manager)
+_active: List[FaultSpec] = []
+
+
+def _env_specs() -> List[FaultSpec]:
+    raw = os.environ.get(_ENV)
+    if not raw:
+        return []
+    data = json.loads(raw)
+    if isinstance(data, dict):
+        data = [data]
+    return [FaultSpec.from_dict(d) for d in data]
+
+
+def specs(mode: Optional[str] = None) -> Tuple[FaultSpec, ...]:
+    """Active fault specs (programmatic first, then env), optionally
+    filtered by mode. Evaluated fresh per call — trace-time."""
+    out = list(_active) + _env_specs()
+    if mode is not None:
+        out = [s for s in out if s.mode == mode]
+    return tuple(out)
+
+
+def enabled() -> bool:
+    """Whether ANY fault spec is active (trace-time switch)."""
+    return bool(specs())
+
+
+@contextlib.contextmanager
+def inject(*fault_specs: FaultSpec):
+    """Activate fault specs for the dynamic extent of the block. Specs
+    apply at TRACE time: solves traced inside the block embed the
+    faults; programs traced outside stay clean."""
+    _active.extend(fault_specs)
+    try:
+        yield
+    finally:
+        del _active[len(_active) - len(fault_specs):]
+
+
+def _mask(spec: FaultSpec, elem, level):
+    """Traced bool: this lane (original index ``elem``) is poisoned by
+    ``spec`` at rescue level ``level``."""
+    import jax.numpy as jnp
+
+    sel = jnp.zeros((), dtype=bool)
+    for e in spec.elements:
+        sel = sel | (jnp.asarray(elem) == e)
+    if spec.heal_at >= 0:
+        sel = sel & (jnp.asarray(level) < spec.heal_at)
+    return sel
+
+
+def wrap_rhs(rhs, elem, level):
+    """Wrap an ODE RHS so active ``nan_rhs`` specs poison the targeted
+    elements. Returns ``rhs`` unchanged when no spec applies (zero
+    graph nodes added)."""
+    sps = specs("nan_rhs")
+    if not sps or elem is None:
+        return rhs
+    import jax.numpy as jnp
+
+    def wrapped(t, y, args):
+        f = rhs(t, y, args)
+        bad = jnp.zeros((), dtype=bool)
+        for s in sps:
+            bad = bad | (_mask(s, elem, level) & (t >= s.t_min))
+        return jnp.where(bad, jnp.nan, f)
+
+    return wrapped
+
+
+def newton_stall_mask(elem, level):
+    """Traced bool forcing stage-Newton non-convergence for targeted
+    elements, or None when no ``newton_stall`` spec applies."""
+    return _any_mask("newton_stall", elem, level)
+
+
+def linalg_unstable_mask(elem, level):
+    """Traced bool forcing the linear-solve instability flag for
+    targeted elements, or None when no spec applies."""
+    return _any_mask("linalg_unstable", elem, level)
+
+
+def _any_mask(mode, elem, level):
+    sps = specs(mode)
+    if not sps or elem is None:
+        return None
+    import jax.numpy as jnp
+
+    m = jnp.zeros((), dtype=bool)
+    for s in sps:
+        m = m | _mask(s, elem, level)
+    return m
+
+
+def sweep_elem_ids(B: int) -> Optional[Any]:
+    """Original-index array [B] for a batched sweep — non-None only
+    when injection is active, so the clean path never carries the extra
+    vmapped operand."""
+    if not enabled():
+        return None
+    import jax.numpy as jnp
+
+    return jnp.arange(B)

@@ -1,0 +1,265 @@
+"""Host-side rescue ladder: re-solve only the failed elements of a
+batched solve under an escalating policy.
+
+A B=10k production sweep is only as good as its worst element: one
+stiff/ill-conditioned reactor used to poison the batch with NaNs (or a
+``success=False`` the caller could do nothing about). The resilience
+contract implemented here instead returns **partial results plus
+per-element status**: after a batched solve, the failed-element mask is
+gathered to the host and ONLY that subset is re-solved — escalating
+per attempt until every element is either **rescued** (status OK) or
+**abandoned** with its final machine-readable reason.
+
+The default escalation ladder (the order reflects which failure class
+each rung is aimed at — see :class:`SolveStatus`):
+
+1. ``tight_rtol``   tighter rtol — a tighter controller often walks a
+                    marginal element around the stiff transient that
+                    stalled it at the loose tolerance.
+2. ``small_h0``     tighter rtol + an explicit tiny initial step + a
+                    bigger step budget (BUDGET_EXHAUSTED / startup
+                    stalls; the SDIRK damping ladder gets more room).
+3. ``f64_jac``      adds the f64 Jacobian path (removes the f32
+                    Jacobian as a suspect on TPU; no-op on CPU).
+4. ``pivoted_lu``   adds pivoted LU factors (removes the pivot-free
+                    factorization as a suspect; the LINALG_UNSTABLE
+                    rung).
+
+Rescue attempts re-solve subsets, so each attempt traces its own
+program (subset shapes + different static knobs); on TPU the
+persistent compilation cache amortizes repeats. Bounded work: at most
+``max_attempts`` rungs, and a cooperative per-attempt wall-clock
+budget — a jitted solve cannot be preempted, so an attempt that runs
+past ``attempt_timeout_s`` completes but STOPS the ladder (remaining
+failures are abandoned with their latest status).
+
+Environment knobs (also settable per call):
+
+- ``PYCHEMKIN_RESCUE=0``                   disable rescue entirely
+- ``PYCHEMKIN_RESCUE_MAX_ATTEMPTS``        cap the ladder depth
+- ``PYCHEMKIN_RESCUE_ATTEMPT_TIMEOUT_S``   per-attempt budget (s)
+
+Telemetry: counters ``resilience.rescued`` / ``resilience.abandoned``
+/ ``resilience.status.<NAME>`` on the default recorder plus one
+``rescue`` event per ladder run carrying the full report.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from . import faultinject
+from .status import SolveStatus, failed_mask, name_of, status_counts
+
+
+class EscalationStep(NamedTuple):
+    """One rescue rung: solver knobs for the re-solve of the failed
+    subset. Factors apply to the BASE solve's settings."""
+    name: str
+    rtol_factor: float = 1.0     # rtol *= factor (tighter < 1)
+    h0_rel: float = 0.0          # explicit initial step, fraction of t_end
+    max_steps_factor: float = 1.0  # step budget *= factor
+    f64_jac: bool = False        # force the f64 Jacobian path
+    pivoted_lu: bool = False     # force pivoted LU factors
+
+
+DEFAULT_LADDER: Tuple[EscalationStep, ...] = (
+    EscalationStep("tight_rtol", rtol_factor=0.1),
+    EscalationStep("small_h0", rtol_factor=0.1, h0_rel=1e-7,
+                   max_steps_factor=2.0),
+    EscalationStep("f64_jac", rtol_factor=0.1, h0_rel=1e-7,
+                   max_steps_factor=2.0, f64_jac=True),
+    EscalationStep("pivoted_lu", rtol_factor=0.1, h0_rel=1e-7,
+                   max_steps_factor=2.0, f64_jac=True, pivoted_lu=True),
+)
+
+
+class RescueReport(NamedTuple):
+    """What the ladder did, JSON-ready via :meth:`as_dict`."""
+    n_elements: int
+    n_failed: int          # failures of the base solve
+    n_rescued: int
+    n_abandoned: int
+    attempts: List[Dict]   # per rung: name, n_tried, n_fixed, wall_s
+    status_counts: Dict[str, int]   # FINAL per-status histogram
+
+    def as_dict(self) -> Dict:
+        return {"n_failed": self.n_failed, "n_rescued": self.n_rescued,
+                "n_abandoned": self.n_abandoned,
+                "attempts": list(self.attempts),
+                "status_counts": dict(self.status_counts)}
+
+
+def rescue_enabled() -> bool:
+    return os.environ.get("PYCHEMKIN_RESCUE", "1") != "0"
+
+
+def _env_int(name: str, default: Optional[int]) -> Optional[int]:
+    raw = os.environ.get(name)
+    return int(raw) if raw else default
+
+
+def _env_float(name: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(name)
+    return float(raw) if raw else default
+
+
+def run_rescue(solve_subset, results: Dict[str, np.ndarray], *,
+               ladder: Tuple[EscalationStep, ...] = DEFAULT_LADDER,
+               max_attempts: Optional[int] = None,
+               attempt_timeout_s: Optional[float] = None,
+               recorder=None, label: str = "") -> RescueReport:
+    """Generic rescue engine.
+
+    ``results`` holds the base solve's full-batch arrays and MUST
+    contain ``"status"`` (int codes) — arrays are updated IN PLACE for
+    rescued elements. ``solve_subset(idx, step, level)`` re-solves the
+    elements at original indices ``idx`` under escalation ``step``
+    (1-based rung ``level``) and returns a dict with the same keys,
+    subset-aligned, including ``"status"``.
+    """
+    # explicit call arguments win; the env knobs only fill in defaults
+    if max_attempts is None:
+        max_attempts = _env_int("PYCHEMKIN_RESCUE_MAX_ATTEMPTS", None)
+    if attempt_timeout_s is None:
+        attempt_timeout_s = _env_float(
+            "PYCHEMKIN_RESCUE_ATTEMPT_TIMEOUT_S", None)
+    status = np.asarray(results["status"])
+    n_elements = int(status.size)
+    base_failed = failed_mask(status)
+    n_failed = int(base_failed.sum())
+    attempts: List[Dict] = []
+
+    if n_failed and rescue_enabled():
+        rungs = ladder if max_attempts is None else ladder[:max_attempts]
+        for level, step in enumerate(rungs, start=1):
+            idx = np.nonzero(failed_mask(results["status"]))[0]
+            if idx.size == 0:
+                break
+            t0 = time.perf_counter()
+            sub = solve_subset(idx, step, level)
+            wall_s = time.perf_counter() - t0
+            sub_status = np.asarray(sub["status"])
+            fixed = ~failed_mask(sub_status)
+            for key, arr in results.items():
+                sub_arr = np.asarray(sub[key])
+                if key == "status":
+                    # always adopt the deepest attempt's diagnosis
+                    arr[idx] = sub_arr
+                else:
+                    # partial-results contract: only rescued elements'
+                    # values are replaced; still-failed elements keep
+                    # the base arrays (typically nan markers)
+                    arr[idx[fixed]] = sub_arr[fixed]
+            timed_out = (attempt_timeout_s is not None
+                         and wall_s > attempt_timeout_s)
+            attempts.append({"name": step.name, "level": level,
+                             "n_tried": int(idx.size),
+                             "n_fixed": int(fixed.sum()),
+                             "wall_s": round(wall_s, 6),
+                             "timed_out": bool(timed_out)})
+            if timed_out:
+                # cooperative budget: a jitted attempt cannot be
+                # preempted, so an over-budget rung finishes but the
+                # ladder stops — remaining failures are abandoned
+                break
+
+    final_status = np.asarray(results["status"])
+    still_failed = failed_mask(final_status)
+    n_rescued = int((base_failed & ~still_failed).sum())
+    n_abandoned = int(still_failed.sum())
+    report = RescueReport(
+        n_elements=n_elements, n_failed=n_failed, n_rescued=n_rescued,
+        n_abandoned=n_abandoned, attempts=attempts,
+        status_counts=status_counts(final_status))
+
+    rec = recorder if recorder is not None else telemetry.get_recorder()
+    if n_rescued:
+        rec.inc("resilience.rescued", n_rescued)
+    if n_abandoned:
+        rec.inc("resilience.abandoned", n_abandoned)
+    for sname, n in report.status_counts.items():
+        if sname != "OK":
+            rec.inc(f"resilience.status.{sname}", n)
+    if n_failed:
+        rec.event("rescue", label=label, n_elements=n_elements,
+                  **report.as_dict())
+    return report
+
+
+def resilient_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
+                             t_ends, *, rtol=1e-6, atol=1e-12,
+                             ignition_mode=None, ignition_kwargs=None,
+                             max_steps_per_segment=20_000,
+                             ladder: Tuple[EscalationStep, ...]
+                             = DEFAULT_LADDER,
+                             max_attempts: Optional[int] = None,
+                             attempt_timeout_s: Optional[float] = None,
+                             recorder=None, base_results=None):
+    """Batched ignition-delay sweep with the full resilience contract.
+
+    Runs :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`, then
+    walks the rescue ladder over the failed-element subset. Returns
+    ``(ignition_times [B], success [B], status [B], RescueReport)`` —
+    partial results: healthy and rescued elements carry real values and
+    status OK; abandoned elements keep nan ignition times and their
+    final failure code. The healthy elements' results are the base
+    solve's, untouched by rescue.
+
+    ``base_results``: optional ``{"times", "ok", "status"}`` dict of an
+    ALREADY-RUN base solve over the same inputs (e.g. a sharded sweep)
+    — rescue then only re-solves its failures instead of repeating the
+    base pass.
+    """
+    from ..ops import reactors  # lazy: avoids an import cycle
+
+    if ignition_mode is None:
+        ignition_mode = reactors.IGN_T_INFLECTION
+
+    T0s = np.atleast_1d(np.asarray(T0s, np.float64))
+    B = T0s.shape[0]
+    P0s = np.broadcast_to(np.asarray(P0s, np.float64), (B,))
+    Y0s = np.broadcast_to(np.asarray(Y0s, np.float64),
+                          (B, np.asarray(Y0s).shape[-1]))
+    t_ends = np.broadcast_to(np.asarray(t_ends, np.float64), (B,))
+
+    if base_results is None:
+        times, ok, status = reactors.ignition_delay_sweep(
+            mech, problem, energy, T0s, P0s, Y0s, t_ends, rtol=rtol,
+            atol=atol, ignition_mode=ignition_mode,
+            ignition_kwargs=ignition_kwargs,
+            max_steps_per_segment=max_steps_per_segment)
+    else:
+        times, ok, status = (base_results["times"], base_results["ok"],
+                             base_results["status"])
+    results = {"times": np.array(times), "ok": np.array(ok),
+               "status": np.array(status)}
+
+    def solve_subset(idx, step: EscalationStep, level: int):
+        h0 = (step.h0_rel * float(np.min(t_ends[idx]))
+              if step.h0_rel else 0.0)
+        t, o, s = reactors.ignition_delay_sweep(
+            mech, problem, energy, T0s[idx], P0s[idx], Y0s[idx],
+            t_ends[idx], rtol=rtol * step.rtol_factor, atol=atol,
+            ignition_mode=ignition_mode, ignition_kwargs=ignition_kwargs,
+            max_steps_per_segment=int(max_steps_per_segment
+                                      * step.max_steps_factor),
+            h0=h0, f64_jac=step.f64_jac, pivoted_lu=step.pivoted_lu,
+            # original ids: injected faults must track their elements
+            # through subset re-solves (and heal_at sees the rung)
+            elem_ids=(np.asarray(idx) if faultinject.enabled()
+                      else None),
+            fault_level=level)
+        return {"times": np.asarray(t), "ok": np.asarray(o),
+                "status": np.asarray(s)}
+
+    report = run_rescue(solve_subset, results, ladder=ladder,
+                        max_attempts=max_attempts,
+                        attempt_timeout_s=attempt_timeout_s,
+                        recorder=recorder, label="ignition_sweep")
+    return results["times"], results["ok"], results["status"], report

@@ -1,0 +1,98 @@
+"""Structured solver failure taxonomy.
+
+Every batched solver in this framework used to collapse failure into a
+single boolean (``success`` / ``converged``): a stiff batch element
+that exited :func:`~pychemkin_tpu.ops.odeint.odeint` short of ``t_end``
+was indistinguishable from one whose Newton diverged, whose step budget
+ran out, or whose pivot-free LU factor silently destroyed the solve.
+The rescue ladder (:mod:`pychemkin_tpu.resilience.rescue`) needs the
+*reason* to pick an escalation, and a production caller needs a
+machine-readable code instead of NaNs.
+
+:class:`SolveStatus` is the shared vocabulary. It is an ``IntEnum`` so
+the codes travel as plain ``int32`` arrays **through jitted/vmapped
+solvers** — one status int per batch element, carried in the solution
+NamedTuples (``ODESolution.status``, ``BatchSolution.status``,
+``PSRSolution.status``, ``EquilibriumResult.status``,
+``FlameSolution.status``, ...).
+
+Code semantics (priority when several apply: NONFINITE >
+LINALG_UNSTABLE > NEWTON_DIVERGED > NEWTON_STALL ~ BUDGET_EXHAUSTED >
+TOL_NOT_MET > OK):
+
+- ``OK``                solver met its convergence contract.
+- ``TOL_NOT_MET``       iteration budget ran out while the state was
+                        still finite and improving (fixed-iteration
+                        Newton solvers: equilibrium, PSR phases).
+- ``NEWTON_STALL``      a damped/modified Newton stopped accepting
+                        steps (odeint's consecutive-reject stall, the
+                        flame driver's damped-Newton stall).
+- ``NEWTON_DIVERGED``   the Newton correction norm grew between
+                        iterations on the final failed attempt.
+- ``BUDGET_EXHAUSTED``  the step-attempt budget ran out before
+                        ``t_end`` without a stall (slowly creeping
+                        integration, not a hard failure).
+- ``LINALG_UNSTABLE``   the post-solve residual check of
+                        :mod:`pychemkin_tpu.ops.linalg` stagnated even
+                        after the pivoted fallback on the last Newton
+                        iteration of an unconverged solve.
+- ``NONFINITE``         NaN/Inf reached the state or the error
+                        estimate (poisoned RHS, overflowed factor).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict
+
+import numpy as np
+
+
+class SolveStatus(enum.IntEnum):
+    """Per-element solver exit code (see module docstring)."""
+
+    OK = 0
+    TOL_NOT_MET = 1
+    NEWTON_STALL = 2
+    NEWTON_DIVERGED = 3
+    BUDGET_EXHAUSTED = 4
+    LINALG_UNSTABLE = 5
+    NONFINITE = 6
+
+
+#: every code, in priority order (highest first) — used by mergers
+STATUS_PRIORITY = (
+    SolveStatus.NONFINITE,
+    SolveStatus.LINALG_UNSTABLE,
+    SolveStatus.NEWTON_DIVERGED,
+    SolveStatus.NEWTON_STALL,
+    SolveStatus.BUDGET_EXHAUSTED,
+    SolveStatus.TOL_NOT_MET,
+    SolveStatus.OK,
+)
+
+
+def name_of(code: int) -> str:
+    """Human/telemetry name of one status code; unknown codes render as
+    ``UNKNOWN_<n>`` instead of raising (a forward-compatible log line
+    beats a crashed post-mortem)."""
+    try:
+        return SolveStatus(int(code)).name
+    except ValueError:
+        return f"UNKNOWN_{int(code)}"
+
+
+def status_counts(status: Any) -> Dict[str, int]:
+    """Histogram of a (host or device) status array as
+    ``{status name: count}``, only names that occur. The JSON-ready
+    shape the bench rungs and rescue telemetry record."""
+    arr = np.asarray(status).ravel().astype(np.int64)
+    out: Dict[str, int] = {}
+    for code in np.unique(arr):
+        out[name_of(int(code))] = int(np.sum(arr == code))
+    return out
+
+
+def failed_mask(status: Any) -> np.ndarray:
+    """Host-side boolean mask of elements needing rescue."""
+    return np.asarray(status).astype(np.int64) != int(SolveStatus.OK)

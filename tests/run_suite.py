@@ -33,6 +33,13 @@ Behaviour:
   pytest session; only if EVERY file collected nothing does the suite
   itself exit 5, mirroring single-session pytest semantics;
 - ``-x`` / ``--exitfirst`` stops at the first failing FILE;
+- ``--faults`` runs the resilience suite under ENV-driven fault
+  injection: children get ``PYCHEMKIN_FAULTS`` set to a canned spec
+  (unless the caller already exported one), and — when no files are
+  named explicitly — the run is restricted to ``tests/test_resilience.py``,
+  the file whose env-gated tests exercise the env activation path.
+  Other test files must never run under a global injection spec: their
+  sweeps would pick up the poisoned elements;
 - exit code is 0 iff every file's pytest exited 0 or 5 (with at least
   one 0);
 - a per-file line and a final summary are printed.
@@ -53,8 +60,14 @@ import time
 
 FILE_TIMEOUT = int(os.environ.get("RUN_SUITE_FILE_TIMEOUT", "2400"))
 
+#: the --faults default injection spec: element 1 gets a NaN RHS that
+#: heals at rescue rung 1 — exercised by the env-gated tests of
+#: tests/test_resilience.py
+FAULTS_ENV_SPEC = ('[{"mode": "nan_rhs", "elements": [1], '
+                   '"heal_at": 1}]')
 
-def _child_env():
+
+def _child_env(faults=False):
     env = dict(os.environ)
     # never dial the TPU tunnel from test children (hung-tunnel hazard;
     # tests are pinned to the virtual-CPU mesh anyway)
@@ -63,6 +76,8 @@ def _child_env():
     # tell the child conftest it is already isolated: no re-exec needed
     env["_PYCHEMKIN_TEST_REEXEC"] = "1"
     env["_PYCHEMKIN_SUITE_CHILD"] = "1"
+    if faults:
+        env.setdefault("PYCHEMKIN_FAULTS", FAULTS_ENV_SPEC)
     return env
 
 
@@ -97,6 +112,9 @@ def _split_args(argv):
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     stop_on_fail = any(a in ("-x", "--exitfirst") for a in argv)
+    faults = "--faults" in argv
+    if faults:
+        argv = [a for a in argv if a != "--faults"]
 
     here = os.path.dirname(os.path.abspath(__file__))
     selected, selectors, flags = _split_args(argv)
@@ -105,13 +123,17 @@ def main(argv=None):
         for path in selectors:
             if path not in files:
                 files.append(path)
+    elif faults:
+        # only the resilience suite may run under a global injection
+        # spec — any other file's sweeps would pick up the poison
+        files = [os.path.join(here, "test_resilience.py")]
     else:
         files = sorted(glob.glob(os.path.join(here, "test_*.py")))
     if not files:
         print("run_suite: no test files found", file=sys.stderr)
         return 2
 
-    env = _child_env()
+    env = _child_env(faults=faults)
     results = []
     t_suite = time.time()
     for f in files:

@@ -164,7 +164,7 @@ class TestConpEnergyReactor:
     def test_sweep_monotone_in_temperature(self, chem):
         r = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
         r.time = 0.02
-        taus, ok = r.run_sweep(T0s=np.array([1000.0, 1100.0, 1200.0]))
+        taus, ok, _status = r.run_sweep(T0s=np.array([1000.0, 1100.0, 1200.0]))
         assert ok.all()
         assert np.all(np.diff(taus) < 0.0)   # hotter ignites faster
 
@@ -174,13 +174,13 @@ class TestConpEnergyReactor:
         adiabatic = GivenPressureBatchReactor_EnergyConservation(
             h2_air(chem))
         adiabatic.time = 0.02
-        tau_a, ok_a = adiabatic.run_sweep(T0s=np.array([1000.0]))
+        tau_a, ok_a, _ = adiabatic.run_sweep(T0s=np.array([1000.0]))
         cooled = GivenPressureBatchReactor_EnergyConservation(h2_air(chem))
         cooled.time = 0.02
         cooled.heat_transfer_coefficient = 2.0e7
         cooled.ambient_temperature = 300.0
         cooled.heat_transfer_area = 100.0
-        tau_c, _ = cooled.run_sweep(T0s=np.array([1000.0]))
+        tau_c, _, _ = cooled.run_sweep(T0s=np.array([1000.0]))
         assert ok_a.all()
         # cooling either delays ignition or suppresses it entirely (nan)
         assert (not np.isfinite(tau_c[0])) or tau_c[0] > 1.05 * tau_a[0]

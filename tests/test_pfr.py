@@ -179,7 +179,7 @@ class TestPFRModels:
         r = PlugFlowReactor_EnergyConservation(self._inlet(chem))
         r.length = 50.0
         T0s = np.array([1050.0, 1150.0, 1250.0])
-        dists, ok = r.run_sweep(T0s=T0s)
+        dists, ok, status = r.run_sweep(T0s=T0s)
         assert bool(np.all(ok))
         # hotter inlet ignites earlier along the duct
         assert np.all(np.diff(dists) < 0)

@@ -242,7 +242,7 @@ class TestPSRModels:
         psr.set_inlet(self._make_inlet(chem))
         psr.residence_time = 1e-3
         psr.set_estimate_conditions()
-        T, Y, ok = psr.run_sweep(taus=np.logspace(-2, -4, 7))
+        T, Y, ok, _status = psr.run_sweep(taus=np.logspace(-2, -4, 7))
         assert ok.all()
         assert np.all(np.diff(T) < 0.0)
 

@@ -118,7 +118,7 @@ def test_ignition_monotone_in_temperature(mech):
     """Ignition delay decreases with initial temperature (high-T regime)."""
     Y0 = stoich_h2_air(mech)
     T0s = jnp.array([1100.0, 1250.0, 1400.0])
-    taus, ok = reactors.ignition_delay_sweep(
+    taus, ok, _status = reactors.ignition_delay_sweep(
         mech, "CONP", "ENRG", T0s, P_ATM, jnp.asarray(Y0)[None, :],
         5e-3, rtol=1e-7, atol=1e-13)
     assert bool(jnp.all(ok))
@@ -198,7 +198,7 @@ def test_decreasing_grid_rejected():
 def test_vmap_sweep_batch(mech):
     Y0 = stoich_h2_air(mech)
     T0s = jnp.array([1150.0, 1300.0])
-    taus, ok = reactors.ignition_delay_sweep(
+    taus, ok, _status = reactors.ignition_delay_sweep(
         mech, "CONV", "ENRG", T0s, P_ATM, jnp.asarray(Y0)[None, :], 5e-3,
         rtol=1e-7, atol=1e-13)
     assert bool(jnp.all(ok))

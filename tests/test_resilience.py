@@ -1,0 +1,451 @@
+"""Resilience-layer tests: status taxonomy, fault injection, the rescue
+ladder, and the partial-results contract.
+
+The acceptance scenario (ISSUE 3): inject deterministic faults into 3
+elements of a B=16 ignition sweep on CPU and prove that (a) healthy
+elements BIT-MATCH an uninjected run, (b) every injected element is
+either rescued — status OK after escalation, correct ignition delay —
+or reported abandoned with the right status code, and (c) no NaNs leak
+into the returned arrays for rescued/healthy elements.
+
+Run ``python tests/run_suite.py --faults`` to exercise the ENV-driven
+activation path on top (the env-gated tests below are skipped
+otherwise)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pychemkin_tpu import resilience, telemetry
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import linalg, psr as psr_ops, reactors, thermo
+from pychemkin_tpu.resilience import (
+    EscalationStep,
+    FaultSpec,
+    SolveStatus,
+    faultinject,
+    name_of,
+    run_rescue,
+    status_counts,
+)
+
+P_ATM = 1.01325e6
+T_END = 2e-3
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def stoich_Y(mech):
+    names = list(mech.species_names)
+    X = np.zeros(len(names))
+    X[names.index("H2")] = 2.0
+    X[names.index("O2")] = 1.0
+    X[names.index("N2")] = 3.76
+    return np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+
+
+@pytest.fixture(autouse=True)
+def _no_env_faults(monkeypatch, request):
+    """Deterministic default: the programmatic tests must not see an
+    ambient PYCHEMKIN_FAULTS spec (run_suite --faults sets one); tests
+    marked env_faults opt back in."""
+    if "env_faults" not in request.keywords:
+        monkeypatch.delenv("PYCHEMKIN_FAULTS", raising=False)
+
+
+class TestStatusTaxonomy:
+    def test_names_and_counts(self):
+        assert name_of(0) == "OK"
+        assert name_of(int(SolveStatus.NONFINITE)) == "NONFINITE"
+        assert name_of(99) == "UNKNOWN_99"
+        c = status_counts(np.array([0, 0, 2, 6, 6, 6]))
+        assert c == {"OK": 2, "NEWTON_STALL": 1, "NONFINITE": 3}
+
+    def test_budget_exhausted_vs_newton_stall(self, mech, stoich_Y):
+        """The two 'exited short of t_end' classes must be told apart:
+        a starved step budget is BUDGET_EXHAUSTED (give it more steps);
+        a Newton that stops accepting steps is NEWTON_STALL (escalate
+        the solver, more steps won't help)."""
+        T0s = np.array([1050.0, 1250.0])
+        # 5 step attempts cannot cross an ignition transient: budget
+        _, ok_b, st_b = reactors.ignition_delay_sweep(
+            mech, "CONP", "ENRG", T0s, P_ATM, stoich_Y, T_END,
+            max_steps_per_segment=5)
+        assert not ok_b.any()
+        assert all(int(s) == SolveStatus.BUDGET_EXHAUSTED for s in st_b)
+
+        # forced stage-Newton failure on element 0: consecutive rejects
+        with faultinject.inject(FaultSpec(mode="newton_stall",
+                                          elements=(0,))):
+            _, ok_s, st_s = reactors.ignition_delay_sweep(
+                mech, "CONP", "ENRG", T0s, P_ATM, stoich_Y, T_END)
+        assert int(st_s[0]) == SolveStatus.NEWTON_STALL
+        assert not bool(ok_s[0])
+        assert int(st_s[1]) == SolveStatus.OK and bool(ok_s[1])
+
+    def test_nan_rhs_classified_nonfinite(self, mech, stoich_Y):
+        with faultinject.inject(FaultSpec(mode="nan_rhs",
+                                          elements=(1,))):
+            _, ok, st = reactors.ignition_delay_sweep(
+                mech, "CONP", "ENRG", np.array([1100.0, 1200.0]),
+                P_ATM, stoich_Y, T_END)
+        assert int(st[1]) == SolveStatus.NONFINITE
+        assert int(st[0]) == SolveStatus.OK
+
+
+class TestFaultInjection:
+    def test_zero_cost_when_off(self):
+        """With no active spec the wrappers are identity at TRACE time:
+        the same function object comes back and no mask is built."""
+        assert not faultinject.enabled()
+        rhs = lambda t, y, args: y  # noqa: E731
+        assert faultinject.wrap_rhs(rhs, 0, 0) is rhs
+        assert faultinject.newton_stall_mask(0, 0) is None
+        assert faultinject.linalg_unstable_mask(0, 0) is None
+        assert faultinject.sweep_elem_ids(8) is None
+
+    def test_env_spec_parsing(self, monkeypatch):
+        monkeypatch.setenv(
+            "PYCHEMKIN_FAULTS",
+            '[{"mode": "nan_rhs", "elements": [2, 5], "t_min": 1e-4,'
+            ' "heal_at": 2}]')
+        (spec,) = faultinject.specs()
+        assert spec.mode == "nan_rhs"
+        assert spec.elements == (2, 5)
+        assert spec.t_min == pytest.approx(1e-4)
+        assert spec.heal_at == 2
+        assert faultinject.enabled()
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault mode"):
+            FaultSpec.from_dict({"mode": "typo", "elements": [0]})
+
+    def test_context_scoping(self):
+        spec = FaultSpec(mode="newton_stall", elements=(0,))
+        with faultinject.inject(spec):
+            assert faultinject.specs() == (spec,)
+            with faultinject.inject(spec._replace(elements=(1,))):
+                assert len(faultinject.specs()) == 2
+            assert faultinject.specs() == (spec,)
+        assert faultinject.specs() == ()
+
+
+class TestRunRescueEngine:
+    """Pure-python contract of the generic ladder engine (no solves)."""
+
+    def _results(self, status):
+        status = np.asarray(status, np.int32)
+        return {"times": np.where(status == 0, 1.0, np.nan),
+                "ok": status == 0, "status": status.copy()}
+
+    def test_merges_only_fixed_elements(self):
+        res = self._results([0, 2, 0, 6])
+
+        def solve_subset(idx, step, level):
+            # rung 1 fixes element 1 only; element 3 stays NONFINITE
+            st = np.where(idx == 1, 0, SolveStatus.NONFINITE)
+            return {"times": np.where(st == 0, 42.0, np.nan),
+                    "ok": st == 0, "status": st}
+
+        rec = telemetry.MetricsRecorder()
+        report = run_rescue(solve_subset, res,
+                            ladder=(EscalationStep("only"),),
+                            recorder=rec)
+        assert report.n_failed == 2
+        assert report.n_rescued == 1
+        assert report.n_abandoned == 1
+        assert res["times"][1] == 42.0
+        assert np.isnan(res["times"][3])       # abandoned keeps base nan
+        assert res["times"][0] == 1.0          # healthy untouched
+        assert int(res["status"][3]) == SolveStatus.NONFINITE
+        assert rec.counters["resilience.rescued"] == 1
+        assert rec.counters["resilience.abandoned"] == 1
+        assert rec.counters["resilience.status.NONFINITE"] == 1
+        (ev,) = rec.events("rescue")
+        assert ev["n_failed"] == 2 and ev["attempts"][0]["n_fixed"] == 1
+
+    def test_ladder_stops_when_all_fixed(self):
+        res = self._results([2, 0])
+        calls = []
+
+        def solve_subset(idx, step, level):
+            calls.append(step.name)
+            return {"times": np.ones(idx.size), "ok": np.ones(idx.size,
+                                                             bool),
+                    "status": np.zeros(idx.size, np.int32)}
+
+        run_rescue(solve_subset, res,
+                   ladder=(EscalationStep("a"), EscalationStep("b")),
+                   recorder=telemetry.MetricsRecorder())
+        assert calls == ["a"]                  # second rung never runs
+
+    def test_attempt_timeout_stops_ladder(self):
+        res = self._results([2, 2])
+
+        def solve_subset(idx, step, level):
+            time.sleep(0.05)
+            st = np.full(idx.size, SolveStatus.NEWTON_STALL, np.int32)
+            return {"times": np.full(idx.size, np.nan),
+                    "ok": np.zeros(idx.size, bool), "status": st}
+
+        rec = telemetry.MetricsRecorder()
+        report = run_rescue(solve_subset, res,
+                            ladder=(EscalationStep("a"),
+                                    EscalationStep("b")),
+                            attempt_timeout_s=0.01, recorder=rec)
+        assert len(report.attempts) == 1       # cooperative stop
+        assert report.attempts[0]["timed_out"] is True
+        assert report.n_abandoned == 2
+
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("PYCHEMKIN_RESCUE", "0")
+        res = self._results([2])
+
+        def solve_subset(idx, step, level):  # pragma: no cover
+            raise AssertionError("rescue ran while disabled")
+
+        report = run_rescue(solve_subset, res,
+                            recorder=telemetry.MetricsRecorder())
+        assert report.n_rescued == 0 and report.n_abandoned == 1
+
+
+class TestRescueAcceptance:
+    """The ISSUE 3 acceptance criterion, end to end on CPU."""
+
+    def test_b16_sweep_faults_rescued_or_abandoned(self, mech, stoich_Y):
+        T0s = np.linspace(1000.0, 1400.0, 16)
+        rec = telemetry.get_recorder()
+        rescued0 = rec.counters.get("resilience.rescued", 0)
+        abandoned0 = rec.counters.get("resilience.abandoned", 0)
+
+        # uninjected reference run
+        t_clean, ok_clean, st_clean, rep_clean = \
+            resilience.resilient_ignition_sweep(
+                mech, "CONP", "ENRG", T0s, P_ATM, stoich_Y, T_END)
+        assert rep_clean.n_failed == 0
+        assert status_counts(st_clean) == {"OK": 16}
+
+        faulty = (3, 7, 11)
+        specs = (
+            # NaN RHS healing at rung 1: rescued by tight_rtol
+            FaultSpec(mode="nan_rhs", elements=(3,), heal_at=1),
+            # forced Newton stall healing at rung 2: rescued by small_h0
+            FaultSpec(mode="newton_stall", elements=(7,), heal_at=2),
+            # permanent NaN RHS: must be ABANDONED as NONFINITE
+            FaultSpec(mode="nan_rhs", elements=(11,)),
+        )
+        with faultinject.inject(*specs):
+            t, ok, st, report = resilience.resilient_ignition_sweep(
+                mech, "CONP", "ENRG", T0s, P_ATM, stoich_Y, T_END,
+                max_attempts=2)
+
+        healthy = [i for i in range(16) if i not in faulty]
+        # (a) healthy elements bit-match the uninjected run
+        assert np.array_equal(t[healthy], t_clean[healthy])
+        assert np.array_equal(ok[healthy], ok_clean[healthy])
+        assert all(int(s) == SolveStatus.OK for s in st[healthy])
+
+        # (b) rescued elements: status OK after escalation, correct
+        # ignition delay vs the clean run
+        for i in (3, 7):
+            assert int(st[i]) == SolveStatus.OK, name_of(int(st[i]))
+            assert bool(ok[i])
+            assert t[i] == pytest.approx(t_clean[i], rel=2e-2)
+        # ...and the permanently-poisoned element is abandoned with the
+        # correct code
+        assert int(st[11]) == SolveStatus.NONFINITE
+        assert not bool(ok[11])
+
+        # (c) no NaNs in returned arrays for rescued/healthy elements
+        assert np.all(np.isfinite(t[healthy + [3, 7]]))
+
+        # report + telemetry accounting
+        assert report.n_failed == 3
+        assert report.n_rescued == 2
+        assert report.n_abandoned == 1
+        assert report.status_counts == {"OK": 15, "NONFINITE": 1}
+        assert [a["n_fixed"] for a in report.attempts] == [1, 1]
+        assert rec.counters["resilience.rescued"] == rescued0 + 2
+        assert rec.counters["resilience.abandoned"] == abandoned0 + 1
+
+
+class TestLinalgEscalation:
+    def test_solve_with_info_healthy(self):
+        A = jnp.asarray(np.diag([2.0, 3.0, 4.0]) + 0.1)
+        b = jnp.asarray([1.0, 2.0, 3.0])
+        x, unstable = linalg.solve_with_info(A, b)
+        np.testing.assert_allclose(np.asarray(A) @ np.asarray(x),
+                                   np.asarray(b), rtol=1e-10)
+        assert not bool(unstable)
+
+    def test_forced_pivoted_context(self):
+        """The rescue ladder's pivoted-LU rung: even on the mixed
+        (TPU-style) path, factors built inside the context carry pivot
+        indices and still solve accurately."""
+        rng = np.random.default_rng(0)
+        A = jnp.asarray(rng.normal(size=(6, 6)) + 6 * np.eye(6))
+        b = jnp.asarray(rng.normal(size=6))
+        with linalg.forced_pivoted():
+            fac = linalg.factor(A, mixed=True)
+            assert fac.piv is not None and fac.A is not None
+            x = linalg.solve_factored(fac, b)
+        np.testing.assert_allclose(np.asarray(A) @ np.asarray(x),
+                                   np.asarray(b), rtol=1e-6)
+        fac2 = linalg.factor(A, mixed=True)
+        assert fac2.piv is None            # outside the context: fast path
+
+    def test_psr_linalg_unstable_status(self, mech, stoich_Y):
+        h_in = float(thermo.mixture_enthalpy_mass(mech, 298.15,
+                                                  jnp.asarray(stoich_Y)))
+        kwargs = dict(P=P_ATM, Y_in=stoich_Y, h_in=h_in, tau=1e-3,
+                      T_guess=2000.0, Y_guess=stoich_Y)
+        with faultinject.inject(FaultSpec(mode="linalg_unstable",
+                                          elements=(0,), heal_at=1)):
+            bad = psr_ops.solve_psr(mech, "tau", "ENRG", fault_elem=0,
+                                    fault_level=0, **kwargs)
+            healed = psr_ops.solve_psr(mech, "tau", "ENRG", fault_elem=0,
+                                       fault_level=1, **kwargs)
+        assert int(bad.status) == SolveStatus.LINALG_UNSTABLE
+        assert not bool(bad.converged)
+        assert int(healed.status) == SolveStatus.OK
+
+
+class TestChainVmap:
+    """The ``vmap``-over-chains S-curve claim in the solve_psr_chain
+    docstring, previously untested (ISSUE 3 satellite)."""
+
+    def test_vmap_over_chains_matches_sequential(self, mech, stoich_Y):
+        h_in = float(thermo.mixture_enthalpy_mass(mech, 298.15,
+                                                  jnp.asarray(stoich_Y)))
+        from pychemkin_tpu.ops import equilibrium as eq_ops
+        hot = eq_ops.equilibrate(mech, 1200.0, P_ATM, stoich_Y, option=5)
+        Tg = np.full(2, float(hot.T))
+        Yg = np.tile(np.asarray(hot.Y), (2, 1))
+
+        def one_chain(tau_head):
+            return psr_ops.solve_psr_chain(
+                mech, "ENRG", P=P_ATM, Y_in0=jnp.asarray(stoich_Y),
+                h_in0=h_in, taus=jnp.stack([tau_head, 0.5 * tau_head]),
+                T_guess=jnp.asarray(Tg), Y_guess=jnp.asarray(Yg),
+                mdot=1.0)
+
+        tau_heads = jnp.asarray([3e-3, 1e-3, 3e-4])   # S-curve sweep
+        batched = jax.vmap(one_chain)(tau_heads)
+        assert batched.T.shape == (3, 2)
+        assert bool(np.all(batched.converged))
+        assert all(int(s) == SolveStatus.OK for s in batched.status)
+
+        # each vmapped chain must match its standalone solve
+        for k, tau in enumerate(np.asarray(tau_heads)):
+            single = one_chain(jnp.asarray(tau))
+            np.testing.assert_allclose(np.asarray(batched.T[k]),
+                                       np.asarray(single.T), rtol=1e-8)
+        # ignited branch: every reactor sits far above the inlet
+        assert np.all(np.asarray(batched.T) > 1500.0)
+
+
+class TestModelSurface:
+    def test_batch_run_reports_status(self, mech, stoich_Y):
+        from pychemkin_tpu.chemistry import Chemistry
+        from pychemkin_tpu.mixture import Mixture
+        from pychemkin_tpu.models.batch import (
+            GivenPressureBatchReactor_EnergyConservation,
+        )
+
+        chem = Chemistry.from_mechanism(mech)
+        mix = Mixture(chem)
+        mix.temperature = 1200.0
+        mix.pressure = P_ATM
+        mix.Y = stoich_Y
+        r = GivenPressureBatchReactor_EnergyConservation(mix)
+        r.time = 5e-4
+        assert r.run() == 0
+        assert r.solve_status == int(SolveStatus.OK)
+        assert r.solve_status_name == "OK"
+        rep = r.solve_report()
+        assert rep["status"] == 0 and rep["status_name"] == "OK"
+
+
+@pytest.mark.env_faults
+@pytest.mark.skipif("PYCHEMKIN_FAULTS" not in os.environ,
+                    reason="env-driven injection: run via "
+                           "tests/run_suite.py --faults")
+class TestEnvDrivenFaults:
+    """Exercised by ``python tests/run_suite.py --faults``: the canned
+    env spec poisons element 1 (NaN RHS, heals at rung 1)."""
+
+    def test_env_spec_active_and_rescued(self, mech, stoich_Y):
+        assert faultinject.enabled()
+        T0s = np.linspace(1100.0, 1300.0, 4)
+        t, ok, st, report = resilience.resilient_ignition_sweep(
+            mech, "CONP", "ENRG", T0s, P_ATM, stoich_Y, T_END,
+            max_attempts=1)
+        assert report.n_failed >= 1
+        assert int(st[1]) == SolveStatus.OK       # rescued at rung 1
+        assert np.all(np.isfinite(t))
+
+
+class TestRunSuiteFaultsFlag:
+    def test_faults_flag_sets_child_env(self, tmp_path):
+        """run_suite --faults must export the canned PYCHEMKIN_FAULTS
+        spec to its children (and still pass explicit file args)."""
+        probe = tmp_path / "test_probe_env.py"
+        probe.write_text(
+            "import json, os\n"
+            "def test_env():\n"
+            "    spec = json.loads(os.environ['PYCHEMKIN_FAULTS'])\n"
+            "    assert spec[0]['mode'] == 'nan_rhs'\n")
+        suite = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "run_suite.py")
+        env = dict(os.environ)
+        env.pop("PYCHEMKIN_FAULTS", None)
+        env["RUN_SUITE_FILE_TIMEOUT"] = "120"
+        r = subprocess.run(
+            [sys.executable, suite, "--faults", str(probe)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_faults_flag_defaults_to_resilience_file(self):
+        """Without explicit files, --faults restricts the run to
+        test_resilience.py (a global spec would poison other files)."""
+        import importlib.util
+
+        suite_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "run_suite.py")
+        spec = importlib.util.spec_from_file_location("_rs_probe",
+                                                      suite_path)
+        rs = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rs)
+
+        recorded = {}
+
+        def fake_run(cmd, env=None, timeout=None):
+            recorded["files"] = [a for a in cmd if a.endswith(".py")]
+            recorded["env"] = env
+
+            class R:
+                returncode = 0
+            return R()
+
+        orig = rs.subprocess.run
+        rs.subprocess.run = fake_run
+        try:
+            rc = rs.main(["--faults"])
+        finally:
+            rs.subprocess.run = orig
+        assert rc == 0
+        assert len(recorded["files"]) == 1
+        assert recorded["files"][0].endswith("test_resilience.py")
+        assert "PYCHEMKIN_FAULTS" in recorded["env"]

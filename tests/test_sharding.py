@@ -45,7 +45,7 @@ def test_sweep_padding_odd_batch(mech, stoich_Y):
     exactly 13 results come back, matching the unsharded reference."""
     mesh = parallel.make_mesh()
     T0s = np.linspace(1000.0, 1400.0, 13)
-    times, ok = parallel.sharded_ignition_sweep(
+    times, ok, _status = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
         mesh=mesh, rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
     assert times.shape == (13,) and ok.shape == (13,)
@@ -62,10 +62,10 @@ def test_sweep_matches_unsharded(mech, stoich_Y):
 
     T0s = np.linspace(1050.0, 1350.0, 8)
     mesh = parallel.make_mesh()
-    t_sh, ok_sh = parallel.sharded_ignition_sweep(
+    t_sh, ok_sh, _ = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
         mesh=mesh, rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
-    t_ref, ok_ref = reactors.ignition_delay_sweep(
+    t_ref, ok_ref, _ = reactors.ignition_delay_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
         rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
     assert np.array_equal(np.asarray(ok_sh), np.asarray(ok_ref))
@@ -80,7 +80,7 @@ def test_failure_isolation(mech, stoich_Y):
     mesh = parallel.make_mesh()
     T0s = np.full(8, 1200.0)
     T0s[3] = np.nan
-    times, ok = parallel.sharded_ignition_sweep(
+    times, ok, _status = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
         mesh=mesh, rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
     assert not ok[3]
@@ -89,7 +89,7 @@ def test_failure_isolation(mech, stoich_Y):
     assert np.all(ok[others])
     # the healthy elements still report the correct ignition time
     assert np.all(np.isfinite(times[others]))
-    t_ref, ok_ref = parallel.sharded_ignition_sweep(
+    t_ref, ok_ref, _ = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", np.full(8, 1200.0), 1.01325e6, stoich_Y,
         2e-3, mesh=mesh, rtol=1e-6, atol=1e-12,
         max_steps_per_segment=8000)
@@ -133,12 +133,12 @@ def test_checkpointed_sweep_resumes(mech, stoich_Y, tmp_path):
     T0s = np.linspace(1050.0, 1350.0, 24)
     kw = dict(mesh=mesh, rtol=1e-6, atol=1e-12,
               max_steps_per_segment=8000, chunk_size=8)
-    ref_t, ref_ok = parallel.sharded_ignition_sweep(
+    ref_t, ref_ok, _ = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3, **kw)
 
     ck = str(tmp_path / "sweep.ck.npz")
     full_stats = parallel.SweepStats()
-    t1, ok1 = parallel.sharded_ignition_sweep(
+    t1, ok1, _ = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
         checkpoint_path=ck, stats=full_stats, **kw)
     np.testing.assert_allclose(t1, ref_t, rtol=1e-12)
@@ -149,10 +149,11 @@ def test_checkpointed_sweep_resumes(mech, stoich_Y, tmp_path):
     saved["done_upto"] = np.asarray(16)
     saved["times"] = saved["times"][:16]
     saved["ok"] = saved["ok"][:16]
+    saved["status"] = saved["status"][:16]
     np.savez(ck, **saved)
 
     resume_stats = parallel.SweepStats()
-    t2, ok2 = parallel.sharded_ignition_sweep(
+    t2, ok2, _ = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
         checkpoint_path=ck, stats=resume_stats, **kw)
     np.testing.assert_allclose(t2, ref_t, rtol=1e-12)
@@ -170,11 +171,11 @@ def test_checkpoint_ignores_stale_file(mech, stoich_Y, tmp_path):
     kw = dict(mesh=mesh, rtol=1e-6, atol=1e-12,
               max_steps_per_segment=8000, chunk_size=8,
               checkpoint_path=ck)
-    t1, _ = parallel.sharded_ignition_sweep(
+    t1, _, _ = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3, **kw)
     # same T0 grid, different pressure: delays must differ, and the
     # stale checkpoint must not short-circuit the solve
-    t2, ok2 = parallel.sharded_ignition_sweep(
+    t2, ok2, _ = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 3.0 * 1.01325e6, stoich_Y, 2e-3,
         **kw)
     assert np.all(ok2)

@@ -162,14 +162,37 @@ class TestDeviceCounterBridge:
 # bench banking contract
 
 
-def _fake_config_result(mech, B, platform="tpu"):
+#: every key a bench rung JSON line must carry — the banked-summary
+#: schema consumers (post-mortems, VERDICT parsing) rely on, including
+#: the resilience counters added by ISSUE 3
+RUNG_SCHEMA_KEYS = (
+    "platform", "n_chips", "mech", "B", "chunk", "compile_s", "run_s",
+    "throughput", "rtol", "atol", "t_end", "n_ok", "n_ignited",
+    "n_steps", "n_rejected", "n_newton", "steps_per_sec",
+    "model_f32_gflop", "model_f64_gflop", "mfu_pct",
+    "n_failed", "n_rescued", "n_abandoned", "status_counts",
+)
+
+#: rung keys that _build_summary must forward into configs_run
+CONFIGS_RUN_KEYS = (
+    "mech", "B", "chunk", "throughput", "mfu_pct", "n_failed",
+    "n_rescued", "n_abandoned", "status_counts",
+)
+
+
+def _fake_config_result(mech, B, platform="tpu", n_failed=0):
     return {
         "platform": platform, "n_chips": 4, "mech": mech, "B": B,
         "chunk": min(B, 256), "compile_s": 10.0, "run_s": 1.0,
         "throughput": float(B), "rtol": 1e-6, "atol": 1e-12,
-        "t_end": 2e-3, "n_ok": B, "n_ignited": B, "n_steps": 100 * B,
+        "t_end": 2e-3, "n_ok": B - n_failed, "n_ignited": B - n_failed,
+        "n_steps": 100 * B,
         "n_rejected": B, "n_newton": 400 * B, "steps_per_sec": 1e5,
         "model_f32_gflop": 1.0, "model_f64_gflop": 0.1, "mfu_pct": 1.5,
+        "n_failed": n_failed, "n_rescued": max(n_failed - 1, 0),
+        "n_abandoned": min(n_failed, 1),
+        "status_counts": ({"OK": B - 1, "NONFINITE": 1} if n_failed
+                          else {"OK": B}),
     }
 
 
@@ -223,6 +246,12 @@ class TestBenchBanking:
         assert summaries[-1]["value"] == 64.0
         assert all(c["mfu_pct"] is not None
                    for c in summaries[-1]["configs_run"])
+        # configs_run schema: the resilience counters ride along into
+        # every banked summary (partial lines included)
+        for summary in summaries:
+            for cfg in summary["configs_run"]:
+                for key in CONFIGS_RUN_KEYS:
+                    assert key in cfg, f"missing {key} in configs_run"
         with open(bank) as f:
             banked = json.load(f)
         assert len(banked["configs_run"]) == 2    # final rewrite
@@ -232,7 +261,8 @@ class TestBenchBanking:
         monkeypatch.setenv("BENCH_BASELINE_N", "0")
         monkeypatch.setenv("BENCH_CPU_COMPARE", "0")
         monkeypatch.delenv("BENCH_BANK_PATH", raising=False)
-        self._patch(monkeypatch, [_fake_config_result("h2o2", 16)],
+        self._patch(monkeypatch,
+                    [_fake_config_result("h2o2", 16, n_failed=2)],
                     fail_at=1)
         benchmarks.main()
         summaries = _summary_lines(capfd.readouterr().out)
@@ -240,6 +270,12 @@ class TestBenchBanking:
         assert final["value"] == 16.0             # first rung banked
         assert "timed out" in final["error"]
         assert len(final["configs_run"]) == 1
+        # rescue counters survive into the banked rung record
+        cfg = final["configs_run"][0]
+        assert cfg["n_failed"] == 2
+        assert cfg["n_rescued"] == 1
+        assert cfg["n_abandoned"] == 1
+        assert cfg["status_counts"] == {"OK": 15, "NONFINITE": 1}
 
     def test_total_budget_stops_ladder_with_time_to_spare(
             self, monkeypatch, capfd):
@@ -312,6 +348,22 @@ class TestBenchBanking:
         assert last["configs_run"][0]["mfu_pct"] is not None
         with open(bank) as f:
             assert json.load(f)["configs_run"][0]["B"] == 16
+
+
+class TestBenchRungSchema:
+    @pytest.mark.slow
+    def test_child_config_emits_full_schema_on_cpu(self, capfd,
+                                                   monkeypatch):
+        """The REAL bench child's rung JSON must carry every schema key
+        — including the resilience counters — not just the fakes the
+        banking tests use."""
+        monkeypatch.setenv("BENCH_CHUNK", "8")
+        benchmarks._child_config("h2o2", 4, 1)
+        rung = _summary_lines(capfd.readouterr().out)[-1]
+        for key in RUNG_SCHEMA_KEYS:
+            assert key in rung, f"missing rung key {key}"
+        assert rung["n_failed"] == 0
+        assert rung["status_counts"] == {"OK": 4}
 
 
 class TestAblationTool:
