@@ -44,6 +44,12 @@ Each rung also records the resilience outcome of its sweep —
 after the clean-path measurement, so the headline throughput is
 unchanged while the artifact still carries the per-rung
 partial-results story (schema asserted by tests/test_telemetry.py).
+Rung sweeps run under the durable-job driver
+(``pychemkin_tpu/resilience/driver.py``) and additionally record
+``resume_count`` / ``chunks_replayed`` / ``driver_overhead_s`` — what
+durability did and what it cost (the overhead figure is the banking
+cost of the checkpointed warm-up pass; the timed passes run
+checkpoint-free so the headline throughput stays clean).
 
 Environment knobs:
   BENCH_LADDER      comma list of mech:B pairs (default
@@ -68,6 +74,10 @@ Environment knobs:
                          (atomic tmp+rename rewrite after every rung);
                          a sibling ``<path>.events.jsonl`` gets the
                          crash-safe telemetry event stream
+  BENCH_CKPT_DIR         checkpoint-bank each rung's warm-up sweep to
+                         ``<dir>/<mech>_B<B>.ck.npz`` — a killed rung
+                         resumes its warm-up work on the next run and
+                         reports ``resume_count`` > 0 in its rung JSON
 """
 
 from __future__ import annotations
@@ -165,7 +175,7 @@ def _child_config(mech_name: str, B: int, repeats: int):
     # import itself (pychemkin_tpu/__init__.py)
     import jax
 
-    from . import parallel
+    from . import parallel, resilience
     from .mechanism import load_embedded
 
     (t_lo, t_hi), t_end, rtol, atol = _PROTOCOL[mech_name]
@@ -186,31 +196,67 @@ def _child_config(mech_name: str, B: int, repeats: int):
     P0s = 1.01325e6 * (1.0 + rng.uniform(0.0, 1.0, B))  # 1-2 atm spread
     chunk = int(os.environ.get("BENCH_CHUNK", 256))
 
-    def sweep(stats=None):
+    # every sweep runs under the durable-job driver; with BENCH_CKPT_DIR
+    # set, the WARMUP pass is additionally checkpoint-banked, so a
+    # killed/preempted rung resumes its warm-up work on the next run —
+    # the timed passes stay checkpoint-free (a resumed short-circuit
+    # would fake the throughput)
+    ck_dir = os.environ.get("BENCH_CKPT_DIR") or None
+    ck_path = (os.path.join(ck_dir, f"{mech_name}_B{B}.ck.npz")
+               if ck_dir else None)
+
+    def sweep(stats=None, job_report=None, checkpoint_path=None):
         return parallel.sharded_ignition_sweep(
             mech, "CONP", "ENRG", T0s, P0s, Y0, t_end, mesh=mesh,
             rtol=rtol, atol=atol, max_steps_per_segment=20_000,
-            chunk_size=chunk, stats=stats)
+            chunk_size=chunk, stats=stats, job_report=job_report,
+            checkpoint_path=checkpoint_path)
 
+    warmup_report: dict = {}
     t0 = time.time()
-    times, ok, status = sweep()    # compile + warm-up (chunk-sized shape)
+    try:
+        # compile + warm-up (chunk-sized shape)
+        times, ok, status = sweep(job_report=warmup_report,
+                                  checkpoint_path=ck_path)
+    except resilience.JobInterrupted as e:
+        # preempted mid-warm-up with the in-flight chunk banked: honor
+        # the documented contract — exit with the resumable rc so the
+        # orchestrator reruns (and resumes) instead of marking failure
+        print(f"# warmup interrupted: {e}", file=sys.stderr)
+        sys.exit(e.rc)
+    if ck_path:
+        # the checkpoint exists to survive a kill DURING warm-up; once
+        # the warm-up lands, consume it — a leftover complete manifest
+        # would short-circuit the next run's warm-up and push the
+        # compile into the timed pass
+        try:
+            os.remove(ck_path)
+        except OSError:
+            pass
+        if warmup_report.get("chunks_run", 0) == 0:
+            # fully resumed from a leftover manifest: nothing actually
+            # executed, so nothing is warm — run one clean warm-up
+            times, ok, status = sweep()
     compile_s = time.time() - t0
     print(f"# compile+warmup: {compile_s:.1f}s", file=sys.stderr)
 
     wall = []
     stats = None
+    timed_report: dict = {}
+    timed_replayed = 0
     for _ in range(repeats):
         stats = parallel.SweepStats()
+        timed_report = {}
         t0 = time.time()
-        times, ok, status = sweep(stats)
+        times, ok, status = sweep(stats, job_report=timed_report)
         wall.append(time.time() - t0)
+        timed_replayed += timed_report.get("chunks_replayed", 0)
     run_s = min(wall)
 
     # resilience pass (untimed — the headline number is the clean-path
     # throughput): failed elements get the rescue ladder; the rung's
     # JSON records what rescue did so the bench artifact carries the
     # production partial-results story per rung
-    from . import resilience
     times, ok, status, rescue_report = resilience.resilient_ignition_sweep(
         mech, "CONP", "ENRG", T0s, P0s, Y0, t_end, rtol=rtol, atol=atol,
         max_steps_per_segment=20_000,
@@ -242,7 +288,21 @@ def _child_config(mech_name: str, B: int, repeats: int):
         n_failed=rescue_report.n_failed,
         n_rescued=rescue_report.n_rescued,
         n_abandoned=rescue_report.n_abandoned,
-        status_counts=rescue_report.status_counts)), flush=True)
+        status_counts=rescue_report.status_counts,
+        # durability: what the driver did. resume_count and
+        # chunks_replayed are LIFETIME counters of the rung's banked
+        # warm-up job — they ride in the manifest, so a rung that was
+        # killed and resumed reports every process's resumes/replays,
+        # not just this one's — plus any this-process timed-pass
+        # retries (the timed passes run checkpoint-free, so a resumed
+        # short-circuit can't fake the throughput and their driver
+        # overhead is zero by construction; the warm-up's overhead is
+        # the real per-sweep banking cost when BENCH_CKPT_DIR is set)
+        resume_count=warmup_report.get("resume_count", 0),
+        chunks_replayed=warmup_report.get("chunks_replayed", 0)
+        + timed_replayed,
+        driver_overhead_s=round(
+            warmup_report.get("driver_overhead_s", 0.0), 6))), flush=True)
 
 
 def _child_baseline(mech_name: str, n_points: int, budget_s: float):
@@ -493,7 +553,9 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
                                    "steps_per_sec", "n_steps",
                                    "n_rejected", "n_newton", "platform",
                                    "n_failed", "n_rescued",
-                                   "n_abandoned", "status_counts")}
+                                   "n_abandoned", "status_counts",
+                                   "resume_count", "chunks_replayed",
+                                   "driver_overhead_s")}
             for r in results],
     }
     if partial:

@@ -416,7 +416,9 @@ class BatchReactors(ReactorModel):
         return sens_ops.dominant_reactions(
             table, mech, k, threshold=self._rop_threshold)
 
-    def run_sweep(self, T0s=None, P0s=None, Y0s=None, t_ends=None):
+    def run_sweep(self, T0s=None, P0s=None, Y0s=None, t_ends=None, *,
+                  chunk_size=None, checkpoint_path=None,
+                  job_report=None, driver_kwargs=None):
         """Batched ignition-delay sweep over initial conditions — the TPU
         replacement for the reference's serial Python loops (SURVEY.md
         §2.3; tests/integration_tests/ignitiondelay.py:127-144). Any
@@ -424,8 +426,22 @@ class BatchReactors(ReactorModel):
         reactor's profiles, heat-transfer settings, and tolerances apply
         to every sweep element exactly as in :meth:`run`.
 
+        The sweep runs under the durable-job driver
+        (:func:`pychemkin_tpu.resilience.driver.run_sweep_job`):
+        ``chunk_size`` splits the batch into sequential same-shape
+        jitted calls, ``checkpoint_path`` banks every completed chunk
+        atomically so a killed process resumes instead of restarting
+        (SIGTERM finishes the in-flight chunk, banks, and raises
+        :class:`~pychemkin_tpu.resilience.driver.JobInterrupted` with
+        the resumable rc), and ``job_report`` (a dict) is filled in
+        place with the driver's
+        :class:`~pychemkin_tpu.resilience.driver.SweepJobReport`.
+
         Returns (ignition_delays_ms [B], success [B], status [B]) —
         ``status`` carries each element's SolveStatus code."""
+        from ..resilience import checkpoint as _checkpoint
+        from ..resilience import driver as _driver
+
         cond = self._condition
         if T0s is None:
             T0s = np.asarray([cond.temperature])
@@ -456,9 +472,27 @@ class BatchReactors(ReactorModel):
                                           **kwargs)
             return sol.ignition_time, sol.success, sol.status
 
-        times, ok, status = jax.vmap(one)(T0s, P0s, Y0s, t_ends)
-        return (np.asarray(times) * 1.0e3, np.asarray(ok),
-                np.asarray(status))
+        vm = jax.vmap(one)
+
+        sig = None
+        if checkpoint_path is not None:
+            sig = _checkpoint.config_signature(
+                "batch.run_sweep", type(self).__name__,
+                cfg={k: v for k, v in kwargs.items() if k != "mech"},
+                arrays=(T0s, P0s, Y0s, t_ends), tree=kwargs["mech"])
+
+        def index_solve(idx):
+            t, ok, st = vm(T0s[idx], P0s[idx], Y0s[idx], t_ends[idx])
+            return {"times": t, "ok": ok, "status": st}
+
+        results, _report = _driver.run_vmapped_sweep_job(
+            index_solve, B, chunk_size=chunk_size,
+            checkpoint_path=checkpoint_path, signature=sig,
+            result_keys=("times", "ok", "status"),
+            job_report=job_report, label="batch.run_sweep",
+            **(driver_kwargs or {}))
+        return (results["times"] * 1.0e3, results["ok"],
+                results["status"])
 
     # --- solution retrieval (reference: batchreactor.py:1263-1648) ---------
     def get_solution_size(self) -> Tuple[int, int]:

@@ -326,12 +326,25 @@ class perfectlystirredreactor(openreactor):
             self._estimate_Y = np.asarray(self._solution.Y)
         return self.runstatus
 
-    def run_sweep(self, taus=None, volumes=None):
+    def run_sweep(self, taus=None, volumes=None, *, chunk_size=None,
+                  checkpoint_path=None, job_report=None,
+                  driver_kwargs=None):
         """Whole S-curve in ONE vmapped solve — the TPU replacement for
         the reference's serial continuation loop
         (examples/PSR/PSRgas.py:252-255). All elements share this
         reactor's inlets and estimate. Returns (T [B], Y [B, KK],
-        converged [B], status [B])."""
+        converged [B], status [B]).
+
+        The sweep runs under the durable-job driver: ``chunk_size``
+        splits the S-curve into sequential same-shape jitted calls,
+        ``checkpoint_path`` banks every completed chunk atomically
+        (preemption-safe; resumes on any later device count), and
+        ``job_report`` (a dict) receives the driver's
+        :class:`~pychemkin_tpu.resilience.driver.SweepJobReport`
+        fields."""
+        from ..resilience import checkpoint as _checkpoint
+        from ..resilience import driver as _driver
+
         T_g, Y_g = self._guess()
         kwargs = self._solve_kwargs()
         if self.mode == psr_ops.MODE_TAU:
@@ -355,9 +368,31 @@ class perfectlystirredreactor(openreactor):
                     T_guess=jnp.asarray(T_g), Y_guess=jnp.asarray(Y_g),
                     **kwargs)
 
-        sol = jax.vmap(one)(params)
-        return (np.asarray(sol.T), np.asarray(sol.Y),
-                np.asarray(sol.converged), np.asarray(sol.status))
+        vm = jax.vmap(one)
+        B = int(params.shape[0])
+
+        sig = None
+        if checkpoint_path is not None:
+            sig = _checkpoint.config_signature(
+                "psr.run_sweep", type(self).__name__, self.mode,
+                self._volume, self._tau,
+                cfg={k: v for k, v in kwargs.items() if k != "mech"},
+                arrays=(params, np.asarray(T_g), np.asarray(Y_g)),
+                tree=kwargs["mech"])
+
+        def index_solve(idx):
+            sol = vm(params[idx])
+            return {"T": sol.T, "Y": sol.Y,
+                    "converged": sol.converged, "status": sol.status}
+
+        results, _report = _driver.run_vmapped_sweep_job(
+            index_solve, B, chunk_size=chunk_size,
+            checkpoint_path=checkpoint_path, signature=sig,
+            result_keys=("T", "Y", "converged", "status"),
+            job_report=job_report, label="psr.run_sweep",
+            **(driver_kwargs or {}))
+        return (results["T"], results["Y"], results["converged"],
+                results["status"])
 
     # --- solution (reference: PSR.py:787-865) ------------------------------
     def process_solution(self) -> Stream:

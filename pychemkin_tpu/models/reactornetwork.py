@@ -461,21 +461,13 @@ class ReactorNetwork:
                 if idxs else "the network has no reactors")
         return idxs
 
-    def run_cluster(self) -> int:
-        """Solve a linear PSR chain as ONE coupled Newton system — the
-        TPU-native form of the reference's cluster mode, where
-        clustered PSRs solve in a single native call (reference
-        PSR.py:286 set_reactor_index, :464 cluster_process_keywords;
-        exercised by its PSRChain_network example) instead of the
-        sequential substitution of :meth:`run`. The caller explicitly
-        asked for cluster mode, so an inapplicable topology raises a
-        typed :class:`ClusterNotApplicableError` naming the rule that
-        failed (the same reason logged by the ``cluster_reject``
-        telemetry event)."""
-        import jax.numpy as jnp
-
-        from ..ops import psr as psr_ops_mod
-
+    def _cluster_inputs(self):
+        """Validate the network as a linear PSR chain and assemble the
+        coupled-solve inputs (shared by :meth:`run_cluster` and
+        :meth:`run_cluster_scan`). Raises
+        :class:`ClusterNotApplicableError` naming the failed rule.
+        Returns ``(chain, head, mech, Y_in0, h_in0, mdot, taus, qloss,
+        T_g, Y_g)``."""
         if self.outflow_altered:
             self.set_reactor_outflow()
         chain = self._linear_psr_chain()
@@ -518,6 +510,26 @@ class ReactorNetwork:
             T_g.append(tg)
             Y_g.append(yg)
         mech = head._effective_mech()
+        return (chain, head, mech, Y_in0, h_in0, mdot, taus, qloss,
+                T_g, Y_g)
+
+    def run_cluster(self) -> int:
+        """Solve a linear PSR chain as ONE coupled Newton system — the
+        TPU-native form of the reference's cluster mode, where
+        clustered PSRs solve in a single native call (reference
+        PSR.py:286 set_reactor_index, :464 cluster_process_keywords;
+        exercised by its PSRChain_network example) instead of the
+        sequential substitution of :meth:`run`. The caller explicitly
+        asked for cluster mode, so an inapplicable topology raises a
+        typed :class:`ClusterNotApplicableError` naming the rule that
+        failed (the same reason logged by the ``cluster_reject``
+        telemetry event)."""
+        import jax.numpy as jnp
+
+        from ..ops import psr as psr_ops_mod
+
+        (chain, head, mech, Y_in0, h_in0, mdot, taus, qloss,
+         T_g, Y_g) = self._cluster_inputs()
         sol = psr_ops_mod.solve_psr_chain(
             mech, "ENRG", P=head.pressure, Y_in0=Y_in0, h_in0=h_in0,
             taus=taus, T_guess=np.asarray(T_g), Y_guess=np.asarray(Y_g),
@@ -549,6 +561,69 @@ class ReactorNetwork:
         self.set_external_streams()
         self._run_status = 0
         return 0
+
+    def run_cluster_scan(self, tau_scales, *, chunk_size=None,
+                         checkpoint_path=None, job_report=None,
+                         driver_kwargs=None):
+        """Cluster S-curve scan: the linear PSR chain re-solved at
+        scaled residence times — scan point ``s`` solves the chain with
+        every reactor's ``tau`` multiplied by ``tau_scales[s]`` (the
+        blow-off/extinction scan the reference walks serially, one
+        continuation step per native call). The whole scan is ONE
+        vmapped coupled solve per chunk, driven as a durable job:
+        ``chunk_size`` / ``checkpoint_path`` / ``job_report`` /
+        ``driver_kwargs`` behave exactly as in
+        :meth:`pychemkin_tpu.models.psr.perfectlystirredreactor.run_sweep`.
+
+        Validates the topology like :meth:`run_cluster` (raises
+        :class:`ClusterNotApplicableError` when not a linear chain).
+        Returns ``(T [S, n_chain], Y [S, n_chain, KK], converged [S],
+        status [S])``; the network's stored per-reactor solutions are
+        NOT touched (this is a scan, not a run)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import psr as psr_ops_mod
+        from ..resilience import checkpoint as _checkpoint
+        from ..resilience import driver as _driver
+
+        (chain, head, mech, Y_in0, h_in0, mdot, taus, qloss,
+         T_g, Y_g) = self._cluster_inputs()
+        scales = jnp.atleast_1d(jnp.asarray(tau_scales, jnp.float64))
+        S = int(scales.shape[0])
+        taus_j = jnp.asarray(taus, jnp.float64)
+        qloss_j = jnp.asarray(qloss, jnp.float64)
+        T_gj, Y_gj = jnp.asarray(T_g), jnp.asarray(Y_g)
+        Y_in0j = jnp.asarray(Y_in0)
+
+        def one(scale):
+            return psr_ops_mod.solve_psr_chain(
+                mech, "ENRG", P=head.pressure, Y_in0=Y_in0j,
+                h_in0=h_in0, taus=taus_j * scale, T_guess=T_gj,
+                Y_guess=Y_gj, qloss=qloss_j, mdot=mdot)
+
+        vm = jax.vmap(one)
+
+        sig = None
+        if checkpoint_path is not None:
+            sig = _checkpoint.signature(
+                "network.run_cluster_scan", head.pressure, h_in0, mdot,
+                arrays=(scales, taus_j, qloss_j, T_gj, Y_gj, Y_in0j),
+                tree=mech)
+
+        def index_solve(idx):
+            sol = vm(scales[idx])
+            return {"T": sol.T, "Y": sol.Y,
+                    "converged": sol.converged, "status": sol.status}
+
+        results, _report = _driver.run_vmapped_sweep_job(
+            index_solve, S, chunk_size=chunk_size,
+            checkpoint_path=checkpoint_path, signature=sig,
+            result_keys=("T", "Y", "converged", "status"),
+            job_report=job_report, label="network.run_cluster_scan",
+            **(driver_kwargs or {}))
+        return (results["T"], results["Y"], results["converged"],
+                results["status"])
 
     def _run_one(self, idx: int) -> Stream:
         rxtor = self.reactor_objects[idx]

@@ -24,10 +24,7 @@ Design notes:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Sequence
-
-import os
 
 import jax
 import jax.numpy as jnp
@@ -115,160 +112,18 @@ class SweepStats:
         self.n_newton += int(newton)
 
 
-def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
-                           mesh: Optional[Mesh] = None, rtol=1e-6,
-                           atol=1e-12,
-                           ignition_mode=reactor_ops.IGN_T_INFLECTION,
-                           ignition_kwargs=None,
-                           max_steps_per_segment=20_000,
-                           solve_kwargs=None, chunk_size=None,
-                           stats: Optional[SweepStats] = None,
-                           checkpoint_path: Optional[str] = None,
-                           _stats_n_real=None):
-    """Ignition-delay sweep sharded over a device mesh — the scaled-out
-    form of :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`.
-
-    Each device integrates its shard of initial conditions with the same
-    compiled program (SPMD); the mechanism record is replicated. Returns
-    (ignition_times [B] in seconds, success [B], status [B]) gathered to
-    the host — ``status`` carries each element's
-    :class:`~pychemkin_tpu.resilience.status.SolveStatus` code, so a
-    sweep's failures arrive machine-readable (feed them to
-    :func:`pychemkin_tpu.resilience.rescue.run_rescue` to re-solve only
-    the failed subset).
-
-    ``chunk_size``: process the batch as sequential jitted calls of this
-    size (rounded up to a mesh multiple). One compiled program serves
-    every chunk, so compile time is set by the CHUNK size, flat in total
-    B; a contiguous chunk of a sorted sweep also groups elements of
-    similar stiffness, so fast chunks are not held in lockstep by the
-    batch's slowest element. This is also the overload guard for very
-    large B (a single giant program crashed the TPU worker at B=512 on
-    a 54-state mechanism; 4x128 chunks run fine).
-
-    ``stats``: optional :class:`SweepStats` accumulating total accepted
-    steps / rejected attempts / Newton iterations across the sweep (the
-    measured inputs of the bench's FLOP/MFU model).
-
-    ``checkpoint_path``: an ``.npz`` file updated after every completed
-    chunk (or once, for an unchunked sweep); re-running the same sweep
-    with the same path resumes after the last completed chunk. The file
-    records a hash of the FULL sweep configuration, so a stale file
-    from a different sweep is ignored, never returned. This is the
-    on-disk checkpoint/resume for long sweeps that SURVEY §5 calls for
-    (the reference has only in-memory warm starts) — a preempted
-    10k-point overnight sweep loses one chunk, not the night.
-    """
-    if mesh is None:
-        mesh = make_mesh()
+def _solve_shard(mech, problem, energy, T0s, P0s, Y0s, t_ends, mesh,
+                 kwargs):
+    """One sharded solve of already-broadcast [n] inputs: pad to a mesh
+    multiple, run the cached jitted shard_map program, return host
+    arrays trimmed back to n — (times, ok, status, n_steps, n_rejected,
+    n_newton)."""
     n_dev = mesh.devices.size
     axis = mesh.axis_names[0]
-
-    T0s = jnp.atleast_1d(jnp.asarray(T0s, jnp.float64))
-    B = T0s.shape[0]
-    P0s = jnp.broadcast_to(jnp.asarray(P0s, jnp.float64), (B,))
-    Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64),
-                           (B, jnp.asarray(Y0s).shape[-1]))
-    t_ends = jnp.broadcast_to(jnp.asarray(t_ends, jnp.float64), (B,))
-
-    # checkpoint identity: EVERYTHING that determines the answer, so a
-    # stale file from a different sweep at the same path can never be
-    # returned as this sweep's results
-    ck_sig = None
-    if checkpoint_path is not None:
-        import hashlib
-
-        h = hashlib.sha256()
-        for part in (problem, energy, str(ignition_mode),
-                     repr(ignition_kwargs), repr(rtol), repr(atol),
-                     repr(max_steps_per_segment), repr(solve_kwargs)):
-            h.update(part.encode())
-        for arr in (T0s, P0s, Y0s, t_ends):
-            h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
-        # the MECHANISM determines the answer too: hash every floating
-        # leaf (rates, thermo, stoichiometry) plus the species list, so
-        # e.g. a retuned-A-factor variant cannot reuse the file
-        h.update(",".join(mech.species_names).encode())
-        for leaf in jax.tree_util.tree_leaves(mech):
-            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
-        ck_sig = h.hexdigest()
-
-    def _load_ck(expect_chunk):
-        if checkpoint_path is None or not os.path.exists(
-                checkpoint_path):
-            return 0, [], [], []
-        try:
-            with np.load(checkpoint_path, allow_pickle=False) as ck:
-                if (str(ck["sig"]) == ck_sig
-                        and int(ck["chunk"]) == expect_chunk
-                        and "status" in ck):
-                    return (int(ck["done_upto"]),
-                            [np.asarray(ck["times"])],
-                            [np.asarray(ck["ok"])],
-                            [np.asarray(ck["status"])])
-        except Exception:            # noqa: BLE001 — corrupt/foreign
-            # file: a checkpoint is an optimization; recompute instead
-            # of dying on exactly the stale-file case we promise to
-            # tolerate
-            pass
-        return 0, [], [], []
-
-    def _save_ck(expect_chunk, done_upto, times_parts, ok_parts,
-                 st_parts):
-        tmp = checkpoint_path + ".tmp.npz"
-        np.savez(tmp, sig=ck_sig, B=B, chunk=expect_chunk,
-                 done_upto=done_upto,
-                 times=np.concatenate(times_parts),
-                 ok=np.concatenate(ok_parts),
-                 status=np.concatenate(st_parts))
-        os.replace(tmp, checkpoint_path)
-
-    if chunk_size is not None and chunk_size < B:
-        chunk = max(n_dev, (chunk_size // n_dev) * n_dev)
-        done_upto, times_parts, ok_parts, st_parts = _load_ck(chunk)
-        for lo in range(done_upto, B, chunk):
-            hi = min(lo + chunk, B)
-            # re-enter with exactly one chunk (padded inside); same
-            # shapes -> same cached program for every full chunk
-            tpart, okpart, stpart = sharded_ignition_sweep(
-                mech, problem, energy,
-                jnp.pad(T0s[lo:hi], (0, chunk - (hi - lo)), mode="edge"),
-                jnp.pad(P0s[lo:hi], (0, chunk - (hi - lo)), mode="edge"),
-                jnp.pad(Y0s[lo:hi], ((0, chunk - (hi - lo)), (0, 0)),
-                        mode="edge"),
-                jnp.pad(t_ends[lo:hi], (0, chunk - (hi - lo)),
-                        mode="edge"),
-                mesh=mesh, rtol=rtol, atol=atol,
-                ignition_mode=ignition_mode,
-                ignition_kwargs=ignition_kwargs,
-                max_steps_per_segment=max_steps_per_segment,
-                solve_kwargs=solve_kwargs, stats=stats,
-                _stats_n_real=hi - lo)   # edge-padding is not real work
-            times_parts.append(tpart[:hi - lo])
-            ok_parts.append(okpart[:hi - lo])
-            st_parts.append(stpart[:hi - lo])
-            if checkpoint_path is not None:
-                _save_ck(chunk, hi, times_parts, ok_parts, st_parts)
-        return (np.concatenate(times_parts), np.concatenate(ok_parts),
-                np.concatenate(st_parts))
-
-    if checkpoint_path is not None:
-        # unchunked sweep: all-or-nothing — a completed matching
-        # checkpoint short-circuits; otherwise solve and save one
-        done_upto, times_parts, ok_parts, st_parts = _load_ck(0)
-        if done_upto >= B:
-            return times_parts[0][:B], ok_parts[0][:B], st_parts[0][:B]
-
     T0s, n_real = _pad_to_multiple(T0s, n_dev)
     P0s, _ = _pad_to_multiple(P0s, n_dev)
     Y0s, _ = _pad_to_multiple(Y0s, n_dev)
     t_ends, _ = _pad_to_multiple(t_ends, n_dev)
-
-    kwargs = dict(rtol=rtol, atol=atol, n_out=2,
-                  ignition_mode=ignition_mode,
-                  ignition_kwargs=ignition_kwargs,
-                  max_steps_per_segment=max_steps_per_segment)
-    kwargs.update(solve_kwargs or {})
 
     # cache the jitted program per configuration: a fresh jax.jit wrapper
     # per call would miss the tracing cache and recompile the whole stiff
@@ -302,24 +157,123 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         jax.device_put(P0s, in_sharding),
         jax.device_put(Y0s, NamedSharding(mesh, P(axis, None))),
         jax.device_put(t_ends, in_sharding))
-    times, ok, status, n_steps, n_rej, n_newt = mapped(T0s, P0s, Y0s,
-                                                       t_ends)
+    out = mapped(T0s, P0s, Y0s, t_ends)
+    return tuple(np.asarray(a)[:n_real] for a in out)
+
+
+def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
+                           mesh: Optional[Mesh] = None, rtol=1e-6,
+                           atol=1e-12,
+                           ignition_mode=reactor_ops.IGN_T_INFLECTION,
+                           ignition_kwargs=None,
+                           max_steps_per_segment=20_000,
+                           solve_kwargs=None, chunk_size=None,
+                           stats: Optional[SweepStats] = None,
+                           checkpoint_path: Optional[str] = None,
+                           job_report: Optional[dict] = None,
+                           driver_kwargs: Optional[dict] = None):
+    """Ignition-delay sweep sharded over a device mesh — the scaled-out
+    form of :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`.
+
+    Each device integrates its shard of initial conditions with the same
+    compiled program (SPMD); the mechanism record is replicated. Returns
+    (ignition_times [B] in seconds, success [B], status [B]) gathered to
+    the host — ``status`` carries each element's
+    :class:`~pychemkin_tpu.resilience.status.SolveStatus` code, so a
+    sweep's failures arrive machine-readable (feed them to
+    :func:`pychemkin_tpu.resilience.rescue.run_rescue` to re-solve only
+    the failed subset).
+
+    The sweep runs under the durable-job driver
+    (:func:`pychemkin_tpu.resilience.driver.run_sweep_job`): chunks
+    retry with backoff, SIGTERM/SIGINT finish the in-flight chunk and
+    raise :class:`~pychemkin_tpu.resilience.driver.JobInterrupted`
+    (resumable rc), and ``checkpoint_path`` makes the job preemption-
+    safe. ``driver_kwargs`` forwards extra knobs (``reexec_argv``,
+    ``max_retries``, ...); ``job_report`` (a dict) is filled in place
+    with the :class:`~pychemkin_tpu.resilience.driver.SweepJobReport`
+    fields — ``resume_count``/``chunks_replayed``/``driver_overhead_s``
+    are what the bench rungs record.
+
+    ``chunk_size``: process the batch as sequential jitted calls of this
+    size (rounded up to a mesh multiple). One compiled program serves
+    every chunk, so compile time is set by the CHUNK size, flat in total
+    B; a contiguous chunk of a sorted sweep also groups elements of
+    similar stiffness, so fast chunks are not held in lockstep by the
+    batch's slowest element. This is also the overload guard for very
+    large B (a single giant program crashed the TPU worker at B=512 on
+    a 54-state mechanism; 4x128 chunks run fine).
+
+    ``stats``: optional :class:`SweepStats` accumulating total accepted
+    steps / rejected attempts / Newton iterations across the sweep (the
+    measured inputs of the bench's FLOP/MFU model).
+
+    ``checkpoint_path``: an ``.npz`` manifest updated atomically after
+    every completed chunk (or once, for an unchunked sweep); re-running
+    the same sweep with the same path resumes after the last completed
+    chunk. The manifest is keyed by a hash of the FULL sweep
+    configuration — but NOT of the mesh/chunk layout, so a checkpoint
+    banked on 16 devices resumes on 4 by re-chunking; a stale file from
+    a different sweep is ignored, never returned; a torn/corrupt file
+    recomputes instead of raising. This is the on-disk
+    checkpoint/resume for long sweeps that SURVEY §5 calls for — a
+    preempted 10k-point overnight sweep loses one chunk, not the night.
+    """
+    from ..resilience import checkpoint as _checkpoint
+    from ..resilience import driver as _driver
+
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = mesh.devices.size
+
+    T0s = jnp.atleast_1d(jnp.asarray(T0s, jnp.float64))
+    B = int(T0s.shape[0])
+    P0s = jnp.broadcast_to(jnp.asarray(P0s, jnp.float64), (B,))
+    Y0s = jnp.broadcast_to(jnp.asarray(Y0s, jnp.float64),
+                           (B, jnp.asarray(Y0s).shape[-1]))
+    t_ends = jnp.broadcast_to(jnp.asarray(t_ends, jnp.float64), (B,))
+
+    kwargs = dict(rtol=rtol, atol=atol, n_out=2,
+                  ignition_mode=ignition_mode,
+                  ignition_kwargs=ignition_kwargs,
+                  max_steps_per_segment=max_steps_per_segment)
+    kwargs.update(solve_kwargs or {})
+
+    # checkpoint identity: EVERYTHING that determines the answer
+    # (inputs, tolerances, mechanism leaves) and nothing about the
+    # execution layout — mesh/chunk size may differ on resume
+    sig = None
     if checkpoint_path is not None:
-        _save_ck(0, B, [np.asarray(times)[:n_real]],
-                 [np.asarray(ok)[:n_real]],
-                 [np.asarray(status)[:n_real]])
-    if stats is not None:
-        # count only genuinely distinct elements: chunked callers pad
-        # the tail chunk with edge duplicates whose solver work would
-        # otherwise inflate the bench's steps/s and MFU figures
-        n_count = n_real if _stats_n_real is None else min(
-            n_real, _stats_n_real)
-        real = np.arange(n_count)
-        stats.add(np.asarray(n_steps)[real].sum(),
-                  np.asarray(n_rej)[real].sum(),
-                  np.asarray(n_newt)[real].sum())
-    return (np.asarray(times)[:n_real], np.asarray(ok)[:n_real],
-            np.asarray(status)[:n_real])
+        sig = _checkpoint.signature(
+            problem, energy, str(ignition_mode), ignition_kwargs,
+            rtol, atol, max_steps_per_segment, solve_kwargs,
+            arrays=(T0s, P0s, Y0s, t_ends), tree=mech)
+
+    if chunk_size is None or chunk_size >= B:
+        chunk = B
+    else:
+        chunk = max(n_dev, (chunk_size // n_dev) * n_dev)
+
+    def index_solve(idx):
+        # idx is edge-padded to a fixed chunk length by the driver, so
+        # one cached program serves every chunk; count only the
+        # genuinely distinct elements into stats (the duplicates'
+        # solver work would inflate the bench's steps/s and MFU)
+        n = len(np.unique(idx)) if len(idx) else 0
+        t, ok, st, n_steps, n_rej, n_newt = _solve_shard(
+            mech, problem, energy, T0s[idx], P0s[idx], Y0s[idx],
+            t_ends[idx], mesh, kwargs)
+        if stats is not None:
+            stats.add(n_steps[:n].sum(), n_rej[:n].sum(),
+                      n_newt[:n].sum())
+        return {"times": t, "ok": ok, "status": st}
+
+    results, _report = _driver.run_vmapped_sweep_job(
+        index_solve, B, chunk_size=chunk,
+        checkpoint_path=checkpoint_path, signature=sig,
+        result_keys=("times", "ok", "status"), job_report=job_report,
+        label="sharded_ignition_sweep", **(driver_kwargs or {}))
+    return results["times"], results["ok"], results["status"]
 
 
 def sharded_sweep_summary(mesh: Mesh, times, ok):

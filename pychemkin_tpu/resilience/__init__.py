@@ -1,8 +1,10 @@
-"""Solver resilience layer: failure taxonomy, rescue ladder, fault
-injection.
+"""Solver + job resilience layer: failure taxonomy, rescue ladder,
+fault injection, and the durable sweep-job driver.
 
-Production batched chemistry (the B=10k north star) needs three things
-the raw solvers don't give by themselves:
+Production batched chemistry (the B=10k north star on preemptible
+slices) needs two levels of robustness the raw solvers don't give:
+
+**Per-solve** (PR 3):
 
 1. a **structured failure status** per batch element
    (:class:`~pychemkin_tpu.resilience.status.SolveStatus`, carried as
@@ -14,12 +16,34 @@ the raw solvers don't give by themselves:
    (:mod:`~pychemkin_tpu.resilience.faultinject`, env/context gated,
    zero cost when off) so every rescue path is CI-testable on CPU.
 
-See the README section "Failure semantics & rescue ladder" for the
-user-facing contract.
+**Per-job** (PR 4):
+
+4. a **durable sweep-job driver**
+   (:func:`~pychemkin_tpu.resilience.driver.run_sweep_job`) wrapping
+   any chunked sweep with checkpoint banking
+   (:mod:`~pychemkin_tpu.resilience.checkpoint` — atomic, problem-hash
+   keyed, mesh-size independent), SIGTERM/SIGINT graceful shutdown
+   with a resumable exit code, chunk retry/backoff, and subprocess
+   re-exec escalation for poisoned backends,
+5. a **process-level chaos harness**
+   (:mod:`~pychemkin_tpu.resilience.procfaults`,
+   ``PYCHEMKIN_PROC_FAULTS``) so every driver recovery path is
+   CI-testable on CPU too.
+
+See the README sections "Failure semantics & rescue ladder" and
+"Durable sweeps & preemption" for the user-facing contracts.
 """
 
-from . import faultinject, rescue, status
+from . import checkpoint, driver, faultinject, procfaults, rescue, status
+from .driver import (
+    RESUMABLE_RC,
+    GracefulStop,
+    JobInterrupted,
+    SweepJobReport,
+    run_sweep_job,
+)
 from .faultinject import FaultSpec, inject
+from .procfaults import BackendPoisonedError, ProcFaultSpec
 from .rescue import (
     DEFAULT_LADDER,
     EscalationStep,
@@ -31,19 +55,29 @@ from .rescue import (
 from .status import SolveStatus, failed_mask, name_of, status_counts
 
 __all__ = [
+    "BackendPoisonedError",
     "DEFAULT_LADDER",
     "EscalationStep",
     "FaultSpec",
+    "GracefulStop",
+    "JobInterrupted",
+    "ProcFaultSpec",
+    "RESUMABLE_RC",
     "RescueReport",
     "SolveStatus",
+    "SweepJobReport",
+    "checkpoint",
+    "driver",
     "failed_mask",
     "faultinject",
     "inject",
     "name_of",
+    "procfaults",
     "rescue",
     "rescue_enabled",
     "resilient_ignition_sweep",
     "run_rescue",
+    "run_sweep_job",
     "status",
     "status_counts",
 ]

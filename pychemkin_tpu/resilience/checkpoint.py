@@ -1,0 +1,244 @@
+"""Unified checkpoint manifests for durable sweep jobs.
+
+One ``.npz`` file per job, rewritten atomically (tmp + ``os.replace``,
+the :func:`~pychemkin_tpu.telemetry.sink.atomic_write_json` discipline
+applied to arrays) after every completed chunk. The manifest records:
+
+- ``sig``        the job's PROBLEM signature — a hash of everything that
+                 determines the answer (inputs, tolerances, mechanism),
+                 and deliberately NOT of the execution layout (mesh
+                 size, chunk size, device count). A checkpoint written
+                 on 16 devices therefore resumes on 4: the loader hands
+                 back ``done_upto`` completed ELEMENTS and the driver
+                 re-chunks the remainder however the new mesh likes.
+- ``done_upto``  how many leading batch elements are fully solved.
+- result arrays  each banked result key, stored under an ``r_`` prefix,
+                 leading dimension == ``done_upto``.
+- ``resume_count`` / ``chunks_replayed``  durability counters that
+                 survive process death (they ride in the manifest, so a
+                 re-exec'd or resumed process keeps the running totals).
+
+Corruption contract (the promise tests truncate files to verify): a
+checkpoint is an OPTIMIZATION. A torn, stale, foreign, or
+wrong-signature file loads as "nothing banked" — the sweep recomputes —
+and is never returned as results and never raises out of :func:`load`.
+
+Cost model: every bank rewrites the WHOLE manifest, so checkpoint I/O
+over a job grows as O(done_upto) per chunk (quadratic in total). That
+is the price of the single-file atomicity the corruption contract is
+built on — any interrupted write leaves either the old complete
+manifest or a torn file that loads as nothing, never a half-updated
+state spread over several files. Result payloads are a few scalars per
+element (not trajectories), so the rewrite stays cheap into the 1e5
+range; a million-element job should raise ``chunk_size`` so the bank
+cadence amortizes, not switch to incremental part files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import telemetry
+
+#: manifest layout version; bump on incompatible key changes (an old
+#: version loads as "nothing banked", per the corruption contract)
+MANIFEST_VERSION = 1
+
+#: npz key prefix for banked result arrays (keeps user result keys from
+#: colliding with the manifest's own metadata keys)
+_RESULT_PREFIX = "r_"
+
+_META_KEYS = ("v", "sig", "B", "done_upto", "resume_count",
+              "chunks_replayed")
+
+
+class CheckpointState(NamedTuple):
+    """A successfully loaded manifest."""
+    done_upto: int
+    results: Dict[str, np.ndarray]   # leading dim == done_upto
+    resume_count: int
+    chunks_replayed: int
+
+
+def _hash_array(h, arr) -> None:
+    a = np.ascontiguousarray(np.asarray(arr))
+    h.update(str(a.dtype).encode() + str(a.shape).encode())
+    h.update(a.tobytes())
+
+
+def _hash_part(h, part: Any) -> None:
+    """Hash one identity part: containers recurse, arrays go by their
+    BYTES (``repr`` of a >1000-element ndarray elides the middle — two
+    different problems must never collide on a truncated print),
+    everything else by ``repr``."""
+    if isinstance(part, dict):
+        h.update(b"{")
+        for key in sorted(part, key=repr):
+            _hash_part(h, key)
+            h.update(b":")
+            _hash_part(h, part[key])
+        h.update(b"}")
+    elif isinstance(part, (list, tuple)):
+        h.update(b"(")
+        for item in part:
+            _hash_part(h, item)
+            h.update(b",")
+        h.update(b")")
+    elif isinstance(part, np.ndarray) or (
+            hasattr(part, "dtype") and hasattr(part, "shape")):
+        _hash_array(h, part)
+    else:
+        h.update(repr(part).encode())
+    h.update(b"\x00")
+
+
+def signature(*parts: Any, arrays: Sequence = (),
+              tree: Any = None) -> str:
+    """Problem-identity hash for a sweep job.
+
+    ``parts`` are hashed by ``repr`` — except arrays (at any container
+    depth), which are hashed by their bytes so numpy's elided printing
+    of large arrays can never alias two problems; ``arrays`` by their
+    bytes; ``tree`` (typically the mechanism record) by every array
+    leaf plus any ``species_names`` attribute — so e.g. a retuned-
+    A-factor mechanism variant can never reuse another sweep's file.
+    Execution layout (mesh/chunk/device count) must NOT be fed in
+    here: the whole point of the manifest is that layout may change
+    between processes.
+    """
+    h = hashlib.sha256()
+    for part in parts:
+        _hash_part(h, part)
+    for arr in arrays:
+        _hash_array(h, arr)
+    if tree is not None:
+        names = getattr(tree, "species_names", None)
+        if names is not None:
+            h.update(",".join(names).encode())
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(tree):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def config_signature(*parts: Any, cfg: Any = None, arrays: Sequence = (),
+                     tree: Any = None) -> str:
+    """:func:`signature` for model-layer sweeps whose solve
+    configuration is a kwargs dict of pytree leaves (profiles,
+    tolerances): ``cfg``'s structure is hashed as a part and its leaves
+    as arrays, so any config change — value or shape — changes the
+    identity while the chunk layout stays out of it."""
+    if cfg is not None:
+        import jax
+
+        parts = parts + (jax.tree_util.tree_structure(cfg),)
+        arrays = tuple(np.asarray(leaf) for leaf in
+                       jax.tree_util.tree_leaves(cfg)) + tuple(arrays)
+    return signature(*parts, arrays=arrays, tree=tree)
+
+
+def save(path: str, *, sig: str, B: int, done_upto: int,
+         results: Dict[str, np.ndarray], resume_count: int = 0,
+         chunks_replayed: int = 0, recorder=None,
+         label: str = "") -> None:
+    """Atomically rewrite the manifest at ``path``.
+
+    Every result array is trimmed/validated to ``done_upto`` leading
+    elements. Emits one ``checkpoint.save`` telemetry event.
+    """
+    payload = {
+        "v": np.asarray(MANIFEST_VERSION),
+        "sig": np.asarray(sig),
+        "B": np.asarray(int(B)),
+        "done_upto": np.asarray(int(done_upto)),
+        "resume_count": np.asarray(int(resume_count)),
+        "chunks_replayed": np.asarray(int(chunks_replayed)),
+    }
+    for key, arr in results.items():
+        arr = np.asarray(arr)
+        if arr.shape[0] < done_upto:
+            raise ValueError(
+                f"checkpoint result {key!r} has {arr.shape[0]} elements "
+                f"< done_upto={done_upto}")
+        payload[_RESULT_PREFIX + key] = arr[:done_upto]
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    np.savez(tmp, **payload)
+    os.replace(tmp, path)
+    rec = recorder if recorder is not None else telemetry.get_recorder()
+    rec.event("checkpoint.save", label=label, path=path,
+              done_upto=int(done_upto), B=int(B))
+    rec.inc("checkpoint.saves")
+
+
+def load(path: str, *, sig: str, B: int,
+         expect_keys: Optional[Sequence[str]] = None
+         ) -> Optional[CheckpointState]:
+    """Load a manifest, or ``None`` when nothing usable is banked.
+
+    ``None`` — never an exception — on: missing file, torn/corrupt
+    file, wrong layout version, signature mismatch (different problem),
+    batch-size mismatch, inconsistent array lengths, or (when
+    ``expect_keys`` is given) a different result-key set. A corrupt
+    checkpoint is an optimization miss, not an error.
+    """
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as ck:
+            if int(ck["v"]) != MANIFEST_VERSION:
+                return None
+            if str(ck["sig"]) != sig or int(ck["B"]) != int(B):
+                return None
+            done_upto = int(ck["done_upto"])
+            if not (0 < done_upto <= int(B)):
+                return None
+            results = {}
+            for key in ck.files:
+                if key.startswith(_RESULT_PREFIX):
+                    arr = np.asarray(ck[key])
+                    if arr.shape[0] < done_upto:
+                        return None
+                    results[key[len(_RESULT_PREFIX):]] = arr[:done_upto]
+            if not results:
+                return None
+            if expect_keys is not None and \
+                    set(results) != set(expect_keys):
+                return None
+            return CheckpointState(
+                done_upto=done_upto, results=results,
+                resume_count=int(ck["resume_count"]),
+                chunks_replayed=int(ck["chunks_replayed"]))
+    except Exception:        # noqa: BLE001 — torn/foreign/corrupt file:
+        # recompute instead of dying on exactly the case we promise to
+        # tolerate
+        return None
+
+
+def peek(path: str) -> Optional[Dict[str, Any]]:
+    """Raw manifest contents without signature validation (tooling and
+    tests): the metadata keys plus a ``"results"`` dict of the banked
+    arrays (prefix stripped); ``None`` when the file is missing or
+    unreadable."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as ck:
+            out: Dict[str, Any] = {"results": {}}
+            for key in ck.files:
+                val = np.asarray(ck[key])
+                if key == "sig":
+                    out[key] = str(val)
+                elif key in _META_KEYS:
+                    out[key] = int(val)
+                elif key.startswith(_RESULT_PREFIX):
+                    out["results"][key[len(_RESULT_PREFIX):]] = val
+            return out
+    except Exception:        # noqa: BLE001
+        return None

@@ -1,0 +1,521 @@
+"""Durable sweep-job driver: preemption-safe chunked execution with
+checkpoint banking, retry/backoff, and subprocess re-exec escalation.
+
+PR 3 made a *solve* robust (per-element status + rescue ladder); this
+module makes a *job* robust. A million-condition sweep on a preemptible
+slice dies of process-level causes — SIGTERM preemption, a poisoned
+backend client, a crashed worker — and the orchestration layer, not the
+integrator, decides whether the run finishes. :func:`run_sweep_job`
+wraps ANY chunked sweep (batch ignition, PSR S-curves, sharded sweeps,
+reactor-network cluster scans) with the durable-job contract
+``benchmarks.py`` already gives itself:
+
+1. **Checkpoint banking** — after every completed chunk the results so
+   far are atomically rewritten to a :mod:`.checkpoint` manifest,
+   identity-keyed by problem hash but NOT by execution layout, so a
+   16-device run's checkpoint resumes on 4 devices by re-chunking.
+2. **Signal-aware graceful shutdown** — SIGTERM/SIGINT set a
+   cooperative stop flag; the in-flight chunk finishes, its bank lands,
+   and :class:`JobInterrupted` (``.rc == RESUMABLE_RC`` = 75, the
+   sysexits ``EX_TEMPFAIL`` "transient failure, retry" code) propagates
+   so the process can exit with the documented resumable rc. Re-running
+   the same command resumes after the last banked chunk.
+3. **Chunk retry with exponential backoff + jitter** — a failed chunk
+   is retried in-process up to ``max_retries`` times; a POISONED
+   backend (:class:`~.procfaults.BackendPoisonedError`, or an error
+   matching the known poison markers) skips in-process retries — they
+   are wasted work, the round-3 bench lesson — and escalates straight
+   to **subprocess re-exec**: the process replaces itself with
+   ``reexec_argv`` (typically its own command line) carrying an
+   incremented ``_PYCHEMKIN_DRIVER_REEXEC`` count; the fresh process
+   gets a clean backend and resumes from the bank.
+4. **Rescue hand-off** — per-element failures that survive the run
+   (status != OK in the results) are the RESCUE ladder's job, not the
+   driver's: pass ``rescue=`` a callable and it receives the final
+   results dict (see :func:`~.rescue.run_rescue`).
+
+Every recovery path is CI-tested on CPU via the process-level chaos
+harness (:mod:`.procfaults`, ``PYCHEMKIN_PROC_FAULTS``).
+
+Environment knobs (explicit call arguments win):
+
+- ``PYCHEMKIN_DRIVER_RETRIES``        in-process retries per chunk (2)
+- ``PYCHEMKIN_DRIVER_BACKOFF_S``      initial backoff (0.5 s; doubles
+                                      per attempt, +25 % jitter)
+- ``PYCHEMKIN_DRIVER_BACKOFF_CAP_S``  backoff ceiling (30 s)
+- ``PYCHEMKIN_DRIVER_MAX_REEXECS``    re-exec escalations per job (1)
+
+Telemetry: ``checkpoint.save`` / ``checkpoint.resume`` /
+``driver.retry`` / ``driver.reexec`` / ``driver.interrupted`` events
+plus ``driver.retries`` / ``checkpoint.saves`` counters.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal as _signal
+import sys
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+from . import checkpoint, procfaults
+from .procfaults import REEXEC_COUNT_ENV, BackendPoisonedError
+from .rescue import _env_float, _env_int
+
+#: the documented resumable exit code (sysexits EX_TEMPFAIL): the job
+#: was interrupted AFTER banking — rerun the same command to resume
+RESUMABLE_RC = 75
+
+#: substrings that classify an exception as a poisoned backend even
+#: when it is not a BackendPoisonedError (jax/XLA client failures that
+#: in-process retries cannot heal)
+_POISON_MARKERS = (
+    "DEADLINE_EXCEEDED",
+    "failed to connect to all addresses",
+    "Unable to initialize backend",
+    "backend poisoned",
+)
+
+
+class JobInterrupted(RuntimeError):
+    """A graceful shutdown: the stop signal arrived, the in-flight
+    chunk finished and banked. ``results`` holds everything banked so
+    far (may be partial), ``report`` the job report, ``rc`` the
+    documented resumable exit code for the process to exit with."""
+
+    def __init__(self, message: str, *, report: "SweepJobReport",
+                 results: Optional[Dict[str, np.ndarray]] = None,
+                 signum: Optional[int] = None):
+        super().__init__(message)
+        self.report = report
+        self.results = results
+        self.signum = signum
+        self.rc = RESUMABLE_RC
+
+
+class SweepJobReport(NamedTuple):
+    """What the driver did, JSON-ready via :meth:`as_dict`."""
+    B: int
+    chunk: int
+    n_chunks: int            # total chunks the sweep decomposes into
+    chunks_run: int          # chunks solved by THIS process
+    resumed_upto: int        # elements adopted from the checkpoint
+    resume_count: int        # lifetime resumes (manifest-persisted)
+    chunks_replayed: int     # lifetime retry re-executions (persisted)
+    retries: int             # retries by THIS process
+    driver_overhead_s: float  # checkpoint load/save bookkeeping time
+    wall_s: float
+    interrupted: bool
+
+    def as_dict(self) -> Dict:
+        d = self._asdict()
+        d["driver_overhead_s"] = round(d["driver_overhead_s"], 6)
+        d["wall_s"] = round(d["wall_s"], 6)
+        return d
+
+
+def self_argv() -> List[str]:
+    """This process's own command line — the default ``reexec_argv``
+    for script-style jobs (``python my_sweep.py ...``)."""
+    return [sys.executable] + list(sys.argv)
+
+
+def is_poisoned(exc: BaseException) -> bool:
+    """Classify an exception as a poisoned-backend failure."""
+    if isinstance(exc, BackendPoisonedError):
+        return True
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(marker in msg for marker in _POISON_MARKERS)
+
+
+class GracefulStop:
+    """Cooperative stop flag with signal installation.
+
+    The handler only SETS the flag — a jitted chunk cannot be
+    preempted, so the driver checks the flag at chunk boundaries: the
+    in-flight chunk completes, banks, and then the job raises
+    :class:`JobInterrupted`. A SECOND signal means the operator is done
+    waiting: the saved dispositions are restored and the signal is
+    re-delivered, so the default behaviour (KeyboardInterrupt for
+    SIGINT, termination for SIGTERM) takes over immediately."""
+
+    def __init__(self):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._saved = {}
+
+    def _handler(self, signum, frame):
+        if self.requested:
+            self.restore()
+            os.kill(os.getpid(), signum)
+            return
+        self.requested = True
+        self.signum = signum
+
+    def install(self, signals=(_signal.SIGTERM, _signal.SIGINT)):
+        for sig in signals:
+            try:
+                self._saved[sig] = _signal.signal(sig, self._handler)
+            except ValueError:
+                # not the main thread: cooperative stop still works via
+                # request(), signals just can't be hooked from here
+                pass
+        return self
+
+    def restore(self):
+        for sig, old in self._saved.items():
+            try:
+                _signal.signal(sig, old)
+            except ValueError:
+                pass
+        self._saved.clear()
+
+    def request(self):
+        """Programmatic stop (tests, embedding frameworks)."""
+        self.requested = True
+
+
+def _concat(parts: Dict[str, List[np.ndarray]]) -> Dict[str, np.ndarray]:
+    return {k: (v[0] if len(v) == 1 else np.concatenate(v))
+            for k, v in parts.items()}
+
+
+def run_sweep_job(solve_chunk: Callable[[int, int], Dict[str, np.ndarray]],
+                  B: int, *,
+                  chunk_size: Optional[int] = None,
+                  checkpoint_path: Optional[str] = None,
+                  signature: Optional[str] = None,
+                  result_keys: Optional[Sequence[str]] = None,
+                  label: str = "sweep_job",
+                  recorder=None,
+                  max_retries: Optional[int] = None,
+                  backoff_s: Optional[float] = None,
+                  backoff_cap_s: Optional[float] = None,
+                  jitter: float = 0.25,
+                  reexec_argv: Optional[Sequence[str]] = None,
+                  max_reexecs: Optional[int] = None,
+                  install_signals: Optional[bool] = None,
+                  stop: Optional[GracefulStop] = None,
+                  job_report: Optional[dict] = None,
+                  rescue: Optional[Callable[[Dict[str, np.ndarray]],
+                                            object]] = None):
+    """Run a chunked sweep under the durable-job contract.
+
+    ``solve_chunk(lo, hi)`` solves elements ``[lo, hi)`` and returns a
+    dict of subset-aligned arrays (leading dim ``hi - lo``) with the
+    same keys every call. The driver does NOT round ``chunk_size`` —
+    callers with layout constraints (mesh multiples) round before
+    calling; resume points land at banked-element granularity, so a
+    checkpoint from any other chunking/device count is still usable.
+
+    Returns ``(results, report)`` — ``results`` the concatenated
+    full-batch arrays, ``report`` a :class:`SweepJobReport`. Raises
+    :class:`JobInterrupted` after a graceful stop (partial results
+    banked; ``.rc`` is the resumable exit code) — a stop that lands
+    during the FINAL chunk still raises after that chunk banks, so a
+    signal is never silently swallowed (the rerun is then a pure
+    short-circuit). Re-raises the last chunk error when retries (and
+    re-exec escalation, when configured via ``reexec_argv``) are
+    exhausted.
+
+    ``job_report`` (a dict) is filled in place with the report fields
+    on EVERY exit path — normal return and interrupt alike — so
+    callers that catch :class:`JobInterrupted` still see
+    ``resumed_upto``/``interrupted``.
+
+    ``rescue`` runs AFTER the last chunk with the final results dict —
+    the hand-off that feeds surviving per-element failures into the
+    PR 3 rescue ladder (e.g. a closure over
+    :func:`~.rescue.run_rescue`); its return value is discarded, the
+    results dict is updated in place by the ladder's merge contract.
+
+    ``install_signals`` defaults to auto: handlers are installed only
+    for CHECKPOINTED jobs, where a graceful stop leaves something to
+    resume from. A plain in-memory sweep keeps ordinary
+    ``KeyboardInterrupt`` semantics unless the caller opts in with
+    ``install_signals=True`` (or drives an explicit ``stop``).
+    """
+    if B <= 0:
+        raise ValueError(f"{label}: B must be positive, got {B} "
+                         "(see run_vmapped_sweep_job for empty-sweep "
+                         "handling)")
+    if max_retries is None:
+        max_retries = _env_int("PYCHEMKIN_DRIVER_RETRIES", 2)
+    if backoff_s is None:
+        backoff_s = _env_float("PYCHEMKIN_DRIVER_BACKOFF_S", 0.5)
+    if backoff_cap_s is None:
+        backoff_cap_s = _env_float("PYCHEMKIN_DRIVER_BACKOFF_CAP_S", 30.0)
+    if max_reexecs is None:
+        max_reexecs = _env_int("PYCHEMKIN_DRIVER_MAX_REEXECS", 1)
+    if checkpoint_path is not None and signature is None:
+        raise ValueError("checkpoint_path requires a problem signature")
+    if install_signals is None:
+        install_signals = checkpoint_path is not None
+    rec = recorder if recorder is not None else telemetry.get_recorder()
+
+    B = int(B)
+    chunk = B if chunk_size is None else max(1, min(int(chunk_size), B))
+    n_chunks = -(-B // chunk)
+    t_start = time.perf_counter()
+    overhead_s = 0.0
+
+    # -- adopt banked work ------------------------------------------------
+    done_upto = 0
+    resume_count = 0
+    chunks_replayed = 0
+    parts: Dict[str, List[np.ndarray]] = {}
+    if checkpoint_path is not None:
+        t0 = time.perf_counter()
+        state = checkpoint.load(checkpoint_path, sig=signature, B=B,
+                                expect_keys=result_keys)
+        overhead_s += time.perf_counter() - t0
+        if state is not None:
+            done_upto = state.done_upto
+            resume_count = state.resume_count + 1
+            chunks_replayed = state.chunks_replayed
+            parts = {k: [v] for k, v in state.results.items()}
+            rec.event("checkpoint.resume", label=label,
+                      path=checkpoint_path, done_upto=done_upto, B=B,
+                      resume_count=resume_count)
+            rec.inc("checkpoint.resumes")
+    resumed_upto = done_upto
+
+    stop = stop if stop is not None else GracefulStop()
+    if install_signals:
+        stop.install()
+    retries = 0
+    chunks_run = 0
+
+    def _bank(upto):
+        nonlocal overhead_s
+        if checkpoint_path is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            checkpoint.save(checkpoint_path, sig=signature, B=B,
+                            done_upto=upto, results=_concat(parts),
+                            resume_count=resume_count,
+                            chunks_replayed=chunks_replayed,
+                            recorder=rec, label=label)
+        except Exception as exc:   # noqa: BLE001 — ENOSPC, bad path, ...
+            # the corruption contract cuts both ways: a checkpoint is
+            # an optimization on SAVE too — a failed bank degrades
+            # durability (this chunk won't resume), it must not kill
+            # the job whose work it was protecting
+            rec.event("checkpoint.save_failed", label=label,
+                      path=checkpoint_path, done_upto=int(upto),
+                      error=f"{type(exc).__name__}: {exc}")
+            rec.inc("checkpoint.save_failures")
+        overhead_s += time.perf_counter() - t0
+
+    def _report(interrupted=False):
+        rep = SweepJobReport(
+            B=B, chunk=chunk, n_chunks=n_chunks, chunks_run=chunks_run,
+            resumed_upto=resumed_upto, resume_count=resume_count,
+            chunks_replayed=chunks_replayed, retries=retries,
+            driver_overhead_s=overhead_s,
+            wall_s=time.perf_counter() - t_start,
+            interrupted=interrupted)
+        if job_report is not None:
+            job_report.update(rep.as_dict())
+        return rep
+
+    def _interrupt():
+        rep = _report(interrupted=True)
+        rec.event("driver.interrupted", label=label,
+                  done_upto=done_upto, B=B, signum=stop.signum,
+                  rc=RESUMABLE_RC)
+        if checkpoint_path is not None:
+            what = (f"after banking {done_upto}/{B} elements; rerun to "
+                    f"resume (rc {RESUMABLE_RC})")
+        else:
+            what = (f"after finishing the in-flight chunk "
+                    f"({done_upto}/{B} elements solved, no checkpoint "
+                    "configured — partial results ride on this "
+                    "exception only)")
+        raise JobInterrupted(
+            f"{label}: stopped by signal {stop.signum} {what}",
+            report=rep, results=_concat(parts) if parts else None,
+            signum=stop.signum)
+
+    def _escalate_reexec(exc):
+        """Replace this process with a fresh one (clean backend) that
+        resumes from the bank; returns only if escalation is not
+        available."""
+        if reexec_argv is None or checkpoint_path is None:
+            return
+        count = procfaults.reexec_count()
+        if count >= max_reexecs:
+            return
+        env = dict(os.environ)
+        env[REEXEC_COUNT_ENV] = str(count + 1)
+        # the event must land BEFORE the exec (a replaced process can't
+        # emit it); a failed exec is paired with driver.reexec_failed
+        # so post-mortems don't count an escalation that never ran
+        rec.event("driver.reexec", label=label, count=count + 1,
+                  done_upto=done_upto, B=B,
+                  error=f"{type(exc).__name__}: {exc}")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        try:
+            os.execvpe(reexec_argv[0], list(reexec_argv), env)
+        except OSError as exec_err:
+            rec.event("driver.reexec_failed", label=label,
+                      count=count + 1,
+                      error=f"{type(exec_err).__name__}: {exec_err}")
+            return   # fall through to re-raise the ORIGINAL error
+
+    if done_upto >= B and resume_count:
+        # complete manifest: the loop below won't run a chunk, so no
+        # bank would persist the incremented lifetime resume counter —
+        # rewrite the metadata here or it stays frozen across restarts
+        _bank(done_upto)
+
+    try:
+        lo = done_upto
+        while lo < B:
+            if stop.requested:
+                _interrupt()
+            hi = min(lo + chunk, B)
+            ordinal = lo // chunk
+            attempt = 0
+            while True:
+                if stop.requested:
+                    # a stop that lands while this chunk is FAILING
+                    # must not be deferred through backoff sleeps and
+                    # further attempts (or worse, be masked by an
+                    # exhausted-retry raise instead of the resumable
+                    # JobInterrupted): everything completed is banked,
+                    # bail out here
+                    _interrupt()
+                try:
+                    procfaults.on_chunk_start(ordinal)
+                    part = solve_chunk(lo, hi)
+                    break
+                except JobInterrupted:
+                    raise
+                except Exception as exc:      # noqa: BLE001 — classified
+                    poisoned = is_poisoned(exc)
+                    # a poisoned backend wastes in-process retries: the
+                    # client stays wedged for the life of the process
+                    if not poisoned and attempt < max_retries:
+                        attempt += 1
+                        retries += 1
+                        chunks_replayed += 1
+                        delay = min(backoff_cap_s,
+                                    backoff_s * 2.0 ** (attempt - 1))
+                        delay *= 1.0 + random.uniform(0.0, jitter)
+                        rec.event("driver.retry", label=label,
+                                  chunk=ordinal, lo=lo, hi=hi,
+                                  attempt=attempt,
+                                  backoff_s=round(delay, 3),
+                                  error=f"{type(exc).__name__}: {exc}")
+                        rec.inc("driver.retries")
+                        # sleep in slices: a stop signal landing during
+                        # a capped (~30 s) backoff must reach the
+                        # loop-top check well inside a preemption grace
+                        # window, not after the sleep runs out (the
+                        # handler only sets a flag; sleep auto-resumes
+                        # after EINTR per PEP 475)
+                        deadline = time.monotonic() + delay
+                        while not stop.requested:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            time.sleep(min(0.1, left))
+                        continue
+                    if poisoned:
+                        # re-exec buys a clean backend client; for a
+                        # deterministic chunk error it would just loop
+                        # the fresh process into the same failure
+                        _escalate_reexec(exc)
+                    raise
+            if parts and set(part) != set(parts):
+                raise ValueError(
+                    f"{label}: solve_chunk returned keys "
+                    f"{sorted(part)} but earlier chunks banked "
+                    f"{sorted(parts)}")
+            for key, arr in part.items():
+                arr = np.asarray(arr)
+                if arr.shape[0] != hi - lo:
+                    raise ValueError(
+                        f"{label}: solve_chunk returned {key!r} with "
+                        f"{arr.shape[0]} elements for chunk "
+                        f"[{lo}, {hi})")
+                parts.setdefault(key, []).append(arr)
+            chunks_run += 1
+            done_upto = hi
+            procfaults.on_before_bank(ordinal)
+            _bank(hi)
+            procfaults.on_after_bank(ordinal, checkpoint_path)
+            lo = hi
+    finally:
+        if install_signals:
+            stop.restore()
+        # this job's re-exec budget is spent only on THIS job: consume
+        # the count on every terminal path (success, interrupt,
+        # exhausted retries) so a later job in the same (re-exec'd)
+        # process gets its own escalation. A re-exec itself never gets
+        # here — execvpe replaces the process, and the incremented
+        # count must survive into it
+        os.environ.pop(REEXEC_COUNT_ENV, None)
+
+    if stop.requested:
+        # the signal landed during the FINAL chunk: everything is
+        # banked, but the stop must NOT be silently swallowed (the
+        # caller was told to shut down) — exit resumable; the rerun is
+        # a pure short-circuit off the complete bank
+        _interrupt()
+    results = _concat(parts)
+    if rescue is not None:
+        rescue(results)
+    return results, _report()
+
+
+def edge_pad_indices(lo: int, hi: int, chunk: int) -> np.ndarray:
+    """Element indices for the chunk ``[lo, hi)`` padded to exactly
+    ``chunk`` entries by repeating the last element — every chunk then
+    has the same shape, so ONE compiled program serves the whole sweep
+    (the padding duplicates are trimmed off the results)."""
+    return np.minimum(np.arange(lo, lo + chunk), hi - 1)
+
+
+def run_vmapped_sweep_job(index_solve: Callable[[np.ndarray],
+                                                Dict[str, np.ndarray]],
+                          B: int, *, chunk_size: Optional[int] = None,
+                          **job_kwargs):
+    """Durable chunked execution of an index-driven (vmapped) sweep —
+    the shared scaffolding of the model-layer ``run_sweep`` surfaces.
+
+    ``index_solve(idx)`` solves the elements at ``idx`` (an int array,
+    always of the SAME length per job thanks to edge padding) and
+    returns a dict of index-aligned result arrays. The tail chunk's
+    padding duplicates are trimmed before banking. ``B == 0`` is the
+    degenerate empty sweep: ``index_solve`` runs once with an empty
+    index vector (a vmap over zero elements), preserving the plain
+    empty-arrays contract without involving the driver.
+
+    All other keyword arguments go to :func:`run_sweep_job`.
+    """
+    if B == 0:
+        out = {k: np.asarray(v)
+               for k, v in index_solve(np.arange(0)).items()}
+        report = SweepJobReport(
+            B=0, chunk=0, n_chunks=0, chunks_run=0, resumed_upto=0,
+            resume_count=0, chunks_replayed=0, retries=0,
+            driver_overhead_s=0.0, wall_s=0.0, interrupted=False)
+        job_report = job_kwargs.get("job_report")
+        if job_report is not None:
+            job_report.update(report.as_dict())
+        return out, report
+    chunk = B if chunk_size is None else max(1, min(int(chunk_size), B))
+
+    def solve_chunk(lo, hi):
+        out = index_solve(edge_pad_indices(lo, hi, chunk))
+        return {k: np.asarray(v)[:hi - lo] for k, v in out.items()}
+
+    return run_sweep_job(solve_chunk, B, chunk_size=chunk, **job_kwargs)

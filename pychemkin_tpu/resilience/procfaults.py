@@ -1,0 +1,202 @@
+"""Process-level chaos injection — makes every DRIVER recovery path
+CI-testable.
+
+:mod:`.faultinject` poisons batch ELEMENTS inside a compiled solve;
+this module poisons the PROCESS around a driver-run sweep job: the
+failure classes a preemptible-slice production job actually dies of.
+Same design contract as ``faultinject`` — env or context activated,
+zero cost when off, deterministic, targeted (here by chunk ordinal
+instead of element index).
+
+Modes (``chunk`` names the target chunk ordinal, ``lo // chunk_size``
+counted from element 0 of the sweep — stable across SAME-layout
+resumes; a resume that re-chunks, e.g. on a different device count,
+renumbers the remaining work, so cross-layout chaos specs should
+target element ranges via chunk 0 of the resumed process instead):
+
+- ``kill_at_chunk``     SIGKILL this process at chunk ``chunk`` —
+                        ``when="after_bank"`` (default; a preemption
+                        that lands between chunks) or
+                        ``when="before_bank"`` (the in-flight chunk's
+                        work is lost and must be replayed).
+- ``hang_child``        sleep ``seconds`` at the start of the chunk
+                        (a wedged backend/tunnel; pair with an external
+                        watchdog kill, the ``benchmarks.py`` idiom).
+- ``poison_backend``    raise :class:`BackendPoisonedError` at the
+                        chunk — in-process retries cannot help (the
+                        driver escalates to subprocess re-exec). By
+                        default the poison HEALS in a re-exec'd
+                        process (``heal_on_reexec``), mirroring how a
+                        fresh process gets a clean backend client.
+- ``torn_checkpoint``   after the chunk banks, truncate the checkpoint
+                        file mid-write — the next load must recompute
+                        cleanly, never raise.
+- ``fail_chunk``        raise a plain ``RuntimeError`` at the chunk,
+                        ``n_times`` times (default 1) — exercises the
+                        retry/backoff ladder without poisoning.
+
+Activation, either source (programmatic wins):
+
+- env var ``PYCHEMKIN_PROC_FAULTS`` — a JSON object or list, e.g.
+  ``[{"mode": "kill_at_chunk", "chunk": 2}]`` (read per call, so a
+  chaos harness can set it for child processes only);
+- the :func:`inject` context manager with :class:`ProcFaultSpec`\\ s.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+import sys
+import time
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+_ENV = "PYCHEMKIN_PROC_FAULTS"
+
+#: incremented by the driver on every subprocess re-exec; also how
+#: ``poison_backend`` knows it is running in a "fresh" process
+REEXEC_COUNT_ENV = "_PYCHEMKIN_DRIVER_REEXEC"
+
+MODES = ("kill_at_chunk", "hang_child", "poison_backend",
+         "torn_checkpoint", "fail_chunk")
+
+
+class BackendPoisonedError(RuntimeError):
+    """The accelerator client/tunnel is wedged for THIS process:
+    in-process retries are wasted work (the round-3 bench lesson);
+    recovery needs a fresh process (driver re-exec) or an operator."""
+
+
+class ProcFaultSpec(NamedTuple):
+    """One deterministic process-level fault, targeted by chunk
+    ordinal. ``n_times < 0`` means the fault fires every time the
+    chunk is hit (within this process)."""
+    mode: str
+    chunk: int = 0
+    n_times: int = 1
+    seconds: float = 3600.0          # hang_child sleep
+    when: str = "after_bank"         # kill_at_chunk placement
+    heal_on_reexec: bool = True      # poison_backend clears on re-exec
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProcFaultSpec":
+        mode = d["mode"]
+        if mode not in MODES:
+            raise ValueError(f"unknown proc-fault mode {mode!r}; "
+                             f"expected one of {MODES}")
+        when = d.get("when", "after_bank")
+        if when not in ("after_bank", "before_bank"):
+            raise ValueError(f"kill_at_chunk 'when' must be after_bank "
+                             f"or before_bank, got {when!r}")
+        return cls(mode=mode, chunk=int(d.get("chunk", 0)),
+                   n_times=int(d.get("n_times", 1)),
+                   seconds=float(d.get("seconds", 3600.0)), when=when,
+                   heal_on_reexec=bool(d.get("heal_on_reexec", True)))
+
+
+#: programmatic spec stack (the :func:`inject` context manager)
+_active: List[ProcFaultSpec] = []
+
+#: per-process fire counts, keyed by (mode, chunk) — how ``n_times``
+#: is enforced deterministically
+_fired: Dict[Tuple[str, int], int] = {}
+
+
+def _env_specs() -> List[ProcFaultSpec]:
+    raw = os.environ.get(_ENV)
+    if not raw:
+        return []
+    data = json.loads(raw)
+    if isinstance(data, dict):
+        data = [data]
+    return [ProcFaultSpec.from_dict(d) for d in data]
+
+
+def specs(mode: Optional[str] = None) -> Tuple[ProcFaultSpec, ...]:
+    """Active specs (programmatic first, then env), optionally filtered
+    by mode. Evaluated fresh per call."""
+    out = list(_active) + _env_specs()
+    if mode is not None:
+        out = [s for s in out if s.mode == mode]
+    return tuple(out)
+
+
+def enabled() -> bool:
+    """Whether ANY process-fault spec is active."""
+    return bool(specs())
+
+
+@contextlib.contextmanager
+def inject(*fault_specs: ProcFaultSpec):
+    """Activate specs for the dynamic extent of the block (fire counts
+    reset on entry so repeated tests are deterministic)."""
+    _active.extend(fault_specs)
+    _fired.clear()
+    try:
+        yield
+    finally:
+        del _active[len(_active) - len(fault_specs):]
+
+
+def reexec_count() -> int:
+    """How many times the driver has re-exec'd this job's process."""
+    try:
+        return int(os.environ.get(REEXEC_COUNT_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+def _fires(spec: ProcFaultSpec, ordinal: int) -> bool:
+    if spec.chunk != ordinal:
+        return False
+    if spec.mode == "poison_backend" and spec.heal_on_reexec \
+            and reexec_count() > 0:
+        return False             # fresh process: clean backend client
+    key = (spec.mode, spec.chunk)
+    if spec.n_times >= 0 and _fired.get(key, 0) >= spec.n_times:
+        return False
+    _fired[key] = _fired.get(key, 0) + 1
+    return True
+
+
+def _sigkill_self():
+    # flush first: a chaos kill must not eat the log lines that explain
+    # it (stdio may be block-buffered under a pipe)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def on_chunk_start(ordinal: int) -> None:
+    """Hook: the driver is about to solve chunk ``ordinal``."""
+    for spec in specs():
+        if spec.mode == "hang_child" and _fires(spec, ordinal):
+            time.sleep(spec.seconds)
+        elif spec.mode == "poison_backend" and _fires(spec, ordinal):
+            raise BackendPoisonedError(
+                f"injected poison_backend at chunk {ordinal}")
+        elif spec.mode == "fail_chunk" and _fires(spec, ordinal):
+            raise RuntimeError(
+                f"injected fail_chunk at chunk {ordinal}")
+
+
+def on_before_bank(ordinal: int) -> None:
+    """Hook: chunk ``ordinal`` solved, its bank not yet written."""
+    for spec in specs("kill_at_chunk"):
+        if spec.when == "before_bank" and _fires(spec, ordinal):
+            _sigkill_self()
+
+
+def on_after_bank(ordinal: int, checkpoint_path: Optional[str]) -> None:
+    """Hook: chunk ``ordinal``'s bank has landed on disk."""
+    for spec in specs("torn_checkpoint"):
+        if checkpoint_path and os.path.exists(checkpoint_path) \
+                and _fires(spec, ordinal):
+            size = os.path.getsize(checkpoint_path)
+            with open(checkpoint_path, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+    for spec in specs("kill_at_chunk"):
+        if spec.when == "after_bank" and _fires(spec, ordinal):
+            _sigkill_self()

@@ -20,6 +20,22 @@ Kinds emitted by the framework:
                      (see ``pychemkin_tpu/benchmarks.py``; the summary
                      is also banked to an atomic snapshot after every
                      completed rung).
+- ``checkpoint.save``   — one durable-sweep checkpoint bank landed
+                     (label, path, done_upto, B); emitted by
+                     ``resilience/checkpoint.py`` after every chunk.
+- ``checkpoint.resume`` — a sweep job adopted banked work (label,
+                     path, done_upto, B, resume_count).
+- ``driver.retry``   — a sweep chunk failed and is being retried
+                     (label, chunk, lo, hi, attempt, backoff_s,
+                     error); see ``resilience/driver.py``.
+- ``driver.reexec`` / ``driver.interrupted`` — the driver escalated a
+                     poisoned backend to a subprocess re-exec / a
+                     SIGTERM/SIGINT graceful shutdown banked and is
+                     exiting with the resumable rc.
+- ``checkpoint.save_failed`` / ``driver.reexec_failed`` — a bank could
+                     not be written (durability degraded, job
+                     continues) / an attempted re-exec's ``execvpe``
+                     failed (the original chunk error propagates).
 
 Counters maintained on the default recorder include the pivot-free-LU
 residual-check outcomes, bridged from device via

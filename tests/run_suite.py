@@ -27,6 +27,11 @@ Behaviour:
 - each child gets a per-file timeout (``RUN_SUITE_FILE_TIMEOUT`` seconds,
   default 2400) so one hung child cannot wedge the suite — a timeout is
   recorded as that file failing with rc=124;
+- a child that DIES ON A SIGNAL (rc < 0: SIGKILL'd by the OOM killer,
+  SIGSEGV'd by the sporadic jaxlib XLA:CPU crash this runner exists to
+  contain) is retried ONCE and the retry is marked in the per-file line
+  and the summary — an infra kill is de-flaked, while a test that fails
+  deterministically still fails (its rc is positive, never retried);
 - a child exiting rc=5 (pytest: "no tests collected") counts as SKIPPED,
   not failed — ``pytest tests/ -k <pattern>`` deselects every test in
   most files, and under the per-file re-exec each such file is its own
@@ -136,38 +141,56 @@ def main(argv=None):
     env = _child_env(faults=faults)
     results = []
     t_suite = time.time()
+
+    def _run_child(targets):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "pytest"] + targets + flags,
+                env=env, timeout=FILE_TIMEOUT)
+            return r.returncode
+        except subprocess.TimeoutExpired:
+            return 124
+
     for f in files:
         name = os.path.basename(f)
         # a file selected as a whole (directly or via a dir) runs whole;
         # node-id selectors only narrow files not otherwise selected
         targets = [f] if f in selected else selectors.get(f, [f])
         t0 = time.time()
-        try:
-            r = subprocess.run(
-                [sys.executable, "-m", "pytest"] + targets + flags,
-                env=env, timeout=FILE_TIMEOUT)
-            rc = r.returncode
-        except subprocess.TimeoutExpired:
-            rc = 124
+        rc = _run_child(targets)
+        retried = False
+        if rc < 0:
+            # child died on a signal (OOM kill, sporadic XLA:CPU
+            # segfault): an infra event, not a test verdict — retry
+            # ONCE; a deterministic failure exits with a POSITIVE rc
+            # and is never retried, so real failures stay failures
+            print(f"# run_suite: {name}: killed by signal {-rc}; "
+                  "retrying once", flush=True)
+            rc = _run_child(targets)
+            retried = True
         dt = time.time() - t0
         # rc=5 = "no tests collected" in this child's session (e.g. a
         # -k pattern deselecting the whole file): skipped, not failed
         ok = rc in (0, 5)
-        results.append((name, rc, dt))
+        results.append((name, rc, dt, retried))
         print(f"# run_suite: {name}: "
               f"{'no tests' if rc == 5 else 'ok' if ok else f'FAIL rc={rc}'}"
-              f"{' (timeout)' if rc == 124 else ''} ({dt:.0f}s)",
+              f"{' (timeout)' if rc == 124 else ''}"
+              f"{' (retried after signal)' if retried else ''}"
+              f" ({dt:.0f}s)",
               flush=True)
         if not ok and stop_on_fail:
             break
 
-    n_fail = sum(1 for _, rc, _ in results if rc not in (0, 5))
-    n_empty = sum(1 for _, rc, _ in results if rc == 5)
+    n_fail = sum(1 for _, rc, _, _ in results if rc not in (0, 5))
+    n_empty = sum(1 for _, rc, _, _ in results if rc == 5)
+    n_retried = sum(1 for _, _, _, retried in results if retried)
     total = time.time() - t_suite
     print(f"# run_suite: {len(results)} files, {n_fail} failed, "
-          f"{n_empty} empty, {total:.0f}s total", flush=True)
+          f"{n_empty} empty, {n_retried} retried, {total:.0f}s total",
+          flush=True)
     if n_fail:
-        for name, rc, _ in results:
+        for name, rc, _, _ in results:
             if rc not in (0, 5):
                 print(f"# run_suite:   FAILED {name} rc={rc}", flush=True)
         return 1

@@ -1,0 +1,929 @@
+"""Durable sweep-job driver tests: checkpoint manifests, the chunked
+driver's retry/resume/shutdown contracts, and process-level chaos.
+
+The acceptance scenario (ISSUE 4), all on CPU: a B=64 ignition sweep
+driven with ``kill-at-chunk-2`` injected is SIGKILLed, resumed, and
+completes — already-banked chunks bit-match an uninterrupted run and
+``resume_count`` == 1 in the report; SIGTERM mid-sweep exits with the
+documented resumable rc (75) after banking the in-flight chunk.
+
+Process-level faults are injected via ``PYCHEMKIN_PROC_FAULTS`` (env,
+into child processes) or ``procfaults.inject`` (programmatic,
+in-process) — every driver recovery path runs for real: the kill is a
+real SIGKILL, the resume a real second process, the re-exec a real
+``execv``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from pychemkin_tpu import telemetry
+from pychemkin_tpu.resilience import checkpoint, driver, procfaults
+from pychemkin_tpu.resilience.driver import (
+    RESUMABLE_RC,
+    BackendPoisonedError,
+    GracefulStop,
+    JobInterrupted,
+    run_sweep_job,
+)
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fake_chunk(lo, hi):
+    x = np.arange(lo, hi, dtype=float)
+    return {"y": np.sin(x) * 3.0, "ok": np.ones(hi - lo, bool)}
+
+
+def _fake_reference(B):
+    x = np.arange(B, dtype=float)
+    return np.sin(x) * 3.0
+
+
+def _child_env(**extra):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PYCHEMKIN_PROC_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manifests
+
+
+class TestCheckpointManifest:
+    def _save(self, path, done_upto=6, B=10, sig="s1", **kw):
+        y = np.arange(done_upto, dtype=float)
+        checkpoint.save(path, sig=sig, B=B, done_upto=done_upto,
+                        results={"y": y, "ok": np.ones(done_upto, bool)},
+                        recorder=telemetry.MetricsRecorder(), **kw)
+        return y
+
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        y = self._save(p, resume_count=2, chunks_replayed=3)
+        st = checkpoint.load(p, sig="s1", B=10)
+        assert st.done_upto == 6
+        assert st.resume_count == 2 and st.chunks_replayed == 3
+        np.testing.assert_array_equal(st.results["y"], y)
+        assert st.results["ok"].dtype == bool
+
+    def test_signature_mismatch_loads_nothing(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        self._save(p, sig="s1")
+        assert checkpoint.load(p, sig="other", B=10) is None
+        assert checkpoint.load(p, sig="s1", B=16) is None   # wrong B
+        assert checkpoint.load(p, sig="s1", B=10,
+                               expect_keys=("y",)) is None  # wrong keys
+
+    def test_torn_file_loads_nothing(self, tmp_path):
+        """The corruption contract: a checkpoint truncated mid-file is
+        an optimization miss, not an error."""
+        p = str(tmp_path / "ck.npz")
+        self._save(p)
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.truncate(size // 2)
+        assert checkpoint.load(p, sig="s1", B=10) is None
+        assert checkpoint.peek(p) is None
+
+    def test_missing_file_loads_nothing(self, tmp_path):
+        assert checkpoint.load(str(tmp_path / "no.npz"), sig="s",
+                               B=4) is None
+
+    def test_signature_covers_arrays_and_parts(self):
+        a = np.arange(4.0)
+        s1 = checkpoint.signature("p", 1e-6, arrays=(a,))
+        assert s1 == checkpoint.signature("p", 1e-6, arrays=(a,))
+        assert s1 != checkpoint.signature("p", 1e-7, arrays=(a,))
+        assert s1 != checkpoint.signature("p", 1e-6, arrays=(a + 1,))
+        # layout-free by construction: there is nothing to feed a mesh
+        # size into — identity is (parts, arrays, tree) only
+
+    def test_signature_hashes_large_arrays_inside_parts(self):
+        """An ndarray nested in a PART (e.g. a profile inside
+        solve_kwargs) is hashed by bytes, not repr — numpy elides the
+        middle of >1000-element prints, which must never alias two
+        different problems onto one manifest."""
+        big = np.zeros(2000)
+        other = big.copy()
+        other[1000] = 1.0              # differs only in the elided middle
+        assert repr(big) == repr(other)              # the trap is real
+        s1 = checkpoint.signature({"profile": big})
+        s2 = checkpoint.signature({"profile": other})
+        assert s1 != s2
+        assert s1 == checkpoint.signature({"profile": big.copy()})
+
+    def test_save_creates_parent_dirs(self, tmp_path):
+        p = str(tmp_path / "a" / "b" / "ck.npz")
+        self._save(p)
+        assert checkpoint.load(p, sig="s1", B=10).done_upto == 6
+
+
+# ---------------------------------------------------------------------------
+# driver core (in-process, fake solves)
+
+
+class TestDriverCore:
+    def test_chunked_matches_single_shot(self):
+        res, rep = run_sweep_job(_fake_chunk, 10, chunk_size=4,
+                                 recorder=telemetry.MetricsRecorder())
+        np.testing.assert_array_equal(res["y"], _fake_reference(10))
+        assert rep.n_chunks == 3 and rep.chunks_run == 3
+        assert rep.resume_count == 0 and not rep.interrupted
+        res1, rep1 = run_sweep_job(_fake_chunk, 10,
+                                   recorder=telemetry.MetricsRecorder())
+        np.testing.assert_array_equal(res1["y"], res["y"])
+        assert rep1.n_chunks == 1 and rep1.chunk == 10
+
+    def test_resume_skips_banked_chunks(self, tmp_path):
+        ck = str(tmp_path / "job.npz")
+        sig = checkpoint.signature("core", arrays=(np.arange(10.0),))
+        rec = telemetry.MetricsRecorder()
+        calls = []
+
+        def counting(lo, hi):
+            calls.append((lo, hi))
+            return _fake_chunk(lo, hi)
+
+        run_sweep_job(counting, 10, chunk_size=4, checkpoint_path=ck,
+                      signature=sig, recorder=rec)
+        # rewind the manifest to one banked chunk (simulated preemption)
+        m = checkpoint.peek(ck)
+        checkpoint.save(ck, sig=m["sig"], B=10, done_upto=4,
+                        results={k: v[:4] for k, v in
+                                 m["results"].items()},
+                        recorder=rec)
+        calls.clear()
+        res, rep = run_sweep_job(counting, 10, chunk_size=4,
+                                 checkpoint_path=ck, signature=sig,
+                                 recorder=rec)
+        assert calls == [(4, 8), (8, 10)]          # banked chunk skipped
+        assert rep.resume_count == 1 and rep.resumed_upto == 4
+        np.testing.assert_array_equal(res["y"], _fake_reference(10))
+        (ev,) = rec.events("checkpoint.resume")
+        assert ev["done_upto"] == 4 and ev["resume_count"] == 1
+        # completed-job checkpoint short-circuits entirely
+        calls.clear()
+        _, rep2 = run_sweep_job(counting, 10, chunk_size=4,
+                                checkpoint_path=ck, signature=sig,
+                                recorder=rec)
+        assert calls == [] and rep2.resume_count == 2
+
+    def test_retry_backoff_then_success(self):
+        rec = telemetry.MetricsRecorder()
+        with procfaults.inject(procfaults.ProcFaultSpec(
+                mode="fail_chunk", chunk=1, n_times=2)):
+            res, rep = run_sweep_job(_fake_chunk, 12, chunk_size=4,
+                                     recorder=rec, backoff_s=0.01)
+        assert rep.retries == 2 and rep.chunks_replayed == 2
+        np.testing.assert_array_equal(res["y"], _fake_reference(12))
+        evs = rec.events("driver.retry")
+        assert [e["attempt"] for e in evs] == [1, 2]
+        # exponential: attempt 2 waits at least the base of attempt 1
+        assert evs[1]["backoff_s"] > evs[0]["backoff_s"] * 1.0
+        assert rec.counters["driver.retries"] == 2
+
+    def test_retries_exhausted_raises(self):
+        with procfaults.inject(procfaults.ProcFaultSpec(
+                mode="fail_chunk", chunk=0, n_times=-1)):
+            with pytest.raises(RuntimeError, match="injected fail_chunk"):
+                run_sweep_job(_fake_chunk, 8, chunk_size=4,
+                              recorder=telemetry.MetricsRecorder(),
+                              max_retries=1, backoff_s=0.01)
+
+    def test_poisoned_skips_inprocess_retries(self):
+        """A poisoned backend must NOT be retried in-process (retrying
+        into a poisoned client is wasted work): with no re-exec argv
+        configured it raises immediately."""
+        rec = telemetry.MetricsRecorder()
+        with procfaults.inject(procfaults.ProcFaultSpec(
+                mode="poison_backend", chunk=0, heal_on_reexec=False)):
+            with pytest.raises(BackendPoisonedError):
+                run_sweep_job(_fake_chunk, 8, chunk_size=4,
+                              recorder=rec, backoff_s=0.01)
+        assert rec.events("driver.retry") == []
+
+    def test_graceful_stop_banks_inflight_chunk(self, tmp_path):
+        ck = str(tmp_path / "job.npz")
+        sig = checkpoint.signature("stop", arrays=(np.arange(12.0),))
+        rec = telemetry.MetricsRecorder()
+        stop = GracefulStop()
+
+        def stopping(lo, hi):
+            if lo == 4:      # "signal" arrives while chunk 1 solves
+                stop.request()
+                stop.signum = signal.SIGTERM
+            return _fake_chunk(lo, hi)
+
+        with pytest.raises(JobInterrupted) as exc:
+            run_sweep_job(stopping, 12, chunk_size=4,
+                          checkpoint_path=ck, signature=sig, stop=stop,
+                          install_signals=False, recorder=rec)
+        e = exc.value
+        assert e.rc == RESUMABLE_RC == 75
+        assert e.report.interrupted
+        # the in-flight chunk FINISHED and BANKED before the stop
+        assert checkpoint.peek(ck)["done_upto"] == 8
+        assert len(e.results["y"]) == 8
+        (ev,) = rec.events("driver.interrupted")
+        assert ev["rc"] == RESUMABLE_RC and ev["done_upto"] == 8
+        # rerunning the same job resumes and completes
+        res, rep = run_sweep_job(_fake_chunk, 12, chunk_size=4,
+                                 checkpoint_path=ck, signature=sig,
+                                 recorder=rec)
+        assert rep.resume_count == 1 and rep.resumed_upto == 8
+        np.testing.assert_array_equal(res["y"], _fake_reference(12))
+
+    def test_real_sigterm_is_cooperative(self, tmp_path):
+        """An actual SIGTERM delivered to the process sets the flag via
+        the installed handler; the in-flight chunk completes."""
+        ck = str(tmp_path / "job.npz")
+        sig = checkpoint.signature("sig", arrays=(np.arange(8.0),))
+
+        def self_signalling(lo, hi):
+            if lo == 0:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return _fake_chunk(lo, hi)
+
+        before = signal.getsignal(signal.SIGTERM)
+        with pytest.raises(JobInterrupted) as exc:
+            run_sweep_job(self_signalling, 8, chunk_size=4,
+                          checkpoint_path=ck, signature=sig,
+                          recorder=telemetry.MetricsRecorder())
+        assert exc.value.signum == signal.SIGTERM
+        assert checkpoint.peek(ck)["done_upto"] == 4
+        # the pre-job handler is restored after the job
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_stop_during_final_chunk_still_interrupts(self, tmp_path):
+        """A stop landing during the FINAL chunk is not swallowed: the
+        chunk banks (done_upto == B) and JobInterrupted still raises —
+        the rerun is then a pure short-circuit."""
+        ck = str(tmp_path / "job.npz")
+        sig = checkpoint.signature("final", arrays=(np.arange(8.0),))
+        stop = GracefulStop()
+
+        def stopping(lo, hi):
+            if lo == 4:                    # the last of two chunks
+                stop.request()
+            return _fake_chunk(lo, hi)
+
+        with pytest.raises(JobInterrupted) as exc:
+            run_sweep_job(stopping, 8, chunk_size=4,
+                          checkpoint_path=ck, signature=sig, stop=stop,
+                          install_signals=False,
+                          recorder=telemetry.MetricsRecorder())
+        assert checkpoint.peek(ck)["done_upto"] == 8      # all banked
+        np.testing.assert_array_equal(exc.value.results["y"],
+                                      _fake_reference(8))
+        # rerun: complete bank short-circuits instantly
+        res, rep = run_sweep_job(_fake_chunk, 8, chunk_size=4,
+                                 checkpoint_path=ck, signature=sig,
+                                 recorder=telemetry.MetricsRecorder())
+        assert rep.chunks_run == 0 and rep.resume_count == 1
+
+    def test_job_report_filled_on_interrupt(self):
+        """job_report is filled on EVERY exit path — the interrupt path
+        is exactly where callers need resumed_upto/interrupted."""
+        stop = GracefulStop()
+        job = {}
+
+        def stopping(lo, hi):
+            stop.request()
+            return _fake_chunk(lo, hi)
+
+        with pytest.raises(JobInterrupted):
+            run_sweep_job(stopping, 8, chunk_size=4, stop=stop,
+                          install_signals=False, job_report=job,
+                          recorder=telemetry.MetricsRecorder())
+        assert job["interrupted"] is True
+        assert job["chunks_run"] == 1
+
+    def test_empty_sweep_via_vmapped_helper(self):
+        """B == 0: the vmapped helper preserves the plain empty-arrays
+        contract (one empty index_solve call, no driver machinery)."""
+        calls = []
+
+        def index_solve(idx):
+            calls.append(np.asarray(idx))
+            return {"y": np.asarray(idx, dtype=float) * 2.0,
+                    "ok": np.asarray(idx, dtype=bool)}
+
+        job = {}
+        res, rep = driver.run_vmapped_sweep_job(
+            index_solve, 0, chunk_size=4, job_report=job,
+            recorder=telemetry.MetricsRecorder())
+        assert res["y"].shape == (0,) and res["ok"].dtype == bool
+        assert len(calls) == 1 and calls[0].size == 0
+        assert rep.n_chunks == 0 and job["B"] == 0
+        # the raw driver refuses B=0 loudly instead of dividing by zero
+        with pytest.raises(ValueError, match="B must be positive"):
+            run_sweep_job(_fake_chunk, 0,
+                          recorder=telemetry.MetricsRecorder())
+
+    def test_unwritable_checkpoint_degrades_not_kills(self, tmp_path):
+        """A bank that cannot be written (bad path, ENOSPC) degrades
+        durability — it must not kill the job whose work it protects."""
+        blocker = tmp_path / "not_a_dir"
+        blocker.write_text("file, not dir")
+        ck = str(blocker / "ck.npz")      # parent is a FILE: save fails
+        rec = telemetry.MetricsRecorder()
+        res, rep = run_sweep_job(
+            _fake_chunk, 8, chunk_size=4, checkpoint_path=ck,
+            signature="s", recorder=rec)
+        np.testing.assert_array_equal(res["y"], _fake_reference(8))
+        assert rep.chunks_run == 2
+        evs = rec.events("checkpoint.save_failed")
+        assert len(evs) == 2 and all(ev["path"] == ck for ev in evs)
+        assert rec.counters["checkpoint.save_failures"] == 2
+
+    def test_short_circuit_resume_persists_count(self, tmp_path):
+        """A complete manifest runs zero chunks — the lifetime
+        resume_count must still advance on disk, not freeze at 1."""
+        ck = str(tmp_path / "job.npz")
+        rec = telemetry.MetricsRecorder()
+        for expect in (0, 1, 2, 3):
+            _, rep = run_sweep_job(_fake_chunk, 8, chunk_size=4,
+                                   checkpoint_path=ck, signature="s",
+                                   recorder=rec)
+            assert rep.resume_count == expect
+        assert checkpoint.peek(ck)["resume_count"] == 3
+
+    def test_second_signal_escalates_to_default(self):
+        """One Ctrl-C is cooperative (finish the chunk); a second means
+        the operator is done waiting — dispositions are restored and
+        the default (KeyboardInterrupt for SIGINT) fires immediately."""
+        before = signal.getsignal(signal.SIGINT)
+        stop = GracefulStop().install(signals=(signal.SIGINT,))
+        try:
+            os.kill(os.getpid(), signal.SIGINT)       # first: flag only
+            assert stop.requested
+            with pytest.raises(KeyboardInterrupt):
+                os.kill(os.getpid(), signal.SIGINT)   # second: escalate
+        finally:
+            stop.restore()
+        assert signal.getsignal(signal.SIGINT) is before
+
+    def test_stop_during_failing_chunk_interrupts_not_raises(self,
+                                                             tmp_path):
+        """A stop that lands while a chunk is FAILING must short-cut
+        the backoff/retry ladder and raise JobInterrupted (resumable
+        rc), never the chunk's own error after exhausted retries."""
+        ck = str(tmp_path / "job.npz")
+        stop = GracefulStop()
+
+        def failing(lo, hi):
+            if lo == 4:
+                stop.request()
+                raise RuntimeError("transient chunk failure")
+            return _fake_chunk(lo, hi)
+
+        with pytest.raises(JobInterrupted) as exc:
+            run_sweep_job(failing, 12, chunk_size=4,
+                          checkpoint_path=ck, signature="s", stop=stop,
+                          install_signals=False, backoff_s=30.0,
+                          recorder=telemetry.MetricsRecorder())
+        assert exc.value.rc == RESUMABLE_RC
+        # chunk 0 banked; the failing chunk was neither retried nor
+        # slept for (backoff_s=30 would blow the test budget if it had)
+        assert checkpoint.peek(ck)["done_upto"] == 4
+
+    def test_stop_during_backoff_sleep_interrupts_promptly(self,
+                                                           tmp_path):
+        """A stop landing DURING the backoff sleep (not just before it)
+        must cut the sleep short — a 30 s capped backoff would outlive
+        a preemption grace window."""
+        ck = str(tmp_path / "job.npz")
+        stop = GracefulStop()
+
+        class StopOnRetry(telemetry.MetricsRecorder):
+            def event(self, kind, **kw):
+                super().event(kind, **kw)
+                if kind == "driver.retry":    # emitted just before the
+                    stop.request()            # sleep: stop lands mid-wait
+
+        def failing(lo, hi):
+            if lo == 4:
+                raise RuntimeError("transient chunk failure")
+            return _fake_chunk(lo, hi)
+
+        t0 = time.monotonic()
+        with pytest.raises(JobInterrupted) as exc:
+            run_sweep_job(failing, 12, chunk_size=4,
+                          checkpoint_path=ck, signature="s", stop=stop,
+                          install_signals=False, backoff_s=30.0,
+                          jitter=0.0, recorder=StopOnRetry())
+        assert time.monotonic() - t0 < 5.0    # not the 30 s backoff
+        assert exc.value.rc == RESUMABLE_RC
+        assert checkpoint.peek(ck)["done_upto"] == 4
+
+    def test_failed_reexec_reraises_original_error(self, tmp_path):
+        """A broken reexec_argv must not replace the poisoned-backend
+        error with the exec's OSError; the attempt is paired with a
+        driver.reexec_failed event so post-mortems don't count an
+        escalation that never ran."""
+        ck = str(tmp_path / "job.npz")
+        rec = telemetry.MetricsRecorder()
+        with procfaults.inject(procfaults.ProcFaultSpec(
+                mode="poison_backend", chunk=0, heal_on_reexec=False)):
+            with pytest.raises(BackendPoisonedError):
+                run_sweep_job(_fake_chunk, 8, chunk_size=4,
+                              checkpoint_path=ck, signature="s",
+                              reexec_argv=["/nonexistent/interpreter"],
+                              recorder=rec, backoff_s=0.01)
+        (attempt,) = rec.events("driver.reexec")
+        (failed,) = rec.events("driver.reexec_failed")
+        assert attempt["count"] == failed["count"] == 1
+        assert "FileNotFoundError" in failed["error"]
+
+    def test_rescue_hook_receives_final_results(self):
+        seen = {}
+
+        def rescue(results):
+            seen.update(results)
+
+        run_sweep_job(_fake_chunk, 6, chunk_size=3, rescue=rescue,
+                      recorder=telemetry.MetricsRecorder())
+        np.testing.assert_array_equal(seen["y"], _fake_reference(6))
+
+    def test_bad_chunk_shape_rejected(self):
+        def bad(lo, hi):
+            return {"y": np.zeros(hi - lo + 1)}
+
+        with pytest.raises(ValueError, match="elements for chunk"):
+            run_sweep_job(bad, 8, chunk_size=4, max_retries=0,
+                          recorder=telemetry.MetricsRecorder())
+
+
+class TestProcFaultSpecs:
+    def test_env_parsing(self, monkeypatch):
+        monkeypatch.setenv(
+            "PYCHEMKIN_PROC_FAULTS",
+            '[{"mode": "kill_at_chunk", "chunk": 2, '
+            '"when": "before_bank"}]')
+        (spec,) = procfaults.specs()
+        assert spec.mode == "kill_at_chunk"
+        assert spec.chunk == 2 and spec.when == "before_bank"
+        assert procfaults.enabled()
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown proc-fault mode"):
+            procfaults.ProcFaultSpec.from_dict({"mode": "typo"})
+        with pytest.raises(ValueError, match="when"):
+            procfaults.ProcFaultSpec.from_dict(
+                {"mode": "kill_at_chunk", "when": "sometime"})
+
+    def test_context_scoping_and_off_by_default(self):
+        assert not procfaults.enabled()
+        spec = procfaults.ProcFaultSpec(mode="fail_chunk", chunk=0)
+        with procfaults.inject(spec):
+            assert procfaults.specs() == (spec,)
+        assert procfaults.specs() == ()
+
+    def test_n_times_limits_fires(self):
+        spec = procfaults.ProcFaultSpec(mode="fail_chunk", chunk=0,
+                                        n_times=1)
+        with procfaults.inject(spec):
+            with pytest.raises(RuntimeError):
+                procfaults.on_chunk_start(0)
+            procfaults.on_chunk_start(0)        # second hit: spent
+            procfaults.on_chunk_start(1)        # wrong chunk: inert
+
+
+# ---------------------------------------------------------------------------
+# process-level chaos: real kills, real resumes, real re-execs (cheap
+# fake sweep — the mechanics under test are the driver's, not jax's)
+
+
+CHAOS_B, CHAOS_CHUNK = 12, 4
+
+_CHAOS_SCRIPT = textwrap.dedent(f"""
+    import json, sys, time
+    sys.path.insert(0, {PKG_ROOT!r})
+    import numpy as np
+    from pychemkin_tpu.resilience import checkpoint, driver
+
+    B, CHUNK = {CHAOS_B}, {CHAOS_CHUNK}
+
+    def solve_chunk(lo, hi):
+        if "--slow" in sys.argv:
+            time.sleep(0.4)
+        x = np.arange(lo, hi, dtype=float)
+        return {{"y": np.sin(x) * 3.0, "ok": np.ones(hi - lo, bool)}}
+
+    sig = checkpoint.signature("chaos-fake-sweep",
+                               arrays=(np.arange(B, dtype=float),))
+    reexec = ([sys.executable] + sys.argv if "--reexec" in sys.argv
+              else None)
+    try:
+        res, rep = driver.run_sweep_job(
+            solve_chunk, B, chunk_size=CHUNK,
+            checkpoint_path=sys.argv[1], signature=sig,
+            result_keys=("y", "ok"), label="chaos", backoff_s=0.01,
+            reexec_argv=reexec)
+        print(json.dumps({{"y": list(res["y"]),
+                           "report": rep.as_dict()}}))
+    except driver.JobInterrupted as e:
+        sys.exit(e.rc)
+""")
+
+
+def _run_chaos(tmp_path, ck, *args, faults=None, timeout=120):
+    script = tmp_path / "chaos_job.py"
+    script.write_text(_CHAOS_SCRIPT)
+    env = _child_env()
+    if faults is not None:
+        env["PYCHEMKIN_PROC_FAULTS"] = json.dumps(faults)
+    return subprocess.run(
+        [sys.executable, str(script), ck] + list(args),
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+def _last_json(stdout):
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return None
+
+
+class TestProcessChaos:
+    def test_kill_at_chunk_resume_completes(self, tmp_path):
+        ck = str(tmp_path / "job.npz")
+        r = _run_chaos(tmp_path, ck, faults=[
+            {"mode": "kill_at_chunk", "chunk": 1}])
+        assert r.returncode == -signal.SIGKILL, r.stderr
+        assert checkpoint.peek(ck)["done_upto"] == 8   # chunks 0,1 banked
+        r2 = _run_chaos(tmp_path, ck)
+        assert r2.returncode == 0, r2.stderr
+        out = _last_json(r2.stdout)
+        np.testing.assert_array_equal(out["y"],
+                                      _fake_reference(CHAOS_B))
+        assert out["report"]["resume_count"] == 1
+        assert out["report"]["resumed_upto"] == 8
+        assert out["report"]["chunks_run"] == 1        # only the tail
+
+    def test_kill_before_bank_loses_only_inflight_chunk(self, tmp_path):
+        ck = str(tmp_path / "job.npz")
+        r = _run_chaos(tmp_path, ck, faults=[
+            {"mode": "kill_at_chunk", "chunk": 1,
+             "when": "before_bank"}])
+        assert r.returncode == -signal.SIGKILL
+        assert checkpoint.peek(ck)["done_upto"] == 4   # chunk 1 lost
+        r2 = _run_chaos(tmp_path, ck)
+        assert r2.returncode == 0
+        out = _last_json(r2.stdout)
+        np.testing.assert_array_equal(out["y"],
+                                      _fake_reference(CHAOS_B))
+        assert out["report"]["chunks_run"] == 2        # 1 replayed + tail
+
+    def test_hang_child_killed_then_resumed(self, tmp_path):
+        """A wedged chunk (hung backend) is killed from outside — the
+        benchmarks watchdog idiom — and the rerun resumes from the
+        bank."""
+        ck = str(tmp_path / "job.npz")
+        script = tmp_path / "chaos_job.py"
+        script.write_text(_CHAOS_SCRIPT)
+        env = _child_env(PYCHEMKIN_PROC_FAULTS=json.dumps(
+            [{"mode": "hang_child", "chunk": 1, "seconds": 600}]))
+        proc = subprocess.Popen([sys.executable, str(script), ck],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL, env=env)
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if checkpoint.peek(ck) is not None:
+                    break                 # chunk 0 banked; hang is next
+                time.sleep(0.02)
+            else:
+                pytest.fail("no checkpoint appeared in time")
+            # give the hang a moment to engage, then prove the child is
+            # wedged (still alive, no further progress) and kill it —
+            # the external-watchdog idiom
+            time.sleep(1.0)
+            assert proc.poll() is None, "hung child exited on its own"
+        finally:
+            proc.kill()
+            proc.wait()
+        assert checkpoint.peek(ck)["done_upto"] == 4
+        r2 = _run_chaos(tmp_path, ck)
+        assert r2.returncode == 0
+        np.testing.assert_array_equal(_last_json(r2.stdout)["y"],
+                                      _fake_reference(CHAOS_B))
+
+    def test_torn_checkpoint_recomputes_cleanly(self, tmp_path):
+        """Tear the checkpoint mid-file after the LAST bank: the rerun
+        must recompute from scratch — never raise, never return garbage
+        (the 'corrupt checkpoint is an optimization miss' promise)."""
+        ck = str(tmp_path / "job.npz")
+        r = _run_chaos(tmp_path, ck, faults=[
+            {"mode": "torn_checkpoint", "chunk": 2}])
+        assert r.returncode == 0, r.stderr       # job itself completed
+        assert checkpoint.peek(ck) is None       # file is torn
+        r2 = _run_chaos(tmp_path, ck)
+        assert r2.returncode == 0, r2.stderr
+        out = _last_json(r2.stdout)
+        np.testing.assert_array_equal(out["y"],
+                                      _fake_reference(CHAOS_B))
+        assert out["report"]["resume_count"] == 0    # full recompute
+        assert checkpoint.peek(ck)["done_upto"] == CHAOS_B  # healed
+
+    def test_poison_backend_escalates_to_reexec(self, tmp_path):
+        """A poisoned backend at chunk 1 cannot be retried in-process;
+        with re-exec configured the process replaces itself, the fresh
+        process has a clean backend (heal_on_reexec) and resumes from
+        the bank — ONE spawn from the parent's point of view."""
+        ck = str(tmp_path / "job.npz")
+        r = _run_chaos(tmp_path, ck, "--reexec", faults=[
+            {"mode": "poison_backend", "chunk": 1}])
+        assert r.returncode == 0, r.stderr
+        out = _last_json(r.stdout)
+        np.testing.assert_array_equal(out["y"],
+                                      _fake_reference(CHAOS_B))
+        assert out["report"]["resume_count"] == 1    # resumed post-exec
+        assert out["report"]["resumed_upto"] == 4
+
+    def test_sigterm_exits_resumable_rc(self, tmp_path):
+        """The documented signal contract on a real process: SIGTERM →
+        in-flight chunk finishes, banks, exit code RESUMABLE_RC."""
+        ck = str(tmp_path / "job.npz")
+        script = tmp_path / "chaos_job.py"
+        script.write_text(_CHAOS_SCRIPT)
+        proc = subprocess.Popen(
+            [sys.executable, str(script), ck, "--slow"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=_child_env())
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if checkpoint.peek(ck) is not None:
+                break                       # first chunk banked
+            time.sleep(0.02)
+        else:
+            proc.kill()
+            pytest.fail("no checkpoint appeared in time")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        assert rc == RESUMABLE_RC, rc
+        m = checkpoint.peek(ck)
+        assert 0 < m["done_upto"] < CHAOS_B
+        r2 = _run_chaos(tmp_path, ck)
+        assert r2.returncode == 0
+        out = _last_json(r2.stdout)
+        np.testing.assert_array_equal(out["y"],
+                                      _fake_reference(CHAOS_B))
+        assert out["report"]["resume_count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE 4 acceptance scenario: a REAL B=64 ignition sweep, killed,
+# resumed, bit-matched — and SIGTERM'd into the resumable rc
+
+
+_SWEEP_SCRIPT = textwrap.dedent(f"""
+    import json, sys
+    sys.path.insert(0, {PKG_ROOT!r})
+    import numpy as np
+    import jax.numpy as jnp
+    from pychemkin_tpu import parallel
+    from pychemkin_tpu.mechanism import load_embedded
+    from pychemkin_tpu.ops import thermo
+    from pychemkin_tpu.resilience import driver
+
+    mech = load_embedded("h2o2")
+    names = list(mech.species_names)
+    X = np.zeros(len(names))
+    X[names.index("H2")] = 2.0
+    X[names.index("O2")] = 1.0
+    X[names.index("N2")] = 3.76
+    Y = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+    T0s = np.linspace(1000.0, 1400.0, 64)
+    job = {{}}
+    try:
+        times, ok, status = parallel.sharded_ignition_sweep(
+            mech, "CONP", "ENRG", T0s, 1.01325e6, Y, 2e-3,
+            rtol=1e-6, atol=1e-12, max_steps_per_segment=8000,
+            chunk_size=16, checkpoint_path=sys.argv[1],
+            job_report=job)
+        print(json.dumps({{
+            "times": [float(t) for t in times],
+            "ok": [bool(o) for o in ok],
+            "status": [int(s) for s in status],
+            "report": job}}))
+    except driver.JobInterrupted as e:
+        sys.exit(e.rc)
+""")
+
+
+@pytest.fixture(scope="module")
+def sweep_reference():
+    """The uninterrupted B=64 sweep, computed in-process (same virtual
+    8-device mesh and chunk layout the child processes use)."""
+    import jax.numpy as jnp
+
+    from pychemkin_tpu import parallel
+    from pychemkin_tpu.mechanism import load_embedded
+    from pychemkin_tpu.ops import thermo
+
+    mech = load_embedded("h2o2")
+    names = list(mech.species_names)
+    X = np.zeros(len(names))
+    X[names.index("H2")] = 2.0
+    X[names.index("O2")] = 1.0
+    X[names.index("N2")] = 3.76
+    Y = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+    T0s = np.linspace(1000.0, 1400.0, 64)
+    times, ok, status = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, Y, 2e-3,
+        rtol=1e-6, atol=1e-12, max_steps_per_segment=8000,
+        chunk_size=16)
+    return np.asarray(times), np.asarray(ok), np.asarray(status)
+
+
+def _run_sweep_child(tmp_path, ck, faults=None, timeout=900):
+    script = tmp_path / "sweep_job.py"
+    script.write_text(_SWEEP_SCRIPT)
+    env = _child_env()
+    if faults is not None:
+        env["PYCHEMKIN_PROC_FAULTS"] = json.dumps(faults)
+    return subprocess.run([sys.executable, str(script), ck],
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
+@pytest.mark.slow
+class TestDurableSweepAcceptance:
+    """Real-solve end-to-end (slow lane: stiff-integrator compiles in
+    parent + children; the driver MECHANICS these scenarios exercise
+    run in the fast lane via TestProcessChaos' fake sweeps)."""
+
+    def test_killed_sweep_resumes_and_bitmatches(self, tmp_path,
+                                                 sweep_reference):
+        """ISSUE 4 acceptance, part 1: kill-at-chunk-2 injected into a
+        B=64 ignition sweep; the rerun resumes, completes, the banked
+        chunks BIT-match the uninterrupted run, resume_count == 1."""
+        ref_times, ref_ok, ref_status = sweep_reference
+        ck = str(tmp_path / "sweep.ck.npz")
+        r = _run_sweep_child(tmp_path, ck, faults=[
+            {"mode": "kill_at_chunk", "chunk": 2}])
+        assert r.returncode == -signal.SIGKILL, r.stderr[-800:]
+        m = checkpoint.peek(ck)
+        assert m["done_upto"] == 48            # chunks 0,1,2 of 16 banked
+        # the bank itself already bit-matches the uninterrupted run
+        np.testing.assert_array_equal(m["results"]["times"],
+                                      ref_times[:48])
+
+        r2 = _run_sweep_child(tmp_path, ck)
+        assert r2.returncode == 0, r2.stderr[-800:]
+        out = _last_json(r2.stdout)
+        times = np.asarray(out["times"])
+        # banked chunks: bit-identical to the uninterrupted sweep
+        np.testing.assert_array_equal(times[:48], ref_times[:48])
+        # the replayed tail chunk: same program, same answer
+        np.testing.assert_allclose(times[48:], ref_times[48:],
+                                   rtol=1e-12)
+        assert np.array_equal(np.asarray(out["ok"]), ref_ok)
+        assert np.array_equal(np.asarray(out["status"]), ref_status)
+        assert out["report"]["resume_count"] == 1
+        assert out["report"]["resumed_upto"] == 48
+        assert out["report"]["chunks_run"] == 1
+
+    def test_sigterm_mid_sweep_exits_resumable(self, tmp_path,
+                                               sweep_reference):
+        """ISSUE 4 acceptance, part 2: SIGTERM mid-sweep → the in-flight
+        chunk finishes and BANKS, the process exits with the documented
+        resumable rc, and the rerun completes to the reference answer."""
+        ref_times, _, _ = sweep_reference
+        ck = str(tmp_path / "sweep_term.ck.npz")
+        script = tmp_path / "sweep_job.py"
+        script.write_text(_SWEEP_SCRIPT)
+        proc = subprocess.Popen([sys.executable, str(script), ck],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL,
+                                env=_child_env())
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            if checkpoint.peek(ck) is not None:
+                break                        # first chunk banked
+            time.sleep(0.1)
+        else:
+            proc.kill()
+            pytest.fail("no checkpoint appeared in time")
+        banked_at_signal = checkpoint.peek(ck)["done_upto"]
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=600)
+        m = checkpoint.peek(ck)
+        if m["done_upto"] >= 64:
+            # the sweep outran the signal: landed during the final
+            # chunk → still the resumable rc (stop is never swallowed);
+            # landed after the job (handlers restored) → default
+            # disposition; fully done before delivery → clean exit
+            assert rc in (RESUMABLE_RC, -signal.SIGTERM, 0), rc
+            return
+        assert rc == RESUMABLE_RC, rc
+        # the in-flight chunk was banked AFTER the signal landed
+        assert m["done_upto"] >= banked_at_signal
+        np.testing.assert_array_equal(
+            m["results"]["times"], ref_times[:m["done_upto"]])
+
+        r2 = _run_sweep_child(tmp_path, ck)
+        assert r2.returncode == 0, r2.stderr[-800:]
+        out = _last_json(r2.stdout)
+        np.testing.assert_array_equal(
+            np.asarray(out["times"])[:m["done_upto"]],
+            ref_times[:m["done_upto"]])
+        np.testing.assert_allclose(np.asarray(out["times"]), ref_times,
+                                   rtol=1e-12)
+        assert out["report"]["resume_count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# driver-backed model sweeps (the run_sweep surface)
+
+
+@pytest.mark.slow
+class TestModelSweepDriver:
+    """Driver-backed model run_sweep surface (slow lane: each chunk
+    layout compiles its own batch-integrator program)."""
+
+    @pytest.fixture(scope="class")
+    def reactor(self):
+        import jax.numpy as jnp
+
+        from pychemkin_tpu.chemistry import Chemistry
+        from pychemkin_tpu.mechanism import load_embedded
+        from pychemkin_tpu.mixture import Mixture
+        from pychemkin_tpu.models.batch import (
+            GivenPressureBatchReactor_EnergyConservation,
+        )
+        from pychemkin_tpu.ops import thermo
+
+        mech = load_embedded("h2o2")
+        names = list(mech.species_names)
+        X = np.zeros(len(names))
+        X[names.index("H2")] = 2.0
+        X[names.index("O2")] = 1.0
+        X[names.index("N2")] = 3.76
+        Y = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+        chem = Chemistry.from_mechanism(mech)
+        mix = Mixture(chem)
+        mix.temperature = 1200.0
+        mix.pressure = 1.01325e6
+        mix.Y = Y
+        r = GivenPressureBatchReactor_EnergyConservation(mix)
+        r.time = 5e-4
+        return r
+
+    def test_batch_chunked_checkpoint_resume(self, reactor, tmp_path):
+        """The model-layer sweep under the driver: chunked == unchunked,
+        and a rewound checkpoint resumes without re-solving banked
+        elements."""
+        T0s = np.linspace(1100.0, 1300.0, 4)
+        ref, ref_ok, _ = reactor.run_sweep(T0s=T0s)
+
+        ck = str(tmp_path / "batch.ck.npz")
+        job = {}
+        t1, ok1, st1 = reactor.run_sweep(T0s=T0s, chunk_size=2,
+                                         checkpoint_path=ck,
+                                         job_report=job)
+        np.testing.assert_allclose(t1, ref, rtol=1e-10)
+        assert job["n_chunks"] == 2 and job["resume_count"] == 0
+
+        m = checkpoint.peek(ck)
+        checkpoint.save(ck, sig=m["sig"], B=4, done_upto=2,
+                        results={k: v[:2] for k, v in
+                                 m["results"].items()},
+                        recorder=telemetry.MetricsRecorder())
+        job2 = {}
+        t2, ok2, _ = reactor.run_sweep(T0s=T0s, chunk_size=2,
+                                       checkpoint_path=ck,
+                                       job_report=job2)
+        assert job2["resume_count"] == 1 and job2["resumed_upto"] == 2
+        assert job2["chunks_run"] == 1
+        np.testing.assert_allclose(t2, ref, rtol=1e-10)
+        assert np.array_equal(ok2, ref_ok)
+
+    def test_batch_sweep_signature_excludes_layout(self, reactor,
+                                                   tmp_path):
+        """The checkpoint is reusable across chunk layouts: bank with
+        chunk_size=2, resume with chunk_size=3 — the banked elements
+        are adopted, not discarded (the ISSUE 4 portability fix)."""
+        T0s = np.linspace(1100.0, 1300.0, 4)
+        ck = str(tmp_path / "batch.ck.npz")
+        reactor.run_sweep(T0s=T0s, chunk_size=2, checkpoint_path=ck)
+        job = {}
+        t, ok, _ = reactor.run_sweep(T0s=T0s, chunk_size=3,
+                                     checkpoint_path=ck, job_report=job)
+        assert job["resume_count"] == 1          # layout change kept it
+        assert job["resumed_upto"] == 4
+        assert job["chunks_run"] == 0            # nothing re-solved

@@ -88,6 +88,68 @@ class TestClusterMode:
             net.run_cluster()
 
 
+class TestClusterScan:
+    """Driver-backed cluster S-curve scan (ISSUE 4): the chain
+    re-solved at scaled residence times, vmapped and checkpointable."""
+
+    def _chain_net(self, chem):
+        net = ReactorNetwork(chem)
+        psrs = [make_psr(chem, f"s{i}") for i in range(2)]
+        psrs[0].set_inlet(make_feed(chem))
+        net.add_reactor_list(psrs)
+        net.add_outflow_connections("s1", [("EXIT>>", 1.0)])
+        return net
+
+    def test_scan_brackets_run_cluster(self, chem, tmp_path):
+        """Scale 1.0 of the scan must reproduce run_cluster's solution;
+        neighbouring scales solve too (the S-curve neighbourhood) —
+        and a rewound checkpoint resumes without re-solving banked
+        scan points."""
+        import numpy as np
+
+        from pychemkin_tpu import telemetry
+        from pychemkin_tpu.resilience import checkpoint
+
+        ref = self._chain_net(chem)
+        assert ref.run_cluster() == 0
+        T_ref = [ref.get_reactor_stream(n).temperature
+                 for n in ("s0", "s1")]
+
+        net = self._chain_net(chem)
+        ck = str(tmp_path / "scan.ck.npz")
+        job = {}
+        T, Y, conv, status = net.run_cluster_scan(
+            [1.0, 0.8, 1.2], chunk_size=3, checkpoint_path=ck,
+            job_report=job)
+        assert T.shape == (3, 2) and Y.shape[0] == 3
+        assert bool(np.all(conv)) and np.all(status == 0)
+        np.testing.assert_allclose(T[0], T_ref, atol=0.5)
+        assert job["resume_count"] == 0
+
+        # rewind to 1 banked point; the resume adopts it verbatim
+        m = checkpoint.peek(ck)
+        checkpoint.save(ck, sig=m["sig"], B=3, done_upto=1,
+                        results={k: v[:1] for k, v in
+                                 m["results"].items()},
+                        recorder=telemetry.MetricsRecorder())
+        job2 = {}
+        T2, _, conv2, _ = net.run_cluster_scan(
+            [1.0, 0.8, 1.2], chunk_size=3, checkpoint_path=ck,
+            job_report=job2)
+        assert job2["resume_count"] == 1 and job2["resumed_upto"] == 1
+        np.testing.assert_array_equal(T2[0], T[0])
+        np.testing.assert_allclose(T2, T, rtol=1e-8)
+
+    def test_scan_rejects_nonchain(self, chem):
+        net = ReactorNetwork(chem)
+        psrs = [make_psr(chem, f"x{i}") for i in range(2)]
+        psrs[0].set_inlet(make_feed(chem))
+        psrs[1].set_inlet(make_feed(chem))
+        net.add_reactor_list(psrs)
+        with pytest.raises(RuntimeError, match="linear chain"):
+            net.run_cluster_scan([1.0])
+
+
 class TestClusterRejectionBranches:
     """Every ``return None`` topology of ``_linear_psr_chain`` plus the
     pressure-mismatch guard must reject with the linear-chain

@@ -49,3 +49,40 @@ def test_real_failure_still_fails(tmp_path):
     r = _run([str(f_bad), f_match])
     assert r.returncode == 1
     assert "FAILED test_gamma.py" in r.stdout
+    # a deterministic failure (positive rc) is NEVER retried
+    assert "retrying once" not in r.stdout
+
+
+def test_signal_killed_child_retried_once(tmp_path):
+    """ISSUE 4 satellite: a child pytest that dies on a SIGNAL (OOM
+    kill, sporadic XLA:CPU segfault) is retried once; if the retry
+    passes, the file passes and the retry is marked in the summary."""
+    flag = tmp_path / "died_once.flag"
+    f_flaky = tmp_path / "test_flaky_kill.py"
+    f_flaky.write_text(
+        "import os, signal\n"
+        f"FLAG = {str(flag)!r}\n"
+        "def test_survives_second_run():\n"
+        "    if not os.path.exists(FLAG):\n"
+        "        open(FLAG, 'w').close()\n"
+        "        os.kill(os.getpid(), signal.SIGKILL)\n"
+        "    assert True\n")
+    r = _run([str(f_flaky)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "killed by signal 9; retrying once" in r.stdout
+    assert "(retried after signal)" in r.stdout
+    assert "1 retried" in r.stdout
+
+
+def test_signal_killed_twice_still_fails(tmp_path):
+    """The retry de-flakes infra kills without masking a child that
+    ALWAYS dies: one retry only, then the file fails with its rc."""
+    f_dead = tmp_path / "test_always_kill.py"
+    f_dead.write_text(
+        "import os, signal\n"
+        "def test_always_dies():\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n")
+    r = _run([str(f_dead)])
+    assert r.returncode == 1
+    assert "retrying once" in r.stdout
+    assert "FAILED test_always_kill.py rc=-9" in r.stdout

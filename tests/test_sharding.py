@@ -124,6 +124,19 @@ def test_program_cache_hit(mech, stoich_Y):
     assert n1 == n0 + 1          # one new program, reused on the rerun
 
 
+def _rewind_checkpoint(ck, done_upto):
+    """Trim the banked manifest to ``done_upto`` elements (a simulated
+    preemption between chunks)."""
+    from pychemkin_tpu import telemetry
+    from pychemkin_tpu.resilience import checkpoint
+
+    m = checkpoint.peek(ck)
+    checkpoint.save(
+        ck, sig=m["sig"], B=m["B"], done_upto=done_upto,
+        results={k: v[:done_upto] for k, v in m["results"].items()},
+        recorder=telemetry.MetricsRecorder())
+
+
 def test_checkpointed_sweep_resumes(mech, stoich_Y, tmp_path):
     """On-disk checkpoint/resume for long sweeps (SURVEY §5): a sweep
     interrupted after some chunks resumes from the checkpoint and
@@ -144,22 +157,88 @@ def test_checkpointed_sweep_resumes(mech, stoich_Y, tmp_path):
     np.testing.assert_allclose(t1, ref_t, rtol=1e-12)
 
     # simulate a preemption after 2 of 3 chunks: rewind the marker
-    with np.load(ck) as data:
-        saved = {k: data[k] for k in data.files}
-    saved["done_upto"] = np.asarray(16)
-    saved["times"] = saved["times"][:16]
-    saved["ok"] = saved["ok"][:16]
-    saved["status"] = saved["status"][:16]
-    np.savez(ck, **saved)
+    _rewind_checkpoint(ck, 16)
 
     resume_stats = parallel.SweepStats()
+    job = {}
     t2, ok2, _ = parallel.sharded_ignition_sweep(
         mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
-        checkpoint_path=ck, stats=resume_stats, **kw)
+        checkpoint_path=ck, stats=resume_stats, job_report=job, **kw)
     np.testing.assert_allclose(t2, ref_t, rtol=1e-12)
     assert np.array_equal(ok2, ref_ok)
     # only the last chunk re-ran
     assert 0 < resume_stats.n_steps < 0.6 * full_stats.n_steps
+    assert job["resume_count"] == 1 and job["resumed_upto"] == 16
+
+
+def test_checkpoint_resumes_across_device_counts(mech, stoich_Y,
+                                                 tmp_path):
+    """ISSUE 4 satellite: the manifest banks ELEMENTS, not a chunk
+    layout — a checkpoint written on the 8-device mesh must resume on
+    a 4-device mesh (different rounded chunk size) WITHOUT discarding
+    banked work, and reproduce the uninterrupted answer."""
+    T0s = np.linspace(1050.0, 1350.0, 24)
+    base = dict(rtol=1e-6, atol=1e-12, max_steps_per_segment=8000)
+    mesh8 = parallel.make_mesh()
+    assert mesh8.devices.size == 8
+    ref_t, ref_ok, _ = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        mesh=mesh8, chunk_size=8, **base)
+
+    # bank the full sweep on the 8-device mesh, chunk_size=12 (rounds
+    # to 8 on mesh8, to 12 on mesh4 — the layouts genuinely differ)
+    ck = str(tmp_path / "sweep.ck.npz")
+    parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        mesh=mesh8, chunk_size=12, checkpoint_path=ck, **base)
+    _rewind_checkpoint(ck, 16)          # preempted after 2 of 3 chunks
+
+    # resume on HALF the devices: the banked 16 elements are adopted,
+    # only the tail is recomputed (stats prove it), results match
+    mesh4 = parallel.make_mesh(n_devices=4)
+    resume_stats = parallel.SweepStats()
+    job = {}
+    t2, ok2, _ = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        mesh=mesh4, chunk_size=12, checkpoint_path=ck,
+        stats=resume_stats, job_report=job, **base)
+    assert job["resume_count"] == 1 and job["resumed_upto"] == 16
+    # banked elements are returned verbatim: bit-identical
+    np.testing.assert_array_equal(t2[:16], ref_t[:16])
+    np.testing.assert_allclose(t2, ref_t, rtol=1e-12)
+    assert np.array_equal(ok2, ref_ok)
+    # only ~8/24 elements were solved by the resume
+    assert 0 < resume_stats.n_steps
+
+
+def test_torn_checkpoint_recomputes_not_raises(mech, stoich_Y,
+                                               tmp_path):
+    """ISSUE 4 satellite: truncate the banked ``.npz`` mid-file — the
+    'corrupt checkpoint is an optimization miss, not an error' promise:
+    the sweep must recompute cleanly and return the right answer."""
+    import os
+
+    mesh = parallel.make_mesh()
+    T0s = np.linspace(1100.0, 1300.0, 16)
+    ck = str(tmp_path / "sweep.ck.npz")
+    kw = dict(mesh=mesh, rtol=1e-6, atol=1e-12,
+              max_steps_per_segment=8000, chunk_size=8,
+              checkpoint_path=ck)
+    t1, ok1, _ = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3, **kw)
+    size = os.path.getsize(ck)
+    with open(ck, "r+b") as f:
+        f.truncate(size // 2)               # the torn write
+    job = {}
+    t2, ok2, _ = parallel.sharded_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, 1.01325e6, stoich_Y, 2e-3,
+        job_report=job, **kw)
+    assert job["resume_count"] == 0         # nothing usable was banked
+    np.testing.assert_allclose(t2, t1, rtol=1e-12)
+    assert np.array_equal(ok2, ok1)
+    # and the rerun healed the file
+    from pychemkin_tpu.resilience import checkpoint
+    assert checkpoint.peek(ck)["done_upto"] == 16
 
 
 def test_checkpoint_ignores_stale_file(mech, stoich_Y, tmp_path):

@@ -164,19 +164,22 @@ class TestDeviceCounterBridge:
 
 #: every key a bench rung JSON line must carry — the banked-summary
 #: schema consumers (post-mortems, VERDICT parsing) rely on, including
-#: the resilience counters added by ISSUE 3
+#: the resilience counters added by ISSUE 3 and the durability fields
+#: (driver-run sweeps) added by ISSUE 4
 RUNG_SCHEMA_KEYS = (
     "platform", "n_chips", "mech", "B", "chunk", "compile_s", "run_s",
     "throughput", "rtol", "atol", "t_end", "n_ok", "n_ignited",
     "n_steps", "n_rejected", "n_newton", "steps_per_sec",
     "model_f32_gflop", "model_f64_gflop", "mfu_pct",
     "n_failed", "n_rescued", "n_abandoned", "status_counts",
+    "resume_count", "chunks_replayed", "driver_overhead_s",
 )
 
 #: rung keys that _build_summary must forward into configs_run
 CONFIGS_RUN_KEYS = (
     "mech", "B", "chunk", "throughput", "mfu_pct", "n_failed",
     "n_rescued", "n_abandoned", "status_counts",
+    "resume_count", "chunks_replayed", "driver_overhead_s",
 )
 
 
@@ -193,6 +196,8 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
         "n_abandoned": min(n_failed, 1),
         "status_counts": ({"OK": B - 1, "NONFINITE": 1} if n_failed
                           else {"OK": B}),
+        "resume_count": 0, "chunks_replayed": 0,
+        "driver_overhead_s": 0.001,
     }
 
 
@@ -355,8 +360,8 @@ class TestBenchRungSchema:
     def test_child_config_emits_full_schema_on_cpu(self, capfd,
                                                    monkeypatch):
         """The REAL bench child's rung JSON must carry every schema key
-        — including the resilience counters — not just the fakes the
-        banking tests use."""
+        — including the resilience counters and the ISSUE 4 durability
+        fields — not just the fakes the banking tests use."""
         monkeypatch.setenv("BENCH_CHUNK", "8")
         benchmarks._child_config("h2o2", 4, 1)
         rung = _summary_lines(capfd.readouterr().out)[-1]
@@ -364,6 +369,77 @@ class TestBenchRungSchema:
             assert key in rung, f"missing rung key {key}"
         assert rung["n_failed"] == 0
         assert rung["status_counts"] == {"OK": 4}
+        assert rung["resume_count"] == 0        # nothing to resume
+        assert rung["driver_overhead_s"] >= 0.0
+
+
+class TestDriverEventSchema:
+    """ISSUE 4 satellite: the checkpoint.save / checkpoint.resume /
+    driver.retry event schemas, asserted alongside the rescue events —
+    what post-mortems of a preempted sweep parse."""
+
+    def _run_job(self, tmp_path, rec):
+        from pychemkin_tpu.resilience import checkpoint, driver, procfaults
+
+        def solve_chunk(lo, hi):
+            return {"y": np.arange(lo, hi, dtype=float)}
+
+        ck = str(tmp_path / "job.ck.npz")
+        sig = checkpoint.signature("telemetry-schema",
+                                   arrays=(np.arange(8.0),))
+        with procfaults.inject(procfaults.ProcFaultSpec(
+                mode="fail_chunk", chunk=1, n_times=1)):
+            driver.run_sweep_job(solve_chunk, 8, chunk_size=4,
+                                 checkpoint_path=ck, signature=sig,
+                                 recorder=rec, backoff_s=0.01,
+                                 label="schema_job")
+        # resume (short-circuits from the completed manifest)
+        driver.run_sweep_job(solve_chunk, 8, chunk_size=4,
+                             checkpoint_path=ck, signature=sig,
+                             recorder=rec, label="schema_job")
+        return ck
+
+    def test_event_schemas(self, tmp_path):
+        rec = telemetry.MetricsRecorder()
+        ck = self._run_job(tmp_path, rec)
+
+        saves = rec.events("checkpoint.save")
+        # one bank per chunk + one metadata rewrite on the
+        # short-circuit resume (persists the lifetime resume_count)
+        assert len(saves) == 3
+        for ev in saves:
+            for key in ("t", "kind", "label", "path", "done_upto", "B"):
+                assert key in ev, f"checkpoint.save missing {key}"
+            assert ev["label"] == "schema_job" and ev["path"] == ck
+        assert [ev["done_upto"] for ev in saves] == [4, 8, 8]
+
+        (resume,) = rec.events("checkpoint.resume")
+        for key in ("t", "kind", "label", "path", "done_upto", "B",
+                    "resume_count"):
+            assert key in resume, f"checkpoint.resume missing {key}"
+        assert resume["done_upto"] == 8 and resume["resume_count"] == 1
+
+        (retry,) = rec.events("driver.retry")
+        for key in ("t", "kind", "label", "chunk", "lo", "hi",
+                    "attempt", "backoff_s", "error"):
+            assert key in retry, f"driver.retry missing {key}"
+        assert retry["chunk"] == 1 and retry["attempt"] == 1
+        assert "fail_chunk" in retry["error"]
+
+        assert rec.counters["checkpoint.saves"] == 3
+        assert rec.counters["checkpoint.resumes"] == 1
+        assert rec.counters["driver.retries"] == 1
+
+    def test_events_reach_jsonl_sink(self, tmp_path):
+        """The driver events ride the same crash-safe sink as every
+        other kind: one parseable line each."""
+        p = str(tmp_path / "ev.jsonl")
+        rec = MetricsRecorder(sink=JsonlSink(p))
+        self._run_job(tmp_path, rec)
+        kinds = [e["kind"] for e in read_jsonl(p)]
+        assert "checkpoint.save" in kinds
+        assert "checkpoint.resume" in kinds
+        assert "driver.retry" in kinds
 
 
 class TestAblationTool:
