@@ -1,0 +1,47 @@
+"""Online serving: single requests coalesced into micro-batches.
+
+A minimal client of ``pychemkin_tpu.serve``: build an in-process
+``ChemServer`` over the h2o2 mechanism, warm the bucket ladder once
+(so live traffic never compiles), then submit independent equilibrium
+requests from plain Python calls. The server coalesces them into
+padded micro-batches behind the scenes; each caller just holds a
+future. The final snapshot shows where the time went (queue-wait vs
+solve histograms, batch occupancy).
+"""
+import numpy as np
+
+import pychemkin_tpu as ck
+from pychemkin_tpu import serve
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.serve import loadgen
+
+mech = load_embedded("h2o2")
+Y = loadgen.stoich_h2_air_Y(mech)        # stoichiometric H2/air
+
+server = serve.ChemServer(mech, bucket_sizes=(1, 4, 8),
+                          max_delay_ms=5.0)
+# option=5 is HP (adiabatic flame): a non-default static key, so the
+# warmup payload must carry it — each option is its own program
+hp = dict(T=300.0, P=ck.P_ATM, Y=Y, option=5)
+compiled = server.warmup(["equilibrium"], payloads={"equilibrium": hp})
+print("warmup compiled %d programs" % compiled["equilibrium"])
+
+with server:                              # start; drains on exit
+    # eight independent "users", one unburnt temperature each — the
+    # server forms the batches; nobody hand-assembles arrays
+    T0s = np.linspace(300.0, 1000.0, 8)
+    futures = [server.submit_equilibrium(**{**hp, "T": float(T0)})
+               for T0 in T0s]
+    for T0, fut in zip(T0s, futures):
+        r = fut.result(timeout=300)
+        print("T0 = %6.1f K -> T_ad = %6.1f K   [batch of %d in a "
+              "%d-bucket, %.1f ms]" % (T0, r.value["T"], r.occupancy,
+                                       r.bucket, r.solve_ms))
+
+snap = server.snapshot()
+occ = snap["histograms"]["serve.batch_occupancy"]
+wait = snap["histograms"]["serve.queue_wait_ms"]
+print("batches=%d  mean occupancy=%.1f  queue-wait p99=%.1f ms  "
+      "recompiles after warmup=%d"
+      % (snap["counters"]["serve.batches"], occ["mean"], wait["p99"],
+         snap["counters"]["serve.compiles"] - compiled["equilibrium"]))

@@ -45,6 +45,7 @@ from . import (  # noqa: E402
     ops,
     parallel,
     resilience,
+    serve,
     telemetry,
 )
 from .chemistry import (  # noqa: E402
@@ -128,6 +129,7 @@ __all__ = [
     "ops",
     "parallel",
     "resilience",
+    "serve",
     "set_verbose",
     "telemetry",
     "verbose",

@@ -51,10 +51,22 @@ durability did and what it cost (the overhead figure is the banking
 cost of the checkpointed warm-up pass; the timed passes run
 checkpoint-free so the headline throughput stays clean).
 
+After the throughput ladder, a ``serve_latency`` rung measures the
+ONLINE path (``pychemkin_tpu/serve/``): an open-loop Poisson request
+stream against the in-process micro-batching server, reporting
+p50/p99 request latency and mean batch occupancy. It runs in its own
+subprocess under the same banking contract, and its JSON rides in the
+summary under ``"serve_latency"``.
+
 Environment knobs:
   BENCH_LADDER      comma list of mech:B pairs (default
                     "h2o2:16,h2o2:256,h2o2:1024,h2o2:4096,
                      grisyn:64,grisyn:256,grisyn:1024,grisyn:4096")
+  BENCH_SERVE       "0" disables the serve_latency rung (default on)
+  BENCH_SERVE_N     serve-rung request count (default 200)
+  BENCH_SERVE_RATE  serve-rung offered rate, req/s (default 100)
+  BENCH_SERVE_MECH  serve-rung mechanism (default h2o2)
+  BENCH_SERVE_TIMEOUT  serve-rung subprocess timeout, s (default 600)
   BENCH_CHUNK       max batch elements per compiled call (default 256).
                     Larger B runs as sequential chunks of ONE cached
                     program, so compile time is flat in B, and a single
@@ -303,6 +315,51 @@ def _child_config(mech_name: str, B: int, repeats: int):
         + timed_replayed,
         driver_overhead_s=round(
             warmup_report.get("driver_overhead_s", 0.0), 6))), flush=True)
+
+
+def _child_serve(mech_name: str, n_requests: int, rate_hz: float):
+    """The serve_latency rung: open-loop Poisson load against the
+    in-process micro-batching server; prints one JSON line. Runs in
+    its own subprocess like every other rung (a wedged backend must
+    not take the bench orchestrator with it)."""
+    import jax
+    import numpy as np_  # shadow-safe alias (module-level np exists)
+
+    from . import serve, telemetry
+    from .mechanism import load_embedded
+    from .serve import loadgen
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    if platform != "cpu":
+        from .utils import enable_compilation_cache
+        enable_compilation_cache(partition="axon")
+    mech = load_embedded(mech_name)
+    rec = telemetry.MetricsRecorder()
+    kinds = ["equilibrium", "ignition"]
+    server = serve.ChemServer(
+        mech, bucket_sizes=(1, 8, 32), max_batch_size=32,
+        max_delay_ms=2.0, queue_depth=1024, recorder=rec,
+        engine_config={"ignition": {"rtol": 1e-6, "atol": 1e-10,
+                                    "max_steps_per_segment": 4000}})
+    t0 = time.time()
+    server.warmup(kinds)
+    warmup_s = time.time() - t0
+    print(f"# serve warmup: {warmup_s:.1f}s", file=sys.stderr)
+    rng = np_.random.default_rng(0)
+    samplers = loadgen.default_samplers(mech, kinds)
+    with server:
+        summary = loadgen.run_load(server, samplers, rate_hz=rate_hz,
+                                   n_requests=n_requests, rng=rng)
+    snap = rec.snapshot()
+    print(json.dumps(dict(
+        rung="serve_latency", platform=platform, mech=mech_name,
+        kinds=kinds, warmup_s=round(warmup_s, 1),
+        compiles=snap["counters"].get("serve.compiles", 0),
+        n_batches=snap["counters"].get("serve.batches", 0),
+        queue_wait_ms=snap["histograms"].get("serve.queue_wait_ms"),
+        solve_ms=snap["histograms"].get("serve.solve_ms"),
+        **summary)), flush=True)
 
 
 def _child_baseline(mech_name: str, n_points: int, budget_s: float):
@@ -713,8 +770,43 @@ def _main_guarded():
             print("# host-CPU compare failed:\n#   "
                   + tail.replace("\n", "\n#   "), file=sys.stderr)
 
+    # online serving rung: open-loop Poisson latency against the
+    # micro-batching server (pychemkin_tpu/serve/) — the online-path
+    # counterpart of the offline throughput ladder, in its own
+    # subprocess under the same isolation contract as every rung
+    serve_rung = None
+    rem = _remaining(deadline)
+    # same minimum-viable-window guard as the ladder rungs: a child
+    # spawned into less than warmup time is killed inside XLA compile
+    if os.environ.get("BENCH_SERVE", "1") != "0" \
+            and (rem is None
+                 or rem > _BUDGET_RESERVE_S + _MIN_RUNG_WINDOW_S):
+        serve_mech = os.environ.get("BENCH_SERVE_MECH", "h2o2")
+        serve_n = int(os.environ.get("BENCH_SERVE_N", 200))
+        serve_rate = float(os.environ.get("BENCH_SERVE_RATE", 100))
+        serve_timeout = float(os.environ.get("BENCH_SERVE_TIMEOUT", 600))
+        if rem is not None:
+            serve_timeout = min(serve_timeout,
+                                rem - _BUDGET_RESERVE_S / 2)
+        rc, serve_rung, tail = _run_child(
+            ["serve", serve_mech, str(serve_n), str(serve_rate)],
+            serve_timeout, env=None if on_accel else _cpu_env())
+        if serve_rung:
+            telemetry.record_event("bench_serve", **serve_rung)
+            print(f"# serve_latency: p50={serve_rung.get('p50_ms')}ms "
+                  f"p99={serve_rung.get('p99_ms')}ms "
+                  f"occupancy={serve_rung.get('mean_occupancy')}",
+                  file=sys.stderr)
+        else:
+            print("# serve_latency rung "
+                  + ("timed out" if rc == -2 else f"failed rc={rc}")
+                  + (":\n#   " + tail.replace("\n", "\n#   ")
+                     if tail else ""), file=sys.stderr)
+
     out = _build_summary(results, baselines, is_fallback=is_fallback,
                          accel_err=accel_err, host_cpu=host_cpu)
+    if serve_rung:
+        out["serve_latency"] = serve_rung
     telemetry.record_event("bench_summary", **out)
     if bank_path:
         telemetry.atomic_write_json(bank_path, out)
@@ -728,6 +820,8 @@ def _dispatch():
         _child_config(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) >= 5 and sys.argv[1] == "baseline":
         _child_baseline(sys.argv[2], int(sys.argv[3]), float(sys.argv[4]))
+    elif len(sys.argv) >= 5 and sys.argv[1] == "serve":
+        _child_serve(sys.argv[2], int(sys.argv[3]), float(sys.argv[4]))
     else:
         main()
 

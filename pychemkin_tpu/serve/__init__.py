@@ -1,0 +1,52 @@
+"""Online serving layer: dynamic micro-batching over the vmapped
+solvers.
+
+Everything below the model layer in this framework is batch-first —
+throughput on this hardware lives entirely in solving many independent
+conditions as one device program (the batched-PSR GPGPU result,
+arXiv:2005.11468). This package is the piece that FORMS those batches
+from a live request stream, the same dynamic-batching shape every
+inference stack has:
+
+>>> from pychemkin_tpu import serve
+>>> server = serve.ChemServer(mech, max_batch_size=32,
+...                           max_delay_ms=2.0)
+>>> server.warmup(["ignition"])          # compile the bucket ladder
+>>> server.start()
+>>> fut = server.submit_ignition(T0=1300.0, P0=1.01325e6, Y0=Y0,
+...                              t_end=1e-3)
+>>> fut.result().value["ignition_delay_ms"]
+
+See :mod:`.server` for the full contract (admission control, bucket
+ladder, rescue hand-off, graceful drain, telemetry).
+"""
+
+from .batcher import BatchPolicy
+from .buckets import DEFAULT_BUCKETS, bucket_for, pad_indices
+from .engines import (
+    ENGINE_TYPES,
+    EquilibriumEngine,
+    IgnitionEngine,
+    PSREngine,
+)
+from .errors import ServeError, ServerClosed, ServerOverloaded
+from .futures import Request, ServeFuture, ServeResult
+from .server import ChemServer
+
+__all__ = [
+    "BatchPolicy",
+    "ChemServer",
+    "DEFAULT_BUCKETS",
+    "ENGINE_TYPES",
+    "EquilibriumEngine",
+    "IgnitionEngine",
+    "PSREngine",
+    "Request",
+    "ServeError",
+    "ServeFuture",
+    "ServeResult",
+    "ServerClosed",
+    "ServerOverloaded",
+    "bucket_for",
+    "pad_indices",
+]

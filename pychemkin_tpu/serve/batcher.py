@@ -1,0 +1,82 @@
+"""Dynamic micro-batching policy: how single requests become batches.
+
+The policy is the classic inference-serving tradeoff pair:
+
+- ``max_batch_size``   the occupancy at which a batch dispatches
+                       immediately (capped at the bucket-ladder top so
+                       every batch fits a compiled shape);
+- ``max_delay_ms``     how long the FIRST request of a forming batch
+                       may wait for company before the batch dispatches
+                       anyway — the latency bound a lone request pays
+                       at low traffic.
+
+``collect`` blocks on the queue for the first request, then gathers
+until either bound trips. During a drain (stop requested) the delay
+bound is ignored: whatever is queued is batched out as fast as the
+ladder allows, nothing waits for company that will never be admitted.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import time
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from .futures import Request
+
+
+class BatchPolicy(NamedTuple):
+    """Micro-batching bounds (see module docstring)."""
+    max_batch_size: int = 32
+    max_delay_ms: float = 2.0
+
+
+def collect(q: "_queue.Queue[Request]", policy: BatchPolicy, stop,
+            poll_s: float = 0.05) -> Optional[List[Request]]:
+    """Gather the next micro-batch from ``q``.
+
+    Blocks (in ``poll_s`` slices, so a stop request is honored
+    promptly) until at least one request arrives, then keeps gathering
+    until ``max_batch_size`` or the delay window closes. Returns None
+    when the queue is empty AND a stop was requested — the drain is
+    complete."""
+    first: Optional[Request] = None
+    while first is None:
+        try:
+            first = q.get(timeout=poll_s)
+        except _queue.Empty:
+            if stop.requested:
+                return None
+            continue
+    batch = [first]
+    deadline = time.perf_counter() + policy.max_delay_ms * 1e-3
+    while len(batch) < policy.max_batch_size:
+        if stop.requested:
+            # draining: take what is already queued, wait for nothing
+            try:
+                batch.append(q.get_nowait())
+                continue
+            except _queue.Empty:
+                break
+        left = deadline - time.perf_counter()
+        if left <= 0.0:
+            break
+        try:
+            # wait in poll_s slices, not one `left`-long block: a stop
+            # request landing mid-window must cut the wait short (the
+            # drain should not ride out the delay bound)
+            batch.append(q.get(timeout=min(left, poll_s)))
+        except _queue.Empty:
+            continue
+    return batch
+
+
+def group(batch: List[Request]) -> List[Tuple[str, Tuple,
+                                              List[Request]]]:
+    """Split a mixed micro-batch into per-(kind, static key) groups —
+    the units that solve as one padded program. Insertion-ordered, so
+    earlier-submitted requests solve first."""
+    groups: Dict[Tuple[str, Tuple], List[Request]] = {}
+    for req in batch:
+        groups.setdefault((req.kind, req.key), []).append(req)
+    return [(kind, key, reqs) for (kind, key), reqs in groups.items()]

@@ -1,0 +1,55 @@
+"""Shape-bucket ladder: the compile-reuse contract of the server.
+
+Every distinct batch size is a distinct XLA program; letting occupancy
+pick the shape would compile a fresh stiff integrator for every
+occupancy ever seen (and re-trace it on every dispatch). Instead each
+micro-batch is padded UP to a fixed ladder of bucket sizes — after a
+one-time warmup of the ladder, every batch the server ever solves is a
+jit cache hit (and, across processes, a persistent-XLA-cache hit; see
+``utils/cache.py``). Padding is edge-replication of the last real
+request, the same trick the durable-sweep driver uses
+(:func:`pychemkin_tpu.resilience.driver.edge_pad_indices`): padded
+lanes are real work, trimmed off after the solve, and lane values are
+independent of their companions, so results bit-match a direct solve
+at the same bucket shape.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from ..resilience.driver import edge_pad_indices
+
+#: default bucket ladder; chosen so padding waste is bounded by ~4x at
+#: the bottom and ~2x between adjacent rungs higher up
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 8, 32, 128)
+
+
+def normalize_ladder(buckets: Sequence[int]) -> Tuple[int, ...]:
+    """Validated, sorted, de-duplicated bucket ladder."""
+    out = tuple(sorted({int(b) for b in buckets}))
+    if not out:
+        raise ValueError("bucket ladder must not be empty")
+    if out[0] <= 0:
+        raise ValueError(f"bucket sizes must be positive, got {out}")
+    return out
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest ladder bucket holding ``n`` requests."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(
+        f"occupancy {n} exceeds the largest bucket {max(buckets)}; "
+        "the server caps batch size at the ladder top")
+
+
+def pad_indices(n: int, bucket: int) -> np.ndarray:
+    """Request indices [bucket] for a batch of ``n`` real requests,
+    edge-padded by repeating the last request."""
+    if not 0 < n <= bucket:
+        raise ValueError(f"cannot pad {n} requests into bucket {bucket}")
+    return edge_pad_indices(0, n, bucket)

@@ -1,0 +1,454 @@
+"""Per-kind solve engines: the bridge from request payloads to the
+batched jitted solvers.
+
+Each engine owns ONE jitted batch function per static group key
+(equilibrium's constraint option; ignition and PSR have a single key),
+created once at engine construction and reused for every bucket shape —
+``jax.jit``'s shape-keyed cache gives one compiled program per bucket,
+so a warmed ladder dispatches with zero retraces. Tracing is counted at
+trace time (a Python side effect in the traced body runs exactly once
+per compile), which is what the ``serve.compiles`` /
+``serve.compiles.<kind>`` counters the acceptance test asserts against
+measure.
+
+Engines also own the OFF-hot-path rescue: ``rescue_one`` re-solves a
+single failed request under the per-kind escalation for rung ``level``
+(the ignition engine reuses the PR 3 ladder's knobs verbatim; the
+fixed-iteration Newton kinds escalate their iteration budgets, the
+knob that fixes a TOL_NOT_MET). Rescue re-solves are also jitted and
+memoized per rung, so a recurring stiff condition only pays its trace
+once per process.
+
+Fault injection (:mod:`pychemkin_tpu.resilience.faultinject`) threads
+through at TRACE time: when a spec is active while an engine traces,
+batch lanes carry their position as the fault element id, and rescue
+re-solves carry the original lane id plus the rung as ``fault_level``
+— so ``heal_at`` semantics work end to end and a clean server embeds
+zero injection nodes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..ops import equilibrium as eq_ops
+from ..ops import psr as psr_ops
+from ..ops import reactors as reactor_ops
+from ..ops import thermo
+from ..resilience import faultinject
+from ..resilience.rescue import DEFAULT_LADDER
+from .buckets import pad_indices
+
+
+def _f64(x) -> np.ndarray:
+    return np.asarray(x, np.float64)
+
+
+class Engine:
+    """Shared scaffolding: payload stacking, trace counting, solve
+    timing. Subclasses define the payload schema and the solvers."""
+
+    kind = "?"
+    #: payload fields stacked along the batch axis, in order
+    fields: Tuple[str, ...] = ()
+    max_rescue_rungs = 2
+
+    def __init__(self, mech, recorder=None):
+        self.mech = mech
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
+        self._jit_cache: Dict[Tuple, Any] = {}
+        self._rescue_cache: Dict[Tuple, Any] = {}
+        self._cache_lock = threading.Lock()
+
+    # -- payload ---------------------------------------------------------
+    def normalize(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Validate/coerce one request's payload at the SUBMIT call
+        site, so a malformed request raises to its caller instead of
+        poisoning a batch."""
+        raise NotImplementedError
+
+    def group_key(self, payload: Dict[str, Any]) -> Tuple:
+        """Static solver knobs that must not be mixed in one compiled
+        program (traced together they would retrace per value)."""
+        return ()
+
+    def dummy_payload(self) -> Dict[str, Any]:
+        """A representative payload for ladder warmup."""
+        raise NotImplementedError
+
+    # -- batched solve ---------------------------------------------------
+    def _count_trace(self):
+        # runs while TRACING only: one increment per compiled program
+        self._rec.inc("serve.compiles")
+        self._rec.inc(f"serve.compiles.{self.kind}")
+
+    def _batch_fn(self, key: Tuple):
+        # locked check-then-act: the worker's first live batch and a
+        # caller's solve_direct on the same cold key must share ONE
+        # jit wrapper, or each traces its own program and the
+        # zero-recompiles-after-warmup counter invariant breaks
+        with self._cache_lock:
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                fn = self._jit_cache[key] = jax.jit(
+                    self._make_batch_fn(key))
+            return fn
+
+    def _make_batch_fn(self, key: Tuple):
+        raise NotImplementedError
+
+    def stack(self, payloads: List[Dict[str, Any]],
+              bucket: int) -> List[jnp.ndarray]:
+        """Stack payloads into bucket-shaped arrays (edge-padded)."""
+        idx = pad_indices(len(payloads), bucket)
+        cols = []
+        for f in self.fields:
+            col = np.stack([_f64(p[f]) for p in payloads])
+            cols.append(jnp.asarray(col[idx]))
+        return cols
+
+    def solve(self, payloads: List[Dict[str, Any]], bucket: int,
+              key: Tuple) -> Tuple[Dict[str, np.ndarray], float]:
+        """Solve one padded micro-batch; returns (result arrays at
+        bucket shape, device-fenced solve seconds)."""
+        args = self.stack(payloads, bucket)
+        t0 = time.perf_counter()
+        out = self._batch_fn(key)(*args)
+        out = jax.block_until_ready(out)
+        solve_s = time.perf_counter() - t0
+        return {k: np.asarray(v) for k, v in out.items()}, solve_s
+
+    def value_at(self, out: Dict[str, np.ndarray],
+                 i: int) -> Dict[str, Any]:
+        """Demultiplex element ``i``'s result fields."""
+        raise NotImplementedError
+
+    # -- rescue (off the hot path) --------------------------------------
+    def rescue_one(self, payload: Dict[str, Any], key: Tuple,
+                   level: int, elem_id: int
+                   ) -> Tuple[Dict[str, np.ndarray], int]:
+        """Re-solve ONE request under rung ``level`` escalation;
+        returns (bucket-1 result arrays, status). ``elem_id`` is the
+        request's lane in the failed batch, threaded so injected
+        faults track their element and ``heal_at`` sees the rung."""
+        raise NotImplementedError
+
+
+class IgnitionEngine(Engine):
+    """Ignition delay via the vmapped batch reactor
+    (:func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`).
+
+    Payload: ``T0`` [K], ``P0`` [dyne/cm^2], ``Y0`` [KK mass
+    fractions], ``t_end`` [s]. Value: ``ignition_delay_ms`` (nan when
+    not detected), ``ignition_time_s``."""
+
+    kind = "ignition"
+    fields = ("T0", "P0", "Y0", "t_end")
+    max_rescue_rungs = len(DEFAULT_LADDER)
+
+    def __init__(self, mech, recorder=None, *, problem="CONP",
+                 energy="ENRG", rtol=1e-6, atol=1e-12,
+                 max_steps_per_segment=20_000,
+                 ignition_mode=reactor_ops.IGN_T_INFLECTION,
+                 ignition_kwargs=None):
+        super().__init__(mech, recorder)
+        self.problem, self.energy = problem, energy
+        self.rtol, self.atol = rtol, atol
+        self.max_steps = max_steps_per_segment
+        self.ignition_mode = ignition_mode
+        self.ignition_kwargs = ignition_kwargs
+
+    def normalize(self, payload):
+        Y0 = _f64(payload["Y0"])
+        if Y0.shape != (self.mech.n_species,):
+            raise ValueError(
+                f"Y0 must have shape ({self.mech.n_species},), got "
+                f"{Y0.shape}")
+        return {"T0": float(payload["T0"]), "P0": float(payload["P0"]),
+                "Y0": Y0, "t_end": float(payload["t_end"])}
+
+    def dummy_payload(self):
+        KK = self.mech.n_species
+        return {"T0": 1200.0, "P0": 1.01325e6,
+                "Y0": np.full(KK, 1.0 / KK), "t_end": 1e-5}
+
+    def _make_batch_fn(self, key):
+        def fn(T0s, P0s, Y0s, t_ends):
+            self._count_trace()
+            times, ok, status = reactor_ops.ignition_delay_sweep(
+                self.mech, self.problem, self.energy, T0s, P0s, Y0s,
+                t_ends, rtol=self.rtol, atol=self.atol,
+                ignition_mode=self.ignition_mode,
+                ignition_kwargs=self.ignition_kwargs,
+                max_steps_per_segment=self.max_steps)
+            return {"times": times, "ok": ok, "status": status}
+
+        return fn
+
+    def value_at(self, out, i):
+        t = float(out["times"][i])
+        return {"ignition_time_s": t, "ignition_delay_ms": t * 1e3}
+
+    def _rescue_fn(self, level: int, h0: float):
+        # h0 is a STATIC solver knob (odeint branches on it in
+        # Python), so it joins the memo key — rounded to one
+        # significant figure by the caller to bound program count
+        cache_key = (level, h0)
+        fn = self._rescue_cache.get(cache_key)
+        if fn is None:
+            step = DEFAULT_LADDER[level - 1]
+
+            def traced(T0, P0, Y0, t_end, elem):
+                elem_ids = (elem[None] if faultinject.enabled()
+                            else None)
+                times, ok, status = reactor_ops.ignition_delay_sweep(
+                    self.mech, self.problem, self.energy, T0[None],
+                    P0[None], Y0[None], t_end[None],
+                    rtol=self.rtol * step.rtol_factor, atol=self.atol,
+                    ignition_mode=self.ignition_mode,
+                    ignition_kwargs=self.ignition_kwargs,
+                    max_steps_per_segment=int(
+                        self.max_steps * step.max_steps_factor),
+                    h0=h0, f64_jac=step.f64_jac,
+                    pivoted_lu=step.pivoted_lu, elem_ids=elem_ids,
+                    fault_level=level)
+                return {"times": times, "ok": ok, "status": status}
+
+            fn = self._rescue_cache[cache_key] = jax.jit(traced)
+        return fn
+
+    def rescue_one(self, payload, key, level, elem_id):
+        step = DEFAULT_LADDER[level - 1]
+        h0 = step.h0_rel * payload["t_end"] if step.h0_rel else 0.0
+        if h0:
+            h0 = float(f"{h0:.0e}")    # 1 sig fig bounds the memo key
+        out = self._rescue_fn(level, h0)(
+            jnp.asarray(payload["T0"]), jnp.asarray(payload["P0"]),
+            jnp.asarray(payload["Y0"]), jnp.asarray(payload["t_end"]),
+            jnp.asarray(elem_id))
+        out = {k: np.asarray(v) for k, v in
+               jax.block_until_ready(out).items()}
+        return out, int(out["status"][0])
+
+
+class EquilibriumEngine(Engine):
+    """Constrained equilibrium
+    (:func:`pychemkin_tpu.ops.equilibrium.equilibrate`).
+
+    Payload: ``T`` [K], ``P`` [dyne/cm^2], ``Y`` [KK]; the constraint
+    ``option`` (reference EQOption table) is a STATIC group key — each
+    option is its own compiled program. Value: equilibrium ``T``,
+    ``P``, ``X``, ``Y``, ``h``."""
+
+    kind = "equilibrium"
+    fields = ("T", "P", "Y")
+
+    def __init__(self, mech, recorder=None, *, n_iter=80):
+        super().__init__(mech, recorder)
+        self.n_iter = n_iter
+
+    def normalize(self, payload):
+        Y = _f64(payload["Y"])
+        if Y.shape != (self.mech.n_species,):
+            raise ValueError(
+                f"Y must have shape ({self.mech.n_species},), got "
+                f"{Y.shape}")
+        option = int(payload.get("option", 1))
+        if option not in eq_ops.EQ_OPTIONS:
+            raise ValueError(f"unknown equilibrium option {option}")
+        return {"T": float(payload["T"]), "P": float(payload["P"]),
+                "Y": Y, "option": option}
+
+    def group_key(self, payload):
+        return (payload["option"],)
+
+    def dummy_payload(self):
+        KK = self.mech.n_species
+        return {"T": 1500.0, "P": 1.01325e6,
+                "Y": np.full(KK, 1.0 / KK), "option": 1}
+
+    def _result_dict(self, res):
+        return {"T": res.T, "P": res.P, "X": res.X, "Y": res.Y,
+                "h": res.h, "converged": res.converged,
+                "status": res.status}
+
+    def _make_batch_fn(self, key):
+        option, = key
+
+        def fn(Ts, Ps, Ys):
+            self._count_trace()
+            if faultinject.enabled():
+                elems = jnp.arange(Ts.shape[0])
+                res = jax.vmap(
+                    lambda T, P, Y, e: eq_ops.equilibrate(
+                        self.mech, T, P, Y, option=option,
+                        n_iter=self.n_iter, fault_elem=e))(
+                            Ts, Ps, Ys, elems)
+            else:
+                res = jax.vmap(
+                    lambda T, P, Y: eq_ops.equilibrate(
+                        self.mech, T, P, Y, option=option,
+                        n_iter=self.n_iter))(Ts, Ps, Ys)
+            return self._result_dict(res)
+
+        return fn
+
+    def value_at(self, out, i):
+        # copy, don't view: a retained ServeResult must pin one lane,
+        # not the whole bucket-shaped batch array
+        return {"T": float(out["T"][i]), "P": float(out["P"][i]),
+                "X": np.array(out["X"][i]), "Y": np.array(out["Y"][i]),
+                "h": float(out["h"][i]),
+                "converged": bool(out["converged"][i])}
+
+    def rescue_one(self, payload, key, level, elem_id):
+        option, = key
+        cache_key = (option, level)
+        fn = self._rescue_cache.get(cache_key)
+        if fn is None:
+            # escalation: the iteration budget, the knob that fixes a
+            # TOL_NOT_MET of the fixed-iteration Newton
+            n_iter = self.n_iter * 2 ** level
+
+            def traced(T, P, Y, elem):
+                fe = elem if faultinject.enabled() else None
+                res = eq_ops.equilibrate(
+                    self.mech, T, P, Y, option=option, n_iter=n_iter,
+                    fault_elem=fe, fault_level=level)
+                return {k: v[None] for k, v in
+                        self._result_dict(res).items()}
+
+            fn = self._rescue_cache[cache_key] = jax.jit(traced)
+        out = fn(jnp.asarray(payload["T"]), jnp.asarray(payload["P"]),
+                 jnp.asarray(payload["Y"]), jnp.asarray(elem_id))
+        out = {k: np.asarray(v) for k, v in
+               jax.block_until_ready(out).items()}
+        return out, int(out["status"][0])
+
+
+class PSREngine(Engine):
+    """Perfectly-stirred-reactor steady state
+    (:func:`pychemkin_tpu.ops.psr.solve_psr`, residence-time mode).
+
+    Payload: ``tau`` [s], ``P`` [dyne/cm^2], ``Y_in`` [KK], ``h_in``
+    [erg/g] (or ``T_in`` [K], converted at submit), optional
+    ``T_guess``/``Y_guess``. Value: steady ``T``, ``Y``,
+    ``residual``."""
+
+    kind = "psr"
+    fields = ("tau", "P", "Y_in", "h_in", "T_guess", "Y_guess")
+
+    def __init__(self, mech, recorder=None, *, energy="ENRG",
+                 n_newton=50, n_pseudo=100, **solver_kwargs):
+        super().__init__(mech, recorder)
+        self.energy = energy
+        self.n_newton = n_newton
+        self.n_pseudo = n_pseudo
+        self.solver_kwargs = solver_kwargs
+
+    def normalize(self, payload):
+        Y_in = _f64(payload["Y_in"])
+        if Y_in.shape != (self.mech.n_species,):
+            raise ValueError(
+                f"Y_in must have shape ({self.mech.n_species},), got "
+                f"{Y_in.shape}")
+        if "h_in" in payload:
+            h_in = float(payload["h_in"])
+        elif "T_in" in payload:
+            h_in = float(thermo.mixture_enthalpy_mass(
+                self.mech, float(payload["T_in"]), jnp.asarray(Y_in)))
+        else:
+            raise ValueError("PSR payload needs h_in or T_in")
+        Y_guess = _f64(payload.get("Y_guess", Y_in))
+        if Y_guess.shape != (self.mech.n_species,):
+            raise ValueError(
+                f"Y_guess must have shape ({self.mech.n_species},), "
+                f"got {Y_guess.shape}")
+        return {"tau": float(payload["tau"]), "P": float(payload["P"]),
+                "Y_in": Y_in, "h_in": h_in,
+                "T_guess": float(payload.get("T_guess", 1800.0)),
+                "Y_guess": Y_guess}
+
+    def dummy_payload(self):
+        KK = self.mech.n_species
+        Y = np.full(KK, 1.0 / KK)
+        return {"tau": 1e-3, "P": 1.01325e6, "Y_in": Y, "T_in": 1000.0}
+
+    def _solve_one(self, tau, P, Y_in, h_in, T_guess, Y_guess, *,
+                   n_newton, n_pseudo, fault_elem=None, fault_level=0):
+        return psr_ops.solve_psr(
+            self.mech, psr_ops.MODE_TAU, self.energy, P=P, Y_in=Y_in,
+            h_in=h_in, T_guess=T_guess, Y_guess=Y_guess, tau=tau,
+            n_newton=n_newton, n_pseudo=n_pseudo,
+            fault_elem=fault_elem, fault_level=fault_level,
+            **self.solver_kwargs)
+
+    def _result_dict(self, sol):
+        return {"T": sol.T, "Y": sol.Y, "residual": sol.residual,
+                "converged": sol.converged, "status": sol.status}
+
+    def _make_batch_fn(self, key):
+        def fn(taus, Ps, Y_ins, h_ins, T_gs, Y_gs):
+            self._count_trace()
+            if faultinject.enabled():
+                elems = jnp.arange(taus.shape[0])
+                sol = jax.vmap(
+                    lambda t, p, yi, hi, tg, yg, e: self._solve_one(
+                        t, p, yi, hi, tg, yg, n_newton=self.n_newton,
+                        n_pseudo=self.n_pseudo, fault_elem=e))(
+                            taus, Ps, Y_ins, h_ins, T_gs, Y_gs, elems)
+            else:
+                sol = jax.vmap(
+                    lambda t, p, yi, hi, tg, yg: self._solve_one(
+                        t, p, yi, hi, tg, yg, n_newton=self.n_newton,
+                        n_pseudo=self.n_pseudo))(
+                            taus, Ps, Y_ins, h_ins, T_gs, Y_gs)
+            return self._result_dict(sol)
+
+        return fn
+
+    def value_at(self, out, i):
+        # copy, don't view (see EquilibriumEngine.value_at)
+        return {"T": float(out["T"][i]), "Y": np.array(out["Y"][i]),
+                "residual": float(out["residual"][i]),
+                "converged": bool(out["converged"][i])}
+
+    def rescue_one(self, payload, key, level, elem_id):
+        fn = self._rescue_cache.get(level)
+        if fn is None:
+            # escalation: more damped-Newton room and a longer
+            # pseudo-transient rescue phase per rung
+            n_newton = self.n_newton * (level + 1)
+            n_pseudo = self.n_pseudo * 2 ** level
+
+            def traced(tau, P, Y_in, h_in, T_g, Y_g, elem):
+                fe = elem if faultinject.enabled() else None
+                sol = self._solve_one(
+                    tau, P, Y_in, h_in, T_g, Y_g, n_newton=n_newton,
+                    n_pseudo=n_pseudo, fault_elem=fe,
+                    fault_level=level)
+                return {k: v[None] for k, v in
+                        self._result_dict(sol).items()}
+
+            fn = self._rescue_cache[level] = jax.jit(traced)
+        out = fn(*(jnp.asarray(payload[f]) for f in self.fields),
+                 jnp.asarray(elem_id))
+        out = {k: np.asarray(v) for k, v in
+               jax.block_until_ready(out).items()}
+        return out, int(out["status"][0])
+
+
+#: engine registry: request kind -> constructor
+ENGINE_TYPES = {
+    IgnitionEngine.kind: IgnitionEngine,
+    EquilibriumEngine.kind: EquilibriumEngine,
+    PSREngine.kind: PSREngine,
+}

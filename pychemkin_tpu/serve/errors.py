@@ -1,0 +1,34 @@
+"""Typed failure surface of the serving layer.
+
+Admission control and lifecycle are the only things that raise at the
+``submit`` call site; a request that was ADMITTED never raises for
+solver reasons — its future resolves with a
+:class:`~pychemkin_tpu.serve.futures.ServeResult` whose ``status``
+carries the machine-readable outcome (the resilience-layer contract:
+partial results + per-element status, never exceptions on the hot
+path).
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class of serving-layer errors."""
+
+
+class ServerOverloaded(ServeError):
+    """The bounded request queue is full: admission refused.
+
+    Backpressure is a REJECTION, never a block — a caller that wants
+    queueing semantics retries with its own backoff; the server's
+    worker can always drain the queue it has (no producer can wedge
+    it). ``queue_depth`` is the configured bound that was hit."""
+
+    def __init__(self, message: str, *, queue_depth: int):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+
+
+class ServerClosed(ServeError):
+    """Submission after shutdown began (``close()`` was called, a
+    drain signal arrived, or the server never started)."""

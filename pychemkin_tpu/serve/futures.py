@@ -1,0 +1,73 @@
+"""Request/result plumbing: what a caller holds while the batch forms.
+
+``submit_*`` returns a :class:`ServeFuture` immediately; the worker
+resolves it with a :class:`ServeResult` after the micro-batch solves
+(or after the rescue ladder finishes, for elements that failed the hot
+path). A future only carries an EXCEPTION for infrastructure failures
+(the batch solve itself raised, or the server was torn down without
+drain); solver non-convergence is data — ``status`` — not an
+exception, mirroring the per-element status contract of
+:mod:`pychemkin_tpu.resilience`.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import itertools
+from typing import Any, Dict, NamedTuple, Tuple
+
+from ..resilience.status import name_of
+
+
+class ServeResult(NamedTuple):
+    """One request's outcome plus its serving metadata."""
+    value: Dict[str, Any]    # per-kind result fields (see engines)
+    status: int              # SolveStatus code after any rescue
+    status_name: str
+    ok: bool                 # status == OK
+    rescued: bool            # failed hot path, fixed by the ladder
+    rescue_rungs: int        # ladder rungs attempted (0 = hot path)
+    kind: str
+    bucket: int              # padded shape the batch solved at
+    occupancy: int           # real requests in that batch
+    queue_wait_ms: float     # submit -> batch formation
+    solve_ms: float          # the batch's device solve wall time
+
+
+def make_result(value: Dict[str, Any], status: int, *, kind: str,
+                bucket: int, occupancy: int, queue_wait_ms: float,
+                solve_ms: float, rescued: bool = False,
+                rescue_rungs: int = 0) -> ServeResult:
+    status = int(status)
+    return ServeResult(
+        value=value, status=status, status_name=name_of(status),
+        ok=status == 0, rescued=rescued, rescue_rungs=rescue_rungs,
+        kind=kind, bucket=bucket, occupancy=occupancy,
+        queue_wait_ms=round(queue_wait_ms, 3),
+        solve_ms=round(solve_ms, 3))
+
+
+class ServeFuture(concurrent.futures.Future):
+    """A :class:`concurrent.futures.Future` resolving to a
+    :class:`ServeResult`. ``result(timeout=...)`` blocks the caller,
+    never the server."""
+
+
+_req_counter = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One admitted request, queued until a micro-batch adopts it."""
+    kind: str
+    key: Tuple                 # static group key (e.g. equilibrium option)
+    payload: Dict[str, Any]    # normalized numeric payload
+    future: ServeFuture
+    t_submit: float            # time.perf_counter() at admission
+    #: correlates a request across serve.rescue/serve.demux_error events
+    id: int = dataclasses.field(
+        default_factory=lambda: next(_req_counter))
+    #: set by the worker BEFORE the rescue hand-off: from then on the
+    #: rescue thread owns the future and crash cleanup must skip it
+    handed_off: bool = False

@@ -1,0 +1,159 @@
+"""Open-loop Poisson load generation against a :class:`ChemServer`.
+
+Open-loop means arrivals follow their schedule REGARDLESS of
+completions — the honest way to measure a serving system (a closed
+loop self-throttles and hides queueing collapse; see the coordinated-
+omission literature). Arrival gaps are exponential draws from a seeded
+generator, so a given (seed, rate, n) schedule is reproducible.
+
+Shared by ``tools/loadgen.py`` (CLI emitting a JSON latency artifact)
+and the ``serve_latency`` bench rung in
+:mod:`pychemkin_tpu.benchmarks`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..resilience.status import name_of
+from .errors import ServerOverloaded
+from .server import ChemServer
+
+#: a payload sampler: (index, rng) -> (kind, payload kwargs)
+Sampler = Callable[[int, np.random.Generator], Tuple[str, Dict]]
+
+
+def stoich_h2_air_Y(mech) -> np.ndarray:
+    """Stoichiometric H2/air mass fractions for the h2o2/grisyn
+    fixture family (their live chemistry is the H2/O2 subsystem).
+    Delegates to the bench's composition helper so the recipe lives
+    in one place."""
+    from ..benchmarks import _stoich_Y0
+
+    return _stoich_Y0(mech, "h2air")
+
+
+def default_samplers(mech, kinds: Sequence[str], *,
+                     T_range=(1250.0, 1400.0), P=1.01325e6,
+                     t_end=4e-4, tau_range=(3e-4, 3e-3),
+                     eq_T_range=(900.0, 2000.0),
+                     option=1) -> List[Sampler]:
+    """One sampler per requested kind over physically sane ranges."""
+    Y0 = stoich_h2_air_Y(mech)
+    out: List[Sampler] = []
+    for kind in kinds:
+        if kind == "ignition":
+            def s(i, rng, _k=kind):
+                return _k, dict(
+                    T0=float(rng.uniform(*T_range)), P0=P, Y0=Y0,
+                    t_end=t_end)
+        elif kind == "equilibrium":
+            def s(i, rng, _k=kind):
+                return _k, dict(
+                    T=float(rng.uniform(*eq_T_range)), P=P, Y=Y0,
+                    option=option)
+        elif kind == "psr":
+            def s(i, rng, _k=kind):
+                return _k, dict(
+                    tau=float(rng.uniform(*tau_range)), P=P, Y_in=Y0,
+                    T_in=300.0, T_guess=1800.0)
+        else:
+            raise ValueError(f"no default sampler for kind {kind!r}")
+        out.append(s)
+    return out
+
+
+def run_load(server: ChemServer, samplers: Sequence[Sampler], *,
+             rate_hz: float, n_requests: int,
+             rng: np.random.Generator,
+             result_timeout_s: float = 300.0) -> Dict:
+    """Drive ``server`` with an open-loop Poisson stream; returns the
+    JSON-ready latency summary.
+
+    Latency is submit -> future resolution (queue wait + batch solve +
+    any rescue), captured via done-callbacks so slow consumers of the
+    results cannot inflate it. Overload rejections are counted, not
+    retried (open loop: the lost arrival is the datapoint)."""
+    if not samplers:
+        raise ValueError("need at least one payload sampler")
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz,
+                                         size=n_requests))
+    done_at: Dict[int, float] = {}
+    records = []
+    n_rejected = 0
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        target = t0 + arrivals[i]
+        while True:
+            now = time.perf_counter()
+            if now >= target:
+                break
+            time.sleep(min(target - now, 0.01))
+        kind, payload = samplers[int(rng.integers(len(samplers)))](
+            i, rng)
+        t_sub = time.perf_counter()
+        try:
+            fut = server.submit(kind, **payload)
+        except ServerOverloaded:
+            n_rejected += 1
+            continue
+        fut.add_done_callback(
+            lambda f, j=i: done_at.__setitem__(
+                j, time.perf_counter()))
+        records.append((i, kind, fut, t_sub))
+    offered_s = time.perf_counter() - t0
+
+    lat_ms: List[float] = []
+    occupancies: List[int] = []
+    status_counts: Dict[str, int] = {}
+    n_rescued = 0
+    for i, kind, fut, t_sub in records:
+        res = fut.result(timeout=result_timeout_s)
+        # result() can return before the done-callback has run (the
+        # waiter wakes under the condition lock; callbacks fire after
+        # it is released) — wait the beat out instead of KeyError-ing
+        while i not in done_at:
+            time.sleep(1e-4)
+        lat_ms.append((done_at[i] - t_sub) * 1e3)
+        occupancies.append(res.occupancy)
+        status_counts[res.status_name] = (
+            status_counts.get(res.status_name, 0) + 1)
+        n_rescued += int(res.rescued)
+    wall_s = time.perf_counter() - t0
+
+    # zero served requests (everything rejected) must still yield a
+    # STRICT-JSON artifact: null stats, never a bare NaN literal
+    lat = np.asarray(lat_ms)
+    occ = np.asarray(occupancies, float)
+
+    def _pct(q):
+        return (round(float(np.percentile(lat, q)), 3)
+                if lat_ms else None)
+
+    return {
+        "n_requests": n_requests,
+        "n_served": len(records),
+        "n_rejected": n_rejected,
+        "n_rescued": n_rescued,
+        "rate_hz": rate_hz,
+        "offered_s": round(offered_s, 3),
+        "wall_s": round(wall_s, 3),
+        "status_counts": status_counts,
+        "p50_ms": _pct(50),
+        "p95_ms": _pct(95),
+        "p99_ms": _pct(99),
+        "mean_ms": round(float(lat.mean()), 3) if lat_ms else None,
+        "max_ms": round(float(lat.max()), 3) if lat_ms else None,
+        "mean_occupancy": (round(float(occ.mean()), 3)
+                           if occupancies else None),
+        "max_occupancy": int(occ.max()) if occupancies else 0,
+    }
+
+
+def ok_fraction(summary: Dict) -> float:
+    """Fraction of served requests that resolved status OK."""
+    served = max(summary["n_served"], 1)
+    return summary["status_counts"].get(name_of(0), 0) / served

@@ -1,0 +1,518 @@
+"""In-process online serving: one user request in, one future out.
+
+``ChemServer`` is the missing path from "one user asks for one
+ignition delay / PSR state / equilibrium" to the vmapped solvers,
+without paying a per-request compile or hand-assembling batches:
+
+- **Admission**: ``submit_*`` validates the payload, stamps the
+  request, and enqueues it on a BOUNDED queue. A full queue raises
+  :class:`~.errors.ServerOverloaded` immediately (backpressure is a
+  typed rejection, never a block — no producer can deadlock the
+  worker). After shutdown begins, :class:`~.errors.ServerClosed`.
+- **Micro-batching**: one worker thread coalesces queued requests
+  under the ``max_batch_size`` / ``max_delay_ms`` policy
+  (:mod:`.batcher`), splits them by (kind, static solver key), pads
+  each group to the bucket ladder (:mod:`.buckets`), and dispatches
+  ONE jitted program per bucket shape. After :meth:`warmup`, steady
+  traffic runs with zero recompiles (asserted by the
+  ``serve.compiles`` counters).
+- **Demux**: per-element results and ``SolveStatus`` codes come back
+  to per-request futures as :class:`~.futures.ServeResult`. Lane
+  values are independent of batch companions, so every returned value
+  bit-matches :meth:`solve_direct` at the same bucket shape.
+- **Rescue hand-off**: elements that fail the hot solve resolve LATER,
+  from a separate rescue thread that walks the per-kind escalation
+  ladder (:mod:`.engines`) — one stiff condition never stalls the
+  batch pipeline; healthy requests in the same batch resolve
+  immediately.
+- **Graceful drain**: ``close()`` — or SIGTERM/SIGINT after
+  :meth:`install_signal_handlers` — stops admissions, lets the
+  in-flight batch finish, then drains everything already admitted
+  (the cooperative-stop idiom of
+  :class:`pychemkin_tpu.resilience.driver.GracefulStop`: signal
+  handlers only set a flag; batch boundaries poll it).
+
+Telemetry on the attached recorder: ``serve.queue_depth`` gauge;
+``serve.queue_wait_ms`` / ``serve.solve_ms`` / ``serve.batch_occupancy``
+histograms (p50/p95/p99 in ``snapshot()``); ``serve.requests`` /
+``serve.rejected`` / ``serve.batches`` / ``serve.rescued`` /
+``serve.abandoned`` / ``serve.status.<NAME>`` / ``serve.compiles[.*]``
+counters; one ``serve.batch`` event per dispatched micro-batch and a
+``serve.drain`` event at shutdown.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _cf
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import telemetry
+from ..resilience.driver import GracefulStop
+from ..resilience.status import SolveStatus, name_of
+from . import batcher, buckets
+from .engines import ENGINE_TYPES, Engine
+from .errors import ServerClosed, ServerOverloaded
+from .futures import Request, ServeFuture, ServeResult, make_result
+
+_RESCUE_STOP = object()
+
+
+class ChemServer:
+    """Dynamic micro-batching server over one mechanism's solvers.
+
+    ``engine_config`` maps a kind name (``ignition`` / ``psr`` /
+    ``equilibrium``) to constructor kwargs for its engine — e.g.
+    ``{"ignition": {"rtol": 1e-5, "max_steps_per_segment": 4000}}``.
+    Engines are built lazily on first use of a kind unless listed in
+    ``kinds``. ``rescue=False`` disables the ladder: failed elements
+    resolve immediately with their hot-path status."""
+
+    def __init__(self, mech, *,
+                 bucket_sizes: Sequence[int] = buckets.DEFAULT_BUCKETS,
+                 max_batch_size: Optional[int] = None,
+                 max_delay_ms: float = 2.0,
+                 queue_depth: int = 256,
+                 rescue: bool = True,
+                 max_rescue_rungs: Optional[int] = None,
+                 recorder=None,
+                 kinds: Sequence[str] = (),
+                 engine_config: Optional[Dict[str, Dict]] = None):
+        self.mech = mech
+        self.buckets = buckets.normalize_ladder(bucket_sizes)
+        top = self.buckets[-1]
+        self.policy = batcher.BatchPolicy(
+            max_batch_size=min(int(max_batch_size or top), top),
+            max_delay_ms=float(max_delay_ms))
+        self.queue_depth = int(queue_depth)
+        self.rescue_enabled = bool(rescue)
+        self.max_rescue_rungs = max_rescue_rungs
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
+        self._engine_config = dict(engine_config or {})
+        self._engines: Dict[str, Engine] = {}
+        self._queue: "_queue.Queue[Request]" = _queue.Queue(
+            maxsize=self.queue_depth)
+        self._rescue_q: "_queue.Queue[Any]" = _queue.Queue()
+        self._stop = GracefulStop()
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._rescuer: Optional[threading.Thread] = None
+        self._started = False
+        self._closed = False
+        self._worker_done = False
+        self._worker_exc: Optional[BaseException] = None
+        self._rescuer_done = False
+        for kind in kinds:
+            self.engine(kind)
+
+    # -- engines ---------------------------------------------------------
+    def engine(self, kind: str) -> Engine:
+        with self._lock:
+            eng = self._engines.get(kind)
+            if eng is None:
+                if kind not in ENGINE_TYPES:
+                    raise ValueError(
+                        f"unknown request kind {kind!r}; expected one "
+                        f"of {sorted(ENGINE_TYPES)}")
+                eng = ENGINE_TYPES[kind](
+                    self.mech, self._rec,
+                    **self._engine_config.get(kind, {}))
+                self._engines[kind] = eng
+            return eng
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ChemServer":
+        # threads are created AND started before _started flips, all
+        # under the lock: a concurrent close() that observes
+        # _started=True may join the thread objects unconditionally
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("server already closed")
+            if self._started:
+                return self
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="chemserver-worker",
+                daemon=True)
+            self._rescuer = threading.Thread(
+                target=self._rescue_loop, name="chemserver-rescue",
+                daemon=True)
+            self._worker.start()
+            self._rescuer.start()
+            self._started = True
+        return self
+
+    def install_signal_handlers(self) -> GracefulStop:
+        """Hook SIGTERM/SIGINT to a graceful drain (handler only sets
+        the cooperative flag; the worker finishes the in-flight batch,
+        drains admitted requests, and exits). Returns the stop handle
+        so embedders can also ``request()`` programmatically."""
+        return self._stop.install()
+
+    def request_drain(self) -> None:
+        """Programmatic SIGTERM equivalent."""
+        self._stop.request()
+
+    @property
+    def draining(self) -> bool:
+        return self._stop.requested or self._closed
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None
+              ) -> bool:
+        """Stop admissions and shut down. ``drain=True`` completes
+        every admitted request first (in-flight batch always
+        completes); ``drain=False`` fails still-queued requests with
+        :class:`ServerClosed` after the in-flight batch. Returns True
+        once shutdown completed; False if ``timeout`` expired with a
+        thread still finishing — admissions stay refused, the drain
+        continues in the background, and the rescue thread keeps
+        accepting hand-offs until a later ``close()`` completes."""
+        if self._closed:
+            # idempotent: `close()` inside a `with server:` block is
+            # followed by __exit__'s close — one drain, one event
+            return True
+        self._stop.request()
+        if not drain:
+            # pull whatever has not been adopted by a batch yet; the
+            # worker keeps whatever it already holds
+            self._fail_queued(ServerClosed("server closed without drain"))
+        # under the lock for a consistent view: start() only flips
+        # _started after both threads are running
+        with self._lock:
+            started = self._started
+        if started:
+            # ONE deadline across both joins: `timeout` bounds the
+            # whole close(), not each thread separately
+            deadline = (None if timeout is None
+                        else time.perf_counter() + timeout)
+            self._worker.join(timeout)
+            if self._worker.is_alive():
+                self._rec.event("serve.close_timeout", timeout=timeout)
+                return False
+            # a submit that raced past the draining check after the
+            # worker's final queue sweep would otherwise hang forever
+            self._fail_queued(ServerClosed("server closed"))
+            # the worker is confirmed dead, so every rescue hand-off is
+            # already in the FIFO queue ahead of this sentinel
+            self._rescue_q.put(_RESCUE_STOP)
+            self._rescuer.join(
+                None if deadline is None
+                else max(0.0, deadline - time.perf_counter()))
+            if self._rescuer.is_alive():
+                self._rec.event("serve.close_timeout", timeout=timeout)
+                return False
+        else:
+            # never started: nothing will ever serve the queue
+            self._fail_queued(ServerClosed("server closed before start"))
+        self._stop.restore()
+        self._closed = True
+        self._rec.event("serve.drain", drained=drain,
+                        queue_depth=self._queue.qsize())
+        self._rec.gauge("serve.queue_depth", self._queue.qsize())
+        return True
+
+    def __enter__(self) -> "ChemServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- admission -------------------------------------------------------
+    def submit(self, kind: str, **payload) -> ServeFuture:
+        """Admit one request; returns its future. Raises
+        :class:`ServerOverloaded` (queue full) or
+        :class:`ServerClosed` (shutdown began) — the only two ways a
+        request fails at the call site."""
+        if self.draining or self._worker_done:
+            raise ServerClosed("server is draining; no new admissions")
+        eng = self.engine(kind)
+        norm = eng.normalize(payload)
+        req = Request(kind=kind, key=eng.group_key(norm), payload=norm,
+                      future=ServeFuture(), t_submit=time.perf_counter())
+        try:
+            self._queue.put_nowait(req)
+        except _queue.Full:
+            self._rec.inc("serve.rejected")
+            raise ServerOverloaded(
+                f"request queue full ({self.queue_depth}); retry with "
+                "backoff", queue_depth=self.queue_depth) from None
+        if self._worker_done:
+            # the worker exited (drain finished or crashed) between the
+            # admission check and our enqueue; it will never pop this
+            # request — fail it now instead of hanging the caller
+            self._fail_queued(self._worker_exc
+                              or ServerClosed("server drained"))
+        self._rec.inc("serve.requests")
+        self._rec.gauge("serve.queue_depth", self._queue.qsize())
+        return req.future
+
+    def submit_ignition(self, *, T0, P0, Y0, t_end) -> ServeFuture:
+        return self.submit("ignition", T0=T0, P0=P0, Y0=Y0, t_end=t_end)
+
+    def submit_equilibrium(self, *, T, P, Y, option=1) -> ServeFuture:
+        return self.submit("equilibrium", T=T, P=P, Y=Y, option=option)
+
+    def submit_psr(self, *, tau, P, Y_in, h_in=None, T_in=None,
+                   T_guess=None, Y_guess=None) -> ServeFuture:
+        payload = {"tau": tau, "P": P, "Y_in": Y_in}
+        if h_in is not None:
+            payload["h_in"] = h_in
+        if T_in is not None:
+            payload["T_in"] = T_in
+        if T_guess is not None:
+            payload["T_guess"] = T_guess
+        if Y_guess is not None:
+            payload["Y_guess"] = Y_guess
+        return self.submit("psr", **payload)
+
+    # -- direct reference path -------------------------------------------
+    def solve_direct(self, kind: str, *, bucket: int = 1,
+                     **payload) -> ServeResult:
+        """Solve ONE request synchronously through the same engine and
+        the same compiled program shape the batcher would use at
+        ``bucket`` — the bit-match reference for served results (lane
+        values are companion-independent, so a request served in any
+        batch at this bucket returns exactly these values). Does not
+        touch the queue or the worker."""
+        eng = self.engine(kind)
+        norm = eng.normalize(payload)
+        key = eng.group_key(norm)
+        out, solve_s = eng.solve([norm], bucket, key)
+        return make_result(
+            eng.value_at(out, 0), int(out["status"][0]), kind=kind,
+            bucket=bucket, occupancy=1, queue_wait_ms=0.0,
+            solve_ms=solve_s * 1e3)
+
+    # -- warmup ----------------------------------------------------------
+    def warmup(self, kinds: Optional[Sequence[str]] = None,
+               bucket_sizes: Optional[Sequence[int]] = None,
+               payloads: Optional[Dict[str, Dict]] = None
+               ) -> Dict[str, int]:
+        """Trace + compile (or load from the persistent XLA cache) the
+        bucket ladder for the given kinds, so live traffic never pays
+        a compile. Ladder rungs above what ``max_batch_size`` lets the
+        batcher dispatch are skipped unless passed explicitly via
+        ``bucket_sizes``. ``payloads`` optionally maps kind -> a
+        representative payload — REQUIRED for traffic whose static
+        group key differs from the engine default (e.g. a non-default
+        equilibrium ``option``: each option is its own program).
+        Returns {kind: programs compiled this call}."""
+        if bucket_sizes is not None:
+            ladder = [int(b) for b in bucket_sizes]
+        else:
+            # only buckets dispatch can reach: occupancy is capped at
+            # max_batch_size, so any bucket above its rung is a
+            # program the batcher can never request
+            reach = buckets.bucket_for(self.policy.max_batch_size,
+                                       self.buckets)
+            ladder = [b for b in self.buckets if b <= reach]
+        compiled = {}
+        for kind in (kinds if kinds is not None else
+                     sorted(self._engines) or sorted(ENGINE_TYPES)):
+            eng = self.engine(kind)
+            # .get, not [.]: counters is a defaultdict and an unlocked
+            # missing-key read would INSERT, racing a live snapshot()
+            before = self._rec.counters.get(
+                f"serve.compiles.{kind}", 0)
+            dummy = eng.normalize(
+                (payloads or {}).get(kind) or eng.dummy_payload())
+            key = eng.group_key(dummy)
+            for b in ladder:
+                eng.solve([dummy], b, key)
+            compiled[kind] = (self._rec.counters.get(
+                f"serve.compiles.{kind}", 0) - before)
+        return compiled
+
+    # -- future plumbing -------------------------------------------------
+    @staticmethod
+    def _fail_future(fut: ServeFuture, exc: BaseException) -> None:
+        try:
+            fut.set_exception(exc)
+        except _cf.InvalidStateError:
+            pass   # already resolved (e.g. by the rescue thread)
+
+    @staticmethod
+    def _resolve_future(fut: ServeFuture, result: ServeResult) -> None:
+        try:
+            fut.set_result(result)
+        except _cf.InvalidStateError:
+            pass   # already failed by a crash/close path
+
+    def _fail_queued(self, exc: BaseException) -> None:
+        """Fail every request still sitting in the admission queue."""
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except _queue.Empty:
+                return
+            self._fail_future(req.future, exc)
+
+    # -- worker ----------------------------------------------------------
+    def _worker_loop(self) -> None:
+        batch: Optional[List[Request]] = None
+        exit_exc: Optional[BaseException] = None
+        try:
+            while True:
+                batch = batcher.collect(self._queue, self.policy,
+                                        self._stop)
+                if batch is None:
+                    break
+                self._rec.gauge("serve.queue_depth",
+                                self._queue.qsize())
+                for kind, key, reqs in batcher.group(batch):
+                    self._process_group(kind, key, reqs)
+                batch = None
+        except BaseException as exc:   # noqa: BLE001 — worker died
+            exit_exc = exc
+            self._rec.event("serve.worker_crashed",
+                            error=f"{type(exc).__name__}: {exc}")
+            raise
+        finally:
+            # whatever the exit path, nothing serves this queue again:
+            # the in-flight batch's unresolved futures, everything still
+            # queued, and anything a racing submit slips in afterwards
+            # (it re-checks _worker_done after its put) must fail, not
+            # hang. Futures handed off to the rescue thread are ITS to
+            # resolve — failing them here would discard an in-progress
+            # rescue result behind the InvalidStateError guard.
+            closed = exit_exc if exit_exc is not None else ServerClosed(
+                "server drained")
+            self._worker_exc = exit_exc
+            self._worker_done = True
+            for req in (batch or []):
+                if not req.handed_off and not req.future.done():
+                    self._fail_future(req.future, closed)
+            self._fail_queued(closed)
+
+    def _process_group(self, kind: str, key: Tuple,
+                       reqs: List[Request]) -> None:
+        eng = self._engines[kind]
+        occupancy = len(reqs)
+        bucket = buckets.bucket_for(occupancy, self.buckets)
+        t_form = time.perf_counter()
+        try:
+            out, solve_s = eng.solve([r.payload for r in reqs],
+                                     bucket, key)
+        except Exception as exc:       # noqa: BLE001 — infra failure
+            # the solve itself raised (not a per-element failure):
+            # every future in the group carries the infrastructure
+            # error; the worker survives for the next batch
+            self._rec.inc("serve.batch_errors")
+            self._rec.event("serve.batch_error", req_kind=kind,
+                            occupancy=occupancy, bucket=bucket,
+                            error=f"{type(exc).__name__}: {exc}")
+            for r in reqs:
+                # guarded: a caller-cancelled future must not crash
+                # the worker out of the error handler
+                self._fail_future(r.future, exc)
+            return
+        solve_ms = solve_s * 1e3
+        self._rec.inc("serve.batches")
+        self._rec.observe("serve.batch_occupancy", occupancy)
+        self._rec.observe("serve.solve_ms", solve_ms)
+        n_handed_off = 0
+        for i, req in enumerate(reqs):
+            try:
+                wait_ms = (t_form - req.t_submit) * 1e3
+                self._rec.observe("serve.queue_wait_ms", wait_ms)
+                status = int(out["status"][i])
+                self._rec.inc(f"serve.status.{name_of(status)}")
+                meta = dict(kind=kind, bucket=bucket,
+                            occupancy=occupancy,
+                            queue_wait_ms=wait_ms, solve_ms=solve_ms)
+                if (status != int(SolveStatus.OK)
+                        and self.rescue_enabled):
+                    # off the hot path: the rescue thread owns this
+                    # future from here
+                    n_handed_off += 1
+                    req.handed_off = True
+                    self._rescue_q.put((req, key, eng.value_at(out, i),
+                                        status, i, meta))
+                    if self._rescuer_done:
+                        # rescuer died between hand-off and here; it
+                        # will never pop this item
+                        self._drain_rescue_q(
+                            ServerClosed("rescue thread exited"))
+                else:
+                    req.future.set_result(make_result(
+                        eng.value_at(out, i), status, **meta))
+            except Exception as exc:   # noqa: BLE001 — demux failure
+                # a bad lane (unexpected engine output shape, recorder
+                # fault) fails ITS future; companions still resolve and
+                # the worker survives for the next batch
+                self._rec.inc("serve.batch_errors")
+                self._rec.event("serve.demux_error", req_kind=kind,
+                                req_id=req.id, lane=i, bucket=bucket,
+                                error=f"{type(exc).__name__}: {exc}")
+                self._fail_future(req.future, exc)
+        self._rec.event("serve.batch", req_kind=kind, key=list(key),
+                        occupancy=occupancy, bucket=bucket,
+                        solve_ms=round(solve_ms, 3),
+                        n_rescue_handoff=n_handed_off)
+
+    # -- rescue thread ---------------------------------------------------
+    def _drain_rescue_q(self, exc: BaseException) -> None:
+        """Fail every hand-off still sitting in the rescue queue."""
+        while True:
+            try:
+                item = self._rescue_q.get_nowait()
+            except _queue.Empty:
+                return
+            if item is not _RESCUE_STOP:
+                self._fail_future(item[0].future, exc)
+
+    def _rescue_loop(self) -> None:
+        try:
+            while True:
+                item = self._rescue_q.get()
+                if item is _RESCUE_STOP:
+                    break
+                req = item[0]
+                try:
+                    self._rescue_one(item)
+                except Exception as exc:  # noqa: BLE001 — per-item
+                    # infra failure (rescue solve, recorder, sink I/O):
+                    # fail THIS future; the rescue thread survives for
+                    # the next hand-off
+                    self._fail_future(req.future, exc)
+        finally:
+            # sentinel or crash: nothing consumes hand-offs anymore —
+            # fail what remains (and anything the worker slips in
+            # afterwards; _process_group re-checks _rescuer_done)
+            self._rescuer_done = True
+            self._drain_rescue_q(ServerClosed("rescue thread exited"))
+
+    def _rescue_one(self, item) -> None:
+        req, key, base_value, base_status, elem_id, meta = item
+        eng = self._engines[req.kind]
+        rungs = eng.max_rescue_rungs
+        if self.max_rescue_rungs is not None:
+            rungs = min(rungs, self.max_rescue_rungs)
+        value, status, level = base_value, base_status, 0
+        for level in range(1, rungs + 1):
+            out, status = eng.rescue_one(req.payload, key,
+                                         level, elem_id)
+            # keep value and status PAIRED: when every rung fails, the
+            # result carries the last rung's value with the last rung's
+            # status, never the hot path's diverged value under a
+            # milder rung status
+            value = eng.value_at(out, 0)
+            if status == int(SolveStatus.OK):
+                break
+        rescued = status == int(SolveStatus.OK)
+        self._rec.inc("serve.rescued" if rescued
+                      else "serve.abandoned")
+        self._rec.event("serve.rescue", req_kind=req.kind,
+                        req_id=req.id, rungs=level, rescued=rescued,
+                        status=name_of(status))
+        self._resolve_future(req.future, make_result(
+            value, status, rescued=rescued, rescue_rungs=level,
+            **meta))
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The attached recorder's aggregate snapshot (queue-depth
+        gauge, latency/occupancy histograms, per-status counters)."""
+        return self._rec.snapshot()

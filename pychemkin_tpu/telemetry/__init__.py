@@ -36,6 +36,25 @@ Kinds emitted by the framework:
                      not be written (durability degraded, job
                      continues) / an attempted re-exec's ``execvpe``
                      failed (the original chunk error propagates).
+- ``serve.batch``    — one dispatched micro-batch of the online
+                     serving layer (req_kind, key, occupancy, bucket,
+                     solve_ms, n_rescue_handoff); see
+                     ``pychemkin_tpu/serve/``.
+- ``serve.rescue``   — one failed request finished the off-hot-path
+                     rescue ladder (req_kind, rungs, rescued, status).
+- ``serve.drain``    — the server shut down (drained, queue_depth).
+- ``serve.batch_error`` / ``serve.worker_crashed`` — a batch solve
+                     raised (futures carry the error, worker
+                     survives) / the worker loop itself died (queued
+                     futures failed, thread exits).
+
+Histograms (``MetricsRecorder.observe``; p50/p95/p99 under
+``histograms`` in ``snapshot()``): ``serve.queue_wait_ms``,
+``serve.solve_ms``, ``serve.batch_occupancy``. The serving layer also
+maintains the ``serve.queue_depth`` gauge and ``serve.requests`` /
+``serve.rejected`` / ``serve.batches`` / ``serve.rescued`` /
+``serve.abandoned`` / ``serve.status.<NAME>`` / ``serve.compiles[.*]``
+counters.
 
 Counters maintained on the default recorder include the pivot-free-LU
 residual-check outcomes, bridged from device via
@@ -47,6 +66,7 @@ several to the former, one to the latter).
 """
 
 from .recorder import (
+    Histogram,
     MetricsRecorder,
     configure,
     device_counters_enabled,
@@ -63,6 +83,7 @@ from .sink import (
 )
 
 __all__ = [
+    "Histogram",
     "JsonlSink",
     "MetricsRecorder",
     "append_jsonl",

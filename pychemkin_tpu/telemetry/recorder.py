@@ -7,7 +7,10 @@ surfaces:
 
 - host counters/gauges/timers on :class:`MetricsRecorder`, with
   ``section(...)`` timing blocks fenced by ``jax.block_until_ready`` so
-  a section charges DEVICE time, not Python dispatch time;
+  a section charges DEVICE time, not Python dispatch time, and
+  log-spaced-bucket :class:`Histogram` distributions via
+  ``observe(name, value)`` (the serving layer's latency/occupancy
+  primitive — p50/p95/p99 summarized in ``snapshot()``);
 - structured events: ``event(kind, **fields)`` appends one JSONL line to
   the attached crash-safe sink (see :mod:`.sink`) and keeps a bounded
   in-memory tail for ``solve_report()``-style surfaces;
@@ -25,11 +28,14 @@ sink to it.
 
 from __future__ import annotations
 
+import bisect
 import collections
 import contextlib
+import math
 import os
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from .sink import JsonlSink, timestamp
 
@@ -45,16 +51,105 @@ def device_counters_enabled() -> bool:
     return os.environ.get(_DEVICE_COUNTERS_ENV, "1") != "0"
 
 
+#: histogram bucket edges: log-spaced, 8 buckets per decade over
+#: [1e-6, 1e9) — wide enough for latencies in ms OR s, occupancies,
+#: queue depths. Values outside the range land in the open end buckets.
+_HIST_EDGES: List[float] = [10.0 ** (k / 8.0) for k in range(-48, 73)]
+
+
+class Histogram:
+    """Log-spaced-bucket value distribution with exact count/sum/min/max
+    and interpolated percentiles.
+
+    The latency primitive of the serving layer: ``observe(value)`` is
+    O(log n_buckets) and allocation-free, so the request hot path can
+    afford one per request; ``summary()`` reduces the buckets to the
+    JSON-ready ``{count, sum, mean, min, max, p50, p95, p99}`` shape
+    that ``MetricsRecorder.snapshot()`` publishes. Percentiles are
+    estimated by log-linear interpolation inside the winning bucket and
+    clamped to the exact observed [min, max], so a single-value
+    histogram reports that value for every percentile."""
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self):
+        self.counts = collections.defaultdict(int)  # edge index -> n
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        self.counts[bisect.bisect_right(_HIST_EDGES, v)] += 1
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-th percentile (q in [0, 100])."""
+        if self.count == 0:
+            return math.nan
+        rank = (q / 100.0) * self.count
+        seen = 0
+        for idx in sorted(self.counts):
+            n_here = self.counts[idx]
+            seen += n_here
+            if seen >= rank:
+                lo = _HIST_EDGES[idx - 1] if idx > 0 else self.min
+                hi = (_HIST_EDGES[idx] if idx < len(_HIST_EDGES)
+                      else self.max)
+                # interpolate by the rank's position INSIDE the winning
+                # bucket (log-space when possible), so two percentiles
+                # landing in one bucket still order correctly
+                frac = (rank - (seen - n_here)) / n_here
+                if lo > 0 and hi > lo:
+                    est = lo * (hi / lo) ** frac
+                else:
+                    est = lo + (hi - lo) * frac
+                return min(max(est, self.min), self.max)
+        return self.max
+
+    def summary(self) -> Dict[str, float]:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "mean": round(self.sum / self.count, 6),
+            "min": round(self.min, 6),
+            "max": round(self.max, 6),
+            "p50": round(self.percentile(50.0), 6),
+            "p95": round(self.percentile(95.0), 6),
+            "p99": round(self.percentile(99.0), 6),
+        }
+
+
 class MetricsRecorder:
-    """Counters + gauges + device-fenced wall-clock timers + events."""
+    """Counters + gauges + histograms + device-fenced wall-clock timers
+    + events.
+
+    Mutations are guarded by one internal lock: the serving layer
+    increments counters and observes histograms from submitter, worker,
+    and rescue threads concurrently, and a monitoring thread may call
+    :meth:`snapshot` mid-traffic — unsynchronized ``dict[k] += n`` would
+    drop updates and a dict resized during snapshot iteration would
+    raise."""
 
     def __init__(self, sink: Optional[JsonlSink] = None,
                  max_events: int = 256):
         self.counters: Dict[str, int] = collections.defaultdict(int)
         self.gauges: Dict[str, float] = {}
         self.timers: Dict[str, float] = collections.defaultdict(float)
+        self.histograms: Dict[str, Histogram] = {}
         self._events: collections.deque = collections.deque(
             maxlen=max_events)
+        self._lock = threading.Lock()
+        # events get their own lock: emit() does sink disk I/O, and
+        # holding the metrics lock across a write/flush would stall
+        # every hot-path inc()/observe() behind the filesystem
+        self._event_lock = threading.Lock()
         self._sink = sink
 
     # -- sink plumbing ---------------------------------------------------
@@ -67,10 +162,27 @@ class MetricsRecorder:
 
     # -- scalars ---------------------------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
-        self.counters[name] += int(n)
+        with self._lock:
+            self.counters[name] += int(n)
 
     def gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = float(value)
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into the named histogram (created on first
+        use). Summaries (count/sum/mean/min/max/p50/p95/p99) appear
+        under ``histograms`` in :meth:`snapshot`."""
+        with self._lock:
+            hist = self.histograms.get(name)
+            if hist is None:
+                hist = self.histograms[name] = Histogram()
+            hist.observe(value)
+
+    def histogram_summary(self, name: str) -> Dict[str, float]:
+        with self._lock:
+            hist = self.histograms.get(name)
+            return hist.summary() if hist is not None else {"count": 0}
 
     @contextlib.contextmanager
     def section(self, name: str, fence: Any = None):
@@ -87,7 +199,8 @@ class MetricsRecorder:
                 import jax
 
                 jax.block_until_ready(fence)
-            self.timers[name] += time.perf_counter() - t0
+            with self._lock:
+                self.timers[name] += time.perf_counter() - t0
 
     # -- events ----------------------------------------------------------
     def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
@@ -95,40 +208,57 @@ class MetricsRecorder:
         a crash-safe JSONL line and kept in the in-memory tail."""
         ev = {"t": timestamp(), "kind": kind}
         ev.update(fields)
-        self._events.append(ev)
-        if self._sink is not None:
-            self._sink.emit(ev)
+        # sink emit under the event lock: worker/rescue/caller threads
+        # all emit, and interleaved writes on one line-buffered text
+        # file would tear JSONL lines mid-log (read_jsonl only
+        # tolerates a torn FINAL line)
+        with self._event_lock:
+            self._events.append(ev)
+            if self._sink is not None:
+                self._sink.emit(ev)
         return ev
 
     def last_event(self, kind: str) -> Optional[Dict[str, Any]]:
-        for ev in reversed(self._events):
-            if ev["kind"] == kind:
-                return ev
+        with self._event_lock:
+            for ev in reversed(self._events):
+                if ev["kind"] == kind:
+                    return ev
         return None
 
     def events(self, kind: Optional[str] = None):
-        return [ev for ev in self._events
-                if kind is None or ev["kind"] == kind]
+        with self._event_lock:
+            return [ev for ev in self._events
+                    if kind is None or ev["kind"] == kind]
 
     # -- aggregate views -------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """Aggregate state as one JSON-ready dict; also rewritten
         atomically to the sink's snapshot file when a sink is attached."""
-        snap = {
-            "t": timestamp(),
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "timers": {k: round(v, 6) for k, v in self.timers.items()},
-        }
+        with self._lock:
+            snap = {
+                "t": timestamp(),
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timers": {k: round(v, 6)
+                           for k, v in self.timers.items()},
+                "histograms": {k: h.summary()
+                               for k, h in self.histograms.items()},
+            }
         if self._sink is not None:
-            self._sink.write_snapshot(snap)
+            # under the sink-I/O lock: concurrent snapshots must not
+            # interleave their last-writer-wins renames out of order
+            with self._event_lock:
+                self._sink.write_snapshot(snap)
         return snap
 
     def reset(self) -> None:
-        self.counters.clear()
-        self.gauges.clear()
-        self.timers.clear()
-        self._events.clear()
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.timers.clear()
+            self.histograms.clear()
+        with self._event_lock:
+            self._events.clear()
 
 
 def _iter_leaves(x):

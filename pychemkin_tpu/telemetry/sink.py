@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
@@ -52,8 +53,11 @@ def dumps_line(obj: Dict[str, Any]) -> str:
 
 def atomic_write_json(path: str, obj: Any) -> str:
     """Rewrite ``path`` atomically (tmp + ``os.replace``); the file is
-    always a complete JSON document even across a concurrent kill."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    always a complete JSON document even across a concurrent kill.
+    The tmp name is unique per (process, thread): two threads
+    snapshotting at once must not truncate each other's tmp mid-write
+    and race the rename."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         f.write(json.dumps(obj, default=_json_default, indent=1))
         f.write("\n")

@@ -47,7 +47,10 @@ Behaviour:
   sweeps would pick up the poisoned elements;
 - exit code is 0 iff every file's pytest exited 0 or 5 (with at least
   one 0);
-- a per-file line and a final summary are printed.
+- a per-file line and a final summary are printed; the summary ends
+  with every file's wall time sorted slowest-first, so the suite's
+  budget under the tier-1 wall-clock cap stays visible as files are
+  added.
 
 ``pytest tests/`` (the driver's command) is re-exec'ed into this runner
 by the multi-file branch of ``pytest_configure`` in ``tests/conftest.py``,
@@ -189,6 +192,13 @@ def main(argv=None):
     print(f"# run_suite: {len(results)} files, {n_fail} failed, "
           f"{n_empty} empty, {n_retried} retried, {total:.0f}s total",
           flush=True)
+    # per-file wall time, slowest first: the tier-1 suite runs under a
+    # hard wall-clock cap, so the budget each file burns must be
+    # visible right where a new file's cost would show up
+    print("# run_suite: per-file wall time (slowest first):",
+          flush=True)
+    for name, _, dt, _ in sorted(results, key=lambda r: -r[2]):
+        print(f"# run_suite:   {dt:7.1f}s  {name}", flush=True)
     if n_fail:
         for name, rc, _, _ in results:
             if rc not in (0, 5):

@@ -1,0 +1,177 @@
+"""Tests for the persistent-XLA-cache safety logic in
+``pychemkin_tpu/utils/cache.py``.
+
+This is the SIGILL-prevention layer: cache entries are AOT machine
+code for the producing host's CPU features, and three round-3 suite
+runs died rc=139 loading foreign entries before the cache directory
+was partitioned by host fingerprint. The partitioning and the
+remote-compile refusal had no tests until now (ISSUE 5 satellite).
+"""
+
+import builtins
+import io
+import os
+
+import jax
+import pytest
+
+from pychemkin_tpu.utils import cache
+
+
+def _fake_cpuinfo(monkeypatch, text):
+    """Route reads of /proc/cpuinfo to canned content (everything else
+    opens normally)."""
+    real_open = builtins.open
+
+    def fake_open(path, *args, **kwargs):
+        if path == "/proc/cpuinfo":
+            if text is None:
+                raise OSError("no /proc/cpuinfo on this platform")
+            return io.StringIO(text)
+        return real_open(path, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "open", fake_open)
+
+
+class TestHostCpuTag:
+    def test_stable_and_short(self):
+        a, b = cache._host_cpu_tag(), cache._host_cpu_tag()
+        assert a == b
+        assert len(a) == 10
+        int(a, 16)   # hex digest prefix
+
+    def test_partitions_by_feature_set(self, monkeypatch):
+        _fake_cpuinfo(monkeypatch,
+                      "processor\t: 0\nflags\t\t: fpu sse sse2 avx\n")
+        tag_a = cache._host_cpu_tag()
+        _fake_cpuinfo(monkeypatch,
+                      "processor\t: 0\n"
+                      "flags\t\t: fpu sse sse2 avx amx-fp16\n")
+        tag_b = cache._host_cpu_tag()
+        # a host with different features must be a different partition:
+        # its entries would be unreachable here (never SIGILL-loaded)
+        assert tag_a != tag_b
+
+    def test_flag_order_does_not_split_the_partition(self, monkeypatch):
+        _fake_cpuinfo(monkeypatch, "flags\t: avx sse2 sse fpu\n")
+        tag_a = cache._host_cpu_tag()
+        _fake_cpuinfo(monkeypatch, "flags\t: fpu sse sse2 avx\n")
+        assert cache._host_cpu_tag() == tag_a
+
+    def test_aarch64_features_line(self, monkeypatch):
+        _fake_cpuinfo(monkeypatch,
+                      "processor\t: 0\nFeatures\t: fp asimd sve\n")
+        tag = cache._host_cpu_tag()
+        assert len(tag) == 10
+
+    def test_unreadable_cpuinfo_falls_back_to_platform(self,
+                                                       monkeypatch):
+        _fake_cpuinfo(monkeypatch, None)
+        tag = cache._host_cpu_tag()
+        assert len(tag) == 10
+        int(tag, 16)
+
+
+class TestDefaultDir:
+    def test_writable_parent_uses_repo_local_dir(self, monkeypatch):
+        monkeypatch.setattr(os, "access", lambda p, m: True)
+        d = cache._default_dir()
+        assert d.endswith(".jax_cache")
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(cache.__file__))))
+        assert d == os.path.join(repo, ".jax_cache")
+
+    def test_readonly_parent_falls_back_to_xdg(self, monkeypatch,
+                                               tmp_path):
+        # a read-only site-packages install (Docker/Nix) must still
+        # cache — per-user XDG dir instead of the package parent
+        monkeypatch.setattr(os, "access", lambda p, m: False)
+        monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+        d = cache._default_dir()
+        assert d == os.path.join(str(tmp_path / "xdg"),
+                                 "pychemkin_tpu", "jax_cache")
+
+    def test_readonly_parent_without_xdg_uses_home(self, monkeypatch,
+                                                   tmp_path):
+        monkeypatch.setattr(os, "access", lambda p, m: False)
+        monkeypatch.delenv("XDG_CACHE_HOME", raising=False)
+        monkeypatch.setenv("HOME", str(tmp_path))
+        d = cache._default_dir()
+        assert d == os.path.join(str(tmp_path), ".cache",
+                                 "pychemkin_tpu", "jax_cache")
+
+
+class TestEnvFingerprint:
+    def test_local_host_partition(self, monkeypatch):
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        fp = cache._env_fingerprint()
+        assert fp == "local-" + cache._host_cpu_tag()
+
+    def test_remote_compile_env_is_unsafe(self, monkeypatch):
+        # with the axon tunnel active, XLA:CPU AOT entries target the
+        # REMOTE machine's features — caching must be refused
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+        assert cache._env_fingerprint() is None
+
+
+@pytest.fixture
+def restore_jax_cache_config():
+    """Snapshot/restore the jax compilation-cache settings the enable
+    call mutates, so these tests cannot leak into other tests."""
+    keys = ("jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes")
+    saved = {k: getattr(jax.config, k) for k in keys}
+    yield
+    for k, v in saved.items():
+        jax.config.update(k, v)
+
+
+class TestEnableCompilationCache:
+    def test_explicit_dir_wins(self, tmp_path,
+                               restore_jax_cache_config):
+        target = str(tmp_path / "ck")
+        got = cache.enable_compilation_cache(cache_dir=target)
+        assert got == target
+        assert os.path.isdir(target)
+        assert jax.config.jax_compilation_cache_dir == target
+
+    def test_env_var_relocates(self, tmp_path, monkeypatch,
+                               restore_jax_cache_config):
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        target = str(tmp_path / "env_ck")
+        monkeypatch.setenv("PYCHEMKIN_CACHE_DIR", target)
+        assert cache.enable_compilation_cache() == target
+
+    def test_remote_compile_env_refuses(self, tmp_path, monkeypatch,
+                                        restore_jax_cache_config):
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+        # even an explicit PYCHEMKIN_CACHE_DIR does not override the
+        # safety refusal — only a backend-verified partition does
+        monkeypatch.setenv("PYCHEMKIN_CACHE_DIR",
+                           str(tmp_path / "never"))
+        before = jax.config.jax_compilation_cache_dir
+        assert cache.enable_compilation_cache() is None
+        assert jax.config.jax_compilation_cache_dir == before
+        assert not os.path.exists(str(tmp_path / "never"))
+
+    def test_verified_partition_overrides_refusal(self, tmp_path,
+                                                  monkeypatch,
+                                                  restore_jax_cache_config):
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+        target = str(tmp_path / "axon_ck")
+        monkeypatch.setenv("PYCHEMKIN_CACHE_DIR", target)
+        # a TPU entry point that confirmed its backend opts in: compile
+        # target == execution target, so caching is safe again
+        assert cache.enable_compilation_cache(
+            partition="axon") == target
+
+    def test_default_dir_is_partitioned_by_fingerprint(
+            self, tmp_path, monkeypatch, restore_jax_cache_config):
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        monkeypatch.delenv("PYCHEMKIN_CACHE_DIR", raising=False)
+        monkeypatch.setattr(cache, "_default_dir",
+                            lambda: str(tmp_path / "root"))
+        got = cache.enable_compilation_cache()
+        assert got == os.path.join(str(tmp_path / "root"),
+                                   "local-" + cache._host_cpu_tag())

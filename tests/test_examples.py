@@ -23,6 +23,7 @@ FAST = [
     "pfr/plugflow.py",
     "engine/hcci_engine.py",
     "reactor_network/psr_chain_cluster.py",
+    "serve/online_requests.py",
 ]
 
 

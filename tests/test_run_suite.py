@@ -3,6 +3,7 @@ child must count as SKIPPED, not failed, so ``pytest tests/ -k pat``
 works again under the per-file re-exec (ADVICE round-5 #2)."""
 
 import os
+import re
 import subprocess
 import sys
 
@@ -33,6 +34,33 @@ def test_deselected_file_counts_as_skipped(tmp_path):
     assert "no tests" in r.stdout
     assert "0 failed" in r.stdout
     assert "1 empty" in r.stdout
+
+
+def test_summary_lists_per_file_wall_time_slowest_first(tmp_path):
+    """ISSUE 5 satellite: the summary ends with every file's wall
+    time, sorted slowest first, so the tier-1 wall-clock budget stays
+    visible as test files are added."""
+    f_fast = tmp_path / "test_fast.py"
+    f_fast.write_text("def test_quick():\n    assert True\n")
+    f_slow = tmp_path / "test_slow.py"
+    f_slow.write_text(
+        "import time\n"
+        "def test_sleepy():\n"
+        "    time.sleep(1.5)\n")
+    r = _run([str(f_fast), str(f_slow)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = r.stdout.splitlines()
+    hdr = next(i for i, ln in enumerate(lines)
+               if "per-file wall time (slowest first)" in ln)
+    timing = [ln for ln in lines[hdr + 1:]
+              if ln.startswith("# run_suite:   ") and ln.endswith(".py")]
+    assert len(timing) == 2, r.stdout
+    # the sleeping file must be listed first, with its seconds visible
+    assert "test_slow.py" in timing[0] and "test_fast.py" in timing[1]
+    slow_s = float(re.search(r"([\d.]+)s", timing[0]).group(1))
+    fast_s = float(re.search(r"([\d.]+)s", timing[1]).group(1))
+    assert slow_s >= fast_s
+    assert slow_s >= 1.5
 
 
 def test_all_files_empty_returns_5(tmp_path):

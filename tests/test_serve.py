@@ -1,0 +1,605 @@
+"""Online serving layer tests (ISSUE 5): dynamic micro-batching,
+shape-bucketed compile reuse, backpressure, rescue hand-off, graceful
+drain — all CPU, all threads.
+
+The acceptance scenario lives in ``TestAcceptance``: 64 concurrent
+mixed requests coalesced into bucketed micro-batches, bit-matching
+direct solves with zero warm recompiles; a separate fault-injected
+server proves the rescue hand-off leaves batch companions untouched.
+"""
+
+import os
+import queue
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pychemkin_tpu import serve, telemetry
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.resilience import faultinject
+from pychemkin_tpu.resilience.driver import GracefulStop
+from pychemkin_tpu.resilience.faultinject import FaultSpec
+from pychemkin_tpu.serve import batcher, buckets, loadgen
+from pychemkin_tpu.serve.errors import ServerClosed, ServerOverloaded
+from pychemkin_tpu.serve.futures import Request, ServeFuture
+
+P_ATM = 1.01325e6
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def Y_h2air(mech):
+    return loadgen.stoich_h2_air_Y(mech)
+
+
+def _eq_payload(Y, T=1200.0):
+    return dict(T=T, P=P_ATM, Y=Y, option=1)
+
+
+def _values_bitmatch(a, b):
+    """Exact comparison of two ServeResult.value dicts (scalars and
+    arrays): the served lane must BIT-match the direct solve."""
+    assert set(a) == set(b)
+    for k in a:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+
+class TestBuckets:
+    def test_normalize_sorts_and_dedups(self):
+        assert buckets.normalize_ladder([32, 1, 8, 8]) == (1, 8, 32)
+
+    def test_normalize_rejects_empty_and_nonpositive(self):
+        with pytest.raises(ValueError):
+            buckets.normalize_ladder([])
+        with pytest.raises(ValueError):
+            buckets.normalize_ladder([0, 4])
+
+    def test_bucket_for_picks_smallest_fit(self):
+        ladder = (1, 8, 32)
+        assert buckets.bucket_for(1, ladder) == 1
+        assert buckets.bucket_for(2, ladder) == 8
+        assert buckets.bucket_for(8, ladder) == 8
+        assert buckets.bucket_for(9, ladder) == 32
+        with pytest.raises(ValueError):
+            buckets.bucket_for(33, ladder)
+
+    def test_pad_indices_edge_replicates(self):
+        np.testing.assert_array_equal(buckets.pad_indices(3, 8),
+                                      [0, 1, 2, 2, 2, 2, 2, 2])
+        np.testing.assert_array_equal(buckets.pad_indices(4, 4),
+                                      [0, 1, 2, 3])
+        with pytest.raises(ValueError):
+            buckets.pad_indices(0, 4)
+        with pytest.raises(ValueError):
+            buckets.pad_indices(5, 4)
+
+
+# ---------------------------------------------------------------------------
+# batching policy (no server, no solves)
+
+def _req(kind="a", key=()):
+    return Request(kind=kind, key=key, payload={}, future=ServeFuture(),
+                   t_submit=time.perf_counter())
+
+
+class TestBatcher:
+    def test_collect_returns_none_on_stopped_empty_queue(self):
+        stop = GracefulStop()
+        stop.request()
+        assert batcher.collect(queue.Queue(), batcher.BatchPolicy(),
+                               stop, poll_s=0.01) is None
+
+    def test_collect_caps_at_max_batch_size(self):
+        q = queue.Queue()
+        for _ in range(5):
+            q.put(_req())
+        got = batcher.collect(q, batcher.BatchPolicy(max_batch_size=3),
+                              GracefulStop())
+        assert len(got) == 3
+        assert q.qsize() == 2
+
+    def test_collect_dispatches_lone_request_after_delay(self):
+        q = queue.Queue()
+        q.put(_req())
+        t0 = time.perf_counter()
+        got = batcher.collect(
+            q, batcher.BatchPolicy(max_batch_size=8, max_delay_ms=40.0),
+            GracefulStop())
+        dt = time.perf_counter() - t0
+        assert len(got) == 1
+        assert 0.03 <= dt < 2.0     # waited the window, not forever
+
+    def test_drain_ignores_delay_bound(self):
+        # a stop request must cut the delay window short: whatever is
+        # queued goes out immediately, nothing waits for company
+        q = queue.Queue()
+        for _ in range(2):
+            q.put(_req())
+        stop = GracefulStop()
+        stop.request()
+        t0 = time.perf_counter()
+        got = batcher.collect(
+            q, batcher.BatchPolicy(max_batch_size=8,
+                                   max_delay_ms=30_000.0), stop)
+        assert len(got) == 2
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_stop_mid_window_cuts_wait_short(self):
+        q = queue.Queue()
+        q.put(_req())
+        stop = GracefulStop()
+
+        def later():
+            time.sleep(0.1)
+            stop.request()
+
+        t = threading.Thread(target=later)
+        t.start()
+        t0 = time.perf_counter()
+        got = batcher.collect(
+            q, batcher.BatchPolicy(max_batch_size=8,
+                                   max_delay_ms=30_000.0), stop)
+        t.join()
+        assert len(got) == 1
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_group_splits_by_kind_and_key_in_order(self):
+        reqs = [_req("eq", (1,)), _req("ign"), _req("eq", (2,)),
+                _req("eq", (1,)), _req("ign")]
+        groups = batcher.group(reqs)
+        assert [(k, key, len(rs)) for k, key, rs in groups] == [
+            ("eq", (1,), 2), ("ign", (), 2), ("eq", (2,), 1)]
+        # order within a group is submission order
+        assert groups[0][2] == [reqs[0], reqs[3]]
+
+
+# ---------------------------------------------------------------------------
+# admission control (no worker: nothing here compiles)
+
+class TestAdmission:
+    def test_unknown_kind_raises_at_submit(self, mech):
+        server = serve.ChemServer(mech)
+        with pytest.raises(ValueError, match="unknown request kind"):
+            server.submit("flamethrower", x=1)
+
+    def test_malformed_payload_raises_at_submit(self, mech, Y_h2air):
+        # validation happens at the call site, never inside a batch
+        server = serve.ChemServer(mech)
+        with pytest.raises(ValueError, match="shape"):
+            server.submit_equilibrium(T=1200.0, P=P_ATM,
+                                      Y=Y_h2air[:-1])
+        with pytest.raises(ValueError, match="option"):
+            server.submit_equilibrium(T=1200.0, P=P_ATM, Y=Y_h2air,
+                                      option=99)
+
+    def test_overload_is_typed_rejection_not_deadlock(self, mech,
+                                                      Y_h2air):
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, queue_depth=4, recorder=rec)
+        futs = [server.submit_equilibrium(**_eq_payload(Y_h2air))
+                for _ in range(4)]
+        with pytest.raises(ServerOverloaded) as ei:
+            server.submit_equilibrium(**_eq_payload(Y_h2air))
+        assert ei.value.queue_depth == 4
+        assert rec.counters["serve.rejected"] == 1
+        assert rec.counters["serve.requests"] == 4
+        # admitted-but-never-served requests fail typed at close
+        server.close()
+        for f in futs:
+            with pytest.raises(ServerClosed):
+                f.result(timeout=5)
+
+    def test_close_without_drain_fails_queued(self, mech, Y_h2air):
+        server = serve.ChemServer(mech)
+        fut = server.submit_equilibrium(**_eq_payload(Y_h2air))
+        server.close(drain=False)
+        with pytest.raises(ServerClosed):
+            fut.result(timeout=5)
+
+    def test_submit_after_drain_requested_raises(self, mech, Y_h2air):
+        server = serve.ChemServer(mech)
+        server.request_drain()
+        assert server.draining
+        with pytest.raises(ServerClosed):
+            server.submit_equilibrium(**_eq_payload(Y_h2air))
+
+
+# ---------------------------------------------------------------------------
+# micro-batching + compile reuse (one warmed server, equilibrium only)
+
+class TestServing:
+    def test_coalesce_bitmatch_and_zero_warm_recompiles(self, mech,
+                                                        Y_h2air):
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 4), max_delay_ms=100.0,
+            recorder=rec)
+        warm = server.warmup(["equilibrium"])
+        assert warm == {"equilibrium": 2}          # one program per rung
+        assert server.warmup(["equilibrium"]) == {"equilibrium": 0}
+        warm_compiles = rec.counters["serve.compiles"]
+
+        Ts = [950.0, 1400.0, 1850.0]
+        with server:
+            futs = [server.submit_equilibrium(**_eq_payload(Y_h2air, T))
+                    for T in Ts]
+            res = [f.result(timeout=60) for f in futs]
+            # coalesced: one batch of 3, padded up the ladder to 4
+            assert [r.occupancy for r in res] == [3, 3, 3]
+            assert [r.bucket for r in res] == [4, 4, 4]
+            assert all(r.ok and not r.rescued for r in res)
+            # every served value bit-matches a direct single-condition
+            # solve at the same bucket shape
+            for T, r in zip(Ts, res):
+                direct = server.solve_direct(
+                    "equilibrium", bucket=4, **_eq_payload(Y_h2air, T))
+                _values_bitmatch(r.value, direct.value)
+            # a lone request lands in the 1-bucket
+            solo = server.submit_equilibrium(
+                **_eq_payload(Y_h2air, 1200.0)).result(timeout=60)
+            assert (solo.occupancy, solo.bucket) == (1, 1)
+        # warm ladder → ZERO recompiles from live traffic
+        assert rec.counters["serve.compiles"] == warm_compiles
+
+        snap = rec.snapshot()
+        assert snap["counters"]["serve.batches"] == 2
+        assert snap["counters"]["serve.status.OK"] == 4
+        assert "serve.queue_depth" in snap["gauges"]
+        for h in ("serve.queue_wait_ms", "serve.solve_ms",
+                  "serve.batch_occupancy"):
+            assert snap["histograms"][h]["count"] > 0
+            assert {"p50", "p95", "p99"} <= set(snap["histograms"][h])
+
+
+    def test_warmup_skips_unreachable_buckets(self, mech, Y_h2air):
+        # max_batch_size=1 means the batcher can never dispatch the
+        # 4-bucket: warmup must not pay that compile
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, bucket_sizes=(1, 4),
+                                  max_batch_size=1, recorder=rec)
+        assert server.warmup(["equilibrium"]) == {"equilibrium": 1}
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+
+class TestDrain:
+    def test_close_drains_in_flight_and_queued(self, mech, Y_h2air):
+        # delay window far larger than the test: only the drain's
+        # cut-short path can dispatch these
+        server = serve.ChemServer(mech, bucket_sizes=(1, 2),
+                                  max_delay_ms=60_000.0)
+        server.start()
+        futs = [server.submit_equilibrium(**_eq_payload(Y_h2air, T))
+                for T in (1000.0, 1300.0, 1600.0)]
+        server.close()                     # drain=True
+        for f in futs:
+            assert f.result(timeout=5).ok  # already resolved
+        assert not server._worker.is_alive()
+        assert not server._rescuer.is_alive()
+
+    def test_sigterm_drains_in_flight_batch(self, mech, Y_h2air):
+        before = signal.getsignal(signal.SIGTERM)
+        server = serve.ChemServer(mech, bucket_sizes=(1, 2),
+                                  max_delay_ms=60_000.0)
+        server.install_signal_handlers()
+        server.start()
+        futs = [server.submit_equilibrium(**_eq_payload(Y_h2air, T))
+                for T in (1100.0, 1500.0)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        # the handler only sets the cooperative flag; the worker
+        # finishes the in-flight batch and exits
+        res = [f.result(timeout=60) for f in futs]
+        assert all(r.ok for r in res)
+        assert server.draining
+        with pytest.raises(ServerClosed):
+            server.submit_equilibrium(**_eq_payload(Y_h2air))
+        server.close()
+        assert signal.getsignal(signal.SIGTERM) == before  # restored
+
+
+# ---------------------------------------------------------------------------
+# load generator (shared core + CLI tool)
+
+class TestLoadgen:
+    def test_run_load_summary_schema(self, mech, Y_h2air):
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, bucket_sizes=(1, 4),
+                                  max_delay_ms=5.0, recorder=rec)
+        server.warmup(["equilibrium"])
+        rng = np.random.default_rng(7)
+        with server:
+            summary = loadgen.run_load(
+                server, loadgen.default_samplers(mech, ["equilibrium"]),
+                rate_hz=400.0, n_requests=12, rng=rng)
+        assert summary["n_served"] + summary["n_rejected"] == 12
+        for key in ("p50_ms", "p95_ms", "p99_ms", "mean_ms", "max_ms",
+                    "mean_occupancy", "max_occupancy", "offered_s",
+                    "wall_s", "status_counts", "n_rescued"):
+            assert key in summary, key
+        assert summary["p50_ms"] <= summary["p99_ms"] <= \
+            summary["max_ms"]
+        assert summary["status_counts"] == {"OK": summary["n_served"]}
+        assert loadgen.ok_fraction(summary) == 1.0
+
+    def test_open_loop_schedule_is_seeded(self):
+        a = np.random.default_rng(3).exponential(0.01, size=8)
+        b = np.random.default_rng(3).exponential(0.01, size=8)
+        np.testing.assert_array_equal(a, b)
+
+    def test_all_rejected_run_is_strict_json(self):
+        import json
+
+        class _AlwaysFull:
+            queue_depth = 0
+
+            def submit(self, kind, **payload):
+                raise ServerOverloaded("full", queue_depth=0)
+
+        summary = loadgen.run_load(
+            _AlwaysFull(), [lambda i, rng: ("equilibrium", {})],
+            rate_hz=1000.0, n_requests=5,
+            rng=np.random.default_rng(0))
+        assert summary["n_served"] == 0
+        assert summary["n_rejected"] == 5
+        assert summary["p50_ms"] is None
+        # the banked artifact must stay strict JSON — no NaN literal
+        assert "NaN" not in json.dumps(summary)
+
+    def test_tool_banks_atomic_artifact(self, tmp_path):
+        import json
+
+        from tools import loadgen as loadgen_tool
+        out = str(tmp_path / "LOADGEN.json")
+        rc = loadgen_tool.main([
+            "--mech", "h2o2", "--kinds", "equilibrium", "--rate", "400",
+            "--n", "10", "--seed", "0", "--buckets", "1,4",
+            "--delay-ms", "5", "--out", out])
+        assert rc == 0
+        with open(out) as f:
+            art = json.load(f)
+        assert art["tool"] == "loadgen"
+        assert art["n_served"] + art["n_rejected"] == 10
+        assert art["warmup_compiles"] == {"equilibrium": 2}
+        # server-side telemetry rides in the artifact
+        snap = art["telemetry"]
+        assert snap["histograms"]["serve.queue_wait_ms"]["count"] > 0
+        assert snap["counters"]["serve.batches"] >= 1
+
+    @pytest.mark.slow
+    def test_soak_mixed_kinds(self, mech):
+        """Soak variant: sustained mixed traffic, every request OK."""
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 8, 32), max_delay_ms=2.0,
+            recorder=rec,
+            engine_config={"ignition": {"rtol": 1e-6, "atol": 1e-10,
+                                        "max_steps_per_segment": 4000}})
+        server.warmup(["equilibrium", "ignition"])
+        warm_compiles = rec.counters["serve.compiles"]
+        rng = np.random.default_rng(11)
+        with server:
+            summary = loadgen.run_load(
+                server,
+                loadgen.default_samplers(mech,
+                                         ["equilibrium", "ignition"]),
+                rate_hz=150.0, n_requests=300, rng=rng)
+        assert summary["n_rejected"] == 0
+        assert loadgen.ok_fraction(summary) == 1.0
+        assert summary["mean_occupancy"] > 1.0
+        assert rec.counters["serve.compiles"] == warm_compiles
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE 5 acceptance scenario
+
+class TestAcceptance:
+    N = 64
+
+    def _mixed_payloads(self, Y):
+        rng = np.random.default_rng(0)
+        out = []
+        for i in range(self.N):
+            if i % 2 == 0:
+                out.append(("equilibrium", dict(
+                    T=float(rng.uniform(900.0, 2000.0)), P=P_ATM, Y=Y,
+                    option=1)))
+            else:
+                out.append(("ignition", dict(
+                    T0=float(rng.uniform(1250.0, 1400.0)), P0=P_ATM,
+                    Y0=Y, t_end=4e-4)))
+        return out
+
+    def _submit_concurrently(self, server, payloads, n_threads=8):
+        futs = [None] * len(payloads)
+        errs = []
+
+        def submitter(tid):
+            try:
+                for i in range(tid, len(payloads), n_threads):
+                    kind, pl = payloads[i]
+                    futs[i] = server.submit(kind, **pl)
+            except Exception as e:     # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=submitter, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        return futs
+
+    def test_issue5_acceptance(self, mech, Y_h2air):
+        """64 concurrent mixed requests → bucketed micro-batches,
+        bit-matched values, zero warm recompiles, latency/occupancy/
+        queue-depth telemetry."""
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 8, 32), max_batch_size=32,
+            max_delay_ms=150.0, queue_depth=256, recorder=rec,
+            engine_config={"ignition": {"rtol": 1e-6, "atol": 1e-10,
+                                        "max_steps_per_segment": 4000}})
+        warm = server.warmup(["equilibrium", "ignition"])
+        assert warm == {"equilibrium": 3, "ignition": 3}
+        warm_compiles = rec.counters["serve.compiles"]
+
+        payloads = self._mixed_payloads(Y_h2air)
+        with server:
+            futs = self._submit_concurrently(server, payloads)
+            res = [f.result(timeout=600) for f in futs]
+
+        # every request served OK off the hot path
+        assert all(r.ok and not r.rescued for r in res)
+        assert rec.counters["serve.requests"] == self.N
+        assert rec.counters["serve.status.OK"] == self.N
+
+        # coalesced into bucketed micro-batches: far fewer device
+        # programs than requests, every one at a ladder shape
+        n_batches = rec.counters["serve.batches"]
+        assert n_batches <= 10
+        assert all(r.bucket in (1, 8, 32) for r in res)
+        assert all(r.occupancy <= r.bucket for r in res)
+        occ = rec.histograms["serve.batch_occupancy"]
+        assert occ.max > 4            # real coalescing happened
+
+        # warm bucket shapes → ZERO recompiles from live traffic
+        assert rec.counters["serve.compiles"] == warm_compiles
+
+        # served values bit-match a direct single-condition solve at
+        # the same bucket (every equilibrium; ignition sampled — each
+        # direct solve runs a full padded batch program)
+        ign_checked = 0
+        for i, (kind, pl) in enumerate(payloads):
+            if kind == "equilibrium":
+                direct = server.solve_direct(kind, bucket=res[i].bucket,
+                                             **pl)
+                _values_bitmatch(res[i].value, direct.value)
+            elif ign_checked < 2:
+                direct = server.solve_direct(kind, bucket=res[i].bucket,
+                                             **pl)
+                _values_bitmatch(res[i].value, direct.value)
+                assert np.isfinite(res[i].value["ignition_delay_ms"])
+                ign_checked += 1
+        assert rec.counters["serve.compiles"] == warm_compiles
+
+        # p50/p99 latency, occupancy, and queue depth in the snapshot
+        snap = rec.snapshot()
+        assert "serve.queue_depth" in snap["gauges"]
+        for h in ("serve.queue_wait_ms", "serve.solve_ms",
+                  "serve.batch_occupancy"):
+            s = snap["histograms"][h]
+            assert s["count"] > 0 and s["p50"] <= s["p99"], h
+
+    def test_faulted_request_rescued_companions_unaffected(self, mech,
+                                                           Y_h2air):
+        """One injected-fault request resolves via the rescue ladder;
+        healthy requests in the SAME batch resolve from the hot path
+        and bit-match a direct solve."""
+        rec = telemetry.MetricsRecorder()
+        victim_lane, n_reqs = 20, 24
+        spec = FaultSpec(mode="linalg_unstable", elements=(victim_lane,),
+                         heal_at=1)
+        with faultinject.inject(spec):
+            server = serve.ChemServer(
+                mech, bucket_sizes=(32,), max_delay_ms=150.0,
+                recorder=rec)
+            # traced INSIDE the injection context: the program carries
+            # the fault nodes for lane 20 only
+            server.warmup(["equilibrium"], bucket_sizes=(32,))
+            # deterministic batch composition: admit everything before
+            # the worker exists, then start — one batch, lanes in
+            # submission order
+            futs = [server.submit_equilibrium(
+                T=900.0 + 45.0 * i, P=P_ATM, Y=Y_h2air)
+                for i in range(n_reqs)]
+            with server:
+                res = [f.result(timeout=120) for f in futs]
+
+            victim = res[victim_lane]
+            assert victim.ok and victim.rescued
+            assert victim.rescue_rungs == 1        # healed at rung 1
+            assert 900.0 < victim.value["T"] < 4000.0
+            assert rec.counters["serve.rescued"] == 1
+            (ev,) = rec.events("serve.rescue")
+            assert ev["rescued"] is True and ev["req_kind"] == \
+                "equilibrium"
+            (bev,) = rec.events("serve.batch")
+            assert bev["n_rescue_handoff"] == 1
+            assert bev["occupancy"] == n_reqs
+
+            # companions: hot path, untouched, bit-matching direct
+            for i, r in enumerate(res):
+                if i == victim_lane:
+                    continue
+                assert r.ok and not r.rescued, i
+                direct = server.solve_direct(
+                    "equilibrium", bucket=32, T=900.0 + 45.0 * i,
+                    P=P_ATM, Y=Y_h2air)
+                _values_bitmatch(r.value, direct.value)
+
+    def test_abandoned_fault_reports_status(self, mech, Y_h2air):
+        """A never-healing fault walks every rung, then resolves with
+        its failure status as DATA (never an exception)."""
+        rec = telemetry.MetricsRecorder()
+        spec = FaultSpec(mode="linalg_unstable", elements=(0,),
+                         heal_at=-1)
+        with faultinject.inject(spec):
+            server = serve.ChemServer(mech, bucket_sizes=(1,),
+                                      max_delay_ms=5.0, recorder=rec,
+                                      max_rescue_rungs=1)
+            with server:
+                r = server.submit_equilibrium(
+                    **_eq_payload(Y_h2air)).result(timeout=120)
+            assert not r.ok and not r.rescued
+            assert r.status_name == "LINALG_UNSTABLE"
+            assert rec.counters["serve.abandoned"] == 1
+
+
+# ---------------------------------------------------------------------------
+# worker resilience: demux failures stay contained to their lane
+
+class TestWorkerResilience:
+    def test_demux_error_contained_to_lane(self, mech, Y_h2air):
+        """A per-lane demux failure (bad engine output for one lane)
+        fails THAT future; companions in the same batch resolve and
+        the worker survives to drain cleanly."""
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, bucket_sizes=(2,),
+                                  max_delay_ms=150.0, recorder=rec)
+        eng = server.engine("equilibrium")
+        orig = eng.value_at
+
+        def bad_lane0(out, i):
+            if i == 0:
+                raise RuntimeError("boom lane 0")
+            return orig(out, i)
+
+        eng.value_at = bad_lane0
+        # admit both before start: one deterministic batch, lanes in
+        # submission order
+        f0 = server.submit_equilibrium(**_eq_payload(Y_h2air, 1000.0))
+        f1 = server.submit_equilibrium(**_eq_payload(Y_h2air, 1400.0))
+        server.start()
+        with pytest.raises(RuntimeError, match="boom lane 0"):
+            f0.result(timeout=120)
+        assert f1.result(timeout=120).ok
+        assert rec.counters["serve.batch_errors"] == 1
+        assert rec.last_event("serve.demux_error")["lane"] == 0
+        # worker survived the bad lane: drain completes
+        assert server.close() is True
+        assert not server._worker.is_alive()
+        # post-drain admissions stay typed
+        with pytest.raises(ServerClosed):
+            server.submit_equilibrium(**_eq_payload(Y_h2air))

@@ -66,6 +66,109 @@ class TestRecorder:
         assert all(e["kind"] == "solve" for e in evs)
 
 
+class TestHistogram:
+    """ISSUE 5 satellite: the log-spaced-bucket histogram primitive
+    (``MetricsRecorder.observe``) — the serving layer's latency/
+    occupancy distribution surface."""
+
+    def test_observe_summary_schema(self):
+        rec = MetricsRecorder()
+        for v in (1.0, 2.0, 4.0, 8.0, 100.0):
+            rec.observe("lat_ms", v)
+        s = rec.histogram_summary("lat_ms")
+        for key in ("count", "sum", "mean", "min", "max",
+                    "p50", "p95", "p99"):
+            assert key in s, f"summary missing {key}"
+        assert s["count"] == 5
+        assert s["sum"] == pytest.approx(115.0)
+        assert s["mean"] == pytest.approx(23.0)
+        assert (s["min"], s["max"]) == (1.0, 100.0)
+        # percentile estimates are monotone and clamped to [min, max]
+        assert s["min"] <= s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+
+    def test_single_value_reports_itself_at_every_percentile(self):
+        rec = MetricsRecorder()
+        rec.observe("h", 42.0)
+        s = rec.histogram_summary("h")
+        assert s["p50"] == s["p95"] == s["p99"] == 42.0
+
+    def test_log_spaced_percentile_accuracy(self):
+        # against numpy on a wide log-uniform sample: log-spaced
+        # buckets (8/decade) bound relative error tightly
+        rng = np.random.default_rng(0)
+        vals = 10.0 ** rng.uniform(-1, 4, size=2000)
+        rec = MetricsRecorder()
+        for v in vals:
+            rec.observe("h", v)
+        s = rec.histogram_summary("h")
+        for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+            exact = float(np.percentile(vals, q))
+            assert abs(s[key] - exact) / exact < 0.35, (key, s[key],
+                                                        exact)
+
+    def test_empty_histogram_is_count_zero(self):
+        rec = MetricsRecorder()
+        assert rec.histogram_summary("never") == {"count": 0}
+
+    def test_snapshot_carries_histograms_to_sink(self, tmp_path):
+        p = str(tmp_path / "snap.json")
+        rec = MetricsRecorder(sink=JsonlSink(str(tmp_path / "e.jsonl"),
+                                             snapshot_path=p))
+        rec.observe("serve.solve_ms", 3.5)
+        rec.observe("serve.solve_ms", 7.0)
+        snap = rec.snapshot()
+        assert snap["histograms"]["serve.solve_ms"]["count"] == 2
+        with open(p) as f:
+            on_disk = json.load(f)
+        assert on_disk["histograms"]["serve.solve_ms"] == \
+            snap["histograms"]["serve.solve_ms"]
+
+    def test_reset_clears_histograms(self):
+        rec = MetricsRecorder()
+        rec.observe("h", 1.0)
+        rec.reset()
+        assert rec.histogram_summary("h") == {"count": 0}
+
+
+class TestRecorderThreadSafety:
+    def test_concurrent_inc_observe_snapshot(self):
+        # the serving layer mutates one recorder from submitter,
+        # worker, and rescue threads while a monitor snapshots: no
+        # lost increments, no "dict changed size" from snapshot()
+        # racing first-observe histogram creation
+        import threading
+
+        rec = MetricsRecorder()
+        n, n_threads = 2000, 8
+        errs = []
+
+        def hammer(t):
+            try:
+                for i in range(n):
+                    rec.inc("serve.requests")
+                    # rotate histogram names so snapshots race dict
+                    # growth, not just bucket updates
+                    rec.observe(f"h{t}.{i // 250}", float(i + 1))
+                    if i % 100 == t:
+                        rec.snapshot()
+            except Exception as exc:   # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert errs == []
+        snap = rec.snapshot()
+        assert snap["counters"]["serve.requests"] == n * n_threads
+        for t in range(n_threads):
+            total = sum(snap["histograms"][f"h{t}.{j}"]["count"]
+                        for j in range(n // 250))
+            assert total == n
+
+
 class TestSinkCrashSafety:
     def test_torn_tail_line_is_skipped(self, tmp_path):
         p = str(tmp_path / "ev.jsonl")
@@ -201,6 +304,34 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
     }
 
 
+#: every key the serve_latency rung JSON must carry (ISSUE 5): the
+#: online-path counterpart of RUNG_SCHEMA_KEYS — request-side latency
+#: percentiles, occupancy, rejection/rescue counts, compile counters
+SERVE_RUNG_KEYS = (
+    "rung", "platform", "mech", "kinds", "warmup_s", "compiles",
+    "n_batches", "queue_wait_ms", "solve_ms", "n_requests", "n_served",
+    "n_rejected", "n_rescued", "rate_hz", "offered_s", "wall_s",
+    "status_counts", "p50_ms", "p95_ms", "p99_ms", "mean_ms", "max_ms",
+    "mean_occupancy", "max_occupancy",
+)
+
+
+def _fake_serve_result():
+    return {
+        "rung": "serve_latency", "platform": "tpu", "mech": "h2o2",
+        "kinds": ["equilibrium", "ignition"], "warmup_s": 3.0,
+        "compiles": 6, "n_batches": 9,
+        "queue_wait_ms": {"count": 20, "p50": 2.0, "p95": 4.0,
+                          "p99": 5.0},
+        "solve_ms": {"count": 9, "p50": 8.0, "p95": 9.0, "p99": 9.5},
+        "n_requests": 20, "n_served": 20, "n_rejected": 0,
+        "n_rescued": 0, "rate_hz": 100.0, "offered_s": 0.2,
+        "wall_s": 0.4, "status_counts": {"OK": 20}, "p50_ms": 10.0,
+        "p95_ms": 12.0, "p99_ms": 14.0, "mean_ms": 10.5, "max_ms": 15.0,
+        "mean_occupancy": 2.2, "max_occupancy": 4,
+    }
+
+
 def _summary_lines(captured: str):
     out = []
     for line in captured.splitlines():
@@ -223,6 +354,8 @@ class TestBenchBanking:
             if args[0] == "baseline":
                 return 0, {"n_points": 2, "s_per_ignition": 0.5,
                            "ignitions_per_sec": 2.0}, ""
+            if args[0] == "serve":
+                return 0, _fake_serve_result(), ""
             assert args[0] == "config"
             i = calls["n"]
             calls["n"] += 1
@@ -251,6 +384,12 @@ class TestBenchBanking:
         assert summaries[-1]["value"] == 64.0
         assert all(c["mfu_pct"] is not None
                    for c in summaries[-1]["configs_run"])
+        # the serve_latency rung rides in the final summary (and the
+        # bank below), with its full schema
+        serve_rung = summaries[-1]["serve_latency"]
+        for key in SERVE_RUNG_KEYS:
+            assert key in serve_rung, f"serve rung missing {key}"
+        assert all("serve_latency" not in s for s in summaries[:-1])
         # configs_run schema: the resilience counters ride along into
         # every banked summary (partial lines included)
         for summary in summaries:
@@ -371,6 +510,25 @@ class TestBenchRungSchema:
         assert rung["status_counts"] == {"OK": 4}
         assert rung["resume_count"] == 0        # nothing to resume
         assert rung["driver_overhead_s"] >= 0.0
+
+
+class TestServeRungSchema:
+    @pytest.mark.slow
+    def test_child_serve_emits_full_schema_on_cpu(self, capfd):
+        """The REAL serve_latency child must emit every schema key the
+        fake banking tests rely on — low request count, equilibrium
+        pressure only comes from warmup (ignition warms too, so the
+        rung exercises the mixed-kind path end to end)."""
+        benchmarks._child_serve("h2o2", 16, 200.0)
+        rung = _summary_lines(capfd.readouterr().out)[-1]
+        for key in SERVE_RUNG_KEYS:
+            assert key in rung, f"missing serve rung key {key}"
+        assert rung["rung"] == "serve_latency"
+        assert rung["n_served"] + rung["n_rejected"] == 16
+        assert rung["compiles"] == 6          # 2 kinds x 3-rung ladder
+        assert rung["queue_wait_ms"]["count"] == rung["n_served"]
+        assert rung["p50_ms"] <= rung["p99_ms"] <= rung["max_ms"]
+        assert rung["status_counts"].get("OK", 0) == rung["n_served"]
 
 
 class TestDriverEventSchema:

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Open-loop Poisson load generator for the online serving layer.
+
+Drives an in-process :class:`pychemkin_tpu.serve.ChemServer` with a
+seeded Poisson request stream (open loop: arrivals keep their schedule
+regardless of completions, so queueing collapse is visible instead of
+self-throttled away) and banks a JSON latency artifact with the same
+atomic tmp+rename idiom as the bench (a kill mid-run leaves either the
+previous artifact or a complete new one, never a torn file).
+
+Usage::
+
+    python tools/loadgen.py --mech h2o2 --kinds equilibrium,ignition \
+        --rate 100 --n 200 --seed 0 --out LOADGEN.json
+
+The artifact carries the request-side latency distribution
+(p50/p95/p99/mean/max ms), occupancy, rejection and rescue counts,
+plus the server-side telemetry snapshot (queue-depth gauge,
+wait/solve/occupancy histograms, per-status counters).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+# runnable as a script from anywhere: the repo root is the package's
+# parent, same bootstrap as bench.py
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from pychemkin_tpu import serve, telemetry          # noqa: E402
+from pychemkin_tpu.mechanism import load_embedded   # noqa: E402
+from pychemkin_tpu.serve import loadgen             # noqa: E402
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mech", default="h2o2",
+                   help="embedded mechanism name (default h2o2)")
+    p.add_argument("--kinds", default="equilibrium",
+                   help="comma list of request kinds "
+                        "(ignition,psr,equilibrium)")
+    p.add_argument("--rate", type=float, default=100.0,
+                   help="offered arrival rate, requests/s")
+    p.add_argument("--n", type=int, default=200,
+                   help="number of arrivals to offer")
+    p.add_argument("--seed", type=int, default=0,
+                   help="rng seed (arrival schedule + payloads)")
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--delay-ms", type=float, default=2.0)
+    p.add_argument("--buckets", default="1,8,32",
+                   help="comma list of bucket sizes")
+    p.add_argument("--queue-depth", type=int, default=256)
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="per-future result timeout, s")
+    p.add_argument("--out", default="LOADGEN.json",
+                   help="artifact path (atomic rewrite)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
+    bucket_sizes = tuple(int(b) for b in args.buckets.split(","))
+
+    mech = load_embedded(args.mech)
+    rec = telemetry.MetricsRecorder()
+    server = serve.ChemServer(
+        mech, bucket_sizes=bucket_sizes, max_batch_size=args.max_batch,
+        max_delay_ms=args.delay_ms, queue_depth=args.queue_depth,
+        recorder=rec,
+        engine_config={"ignition": {"rtol": 1e-6, "atol": 1e-10,
+                                    "max_steps_per_segment": 4000}})
+    rng = np.random.default_rng(args.seed)
+    samplers = loadgen.default_samplers(mech, kinds)
+
+    print(f"# loadgen: warming {kinds} over buckets {bucket_sizes}",
+          file=sys.stderr)
+    warm = server.warmup(kinds)
+    with server:
+        summary = loadgen.run_load(
+            server, samplers, rate_hz=args.rate, n_requests=args.n,
+            rng=rng, result_timeout_s=args.timeout)
+
+    artifact = {
+        "tool": "loadgen",
+        "mech": args.mech,
+        "kinds": kinds,
+        "seed": args.seed,
+        "buckets": list(bucket_sizes),
+        "max_batch_size": args.max_batch,
+        "max_delay_ms": args.delay_ms,
+        "warmup_compiles": warm,
+        **summary,
+        "telemetry": rec.snapshot(),
+    }
+    telemetry.atomic_write_json(args.out, artifact)
+    print(json.dumps({k: v for k, v in artifact.items()
+                      if k != "telemetry"}), flush=True)
+    print(f"# loadgen: artifact banked to {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
