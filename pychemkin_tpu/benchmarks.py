@@ -201,6 +201,8 @@ def _child_config(mech_name: str, B: int, repeats: int):
         from .utils import enable_compilation_cache
         enable_compilation_cache(partition="axon")
     mech = load_embedded(mech_name)
+    from .ops import jacobian
+    sparsity = jacobian.sparsity_stats(mech)
     Y0 = _stoich_Y0(mech, mech_name)
     mesh = parallel.make_mesh()
     T0s = np.linspace(t_lo, t_hi, B)
@@ -217,12 +219,18 @@ def _child_config(mech_name: str, B: int, repeats: int):
     ck_path = (os.path.join(ck_dir, f"{mech_name}_B{B}.ck.npz")
                if ck_dir else None)
 
+    # Jacobian mode of the stiff hot path: "analytic" (the closed-form
+    # default since ISSUE 6) or "ad" for A/B-ing the retired dense
+    # jacfwd build; the rung JSON records which one the timing measured
+    jac_mode = os.environ.get("BENCH_JAC_MODE", "analytic")
+
     def sweep(stats=None, job_report=None, checkpoint_path=None):
         return parallel.sharded_ignition_sweep(
             mech, "CONP", "ENRG", T0s, P0s, Y0, t_end, mesh=mesh,
             rtol=rtol, atol=atol, max_steps_per_segment=20_000,
             chunk_size=chunk, stats=stats, job_report=job_report,
-            checkpoint_path=checkpoint_path)
+            checkpoint_path=checkpoint_path,
+            solve_kwargs={"jac_mode": jac_mode})
 
     warmup_report: dict = {}
     t0 = time.time()
@@ -271,7 +279,7 @@ def _child_config(mech_name: str, B: int, repeats: int):
     # production partial-results story per rung
     times, ok, status, rescue_report = resilience.resilient_ignition_sweep(
         mech, "CONP", "ENRG", T0s, P0s, Y0, t_end, rtol=rtol, atol=atol,
-        max_steps_per_segment=20_000,
+        max_steps_per_segment=20_000, jac_mode=jac_mode,
         base_results={"times": times, "ok": ok, "status": status})
 
     n_ok = int(np.sum(ok))
@@ -297,6 +305,12 @@ def _child_config(mech_name: str, B: int, repeats: int):
         model_f32_gflop=round(f32_flops / 1e9, 2),
         model_f64_gflop=round(f64_flops / 1e9, 2),
         mfu_pct=mfu,
+        # Jacobian mode + the mechanism sparsity the analytical
+        # assembly exploits (ops/jacobian.py) — so a banked rung is
+        # self-describing about WHICH Jacobian path its timing measured
+        jac_mode=jac_mode,
+        nu_nnz_frac=sparsity["nu_nnz_frac"],
+        n_species_active=sparsity["n_species_active"],
         n_failed=rescue_report.n_failed,
         n_rescued=rescue_report.n_rescued,
         n_abandoned=rescue_report.n_abandoned,
@@ -372,7 +386,7 @@ def _child_baseline(mech_name: str, n_points: int, budget_s: float):
     from scipy.integrate import solve_ivp
 
     from .mechanism import load_embedded
-    from .ops import reactors, thermo
+    from .ops import jacobian, reactors, thermo
 
     (t_lo, t_hi), t_end, rtol, atol = _PROTOCOL[mech_name]
     mech = load_embedded(mech_name)
@@ -395,8 +409,16 @@ def _child_baseline(mech_name: str, n_points: int, budget_s: float):
             mass=float(thermo.density(mech, float(T0), P0,
                                       jnp.asarray(Y0))))
         rhs = jax.jit(lambda t, y, a=args: reactors.conp_enrg_rhs(t, y, a))
-        jac = jax.jit(lambda t, y, a=args: jax.jacfwd(
-            lambda yy: reactors.conp_enrg_rhs(t, yy, a))(y))
+        # same Jacobian code the stiff solver runs — the baseline and
+        # the sweep must time the same assembly, including under a
+        # BENCH_JAC_MODE=ad A/B run (where the sweep's solves use the
+        # retired jacfwd path, so the baseline must too)
+        if os.environ.get("BENCH_JAC_MODE", "analytic") == "ad":
+            jac = jax.jit(lambda t, y, a=args: jax.jacfwd(
+                lambda yy: reactors.conp_enrg_rhs(t, yy, a))(y))
+        else:
+            jac_fn = jacobian.batch_rhs_jacobian("CONP", "ENRG")
+            jac = jax.jit(lambda t, y, a=args: jac_fn(t, y, a))
         y0 = np.concatenate([Y0, [float(T0)]])
         # warm the jits so compile time doesn't count against the baseline
         np.asarray(rhs(0.0, jnp.asarray(y0)))
@@ -600,6 +622,7 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
         "n_ok": best["n_ok"],
         "n_ignited": best["n_ignited"],
         "mfu_pct": best.get("mfu_pct"),
+        "jac_mode": best.get("jac_mode"),
         "steps_per_sec": best.get("steps_per_sec"),
         "baseline_ignitions_per_sec": round(baseline_ips, 4),
         "baseline_kind": baseline_kind,
@@ -609,6 +632,8 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
                                    "compile_s", "run_s", "mfu_pct",
                                    "steps_per_sec", "n_steps",
                                    "n_rejected", "n_newton", "platform",
+                                   "jac_mode", "nu_nnz_frac",
+                                   "n_species_active",
                                    "n_failed", "n_rescued",
                                    "n_abandoned", "status_counts",
                                    "resume_count", "chunks_replayed",

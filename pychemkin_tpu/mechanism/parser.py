@@ -38,6 +38,7 @@ from .record import (
     TB_NONE,
     TB_SPECIES,
     MechanismRecord,
+    jac_sparsity_fields,
 )
 
 # --- standard atomic weights [g/mol] ---------------------------------------
@@ -866,6 +867,8 @@ class MechanismParser:
             rev_A=rev_A, rev_beta=rev_beta, rev_Ea_R=rev_Ea_R,
             tb_type=tb_type, tb_eff=tb_eff,
             falloff_type=falloff_type, is_chem_act=is_chem_act,
+            **jac_sparsity_fields(nu_f, nu_r, ord_f, ord_r, tb_type,
+                                  falloff_type),
             low_A=low_A, low_beta=low_beta, low_Ea_R=low_Ea_R,
             troe=troe, sri=sri,
             **plog_arrays,

@@ -121,6 +121,22 @@ class MechanismRecord:
     plog_beta: Any = None      # [IIp, L, Tm]
     plog_Ea_R: Any = None      # [IIp, L, Tm]
 
+    # ---- Jacobian sparsity metadata (static, parse-time) -------------------
+    # Precomputed at Mechanism build time so the analytical Jacobian
+    # (ops/jacobian.py) can compact its correction terms to the rows that
+    # actually carry them and report mechanism sparsity in telemetry,
+    # without probing (possibly traced) array leaves at trace time.
+    # None on hand-built records: jacobian.py falls back to computing
+    # them from concrete leaves (or to the conservative full row sets).
+    jac_falloff_rows: tuple = dataclasses.field(
+        default=None, metadata={"static": True})   # rows w/ falloff blending
+    jac_tb_rows: tuple = dataclasses.field(
+        default=None, metadata={"static": True})   # rows w/ any third body
+    jac_active_species: tuple = dataclasses.field(
+        default=None, metadata={"static": True})   # cols w/ any nu/ord entry
+    nu_nnz_frac: float = dataclasses.field(
+        default=None, metadata={"static": True})   # nnz(nu)/size(nu)
+
     # ---- transport ----------------------------------------------------------
     geom: Any = None       # [KK] int: 0 atom / 1 linear / 2 nonlinear
     eps_k: Any = None      # [KK] LJ well depth / kB, K
@@ -172,3 +188,30 @@ class MechanismRecord:
         (reference: reactormodel.py:1440)."""
         A = np.asarray(self.A) * np.asarray(multipliers)
         return dataclasses.replace(self, A=A)
+
+
+def jac_sparsity_fields(nu_f, nu_r, order_f, order_r, tb_type,
+                        falloff_type) -> dict:
+    """Static Jacobian-sparsity metadata from concrete stoichiometry
+    arrays — computed once at Mechanism build time (parser) or lazily by
+    ``ops/jacobian.py`` for hand-built records.
+
+    Returns the four ``jac_*``/``nu_nnz_frac`` record fields: compact
+    index sets (CSR-style row/column subsets) the analytical Jacobian
+    uses to skip padding work where ``nu`` rows are empty, plus the
+    sparsity stats telemetry reports per mechanism."""
+    nu_f = np.asarray(nu_f)
+    nu_r = np.asarray(nu_r)
+    nu = nu_r - nu_f
+    order_f = nu_f if order_f is None else np.asarray(order_f)
+    order_r = nu_r if order_r is None else np.asarray(order_r)
+    falloff = np.asarray(falloff_type) != FALLOFF_NONE
+    third_body = (np.asarray(tb_type) != TB_NONE) | falloff
+    active = (nu != 0).any(axis=0) | (order_f != 0).any(axis=0) \
+        | (order_r != 0).any(axis=0)
+    return dict(
+        jac_falloff_rows=tuple(np.where(falloff)[0].tolist()),
+        jac_tb_rows=tuple(np.where(third_body)[0].tolist()),
+        jac_active_species=tuple(np.where(active)[0].tolist()),
+        nu_nnz_frac=round(float(np.count_nonzero(nu)) / max(nu.size, 1), 4),
+    )

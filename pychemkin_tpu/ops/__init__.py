@@ -6,6 +6,7 @@ reference's native Chemkin-CFD-API blocks (SURVEY.md §2.2):
 - :mod:`.kinetics`     reaction rates / ROP (the hot kernel)
 - :mod:`.equilibrium`  element-potential Gibbs minimization + CJ
 - :mod:`.odeint`       SDIRK3 stiff integrator (vmap-able)
+- :mod:`.jacobian`     analytical sparse kinetics Jacobian assembly
 - :mod:`.reactors`     0-D batch-reactor RHS + batched solves
 - :mod:`.psr`          steady-state PSR Newton/pseudo-transient
 - :mod:`.pfr`          plug-flow axial integration
@@ -18,6 +19,7 @@ from . import (
     blocktridiag,
     equilibrium,
     flame1d,
+    jacobian,
     kinetics,
     linalg,
     odeint,
@@ -33,6 +35,7 @@ __all__ = [
     "blocktridiag",
     "equilibrium",
     "flame1d",
+    "jacobian",
     "kinetics",
     "linalg",
     "odeint",

@@ -1,0 +1,632 @@
+"""Analytical sparse kinetics Jacobian — retires dense-AD from the stiff
+hot path.
+
+The captured step-cost ablation (``STEP_COST_grisyn.json``) showed
+Jacobian assembly via ``jax.jacfwd`` at ~56% of every Newton attempt on
+GRI-scale chemistry: forward-mode AD pushes KK+1 tangents through the
+whole kinetics graph — every ``exp``/``log``/falloff transcendental and
+every [II, KK] stoichiometry matmul is re-evaluated tangent-wide. But
+the Jacobian of mass-action kinetics is CLOSED FORM in quantities one
+rate-of-progress evaluation already produces (pyJac, arXiv:1605.03262;
+Pyrometheus, arXiv:2503.24286):
+
+    dq_i/dC_k = tb_i * (qf_i * ord_f[i,k] - qr_i * ord_r[i,k]) / C_k
+              + third-body / falloff / PLOG correction terms
+
+so ``dwdot/dC = nu^T @ dq/dC`` contracts through ONE [KK, II] x
+[II, KK] matmul (MXU-native on TPU) instead of KK forward-mode tangents
+through the kinetics graph. The only non-trivial scalar derivatives —
+the falloff blend's dk/dT and dk/d[M] — are taken by a 2-wide ``jvp``
+over the COMPACT falloff-row subset (``mech.jac_falloff_rows``,
+precomputed at parse time), so the broadening transcendentals are
+differentiated once over ~IIf rows, not KK-wide over all II.
+
+Three consumers:
+
+- :func:`batch_rhs_jacobian` — fully closed-form d(rhs)/d(y) for the
+  four 0-D batch-reactor RHS variants; the default ``jac=`` of
+  ``odeint`` via ``reactors.solve_batch`` (the stiff hot path).
+- :func:`net_production_rates_analytic` — a ``custom_jvp`` wrapper whose
+  tangent rule is the closed form; ``kinetics.analytic_jacobian()``
+  routes every ``net_production_rates`` call traced in the block through
+  it, so a ``jax.jacfwd`` over ANY RHS (the PSR residual, PSR chains)
+  contracts the analytical core while AD handles only the cheap shell.
+- ``tools/ablate_step_cost.py`` — measures both against the AD path.
+
+``jax.jacfwd`` of the full RHS remains the ``f64_jac`` rescue-ladder
+rung and the property-test oracle (``tests/test_jacobian.py``): the
+analytical path must agree with it to f64 tightness on every reaction
+type, clamps included.
+
+Clamp semantics: every ``_safe_exp``/floor in the kinetics kernel has a
+zero-derivative region; the closed form reproduces AD's behavior with
+explicit indicator factors (derivative 0 outside the clamp window), so
+agreement with ``jacfwd`` holds in the clamp regions too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.custom_derivatives import SymbolicZero
+
+from ..constants import R_GAS
+from ..mechanism.record import (
+    FALLOFF_NONE,
+    TB_MIXTURE,
+    jac_sparsity_fields,
+)
+from . import kinetics, linalg, thermo
+from .kinetics import _TINY, _arrhenius, _safe_exp
+from .odeint import _cast_floats
+
+__all__ = [
+    "KineticsDerivatives",
+    "batch_rhs_jacobian",
+    "kinetics_derivatives",
+    "net_production_rates_analytic",
+    "sparsity_stats",
+]
+
+
+# ---------------------------------------------------------------------------
+# sparsity metadata
+
+def _sparsity(mech):
+    """(falloff_rows, tb_rows, active_species, nu_nnz_frac) as numpy index
+    arrays — from the record's parse-time static fields when present,
+    recomputed from concrete leaves otherwise, conservative full sets
+    when the record itself is traced."""
+    if getattr(mech, "jac_falloff_rows", None) is not None:
+        return (np.asarray(mech.jac_falloff_rows, dtype=np.int64),
+                np.asarray(mech.jac_tb_rows, dtype=np.int64),
+                np.asarray(mech.jac_active_species, dtype=np.int64),
+                mech.nu_nnz_frac)
+    try:
+        f = jac_sparsity_fields(mech.nu_f, mech.nu_r, mech.order_f,
+                                mech.order_r, mech.tb_type,
+                                mech.falloff_type)
+        return (np.asarray(f["jac_falloff_rows"], dtype=np.int64),
+                np.asarray(f["jac_tb_rows"], dtype=np.int64),
+                np.asarray(f["jac_active_species"], dtype=np.int64),
+                f["nu_nnz_frac"])
+    except jax.errors.TracerArrayConversionError:
+        II = mech.n_reactions
+        KK = mech.n_species
+        full = np.arange(II)
+        return full, full, np.arange(KK), None
+
+
+class _StoichCOO(NamedTuple):
+    """COO triple-product index set of the hot-path contraction
+    ``dwdot/dC[ko, ki] = sum_i nu[i, ko] * (qf_i ord_f[i, ki]
+    - qr_i ord_r[i, ki]) / C_ki``: one entry per structurally nonzero
+    (reaction i, product species ko, reactant species ki) triple, with
+    the static coefficients ``nu * ord`` folded in. GRI-scale ``nu`` is
+    ~94% zeros, so the entry count (~4k for grisyn) is ~200x below the
+    dense contraction's flop count — a gather + segment-sum instead of
+    a [KK, II] x [II, KK] matmul."""
+    rxn: Any    # [E] int32: reaction index i of each entry
+    seg: Any    # [E] int32: flattened output index ko*KK + ki, SORTED
+    cf: Any     # [E] float: nu[i, ko] * ord_f[i, ki]
+    cr: Any     # [E] float: nu[i, ko] * ord_r[i, ki]
+
+
+def _stoich_coo(mech):
+    """Build the COO entry set from concrete stoichiometry leaves.
+
+    Trace-time numpy on the record's arrays: ``None`` when the record is
+    itself traced (dense-matmul fallback) or on TPU, where the MXU
+    matmul beats gather/scatter and the dense contraction stays the
+    right mapping. Rebuilt per trace (a few ms of host work, amortized
+    by the jit cache)."""
+    if jax.default_backend() == "tpu":
+        return None
+    try:
+        nu_f = np.asarray(mech.nu_f)
+        nu_r = np.asarray(mech.nu_r)
+        ord_f = np.asarray(mech.order_f if mech.order_f is not None
+                           else mech.nu_f)
+        ord_r = np.asarray(mech.order_r if mech.order_r is not None
+                           else mech.nu_r)
+    except jax.errors.TracerArrayConversionError:
+        return None
+    nu = nu_r - nu_f
+    KK = nu.shape[1]
+    rxn, seg, cf, cr = [], [], [], []
+    for i in range(nu.shape[0]):
+        kos = np.nonzero(nu[i])[0]
+        kis = np.nonzero((ord_f[i] != 0) | (ord_r[i] != 0))[0]
+        if not kos.size or not kis.size:
+            continue                      # padding row: skipped entirely
+        ko_g, ki_g = np.meshgrid(kos, kis, indexing="ij")
+        rxn.append(np.full(ko_g.size, i))
+        seg.append((ko_g * KK + ki_g).ravel())
+        cf.append((nu[i, ko_g] * ord_f[i, ki_g]).ravel())
+        cr.append((nu[i, ko_g] * ord_r[i, ki_g]).ravel())
+    if not rxn:
+        return None                           # degenerate: no entries
+    rxn = np.concatenate(rxn)
+    seg = np.concatenate(seg)
+    cf = np.concatenate(cf).astype(np.float64)
+    cr = np.concatenate(cr).astype(np.float64)
+    order = np.argsort(seg, kind="stable")  # sorted segments: faster sum
+    return _StoichCOO(rxn=jnp.asarray(rxn[order], dtype=jnp.int32),
+                      seg=jnp.asarray(seg[order], dtype=jnp.int32),
+                      cf=jnp.asarray(cf[order]),
+                      cr=jnp.asarray(cr[order]))
+
+
+def sparsity_stats(mech) -> dict:
+    """Mechanism sparsity summary for telemetry/bench artifacts:
+    ``nu_nnz_frac`` (fraction of nonzero stoichiometric entries) and
+    ``n_species_active`` (species appearing in at least one reaction),
+    plus the compact-correction row counts the analytical Jacobian
+    exploits."""
+    falloff_rows, tb_rows, active, nnz = _sparsity(mech)
+    return {
+        "nu_nnz_frac": nnz,
+        "n_species_active": int(active.size),
+        "n_falloff_rows": int(falloff_rows.size),
+        "n_third_body_rows": int(tb_rows.size),
+    }
+
+
+# ---------------------------------------------------------------------------
+# closed-form rate-constant derivatives
+
+def _clip_ind(x, lo=-kinetics._EXP_CLIP, hi=kinetics._EXP_CLIP):
+    """Derivative indicator of ``jnp.clip(x, lo, hi)`` (1 inside, 0 in
+    the clamped regions) — the closed-form mirror of what AD propagates
+    through ``_safe_exp`` (same bounds by construction)."""
+    return ((x > lo) & (x < hi)).astype(x.dtype)
+
+
+def _arrhenius_dT(A, beta, Ea_R, T, lnT, k):
+    """d/dT of :func:`kinetics._arrhenius` given its value ``k``:
+    k * (beta/T + Ea_R/T^2), gated by the _safe_exp clamp indicator."""
+    arg = jnp.log(jnp.maximum(jnp.abs(A), _TINY)) + beta * lnT - Ea_R / T
+    return k * (beta / T + Ea_R / (T * T)) * _clip_ind(arg)
+
+
+def _dln_kc_dT(mech, T):
+    """d(ln Kc)/dT [II] — exact NASA-7 identity: d(g/RT)/dT = -h/(RT^2)
+    termwise, so d(ln Kc)/dT = (nu @ h_RT - dnu) / T."""
+    nu = mech.nu_r - mech.nu_f
+    h = thermo.h_RT(mech, T)
+    return (nu @ h - nu.sum(axis=1)) / T
+
+
+class _RateConstDerivs(NamedTuple):
+    """d(kf)/dx and d(kr)/dx for x in (T, M, P), [II] each. The M
+    derivative is the DIAGONAL d(k_i)/d(M_i) (k_i depends on no other
+    row's third-body concentration); P derivatives are zero except on
+    PLOG rows."""
+    dkf_dT: Any
+    dkf_dM: Any
+    dkf_dP: Any
+    dkr_dT: Any
+    dkr_dM: Any
+    dkr_dP: Any
+
+
+def _rate_constant_derivatives(mech, T, M, kf, P) -> _RateConstDerivs:
+    """Closed-form/compact-jvp derivatives of (kf, kr) wrt (T, M, P),
+    mirroring ``forward_rate_constants_TM`` + ``reverse_rate_constants``
+    branch by branch.
+
+    Plain-Arrhenius and equilibrium (ln Kc) derivatives are fully closed
+    form. The falloff blend — the one genuinely gnarly scalar graph
+    (Troe/SRI broadening) — is differentiated by a 2-wide ``jax.jacfwd``
+    over the compact falloff-row subset only (``mech.jac_falloff_rows``):
+    exact (the AD derivative of the very same formula, clamps included)
+    at the cost of ~2 extra evaluations of IIf rows instead of KK
+    tangents through all II rows. PLOG rows get the same treatment over
+    (T, P)."""
+    lnT = jnp.log(T)
+    dtype = kf.dtype
+    zero = jnp.zeros_like(kf)
+
+    # --- forward: plain Arrhenius everywhere first ---
+    k_inf = _arrhenius(mech.A, mech.beta, mech.Ea_R, T, lnT)
+    dkf_dT = _arrhenius_dT(mech.A, mech.beta, mech.Ea_R, T, lnT, k_inf)
+    dkf_dM = zero
+    dkf_dP = zero
+
+    falloff_rows, _, _, _ = _sparsity(mech)
+    if kinetics.has_falloff(mech) and falloff_rows.size:
+        rows = falloff_rows
+        A_s, b_s, E_s = mech.A[rows], mech.beta[rows], mech.Ea_R[rows]
+        lA_s, lb_s, lE_s = (mech.low_A[rows], mech.low_beta[rows],
+                            mech.low_Ea_R[rows])
+        ft_s, ica_s = mech.falloff_type[rows], mech.is_chem_act[rows]
+        troe_s, sri_s = mech.troe[rows], mech.sri[rows]
+        M_s0 = M[rows]
+
+        def kf_sub(s):
+            T_s = T + s[0]
+            lnT_s = jnp.log(T_s)
+            ki = _arrhenius(A_s, b_s, E_s, T_s, lnT_s)
+            k0 = _arrhenius(lA_s, lb_s, lE_s, T_s, lnT_s)
+            return kinetics.falloff_blend(T_s, lnT_s, M_s0 + s[1], ki, k0,
+                                          ft_s, ica_s, troe_s, sri_s)
+
+        dsub = jax.jacfwd(kf_sub)(jnp.zeros(2, dtype=dtype))  # [IIf, 2]
+        # gate on each row's own falloff flag, mirroring the primal's
+        # jnp.where(falloff_type != FALLOFF_NONE, blend, k_inf): on the
+        # conservative traced-record fallback `rows` spans ALL reactions
+        # and a non-falloff row's blend derivative (built from low_A
+        # padding) must not replace its plain-Arrhenius dk/dT
+        is_fo = ft_s != FALLOFF_NONE
+        dkf_dT = dkf_dT.at[rows].set(
+            jnp.where(is_fo, dsub[:, 0], dkf_dT[rows]))
+        dkf_dM = dkf_dM.at[rows].set(jnp.where(is_fo, dsub[:, 1], 0.0))
+
+    if mech.plog_idx.shape[0] > 0:
+        pidx = mech.plog_idx
+
+        def plog_packed(s):
+            T_s = T + s[0]
+            return kinetics._plog_rate(mech, T_s, jnp.log(T_s),
+                                       jnp.log(P + s[1]))
+
+        dpl = jax.jacfwd(plog_packed)(jnp.zeros(2, dtype=dtype))  # [IIp, 2]
+        dkf_dT = dkf_dT.at[pidx].set(dpl[:, 0])
+        dkf_dM = dkf_dM.at[pidx].set(0.0)
+        dkf_dP = dkf_dP.at[pidx].set(dpl[:, 1])
+
+    # --- reverse: thermo path kr = safe_exp(ln(max(kf,tiny)) - ln Kc),
+    # explicit-REV rows are plain Arrhenius, irreversible rows are 0 ---
+    ln_Kc = kinetics.ln_equilibrium_constants(mech, T)
+    dln_kc = _dln_kc_dT(mech, T)
+    kf_c = jnp.maximum(kf, _TINY)
+    i_kf = (kf > _TINY).astype(dtype)
+    ln_kr = jnp.log(kf_c) - ln_Kc
+    kr_th = _safe_exp(ln_kr)
+    cg_kr = _clip_ind(ln_kr) * kr_th          # d(kr_th)/d(ln_kr) folded
+
+    kr_exp = _arrhenius(mech.rev_A, mech.rev_beta, mech.rev_Ea_R, T, lnT)
+    dkr_exp_dT = _arrhenius_dT(mech.rev_A, mech.rev_beta, mech.rev_Ea_R,
+                               T, lnT, kr_exp)
+
+    dth_dT = cg_kr * (i_kf * dkf_dT / kf_c - dln_kc)
+    dth_dM = cg_kr * i_kf * dkf_dM / kf_c
+    dth_dP = cg_kr * i_kf * dkf_dP / kf_c
+    rev = mech.reversible
+    hasr = mech.has_rev_params
+    dkr_dT = jnp.where(rev, jnp.where(hasr, dkr_exp_dT, dth_dT), 0.0)
+    dkr_dM = jnp.where(rev & ~hasr, dth_dM, 0.0)
+    dkr_dP = jnp.where(rev & ~hasr, dth_dP, 0.0)
+
+    return _RateConstDerivs(dkf_dT=dkf_dT, dkf_dM=dkf_dM, dkf_dP=dkf_dP,
+                            dkr_dT=dkr_dT, dkr_dM=dkr_dM, dkr_dP=dkr_dP)
+
+
+class KineticsDerivatives(NamedTuple):
+    """Closed-form kinetics Jacobian core: the net production rates and
+    their exact derivatives wrt concentrations and temperature."""
+    wdot: Any      # [KK] net molar production rates
+    dwdot_dC: Any  # [KK, KK]
+    dwdot_dT: Any  # [KK]
+
+
+def kinetics_derivatives(mech, T, C, P=None) -> KineticsDerivatives:
+    """Analytical (wdot, dwdot/dC, dwdot/dT) at one state.
+
+    ``P`` semantics match :func:`kinetics.net_production_rates`: when
+    None and the mechanism has PLOG rows, P is reconstructed as
+    sum(C) R T — and the reconstruction's dP/dC = R T / dP/dT = sum(C) R
+    chain terms are included, so the result equals ``jacfwd`` of the
+    same call signature.
+
+    Assembly: one elementwise [II, KK] pass builds dq/dC's reaction-row
+    factors (concentration-product term via ord_f/ord_r, third-body and
+    falloff dk/d[M] corrections via tb_eff), then a single
+    [KK, II] @ [II, KK+1] matmul contracts through nu^T — the "two
+    skinny matmuls" (with the dq/dT column riding along) that replace
+    KK forward tangents. wdot itself is the bit-identical
+    ``nu^T @ (qf - qr)`` matvec of the primal kernel."""
+    r = kinetics.rop_intermediates(mech, T, C, P)
+    T = jnp.asarray(T, dtype=r.qf.dtype)
+
+    dk = _rate_constant_derivatives(mech, T, r.M, r.kf, r.P)
+    dkf_dT, dkf_dM, dkf_dP = dk.dkf_dT, dk.dkf_dM, dk.dkf_dP
+    dkr_dT, dkr_dM, dkr_dP = dk.dkr_dT, dk.dkr_dM, dk.dkr_dP
+
+    # --- dq/dC reaction-row factors -------------------------------------
+    cg_f = _clip_ind(r.arg_f)
+    cg_r = _clip_ind(r.arg_r)
+    qf_g = r.qf * cg_f
+    qr_g = r.qr * cg_r
+    dln = jnp.where(C > _TINY, 1.0 / jnp.maximum(C, _TINY), 0.0)
+
+    ord_f = mech.order_f if mech.order_f is not None else mech.nu_f
+    ord_r = mech.order_r if mech.order_r is not None else mech.nu_r
+    plain_tb = (mech.tb_type == TB_MIXTURE) & \
+        (mech.falloff_type == FALLOFF_NONE)
+    _, tb_rows, _, _ = _sparsity(mech)
+    nu = (mech.nu_r - mech.nu_f)
+
+    if tb_rows.size:
+        G = (jnp.where(plain_tb, r.kf * r.prod_f - r.kr * r.prod_r, 0.0)
+             + r.tb_mult * (dkf_dM * r.prod_f - dkr_dM * r.prod_r))
+
+    # dq/dT column rides the main contraction
+    if r.P_from_C:
+        dP_dT = jnp.sum(C) * R_GAS
+        dkf_T_eff = dkf_dT + dkf_dP * dP_dT
+        dkr_T_eff = dkr_dT + dkr_dP * dP_dT
+    else:
+        dkf_T_eff, dkr_T_eff = dkf_dT, dkr_dT
+    dq_dT = r.tb_mult * (dkf_T_eff * r.prod_f - dkr_T_eff * r.prod_r)
+
+    if getattr(mech, "has_order_overrides", False):
+        # order-override mechanisms (global, tiny): fold everything —
+        # d(lnC)/dC columns, the fractional-floor entry patches, and the
+        # third-body corrections — into E before ONE contraction
+        E = (qf_g[:, None] * ord_f - qr_g[:, None] * ord_r) * dln[None, :]
+        if tb_rows.size:
+            E = E + G[:, None] * mech.tb_eff
+        # fractional-FORD/RORD entries use the 1e-16 concentration floor
+        # (see kinetics.rop_intermediates): patch d(lnC)/dC accordingly
+        dln_hi = jnp.where(C > kinetics.FRAC_ORDER_FLOOR,
+                           1.0 / jnp.maximum(C, kinetics.FRAC_ORDER_FLOOR),
+                           0.0)
+        for entries, qg, om in ((mech.ford_frac_entries, qf_g, ord_f),
+                                (mech.rord_frac_entries, -qr_g, ord_r)):
+            if entries:
+                rows = np.array([i for i, _ in entries])
+                cols = np.array([k for _, k in entries])
+                E = E.at[rows, cols].add(
+                    qg[rows] * om[rows, cols]
+                    * (dln_hi[cols] - dln[cols]))
+        E_aug = jnp.concatenate([E, dq_dT[:, None]], axis=1)
+        out = nu.T @ E_aug                    # [KK, KK+1]
+        D = out[:, :-1]
+        w_T = out[:, -1]
+    else:
+        # hot path (integer orders): the d(lnC)/dC factor is a COLUMN
+        # scaling, so it commutes with the nu^T contraction — scale the
+        # [KK, KK] result instead of the [II, KK] operand, and contract
+        # the third-body/falloff corrections over the compact
+        # mech.jac_tb_rows subset only (the CSR-style index set: padding
+        # rows without third bodies contribute nothing and are skipped)
+        KK = C.shape[0]
+        coo = _stoich_coo(mech)
+        if coo is not None:
+            # sparse assembly (CPU): gather qf/qr per structurally
+            # nonzero triple, one sorted segment-sum into [KK, KK] —
+            # ~nnz(nu)*nnz(ord) work instead of the dense contraction
+            vals = (qf_g[coo.rxn] * coo.cf.astype(qf_g.dtype)
+                    - qr_g[coo.rxn] * coo.cr.astype(qf_g.dtype))
+            D = jax.ops.segment_sum(
+                vals, coo.seg, num_segments=KK * KK,
+                indices_are_sorted=True).reshape(KK, KK)
+            D = D * dln[None, :]
+            w_T = nu.T @ dq_dT
+        else:
+            # dense contraction (TPU MXU / traced record): the dq/dT
+            # column rides the same matmul
+            E_aug = jnp.concatenate(
+                [qf_g[:, None] * ord_f - qr_g[:, None] * ord_r,
+                 dq_dT[:, None]], axis=1)
+            out = nu.T @ E_aug                # [KK, KK+1]
+            D = out[:, :-1] * dln[None, :]
+            w_T = out[:, -1]
+        if tb_rows.size:
+            D = D + (nu[tb_rows].T * G[tb_rows][None, :]) @ \
+                mech.tb_eff[tb_rows]
+    if r.P_from_C:
+        # P = sum(C) R T reconstruction: dP/dC_k = R T for every k
+        vP = nu.T @ (r.tb_mult * (dkf_dP * r.prod_f - dkr_dP * r.prod_r))
+        D = D + vP[:, None] * (R_GAS * T)
+    # bit-identical primal (same matvec as net_production_rates)
+    wdot = nu.T @ (r.qf - r.qr)
+    return KineticsDerivatives(wdot=wdot, dwdot_dC=D, dwdot_dT=w_T)
+
+
+# ---------------------------------------------------------------------------
+# custom-JVP production rates: AD shell, analytical core
+
+def net_production_rates_analytic(mech, T, C, P=None):
+    """``kinetics.net_production_rates`` with a closed-form custom-JVP
+    rule: the primal is the bit-identical standard kernel; forward-mode
+    tangents contract through :func:`kinetics_derivatives` instead of
+    differentiating the kinetics graph. Under ``jax.jacfwd`` of an
+    enclosing RHS the core (dwdot/dC, dwdot/dT) is built ONCE and each
+    of the N tangents costs one [KK, KK] matvec — MXU-batched to a
+    single [KK, KK] x [KK, N] matmul."""
+    # every standard-kernel call below suppresses the analytic_jacobian
+    # trace-time flag: with it still set, the call would reroute back
+    # into THIS function and recurse without bound (plain calls inside
+    # the context, and the PLOG dP jvp below, both hit it)
+    if P is None:
+        @jax.custom_jvp
+        def f(T, C):
+            with kinetics.analytic_jacobian(False):
+                return kinetics.net_production_rates(mech, T, C, None)
+
+        @f.defjvp
+        def f_jvp(primals, tangents):
+            T0, C0 = primals
+            dT, dC = tangents
+            d = kinetics_derivatives(mech, T0, C0, None)
+            return d.wdot, d.dwdot_dC @ dC + d.dwdot_dT * dT
+
+        return f(T, C)
+
+    @jax.custom_jvp
+    def g(T, C, P):
+        with kinetics.analytic_jacobian(False):
+            return kinetics.net_production_rates(mech, T, C, P)
+
+    # symbolic_zeros: jacfwd over (T, C) alone — the PSR Newton, where P
+    # is a fixed parameter — hands dP as a SymbolicZero, and the
+    # full-kinetics dP jvp below (the one genuinely expensive term of
+    # this rule) is skipped instead of evaluated and multiplied by zero
+    def g_jvp(primals, tangents):
+        T0, C0, P0 = primals
+        dT, dC, dP = tangents
+        d = kinetics_derivatives(mech, T0, C0, P0)
+        tangent = jnp.zeros_like(d.wdot)
+        if not isinstance(dC, SymbolicZero):
+            tangent = tangent + d.dwdot_dC @ dC
+        if not isinstance(dT, SymbolicZero):
+            tangent = tangent + d.dwdot_dT * dT
+        # dwdot/dP at EXPLICIT P: nonzero only through PLOG rows
+        if mech.plog_idx.shape[0] > 0 and not isinstance(dP, SymbolicZero):
+            eps = jnp.asarray(1.0, dtype=jnp.result_type(P0))
+
+            def wp(p):
+                with kinetics.analytic_jacobian(False):
+                    return kinetics.net_production_rates(mech, T0, C0, p)
+
+            _, w_P = jax.jvp(wp, (P0,), (eps,))
+            tangent = tangent + w_P * dP
+        return d.wdot, tangent
+
+    g.defjvp(g_jvp, symbolic_zeros=True)
+    return g(T, C, P)
+
+
+# ---------------------------------------------------------------------------
+# closed-form batch-reactor RHS Jacobians (the odeint hot path)
+
+
+def _batch_jac_core(problem, energy, t, y, args):
+    """Closed-form d(rhs)/dy for the reactors.py RHS variants — exact
+    chain rule of the corresponding ``conp_/conv_*_rhs`` code path (the
+    derivations mirror the RHS expressions term by term; agreement with
+    ``jacfwd`` is property-tested across all four variants)."""
+    # local import: reactors imports THIS module at top level, so a
+    # module-level import here would be a genuine cycle at package init
+    from . import reactors
+
+    mech = args.mech
+    KK = mech.n_species
+    dtype = y.dtype
+    Y = y[:-1]
+    T_clamped = jnp.maximum(y[-1], reactors.T_FLOOR)
+    # d(T)/d(y[-1]) clamp indicator (same floor as reactors._split)
+    mT = (y[-1] > reactors.T_FLOOR).astype(dtype)
+    wt = mech.wt
+
+    if energy == "TGIV":
+        T, _ = reactors.profile_value_slope(args.tprof, t)
+    else:
+        T = T_clamped
+
+    if problem == "CONP":
+        P, Pdot = reactors.profile_value_slope(args.constraint, t)
+        rho = thermo.density(mech, T, P, Y)
+        P_kin = P
+    else:
+        V, Vdot = reactors.profile_value_slope(args.constraint, t)
+        rho = args.mass / V
+        P_kin = None                      # conv RHS passes no P
+    C = thermo.Y_to_C(mech, Y, rho)
+    d = kinetics_derivatives(mech, T, C, P_kin)
+    wdot, D, w_T = d.wdot, d.dwdot_dC, d.dwdot_dT
+    dYdt = wdot * wt / rho
+
+    if problem == "CONP":
+        # C = rho(T,P,Y) Y / W: dC/dY = diag(rho/W) - C (Wbar/W)^T,
+        # dC/dT = -C/T, drho/dY_j = -rho Wbar/W_j, drho/dT = -rho/T
+        Wbar = thermo.mean_molecular_weight_Y(mech, Y)
+        s = jnp.dot(Y, 1.0 / wt)
+        i_s = (s > 1e-30).astype(dtype)   # mean-MW guard indicator
+        rw = Wbar / wt * i_s              # [KK]: Wbar/W_j
+        DC = D @ C
+        J_YY = (D * (wt[:, None] / wt[None, :])
+                + (dYdt - wt * DC / rho)[:, None] * rw[None, :])
+        dw_dT = w_T - DC / T
+        J_YT = (wt / rho) * dw_dT + dYdt / T
+    else:
+        # C = (mass/V) Y / W: dC/dY diagonal, dC/dT = 0
+        J_YY = D * (wt[:, None] / wt[None, :])
+        J_YT = (wt / rho) * w_T
+        dw_dT = w_T
+
+    if energy == "TGIV":
+        # T rides its profile: rhs[-1] = Tdot(t); no y-dependence, and
+        # the species block does not see y[-1] at all
+        zcol = jnp.zeros((KK + 1,), dtype=dtype)
+        return jnp.concatenate(
+            [jnp.concatenate([J_YY, jnp.zeros((1, KK), dtype=dtype)],
+                             axis=0), zcol[:, None]], axis=1)
+
+    ql, _ = reactors.profile_value_slope(args.qloss, t)
+    ar, _ = reactors.profile_value_slope(args.area, t)
+    q = (-ql + args.htc * ar * (args.tamb - T)) / args.mass
+    dq_dT = -args.htc * ar / args.mass
+
+    if problem == "CONP":
+        cpk = thermo.species_cp_mass(mech, T)
+        cp = jnp.dot(Y, cpk)
+        h = thermo.h_RT(mech, T) * (R_GAS * T)          # molar
+        cp_molar = thermo.cp_R(mech, T) * R_GAS         # dh/dT exactly
+        hD = h @ D
+        hDC = jnp.dot(h, DC)
+        hw = jnp.dot(h, wdot)
+        dTdt = (q + Pdot / rho - hw / rho) / cp
+        dN_dY = (Pdot - hw + hDC) * rw / rho - hD / wt
+        J_TY = dN_dY / cp - dTdt * cpk / cp
+        # d(1/rho)/dT = +1/(rho T) at fixed (P, Y), so the +Pdot/rho and
+        # -hw/rho terms contribute +Pdot/(rho T) and -hw/(rho T)
+        dN_dT = (dq_dT + Pdot / (rho * T)
+                 - (jnp.dot(cp_molar, wdot) + jnp.dot(h, dw_dT)) / rho
+                 - hw / (rho * T))
+        dcp_dT = jnp.dot(Y, thermo.dcp_R_dT(mech, T) * R_GAS / wt)
+        J_TT = (dN_dT - dTdt * dcp_dT) / cp
+    else:
+        cvk = thermo.species_cv_mass(mech, T)
+        cv = jnp.dot(Y, cvk)
+        u = thermo.u_RT(mech, T) * (R_GAS * T)          # molar
+        cv_molar = (thermo.cp_R(mech, T) - 1.0) * R_GAS  # du/dT exactly
+        uD = u @ D
+        uw = jnp.dot(u, wdot)
+        P = thermo.pressure(mech, T, rho, Y)
+        s = jnp.dot(Y, 1.0 / wt)
+        i_s = (s > 1e-30).astype(dtype)
+        dTdt = (q - P * Vdot / args.mass - uw / rho) / cv
+        # dP/dY_j = rho R T / W_j (through 1/Wbar), dP/dT = rho R / Wbar
+        dN_dY = (-(Vdot / args.mass) * rho * R_GAS * T * i_s / wt
+                 - uD / wt)
+        J_TY = dN_dY / cv - dTdt * cvk / cv
+        dP_dT = rho * R_GAS * s * i_s
+        dN_dT = (dq_dT - Vdot / args.mass * dP_dT
+                 - (jnp.dot(cv_molar, wdot) + jnp.dot(u, dw_dT)) / rho)
+        dcv_dT = jnp.dot(Y, thermo.dcp_R_dT(mech, T) * R_GAS / wt)
+        J_TT = (dN_dT - dTdt * dcv_dT) / cv
+
+    top = jnp.concatenate([J_YY, (J_YT * mT)[:, None]], axis=1)
+    bot = jnp.concatenate([J_TY, (J_TT * mT)[None]])[None, :]
+    return jnp.concatenate([top, bot], axis=0)
+
+
+def batch_rhs_jacobian(problem, energy):
+    """Closed-form Jacobian function for one batch-reactor RHS variant:
+    ``jac_fn(t, y, args) -> [N, N]``, drop-in for the ``jac=`` kwarg of
+    :func:`pychemkin_tpu.ops.odeint.odeint` (and the shared factory the
+    serial bench baseline uses).
+
+    Mixed-precision contract matches ``odeint._make_jac_fn``: on TPU the
+    whole assembly runs in f32 (the Jacobian only builds the Newton
+    preconditioner M = I - h*g*J; integration accuracy is set by the
+    f64 residuals), on CPU it is exact f64."""
+    if (problem, energy) not in (("CONP", "ENRG"), ("CONP", "TGIV"),
+                                 ("CONV", "ENRG"), ("CONV", "TGIV")):
+        raise ValueError(f"unknown RHS variant {(problem, energy)!r}")
+
+    def jac_fn(t, y, args):
+        if linalg.use_mixed_precision():
+            args32 = _cast_floats(args, jnp.float32)
+            return _batch_jac_core(problem, energy,
+                                   jnp.asarray(t, jnp.float32),
+                                   y.astype(jnp.float32), args32)
+        return _batch_jac_core(problem, energy, t, y, args)
+
+    return jac_fn

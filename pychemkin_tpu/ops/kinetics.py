@@ -22,6 +22,10 @@ mol/(cm^3 s), activation temperatures K).
 
 from __future__ import annotations
 
+import contextlib
+import threading
+from typing import Any, NamedTuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -43,6 +47,10 @@ _LN10 = 2.302585092994046
 # RANGE. Values below ~1e-38 flush to zero and exp() underflows at ~-88.
 # Every floor/clamp here is chosen to stay inside that range.
 _TINY = 1e-30
+#: _safe_exp's clip window; the analytical Jacobian's closed-form clamp
+#: indicators (ops/jacobian.py:_clip_ind) must gate on the SAME bounds
+#: or they diverge from AD exactly in the clamp regions
+_EXP_CLIP = 85.0
 
 
 def _safe_exp(x):
@@ -52,7 +60,7 @@ def _safe_exp(x):
     returns NaN rather than 0/inf (double-single range overflow inside the
     exp algorithm), and those NaNs poison reverse-mode AD even through
     jnp.where. exp(±85) ~ 1e∓37 is already numerical zero/saturation."""
-    return jnp.exp(jnp.clip(x, -85.0, 85.0))
+    return jnp.exp(jnp.clip(x, -_EXP_CLIP, _EXP_CLIP))
 
 
 def _arrhenius(A, beta, Ea_R, T, lnT):
@@ -100,6 +108,89 @@ def third_body_concentrations(mech, C):
     return mech.tb_eff @ C
 
 
+def has_falloff(mech) -> bool:
+    """Static structure decision: does the falloff branch exist at all?
+
+    numpy on concrete record leaves; if the record is itself traced,
+    conservatively include the branch."""
+    try:
+        return bool(np.any(np.asarray(mech.falloff_type) != FALLOFF_NONE))
+    except jax.errors.TracerArrayConversionError:
+        return True
+
+
+def falloff_blend(T, lnT, M, k_inf, k0, ftype, is_chem_act, troe, sri):
+    """Blended falloff rate constant for rows carrying LOW/HIGH data.
+
+    Shared between the full-mechanism kernel (masked over all II rows)
+    and the analytical-Jacobian module, which evaluates and
+    differentiates it on the compact falloff-row subset only. All
+    arguments are arrays over the SAME row set (full or compact)."""
+    Pr = jnp.maximum(k0 * M / jnp.maximum(k_inf, _TINY), 1e-35)
+    log10_Pr = jnp.log(Pr) / _LN10
+
+    # Troe broadening factor. T2* = inf marks the absent 4th parameter;
+    # compute exp on a sanitized finite value and mask, so reverse-mode
+    # AD never sees 0 * inf (the jnp.where NaN-gradient trap).
+    a, T3, T1, T2 = troe[:, 0], troe[:, 1], troe[:, 2], troe[:, 3]
+    has_T2 = jnp.isfinite(T2)
+    T2_safe = jnp.where(has_T2, T2, 0.0)
+    term_T2 = jnp.where(has_T2, _safe_exp(-T2_safe / T), 0.0)
+    Fcent = ((1.0 - a) * _safe_exp(-T / jnp.maximum(T3, 1e-30))
+             + a * _safe_exp(-T / jnp.maximum(T1, 1e-30))
+             + term_T2)
+    Fcent = jnp.maximum(Fcent, 1e-30)
+    log10_Fc = jnp.log(Fcent) / _LN10
+    c_t = -0.4 - 0.67 * log10_Fc
+    n_t = 0.75 - 1.27 * log10_Fc
+    f1 = (log10_Pr + c_t) / (n_t - 0.14 * (log10_Pr + c_t))
+    log10_F_troe = log10_Fc / (1.0 + f1 * f1)
+    F_troe = _safe_exp(_LN10 * log10_F_troe)
+
+    # SRI broadening factor
+    sa, sb, sc, sd, se = sri[:, 0], sri[:, 1], sri[:, 2], sri[:, 3], sri[:, 4]
+    x_sri = 1.0 / (1.0 + log10_Pr * log10_Pr)
+    base = jnp.maximum(sa * _safe_exp(-sb / T)
+                       + _safe_exp(-T / jnp.maximum(sc, 1e-30)), _TINY)
+    F_sri = sd * _safe_exp(x_sri * jnp.log(base)) * _safe_exp(se * lnT)
+
+    F = jnp.where(ftype == FALLOFF_TROE, F_troe,
+                  jnp.where(ftype == FALLOFF_SRI, F_sri, 1.0))
+    # fall-off (LOW given): kinf * Pr/(1+Pr) * F
+    # chemically activated (HIGH given): k_low * 1/(1+Pr) * F
+    # — broadening F composes with both forms
+    blend = jnp.where(is_chem_act,
+                      k0 / (1.0 + Pr),
+                      k_inf * Pr / (1.0 + Pr))
+    return blend * F
+
+
+def forward_rate_constants_TM(mech, T, M, P=None):
+    """Forward rate constants kf [II] from (T, third-body concentrations M,
+    pressure P) — the (T, M, P)-parameterized core of
+    :func:`forward_rate_constants`, shared with the analytical-Jacobian
+    module (``ops/jacobian.py``), whose rate-constant derivatives are
+    taken with respect to exactly these three quantities.
+
+    ``P`` is required here whenever the mechanism has PLOG reactions
+    (the caller owns the ideal-gas reconstruction from C)."""
+    lnT = jnp.log(T)
+    k_inf = _arrhenius(mech.A, mech.beta, mech.Ea_R, T, lnT)
+
+    if has_falloff(mech):
+        k0 = _arrhenius(mech.low_A, mech.low_beta, mech.low_Ea_R, T, lnT)
+        blend = falloff_blend(T, lnT, M, k_inf, k0, mech.falloff_type,
+                              mech.is_chem_act, mech.troe, mech.sri)
+        kf = jnp.where(mech.falloff_type != FALLOFF_NONE, blend, k_inf)
+    else:
+        kf = k_inf
+
+    if mech.plog_idx.shape[0] > 0:
+        k_plog = _plog_rate(mech, T, lnT, jnp.log(P))
+        kf = kf.at[mech.plog_idx].set(k_plog)
+    return kf
+
+
 def forward_rate_constants(mech, T, C, P=None):
     """Forward rate constants kf [II], including third-body falloff blending
     and PLOG pressure interpolation.
@@ -107,68 +198,10 @@ def forward_rate_constants(mech, T, C, P=None):
     ``P`` (dyne/cm^2) is only needed when the mechanism has PLOG reactions;
     if omitted it is reconstructed from C and T by the ideal-gas law.
     """
-    lnT = jnp.log(T)
-    k_inf = _arrhenius(mech.A, mech.beta, mech.Ea_R, T, lnT)
-
-    ftype = mech.falloff_type
-    # static structure decision: skip the whole falloff branch when the
-    # mechanism has none (numpy on concrete record leaves; if the record is
-    # itself traced, conservatively include the branch)
-    try:
-        any_falloff = bool(np.any(np.asarray(mech.falloff_type) != FALLOFF_NONE))
-    except jax.errors.TracerArrayConversionError:
-        any_falloff = True
-    if any_falloff:
-        k0 = _arrhenius(mech.low_A, mech.low_beta, mech.low_Ea_R, T, lnT)
-        M = third_body_concentrations(mech, C)
-        Pr = jnp.maximum(k0 * M / jnp.maximum(k_inf, _TINY), 1e-35)
-        log10_Pr = jnp.log(Pr) / _LN10
-
-        # Troe broadening factor. T2* = inf marks the absent 4th parameter;
-        # compute exp on a sanitized finite value and mask, so reverse-mode
-        # AD never sees 0 * inf (the jnp.where NaN-gradient trap).
-        a, T3, T1, T2 = (mech.troe[:, 0], mech.troe[:, 1],
-                         mech.troe[:, 2], mech.troe[:, 3])
-        has_T2 = jnp.isfinite(T2)
-        T2_safe = jnp.where(has_T2, T2, 0.0)
-        term_T2 = jnp.where(has_T2, _safe_exp(-T2_safe / T), 0.0)
-        Fcent = ((1.0 - a) * _safe_exp(-T / jnp.maximum(T3, 1e-30))
-                 + a * _safe_exp(-T / jnp.maximum(T1, 1e-30))
-                 + term_T2)
-        Fcent = jnp.maximum(Fcent, 1e-30)
-        log10_Fc = jnp.log(Fcent) / _LN10
-        c_t = -0.4 - 0.67 * log10_Fc
-        n_t = 0.75 - 1.27 * log10_Fc
-        f1 = (log10_Pr + c_t) / (n_t - 0.14 * (log10_Pr + c_t))
-        log10_F_troe = log10_Fc / (1.0 + f1 * f1)
-        F_troe = _safe_exp(_LN10 * log10_F_troe)
-
-        # SRI broadening factor
-        sa, sb, sc, sd, se = (mech.sri[:, 0], mech.sri[:, 1], mech.sri[:, 2],
-                              mech.sri[:, 3], mech.sri[:, 4])
-        x_sri = 1.0 / (1.0 + log10_Pr * log10_Pr)
-        base = jnp.maximum(sa * _safe_exp(-sb / T)
-                           + _safe_exp(-T / jnp.maximum(sc, 1e-30)), _TINY)
-        F_sri = sd * _safe_exp(x_sri * jnp.log(base)) * _safe_exp(se * lnT)
-
-        F = jnp.where(ftype == FALLOFF_TROE, F_troe,
-                      jnp.where(ftype == FALLOFF_SRI, F_sri, 1.0))
-        # fall-off (LOW given): kinf * Pr/(1+Pr) * F
-        # chemically activated (HIGH given): k_low * 1/(1+Pr) * F
-        # — broadening F composes with both forms
-        blend = jnp.where(mech.is_chem_act,
-                          k0 / (1.0 + Pr),
-                          k_inf * Pr / (1.0 + Pr))
-        kf = jnp.where(ftype != FALLOFF_NONE, blend * F, k_inf)
-    else:
-        kf = k_inf
-
-    if mech.plog_idx.shape[0] > 0:
-        if P is None:
-            P = jnp.sum(C) * R_GAS * T
-        k_plog = _plog_rate(mech, T, lnT, jnp.log(P))
-        kf = kf.at[mech.plog_idx].set(k_plog)
-    return kf
+    M = third_body_concentrations(mech, C)
+    if mech.plog_idx.shape[0] > 0 and P is None:
+        P = jnp.sum(C) * R_GAS * T
+    return forward_rate_constants_TM(mech, T, M, P)
 
 
 def ln_equilibrium_constants(mech, T):
@@ -208,17 +241,35 @@ def reverse_rate_constants(mech, T, kf):
     return jnp.where(mech.reversible, kr, 0.0)
 
 
-def rates_of_progress(mech, T, C, P=None):
-    """Net rate of progress q [II] in mol/(cm^3 s), plus (qf, qr).
+#: the fractional-order concentration floor (mol/cm^3): entries carrying
+#: a FRACTIONAL FORD/RORD override use this floor instead of _TINY so
+#: their C -> 0 derivative stays bounded (see rop_intermediates)
+FRAC_ORDER_FLOOR = 1e-16
 
-    q_i = [M]_i^(tb) * (kf_i prod_k C_k^nu'_ki - kr_i prod_k C_k^nu''_ki)
-    with the [M] multiplier applied only to non-falloff +M reactions.
-    """
-    kf = forward_rate_constants(mech, T, C, P)
-    kr = reverse_rate_constants(mech, T, kf)
-    lnC = jnp.log(jnp.maximum(C, _TINY))
-    # MXU-friendly concentration products; FORD/RORD overrides live in
-    # order_f/order_r (== nu_f/nu_r except on global-mechanism rows)
+
+class RopIntermediates(NamedTuple):
+    """Every intermediate of one rate-of-progress evaluation — the raw
+    material the analytical Jacobian (``ops/jacobian.py``) assembles
+    dq/d(T, C) from without re-deriving any of it through AD tangents.
+    All arrays are [II] unless noted."""
+    kf: Any          # forward rate constants
+    kr: Any          # reverse rate constants (0 for irreversible)
+    M: Any           # third-body concentrations (tb_eff @ C)
+    tb_mult: Any     # plain +M multiplier (M on non-falloff +M rows, else 1)
+    prod_f: Any      # forward concentration products (post-clamp)
+    prod_r: Any      # reverse concentration products
+    arg_f: Any       # pre-clip exponent of prod_f (ord_f @ lnC [+ floors])
+    arg_r: Any       # pre-clip exponent of prod_r
+    qf: Any          # tb_mult * kf * prod_f
+    qr: Any          # tb_mult * kr * prod_r
+    lnC: Any         # [KK] log(max(C, _TINY))
+    P: Any           # scalar pressure the rate constants actually used
+    P_from_C: bool   # True when P was reconstructed as sum(C) R T
+
+
+def _conc_product_args(mech, C, lnC):
+    """Pre-clip exponents (arg_f, arg_r) of the concentration products,
+    including the fractional-FORD/RORD floor corrections."""
     ord_f = mech.order_f if mech.order_f is not None else mech.nu_f
     ord_r = mech.order_r if mech.order_r is not None else mech.nu_r
     # structure choice from STATIC record metadata (parse-time facts),
@@ -233,33 +284,101 @@ def rates_of_progress(mech, T, C, P=None):
         # every reaction keeps the MXU-friendly ord @ lnC path;
         # integer-order entries keep the exact tiny floor so absent
         # species still shut their reactions off completely.
-        lnC_hi = jnp.log(jnp.maximum(C, 1e-16))
+        lnC_hi = jnp.log(jnp.maximum(C, FRAC_ORDER_FLOOR))
 
         def _with_floor(ord_mat, entries):
             base = ord_mat @ lnC
             if not entries:
-                return _safe_exp(base)
+                return base
             rows = np.array([i for i, _ in entries])
             cols = np.array([k for _, k in entries])
             delta = jnp.zeros(base.shape, base.dtype).at[rows].add(
                 ord_mat[rows, cols] * (lnC_hi[cols] - lnC[cols]))
-            return _safe_exp(base + delta)
+            return base + delta
 
-        prod_f = _with_floor(ord_f, mech.ford_frac_entries)
-        prod_r = _with_floor(ord_r, mech.rord_frac_entries)
+        arg_f = _with_floor(ord_f, mech.ford_frac_entries)
+        arg_r = _with_floor(ord_r, mech.rord_frac_entries)
     else:
-        prod_f = _safe_exp(ord_f @ lnC)
-        prod_r = _safe_exp(ord_r @ lnC)
-    qf = kf * prod_f
-    qr = kr * prod_r
-    plain_tb = (mech.tb_type == TB_MIXTURE) & (mech.falloff_type == FALLOFF_NONE)
+        arg_f = ord_f @ lnC
+        arg_r = ord_r @ lnC
+    return arg_f, arg_r
+
+
+def rop_intermediates(mech, T, C, P=None) -> RopIntermediates:
+    """One rate-of-progress evaluation with every intermediate exposed.
+
+    This is THE primal kinetics computation: :func:`rates_of_progress`
+    is a thin wrapper, and the analytical Jacobian assembles
+    dq/d(T, C) from these quantities in closed form instead of pushing
+    KK forward-mode tangents through this graph."""
     M = third_body_concentrations(mech, C)
+    P_from_C = P is None and mech.plog_idx.shape[0] > 0
+    if P_from_C:
+        P = jnp.sum(C) * R_GAS * T
+    kf = forward_rate_constants_TM(mech, T, M, P)
+    kr = reverse_rate_constants(mech, T, kf)
+    lnC = jnp.log(jnp.maximum(C, _TINY))
+    # MXU-friendly concentration products; FORD/RORD overrides live in
+    # order_f/order_r (== nu_f/nu_r except on global-mechanism rows)
+    arg_f, arg_r = _conc_product_args(mech, C, lnC)
+    prod_f = _safe_exp(arg_f)
+    prod_r = _safe_exp(arg_r)
+    plain_tb = (mech.tb_type == TB_MIXTURE) & (mech.falloff_type == FALLOFF_NONE)
     tb_mult = jnp.where(plain_tb, M, 1.0)
-    return tb_mult * (qf - qr), tb_mult * qf, tb_mult * qr
+    return RopIntermediates(
+        kf=kf, kr=kr, M=M, tb_mult=tb_mult,
+        prod_f=prod_f, prod_r=prod_r, arg_f=arg_f, arg_r=arg_r,
+        qf=tb_mult * kf * prod_f, qr=tb_mult * kr * prod_r,
+        lnC=lnC, P=P, P_from_C=P_from_C)
+
+
+def rates_of_progress(mech, T, C, P=None):
+    """Net rate of progress q [II] in mol/(cm^3 s), plus (qf, qr).
+
+    q_i = [M]_i^(tb) * (kf_i prod_k C_k^nu'_ki - kr_i prod_k C_k^nu''_ki)
+    with the [M] multiplier applied only to non-falloff +M reactions.
+    """
+    r = rop_intermediates(mech, T, C, P)
+    return r.qf - r.qr, r.qf, r.qr
+
+
+class _AnalyticJVPState(threading.local):
+    """Trace-time flag stack (see :func:`analytic_jacobian`): when the
+    top is True, every net_production_rates call traced on THIS thread
+    carries the closed-form custom-JVP rule of ops/jacobian.py, so a
+    ``jax.jacfwd`` over ANY RHS built on it contracts the analytical
+    dq/d(T,C) instead of differentiating through this module's graph.
+    Thread-local because the serve layer traces/compiles concurrently
+    (worker, rescue, and solve_direct threads): one thread's analytic
+    window must not reroute — or un-suppress — another thread's trace."""
+
+    def __init__(self):
+        self.stack = [False]
+
+
+_ANALYTIC_JVP = _AnalyticJVPState()
+
+
+@contextlib.contextmanager
+def analytic_jacobian(on: bool = True):
+    """Trace-time context: net_production_rates calls traced inside the
+    block use the analytical-Jacobian custom-JVP rule
+    (:func:`pychemkin_tpu.ops.jacobian.net_production_rates_analytic`).
+    Primal values are identical; only derivative PROPAGATION changes —
+    ``jax.jacfwd`` of an enclosing RHS then costs two skinny matmuls
+    instead of KK tangents through the kinetics graph."""
+    _ANALYTIC_JVP.stack.append(bool(on))
+    try:
+        yield
+    finally:
+        _ANALYTIC_JVP.stack.pop()
 
 
 def net_production_rates(mech, T, C, P=None):
     """Species net molar production rates omega_dot [KK], mol/(cm^3 s)."""
+    if _ANALYTIC_JVP.stack[-1]:
+        from . import jacobian
+        return jacobian.net_production_rates_analytic(mech, T, C, P)
     q, _, _ = rates_of_progress(mech, T, C, P)
     return (mech.nu_r - mech.nu_f).T @ q
 

@@ -16,9 +16,12 @@ transcription error cannot survive.
 
 TPU-first design notes:
 - One Newton matrix M = I - h*gamma*J serves all three stages (SDIRK); one
-  LU per step attempt. The Jacobian is ``jax.jacfwd`` of the RHS — for a
-  matmul-heavy combustion RHS this pushes N tangents through the [II, KK]
-  stoichiometry matmuls at once, which is itself MXU work.
+  LU per step attempt. The Jacobian is caller-supplied via ``jac=`` —
+  the combustion solvers pass the closed-form analytical assembly of
+  ``ops/jacobian.py`` (two skinny stoichiometry matmuls; the dominant
+  per-attempt cost of the dense-AD path retired by the step-cost
+  ablation) — with ``jax.jacfwd`` of the RHS as the default fallback
+  and as the ``f64_jac`` rescue-ladder escalation.
 - The Jacobian is refreshed every attempt rather than cached: under ``vmap``
   a lazily-refreshed Jacobian is evaluated on every iteration regardless
   (both branches of the mask execute), so caching would only add carried
@@ -471,7 +474,11 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
 
     The returned ``status`` is this element's
     :class:`~pychemkin_tpu.resilience.status.SolveStatus` code.
-    ``f64_jac`` forces the f64 Jacobian path (rescue escalation).
+    ``jac(t, y, args) -> [N, N]`` overrides the Jacobian used for the
+    Newton matrix (the batch-reactor solvers pass the analytical
+    assembly of :mod:`pychemkin_tpu.ops.jacobian`); default is
+    ``jax.jacfwd`` of the RHS. ``f64_jac`` forces the f64 AD Jacobian
+    path (rescue escalation; ignored when ``jac`` is given).
     ``fault_elem``/``fault_level`` thread this element's original batch
     index and rescue rung into the fault-injection harness; both are
     inert (no graph nodes) unless injection is active at trace time.

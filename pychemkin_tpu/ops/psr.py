@@ -134,8 +134,20 @@ def make_rhs(mode, energy):
     return rhs
 
 
+def _resid_jac(resid_fn, y, args, analytic):
+    """Jacobian of the PSR residual at y: ``jax.jacfwd``, traced under
+    the analytic-kinetics mode when ``analytic`` — the net-production
+    core then carries the closed-form custom-JVP rule of
+    :mod:`pychemkin_tpu.ops.jacobian`, so the KK+1 tangents flow only
+    through the cheap flow/thermo shell and contract one precomputed
+    [KK, KK] block instead of re-differentiating the kinetics graph."""
+    with kinetics.analytic_jacobian(analytic):
+        return jax.jacfwd(lambda yy: resid_fn(yy, args))(y)
+
+
 def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
-                  species_floor, damping=True, fault_mask=None):
+                  species_floor, damping=True, fault_mask=None,
+                  analytic_jac=True):
     """Damped Newton with masked convergence; returns
     (y, converged, n, lin_unstable) — ``lin_unstable`` is the linear
     solver's stagnation flag from the LAST iteration (the
@@ -157,7 +169,7 @@ def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
     def body(carry):
         y, _, it, _ = carry
         r = resid_fn(y, args)
-        J = jax.jacfwd(lambda yy: resid_fn(yy, args))(y)
+        J = _resid_jac(resid_fn, y, args, analytic_jac)
         J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-14 * jnp.eye(n)
         dy, unstable = linalg.solve_with_info(
             J, -jnp.where(jnp.isfinite(r), r, 1e6), fault_mask=fault_mask)
@@ -195,7 +207,7 @@ def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
 
 def _pseudo_transient_phase(rhs_fn, y0, args, n_steps, dt0, up_factor,
                             down_factor, dt_min, dt_max, T_max,
-                            species_floor):
+                            species_floor, analytic_jac=True):
     """Implicit-Euler continuation with bounded, adaptive step size
     (reference strategy and defaults: steadystatesolver.py:79-87 —
     TRminstepsize/TRmaxstepsize bounds, up/down factors 2.0/2.2); each
@@ -204,7 +216,8 @@ def _pseudo_transient_phase(rhs_fn, y0, args, n_steps, dt0, up_factor,
 
     def step(carry, _):
         y, dt = carry
-        J = jax.jacfwd(lambda yy: rhs_fn(0.0, yy, args))(y)
+        J = _resid_jac(lambda yy, a: rhs_fn(0.0, yy, a), y, args,
+                       analytic_jac)
         M = jnp.eye(n) - dt * J
         fac = linalg.factor(jnp.where(jnp.isfinite(M), M, 0.0))
 
@@ -244,7 +257,7 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
               n_pseudo=100, pseudo_dt0=1e-6, pseudo_up=2.0,
               pseudo_down=2.2, pseudo_dt_min=1e-10, pseudo_dt_max=1e-2,
               T_max=5000.0, species_floor=-1e-14,
-              fault_elem=None, fault_level=0):
+              jac_mode="analytic", fault_elem=None, fault_level=0):
     """Solve one PSR steady state; jit/vmap-safe.
 
     mode: "tau" (SetResTime) | "vol" (SetVolume);
@@ -252,10 +265,17 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
     steady-state solver controls (steadystatesolver.py:40-99: atol 1e-9,
     rtol 1e-4, pseudo-transient stride 1e-6 s x 100 steps, up-factor 2.0).
 
+    ``jac_mode``: "analytic" (default) assembles every Newton/pseudo-
+    transient Jacobian with the closed-form kinetics core of
+    :mod:`pychemkin_tpu.ops.jacobian` (AD differentiates only the cheap
+    flow/thermo shell); "ad" keeps the full ``jax.jacfwd`` path.
     The returned ``status`` is the element's SolveStatus code;
     ``fault_elem``/``fault_level`` thread fault injection (inert unless
     a spec is active at trace time).
     """
+    if jac_mode not in ("analytic", "ad"):
+        raise ValueError(f"unknown jac_mode {jac_mode!r}")
+    analytic_jac = jac_mode == "analytic"
     fault_mask = None
     if fault_elem is not None and faultinject.enabled():
         fault_mask = faultinject.linalg_unstable_mask(fault_elem,
@@ -291,17 +311,20 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
 
     y1, conv1, n1, unst1 = _newton_phase(resid, y0, mech_args, weights,
                                          n_newton, T_max, species_floor,
-                                         fault_mask=fault_mask)
+                                         fault_mask=fault_mask,
+                                         analytic_jac=analytic_jac)
 
     # pseudo-transient rescue for unconverged elements; a no-op (masked)
     # when phase 1 already converged
     y_pt = _pseudo_transient_phase(rhs, y1, mech_args, n_pseudo, pseudo_dt0,
                                    pseudo_up, pseudo_down, pseudo_dt_min,
-                                   pseudo_dt_max, T_max, species_floor)
+                                   pseudo_dt_max, T_max, species_floor,
+                                   analytic_jac=analytic_jac)
     y_pt = jnp.where(conv1, y1, y_pt)
     y2, conv2, n2, unst2 = _newton_phase(resid, y_pt, mech_args, weights,
                                          n_newton, T_max, species_floor,
-                                         fault_mask=fault_mask)
+                                         fault_mask=fault_mask,
+                                         analytic_jac=analytic_jac)
     y = jnp.where(conv1, y1, y2)
     converged = conv1 | conv2
     lin_unstable = jnp.where(conv1, unst1, unst2)
@@ -345,7 +368,7 @@ def solve_psr_chain(mech, energy="ENRG", *, P, Y_in0, h_in0, taus,
                     T_guess, Y_guess, qloss=None, T_fixed=None,
                     mdot=1.0, ss_atol=1e-9, ss_rtol=1e-4, n_newton=80,
                     T_max=5000.0, species_floor=-1e-14,
-                    fault_elem=None, fault_level=0):
+                    jac_mode="analytic", fault_elem=None, fault_level=0):
     """Solve a linear chain of PSRs as ONE coupled damped-Newton system
     — the TPU-native form of the reference's PSR cluster mode
     (reference PSR.py:286 set_reactor_index / :464
@@ -362,9 +385,13 @@ def solve_psr_chain(mech, energy="ENRG", *, P, Y_in0, h_in0, taus,
     ``tests/test_resilience.py::TestChainVmap``).
 
     The returned ``status`` is a whole-chain SolveStatus code;
+    ``jac_mode`` selects the coupled-chain Jacobian assembly ("analytic"
+    = closed-form kinetics core under the AD shell, "ad" = full jacfwd);
     ``fault_elem``/``fault_level`` thread fault injection for vmapped
     chain sweeps (inert unless a spec is active at trace time).
     """
+    if jac_mode not in ("analytic", "ad"):
+        raise ValueError(f"unknown jac_mode {jac_mode!r}")
     fault_mask = None
     if fault_elem is not None and faultinject.enabled():
         fault_mask = faultinject.linalg_unstable_mask(fault_elem,
@@ -412,7 +439,8 @@ def solve_psr_chain(mech, energy="ENRG", *, P, Y_in0, h_in0, taus,
     def body(carry):
         z, _, it, _ = carry
         r = chain_resid(z)
-        J = jax.jacfwd(chain_resid)(z)
+        J = _resid_jac(lambda zz, _a: chain_resid(zz), z, None,
+                       jac_mode == "analytic")
         J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-14 * jnp.eye(M)
         # row-equilibrated: the coupled chain Jacobian is NOT of the
         # I - c*J form the pivot-free f32 factor is argued safe for,

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from ..constants import R_GAS
 from ..resilience import faultinject
-from . import kinetics, linalg, thermo
+from . import jacobian, kinetics, linalg, thermo
 from .odeint import Event, odeint
 
 
@@ -85,8 +85,14 @@ def _heat_rate(args, T, t):
     return -ql + args.htc * ar * (args.tamb - T)
 
 
+#: temperature floor of the RHS state split; the analytical Jacobian's
+#: T-clamp indicator (ops/jacobian.py:_batch_jac_core) gates on the
+#: same value so its zero-derivative region matches AD's
+T_FLOOR = 50.0
+
+
 def _split(y):
-    return y[:-1], jnp.maximum(y[-1], 50.0)
+    return y[:-1], jnp.maximum(y[-1], T_FLOOR)
 
 
 def conp_enrg_rhs(t, y, args: BatchArgs):
@@ -224,7 +230,7 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
                 area=0.0, ignition_mode=IGN_T_INFLECTION,
                 ignition_kwargs=None, t_start=0.0,
                 max_steps_per_segment=20_000, h0=0.0, f64_jac=False,
-                fault_elem=None, fault_level=0):
+                jac_mode="analytic", fault_elem=None, fault_level=0):
     """Solve one 0-D batch reactor; jit/vmap-safe core of the reference's
     ``BatchReactors.run()`` (batchreactor.py:1161).
 
@@ -232,12 +238,28 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
     For CONP the constraint profile is P(t) [dyne/cm^2] (default: constant
     P0); for CONV it is V(t) [cm^3] (default: constant ``volume``).
 
-    ``h0``/``f64_jac`` are rescue-ladder escalation knobs (explicit
-    initial step, f64 Jacobian); ``fault_elem``/``fault_level`` thread
-    fault injection (see :func:`pychemkin_tpu.ops.odeint.odeint`).
-    The returned ``status`` is the per-element SolveStatus code.
+    ``jac_mode`` selects the stiff integrator's Jacobian: ``"analytic"``
+    (default) assembles it in closed form from the mechanism's
+    stoichiometric sparsity (:mod:`pychemkin_tpu.ops.jacobian` — two
+    skinny matmuls instead of KK forward-mode AD tangents), ``"ad"``
+    keeps the ``jax.jacfwd`` path. ``h0``/``f64_jac`` are rescue-ladder
+    escalation knobs (explicit initial step, f64 AD Jacobian — forcing
+    ``f64_jac`` overrides ``jac_mode``, so the rescue rung exercises a
+    genuinely different Jacobian path);
+    ``fault_elem``/``fault_level`` thread fault injection (see
+    :func:`pychemkin_tpu.ops.odeint.odeint`). The returned ``status``
+    is the per-element SolveStatus code.
     """
     rhs = _RHS[(problem, energy)]
+    # the analytical Jacobian differentiates the CLEAN RHS: an injected
+    # NaN fault must poison the Newton residuals (it does — odeint wraps
+    # the rhs itself), not silently flow through a Jacobian whose closed
+    # form does not model the fault
+    jac = None
+    if jac_mode == "analytic" and not f64_jac:
+        jac = jacobian.batch_rhs_jacobian(problem, energy)
+    elif jac_mode not in ("analytic", "ad"):
+        raise ValueError(f"unknown jac_mode {jac_mode!r}")
     dtype = jnp.result_type(jnp.asarray(Y0).dtype, jnp.float64)
     Y0 = jnp.asarray(Y0, dtype=dtype)
     T0 = jnp.asarray(T0, dtype=dtype)
@@ -280,7 +302,7 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
     atol_vec = atol_vec.at[-1].set(jnp.maximum(atol * 1e6, 1e-8))
     sol = odeint(rhs, y0, ts, args, rtol=rtol, atol=atol_vec, events=events,
                  max_steps_per_segment=max_steps_per_segment, h0=h0,
-                 f64_jac=f64_jac, fault_elem=fault_elem,
+                 jac=jac, f64_jac=f64_jac, fault_elem=fault_elem,
                  fault_level=fault_level)
 
     ignition_time = sol.event_times[0]
@@ -321,7 +343,8 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                          ignition_kwargs=None, n_out=2,
                          max_steps_per_segment=20_000, h0=0.0,
                          f64_jac=False, pivoted_lu=False,
-                         elem_ids=None, fault_level=0):
+                         jac_mode="analytic", elem_ids=None,
+                         fault_level=0):
     """Batched ignition-delay computation over [B] initial conditions — the
     TPU answer to the reference's serial Python sweep loop
     (tests/integration_tests/ignitiondelay.py:127-144). Returns a triple
@@ -359,8 +382,8 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                           ignition_mode=ignition_mode,
                           ignition_kwargs=ignition_kwargs,
                           max_steps_per_segment=max_steps_per_segment,
-                          h0=h0, f64_jac=f64_jac, fault_elem=elem,
-                          fault_level=fault_level)
+                          h0=h0, f64_jac=f64_jac, jac_mode=jac_mode,
+                          fault_elem=elem, fault_level=fault_level)
         return sol.ignition_time, sol.success, sol.status
 
     def run():

@@ -56,6 +56,15 @@ def g_RT(mech, T):
     return h_RT(mech, T) - s_R(mech, T)
 
 
+def dcp_R_dT(mech, T):
+    """Temperature derivative d(Cp/R)/dT, [KK] (1/K) — the NASA-7
+    polynomial differentiated termwise; used by the analytical Jacobian
+    (``ops/jacobian.py``) for the energy-equation row."""
+    a = _select_coeffs(mech, T)
+    return a[:, 1] + T * (2.0 * a[:, 2] + T * (3.0 * a[:, 3]
+                                               + T * 4.0 * a[:, 4]))
+
+
 def cv_R(mech, T):
     """Species molar heat capacity Cv/R (ideal gas), [KK]."""
     return cp_R(mech, T) - 1.0

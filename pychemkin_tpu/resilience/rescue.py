@@ -200,7 +200,8 @@ def resilient_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
                              = DEFAULT_LADDER,
                              max_attempts: Optional[int] = None,
                              attempt_timeout_s: Optional[float] = None,
-                             recorder=None, base_results=None):
+                             recorder=None, base_results=None,
+                             jac_mode="analytic"):
     """Batched ignition-delay sweep with the full resilience contract.
 
     Runs :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`, then
@@ -215,6 +216,13 @@ def resilient_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
     ALREADY-RUN base solve over the same inputs (e.g. a sharded sweep)
     — rescue then only re-solves its failures instead of repeating the
     base pass.
+
+    ``jac_mode`` threads the caller's Jacobian path (see
+    :func:`pychemkin_tpu.ops.reactors.solve_batch`) into the base solve
+    AND every rescue rung, so an "ad" A/B run's rescued elements are
+    re-solved on the path the artifact claims to measure (the f64_jac
+    rung still overrides to the f64 AD Jacobian — that escalation IS
+    the different-path rung).
     """
     from ..ops import reactors  # lazy: avoids an import cycle
 
@@ -233,7 +241,8 @@ def resilient_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
             mech, problem, energy, T0s, P0s, Y0s, t_ends, rtol=rtol,
             atol=atol, ignition_mode=ignition_mode,
             ignition_kwargs=ignition_kwargs,
-            max_steps_per_segment=max_steps_per_segment)
+            max_steps_per_segment=max_steps_per_segment,
+            jac_mode=jac_mode)
     else:
         times, ok, status = (base_results["times"], base_results["ok"],
                              base_results["status"])
@@ -250,6 +259,7 @@ def resilient_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
             max_steps_per_segment=int(max_steps_per_segment
                                       * step.max_steps_factor),
             h0=h0, f64_jac=step.f64_jac, pivoted_lu=step.pivoted_lu,
+            jac_mode=jac_mode,
             # original ids: injected faults must track their elements
             # through subset re-solves (and heal_at sees the rung)
             elem_ids=(np.asarray(idx) if faultinject.enabled()

@@ -1,0 +1,500 @@
+"""Property tests of the analytical kinetics Jacobian (``ops/jacobian.py``)
+against the ``jax.jacfwd`` oracle — the AD path it retires from the stiff
+hot path stays as the correctness reference.
+
+Coverage per ISSUE 6: plain / third-body / falloff (Lindemann, Troe,
+SRI, chemically-activated) / PLOG reaction subsets on both embedded
+mechanisms and hand-built tiny records, negative-A duplicate pairs, the
+``_safe_exp`` clamp regions, the fractional-FORD order-override branch
+(ch4global), the four batch-reactor RHS variants, the custom-JVP
+propagation path the PSR solvers use, and the parse-time sparsity
+metadata. f64 agreement is tight (this platform's f64 is double-single
+emulation: ~1e-12 scale-relative); the f32 bound (F32_TOL) is the
+documented mixed-precision tolerance of the TPU Jacobian path.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu.constants import P_ATM, R_GAS
+from pychemkin_tpu.mechanism import load_embedded, load_mechanism_from_strings
+from pychemkin_tpu.ops import jacobian, kinetics, psr, reactors, thermo
+from pychemkin_tpu.ops.reactors import BatchArgs, constant_profile
+
+THERM_AB = """\
+THERMO ALL
+   300.000  1000.000  5000.000
+A                 test  H   2               G   300.000  5000.000 1000.00      1
+ 2.50000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00    2
+ 1.00000000E+03 5.00000000E+00 2.50000000E+00 0.00000000E+00 0.00000000E+00    3
+ 0.00000000E+00 0.00000000E+00 1.00000000E+03 5.00000000E+00                   4
+B                 test  H   2               G   300.000  5000.000 1000.00      1
+ 2.50000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00    2
+ 0.00000000E+00 0.00000000E+00 2.50000000E+00 0.00000000E+00 0.00000000E+00    3
+ 0.00000000E+00 0.00000000E+00 0.00000000E+00 0.00000000E+00                   4
+END
+"""
+
+#: documented f32 tolerance of the analytical path: scale-relative max
+#: error of the f32 assembly vs the f32 AD oracle. The kinetics kernel
+#: works in log space, so f32 rounding is amplified by the exponent
+#: magnitudes (|arg| up to 85): ~85 * eps_f32 ~ 1e-5 per entry, with
+#: headroom for the nu^T contraction's accumulation order differing
+#: between the two paths.
+F32_TOL = 2e-4
+F64_TOL = 1e-11
+
+
+def _tiny(reactions, extra=""):
+    mech = ("ELEMENTS\nH\nEND\nSPECIES\nA B\nEND\n"
+            "REACTIONS" + extra + "\n" + reactions + "\nEND\n")
+    return load_mechanism_from_strings(mech, thermo_text=THERM_AB)
+
+
+@pytest.fixture(scope="module")
+def h2o2():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def grisyn():
+    return load_embedded("grisyn")
+
+
+@pytest.fixture(scope="module")
+def ch4global():
+    return load_embedded("ch4global")
+
+
+def _oracle(mech, T, C, P=None):
+    """(dwdot/dC, dwdot/dT) by jax.jacfwd of the standard kernel — the
+    retired hot-path computation, kept as rescue rung and as this
+    oracle."""
+    J_C = jax.jacfwd(lambda c: kinetics.net_production_rates(mech, T, c, P))(C)
+    J_T = jax.jacfwd(
+        lambda t: kinetics.net_production_rates(mech, t, C, P))(
+            jnp.asarray(T, C.dtype))
+    return J_C, J_T
+
+
+def _scale_rel(a, b):
+    """Max abs error of a vs b, relative to max |b| (Jacobian entries
+    span ~30 decades; per-entry rtol on the tiny entries is meaningless
+    for the Newton matrix the consumer builds)."""
+    a, b = np.asarray(a), np.asarray(b)
+    return float(np.abs(a - b).max() / max(np.abs(b).max(), 1e-300))
+
+
+def _check_state(mech, T, C, P=None, tol=F64_TOL):
+    d = jacobian.kinetics_derivatives(mech, T, C, P)
+    J_C, J_T = _oracle(mech, T, C, P)
+    assert _scale_rel(d.dwdot_dC, J_C) < tol
+    assert _scale_rel(d.dwdot_dT, J_T) < tol
+    # the primal must be BIT-identical to the standard kernel (same
+    # nu^T @ q matvec): rescue-rung handoff must not change residuals
+    w = kinetics.net_production_rates(mech, T, C, P)
+    np.testing.assert_array_equal(np.asarray(d.wdot), np.asarray(w))
+
+
+def _random_C(mech, seed, scale=1e-6):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(np.abs(rng.normal(scale, scale / 2,
+                                         mech.n_species)) + 1e-12)
+
+
+class TestEmbeddedMechanisms:
+    """Full-mechanism agreement at physically relevant states: h2o2
+    (Troe falloff + third bodies + REV rows) and grisyn (GRI-sized,
+    ~94% zero nu, 10 falloff rows)."""
+
+    @pytest.mark.parametrize("T", [400.0, 1200.0, 2800.0])
+    def test_h2o2_f64(self, h2o2, T):
+        _check_state(h2o2, T, _random_C(h2o2, int(T)))
+
+    @pytest.mark.parametrize("T", [900.0, 1800.0])
+    def test_grisyn_f64(self, grisyn, T):
+        _check_state(grisyn, T, _random_C(grisyn, int(T)))
+
+    def test_h2o2_f32_documented_tolerance(self, h2o2):
+        """f32 assembly vs f32 AD oracle — the mixed-precision contract
+        of the TPU Jacobian path (odeint only builds the Newton
+        preconditioner from it)."""
+        m32 = jacobian._cast_floats(h2o2, jnp.float32)
+        T = jnp.float32(1300.0)
+        C = _random_C(h2o2, 7).astype(jnp.float32)
+        d = jacobian.kinetics_derivatives(m32, T, C)
+        J_C, J_T = _oracle(m32, T, C)
+        assert d.dwdot_dC.dtype == jnp.float32
+        assert _scale_rel(d.dwdot_dC, J_C) < F32_TOL
+        assert _scale_rel(d.dwdot_dT, J_T) < F32_TOL
+
+    def test_grisyn_f32_documented_tolerance(self, grisyn):
+        m32 = jacobian._cast_floats(grisyn, jnp.float32)
+        T = jnp.float32(1500.0)
+        C = _random_C(grisyn, 11).astype(jnp.float32)
+        d = jacobian.kinetics_derivatives(m32, T, C)
+        J_C, J_T = _oracle(m32, T, C)
+        assert _scale_rel(d.dwdot_dC, J_C) < F32_TOL
+        assert _scale_rel(d.dwdot_dT, J_T) < F32_TOL
+
+
+class TestReactionTypes:
+    """Per-reaction-type agreement on minimal hand-built records, so a
+    regression in one correction term cannot hide behind a full
+    mechanism's dominant rows."""
+
+    C2 = jnp.array([2e-6, 5e-7])
+
+    def test_plain_reversible(self):
+        _check_state(_tiny("A<=>B 5.0E10 0.5 3000.0"), 1100.0, self.C2)
+
+    def test_irreversible(self):
+        _check_state(_tiny("A=>B 5.0E10 0.0 1000.0"), 1100.0, self.C2)
+
+    def test_explicit_rev(self):
+        _check_state(_tiny("A<=>B 1.0E10 0.0 0.0\nREV/3.0E9 0.7 500.0/"),
+                     1100.0, self.C2)
+
+    def test_negative_A_duplicate_pair(self):
+        rec = _tiny("A<=>B 5.0E10 0.0 0.0\nDUP\nA<=>B -2.0E10 0.3 100.0\nDUP")
+        _check_state(rec, 1100.0, self.C2)
+
+    def test_plain_third_body(self):
+        rec = _tiny("A+M<=>B+M 1.0E10 0.0 0.0\nA/2.5/ B/0.5/")
+        _check_state(rec, 1100.0, self.C2)
+
+    def test_lindemann(self):
+        rec = _tiny("A(+M)<=>B(+M) 1.0E12 0.0 0.0\nLOW/1.0E14 0.0 0.0/")
+        _check_state(rec, 1100.0, self.C2)
+
+    def test_troe(self):
+        rec = _tiny("A(+M)<=>B(+M) 1.0E12 0.0 0.0\n"
+                    "LOW/1.0E16 -0.5 200.0/\n"
+                    "TROE/0.6 100.0 2000.0 5000.0/")
+        # mid-blend state: Pr ~ O(1) so every Troe term carries signal
+        _check_state(rec, 1100.0, jnp.array([5e-5, 2e-5]))
+
+    def test_troe_three_parameter(self):
+        """T2 absent (the inf-marked 4th parameter): its masked exp term
+        must contribute zero derivative, not NaN."""
+        rec = _tiny("A(+M)<=>B(+M) 1.0E12 0.0 0.0\n"
+                    "LOW/1.0E16 0.0 0.0/\nTROE/0.7 150.0 1500.0/")
+        _check_state(rec, 1100.0, jnp.array([5e-5, 2e-5]))
+
+    def test_sri(self):
+        rec = _tiny("A(+M)<=>B(+M) 1.0E12 0.0 0.0\n"
+                    "LOW/1.0E16 0.0 0.0/\nSRI/0.5 300.0 1200.0/")
+        _check_state(rec, 1100.0, jnp.array([5e-5, 2e-5]))
+
+    def test_sri_five_parameter(self):
+        rec = _tiny("A(+M)<=>B(+M) 1.0E12 0.0 0.0\n"
+                    "LOW/1.0E16 0.0 0.0/\nSRI/0.5 300.0 1200.0 1.2 0.1/")
+        _check_state(rec, 1100.0, jnp.array([5e-5, 2e-5]))
+
+    def test_chemically_activated_troe(self):
+        rec = _tiny("A(+M)<=>B(+M) 1.0E6 0.0 0.0\n"
+                    "HIGH/1.0E12 0.0 0.0/\nTROE/0.6 100.0 2000.0/")
+        _check_state(rec, 1000.0, jnp.array([1e-6, 1e-6]))
+
+    def test_plog_explicit_pressure(self):
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\n"
+                    "PLOG/0.1  1.0E8  0.0 1000.0/\n"
+                    "PLOG/1.0  1.0E10 0.5 2000.0/\n"
+                    "PLOG/10.0 1.0E12 0.0 3000.0/")
+        # between table nodes: the log-P interpolation slope is live
+        _check_state(rec, 1000.0, self.C2, P=0.4 * P_ATM)
+
+    def test_plog_reconstructed_pressure(self):
+        """P=None with PLOG rows: P = sum(C) R T, so dP/dC_k = RT and
+        dP/dT = sum(C) R chain terms must be included."""
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\n"
+                    "PLOG/0.1  1.0E8  0.0 1000.0/\n"
+                    "PLOG/1.0  1.0E10 0.5 2000.0/\n"
+                    "PLOG/10.0 1.0E12 0.0 3000.0/")
+        T = 1000.0
+        C = jnp.array([1.0, 1.0]) * (0.4 * P_ATM / (R_GAS * T) / 2)
+        _check_state(rec, T, C, P=None)
+
+    def test_order_overrides_fractional_ford(self, ch4global):
+        """The has_order_overrides branch (fractional FORD entries with
+        their own concentration floor) — ch4global is the only embedded
+        mechanism exercising it."""
+        _check_state(ch4global, 1600.0, _random_C(ch4global, 3))
+
+
+class TestClampRegions:
+    """Every _safe_exp / floor in the kinetics kernel has a
+    zero-derivative region; the closed form must reproduce AD's behavior
+    there (indicator factors), not extrapolate the unclamped formula."""
+
+    def test_conc_product_clamp_high(self):
+        """arg_f beyond +85: 3 A => 3 B at ln C_A ~ 30 puts ord@lnC at
+        ~90, inside _safe_exp's upper clamp — d(prod)/dC must be 0."""
+        rec = _tiny("A+A+A=>B+B+B 1.0E1 0.0 0.0")
+        T, C = 1000.0, jnp.array([1e13, 1e0])
+        r = kinetics.rop_intermediates(rec, T, C)
+        assert float(r.arg_f[0]) > 85.0  # the test is vacuous otherwise
+        _check_state(rec, T, C)
+
+    def test_zero_concentration_floor(self):
+        """Species at exactly C=0 sit below the _TINY floor: the lnC
+        clamp makes the derivative wrt that species 0 in AD, and the
+        analytic dln indicator must match."""
+        rec = _tiny("A+B=>B+B 1.0E10 0.0 0.0\nA<=>B 1.0E8 0.0 0.0")
+        _check_state(rec, 1000.0, jnp.array([1e-6, 0.0]))
+
+    def test_arrhenius_exp_clamp(self):
+        """A rate constant whose log-space argument exceeds +85 rides
+        _safe_exp's clamp: dk/dT must be 0 there, matching AD."""
+        rec = _tiny("A<=>B 1.0E30 10.0 0.0")
+        T = 2000.0
+        k = kinetics.forward_rate_constants(rec, T, self_C := jnp.array(
+            [1e-6, 1e-6]))
+        assert float(k[0]) == pytest.approx(np.exp(85.0), rel=1e-6)
+        _check_state(rec, T, self_C)
+
+
+class TestBatchRHSJacobian:
+    """The closed-form d(rhs)/dy of the four 0-D reactor RHS variants —
+    what odeint's Newton actually consumes on the hot path."""
+
+    @staticmethod
+    def _args_y0(mech, problem, T0=1300.0, P0=1.01325e6, seed=0):
+        rng = np.random.default_rng(seed)
+        Y = np.abs(rng.normal(0.1, 0.05, mech.n_species))
+        Y = jnp.asarray(Y / Y.sum())
+        rho0 = thermo.density(mech, T0, P0, Y)
+        cprof = constant_profile(jnp.asarray(P0 if problem == "CONP"
+                                             else 1.0))
+        args = BatchArgs(mech=mech, constraint=cprof,
+                         tprof=constant_profile(jnp.asarray(T0)),
+                         qloss=constant_profile(jnp.asarray(0.0)),
+                         area=constant_profile(jnp.asarray(1.0)),
+                         mass=rho0 * 1.0, htc=2.5, tamb=300.0)
+        y0 = jnp.concatenate([Y, jnp.asarray([T0])])
+        return args, y0
+
+    @pytest.mark.parametrize("problem", ["CONP", "CONV"])
+    @pytest.mark.parametrize("energy", ["ENRG", "TGIV"])
+    def test_variant_agrees_with_jacfwd(self, h2o2, problem, energy):
+        args, y0 = self._args_y0(h2o2, problem)
+        rhs = reactors._RHS[(problem, energy)]
+        jac_fn = jacobian.batch_rhs_jacobian(problem, energy)
+        t = jnp.asarray(1e-5)
+        Ja = jac_fn(t, y0, args)
+        Jo = jax.jacfwd(lambda yy: rhs(t, yy, args))(y0)
+        assert _scale_rel(Ja, Jo) < F64_TOL
+
+    def test_grisyn_conp_enrg(self, grisyn):
+        args, y0 = self._args_y0(grisyn, "CONP", seed=2)
+        jac_fn = jacobian.batch_rhs_jacobian("CONP", "ENRG")
+        t = jnp.asarray(0.0)
+        Jo = jax.jacfwd(
+            lambda yy: reactors._RHS[("CONP", "ENRG")](t, yy, args))(y0)
+        assert _scale_rel(jac_fn(t, y0, args), Jo) < F64_TOL
+
+    def test_unknown_variant_rejected(self):
+        with pytest.raises(ValueError, match="unknown RHS variant"):
+            jacobian.batch_rhs_jacobian("CONP", "HPEN")
+
+
+class TestCustomJVP:
+    """net_production_rates_analytic and the kinetics.analytic_jacobian()
+    trace-time switch — the propagation path PSR Newton phases use."""
+
+    def test_primal_bit_identical(self, h2o2):
+        T, C = 1300.0, _random_C(h2o2, 5)
+        w_std = kinetics.net_production_rates(h2o2, T, C)
+        w_ana = jacobian.net_production_rates_analytic(h2o2, T, C)
+        np.testing.assert_array_equal(np.asarray(w_std), np.asarray(w_ana))
+
+    def test_jacfwd_through_custom_jvp(self, h2o2):
+        T, C = 1300.0, _random_C(h2o2, 5)
+        J_ana = jax.jacfwd(
+            lambda c: jacobian.net_production_rates_analytic(h2o2, T, c))(C)
+        J_std, _ = _oracle(h2o2, T, C)
+        assert _scale_rel(J_ana, J_std) < F64_TOL
+
+    def test_analytic_context_reroutes(self, h2o2):
+        """Under the context manager the standard entry point carries
+        the closed-form JVP; outside it, plain AD — same values."""
+        T, C = 1300.0, _random_C(h2o2, 6)
+
+        def f(c):
+            return kinetics.net_production_rates(h2o2, T, c)
+
+        with kinetics.analytic_jacobian():
+            J_ctx = jax.jacfwd(f)(C)
+        J_std = jax.jacfwd(f)(C)
+        assert _scale_rel(J_ctx, J_std) < F64_TOL
+
+    def test_plain_call_inside_context(self, h2o2):
+        """Regression: a PLAIN (non-AD) net_production_rates call traced
+        inside the context reroutes into the custom-JVP wrapper, whose
+        primal body calls the standard kernel again — without the
+        flag-suppression in net_production_rates_analytic that call
+        would reroute back and recurse without bound."""
+        T, C = 1300.0, _random_C(h2o2, 8)
+        w_std = kinetics.net_production_rates(h2o2, T, C)
+        with kinetics.analytic_jacobian():
+            w_ctx = kinetics.net_production_rates(h2o2, T, C)
+        np.testing.assert_array_equal(np.asarray(w_ctx), np.asarray(w_std))
+
+    def test_plog_explicit_P_inside_context(self):
+        """Regression: jacfwd at explicit P with PLOG rows inside the
+        context — the JVP rule's dP term re-evaluates the standard
+        kernel (the ``wp`` closure), which must also suppress the
+        reroute flag or it recurses."""
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\n"
+                    "PLOG/0.1  1.0E8  0.0 1000.0/\n"
+                    "PLOG/10.0 1.0E12 0.0 3000.0/")
+        T, C = 1000.0, jnp.array([2e-6, 5e-7])
+        P0 = jnp.asarray(0.4 * P_ATM)
+
+        def f(p):
+            return kinetics.net_production_rates(rec, T, C, p)
+
+        with kinetics.analytic_jacobian():
+            J_ctx = jax.jacfwd(f)(P0)
+        J_std = jax.jacfwd(f)(P0)
+        assert _scale_rel(J_ctx, J_std) < F64_TOL
+
+    def test_explicit_P_symbolic_zero_dP(self):
+        """jacfwd over C alone at explicit P (the PSR Newton shape): dP
+        arrives as a symbolic zero and the rule must skip its
+        full-kinetics jvp term yet still match the AD oracle."""
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\n"
+                    "PLOG/0.1  1.0E8  0.0 1000.0/\n"
+                    "PLOG/10.0 1.0E12 0.0 3000.0/")
+        T, C = 1000.0, jnp.array([2e-6, 5e-7])
+        P0 = jnp.asarray(0.4 * P_ATM)
+        J_ana = jax.jacfwd(
+            lambda c: jacobian.net_production_rates_analytic(
+                rec, T, c, P0))(C)
+        J_std = jax.jacfwd(
+            lambda c: kinetics.net_production_rates(rec, T, c, P0))(C)
+        assert _scale_rel(J_ana, J_std) < F64_TOL
+
+    def test_explicit_pressure_jvp(self):
+        """PLOG at explicit P: the custom-JVP rule's dP tangent term."""
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\n"
+                    "PLOG/0.1  1.0E8  0.0 0.0/\n"
+                    "PLOG/10.0 1.0E12 0.0 0.0/")
+        T, C = 1000.0, jnp.array([2e-6, 5e-7])
+        P0 = jnp.asarray(0.4 * P_ATM)
+
+        def f(p):
+            return jacobian.net_production_rates_analytic(rec, T, C, p)
+
+        def f_std(p):
+            return kinetics.net_production_rates(rec, T, C, p)
+
+        J_ana = jax.jacfwd(f)(P0)
+        J_std = jax.jacfwd(f_std)(P0)
+        assert _scale_rel(J_ana, J_std) < F64_TOL
+
+
+class TestSparsityMetadata:
+    """Parse-time sparsity fields and their fallback recomputation."""
+
+    def test_parser_populates_fields(self, h2o2):
+        from pychemkin_tpu.mechanism.record import FALLOFF_NONE, TB_NONE
+        falloff = np.asarray(h2o2.falloff_type) != FALLOFF_NONE
+        assert h2o2.jac_falloff_rows == tuple(np.where(falloff)[0])
+        tb = (np.asarray(h2o2.tb_type) != TB_NONE) | falloff
+        assert h2o2.jac_tb_rows == tuple(np.where(tb)[0])
+        assert len(h2o2.jac_active_species) == h2o2.n_species
+        assert 0.0 < h2o2.nu_nnz_frac < 1.0
+
+    def test_grisyn_is_sparse(self, grisyn):
+        """The tentpole's premise: GRI-scale nu is ~90%+ zeros, and only
+        a minority of rows carry falloff corrections."""
+        assert grisyn.nu_nnz_frac < 0.10
+        assert len(grisyn.jac_falloff_rows) < grisyn.n_reactions // 4
+
+    def test_stats_dict(self, grisyn):
+        s = jacobian.sparsity_stats(grisyn)
+        assert set(s) == {"nu_nnz_frac", "n_species_active",
+                          "n_falloff_rows", "n_third_body_rows"}
+        assert s["n_species_active"] == grisyn.n_species
+        assert s["n_falloff_rows"] == len(grisyn.jac_falloff_rows)
+
+    def test_traced_record_conservative_fallback(self):
+        """A record with stripped static fields whose LEAVES are traced
+        (the mechanism passed as a jit argument, e.g. for parameter
+        sensitivity) falls back to the conservative full row sets: the
+        falloff jvp then runs over ALL rows and must not clobber the
+        plain-Arrhenius dk/dT of non-falloff rows (regression — the
+        write is gated by each row's own falloff flag)."""
+        rec = _tiny("A<=>B 5.0E10 0.5 3000.0\n"
+                    "A(+M)<=>B(+M) 1.0E12 0.0 0.0\n"
+                    "LOW/1.0E16 -0.5 200.0/\n"
+                    "TROE/0.6 100.0 2000.0 5000.0/")
+        bare = dataclasses.replace(
+            rec, jac_falloff_rows=None, jac_tb_rows=None,
+            jac_active_species=None, nu_nnz_frac=None)
+        T, C = 1100.0, jnp.array([5e-5, 2e-5])
+        d = jax.jit(
+            lambda m: jacobian.kinetics_derivatives(m, T, C))(bare)
+        J_C, J_T = _oracle(rec, T, C)
+        assert _scale_rel(d.dwdot_dC, J_C) < F64_TOL
+        assert _scale_rel(d.dwdot_dT, J_T) < F64_TOL
+
+    def test_handbuilt_record_fallback(self, h2o2):
+        """Records without the parse-time fields (hand-built in tests,
+        older pickles) recompute them from concrete leaves — and the
+        Jacobian still agrees."""
+        bare = dataclasses.replace(
+            h2o2, jac_falloff_rows=None, jac_tb_rows=None,
+            jac_active_species=None, nu_nnz_frac=None)
+        s = jacobian.sparsity_stats(bare)
+        assert s["n_falloff_rows"] == len(h2o2.jac_falloff_rows)
+        assert s["nu_nnz_frac"] == h2o2.nu_nnz_frac
+        _check_state(bare, 1200.0, _random_C(h2o2, 9))
+
+
+class TestSolverIntegration:
+    """End-to-end: the analytic default reproduces the AD path's
+    solutions (rescue-ladder handoff depends on this)."""
+
+    @pytest.fixture(scope="class")
+    def stoich(self, h2o2):
+        Y0 = np.zeros(h2o2.n_species)
+        names = [s.upper() for s in h2o2.species_names]
+        Y0[names.index("H2")] = 0.0283
+        Y0[names.index("O2")] = 0.2264
+        Y0[names.index("N2")] = 0.7453
+        return jnp.asarray(Y0)
+
+    def test_solve_batch_matches_ad(self, h2o2, stoich):
+        kw = dict(T0=1200.0, P0=1.01325e6, Y0=stoich, t_end=1e-3)
+        sol_a = reactors.solve_batch(h2o2, "CONP", "ENRG", **kw)
+        sol_d = reactors.solve_batch(h2o2, "CONP", "ENRG", jac_mode="ad",
+                                     **kw)
+        assert bool(sol_a.success) and bool(sol_d.success)
+        np.testing.assert_allclose(float(sol_a.ignition_time),
+                                   float(sol_d.ignition_time), rtol=1e-9)
+
+    def test_solve_batch_rejects_bad_mode(self, h2o2, stoich):
+        with pytest.raises(ValueError, match="unknown jac_mode"):
+            reactors.solve_batch(h2o2, "CONP", "ENRG", 1200.0, 1.01325e6,
+                                 stoich, 1e-3, jac_mode="sparse")
+
+    def test_solve_psr_matches_ad(self, h2o2, stoich):
+        h_in = thermo.mixture_enthalpy_mass(h2o2, 298.15, stoich)
+        kw = dict(P=1.01325e6, Y_in=stoich, h_in=h_in, T_guess=1500.0,
+                  Y_guess=stoich, tau=1e-3)
+        r_a = psr.solve_psr(h2o2, "tau", "ENRG", **kw)
+        r_d = psr.solve_psr(h2o2, "tau", "ENRG", jac_mode="ad", **kw)
+        assert bool(r_a.converged) and bool(r_d.converged)
+        np.testing.assert_allclose(float(r_a.T), float(r_d.T), rtol=1e-8)
+
+    def test_solve_psr_rejects_bad_mode(self, h2o2, stoich):
+        with pytest.raises(ValueError, match="unknown jac_mode"):
+            psr.solve_psr(h2o2, "tau", "ENRG", P=1.01325e6, Y_in=stoich,
+                          h_in=0.0, T_guess=1500.0, Y_guess=stoich,
+                          tau=1e-3, jac_mode="none")

@@ -267,13 +267,15 @@ class TestDeviceCounterBridge:
 
 #: every key a bench rung JSON line must carry — the banked-summary
 #: schema consumers (post-mortems, VERDICT parsing) rely on, including
-#: the resilience counters added by ISSUE 3 and the durability fields
-#: (driver-run sweeps) added by ISSUE 4
+#: the resilience counters added by ISSUE 3, the durability fields
+#: (driver-run sweeps) added by ISSUE 4, and the Jacobian-mode /
+#: mechanism-sparsity fields added by ISSUE 6
 RUNG_SCHEMA_KEYS = (
     "platform", "n_chips", "mech", "B", "chunk", "compile_s", "run_s",
     "throughput", "rtol", "atol", "t_end", "n_ok", "n_ignited",
     "n_steps", "n_rejected", "n_newton", "steps_per_sec",
     "model_f32_gflop", "model_f64_gflop", "mfu_pct",
+    "jac_mode", "nu_nnz_frac", "n_species_active",
     "n_failed", "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
 )
@@ -281,6 +283,7 @@ RUNG_SCHEMA_KEYS = (
 #: rung keys that _build_summary must forward into configs_run
 CONFIGS_RUN_KEYS = (
     "mech", "B", "chunk", "throughput", "mfu_pct", "n_failed",
+    "jac_mode", "nu_nnz_frac", "n_species_active",
     "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
 )
@@ -295,6 +298,8 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
         "n_steps": 100 * B,
         "n_rejected": B, "n_newton": 400 * B, "steps_per_sec": 1e5,
         "model_f32_gflop": 1.0, "model_f64_gflop": 0.1, "mfu_pct": 1.5,
+        "jac_mode": "analytic", "nu_nnz_frac": 0.32,
+        "n_species_active": 10,
         "n_failed": n_failed, "n_rescued": max(n_failed - 1, 0),
         "n_abandoned": min(n_failed, 1),
         "status_counts": ({"OK": B - 1, "NONFINITE": 1} if n_failed
@@ -510,6 +515,11 @@ class TestBenchRungSchema:
         assert rung["status_counts"] == {"OK": 4}
         assert rung["resume_count"] == 0        # nothing to resume
         assert rung["driver_overhead_s"] >= 0.0
+        # ISSUE 6: the rung says which Jacobian path it timed, and the
+        # sparsity the analytical assembly exploits
+        assert rung["jac_mode"] == "analytic"
+        assert 0.0 < rung["nu_nnz_frac"] < 1.0
+        assert rung["n_species_active"] == 10   # h2o2: all 10 species
 
 
 class TestServeRungSchema:

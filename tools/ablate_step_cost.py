@@ -37,7 +37,8 @@ import numpy as np                                         # noqa: E402
 from pychemkin_tpu import telemetry                        # noqa: E402
 from pychemkin_tpu.benchmarks import _flop_model           # noqa: E402
 from pychemkin_tpu.mechanism import load_embedded          # noqa: E402
-from pychemkin_tpu.ops import linalg, reactors, thermo     # noqa: E402
+from pychemkin_tpu.ops import (                            # noqa: E402
+    jacobian, linalg, reactors, thermo)
 from pychemkin_tpu.ops.odeint import _GAMMA, _cast_floats  # noqa: E402
 
 
@@ -108,6 +109,19 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
             lambda yy: rhs(jnp.float32(0.0), yy, args32))(y))(
             ys.astype(jnp.float32))
 
+    # the analytical closed-form assembly (ops/jacobian.py) — what the
+    # stiff hot path now runs by default (jac_mode="analytic"); the
+    # jac_f64/jac_f32 AD components above are the retired dense path,
+    # kept as the f64_jac rescue rung
+    def jac_analytic64(ys):
+        return jax.vmap(lambda y: jacobian._batch_jac_core(
+            "CONP", "ENRG", 0.0, y, args))(ys)
+
+    def jac_analytic32(ys):
+        return jax.vmap(lambda y: jacobian._batch_jac_core(
+            "CONP", "ENRG", jnp.float32(0.0), y, args32))(
+            ys.astype(jnp.float32))
+
     def newton_matrix(J):
         return jnp.eye(N, dtype=J.dtype) - (h * _GAMMA) * J
 
@@ -138,6 +152,8 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
             ("rhs_f32", jax.jit(rhs32)),
             ("jac_f64", jax.jit(jac64)),
             ("jac_f32", jax.jit(jac32)),
+            ("jac_analytic_f64", jax.jit(jac_analytic64)),
+            ("jac_analytic_f32", jax.jit(jac_analytic32)),
             ("lu_nopivot_f32", jax.jit(lu_nopivot)),
             ("lu_pivoted_f32", jax.jit(lu_pivoted)),
     ]:
@@ -157,17 +173,31 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
 
     # one SDIRK3 step attempt = 1 Jacobian + 1 LU + (3 stages x ~2
     # Newton iterations) x (1 f64 RHS + 1 triangular solve) + the error
-    # filter solve; shares from the measured component times
+    # filter solve; shares from the measured component times. Two
+    # attempt models: the analytical Jacobian (jac_mode="analytic", the
+    # hot-path default since ISSUE 6) and the retired dense-AD build
+    # (the f64_jac rescue rung) — before/after in one artifact.
     n_newton = 6
-    jac_key = ("jac_f32" if linalg.use_mixed_precision() else "jac_f64")
-    lu_key = ("lu_nopivot_f32" if linalg.use_mixed_precision()
-              else "lu_pivoted_f32")
-    t_jac = components[jac_key]["run_s"]
+    mixed = linalg.use_mixed_precision()
+    lu_key = "lu_nopivot_f32" if mixed else "lu_pivoted_f32"
     t_lu = components[lu_key]["run_s"]
     t_newton = n_newton * (components["rhs_f64"]["run_s"]
                            + components["tri_solve_f32"]["run_s"])
     t_err = components["tri_solve_f32"]["run_s"]
-    t_attempt = t_jac + t_lu + t_newton + t_err
+
+    def attempt_model(jac_key):
+        t_jac = components[jac_key]["run_s"]
+        t_attempt = t_jac + t_lu + t_newton + t_err
+        return {
+            "n_newton_assumed": n_newton,
+            "jac_component": jac_key,
+            "attempt_s": round(t_attempt, 6),
+            "jac_pct": round(100 * t_jac / t_attempt, 2),
+            "lu_pct": round(100 * t_lu / t_attempt, 2),
+            "newton_rhs_solve_pct": round(100 * t_newton / t_attempt, 2),
+            "err_filter_pct": round(100 * t_err / t_attempt, 2),
+        }
+
     f32_flop, f64_flop = _flop_model(mech, n_steps=1, n_rejected=0,
                                      n_newton=n_newton)
 
@@ -179,13 +209,21 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
         "n_state": N,
         "repeats": repeats,
         "components": components,
-        "attempt_model": {
-            "n_newton_assumed": n_newton,
-            "attempt_s": round(t_attempt, 6),
-            "jac_pct": round(100 * t_jac / t_attempt, 2),
-            "lu_pct": round(100 * t_lu / t_attempt, 2),
-            "newton_rhs_solve_pct": round(100 * t_newton / t_attempt, 2),
-            "err_filter_pct": round(100 * t_err / t_attempt, 2),
+        "sparsity": jacobian.sparsity_stats(mech),
+        # the hot path's attempt (analytical Jacobian, the default)
+        "attempt_model": attempt_model(
+            "jac_analytic_f32" if mixed else "jac_analytic_f64"),
+        # the retired dense-AD attempt (f64_jac rescue rung) — the
+        # "before" split this artifact's earlier revisions reported
+        "attempt_model_ad": attempt_model(
+            "jac_f32" if mixed else "jac_f64"),
+        "analytic_vs_ad": {
+            "jac_speedup_f64": round(
+                components["jac_f64"]["run_s"]
+                / max(components["jac_analytic_f64"]["run_s"], 1e-12), 3),
+            "jac_speedup_f32": round(
+                components["jac_f32"]["run_s"]
+                / max(components["jac_analytic_f32"]["run_s"], 1e-12), 3),
         },
         "f32_vs_f64": {
             "rhs_speedup": round(components["rhs_f64"]["run_s"]
