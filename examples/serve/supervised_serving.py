@@ -1,0 +1,53 @@
+"""Fleet-ready serving: a supervised backend that survives a SIGKILL.
+
+The production shape of ``pychemkin_tpu.serve``: the solver core runs
+in a SEPARATE backend process behind a JSON-over-TCP transport, and a
+:class:`~pychemkin_tpu.serve.Supervisor` keeps it alive — heartbeat
+watchdog, budgeted respawn, in-flight re-submission. This example
+drives requests through the supervisor, SIGKILLs the backend mid-run
+(the chaos layer's ``kill_backend_at_request``), and shows every
+request still resolving: the killed generation's in-flight work is
+re-submitted to the respawned backend, whose warmup replays the bucket
+ladder against the persistent XLA cache.
+
+Requests carry deadlines; an expired request resolves with
+``DEADLINE_EXCEEDED`` status as data and never consumes a batch slot.
+"""
+import numpy as np
+
+import pychemkin_tpu as ck
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.serve import Supervisor, loadgen
+
+mech = load_embedded("h2o2")
+Y = loadgen.stoich_h2_air_Y(mech)        # stoichiometric H2/air
+
+# the backend child: one tenant, equilibrium warmed, small ladder;
+# the chaos spec SIGKILLs it when the 4th submit arrives
+sup = Supervisor(
+    {"tenants": {"default": {"mech": "h2o2", "quota": 32}},
+     "kinds": ["equilibrium"],
+     "chem": {"bucket_sizes": [1, 4], "max_delay_ms": 5.0}},
+    env_overrides={"PYCHEMKIN_PROC_FAULTS":
+                   '[{"mode": "kill_backend_at_request",'
+                   ' "request": 3}]'},
+    retry_budget=1, max_respawns=2)
+
+with sup:
+    print("backend up on port %d (generation %d)"
+          % (sup.port, sup.generation))
+    T0s = np.linspace(900.0, 1800.0, 6)
+    futures = [sup.submit("equilibrium", T=float(T0), P=ck.P_ATM,
+                          Y=Y, option=1, deadline_ms=120_000.0)
+               for T0 in T0s]
+    for T0, fut in zip(T0s, futures):
+        r = fut.result(timeout=300)      # resolves across the respawn
+        print("T = %6.1f K -> %-6s  T_eq = %8.2f K"
+              % (T0, r.status_name,
+                 r.value.get("T", float("nan"))))
+    stats = sup.stats()
+    print("supervisor: %d respawn(s), %d re-submission(s), "
+          "%d backend-lost" % (stats["respawns"], stats["resubmits"],
+                               stats["backend_lost_requests"]))
+    assert stats["respawns"] == 1        # the SIGKILL was absorbed
+print("drained cleanly")

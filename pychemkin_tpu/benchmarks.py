@@ -67,6 +67,10 @@ Environment knobs:
   BENCH_SERVE_RATE  serve-rung offered rate, req/s (default 100)
   BENCH_SERVE_MECH  serve-rung mechanism (default h2o2)
   BENCH_SERVE_TIMEOUT  serve-rung subprocess timeout, s (default 600)
+  BENCH_SERVE_DEADLINE_MS  per-request deadline budget for the serve
+                    rung (default none); expired requests resolve
+                    DEADLINE_EXCEEDED without consuming a batch slot
+                    and the rung records n_deadline_expired
   BENCH_CHUNK       max batch elements per compiled call (default 256).
                     Larger B runs as sequential chunks of ONE cached
                     program, so compile time is flat in B, and a single
@@ -362,15 +366,21 @@ def _child_serve(mech_name: str, n_requests: int, rate_hz: float):
     print(f"# serve warmup: {warmup_s:.1f}s", file=sys.stderr)
     rng = np_.random.default_rng(0)
     samplers = loadgen.default_samplers(mech, kinds)
+    deadline_env = os.environ.get("BENCH_SERVE_DEADLINE_MS")
+    deadline_ms = float(deadline_env) if deadline_env else None
     with server:
         summary = loadgen.run_load(server, samplers, rate_hz=rate_hz,
-                                   n_requests=n_requests, rng=rng)
+                                   n_requests=n_requests, rng=rng,
+                                   deadline_ms=deadline_ms)
     snap = rec.snapshot()
     print(json.dumps(dict(
         rung="serve_latency", platform=platform, mech=mech_name,
         kinds=kinds, warmup_s=round(warmup_s, 1),
+        deadline_ms=deadline_ms,
         compiles=snap["counters"].get("serve.compiles", 0),
         n_batches=snap["counters"].get("serve.batches", 0),
+        n_deadline_expired=snap["counters"].get(
+            "serve.deadline_expired", 0),
         queue_wait_ms=snap["histograms"].get("serve.queue_wait_ms"),
         solve_ms=snap["histograms"].get("serve.solve_ms"),
         **summary)), flush=True)

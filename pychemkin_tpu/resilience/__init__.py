@@ -30,8 +30,18 @@ slices) needs two levels of robustness the raw solvers don't give:
    ``PYCHEMKIN_PROC_FAULTS``) so every driver recovery path is
    CI-testable on CPU too.
 
-See the README sections "Failure semantics & rescue ladder" and
-"Durable sweeps & preemption" for the user-facing contracts.
+**Per-service** (PR 7): the serving layer reuses this stack for live
+traffic — :class:`SolveStatus` grew the host-side
+``DEADLINE_EXCEEDED``/``BACKEND_LOST`` codes, ``procfaults`` grew
+serving-path chaos modes (``kill_backend_at_request``,
+``hang_heartbeat``, request-targeted ``poison_backend``), and
+``pychemkin_tpu.serve.supervisor`` reuses the driver's
+poisoned-backend classification and re-exec stamp for backend
+respawns.
+
+See the README sections "Failure semantics & rescue ladder",
+"Durable sweeps & preemption", and "Failure semantics runbook" for
+the user-facing contracts.
 """
 
 from . import checkpoint, driver, faultinject, procfaults, rescue, status

@@ -35,6 +35,32 @@ target element ranges via chunk 0 of the resumed process instead):
                         ``n_times`` times (default 1) — exercises the
                         retry/backoff ladder without poisoning.
 
+Serving-path modes (``request`` names the target request ordinal,
+counted from 0 over all submits a transport backend process receives;
+a spec without ``request`` never fires on the serving hooks, so driver
+chaos specs cannot leak into a server and vice versa; ALL serving
+modes honor ``heal_on_reexec`` — a respawned, re-exec-stamped backend
+is immune by default, since its request ordinals restart and a
+still-armed spec would re-fire every generation):
+
+- ``kill_backend_at_request``  SIGKILL this process when submit
+                        ordinal ``request`` arrives — the mid-load
+                        backend crash the supervisor must absorb
+                        (respawn + re-submit in-flight requests).
+- ``hang_heartbeat``    stop answering heartbeat pings from ping
+                        ordinal ``request`` onward (sleep ``seconds``
+                        in the ping handler) — the wedged-but-alive
+                        backend only a watchdog can catch. Data-plane
+                        requests keep flowing; the supervisor's hang
+                        timeout must still trip.
+- ``poison_backend``    with ``request`` set: raise
+                        :class:`BackendPoisonedError` at that submit —
+                        the supervisor classifies the reply via
+                        :func:`~.driver.is_poisoned` and respawns. By
+                        default heals in the respawned process
+                        (``heal_on_reexec``; the supervisor stamps the
+                        child's re-exec count exactly like the driver).
+
 Activation, either source (programmatic wins):
 
 - env var ``PYCHEMKIN_PROC_FAULTS`` — a JSON object or list, e.g.
@@ -60,7 +86,12 @@ _ENV = "PYCHEMKIN_PROC_FAULTS"
 REEXEC_COUNT_ENV = "_PYCHEMKIN_DRIVER_REEXEC"
 
 MODES = ("kill_at_chunk", "hang_child", "poison_backend",
-         "torn_checkpoint", "fail_chunk")
+         "torn_checkpoint", "fail_chunk",
+         "kill_backend_at_request", "hang_heartbeat")
+
+#: modes that target the SERVING path (request ordinals, not chunks)
+SERVE_MODES = ("kill_backend_at_request", "hang_heartbeat",
+               "poison_backend")
 
 
 class BackendPoisonedError(RuntimeError):
@@ -71,14 +102,17 @@ class BackendPoisonedError(RuntimeError):
 
 class ProcFaultSpec(NamedTuple):
     """One deterministic process-level fault, targeted by chunk
-    ordinal. ``n_times < 0`` means the fault fires every time the
-    chunk is hit (within this process)."""
+    ordinal (driver path) or request ordinal (serving path).
+    ``n_times < 0`` means the fault fires every time the target is hit
+    (within this process); ``request < 0`` means the spec is NOT a
+    serving-path spec (the serve hooks ignore it)."""
     mode: str
     chunk: int = 0
     n_times: int = 1
-    seconds: float = 3600.0          # hang_child sleep
+    seconds: float = 3600.0          # hang_child / hang_heartbeat sleep
     when: str = "after_bank"         # kill_at_chunk placement
     heal_on_reexec: bool = True      # poison_backend clears on re-exec
+    request: int = -1                # serving-path target ordinal
 
     @classmethod
     def from_dict(cls, d: dict) -> "ProcFaultSpec":
@@ -90,18 +124,29 @@ class ProcFaultSpec(NamedTuple):
         if when not in ("after_bank", "before_bank"):
             raise ValueError(f"kill_at_chunk 'when' must be after_bank "
                              f"or before_bank, got {when!r}")
+        # serving-only modes default to request 0 so a bare
+        # {"mode": "kill_backend_at_request"} spec is live; the
+        # dual-path poison_backend stays driver-targeted unless the
+        # spec names a request explicitly
+        req_default = 0 if mode in ("kill_backend_at_request",
+                                    "hang_heartbeat") else -1
+        # a hung heartbeat stays hung: every ping from `request` onward
+        # misses, unless the spec bounds it explicitly
+        n_default = -1 if mode == "hang_heartbeat" else 1
         return cls(mode=mode, chunk=int(d.get("chunk", 0)),
-                   n_times=int(d.get("n_times", 1)),
+                   n_times=int(d.get("n_times", n_default)),
                    seconds=float(d.get("seconds", 3600.0)), when=when,
-                   heal_on_reexec=bool(d.get("heal_on_reexec", True)))
+                   heal_on_reexec=bool(d.get("heal_on_reexec", True)),
+                   request=int(d.get("request", req_default)))
 
 
 #: programmatic spec stack (the :func:`inject` context manager)
 _active: List[ProcFaultSpec] = []
 
-#: per-process fire counts, keyed by (mode, chunk) — how ``n_times``
+#: per-process fire counts, keyed by (mode, chunk) for the driver path
+#: and (mode, "serve", request) for the serving path — how ``n_times``
 #: is enforced deterministically
-_fired: Dict[Tuple[str, int], int] = {}
+_fired: Dict[Tuple, int] = {}
 
 
 def _env_specs() -> List[ProcFaultSpec]:
@@ -149,12 +194,42 @@ def reexec_count() -> int:
 
 
 def _fires(spec: ProcFaultSpec, ordinal: int) -> bool:
+    if spec.request >= 0:
+        # a serving-targeted spec (request ordinal named) must never
+        # fire on the DRIVER hooks — the leak guard cuts both ways
+        return False
     if spec.chunk != ordinal:
         return False
     if spec.mode == "poison_backend" and spec.heal_on_reexec \
             and reexec_count() > 0:
         return False             # fresh process: clean backend client
     key = (spec.mode, spec.chunk)
+    if spec.n_times >= 0 and _fired.get(key, 0) >= spec.n_times:
+        return False
+    _fired[key] = _fired.get(key, 0) + 1
+    return True
+
+
+def _fires_serve(spec: ProcFaultSpec, ordinal: int) -> bool:
+    """Serving-path firing rule: a spec without ``request`` never
+    fires here; ``hang_heartbeat`` matches every ordinal from its
+    target onward (a wedge persists), the others match exactly.
+    ``heal_on_reexec`` (default True) gates EVERY serving mode: a
+    respawned backend carries the supervisor's re-exec stamp and is
+    immune — request ordinals restart in the fresh process, so a
+    still-armed spec would otherwise re-fire every generation and no
+    respawn budget could ever absorb it. Set ``heal_on_reexec`` false
+    to chaos-test the budget-exhaustion path itself."""
+    if spec.request < 0:
+        return False
+    if spec.mode == "hang_heartbeat":
+        if ordinal < spec.request:
+            return False
+    elif spec.request != ordinal:
+        return False
+    if spec.heal_on_reexec and reexec_count() > 0:
+        return False             # respawned backend: fault healed
+    key = (spec.mode, "serve", spec.request)
     if spec.n_times >= 0 and _fired.get(key, 0) >= spec.n_times:
         return False
     _fired[key] = _fired.get(key, 0) + 1
@@ -200,3 +275,26 @@ def on_after_bank(ordinal: int, checkpoint_path: Optional[str]) -> None:
     for spec in specs("kill_at_chunk"):
         if spec.when == "after_bank" and _fires(spec, ordinal):
             _sigkill_self()
+
+
+def on_serve_request(ordinal: int) -> None:
+    """Hook: a transport backend received submit ordinal ``ordinal``
+    (counted over the process's whole life, all connections)."""
+    for spec in specs():
+        if spec.mode == "kill_backend_at_request" \
+                and _fires_serve(spec, ordinal):
+            _sigkill_self()
+        elif spec.mode == "poison_backend" \
+                and _fires_serve(spec, ordinal):
+            raise BackendPoisonedError(
+                f"injected poison_backend at request {ordinal}")
+
+
+def on_heartbeat(ordinal: int) -> None:
+    """Hook: a transport backend is about to answer heartbeat ping
+    ``ordinal``. A firing ``hang_heartbeat`` spec sleeps here — the
+    pong never goes out in time, while data-plane requests keep being
+    served: the exact wedged-backend shape only a watchdog catches."""
+    for spec in specs("hang_heartbeat"):
+        if _fires_serve(spec, ordinal):
+            time.sleep(spec.seconds)

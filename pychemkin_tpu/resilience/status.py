@@ -38,6 +38,19 @@ TOL_NOT_MET > OK):
                         iteration of an unconverged solve.
 - ``NONFINITE``         NaN/Inf reached the state or the error
                         estimate (poisoned RHS, overflowed factor).
+
+Two codes are HOST-side only — they never come out of a jitted solver,
+they classify what the *serving layer* did with a request
+(:mod:`pychemkin_tpu.serve`):
+
+- ``DEADLINE_EXCEEDED`` the request's deadline passed before dispatch
+                        (dropped without consuming a batch slot) or
+                        before a rescue rung could start.
+- ``BACKEND_LOST``      the supervised serving backend died and the
+                        request exhausted its re-submission budget
+                        across respawns (:mod:`pychemkin_tpu.serve
+                        .supervisor`) — the caller gets this code
+                        instead of a hang.
 """
 
 from __future__ import annotations
@@ -58,10 +71,17 @@ class SolveStatus(enum.IntEnum):
     BUDGET_EXHAUSTED = 4
     LINALG_UNSTABLE = 5
     NONFINITE = 6
+    # host-side serving-layer codes (never emitted by jitted solvers)
+    DEADLINE_EXCEEDED = 7
+    BACKEND_LOST = 8
 
 
-#: every code, in priority order (highest first) — used by mergers
+#: every code, in priority order (highest first) — used by mergers;
+#: the serving-layer codes outrank solver codes: a request that was
+#: never solved (lost backend, expired deadline) has no solver verdict
 STATUS_PRIORITY = (
+    SolveStatus.BACKEND_LOST,
+    SolveStatus.DEADLINE_EXCEEDED,
     SolveStatus.NONFINITE,
     SolveStatus.LINALG_UNSTABLE,
     SolveStatus.NEWTON_DIVERGED,

@@ -19,6 +19,13 @@ inference stack has:
 
 See :mod:`.server` for the full contract (admission control, bucket
 ladder, rescue hand-off, graceful drain, telemetry).
+
+The in-process core scales out over a process boundary:
+:mod:`.transport` is a stdlib JSON-over-TCP front with multi-tenant
+routing and per-tenant admission quotas, and :mod:`.supervisor` keeps
+a transport backend process alive — crash/hang/poison detection,
+budgeted respawn, in-flight re-submission (``BACKEND_LOST`` as data
+when the budget is spent), graceful SIGTERM drain end-to-end.
 """
 
 from .batcher import BatchPolicy
@@ -29,9 +36,16 @@ from .engines import (
     IgnitionEngine,
     PSREngine,
 )
-from .errors import ServeError, ServerClosed, ServerOverloaded
+from .errors import (
+    ServeError,
+    ServerClosed,
+    ServerOverloaded,
+    TransportClosed,
+)
 from .futures import Request, ServeFuture, ServeResult
 from .server import ChemServer
+from .supervisor import Supervisor, SupervisorError
+from .transport import TransportClient, TransportServer
 
 __all__ = [
     "BatchPolicy",
@@ -47,6 +61,11 @@ __all__ = [
     "ServeResult",
     "ServerClosed",
     "ServerOverloaded",
+    "Supervisor",
+    "SupervisorError",
+    "TransportClient",
+    "TransportClosed",
+    "TransportServer",
     "bucket_for",
     "pad_indices",
 ]

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import queue as _queue
 import time
-from typing import Dict, List, NamedTuple, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from .futures import Request
 
@@ -32,18 +32,33 @@ class BatchPolicy(NamedTuple):
 
 
 def collect(q: "_queue.Queue[Request]", policy: BatchPolicy, stop,
-            poll_s: float = 0.05) -> Optional[List[Request]]:
+            poll_s: float = 0.05,
+            on_expired: Optional[Callable[[Request], None]] = None
+            ) -> Optional[List[Request]]:
     """Gather the next micro-batch from ``q``.
 
     Blocks (in ``poll_s`` slices, so a stop request is honored
     promptly) until at least one request arrives, then keeps gathering
     until ``max_batch_size`` or the delay window closes. Returns None
     when the queue is empty AND a stop was requested — the drain is
-    complete."""
+    complete.
+
+    A popped request whose deadline has already passed is handed to
+    ``on_expired`` instead of the batch: an expired request never
+    consumes a batch slot, never opens the delay window, and never
+    reaches a compiled program (the deadline contract the serve layer
+    resolves with ``DEADLINE_EXCEEDED``)."""
+
+    def _adopt(req: Request) -> Optional[Request]:
+        if on_expired is not None and req.expired():
+            on_expired(req)
+            return None
+        return req
+
     first: Optional[Request] = None
     while first is None:
         try:
-            first = q.get(timeout=poll_s)
+            first = _adopt(q.get(timeout=poll_s))
         except _queue.Empty:
             if stop.requested:
                 return None
@@ -54,7 +69,9 @@ def collect(q: "_queue.Queue[Request]", policy: BatchPolicy, stop,
         if stop.requested:
             # draining: take what is already queued, wait for nothing
             try:
-                batch.append(q.get_nowait())
+                req = _adopt(q.get_nowait())
+                if req is not None:
+                    batch.append(req)
                 continue
             except _queue.Empty:
                 break
@@ -65,7 +82,9 @@ def collect(q: "_queue.Queue[Request]", policy: BatchPolicy, stop,
             # wait in poll_s slices, not one `left`-long block: a stop
             # request landing mid-window must cut the wait short (the
             # drain should not ride out the delay bound)
-            batch.append(q.get(timeout=min(left, poll_s)))
+            req = _adopt(q.get(timeout=min(left, poll_s)))
+            if req is not None:
+                batch.append(req)
         except _queue.Empty:
             continue
     return batch

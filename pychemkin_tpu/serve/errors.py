@@ -11,24 +11,44 @@ path).
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 class ServeError(RuntimeError):
     """Base class of serving-layer errors."""
 
 
 class ServerOverloaded(ServeError):
-    """The bounded request queue is full: admission refused.
+    """Admission refused: the bounded request queue is full, or the
+    requester's tenant quota is saturated (transport layer).
 
     Backpressure is a REJECTION, never a block — a caller that wants
     queueing semantics retries with its own backoff; the server's
     worker can always drain the queue it has (no producer can wedge
-    it). ``queue_depth`` is the configured bound that was hit."""
+    it). ``queue_depth`` is the bound that was hit (the admission
+    queue's configured depth, or the tenant's quota);
+    ``retry_after_ms`` is the server's backoff hint — roughly one
+    batch-formation window plus the recent typical batch solve time —
+    so a transport can map overload to a proper backpressure reply
+    instead of a bare error string."""
 
-    def __init__(self, message: str, *, queue_depth: int):
+    def __init__(self, message: str, *, queue_depth: int,
+                 retry_after_ms: Optional[float] = None):
         super().__init__(message)
         self.queue_depth = queue_depth
+        self.retry_after_ms = retry_after_ms
 
 
 class ServerClosed(ServeError):
     """Submission after shutdown began (``close()`` was called, a
     drain signal arrived, or the server never started)."""
+
+
+class TransportClosed(ServeError):
+    """The socket transport to a remote serving backend dropped while
+    this request was in flight. Raised out of a client-side future when
+    no supervisor is managing re-submission; under a
+    :class:`~pychemkin_tpu.serve.supervisor.Supervisor` the request is
+    instead re-submitted to the respawned backend (and resolves with
+    ``SolveStatus.BACKEND_LOST`` as data once the retry budget is
+    spent)."""

@@ -15,7 +15,8 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import itertools
-from typing import Any, Dict, NamedTuple, Tuple
+import time
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 from ..resilience.status import name_of
 
@@ -65,9 +66,21 @@ class Request:
     payload: Dict[str, Any]    # normalized numeric payload
     future: ServeFuture
     t_submit: float            # time.perf_counter() at admission
+    #: absolute time.perf_counter() deadline (None = no deadline): an
+    #: expired request is dropped before dispatch and resolves with
+    #: ``SolveStatus.DEADLINE_EXCEEDED`` — it never consumes a batch
+    #: slot, and the rescue ladder starts no rung past it
+    deadline: Optional[float] = None
     #: correlates a request across serve.rescue/serve.demux_error events
     id: int = dataclasses.field(
         default_factory=lambda: next(_req_counter))
     #: set by the worker BEFORE the rescue hand-off: from then on the
     #: rescue thread owns the future and crash cleanup must skip it
     handed_off: bool = False
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """Whether the deadline has passed (False when none was set)."""
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) \
+            >= self.deadline

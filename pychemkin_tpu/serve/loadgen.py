@@ -13,14 +13,15 @@ and the ``serve_latency`` bench rung in
 
 from __future__ import annotations
 
+import concurrent.futures as _cf
 import time
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..resilience.procfaults import BackendPoisonedError
 from ..resilience.status import name_of
-from .errors import ServerOverloaded
-from .server import ChemServer
+from .errors import ServeError, ServerOverloaded
 
 #: a payload sampler: (index, rng) -> (kind, payload kwargs)
 Sampler = Callable[[int, np.random.Generator], Tuple[str, Dict]]
@@ -66,17 +67,31 @@ def default_samplers(mech, kinds: Sequence[str], *,
     return out
 
 
-def run_load(server: ChemServer, samplers: Sequence[Sampler], *,
+def run_load(server, samplers: Sequence[Sampler], *,
              rate_hz: float, n_requests: int,
              rng: np.random.Generator,
-             result_timeout_s: float = 300.0) -> Dict:
+             result_timeout_s: float = 300.0,
+             deadline_ms: Optional[float] = None) -> Dict:
     """Drive ``server`` with an open-loop Poisson stream; returns the
     JSON-ready latency summary.
+
+    ``server`` is anything with the ``submit(kind, **payload)`` duck
+    type returning a future of :class:`~.futures.ServeResult`: the
+    in-process :class:`ChemServer`, a
+    :class:`~.transport.TransportClient`, or a supervised
+    :class:`~.supervisor.Supervisor` — the same soak core drives all
+    three. ``deadline_ms`` stamps every request with that budget.
 
     Latency is submit -> future resolution (queue wait + batch solve +
     any rescue), captured via done-callbacks so slow consumers of the
     results cannot inflate it. Overload rejections are counted, not
-    retried (open loop: the lost arrival is the datapoint)."""
+    retried (open loop: the lost arrival is the datapoint) — whether
+    they raise at ``submit`` (in-process) or come back on the future
+    (transport); rejections carrying a ``retry_after_ms`` hint are
+    ALSO counted in ``n_rejected_with_hint``. A per-request result
+    timeout or transport error is counted (``n_timeout`` /
+    ``n_error``), never raised: one stuck future must not destroy the
+    whole run's latency artifact."""
     if not samplers:
         raise ValueError("need at least one payload sampler")
     arrivals = np.cumsum(rng.exponential(1.0 / rate_hz,
@@ -84,6 +99,7 @@ def run_load(server: ChemServer, samplers: Sequence[Sampler], *,
     done_at: Dict[int, float] = {}
     records = []
     n_rejected = 0
+    n_rejected_with_hint = 0
     t0 = time.perf_counter()
     for i in range(n_requests):
         target = t0 + arrivals[i]
@@ -96,9 +112,15 @@ def run_load(server: ChemServer, samplers: Sequence[Sampler], *,
             i, rng)
         t_sub = time.perf_counter()
         try:
-            fut = server.submit(kind, **payload)
-        except ServerOverloaded:
+            if deadline_ms is None:
+                fut = server.submit(kind, **payload)
+            else:
+                fut = server.submit(kind, deadline_ms=deadline_ms,
+                                    **payload)
+        except ServerOverloaded as exc:
             n_rejected += 1
+            n_rejected_with_hint += int(
+                getattr(exc, "retry_after_ms", None) is not None)
             continue
         fut.add_done_callback(
             lambda f, j=i: done_at.__setitem__(
@@ -110,8 +132,32 @@ def run_load(server: ChemServer, samplers: Sequence[Sampler], *,
     occupancies: List[int] = []
     status_counts: Dict[str, int] = {}
     n_rescued = 0
+    n_timeout = 0
+    n_error = 0
+    n_resolved = 0
     for i, kind, fut, t_sub in records:
-        res = fut.result(timeout=result_timeout_s)
+        try:
+            res = fut.result(timeout=result_timeout_s)
+        except _cf.TimeoutError:
+            # per-request containment: ONE stuck future becomes one
+            # n_timeout count — it must not raise out of the run and
+            # destroy every other request's latency datapoint
+            n_timeout += 1
+            continue
+        except ServerOverloaded as exc:
+            # transport-path rejection: admission happened on the far
+            # side of the wire, so the refusal rides the future
+            n_rejected += 1
+            n_rejected_with_hint += int(
+                getattr(exc, "retry_after_ms", None) is not None)
+            continue
+        except (ServeError, BackendPoisonedError, OSError):
+            # every typed remote failure class a bare TransportClient
+            # can surface (a supervisor absorbs poison, a raw client
+            # re-raises it) — counted, never raised out of the run
+            n_error += 1
+            continue
+        n_resolved += 1
         # result() can return before the done-callback has run (the
         # waiter wakes under the condition lock; callbacks fire after
         # it is released) — wait the beat out instead of KeyError-ing
@@ -135,8 +181,11 @@ def run_load(server: ChemServer, samplers: Sequence[Sampler], *,
 
     return {
         "n_requests": n_requests,
-        "n_served": len(records),
+        "n_served": n_resolved,
         "n_rejected": n_rejected,
+        "n_rejected_with_hint": n_rejected_with_hint,
+        "n_timeout": n_timeout,
+        "n_error": n_error,
         "n_rescued": n_rescued,
         "rate_hz": rate_hz,
         "offered_s": round(offered_s, 3),

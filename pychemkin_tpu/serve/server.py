@@ -32,13 +32,20 @@ without paying a per-request compile or hand-assembling batches:
   :class:`pychemkin_tpu.resilience.driver.GracefulStop`: signal
   handlers only set a flag; batch boundaries poll it).
 
+- **Deadlines**: ``submit(..., deadline_ms=...)`` bounds a request's
+  whole life. An expired request is dropped BEFORE dispatch (batch
+  collection and group formation both gate on it) and resolves with
+  ``SolveStatus.DEADLINE_EXCEEDED`` as data — it never consumes a
+  batch slot or reaches a compiled program — and the rescue ladder
+  starts no rung past the deadline.
+
 Telemetry on the attached recorder: ``serve.queue_depth`` gauge;
 ``serve.queue_wait_ms`` / ``serve.solve_ms`` / ``serve.batch_occupancy``
 histograms (p50/p95/p99 in ``snapshot()``); ``serve.requests`` /
-``serve.rejected`` / ``serve.batches`` / ``serve.rescued`` /
-``serve.abandoned`` / ``serve.status.<NAME>`` / ``serve.compiles[.*]``
-counters; one ``serve.batch`` event per dispatched micro-batch and a
-``serve.drain`` event at shutdown.
+``serve.rejected`` / ``serve.deadline_expired`` / ``serve.batches`` /
+``serve.rescued`` / ``serve.abandoned`` / ``serve.status.<NAME>`` /
+``serve.compiles[.*]`` counters; one ``serve.batch`` event per
+dispatched micro-batch and a ``serve.drain`` event at shutdown.
 """
 
 from __future__ import annotations
@@ -220,24 +227,48 @@ class ChemServer:
         self.close()
 
     # -- admission -------------------------------------------------------
-    def submit(self, kind: str, **payload) -> ServeFuture:
+    def retry_hint_ms(self) -> float:
+        """Backoff hint for an overloaded caller: one batch-formation
+        window plus the recent typical (p50) batch solve time — after
+        that long, at least one queued batch has drained, so a retry
+        has a fresh admission chance."""
+        hint = self.policy.max_delay_ms
+        solve = self._rec.histogram_summary("serve.solve_ms")
+        hint += solve.get("p50") or self.policy.max_delay_ms
+        return round(float(hint), 3)
+
+    def submit(self, kind: str, *, deadline_ms: Optional[float] = None,
+               **payload) -> ServeFuture:
         """Admit one request; returns its future. Raises
-        :class:`ServerOverloaded` (queue full) or
+        :class:`ServerOverloaded` (queue full; carries
+        ``queue_depth``/``retry_after_ms`` backpressure hints) or
         :class:`ServerClosed` (shutdown began) — the only two ways a
-        request fails at the call site."""
+        request fails at the call site.
+
+        ``deadline_ms`` bounds the request's whole life from this call:
+        once it passes, the request is dropped before dispatch — it
+        never consumes a batch slot or reaches a compiled program — and
+        its future resolves with ``SolveStatus.DEADLINE_EXCEEDED`` as
+        data; a request already dispatched keeps its hot-path result,
+        but no rescue rung starts past the deadline."""
         if self.draining or self._worker_done:
             raise ServerClosed("server is draining; no new admissions")
         eng = self.engine(kind)
         norm = eng.normalize(payload)
+        t_submit = time.perf_counter()
+        deadline = (None if deadline_ms is None
+                    else t_submit + float(deadline_ms) * 1e-3)
         req = Request(kind=kind, key=eng.group_key(norm), payload=norm,
-                      future=ServeFuture(), t_submit=time.perf_counter())
+                      future=ServeFuture(), t_submit=t_submit,
+                      deadline=deadline)
         try:
             self._queue.put_nowait(req)
         except _queue.Full:
             self._rec.inc("serve.rejected")
             raise ServerOverloaded(
                 f"request queue full ({self.queue_depth}); retry with "
-                "backoff", queue_depth=self.queue_depth) from None
+                "backoff", queue_depth=self.queue_depth,
+                retry_after_ms=self.retry_hint_ms()) from None
         if self._worker_done:
             # the worker exited (drain finished or crashed) between the
             # admission check and our enqueue; it will never pop this
@@ -248,14 +279,19 @@ class ChemServer:
         self._rec.gauge("serve.queue_depth", self._queue.qsize())
         return req.future
 
-    def submit_ignition(self, *, T0, P0, Y0, t_end) -> ServeFuture:
-        return self.submit("ignition", T0=T0, P0=P0, Y0=Y0, t_end=t_end)
+    def submit_ignition(self, *, T0, P0, Y0, t_end,
+                        deadline_ms=None) -> ServeFuture:
+        return self.submit("ignition", deadline_ms=deadline_ms,
+                           T0=T0, P0=P0, Y0=Y0, t_end=t_end)
 
-    def submit_equilibrium(self, *, T, P, Y, option=1) -> ServeFuture:
-        return self.submit("equilibrium", T=T, P=P, Y=Y, option=option)
+    def submit_equilibrium(self, *, T, P, Y, option=1,
+                           deadline_ms=None) -> ServeFuture:
+        return self.submit("equilibrium", deadline_ms=deadline_ms,
+                           T=T, P=P, Y=Y, option=option)
 
     def submit_psr(self, *, tau, P, Y_in, h_in=None, T_in=None,
-                   T_guess=None, Y_guess=None) -> ServeFuture:
+                   T_guess=None, Y_guess=None,
+                   deadline_ms=None) -> ServeFuture:
         payload = {"tau": tau, "P": P, "Y_in": Y_in}
         if h_in is not None:
             payload["h_in"] = h_in
@@ -265,7 +301,7 @@ class ChemServer:
             payload["T_guess"] = T_guess
         if Y_guess is not None:
             payload["Y_guess"] = Y_guess
-        return self.submit("psr", **payload)
+        return self.submit("psr", deadline_ms=deadline_ms, **payload)
 
     # -- direct reference path -------------------------------------------
     def solve_direct(self, kind: str, *, bucket: int = 1,
@@ -349,6 +385,20 @@ class ChemServer:
                 return
             self._fail_future(req.future, exc)
 
+    def _expire(self, req: Request) -> None:
+        """Resolve an expired request with ``DEADLINE_EXCEEDED`` as
+        data. Called only BEFORE dispatch (batch collection / group
+        formation), so an expired request provably never reaches a
+        compiled program — batch and compile counters are untouched."""
+        now = time.perf_counter()
+        self._rec.inc("serve.deadline_expired")
+        self._rec.inc(
+            f"serve.status.{name_of(SolveStatus.DEADLINE_EXCEEDED)}")
+        self._resolve_future(req.future, make_result(
+            {}, int(SolveStatus.DEADLINE_EXCEEDED), kind=req.kind,
+            bucket=0, occupancy=0,
+            queue_wait_ms=(now - req.t_submit) * 1e3, solve_ms=0.0))
+
     # -- worker ----------------------------------------------------------
     def _worker_loop(self) -> None:
         batch: Optional[List[Request]] = None
@@ -356,7 +406,8 @@ class ChemServer:
         try:
             while True:
                 batch = batcher.collect(self._queue, self.policy,
-                                        self._stop)
+                                        self._stop,
+                                        on_expired=self._expire)
                 if batch is None:
                     break
                 self._rec.gauge("serve.queue_depth",
@@ -388,6 +439,20 @@ class ChemServer:
 
     def _process_group(self, kind: str, key: Tuple,
                        reqs: List[Request]) -> None:
+        # last pre-dispatch deadline gate: earlier groups of the same
+        # micro-batch solve first, and their solve time may outlive a
+        # later group's deadline — drop those lanes HERE, before the
+        # padded program runs, so they never consume a slot
+        now = time.perf_counter()
+        live = []
+        for req in reqs:
+            if req.expired(now):
+                self._expire(req)
+            else:
+                live.append(req)
+        reqs = live
+        if not reqs:
+            return
         eng = self._engines[kind]
         occupancy = len(reqs)
         bucket = buckets.bucket_for(occupancy, self.buckets)
@@ -491,7 +556,17 @@ class ChemServer:
         if self.max_rescue_rungs is not None:
             rungs = min(rungs, self.max_rescue_rungs)
         value, status, level = base_value, base_status, 0
-        for level in range(1, rungs + 1):
+        deadline_cut = False
+        for next_level in range(1, rungs + 1):
+            if req.expired():
+                # a rung only starts while deadline budget remains: a
+                # jitted re-solve cannot be preempted, so the gate is
+                # at rung boundaries — the future resolves NOW with the
+                # deepest diagnosis instead of burning ladder time the
+                # caller stopped waiting for
+                deadline_cut = True
+                break
+            level = next_level
             out, status = eng.rescue_one(req.payload, key,
                                          level, elem_id)
             # keep value and status PAIRED: when every rung fails, the
@@ -506,6 +581,7 @@ class ChemServer:
                       else "serve.abandoned")
         self._rec.event("serve.rescue", req_kind=req.kind,
                         req_id=req.id, rungs=level, rescued=rescued,
+                        deadline_cut=deadline_cut,
                         status=name_of(status))
         self._resolve_future(req.future, make_result(
             value, status, rescued=rescued, rescue_rungs=level,

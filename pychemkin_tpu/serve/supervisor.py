@@ -1,0 +1,583 @@
+"""Supervised serving: a parent that keeps a transport backend alive.
+
+:mod:`.transport` gives the serving core a process boundary; this
+module makes that boundary SURVIVABLE. A production serving process
+dies of exactly the failure classes the durable sweep driver (PR 4)
+catalogued — SIGKILL preemption, a wedged-but-alive backend, a
+poisoned accelerator client — and without a supervisor every in-flight
+future dies with it. :class:`Supervisor` closes that hole:
+
+- **Spawn**: the backend child runs ``python -m
+  pychemkin_tpu.serve.transport`` (or any ``backend_argv`` speaking
+  the same stdout markers), prints its port, warms the bucket ladder,
+  prints READY. Respawned children get the driver's re-exec count
+  stamp (``_PYCHEMKIN_DRIVER_REEXEC``), so ``poison_backend`` chaos
+  heals on respawn exactly as it does on a driver re-exec, and the
+  replayed warmup hits the persistent XLA cache — post-respawn
+  dispatches are still compile-cache hits.
+- **Watch**: a heartbeat client pings on its own control connection
+  every ``heartbeat_s``; ``hang_timeout_s`` without a pong classifies
+  the backend as HUNG (SIGKILL + respawn) even while its data plane
+  looks alive. A reply matching the driver's poisoned-backend
+  classification (:func:`~pychemkin_tpu.resilience.driver.is_poisoned`)
+  skips per-request retries against the wedged process — the round-3
+  lesson — and respawns instead. A child exit outside a drain is a
+  CRASH.
+- **Respawn + re-submit**: respawns are budgeted
+  (``max_respawns``, env ``PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS``).
+  In-flight requests are re-submitted to the fresh backend, each up to
+  ``retry_budget`` re-sends; a request that exhausts it resolves with
+  ``SolveStatus.BACKEND_LOST`` **as data** — never a hang. Deadlines
+  travel: a re-send carries the REMAINING budget, and an expired
+  request resolves ``DEADLINE_EXCEEDED`` without touching the wire.
+- **Graceful drain**: ``close()`` — or SIGTERM after
+  :meth:`install_signal_handlers` — SIGTERMs the child, whose own
+  ``GracefulStop`` drains every ChemServer; the in-flight replies
+  flush back over the socket before the child exits
+  (``GracefulStop`` end-to-end). Anything still unresolved after the
+  child is gone fails typed ``ServerClosed``.
+
+Telemetry: ``supervisor.spawn`` / ``supervisor.backend_lost`` /
+``supervisor.respawn_exhausted`` / ``supervisor.drain`` events;
+``supervisor.respawns`` / ``supervisor.resubmits`` /
+``supervisor.backend_lost_requests`` counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import signal as _signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import telemetry
+from ..resilience.driver import GracefulStop, is_poisoned
+from ..resilience.procfaults import REEXEC_COUNT_ENV
+from ..resilience.rescue import _env_int
+from ..resilience.status import SolveStatus, name_of
+from .errors import ServerClosed, TransportClosed
+from .futures import ServeFuture, make_result
+from .transport import PORT_MARKER, READY_MARKER, TransportClient
+
+
+class SupervisorError(RuntimeError):
+    """The backend could not be (re)started (spawn/ready timeout)."""
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One accepted request the supervisor guarantees a resolution
+    for: value, typed status (``BACKEND_LOST`` / ``DEADLINE_EXCEEDED``
+    included), or typed error — never a hang."""
+    kind: str
+    tenant: Optional[str]
+    payload: Dict[str, Any]
+    future: ServeFuture
+    t_submit: float
+    deadline: Optional[float]        # absolute perf_counter, or None
+    attempts: int = 0                # wire sends so far
+    generation_sent: int = -1        # backend generation last sent to
+
+
+class Supervisor:
+    """Parent of one supervised transport backend (see module doc).
+
+    ``config`` is the backend's ``--config-json`` payload (tenants,
+    kinds to warm, ChemServer knobs). ``backend_argv`` overrides the
+    spawned command — anything that prints the ``PYCHEMKIN_SERVE_PORT=``
+    and ``PYCHEMKIN_SERVE_READY`` markers and speaks the transport
+    protocol (tests use a stdlib-only fake). ``retry_budget`` is
+    RE-sends per request after its first send; ``max_respawns`` is
+    backend respawns for the supervisor's life."""
+
+    def __init__(self, config: Optional[Dict] = None, *,
+                 host: str = "127.0.0.1",
+                 backend_argv: Optional[List[str]] = None,
+                 env_overrides: Optional[Dict[str, str]] = None,
+                 heartbeat_s: float = 0.5,
+                 hang_timeout_s: float = 10.0,
+                 max_respawns: Optional[int] = None,
+                 retry_budget: int = 1,
+                 spawn_timeout_s: float = 300.0,
+                 default_tenant: str = "default",
+                 recorder=None):
+        self.config = dict(config or {})
+        self.host = host
+        self._backend_argv = backend_argv
+        self._env_overrides = dict(env_overrides or {})
+        self.heartbeat_s = float(heartbeat_s)
+        self.hang_timeout_s = float(hang_timeout_s)
+        if max_respawns is None:
+            max_respawns = _env_int(
+                "PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS", 2)
+        self.max_respawns = int(max_respawns)
+        self.retry_budget = int(retry_budget)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.default_tenant = default_tenant
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
+        self._lock = threading.RLock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._client: Optional[TransportClient] = None
+        self._hb: Optional[TransportClient] = None
+        self._port: Optional[int] = None
+        self._inflight: Dict[int, _InFlight] = {}
+        self._ids = itertools.count()
+        self._respawns = 0
+        self._resubmits = 0
+        self._lost_requests = 0
+        self._lost_reason: Optional[str] = None
+        self._draining = False
+        self._dead = False
+        self._started = False
+        self._monitor: Optional[threading.Thread] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self._stop = GracefulStop()
+
+    # -- spawning --------------------------------------------------------
+    def _argv(self) -> List[str]:
+        if self._backend_argv is not None:
+            return list(self._backend_argv)
+        # -c instead of -m: the serve package imports .transport at
+        # package-import time, and runpy would warn about re-executing
+        # an already-imported module
+        return [sys.executable, "-c",
+                "import sys; from pychemkin_tpu.serve import "
+                "transport; sys.exit(transport.main())",
+                "--host", self.host, "--port", "0",
+                "--config-json", json.dumps(self.config)]
+
+    def _child_env(self, generation: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self._env_overrides)
+        # package importable regardless of the parent's cwd
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        # the driver's re-exec stamp: a respawned child is a "fresh
+        # process" to the chaos layer (poison_backend heals) and to
+        # anything else keyed on the re-exec count
+        if generation > 0:
+            env[REEXEC_COUNT_ENV] = str(generation)
+        else:
+            env.pop(REEXEC_COUNT_ENV, None)
+        return env
+
+    def _spawn(self, generation: int) -> None:
+        """Start a backend child and connect; raises
+        :class:`SupervisorError` on spawn/ready timeout."""
+        proc = subprocess.Popen(
+            self._argv(), env=self._child_env(generation),
+            stdout=subprocess.PIPE, text=True, bufsize=1)
+        port_box: Dict[str, int] = {}
+        port_evt, ready_evt = threading.Event(), threading.Event()
+
+        def pump():
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                if line.startswith(PORT_MARKER):
+                    port_box["port"] = int(line[len(PORT_MARKER):])
+                    port_evt.set()
+                elif line.strip() == READY_MARKER:
+                    ready_evt.set()
+            proc.stdout.close()
+
+        threading.Thread(target=pump, name="supervisor-stdout",
+                         daemon=True).start()
+        deadline = time.perf_counter() + self.spawn_timeout_s
+        for evt, what in ((port_evt, "port"), (ready_evt, "ready")):
+            if not evt.wait(max(0.0, deadline - time.perf_counter())):
+                proc.kill()
+                proc.wait()
+                raise SupervisorError(
+                    f"backend never reported {what} within "
+                    f"{self.spawn_timeout_s}s (generation "
+                    f"{generation})")
+        port = port_box["port"]
+        client = TransportClient(self.host, port,
+                                 tenant=self.default_tenant)
+        hb = TransportClient(self.host, port)
+        with self._lock:
+            self._proc, self._port = proc, port
+            self._client, self._hb = client, hb
+        self._rec.event("supervisor.spawn", generation=generation,
+                        pid=proc.pid, port=port)
+
+    def start(self) -> "Supervisor":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        self._spawn(0)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="supervisor-monitor",
+            daemon=True)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="supervisor-heartbeat",
+            daemon=True)
+        self._monitor.start()
+        self._hb_thread.start()
+        return self
+
+    def __enter__(self) -> "Supervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def port(self) -> Optional[int]:
+        return self._port
+
+    @property
+    def generation(self) -> int:
+        """Backend generation: 0 original, +1 per respawn."""
+        return self._respawns
+
+    @property
+    def alive(self) -> bool:
+        with self._lock:
+            return (not self._dead and self._proc is not None
+                    and self._proc.poll() is None)
+
+    def stats(self) -> Dict[str, Any]:
+        """JSON-ready supervisor-side counters (the soak artifact's
+        ``supervisor`` block)."""
+        with self._lock:
+            return {"generation": self._respawns,
+                    "respawns": self._respawns,
+                    "max_respawns": self.max_respawns,
+                    "resubmits": self._resubmits,
+                    "backend_lost_requests": self._lost_requests,
+                    "n_inflight": len(self._inflight),
+                    "alive": (self._proc is not None
+                              and self._proc.poll() is None),
+                    "dead": self._dead}
+
+    def server_stats(self, timeout: float = 30.0) -> Dict[str, Any]:
+        """The live backend's ``stats`` reply (serve counters,
+        per-tenant in-flight)."""
+        with self._lock:
+            client = self._client
+        if client is None:
+            raise ServerClosed("no live backend")
+        return client.stats(timeout=timeout)
+
+    def install_signal_handlers(self) -> GracefulStop:
+        """SIGTERM/SIGINT → graceful drain (flag only; the heartbeat
+        thread notices and starts :meth:`close`)."""
+        return self._stop.install()
+
+    # -- request path ----------------------------------------------------
+    def submit(self, kind: str, *, tenant: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               **payload) -> ServeFuture:
+        """Admit one request through the supervised backend. The
+        returned future ALWAYS resolves: a value with its status, a
+        ``BACKEND_LOST``/``DEADLINE_EXCEEDED`` status as data, or a
+        typed error (overload, closed) — crash, hang, and poison are
+        absorbed by respawn + re-submission."""
+        with self._lock:
+            if self._draining or self._dead:
+                raise ServerClosed(
+                    "supervisor is draining or backend is lost")
+            if not self._started:
+                raise ServerClosed("supervisor not started")
+            t_submit = time.perf_counter()
+            entry = _InFlight(
+                kind=kind, tenant=tenant, payload=dict(payload),
+                future=ServeFuture(), t_submit=t_submit,
+                deadline=(None if deadline_ms is None
+                          else t_submit + float(deadline_ms) * 1e-3))
+            self._inflight[next(self._ids)] = entry
+        self._try_send(entry)
+        return entry.future
+
+    def _remove(self, entry: _InFlight) -> None:
+        with self._lock:
+            for eid, e in list(self._inflight.items()):
+                if e is entry:
+                    del self._inflight[eid]
+                    return
+
+    def _resolve_status(self, entry: _InFlight, status: int) -> None:
+        """Resolve an entry with a host-side status-as-data result."""
+        self._remove(entry)
+        try:
+            entry.future.set_result(make_result(
+                {}, status, kind=entry.kind, bucket=0, occupancy=0,
+                queue_wait_ms=(time.perf_counter()
+                               - entry.t_submit) * 1e3,
+                solve_ms=0.0))
+        except Exception:            # noqa: BLE001 — racing resolution
+            pass
+
+    def _try_send(self, entry: _InFlight) -> None:
+        with self._lock:
+            client, generation = self._client, self._respawns
+            if client is None:
+                return               # respawn in progress: queued
+            if entry.generation_sent >= generation \
+                    or entry.future.done():
+                # already claimed for this backend generation: submit()
+                # racing the monitor's _resubmit_all must not
+                # double-send (and double-charge the retry budget)
+                return
+            entry.generation_sent = generation
+        if entry.deadline is not None:
+            remaining_ms = (entry.deadline
+                            - time.perf_counter()) * 1e3
+            if remaining_ms <= 0.0:
+                self._resolve_status(
+                    entry, int(SolveStatus.DEADLINE_EXCEEDED))
+                return
+        else:
+            remaining_ms = None
+        try:
+            wire_fut = client.submit(
+                entry.kind, tenant=entry.tenant,
+                deadline_ms=remaining_ms, **entry.payload)
+        except TransportClosed:
+            with self._lock:
+                entry.generation_sent = -1
+            return                   # respawn will re-send
+        if wire_fut.done() and isinstance(wire_fut.exception(),
+                                          TransportClosed):
+            # the send itself failed (dead socket): the request never
+            # reached a backend, so it must not burn retry budget
+            with self._lock:
+                entry.generation_sent = -1
+            return
+        entry.attempts += 1
+        wire_fut.add_done_callback(
+            lambda f, e=entry: self._on_wire_done(e, f))
+
+    def _on_wire_done(self, entry: _InFlight, fut: ServeFuture) -> None:
+        exc = fut.exception()
+        if exc is None:
+            self._remove(entry)
+            try:
+                entry.future.set_result(fut.result())
+            except Exception:        # noqa: BLE001 — racing resolution
+                pass
+            return
+        if isinstance(exc, TransportClosed):
+            # backend died with this request on board: the monitor
+            # respawns and re-submits; the entry stays in flight
+            return
+        if is_poisoned(exc):
+            # the driver's classification, reused verbatim: retrying
+            # against a poisoned process is wasted work — kill it, let
+            # the monitor respawn (the re-exec stamp heals the poison),
+            # and keep this entry in flight for re-submission
+            self._kill_backend(f"poisoned backend reply: {exc}")
+            return
+        # typed admission/lifecycle error (overload, closed, bad
+        # payload): the caller's to handle — propagate as-is
+        self._remove(entry)
+        try:
+            entry.future.set_exception(exc)
+        except Exception:            # noqa: BLE001 — racing resolution
+            pass
+
+    # -- failure detection -----------------------------------------------
+    def _kill_backend(self, reason: str) -> None:
+        with self._lock:
+            if self._draining:
+                return
+            if self._lost_reason is None:
+                self._lost_reason = reason
+            proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+    def _heartbeat_loop(self) -> None:
+        last_pong = time.perf_counter()
+        hb_seen = self._hb
+        while True:
+            time.sleep(self.heartbeat_s)
+            with self._lock:
+                if self._draining or self._dead:
+                    return
+                hb = self._hb
+            if self._stop.requested:
+                # SIGTERM landed: drain from a fresh thread (close()
+                # joins this one)
+                threading.Thread(target=self.close,
+                                 name="supervisor-drain",
+                                 daemon=True).start()
+                return
+            if hb is None:
+                continue             # respawn in progress
+            if hb is not hb_seen:
+                hb_seen, last_pong = hb, time.perf_counter()
+            try:
+                hb.ping(timeout=self.heartbeat_s)
+                last_pong = time.perf_counter()
+            except Exception:        # noqa: BLE001 — miss or torn conn
+                if (time.perf_counter() - last_pong
+                        > self.hang_timeout_s):
+                    # wedged-but-alive: data plane may even be serving,
+                    # but a backend that cannot answer its watchdog is
+                    # not healthy enough to hold in-flight futures
+                    self._kill_backend(
+                        f"heartbeat silent > {self.hang_timeout_s}s")
+                    last_pong = time.perf_counter()
+
+    def _close_clients(self) -> None:
+        with self._lock:
+            client, hb = self._client, self._hb
+            self._client = self._hb = None
+        for c in (client, hb):
+            if c is not None:
+                c.close()
+
+    def _monitor_loop(self) -> None:
+        while True:
+            with self._lock:
+                proc = self._proc
+            rc = proc.wait()
+            with self._lock:
+                if self._draining:
+                    # graceful drain exit: close() owns the clients —
+                    # tearing them down here would race the recv
+                    # threads still delivering the drain's last replies
+                    return
+            # fail the wire futures FIRST: their TransportClosed keeps
+            # the entries in flight for re-submission
+            self._close_clients()
+            with self._lock:
+                reason = (self._lost_reason
+                          or f"backend crashed (rc={rc})")
+                self._lost_reason = None
+                respawns = self._respawns
+            self._rec.event("supervisor.backend_lost", reason=reason,
+                            rc=rc, generation=respawns,
+                            n_inflight=len(self._inflight))
+            if respawns >= self.max_respawns:
+                self._mark_dead(
+                    f"respawn budget ({self.max_respawns}) exhausted "
+                    f"after: {reason}")
+                return
+            with self._lock:
+                self._respawns = respawns + 1
+            self._rec.inc("supervisor.respawns")
+            try:
+                self._spawn(respawns + 1)
+            except SupervisorError as exc:
+                self._mark_dead(str(exc))
+                return
+            self._resubmit_all()
+
+    def _mark_dead(self, reason: str) -> None:
+        with self._lock:
+            self._dead = True
+            entries = list(self._inflight.values())
+            self._inflight.clear()
+        self._rec.event("supervisor.respawn_exhausted", reason=reason,
+                        n_inflight=len(entries))
+        for entry in entries:
+            self._lost_requests += 1
+            self._rec.inc("supervisor.backend_lost_requests")
+            try:
+                entry.future.set_result(make_result(
+                    {}, int(SolveStatus.BACKEND_LOST),
+                    kind=entry.kind, bucket=0, occupancy=0,
+                    queue_wait_ms=(time.perf_counter()
+                                   - entry.t_submit) * 1e3,
+                    solve_ms=0.0))
+            except Exception:        # noqa: BLE001 — racing resolution
+                pass
+
+    def _resubmit_all(self) -> None:
+        with self._lock:
+            entries = list(self._inflight.values())
+            generation = self._respawns
+        for entry in entries:
+            if entry.future.done():
+                continue
+            if entry.generation_sent >= generation:
+                continue             # already on the live backend
+            if entry.attempts > self.retry_budget:
+                # the per-request budget is spent: resolve with
+                # BACKEND_LOST as data instead of riding respawns
+                # forever
+                self._lost_requests += 1
+                self._rec.inc("supervisor.backend_lost_requests")
+                self._resolve_status(entry,
+                                     int(SolveStatus.BACKEND_LOST))
+                continue
+            if entry.attempts > 0:
+                self._resubmits += 1
+                self._rec.inc("supervisor.resubmits")
+            self._try_send(entry)
+
+    # -- shutdown --------------------------------------------------------
+    def close(self, timeout: float = 120.0) -> bool:
+        """Graceful stop: SIGTERM the backend (its ``GracefulStop``
+        drains every ChemServer; replies flush back), wait for it to
+        exit, then fail anything still unresolved with typed
+        ``ServerClosed``. Returns False when the child had to be
+        SIGKILLed after ``timeout``."""
+        with self._lock:
+            if self._draining:
+                already = True
+            else:
+                already = False
+                self._draining = True
+            proc = self._proc
+        graceful = True
+        if not already and proc is not None:
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(_signal.SIGTERM)
+                except OSError:
+                    pass
+                deadline = time.perf_counter() + timeout
+                while proc.poll() is None:
+                    if time.perf_counter() >= deadline:
+                        graceful = False
+                        proc.kill()
+                        proc.wait()
+                        break
+                    time.sleep(0.02)
+            # grace for the recv threads: the exited backend's last
+            # replies may still sit in the socket buffer — let them
+            # resolve their entries before the typed-failure sweep
+            reply_grace = time.perf_counter() + 5.0
+            while time.perf_counter() < reply_grace:
+                with self._lock:
+                    if not self._inflight:
+                        break
+                time.sleep(0.01)
+            self._close_clients()
+            for t in (self._monitor, self._hb_thread):
+                if t is not None and t is not threading.current_thread():
+                    t.join(timeout=10.0)
+            with self._lock:
+                leftovers = list(self._inflight.values())
+                self._inflight.clear()
+            closed = ServerClosed("supervisor drained")
+            for entry in leftovers:
+                try:
+                    entry.future.set_exception(closed)
+                except Exception:    # noqa: BLE001 — racing resolution
+                    pass
+            self._stop.restore()
+            self._rec.event("supervisor.drain", graceful=graceful,
+                            respawns=self._respawns,
+                            resubmits=self._resubmits,
+                            backend_lost=self._lost_requests)
+        return graceful

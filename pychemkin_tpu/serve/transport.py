@@ -1,0 +1,723 @@
+"""Cross-process serving: length-prefixed JSON-over-TCP in front of
+:class:`~pychemkin_tpu.serve.server.ChemServer`.
+
+The in-process server (PR 5) is deliberately transport-agnostic; this
+module is the fleet-facing front it was built for — stdlib-only (no
+HTTP framework to vendor), so the wire contract is fully owned and a
+supervisor (:mod:`.supervisor`) can speak it to a backend child it
+spawned:
+
+- **Framing**: every message is a 4-byte big-endian length prefix plus
+  a UTF-8 JSON object. One socket carries many concurrent requests;
+  replies are demultiplexed by the caller-chosen ``id``.
+- **Multi-tenant routing**: a submit carries a ``tenant`` id. Each
+  tenant maps to a mechanism (mechanism-as-pytree makes mechanisms
+  values, so one backend serves several) and a bounded admission
+  quota of in-flight requests. A tenant over quota gets a typed
+  ``ServerOverloaded`` reply with ``queue_depth`` /
+  ``retry_after_ms`` backpressure hints — one tenant's burst never
+  starves another's admissions (quota isolation is a fast-lane test).
+- **Same core contract**: requests flow into the same engines, the
+  same bucket ladder, the same ``SolveStatus``-as-data futures —
+  remote results bit-match ``solve_direct`` at the same bucket shape
+  (floats survive the JSON round trip exactly: ``repr`` round-trips).
+- **Status-as-data stays data**: a solver failure travels as a
+  ``result`` reply with its status code; only admission, lifecycle,
+  and transport failures become ``error`` replies.
+
+Wire ops (requests carry ``id``; every reply echoes it):
+
+=============  ========================================================
+``submit``     ``{tenant, kind, payload, deadline_ms?}`` → ``result``
+               (a :class:`~.futures.ServeResult` dict) or ``error``
+               (``error`` = exception type name, ``message``, and for
+               overload ``queue_depth``/``retry_after_ms``/``scope``)
+``ping``       → ``pong`` (``n_inflight``); the supervisor heartbeat.
+               Runs :func:`~pychemkin_tpu.resilience.procfaults
+               .on_heartbeat` first, so ``hang_heartbeat`` chaos
+               wedges exactly this plane and nothing else
+``stats``      → ``stats_reply`` (per-server counters, per-tenant
+               in-flight) — how acceptance tests prove deadline-
+               expired requests never dispatched
+``drain``      → drains every ChemServer (in-flight requests resolve,
+               replies flush), then ``drain_done``; the process-level
+               half of ``GracefulStop`` end-to-end
+=============  ========================================================
+
+Run as a backend process (what the supervisor spawns)::
+
+    python -m pychemkin_tpu.serve.transport --port 0 \\
+        --config-json '{"tenants": {"default": {"mech": "h2o2"}}}'
+
+The process prints ``PYCHEMKIN_SERVE_PORT=<port>`` once bound and
+``PYCHEMKIN_SERVE_READY`` after the bucket-ladder warmup — on a
+respawn the warmup replays against the persistent XLA cache, so
+post-respawn dispatches are still compile-cache hits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import queue as _queue
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..resilience import procfaults
+from ..resilience.driver import GracefulStop
+from ..resilience.procfaults import BackendPoisonedError
+from .errors import (
+    ServeError,
+    ServerClosed,
+    ServerOverloaded,
+    TransportClosed,
+)
+from .futures import ServeFuture, ServeResult
+from .server import ChemServer
+
+_LEN = struct.Struct(">I")
+
+#: refuse absurd frames instead of allocating them (a corrupt length
+#: prefix must not look like a 4 GB message)
+MAX_FRAME = 64 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# framing + JSON encoding
+
+def _jsonable(x: Any) -> Any:
+    """Numpy-tolerant JSON encoding; floats round-trip bit-exact."""
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    if isinstance(x, np.generic):
+        return x.item()
+    if isinstance(x, dict):
+        return {k: _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    return x
+
+
+def send_msg(sock: socket.socket, obj: Dict,
+             lock: Optional[threading.Lock] = None) -> None:
+    """One framed message; ``lock`` serializes concurrent writers on a
+    shared socket (worker/rescue callbacks reply on the submit
+    connection)."""
+    data = json.dumps(_jsonable(obj),
+                      separators=(",", ":")).encode("utf-8")
+    frame = _LEN.pack(len(data)) + data
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None              # orderly EOF (or torn mid-frame)
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock: socket.socket) -> Optional[Dict]:
+    """One framed message, or None on EOF."""
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > MAX_FRAME:
+        raise ServeError(f"frame length {n} exceeds {MAX_FRAME}")
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+def result_to_wire(res: ServeResult) -> Dict:
+    return dict(res._asdict())
+
+
+def result_from_wire(d: Dict) -> ServeResult:
+    """Rebuild a ServeResult; list-valued fields come back as float64
+    arrays (the shape every engine's ``value_at`` emits)."""
+    value = {k: (np.asarray(v, np.float64) if isinstance(v, list)
+                 else v)
+             for k, v in d["value"].items()}
+    return ServeResult(**{**d, "value": value})
+
+
+# ---------------------------------------------------------------------------
+# server side
+
+class _ConnWriter:
+    """Outbound side of one server connection: a bounded queue + one
+    writer thread.
+
+    Result replies are produced by future done-callbacks, which run on
+    the ChemServer WORKER thread — a blocking ``sendall`` there (a
+    client that stopped reading, a stalled network) would wedge
+    batching for the whole backend while the heartbeat plane keeps
+    answering, so the watchdog would never notice. Producers therefore
+    only ever enqueue (non-blocking); the writer thread owns the
+    blocking sends. A full queue (slow consumer) drops the reply and
+    CLOSES the connection — the client's pending futures fail with
+    ``TransportClosed``, which is a visible, typed outcome instead of
+    an invisible stall."""
+
+    MAXQ = 1024
+
+    def __init__(self, conn: socket.socket, recorder):
+        self._conn = conn
+        self._rec = recorder
+        self._q: "_queue.Queue[Optional[Dict]]" = _queue.Queue(
+            maxsize=self.MAXQ)
+        self._thread = threading.Thread(
+            target=self._run, name="transport-conn-writer", daemon=True)
+        self._thread.start()
+
+    def send(self, obj: Dict) -> bool:
+        """Enqueue a reply; never blocks. False if it was dropped."""
+        try:
+            self._q.put_nowait(obj)
+            return True
+        except _queue.Full:
+            self._rec.inc("serve.transport.reply_dropped")
+            try:
+                # slow consumer: fail its connection loudly rather
+                # than buffer without bound or stall a producer
+                self._conn.close()
+            except OSError:
+                pass
+            return False
+
+    def close(self) -> None:
+        try:
+            self._q.put_nowait(None)
+        except _queue.Full:
+            pass                     # writer is already doomed/closing
+
+    def _run(self) -> None:
+        while True:
+            obj = self._q.get()
+            if obj is None:
+                return
+            try:
+                send_msg(self._conn, obj)
+            except OSError:
+                self._rec.inc("serve.transport.reply_dropped")
+                return               # connection gone; reader cleans up
+
+
+class _Tenant:
+    """Admission bookkeeping for one tenant: its mechanism and its
+    bounded in-flight quota (mutated under the owning server's quota
+    lock)."""
+
+    __slots__ = ("name", "mech", "quota", "inflight")
+
+    def __init__(self, name: str, mech: str, quota: int):
+        if quota <= 0:
+            raise ValueError(
+                f"tenant {name!r}: quota must be positive, got {quota}")
+        self.name = name
+        self.mech = mech
+        self.quota = int(quota)
+        self.inflight = 0
+
+
+class TransportServer:
+    """TCP front over one or more :class:`ChemServer` cores.
+
+    ``tenants`` maps tenant id -> ``{"mech": <embedded mech name>,
+    "quota": <max in-flight requests>}``. Tenants sharing a mechanism
+    share one ChemServer (their batches coalesce); quotas stay
+    per-tenant. ``servers`` optionally supplies pre-built ChemServers
+    keyed by mech name (tests, custom mechanisms); missing ones are
+    built from :func:`pychemkin_tpu.mechanism.load_embedded` with
+    ``chem_kwargs``.
+    """
+
+    DEFAULT_QUOTA = 64
+
+    def __init__(self, tenants: Dict[str, Dict], *,
+                 servers: Optional[Dict[str, ChemServer]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 recorder=None,
+                 chem_kwargs: Optional[Dict] = None):
+        if not tenants:
+            raise ValueError("need at least one tenant")
+        self._tenants = {
+            name: _Tenant(name, cfg["mech"],
+                          int(cfg.get("quota", self.DEFAULT_QUOTA)))
+            for name, cfg in tenants.items()}
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
+        self._chem_kwargs = dict(chem_kwargs or {})
+        self._servers: Dict[str, ChemServer] = dict(servers or {})
+        self._host, self._port = host, int(port)
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: list = []
+        self._quota_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._req_ordinal = itertools.count()
+        self._hb_ordinal = itertools.count()
+        self._closed = False
+        self._drained = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+    def _server_for(self, mech_name: str) -> ChemServer:
+        with self._lock:
+            srv = self._servers.get(mech_name)
+            if srv is None:
+                from ..mechanism import load_embedded
+
+                srv = ChemServer(load_embedded(mech_name),
+                                 recorder=self._rec,
+                                 **self._chem_kwargs)
+                self._servers[mech_name] = srv
+            return srv
+
+    def start(self) -> "TransportServer":
+        if self._listener is not None:
+            return self
+        for tenant in self._tenants.values():
+            self._server_for(tenant.mech).start()
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind((self._host, self._port))
+        lst.listen(32)
+        self._port = lst.getsockname()[1]
+        self._listener = lst
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="transport-accept",
+            daemon=True)
+        self._accept_thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def warmup(self, kinds=None, **kw) -> Dict[str, Dict[str, int]]:
+        """Warm every ChemServer's bucket ladder (see
+        :meth:`ChemServer.warmup`); per-mech compile counts."""
+        return {mech: srv.warmup(kinds, **kw)
+                for mech, srv in sorted(self._servers.items())}
+
+    @property
+    def drained(self) -> bool:
+        return self._drained.is_set()
+
+    def drain(self) -> None:
+        """Drain every ChemServer (in-flight requests resolve, their
+        replies flush through the done-callbacks), then mark the
+        transport drained. Idempotent."""
+        for srv in list(self._servers.values()):
+            srv.close()
+        self._rec.event("serve.transport.drain",
+                        n_conns=len(self._conns))
+        self._drained.set()
+
+    def close(self) -> None:
+        """Drain, stop accepting, drop connections."""
+        if self._closed:
+            return
+        self._closed = True
+        self.drain()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+
+    def __enter__(self) -> "TransportServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- connection handling ---------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return               # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="transport-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        writer = _ConnWriter(conn, self._rec)
+        try:
+            while True:
+                msg = recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg.get("op")
+                if op == "submit":
+                    self._handle_submit(msg, writer)
+                elif op == "ping":
+                    # the chaos hook sleeps HERE on hang_heartbeat: the
+                    # pong misses its window while the data plane (its
+                    # own connection/threads) keeps serving
+                    procfaults.on_heartbeat(next(self._hb_ordinal))
+                    n = sum(t.inflight for t in self._tenants.values())
+                    writer.send({"op": "pong", "id": msg.get("id"),
+                                 "n_inflight": n})
+                elif op == "stats":
+                    writer.send(self._stats_reply(msg.get("id")))
+                elif op == "drain":
+                    threading.Thread(
+                        target=self._drain_and_ack,
+                        args=(writer, msg.get("id")),
+                        name="transport-drain", daemon=True).start()
+                else:
+                    writer.send({"op": "error", "id": msg.get("id"),
+                                 "error": "ValueError",
+                                 "message": f"unknown op {op!r}"})
+        except (OSError, ValueError, ServeError):
+            return                   # connection torn; futures already
+        finally:                     # carry replies or die with client
+            writer.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass                 # close() already swept it
+
+    def _drain_and_ack(self, writer: _ConnWriter, rid) -> None:
+        self.drain()
+        writer.send({"op": "drain_done", "id": rid})
+
+    def _stats_reply(self, rid) -> Dict:
+        with self._quota_lock:
+            tenants = {t.name: t.inflight
+                       for t in self._tenants.values()}
+        # snapshot() copies under the recorder's lock: iterating the
+        # live counters dict would race hot-path inc() resizes
+        counters = {k: v
+                    for k, v in self._rec.snapshot()["counters"].items()
+                    if k.startswith("serve.")}
+        return {"op": "stats_reply", "id": rid, "tenants": tenants,
+                "counters": counters}
+
+    def _overload_reply(self, rid, *, scope: str, queue_depth: int,
+                        retry_after_ms: Optional[float],
+                        message: str) -> Dict:
+        return {"op": "error", "id": rid, "error": "ServerOverloaded",
+                "scope": scope, "queue_depth": queue_depth,
+                "retry_after_ms": retry_after_ms, "message": message}
+
+    def _handle_submit(self, msg: Dict, writer: _ConnWriter) -> None:
+        rid = msg.get("id")
+        try:
+            procfaults.on_serve_request(next(self._req_ordinal))
+        except BackendPoisonedError as exc:
+            # the poisoned-client failure class: the supervisor's
+            # is_poisoned classification reads this reply and respawns
+            # instead of wasting per-request retries on this process
+            writer.send({"op": "error", "id": rid,
+                         "error": "BackendPoisonedError",
+                         "message": str(exc)})
+            return
+        tenant = self._tenants.get(msg.get("tenant", "default"))
+        if tenant is None:
+            writer.send({"op": "error", "id": rid,
+                         "error": "UnknownTenant",
+                         "message": f"unknown tenant "
+                                    f"{msg.get('tenant')!r}"})
+            return
+        srv = self._server_for(tenant.mech)
+        with self._quota_lock:
+            if tenant.inflight >= tenant.quota:
+                # per-tenant bounded admission: this tenant's burst is
+                # refused with a backpressure hint while other tenants'
+                # quotas (and the shared queue) stay untouched
+                self._rec.inc("serve.tenant_rejected")
+                self._rec.inc(f"serve.tenant_rejected.{tenant.name}")
+                over = True
+            else:
+                tenant.inflight += 1
+                over = False
+        if over:
+            writer.send(self._overload_reply(
+                rid, scope="tenant", queue_depth=tenant.quota,
+                retry_after_ms=srv.retry_hint_ms(),
+                message=f"tenant {tenant.name!r} quota "
+                        f"({tenant.quota}) saturated"))
+            return
+        try:
+            fut = srv.submit(msg["kind"],
+                             deadline_ms=msg.get("deadline_ms"),
+                             **msg.get("payload", {}))
+        except BaseException as exc:   # noqa: BLE001 — typed reply
+            with self._quota_lock:
+                tenant.inflight -= 1
+            if isinstance(exc, ServerOverloaded):
+                reply = self._overload_reply(
+                    rid, scope="server", queue_depth=exc.queue_depth,
+                    retry_after_ms=exc.retry_after_ms,
+                    message=str(exc))
+            else:
+                reply = {"op": "error", "id": rid,
+                         "error": type(exc).__name__,
+                         "message": str(exc)}
+            writer.send(reply)
+            return
+
+        def _reply(f: ServeFuture, _rid=rid, _tenant=tenant) -> None:
+            with self._quota_lock:
+                _tenant.inflight -= 1
+            exc = f.exception()
+            if exc is None:
+                out = {"op": "result", "id": _rid,
+                       "result": result_to_wire(f.result())}
+            elif isinstance(exc, ServerOverloaded):
+                out = self._overload_reply(
+                    _rid, scope="server", queue_depth=exc.queue_depth,
+                    retry_after_ms=exc.retry_after_ms,
+                    message=str(exc))
+            else:
+                out = {"op": "error", "id": _rid,
+                       "error": type(exc).__name__,
+                       "message": str(exc)}
+            # enqueue only: this runs on the ChemServer worker/rescue
+            # threads, and a blocking send here would let one stalled
+            # client wedge batching for every tenant
+            writer.send(out)
+
+        fut.add_done_callback(_reply)
+
+
+# ---------------------------------------------------------------------------
+# client side
+
+class TransportClient:
+    """One socket to a :class:`TransportServer`; thread-safe submits
+    demultiplexed by message id.
+
+    ``submit`` mirrors :meth:`ChemServer.submit` (returns a
+    :class:`ServeFuture` resolving to a :class:`ServeResult`), so load
+    generators and tests drive local and remote servers through one
+    duck type. Overload comes back as a ``ServerOverloaded`` failure
+    ON THE FUTURE (admission happens on the far side of the wire). A
+    dropped connection fails every pending future with
+    :class:`TransportClosed` — under a supervisor that is the signal
+    to re-submit against the respawned backend."""
+
+    def __init__(self, host: str, port: int, *,
+                 tenant: str = "default",
+                 connect_timeout_s: float = 30.0):
+        self.tenant = tenant
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=connect_timeout_s)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: Dict[int, Tuple[str, ServeFuture]] = {}
+        self._ids = itertools.count()
+        self._closed = False
+        self._rx = threading.Thread(target=self._recv_loop,
+                                    name="transport-client-recv",
+                                    daemon=True)
+        self._rx.start()
+
+    # -- plumbing --------------------------------------------------------
+    def _register(self, kind: str) -> Tuple[int, ServeFuture]:
+        fut = ServeFuture()
+        with self._plock:
+            if self._closed:
+                raise TransportClosed("transport client closed")
+            rid = next(self._ids)
+            self._pending[rid] = (kind, fut)
+        return rid, fut
+
+    def _send(self, msg: Dict, rid: int, fut: ServeFuture) -> None:
+        try:
+            send_msg(self._sock, msg, self._wlock)
+        except OSError as exc:
+            with self._plock:
+                self._pending.pop(rid, None)
+            fut.set_exception(
+                TransportClosed(f"send failed: {exc}"))
+
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                msg = recv_msg(self._sock)
+                if msg is None:
+                    break
+                self._dispatch(msg)
+        except (OSError, ValueError, ServeError):
+            pass
+        finally:
+            self._fail_pending(TransportClosed(
+                "connection to serving backend dropped"))
+
+    def _dispatch(self, msg: Dict) -> None:
+        rid = msg.get("id")
+        with self._plock:
+            entry = self._pending.pop(rid, None)
+        if entry is None:
+            return                   # late reply for an abandoned id
+        _, fut = entry
+        op = msg.get("op")
+        try:
+            if op == "result":
+                fut.set_result(result_from_wire(msg["result"]))
+            elif op == "error":
+                fut.set_exception(_remote_error(msg))
+            else:                    # pong / stats_reply / drain_done
+                fut.set_result(msg)
+        except Exception:            # noqa: BLE001 — already resolved
+            pass
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        with self._plock:
+            self._closed = True
+            pending, self._pending = dict(self._pending), {}
+        for _, fut in pending.values():
+            try:
+                fut.set_exception(exc)
+            except Exception:        # noqa: BLE001 — racing resolution
+                pass
+
+    # -- API -------------------------------------------------------------
+    def submit(self, kind: str, *, tenant: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               **payload) -> ServeFuture:
+        rid, fut = self._register(kind)
+        self._send({"op": "submit", "id": rid,
+                    "tenant": tenant or self.tenant, "kind": kind,
+                    "deadline_ms": deadline_ms, "payload": payload},
+                   rid, fut)
+        return fut
+
+    def _control(self, op: str, timeout: float) -> Dict:
+        rid, fut = self._register(op)
+        self._send({"op": op, "id": rid}, rid, fut)
+        return fut.result(timeout=timeout)
+
+    def ping(self, timeout: float = 5.0) -> Dict:
+        return self._control("ping", timeout)
+
+    def stats(self, timeout: float = 30.0) -> Dict:
+        return self._control("stats", timeout)
+
+    def drain(self, timeout: float = 300.0) -> Dict:
+        """Graceful remote drain; blocks until ``drain_done`` (every
+        in-flight request's reply lands first — FIFO per connection
+        guarantees the acks trail the results on this socket, and the
+        backend only acks after every ChemServer closed)."""
+        return self._control("drain", timeout)
+
+    def close(self) -> None:
+        with self._plock:
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._rx.join(timeout=5.0)
+
+
+def _remote_error(msg: Dict) -> BaseException:
+    name = msg.get("error", "ServeError")
+    text = msg.get("message", "")
+    if name == "ServerOverloaded":
+        return ServerOverloaded(
+            text, queue_depth=int(msg.get("queue_depth", 0)),
+            retry_after_ms=msg.get("retry_after_ms"))
+    if name == "ServerClosed":
+        return ServerClosed(text)
+    if name == "BackendPoisonedError":
+        return BackendPoisonedError(text)
+    exc = ServeError(f"{name}: {text}")
+    exc.remote_type = name
+    return exc
+
+
+# ---------------------------------------------------------------------------
+# backend process entry point
+
+#: stdout markers the supervisor parses (flushed, one per line)
+PORT_MARKER = "PYCHEMKIN_SERVE_PORT="
+READY_MARKER = "PYCHEMKIN_SERVE_READY"
+
+DEFAULT_CONFIG = {"tenants": {"default": {"mech": "h2o2"}},
+                  "kinds": ["equilibrium"]}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="pychemkin serving backend (JSON-over-TCP)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral; the chosen port is printed as "
+                        f"{PORT_MARKER}<port>")
+    p.add_argument("--config-json", default=None,
+                   help="JSON config: {tenants: {name: {mech, quota}},"
+                        " kinds: [...], chem: {...}, engine_config:"
+                        " {...}}")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    config = dict(DEFAULT_CONFIG)
+    if args.config_json:
+        config.update(json.loads(args.config_json))
+    chem_kwargs = dict(config.get("chem", {}))
+    if config.get("engine_config"):
+        chem_kwargs["engine_config"] = config["engine_config"]
+    ts = TransportServer(config["tenants"], host=args.host,
+                         port=args.port, chem_kwargs=chem_kwargs)
+    ts.start()
+    print(f"{PORT_MARKER}{ts.port}", flush=True)
+    t0 = time.perf_counter()
+    ts.warmup(config.get("kinds") or None)
+    print(f"# warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    # READY only after the ladder is warm: the supervisor's respawn
+    # path waits for this line, so post-respawn traffic always lands on
+    # compiled (persistent-XLA-cache-hit) programs
+    print(READY_MARKER, flush=True)
+    stop = GracefulStop().install()
+    while not stop.requested and not ts.drained:
+        time.sleep(0.05)
+    ts.close()
+    stop.restore()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,14 +47,33 @@ Kinds emitted by the framework:
                      raised (futures carry the error, worker
                      survives) / the worker loop itself died (queued
                      futures failed, thread exits).
+- ``serve.transport.drain`` — a transport backend drained its
+                     ChemServers (every in-flight reply flushed)
+                     before exiting; see
+                     ``pychemkin_tpu/serve/transport.py``.
+- ``supervisor.spawn``        — a supervised backend child came up
+                     (generation, pid, port); generation > 0 is a
+                     respawn (see ``pychemkin_tpu/serve/supervisor.py``).
+- ``supervisor.backend_lost`` — the backend crashed, hung past the
+                     heartbeat timeout, or answered with a
+                     poisoned-client error (reason, rc, generation,
+                     n_inflight).
+- ``supervisor.respawn_exhausted`` — the respawn budget ran out: all
+                     in-flight requests resolved with
+                     ``SolveStatus.BACKEND_LOST`` as data.
+- ``supervisor.drain``        — graceful supervisor shutdown
+                     (graceful, respawns, resubmits, backend_lost).
 
 Histograms (``MetricsRecorder.observe``; p50/p95/p99 under
 ``histograms`` in ``snapshot()``): ``serve.queue_wait_ms``,
 ``serve.solve_ms``, ``serve.batch_occupancy``. The serving layer also
 maintains the ``serve.queue_depth`` gauge and ``serve.requests`` /
-``serve.rejected`` / ``serve.batches`` / ``serve.rescued`` /
-``serve.abandoned`` / ``serve.status.<NAME>`` / ``serve.compiles[.*]``
-counters.
+``serve.rejected`` / ``serve.deadline_expired`` / ``serve.batches`` /
+``serve.rescued`` / ``serve.abandoned`` / ``serve.status.<NAME>`` /
+``serve.compiles[.*]`` counters; the transport layer adds
+``serve.tenant_rejected[.<tenant>]`` (quota refusals) and the
+supervisor ``supervisor.respawns`` / ``supervisor.resubmits`` /
+``supervisor.backend_lost_requests``.
 
 Counters maintained on the default recorder include the pivot-free-LU
 residual-check outcomes, bridged from device via

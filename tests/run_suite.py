@@ -45,6 +45,15 @@ Behaviour:
   the file whose env-gated tests exercise the env activation path.
   Other test files must never run under a global injection spec: their
   sweeps would pick up the poisoned elements;
+- ``--chaos`` is the PROCESS-level counterpart for the serving path:
+  children get ``PYCHEMKIN_PROC_FAULTS`` set to a canned
+  kill-backend-at-request spec (unless already exported) and — when no
+  files are named — the run is restricted to ``tests/test_serve_transport.py``,
+  whose env-gated chaos tests spawn supervised backends that inherit
+  the spec. Every chaos recovery path (kill / hang / poison) runs in
+  CI on CPU this way; the file's deterministic tests scrub the env var
+  themselves (autouse fixture), so the canned spec cannot leak into
+  them;
 - exit code is 0 iff every file's pytest exited 0 or 5 (with at least
   one 0);
 - a per-file line and a final summary are printed; the summary ends
@@ -74,8 +83,15 @@ FILE_TIMEOUT = int(os.environ.get("RUN_SUITE_FILE_TIMEOUT", "2400"))
 FAULTS_ENV_SPEC = ('[{"mode": "nan_rhs", "elements": [1], '
                    '"heal_at": 1}]')
 
+#: the --chaos default injection spec: the serving backend is
+#: SIGKILLed when submit ordinal 2 arrives — exercised by the
+#: env-gated tests of tests/test_serve_transport.py (supervised backends
+#: inherit the env; the supervisor must respawn and re-submit)
+CHAOS_ENV_SPEC = ('[{"mode": "kill_backend_at_request", '
+                  '"request": 2}]')
 
-def _child_env(faults=False):
+
+def _child_env(faults=False, chaos=False):
     env = dict(os.environ)
     # never dial the TPU tunnel from test children (hung-tunnel hazard;
     # tests are pinned to the virtual-CPU mesh anyway)
@@ -86,6 +102,8 @@ def _child_env(faults=False):
     env["_PYCHEMKIN_SUITE_CHILD"] = "1"
     if faults:
         env.setdefault("PYCHEMKIN_FAULTS", FAULTS_ENV_SPEC)
+    if chaos:
+        env.setdefault("PYCHEMKIN_PROC_FAULTS", CHAOS_ENV_SPEC)
     return env
 
 
@@ -121,8 +139,9 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     stop_on_fail = any(a in ("-x", "--exitfirst") for a in argv)
     faults = "--faults" in argv
-    if faults:
-        argv = [a for a in argv if a != "--faults"]
+    chaos = "--chaos" in argv
+    if faults or chaos:
+        argv = [a for a in argv if a not in ("--faults", "--chaos")]
 
     here = os.path.dirname(os.path.abspath(__file__))
     selected, selectors, flags = _split_args(argv)
@@ -131,17 +150,22 @@ def main(argv=None):
         for path in selectors:
             if path not in files:
                 files.append(path)
-    elif faults:
-        # only the resilience suite may run under a global injection
-        # spec — any other file's sweeps would pick up the poison
-        files = [os.path.join(here, "test_resilience.py")]
+    elif faults or chaos:
+        # only the files whose env-gated tests OWN the canned spec may
+        # run under a global injection env — any other file would pick
+        # up the poison/kill it never asked for
+        files = []
+        if faults:
+            files.append(os.path.join(here, "test_resilience.py"))
+        if chaos:
+            files.append(os.path.join(here, "test_serve_transport.py"))
     else:
         files = sorted(glob.glob(os.path.join(here, "test_*.py")))
     if not files:
         print("run_suite: no test files found", file=sys.stderr)
         return 2
 
-    env = _child_env(faults=faults)
+    env = _child_env(faults=faults, chaos=chaos)
     results = []
     t_suite = time.time()
 

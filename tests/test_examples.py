@@ -24,6 +24,10 @@ FAST = [
     "engine/hcci_engine.py",
     "reactor_network/psr_chain_cluster.py",
     "serve/online_requests.py",
+    # two process spawns + warmups: real, but too heavy for the
+    # tier-1 wall-clock budget — slow lane
+    pytest.param("serve/supervised_serving.py",
+                 marks=pytest.mark.slow),
 ]
 
 

@@ -213,6 +213,85 @@ class TestAdmission:
         with pytest.raises(ServerClosed):
             server.submit_equilibrium(**_eq_payload(Y_h2air))
 
+    def test_overload_carries_retry_hint(self, mech, Y_h2air):
+        """ISSUE 7: overload is a backpressure REPLY, not a bare
+        string — queue_depth plus a positive retry_after_ms hint."""
+        server = serve.ChemServer(mech, queue_depth=1)
+        server.submit_equilibrium(**_eq_payload(Y_h2air))
+        with pytest.raises(ServerOverloaded) as ei:
+            server.submit_equilibrium(**_eq_payload(Y_h2air))
+        assert ei.value.queue_depth == 1
+        assert ei.value.retry_after_ms is not None
+        assert ei.value.retry_after_ms > 0
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# request deadlines (ISSUE 7): expired requests never dispatch
+
+class TestDeadlines:
+    def test_expired_request_resolves_without_dispatch(self, mech,
+                                                       Y_h2air):
+        """A request whose deadline passed resolves DEADLINE_EXCEEDED
+        as data and provably never reaches a compiled program: batch
+        and compile counters are untouched by it, and a live companion
+        in the same window still solves."""
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, bucket_sizes=(1, 2),
+                                  max_delay_ms=50.0, recorder=rec)
+        server.warmup(["equilibrium"])
+        warm_compiles = rec.counters["serve.compiles"]
+        # admit both BEFORE start: the worker pops them together, so
+        # the expired one is dropped in the very window that solves
+        # the live one
+        dead = server.submit_equilibrium(**_eq_payload(Y_h2air),
+                                         deadline_ms=0.0)
+        live = server.submit_equilibrium(**_eq_payload(Y_h2air, 1500.0),
+                                         deadline_ms=60_000.0)
+        with server:
+            dres = dead.result(timeout=60)
+            lres = live.result(timeout=60)
+        assert dres.status_name == "DEADLINE_EXCEEDED"
+        assert not dres.ok and dres.value == {}
+        assert dres.occupancy == 0 and dres.bucket == 0
+        assert lres.ok
+        # the expired request consumed no batch slot: the live one
+        # solved alone in the 1-bucket
+        assert (lres.occupancy, lres.bucket) == (1, 1)
+        assert rec.counters["serve.batches"] == 1
+        assert rec.counters["serve.compiles"] == warm_compiles
+        assert rec.counters["serve.deadline_expired"] == 1
+        assert rec.counters["serve.status.DEADLINE_EXCEEDED"] == 1
+
+    def test_rescue_rung_gated_by_deadline(self, mech, Y_h2air):
+        """The rescue ladder starts no rung past the deadline: a
+        failed request whose budget is spent resolves immediately with
+        the hot path's diagnosis (deadline_cut in the rescue event),
+        instead of burning ladder time nobody waits for."""
+        import time as _time
+
+        from pychemkin_tpu.serve.futures import Request, ServeFuture
+
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, recorder=rec)
+        eng = server.engine("equilibrium")
+        norm = eng.normalize(_eq_payload(Y_h2air))
+        req = Request(kind="equilibrium", key=eng.group_key(norm),
+                      payload=norm, future=ServeFuture(),
+                      t_submit=_time.perf_counter(),
+                      deadline=_time.perf_counter() - 1.0)  # expired
+        base_status = 1                                   # TOL_NOT_MET
+        meta = dict(kind="equilibrium", bucket=1, occupancy=1,
+                    queue_wait_ms=0.0, solve_ms=0.0)
+        server._rescue_one((req, eng.group_key(norm), {"T": 0.0},
+                            base_status, 0, meta))
+        res = req.future.result(timeout=5)
+        assert res.status_name == "TOL_NOT_MET"   # hot-path diagnosis
+        assert res.rescue_rungs == 0              # NO rung started
+        ev = rec.last_event("serve.rescue")
+        assert ev["deadline_cut"] is True and ev["rungs"] == 0
+        assert rec.counters["serve.abandoned"] == 1
+
 
 # ---------------------------------------------------------------------------
 # micro-batching + compile reuse (one warmed server, equilibrium only)
@@ -288,6 +367,44 @@ class TestDrain:
         assert not server._worker.is_alive()
         assert not server._rescuer.is_alive()
 
+    def test_close_timeout_then_late_close_still_drains(self, mech,
+                                                        Y_h2air):
+        """ISSUE 7 satellite: a bounded close() that expires returns
+        False WITHOUT marking the server closed — admissions stay
+        refused, the drain keeps running — and a later unbounded
+        close() completes it: the queued request resolves, both
+        threads exit, and the rescue sentinel is not stranded."""
+        server = serve.ChemServer(mech, bucket_sizes=(1, 2),
+                                  max_delay_ms=5.0)
+        eng = server.engine("equilibrium")
+        orig_solve = eng.solve
+        release = threading.Event()
+
+        def slow_solve(payloads, bucket, key):
+            release.wait(timeout=60)
+            return orig_solve(payloads, bucket, key)
+
+        eng.solve = slow_solve
+        server.start()
+        fut = server.submit_equilibrium(**_eq_payload(Y_h2air))
+        # wait until the worker holds the in-flight batch
+        t0 = time.perf_counter()
+        while server._queue.qsize() and time.perf_counter() - t0 < 10:
+            time.sleep(0.01)
+        assert server.close(timeout=0.05) is False
+        assert not server._closed          # NOT marked closed
+        with pytest.raises(ServerClosed):  # admissions stay refused
+            server.submit_equilibrium(**_eq_payload(Y_h2air))
+        release.set()                      # un-wedge the solve
+        assert server.close() is True      # the late close drains
+        assert fut.result(timeout=5).ok    # admitted work completed
+        assert not server._worker.is_alive()
+        # the rescue sentinel was not stranded by the timed-out close:
+        # the rescue thread consumed it and exited
+        assert not server._rescuer.is_alive()
+        assert server._rescue_q.qsize() == 0
+        assert server.close() is True      # idempotent after success
+
     def test_sigterm_drains_in_flight_batch(self, mech, Y_h2air):
         before = signal.getsignal(signal.SIGTERM)
         server = serve.ChemServer(mech, bucket_sizes=(1, 2),
@@ -344,7 +461,8 @@ class TestLoadgen:
             queue_depth = 0
 
             def submit(self, kind, **payload):
-                raise ServerOverloaded("full", queue_depth=0)
+                raise ServerOverloaded("full", queue_depth=0,
+                                       retry_after_ms=12.5)
 
         summary = loadgen.run_load(
             _AlwaysFull(), [lambda i, rng: ("equilibrium", {})],
@@ -352,8 +470,43 @@ class TestLoadgen:
             rng=np.random.default_rng(0))
         assert summary["n_served"] == 0
         assert summary["n_rejected"] == 5
+        # rejections carrying a backpressure hint are counted apart
+        assert summary["n_rejected_with_hint"] == 5
         assert summary["p50_ms"] is None
         # the banked artifact must stay strict JSON — no NaN literal
+        assert "NaN" not in json.dumps(summary)
+
+    def test_result_timeout_counted_not_raised(self):
+        """ISSUE 7 satellite bugfix: one stuck future must become ONE
+        n_timeout count — not an exception that destroys the whole
+        run's latency artifact. Schema stays strict JSON."""
+        import json
+
+        from pychemkin_tpu.serve.futures import ServeFuture, make_result
+
+        class _OneStuck:
+            def __init__(self):
+                self.n = 0
+
+            def submit(self, kind, **payload):
+                fut = ServeFuture()
+                self.n += 1
+                if self.n != 2:        # request 2 never resolves
+                    fut.set_result(make_result(
+                        {"T": 1000.0}, 0, kind=kind, bucket=1,
+                        occupancy=1, queue_wait_ms=0.1, solve_ms=1.0))
+                return fut
+
+        summary = loadgen.run_load(
+            _OneStuck(), [lambda i, rng: ("equilibrium", {})],
+            rate_hz=1000.0, n_requests=4,
+            rng=np.random.default_rng(0), result_timeout_s=0.05)
+        assert summary["n_timeout"] == 1
+        assert summary["n_served"] == 3       # the others still count
+        assert summary["n_error"] == 0
+        assert summary["status_counts"] == {"OK": 3}
+        for key in ("n_timeout", "n_error", "n_rejected_with_hint"):
+            assert key in summary, key
         assert "NaN" not in json.dumps(summary)
 
     def test_tool_banks_atomic_artifact(self, tmp_path):

@@ -1,0 +1,784 @@
+"""Cross-process serving tests (ISSUE 7): socket transport, tenant
+quotas, supervised backend respawn, request deadlines, chaos soak.
+
+Fast lane: wire framing, serving-path procfault specs, an in-process
+``TransportServer`` (quota isolation: tenant A saturated while tenant
+B keeps being admitted and solved; deadline-expired requests never
+dispatched), and a stdlib-only FAKE backend (no jax import, ~instant
+spawn) under the real :class:`Supervisor` — crash respawn +
+re-submission, ``BACKEND_LOST`` after retry-budget exhaustion,
+heartbeat hang watchdog, poisoned-reply classification, graceful
+drain.
+
+Slow lane: the ISSUE 7 chaos-soak acceptance scenario — loadgen
+drives a REAL supervised backend over the socket while procfaults
+SIGKILLs it mid-load; every request resolves, the backend respawns
+within budget, post-respawn results bit-match ``solve_direct``, and
+deadline-expired requests provably never dispatch.
+
+Run ``python tests/run_suite.py --chaos`` to exercise the ENV-driven
+activation path on top (the env-gated tests below are skipped
+otherwise)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from pychemkin_tpu import serve, telemetry
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.resilience import procfaults
+from pychemkin_tpu.resilience.driver import is_poisoned
+from pychemkin_tpu.resilience.procfaults import (
+    REEXEC_COUNT_ENV,
+    BackendPoisonedError,
+    ProcFaultSpec,
+)
+from pychemkin_tpu.resilience.status import SolveStatus
+from pychemkin_tpu.serve import loadgen, transport
+from pychemkin_tpu.serve.errors import ServerClosed, ServerOverloaded
+from pychemkin_tpu.serve.server import ChemServer
+from pychemkin_tpu.serve.supervisor import Supervisor
+from pychemkin_tpu.serve.transport import (
+    TransportClient,
+    TransportServer,
+    recv_msg,
+    result_from_wire,
+    result_to_wire,
+    send_msg,
+)
+
+P_ATM = 1.01325e6
+
+#: path of the real procfaults module — the fake backend loads it
+#: standalone (it is stdlib-only), so the env-driven chaos activation
+#: path runs without paying a jax import per spawned child
+PROCFAULTS_PATH = procfaults.__file__
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def Y_h2air(mech):
+    return loadgen.stoich_h2_air_Y(mech)
+
+
+@pytest.fixture(autouse=True)
+def _no_env_chaos(monkeypatch, request):
+    """Deterministic default: programmatic tests must not see an
+    ambient PYCHEMKIN_PROC_FAULTS spec (run_suite --chaos sets one);
+    tests marked env_chaos opt back in. Spawned backends build their
+    env from os.environ, so scrubbing here covers the children too."""
+    if "env_chaos" not in request.keywords:
+        monkeypatch.delenv("PYCHEMKIN_PROC_FAULTS", raising=False)
+    monkeypatch.delenv(REEXEC_COUNT_ENV, raising=False)
+
+
+def _eq_payload(Y, T=1200.0):
+    return dict(T=T, P=P_ATM, Y=Y, option=1)
+
+
+def _values_bitmatch(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+
+class TestWireProtocol:
+    def test_framed_roundtrip_with_numpy(self):
+        a, b = socket.socketpair()
+        try:
+            msg = {"op": "submit", "id": 3,
+                   "payload": {"Y": np.linspace(0.0, 1.0, 5),
+                               "T": np.float64(1234.5),
+                               "ok": np.bool_(True)}}
+            send_msg(a, msg)
+            got = recv_msg(b)
+            assert got["op"] == "submit" and got["id"] == 3
+            # float64 survives the JSON round trip bit-exact
+            assert got["payload"]["Y"] == np.linspace(0, 1, 5).tolist()
+            assert got["payload"]["T"] == 1234.5
+            assert got["payload"]["ok"] is True
+        finally:
+            a.close()
+            b.close()
+
+    def test_eof_returns_none(self):
+        a, b = socket.socketpair()
+        a.close()
+        assert recv_msg(b) is None
+        b.close()
+
+    def test_result_wire_roundtrip(self):
+        from pychemkin_tpu.serve.futures import make_result
+
+        res = make_result({"T": 1931.25, "Y": np.linspace(0, 1, 4)},
+                          0, kind="equilibrium", bucket=8, occupancy=3,
+                          queue_wait_ms=1.25, solve_ms=7.5)
+        back = result_from_wire(json.loads(json.dumps(
+            transport._jsonable(result_to_wire(res)))))
+        assert back.status_name == "OK" and back.bucket == 8
+        assert back.value["T"] == res.value["T"]
+        np.testing.assert_array_equal(back.value["Y"], res.value["Y"])
+
+
+# ---------------------------------------------------------------------------
+# serving-path procfault specs
+
+class TestServeProcFaults:
+    def test_from_dict_serving_defaults(self):
+        spec = ProcFaultSpec.from_dict(
+            {"mode": "kill_backend_at_request"})
+        assert spec.request == 0            # live by default
+        spec = ProcFaultSpec.from_dict({"mode": "hang_heartbeat",
+                                        "request": 3})
+        assert spec.request == 3
+        assert spec.n_times == -1           # a wedge persists
+        # driver-path specs never fire on the serving hooks
+        spec = ProcFaultSpec.from_dict({"mode": "poison_backend",
+                                        "chunk": 2})
+        assert spec.request == -1
+
+    def test_poison_at_request_fires_once_and_heals_on_reexec(
+            self, monkeypatch):
+        spec = ProcFaultSpec.from_dict(
+            {"mode": "poison_backend", "request": 1})
+        with procfaults.inject(spec):
+            procfaults.on_serve_request(0)  # untargeted ordinal
+            with pytest.raises(BackendPoisonedError) as ei:
+                procfaults.on_serve_request(1)
+            assert is_poisoned(ei.value)    # the driver classification
+            procfaults.on_serve_request(1)  # n_times=1: spent
+        # a respawned (re-exec-stamped) process is healed
+        monkeypatch.setenv(REEXEC_COUNT_ENV, "1")
+        with procfaults.inject(spec):
+            procfaults.on_serve_request(1)  # no raise
+
+    def test_hang_heartbeat_matches_onward(self, monkeypatch):
+        spec = ProcFaultSpec.from_dict(
+            {"mode": "hang_heartbeat", "request": 2,
+             "seconds": 0.01})
+        slept = []
+        monkeypatch.setattr(procfaults.time, "sleep", slept.append)
+        with procfaults.inject(spec):
+            procfaults.on_heartbeat(0)
+            procfaults.on_heartbeat(1)
+            assert not slept                # before the target: healthy
+            procfaults.on_heartbeat(2)
+            procfaults.on_heartbeat(3)
+        assert slept == [0.01, 0.01]        # from the target onward
+
+    def test_env_spec_parsing(self, monkeypatch):
+        monkeypatch.setenv(
+            "PYCHEMKIN_PROC_FAULTS",
+            '[{"mode": "kill_backend_at_request", "request": 5}]')
+        (spec,) = procfaults.specs()
+        assert spec.mode == "kill_backend_at_request"
+        assert spec.request == 5
+        assert procfaults.enabled()
+
+
+# ---------------------------------------------------------------------------
+# in-process transport server: routing, quotas, deadlines
+
+class TestTransportServer:
+    def _server(self, mech, rec, tenants, **chem):
+        chem.setdefault("bucket_sizes", (1, 4))
+        chem.setdefault("max_delay_ms", 5.0)
+        srv = ChemServer(mech, recorder=rec, **chem)
+        ts = TransportServer(tenants, servers={"h2o2": srv},
+                             recorder=rec)
+        ts.start()
+        return ts, srv
+
+    def test_submit_result_bitmatches_solve_direct(self, mech,
+                                                   Y_h2air):
+        rec = telemetry.MetricsRecorder()
+        ts, srv = self._server(mech, rec,
+                               {"default": {"mech": "h2o2"}})
+        cli = TransportClient("127.0.0.1", ts.port)
+        try:
+            res = cli.submit("equilibrium",
+                             **_eq_payload(Y_h2air, 1350.0)).result(
+                                 timeout=120)
+            assert res.ok and res.kind == "equilibrium"
+            direct = srv.solve_direct(
+                "equilibrium", bucket=res.bucket,
+                **_eq_payload(Y_h2air, 1350.0))
+            # floats crossed the wire as JSON and came back bit-equal
+            _values_bitmatch(res.value, direct.value)
+        finally:
+            cli.close()
+            ts.close()
+
+    def test_unknown_tenant_and_bad_payload_are_typed(self, mech,
+                                                      Y_h2air):
+        rec = telemetry.MetricsRecorder()
+        ts, _ = self._server(mech, rec, {"a": {"mech": "h2o2"}})
+        cli = TransportClient("127.0.0.1", ts.port, tenant="nobody")
+        try:
+            with pytest.raises(serve.ServeError, match="unknown tenant"):
+                cli.submit("equilibrium",
+                           **_eq_payload(Y_h2air)).result(timeout=30)
+            with pytest.raises(serve.ServeError, match="shape"):
+                cli.submit("equilibrium", tenant="a", T=1200.0,
+                           P=P_ATM, Y=Y_h2air[:-1].tolist(),
+                           option=1).result(timeout=30)
+        finally:
+            cli.close()
+            ts.close()
+
+    def test_tenant_quota_isolation(self, mech, Y_h2air):
+        """ISSUE 7 fast-lane acceptance: tenant A saturated ⇒ typed
+        overload WITH hints for A, while tenant B's requests are still
+        admitted and solved."""
+        rec = telemetry.MetricsRecorder()
+        # huge delay window: admitted requests stay in flight until the
+        # drain cuts the window, so A's quota stays pinned at 2
+        ts, _ = self._server(
+            mech, rec,
+            {"a": {"mech": "h2o2", "quota": 2},
+             "b": {"mech": "h2o2", "quota": 2}},
+            max_delay_ms=60_000.0)
+        ca = TransportClient("127.0.0.1", ts.port, tenant="a")
+        cb = TransportClient("127.0.0.1", ts.port, tenant="b")
+        try:
+            fa = [ca.submit("equilibrium",
+                            **_eq_payload(Y_h2air, 1000.0 + 50 * i))
+                  for i in range(2)]
+            # one conn thread handles ca's submits in order: by now
+            # A's in-flight count IS 2
+            rej = ca.submit("equilibrium", **_eq_payload(Y_h2air))
+            with pytest.raises(ServerOverloaded) as ei:
+                rej.result(timeout=30)
+            assert ei.value.queue_depth == 2
+            assert ei.value.retry_after_ms is not None
+            assert ei.value.retry_after_ms > 0
+            # tenant B is untouched by A's saturation
+            fb = cb.submit("equilibrium", **_eq_payload(Y_h2air, 1500.0))
+            # release the window: drain resolves everything admitted
+            cb.drain(timeout=300)
+            for f in fa + [fb]:
+                assert f.result(timeout=60).ok
+            assert rec.counters["serve.tenant_rejected"] == 1
+            assert rec.counters["serve.tenant_rejected.a"] == 1
+            assert rec.counters.get("serve.tenant_rejected.b", 0) == 0
+        finally:
+            ca.close()
+            cb.close()
+            ts.close()
+
+    def test_expired_deadline_never_dispatches(self, mech, Y_h2air):
+        """A deadline-expired request resolves DEADLINE_EXCEEDED over
+        the wire and provably never reaches a compiled program."""
+        rec = telemetry.MetricsRecorder()
+        ts, srv = self._server(mech, rec,
+                               {"default": {"mech": "h2o2"}})
+        cli = TransportClient("127.0.0.1", ts.port)
+        try:
+            # a real request first, so batch/compile counters are warm
+            assert cli.submit("equilibrium",
+                              **_eq_payload(Y_h2air)).result(
+                                  timeout=120).ok
+            before = cli.stats()["counters"]
+            futs = [cli.submit("equilibrium", deadline_ms=0.0,
+                               **_eq_payload(Y_h2air, 1300.0))
+                    for _ in range(3)]
+            res = [f.result(timeout=60) for f in futs]
+            assert [r.status_name for r in res] == \
+                ["DEADLINE_EXCEEDED"] * 3
+            assert all(int(r.status) ==
+                       int(SolveStatus.DEADLINE_EXCEEDED)
+                       for r in res)
+            after = cli.stats()["counters"]
+            # batch/compile counters untouched by the expired requests
+            assert after["serve.batches"] == before["serve.batches"]
+            assert after["serve.compiles"] == before["serve.compiles"]
+            assert (after["serve.deadline_expired"]
+                    - before.get("serve.deadline_expired", 0)) == 3
+            # the quota slots were released
+            assert cli.stats()["tenants"]["default"] == 0
+        finally:
+            cli.close()
+            ts.close()
+
+
+# ---------------------------------------------------------------------------
+# the supervisor over a stdlib-only fake backend (no jax in children)
+
+#: a protocol-complete fake backend: canned results, deterministic
+#: failure knobs via env, procfaults hooks via standalone import —
+#: spawns in ~100 ms, so every supervisor recovery path is fast-lane
+FAKE_BACKEND = textwrap.dedent('''
+    import json, os, signal, socket, struct, sys, threading, time
+
+    LEN = struct.Struct(">I")
+
+    def recv_exact(sock, n):
+        buf = b""
+        while len(buf) < n:
+            c = sock.recv(n - len(buf))
+            if not c:
+                return None
+            buf += c
+        return buf
+
+    def recv_msg(sock):
+        head = recv_exact(sock, 4)
+        if head is None:
+            return None
+        (n,) = LEN.unpack(head)
+        body = recv_exact(sock, n)
+        return None if body is None else json.loads(body.decode())
+
+    def send_msg(sock, obj, lock):
+        data = json.dumps(obj).encode()
+        with lock:
+            sock.sendall(LEN.pack(len(data)) + data)
+
+    def gen():
+        try:
+            return int(os.environ.get("_PYCHEMKIN_DRIVER_REEXEC", "0"))
+        except ValueError:
+            return 0
+
+    procfaults = None
+    pf_path = os.environ.get("FAKE_PROCFAULTS_PATH")
+    if pf_path:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("procfaults",
+                                                      pf_path)
+        procfaults = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(procfaults)
+
+    CANNED = {"value": {"T": 1931.25}, "status": 0,
+              "status_name": "OK", "ok": True, "rescued": False,
+              "rescue_rungs": 0, "kind": "equilibrium", "bucket": 1,
+              "occupancy": 1, "queue_wait_ms": 0.1, "solve_ms": 1.0}
+
+    counters = {"req": 0, "hb": 0}
+    ord_lock = threading.Lock()
+    stop_evt = threading.Event()
+
+    def serve_conn(conn):
+        lock = threading.Lock()
+        while True:
+            try:
+                msg = recv_msg(conn)
+            except OSError:
+                return
+            if msg is None:
+                return
+            op = msg.get("op")
+            rid = msg.get("id")
+            if op == "ping":
+                with ord_lock:
+                    hb = counters["hb"]
+                    counters["hb"] += 1
+                if procfaults is not None:
+                    procfaults.on_heartbeat(hb)
+                if os.environ.get("FAKE_HANG_PING") and gen() == 0:
+                    continue          # wedged heartbeat plane (gen 0)
+                send_msg(conn, {"op": "pong", "id": rid,
+                                "n_inflight": 0}, lock)
+            elif op == "submit":
+                with ord_lock:
+                    o = counters["req"]
+                    counters["req"] += 1
+                die = os.environ.get("FAKE_DIE_ON_SUBMIT_GEN")
+                if die == "all" or die == str(gen()):
+                    os.kill(os.getpid(), signal.SIGKILL)
+                if procfaults is not None:
+                    try:
+                        procfaults.on_serve_request(o)
+                    except procfaults.BackendPoisonedError as exc:
+                        send_msg(conn, {"op": "error", "id": rid,
+                                        "error": "BackendPoisonedError",
+                                        "message": str(exc)}, lock)
+                        continue
+                if os.environ.get("FAKE_POISON_GEN") == str(gen()):
+                    send_msg(conn, {"op": "error", "id": rid,
+                                    "error": "BackendPoisonedError",
+                                    "message": "fake wedged client"},
+                             lock)
+                    continue
+                res = dict(CANNED)
+                res["kind"] = msg.get("kind", "equilibrium")
+                send_msg(conn, {"op": "result", "id": rid,
+                                "result": res}, lock)
+            elif op == "stats":
+                send_msg(conn, {"op": "stats_reply", "id": rid,
+                                "tenants": {},
+                                "counters": dict(counters)}, lock)
+            elif op == "drain":
+                send_msg(conn, {"op": "drain_done", "id": rid}, lock)
+                stop_evt.set()
+
+    lst = socket.socket()
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(16)
+    print("PYCHEMKIN_SERVE_PORT=%d" % lst.getsockname()[1], flush=True)
+    print("PYCHEMKIN_SERVE_READY", flush=True)
+    signal.signal(signal.SIGTERM, lambda *a: os._exit(0))
+
+    def accept():
+        while True:
+            try:
+                conn, _ = lst.accept()
+            except OSError:
+                return
+            threading.Thread(target=serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept, daemon=True).start()
+    while not stop_evt.is_set():
+        time.sleep(0.02)
+    os._exit(0)
+''')
+
+
+@pytest.fixture()
+def fake_backend_path(tmp_path):
+    path = tmp_path / "fake_backend.py"
+    path.write_text(FAKE_BACKEND)
+    return str(path)
+
+
+def _fake_supervisor(fake_backend_path, *, env=None, **kw):
+    kw.setdefault("heartbeat_s", 0.1)
+    kw.setdefault("hang_timeout_s", 1.0)
+    kw.setdefault("spawn_timeout_s", 30.0)
+    kw.setdefault("recorder", telemetry.MetricsRecorder())
+    return Supervisor(backend_argv=[sys.executable, fake_backend_path],
+                      env_overrides=env or {}, **kw)
+
+
+def _wait(predicate, timeout_s=20.0, what="condition"):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+class TestSupervisorFake:
+    def test_submit_and_graceful_close(self, fake_backend_path):
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(fake_backend_path, recorder=rec)
+        with sup:
+            res = sup.submit("equilibrium", T=1.0).result(timeout=30)
+            assert res.ok and res.value["T"] == 1931.25
+            assert sup.server_stats()["counters"]["req"] == 1
+        assert sup.close() is True            # idempotent
+        ev = rec.last_event("supervisor.drain")
+        assert ev is not None and ev["graceful"] is True
+        assert rec.last_event("supervisor.backend_lost") is None
+
+    def test_crash_respawn_resubmits_inflight(self, fake_backend_path):
+        """The backend dies with a request on board: the supervisor
+        respawns it (re-exec stamped, so the per-generation death knob
+        heals) and re-submits — the caller's future resolves OK."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=1,
+            max_respawns=2, env={"FAKE_DIE_ON_SUBMIT_GEN": "0"})
+        with sup:
+            fut = sup.submit("equilibrium", T=1.0)
+            res = fut.result(timeout=60)
+            assert res.ok and res.value["T"] == 1931.25
+            stats = sup.stats()
+            assert stats["respawns"] == 1
+            assert stats["resubmits"] == 1
+            assert stats["backend_lost_requests"] == 0
+        ev = rec.last_event("supervisor.backend_lost")
+        assert ev is not None and "crashed" in ev["reason"]
+        assert rec.counters["supervisor.respawns"] == 1
+
+    def test_backend_lost_after_retry_budget_exhausted(
+            self, fake_backend_path):
+        """ISSUE 7 fast-lane acceptance: a request whose re-submission
+        budget is spent resolves with BACKEND_LOST as DATA — never a
+        hang, never an untyped error."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=0,
+            max_respawns=3, env={"FAKE_DIE_ON_SUBMIT_GEN": "all"})
+        with sup:
+            fut = sup.submit("equilibrium", T=1.0)
+            res = fut.result(timeout=60)
+            assert int(res.status) == int(SolveStatus.BACKEND_LOST)
+            assert res.status_name == "BACKEND_LOST"
+            assert not res.ok
+            stats = sup.stats()
+            assert stats["respawns"] == 1      # one respawn, then the
+            assert stats["backend_lost_requests"] == 1  # budget gate
+        assert rec.counters["supervisor.backend_lost_requests"] == 1
+
+    def test_respawn_budget_exhaustion_marks_dead(
+            self, fake_backend_path):
+        """Every crash consumes respawn budget; past it the supervisor
+        fails in-flight with BACKEND_LOST and refuses new submits."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=5,
+            max_respawns=1, env={"FAKE_DIE_ON_SUBMIT_GEN": "all"})
+        with sup:
+            fut = sup.submit("equilibrium", T=1.0)
+            res = fut.result(timeout=60)
+            assert res.status_name == "BACKEND_LOST"
+            _wait(lambda: sup.stats()["dead"], what="supervisor dead")
+            with pytest.raises(ServerClosed):
+                sup.submit("equilibrium", T=2.0)
+            ev = rec.last_event("supervisor.respawn_exhausted")
+            assert ev is not None
+
+    def test_hung_heartbeat_triggers_respawn(self, fake_backend_path):
+        """Wedged-but-alive: the fake answers data-plane traffic but
+        never pongs (generation 0) — the watchdog SIGKILLs it and the
+        respawned backend serves normally."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=1,
+            max_respawns=2, heartbeat_s=0.1, hang_timeout_s=0.6,
+            env={"FAKE_HANG_PING": "1"})
+        with sup:
+            # data plane still answers while the heartbeat is wedged
+            assert sup.submit("equilibrium",
+                              T=1.0).result(timeout=30).ok
+            _wait(lambda: sup.generation == 1, what="hang respawn")
+            ev = rec.last_event("supervisor.backend_lost")
+            assert "heartbeat" in ev["reason"]
+            # post-respawn: healthy heartbeat AND healthy data plane
+            assert sup.submit("equilibrium",
+                              T=2.0).result(timeout=30).ok
+            assert sup.stats()["respawns"] == 1
+
+    def test_poisoned_reply_respawns_not_retries(
+            self, fake_backend_path):
+        """A reply matching the driver's poisoned-backend
+        classification kills + respawns the backend (where the poison
+        heals via the re-exec stamp) instead of retrying against the
+        wedged process."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=1,
+            max_respawns=2, env={"FAKE_POISON_GEN": "0"})
+        with sup:
+            res = sup.submit("equilibrium", T=1.0).result(timeout=60)
+            assert res.ok                     # healed on generation 1
+            assert sup.stats()["respawns"] == 1
+        ev = rec.last_event("supervisor.backend_lost")
+        assert "poisoned" in ev["reason"]
+
+
+# ---------------------------------------------------------------------------
+# run_suite --chaos plumbing
+
+class TestRunSuiteChaosFlag:
+    def test_chaos_flag_sets_child_env(self, tmp_path):
+        probe = tmp_path / "test_probe_chaos_env.py"
+        probe.write_text(
+            "import json, os\n"
+            "def test_env():\n"
+            "    spec = json.loads("
+            "os.environ['PYCHEMKIN_PROC_FAULTS'])\n"
+            "    assert spec[0]['mode'] == 'kill_backend_at_request'\n")
+        suite = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "run_suite.py")
+        env = dict(os.environ)
+        env.pop("PYCHEMKIN_PROC_FAULTS", None)
+        env["RUN_SUITE_FILE_TIMEOUT"] = "120"
+        r = subprocess.run(
+            [sys.executable, suite, "--chaos", str(probe)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_chaos_flag_defaults_to_this_file(self):
+        import importlib.util
+
+        suite_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "run_suite.py")
+        spec = importlib.util.spec_from_file_location("_rs_probe2",
+                                                      suite_path)
+        rs = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rs)
+
+        recorded = {}
+
+        def fake_run(cmd, env=None, timeout=None):
+            recorded.setdefault("files", []).extend(
+                a for a in cmd if a.endswith(".py"))
+            recorded["env"] = env
+
+            class R:
+                returncode = 0
+            return R()
+
+        orig = rs.subprocess.run
+        rs.subprocess.run = fake_run
+        try:
+            rc = rs.main(["--chaos"])
+        finally:
+            rs.subprocess.run = orig
+        assert rc == 0
+        assert [os.path.basename(f) for f in recorded["files"]] == \
+            ["test_serve_transport.py"]
+        assert "PYCHEMKIN_PROC_FAULTS" in recorded["env"]
+
+
+# ---------------------------------------------------------------------------
+# env-driven chaos activation (run_suite --chaos)
+
+@pytest.mark.env_chaos
+@pytest.mark.skipif("PYCHEMKIN_PROC_FAULTS" not in os.environ,
+                    reason="env-driven chaos: run via "
+                           "tests/run_suite.py --chaos")
+class TestEnvDrivenChaos:
+    """Exercised by ``python tests/run_suite.py --chaos``: the canned
+    env spec SIGKILLs the backend at submit ordinal 2; supervised
+    backends inherit the env, the supervisor absorbs the kill."""
+
+    def test_env_spec_active_and_absorbed(self, fake_backend_path):
+        assert procfaults.enabled()
+        (spec,) = procfaults.specs("kill_backend_at_request")
+        sup = _fake_supervisor(
+            fake_backend_path, retry_budget=1, max_respawns=2,
+            env={"FAKE_PROCFAULTS_PATH": PROCFAULTS_PATH})
+        with sup:
+            results = []
+            for i in range(spec.request + 2):
+                fut = sup.submit("equilibrium", T=float(i))
+                results.append(fut.result(timeout=60))
+            # the kill at ordinal `request` was absorbed: every
+            # request resolved OK, exactly one respawn
+            assert all(r.ok for r in results)
+            assert sup.stats()["respawns"] == 1
+            assert sup.stats()["resubmits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7 chaos-soak acceptance (slow lane: real backend, real solves)
+
+@pytest.mark.slow
+class TestChaosSoakAcceptance:
+    def test_kill_backend_mid_load_soak(self, mech, Y_h2air):
+        """Loadgen drives the supervised server over the socket while
+        procfaults SIGKILLs the backend mid-load: every request
+        resolves (zero hangs, zero untyped errors), the backend
+        respawns within the budget, post-respawn results bit-match
+        solve_direct at the same bucket shape, and deadline-expired
+        requests provably never dispatch."""
+        n_requests = 24
+        chaos = ('[{"mode": "kill_backend_at_request", '
+                 '"request": 8}]')
+        rec = telemetry.MetricsRecorder()
+        sup = Supervisor(
+            {"tenants": {"default": {"mech": "h2o2", "quota": 64}},
+             "kinds": ["equilibrium"],
+             "chem": {"bucket_sizes": [1, 8], "max_batch_size": 8,
+                      "max_delay_ms": 5.0}},
+            env_overrides={"PYCHEMKIN_PROC_FAULTS": chaos},
+            retry_budget=1, max_respawns=2, heartbeat_s=0.25,
+            hang_timeout_s=30.0, recorder=rec)
+        with sup:
+            summary = loadgen.run_load(
+                sup, loadgen.default_samplers(mech, ["equilibrium"]),
+                rate_hz=40.0, n_requests=n_requests,
+                rng=np.random.default_rng(5),
+                result_timeout_s=300.0, deadline_ms=240_000.0)
+
+            # every request resolved: no hangs, no untyped errors
+            assert summary["n_timeout"] == 0
+            assert summary["n_error"] == 0
+            assert summary["n_served"] + summary["n_rejected"] == \
+                n_requests
+            assert sum(summary["status_counts"].values()) == \
+                summary["n_served"]
+            # the mid-load SIGKILL happened and was absorbed inside
+            # the respawn budget; re-submission healed every lost
+            # request (retry budget 1 covers the single kill)
+            stats = sup.stats()
+            assert stats["respawns"] == 1
+            assert stats["respawns"] <= sup.max_respawns
+            assert stats["resubmits"] >= 1
+            assert summary["status_counts"].get("OK", 0) == \
+                summary["n_served"]
+            ev = rec.last_event("supervisor.backend_lost")
+            assert ev is not None and ev["n_inflight"] >= 1
+
+            # post-respawn result bit-matches a direct solve at the
+            # same bucket shape (fresh process, warm compile cache)
+            probe = _eq_payload(Y_h2air, 1234.0)
+            res = sup.submit("equilibrium", **probe).result(timeout=120)
+            assert res.ok
+            local = ChemServer(mech, bucket_sizes=(1, 8),
+                               max_batch_size=8)
+            direct = local.solve_direct("equilibrium",
+                                        bucket=res.bucket, **probe)
+            _values_bitmatch(res.value, direct.value)
+
+            # deadline-expired requests: typed resolution, and the
+            # backend's batch/compile counters prove they never
+            # reached a compiled program
+            cli = TransportClient("127.0.0.1", sup.port)
+            try:
+                before = cli.stats()["counters"]
+                futs = [cli.submit("equilibrium", deadline_ms=0.0,
+                                   **_eq_payload(Y_h2air, 1300.0))
+                        for _ in range(4)]
+                expired = [f.result(timeout=60) for f in futs]
+                assert all(r.status_name == "DEADLINE_EXCEEDED"
+                           for r in expired)
+                after = cli.stats()["counters"]
+                assert after["serve.batches"] == \
+                    before["serve.batches"]
+                assert after["serve.compiles"] == \
+                    before["serve.compiles"]
+                assert (after["serve.deadline_expired"]
+                        - before.get("serve.deadline_expired", 0)) == 4
+            finally:
+                cli.close()
+        # graceful end-to-end drain
+        ev = rec.last_event("supervisor.drain")
+        assert ev is not None and ev["graceful"] is True
+
+    def test_transport_loadgen_tool_banks_soak_artifact(self, tmp_path):
+        """tools/loadgen.py --transport --chaos end to end: the banked
+        artifact carries per-status counts plus the supervisor's
+        respawn/re-submit block."""
+        from tools import loadgen as loadgen_tool
+
+        out = str(tmp_path / "SOAK.json")
+        rc = loadgen_tool.main([
+            "--transport", "--mech", "h2o2", "--kinds", "equilibrium",
+            "--rate", "40", "--n", "12", "--seed", "0",
+            "--buckets", "1,8", "--max-batch", "8",
+            "--deadline-ms", "240000",
+            "--chaos",
+            '[{"mode": "kill_backend_at_request", "request": 5}]',
+            "--out", out])
+        assert rc == 0
+        with open(out) as f:
+            art = json.load(f)
+        assert art["transport"] is True
+        assert art["chaos"][0]["mode"] == "kill_backend_at_request"
+        assert art["n_timeout"] == 0
+        assert art["n_served"] + art["n_rejected"] == 12
+        assert art["supervisor"]["respawns"] == 1
+        assert sum(art["status_counts"].values()) == art["n_served"]
+        # strict JSON: the artifact parsed above, and no NaN literal
+        assert "NaN" not in json.dumps(art)

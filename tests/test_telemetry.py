@@ -309,13 +309,16 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
     }
 
 
-#: every key the serve_latency rung JSON must carry (ISSUE 5): the
-#: online-path counterpart of RUNG_SCHEMA_KEYS — request-side latency
-#: percentiles, occupancy, rejection/rescue counts, compile counters
+#: every key the serve_latency rung JSON must carry (ISSUE 5; soak
+#: counters extended by ISSUE 7): the online-path counterpart of
+#: RUNG_SCHEMA_KEYS — request-side latency percentiles, occupancy,
+#: rejection/timeout/rescue/deadline counts, compile counters
 SERVE_RUNG_KEYS = (
     "rung", "platform", "mech", "kinds", "warmup_s", "compiles",
     "n_batches", "queue_wait_ms", "solve_ms", "n_requests", "n_served",
-    "n_rejected", "n_rescued", "rate_hz", "offered_s", "wall_s",
+    "n_rejected", "n_rejected_with_hint", "n_timeout", "n_error",
+    "n_rescued", "deadline_ms", "n_deadline_expired", "rate_hz",
+    "offered_s", "wall_s",
     "status_counts", "p50_ms", "p95_ms", "p99_ms", "mean_ms", "max_ms",
     "mean_occupancy", "max_occupancy",
 )
@@ -330,7 +333,9 @@ def _fake_serve_result():
                           "p99": 5.0},
         "solve_ms": {"count": 9, "p50": 8.0, "p95": 9.0, "p99": 9.5},
         "n_requests": 20, "n_served": 20, "n_rejected": 0,
-        "n_rescued": 0, "rate_hz": 100.0, "offered_s": 0.2,
+        "n_rejected_with_hint": 0, "n_timeout": 0, "n_error": 0,
+        "n_rescued": 0, "deadline_ms": None, "n_deadline_expired": 0,
+        "rate_hz": 100.0, "offered_s": 0.2,
         "wall_s": 0.4, "status_counts": {"OK": 20}, "p50_ms": 10.0,
         "p95_ms": 12.0, "p99_ms": 14.0, "mean_ms": 10.5, "max_ms": 15.0,
         "mean_occupancy": 2.2, "max_occupancy": 4,

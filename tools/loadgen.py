@@ -1,22 +1,37 @@
 #!/usr/bin/env python
 """Open-loop Poisson load generator for the online serving layer.
 
-Drives an in-process :class:`pychemkin_tpu.serve.ChemServer` with a
-seeded Poisson request stream (open loop: arrivals keep their schedule
-regardless of completions, so queueing collapse is visible instead of
-self-throttled away) and banks a JSON latency artifact with the same
-atomic tmp+rename idiom as the bench (a kill mid-run leaves either the
+Drives a :class:`pychemkin_tpu.serve.ChemServer` with a seeded Poisson
+request stream (open loop: arrivals keep their schedule regardless of
+completions, so queueing collapse is visible instead of self-throttled
+away) and banks a JSON latency artifact with the same atomic
+tmp+rename idiom as the bench (a kill mid-run leaves either the
 previous artifact or a complete new one, never a torn file).
+
+Two targets:
+
+- default: the in-process server (the PR 5 latency harness);
+- ``--transport``: a SUPERVISED backend process driven over the
+  JSON-over-TCP socket (``pychemkin_tpu/serve/transport.py`` behind
+  ``serve/supervisor.py``) — the chaos-soak harness. ``--chaos`` puts
+  a ``PYCHEMKIN_PROC_FAULTS`` spec into the backend child only (e.g.
+  ``'[{"mode": "kill_backend_at_request", "request": 20}]'`` SIGKILLs
+  it mid-load), and the artifact then banks the supervisor's
+  respawn/re-submit counters next to the per-status counts — the
+  acceptance evidence that every admitted request resolved.
 
 Usage::
 
     python tools/loadgen.py --mech h2o2 --kinds equilibrium,ignition \
         --rate 100 --n 200 --seed 0 --out LOADGEN.json
+    python tools/loadgen.py --transport --deadline-ms 60000 \
+        --chaos '[{"mode": "kill_backend_at_request", "request": 20}]' \
+        --rate 50 --n 100 --out SOAK.json
 
 The artifact carries the request-side latency distribution
-(p50/p95/p99/mean/max ms), occupancy, rejection and rescue counts,
-plus the server-side telemetry snapshot (queue-depth gauge,
-wait/solve/occupancy histograms, per-status counters).
+(p50/p95/p99/mean/max ms), occupancy, rejection/timeout/rescue counts,
+per-status counts, plus the server-side telemetry snapshot (in-process)
+or the supervisor + backend stats (transport).
 """
 
 from __future__ import annotations
@@ -37,6 +52,7 @@ if _REPO not in sys.path:
 from pychemkin_tpu import serve, telemetry          # noqa: E402
 from pychemkin_tpu.mechanism import load_embedded   # noqa: E402
 from pychemkin_tpu.serve import loadgen             # noqa: E402
+from pychemkin_tpu.serve.supervisor import Supervisor  # noqa: E402
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -59,9 +75,94 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-depth", type=int, default=256)
     p.add_argument("--timeout", type=float, default=300.0,
                    help="per-future result timeout, s")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request deadline budget, ms")
     p.add_argument("--out", default="LOADGEN.json",
                    help="artifact path (atomic rewrite)")
+    # -- supervised transport soak mode --------------------------------
+    p.add_argument("--transport", action="store_true",
+                   help="drive a SUPERVISED backend process over the "
+                        "socket transport instead of in-process")
+    p.add_argument("--tenant", default="default",
+                   help="transport tenant id to submit as")
+    p.add_argument("--quota", type=int, default=256,
+                   help="per-tenant in-flight admission quota")
+    p.add_argument("--chaos", default=None,
+                   help="PYCHEMKIN_PROC_FAULTS JSON injected into the "
+                        "backend child only (chaos soak)")
+    p.add_argument("--retry-budget", type=int, default=1,
+                   help="supervisor re-sends per request after a "
+                        "backend loss")
+    p.add_argument("--max-respawns", type=int, default=None,
+                   help="supervisor backend respawn budget")
     return p
+
+
+def _engine_config() -> dict:
+    return {"ignition": {"rtol": 1e-6, "atol": 1e-10,
+                         "max_steps_per_segment": 4000}}
+
+
+def _run_inprocess(args, kinds, bucket_sizes, rng, samplers):
+    mech = load_embedded(args.mech)
+    rec = telemetry.MetricsRecorder()
+    server = serve.ChemServer(
+        mech, bucket_sizes=bucket_sizes, max_batch_size=args.max_batch,
+        max_delay_ms=args.delay_ms, queue_depth=args.queue_depth,
+        recorder=rec, engine_config=_engine_config())
+    print(f"# loadgen: warming {kinds} over buckets {bucket_sizes}",
+          file=sys.stderr)
+    warm = server.warmup(kinds)
+    with server:
+        summary = loadgen.run_load(
+            server, samplers, rate_hz=args.rate, n_requests=args.n,
+            rng=rng, result_timeout_s=args.timeout,
+            deadline_ms=args.deadline_ms)
+    return summary, {"warmup_compiles": warm,
+                     "telemetry": rec.snapshot()}
+
+
+def _run_transport(args, kinds, bucket_sizes, rng, samplers):
+    if args.chaos is not None:
+        json.loads(args.chaos)       # fail fast on a typo'd spec
+    rec = telemetry.MetricsRecorder()
+    config = {
+        "tenants": {args.tenant: {"mech": args.mech,
+                                  "quota": args.quota}},
+        "kinds": kinds,
+        "chem": {"bucket_sizes": list(bucket_sizes),
+                 "max_batch_size": args.max_batch,
+                 "max_delay_ms": args.delay_ms,
+                 "queue_depth": args.queue_depth},
+        "engine_config": _engine_config(),
+    }
+    env = ({"PYCHEMKIN_PROC_FAULTS": args.chaos}
+           if args.chaos is not None else None)
+    sup = Supervisor(config, env_overrides=env,
+                     retry_budget=args.retry_budget,
+                     max_respawns=args.max_respawns,
+                     default_tenant=args.tenant, recorder=rec)
+    sup.install_signal_handlers()
+    print(f"# loadgen: spawning supervised backend "
+          f"(chaos={'on' if args.chaos else 'off'})", file=sys.stderr)
+    with sup:
+        print(f"# loadgen: backend ready on port {sup.port}",
+              file=sys.stderr)
+        summary = loadgen.run_load(
+            sup, samplers, rate_hz=args.rate, n_requests=args.n,
+            rng=rng, result_timeout_s=args.timeout,
+            deadline_ms=args.deadline_ms)
+        extra = {"transport": True,
+                 "tenant": args.tenant,
+                 "quota": args.quota,
+                 "chaos": (json.loads(args.chaos)
+                           if args.chaos else None),
+                 "supervisor": sup.stats()}
+        try:
+            extra["backend"] = sup.server_stats()
+        except Exception as exc:     # noqa: BLE001 — backend may be dead
+            extra["backend"] = {"error": f"{type(exc).__name__}: {exc}"}
+    return summary, extra
 
 
 def main(argv=None) -> int:
@@ -70,23 +171,11 @@ def main(argv=None) -> int:
     bucket_sizes = tuple(int(b) for b in args.buckets.split(","))
 
     mech = load_embedded(args.mech)
-    rec = telemetry.MetricsRecorder()
-    server = serve.ChemServer(
-        mech, bucket_sizes=bucket_sizes, max_batch_size=args.max_batch,
-        max_delay_ms=args.delay_ms, queue_depth=args.queue_depth,
-        recorder=rec,
-        engine_config={"ignition": {"rtol": 1e-6, "atol": 1e-10,
-                                    "max_steps_per_segment": 4000}})
     rng = np.random.default_rng(args.seed)
     samplers = loadgen.default_samplers(mech, kinds)
 
-    print(f"# loadgen: warming {kinds} over buckets {bucket_sizes}",
-          file=sys.stderr)
-    warm = server.warmup(kinds)
-    with server:
-        summary = loadgen.run_load(
-            server, samplers, rate_hz=args.rate, n_requests=args.n,
-            rng=rng, result_timeout_s=args.timeout)
+    runner = _run_transport if args.transport else _run_inprocess
+    summary, extra = runner(args, kinds, bucket_sizes, rng, samplers)
 
     artifact = {
         "tool": "loadgen",
@@ -96,9 +185,9 @@ def main(argv=None) -> int:
         "buckets": list(bucket_sizes),
         "max_batch_size": args.max_batch,
         "max_delay_ms": args.delay_ms,
-        "warmup_compiles": warm,
+        "deadline_ms": args.deadline_ms,
         **summary,
-        "telemetry": rec.snapshot(),
+        **extra,
     }
     telemetry.atomic_write_json(args.out, artifact)
     print(json.dumps({k: v for k, v in artifact.items()
