@@ -113,7 +113,8 @@ def run_rescue(solve_subset, results: Dict[str, np.ndarray], *,
                ladder: Tuple[EscalationStep, ...] = DEFAULT_LADDER,
                max_attempts: Optional[int] = None,
                attempt_timeout_s: Optional[float] = None,
-               recorder=None, label: str = "") -> RescueReport:
+               recorder=None, label: str = "",
+               trace_id: Optional[str] = None) -> RescueReport:
     """Generic rescue engine.
 
     ``results`` holds the base solve's full-batch arrays and MUST
@@ -122,6 +123,11 @@ def run_rescue(solve_subset, results: Dict[str, np.ndarray], *,
     elements at original indices ``idx`` under escalation ``step``
     (1-based rung ``level``) and returns a dict with the same keys,
     subset-aligned, including ``"status"``.
+
+    ``trace_id`` joins the ladder to a distributed trace: each rung
+    re-solve is additionally emitted as a ``trace.span`` event
+    (``rescue.rung`` with level/name/n_tried/n_fixed), so a sweep whose
+    wall time went into rescue shows WHICH rung ate it.
     """
     # explicit call arguments win; the env knobs only fill in defaults
     if max_attempts is None:
@@ -163,6 +169,12 @@ def run_rescue(solve_subset, results: Dict[str, np.ndarray], *,
                              "n_fixed": int(fixed.sum()),
                              "wall_s": round(wall_s, 6),
                              "timed_out": bool(timed_out)})
+            telemetry.trace.emit_span(
+                recorder if recorder is not None
+                else telemetry.get_recorder(),
+                trace_id, "rescue.rung", wall_s * 1e3, label=label,
+                name=step.name, level=level, n_tried=int(idx.size),
+                n_fixed=int(fixed.sum()))
             if timed_out:
                 # cooperative budget: a jitted attempt cannot be
                 # preempted, so an over-budget rung finishes but the
@@ -201,7 +213,7 @@ def resilient_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
                              max_attempts: Optional[int] = None,
                              attempt_timeout_s: Optional[float] = None,
                              recorder=None, base_results=None,
-                             jac_mode="analytic"):
+                             jac_mode="analytic", trace_id=None):
     """Batched ignition-delay sweep with the full resilience contract.
 
     Runs :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`, then
@@ -271,5 +283,6 @@ def resilient_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
     report = run_rescue(solve_subset, results, ladder=ladder,
                         max_attempts=max_attempts,
                         attempt_timeout_s=attempt_timeout_s,
-                        recorder=recorder, label="ignition_sweep")
+                        recorder=recorder, label="ignition_sweep",
+                        trace_id=trace_id)
     return results["times"], results["ok"], results["status"], report

@@ -53,6 +53,9 @@ def collect(q: "_queue.Queue[Request]", policy: BatchPolicy, stop,
         if on_expired is not None and req.expired():
             on_expired(req)
             return None
+        # adoption stamp: the boundary between the admission span
+        # (submit → here) and the batch-window span (here → dispatch)
+        req.t_adopt = time.perf_counter()
         return req
 
     first: Optional[Request] = None

@@ -77,6 +77,14 @@ class Request:
     #: set by the worker BEFORE the rescue hand-off: from then on the
     #: rescue thread owns the future and crash cleanup must skip it
     handed_off: bool = False
+    #: distributed-tracing id (None = unsampled: every span site takes
+    #: the one-``if`` early-out); assigned at submit, propagated over
+    #: the wire, shared by every span of this request's life
+    trace_id: Optional[str] = None
+    #: time.perf_counter() when the batcher adopted the request off the
+    #: admission queue — splits queue wait into the admission span
+    #: (submit → adopt) and the batch-window span (adopt → dispatch)
+    t_adopt: Optional[float] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         """Whether the deadline has passed (False when none was set)."""

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..resilience.procfaults import BackendPoisonedError
 from ..resilience.status import name_of
+from ..telemetry import trace
 from .errors import ServeError, ServerOverloaded
 
 #: a payload sampler: (index, rng) -> (kind, payload kwargs)
@@ -71,7 +72,9 @@ def run_load(server, samplers: Sequence[Sampler], *,
              rate_hz: float, n_requests: int,
              rng: np.random.Generator,
              result_timeout_s: float = 300.0,
-             deadline_ms: Optional[float] = None) -> Dict:
+             deadline_ms: Optional[float] = None,
+             trace_events: Optional[Callable[[], List[Dict]]] = None,
+             n_exemplars: int = 5) -> Dict:
     """Drive ``server`` with an open-loop Poisson stream; returns the
     JSON-ready latency summary.
 
@@ -91,7 +94,16 @@ def run_load(server, samplers: Sequence[Sampler], *,
     ALSO counted in ``n_rejected_with_hint``. A per-request result
     timeout or transport error is counted (``n_timeout`` /
     ``n_error``), never raised: one stuck future must not destroy the
-    whole run's latency artifact."""
+    whole run's latency artifact.
+
+    Every submit draws a trace id (``PYCHEMKIN_TRACE_SAMPLE``) and the
+    summary carries ``trace_exemplars``: timed-out requests first
+    (the stuck ones ARE the story), then the slowest resolved
+    requests, up to ``n_exemplars`` — each with its trace id, and,
+    when ``trace_events`` (a callable returning ``trace.span`` events,
+    e.g. read from the JSONL sinks) is given, its per-stage span
+    breakdown — so a bad soak run points at the guilty stage without
+    replaying it."""
     if not samplers:
         raise ValueError("need at least one payload sampler")
     arrivals = np.cumsum(rng.exponential(1.0 / rate_hz,
@@ -111,12 +123,13 @@ def run_load(server, samplers: Sequence[Sampler], *,
         kind, payload = samplers[int(rng.integers(len(samplers)))](
             i, rng)
         t_sub = time.perf_counter()
+        tid = trace.new_trace_id()
         try:
             if deadline_ms is None:
-                fut = server.submit(kind, **payload)
+                fut = server.submit(kind, trace_id=tid, **payload)
             else:
                 fut = server.submit(kind, deadline_ms=deadline_ms,
-                                    **payload)
+                                    trace_id=tid, **payload)
         except ServerOverloaded as exc:
             n_rejected += 1
             n_rejected_with_hint += int(
@@ -125,17 +138,19 @@ def run_load(server, samplers: Sequence[Sampler], *,
         fut.add_done_callback(
             lambda f, j=i: done_at.__setitem__(
                 j, time.perf_counter()))
-        records.append((i, kind, fut, t_sub))
+        records.append((i, kind, fut, t_sub, tid))
     offered_s = time.perf_counter() - t0
 
     lat_ms: List[float] = []
     occupancies: List[int] = []
     status_counts: Dict[str, int] = {}
+    resolved_reqs: List[Tuple[float, Optional[str], str, str]] = []
+    stuck_reqs: List[Tuple[Optional[str], str]] = []
     n_rescued = 0
     n_timeout = 0
     n_error = 0
     n_resolved = 0
-    for i, kind, fut, t_sub in records:
+    for i, kind, fut, t_sub, tid in records:
         try:
             res = fut.result(timeout=result_timeout_s)
         except _cf.TimeoutError:
@@ -143,6 +158,7 @@ def run_load(server, samplers: Sequence[Sampler], *,
             # n_timeout count — it must not raise out of the run and
             # destroy every other request's latency datapoint
             n_timeout += 1
+            stuck_reqs.append((tid, kind))
             continue
         except ServerOverloaded as exc:
             # transport-path rejection: admission happened on the far
@@ -163,12 +179,39 @@ def run_load(server, samplers: Sequence[Sampler], *,
         # it is released) — wait the beat out instead of KeyError-ing
         while i not in done_at:
             time.sleep(1e-4)
-        lat_ms.append((done_at[i] - t_sub) * 1e3)
+        latency = (done_at[i] - t_sub) * 1e3
+        lat_ms.append(latency)
         occupancies.append(res.occupancy)
         status_counts[res.status_name] = (
             status_counts.get(res.status_name, 0) + 1)
         n_rescued += int(res.rescued)
+        resolved_reqs.append((latency, tid, kind, res.status_name))
     wall_s = time.perf_counter() - t0
+
+    # trace exemplars: the stuck requests first (their traces show the
+    # last stage that RAN before the stall), then the slowest resolved
+    # ones — the handle a human greps the JSONL sinks with. Within
+    # each group, SAMPLED requests outrank unsampled: at
+    # PYCHEMKIN_TRACE_SAMPLE < 1 a null trace id is a handle pointing
+    # nowhere, so a slightly-faster traced request is the better
+    # exemplar than an untraceable slower one.
+    exemplars: List[Dict] = []
+    for tid, kind in sorted(stuck_reqs, key=lambda r: r[0] is None):
+        exemplars.append({"trace": tid, "kind": kind,
+                          "status": "TIMEOUT", "latency_ms": None})
+    for latency, tid, kind, status in sorted(
+            resolved_reqs, key=lambda r: (r[1] is None, -r[0])):
+        exemplars.append({"trace": tid, "kind": kind, "status": status,
+                          "latency_ms": round(latency, 3)})
+    exemplars = exemplars[:max(int(n_exemplars), 0)]
+    if trace_events is not None and exemplars:
+        span_map = trace.spans_from_events(trace_events())
+        for ex in exemplars:
+            spans = span_map.get(ex["trace"], [])
+            ex["spans"] = [{k: v for k, v in ev.items()
+                           if k not in ("kind", "trace", "t")}
+                          for ev in spans]
+            ex["breakdown"] = trace.breakdown(spans)
 
     # zero served requests (everything rejected) must still yield a
     # STRICT-JSON artifact: null stats, never a bare NaN literal
@@ -199,6 +242,7 @@ def run_load(server, samplers: Sequence[Sampler], *,
         "mean_occupancy": (round(float(occ.mean()), 3)
                            if occupancies else None),
         "max_occupancy": int(occ.max()) if occupancies else 0,
+        "trace_exemplars": exemplars,
     }
 
 

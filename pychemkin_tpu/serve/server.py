@@ -46,6 +46,15 @@ histograms (p50/p95/p99 in ``snapshot()``); ``serve.requests`` /
 ``serve.rescued`` / ``serve.abandoned`` / ``serve.status.<NAME>`` /
 ``serve.compiles[.*]`` counters; one ``serve.batch`` event per
 dispatched micro-batch and a ``serve.drain`` event at shutdown.
+
+Tracing: every sampled request (``PYCHEMKIN_TRACE_SAMPLE``, default
+1.0) carries a trace id from submit and emits its life as
+``trace.span`` events — ``serve.admission`` (submit → batcher
+adoption), ``serve.batch_window`` (adoption → dispatch),
+``serve.dispatch`` (bucket/occupancy/compile-hit/lane/status) and one
+``serve.rescue_rung`` per ladder rung — so a slow or rescued request
+is attributable stage by stage from the JSONL sink alone (see
+:mod:`pychemkin_tpu.telemetry.trace`).
 """
 
 from __future__ import annotations
@@ -59,6 +68,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .. import telemetry
 from ..resilience.driver import GracefulStop
 from ..resilience.status import SolveStatus, name_of
+from ..telemetry import trace
 from . import batcher, buckets
 from .engines import ENGINE_TYPES, Engine
 from .errors import ServerClosed, ServerOverloaded
@@ -238,7 +248,7 @@ class ChemServer:
         return round(float(hint), 3)
 
     def submit(self, kind: str, *, deadline_ms: Optional[float] = None,
-               **payload) -> ServeFuture:
+               trace_id=trace.UNSET, **payload) -> ServeFuture:
         """Admit one request; returns its future. Raises
         :class:`ServerOverloaded` (queue full; carries
         ``queue_depth``/``retry_after_ms`` backpressure hints) or
@@ -250,7 +260,15 @@ class ChemServer:
         never consumes a batch slot or reaches a compiled program — and
         its future resolves with ``SolveStatus.DEADLINE_EXCEEDED`` as
         data; a request already dispatched keeps its hot-path result,
-        but no rescue rung starts past the deadline."""
+        but no rescue rung starts past the deadline.
+
+        ``trace_id`` joins this request to a distributed trace started
+        upstream (a transport client, a supervisor); when not given, a
+        fresh sampling draw decides (``PYCHEMKIN_TRACE_SAMPLE``) —
+        an EXPLICIT ``None`` means upstream sampled the request out
+        and is honored, never re-drawn. Every hop — admission wait,
+        batch window, bucket dispatch, rescue rungs — is emitted as a
+        ``trace.span`` event on the recorder."""
         if self.draining or self._worker_done:
             raise ServerClosed("server is draining; no new admissions")
         eng = self.engine(kind)
@@ -260,7 +278,8 @@ class ChemServer:
                     else t_submit + float(deadline_ms) * 1e-3)
         req = Request(kind=kind, key=eng.group_key(norm), payload=norm,
                       future=ServeFuture(), t_submit=t_submit,
-                      deadline=deadline)
+                      deadline=deadline,
+                      trace_id=trace.resolve_trace_id(trace_id))
         try:
             self._queue.put_nowait(req)
         except _queue.Full:
@@ -279,19 +298,22 @@ class ChemServer:
         self._rec.gauge("serve.queue_depth", self._queue.qsize())
         return req.future
 
-    def submit_ignition(self, *, T0, P0, Y0, t_end,
-                        deadline_ms=None) -> ServeFuture:
+    def submit_ignition(self, *, T0, P0, Y0, t_end, deadline_ms=None,
+                        trace_id=trace.UNSET) -> ServeFuture:
         return self.submit("ignition", deadline_ms=deadline_ms,
+                           trace_id=trace_id,
                            T0=T0, P0=P0, Y0=Y0, t_end=t_end)
 
     def submit_equilibrium(self, *, T, P, Y, option=1,
-                           deadline_ms=None) -> ServeFuture:
+                           deadline_ms=None,
+                           trace_id=trace.UNSET) -> ServeFuture:
         return self.submit("equilibrium", deadline_ms=deadline_ms,
+                           trace_id=trace_id,
                            T=T, P=P, Y=Y, option=option)
 
     def submit_psr(self, *, tau, P, Y_in, h_in=None, T_in=None,
-                   T_guess=None, Y_guess=None,
-                   deadline_ms=None) -> ServeFuture:
+                   T_guess=None, Y_guess=None, deadline_ms=None,
+                   trace_id=trace.UNSET) -> ServeFuture:
         payload = {"tau": tau, "P": P, "Y_in": Y_in}
         if h_in is not None:
             payload["h_in"] = h_in
@@ -301,7 +323,8 @@ class ChemServer:
             payload["T_guess"] = T_guess
         if Y_guess is not None:
             payload["Y_guess"] = Y_guess
-        return self.submit("psr", deadline_ms=deadline_ms, **payload)
+        return self.submit("psr", deadline_ms=deadline_ms,
+                           trace_id=trace_id, **payload)
 
     # -- direct reference path -------------------------------------------
     def solve_direct(self, kind: str, *, bucket: int = 1,
@@ -394,6 +417,9 @@ class ChemServer:
         self._rec.inc("serve.deadline_expired")
         self._rec.inc(
             f"serve.status.{name_of(SolveStatus.DEADLINE_EXCEEDED)}")
+        trace.emit_span(self._rec, req.trace_id, "serve.expired",
+                        (now - req.t_submit) * 1e3, req_kind=req.kind,
+                        req_id=req.id)
         self._resolve_future(req.future, make_result(
             {}, int(SolveStatus.DEADLINE_EXCEEDED), kind=req.kind,
             bucket=0, occupancy=0,
@@ -457,6 +483,9 @@ class ChemServer:
         occupancy = len(reqs)
         bucket = buckets.bucket_for(occupancy, self.buckets)
         t_form = time.perf_counter()
+        # .get: counters is a defaultdict and an unlocked missing-key
+        # read would INSERT, racing a live snapshot()
+        compiles_before = self._rec.counters.get("serve.compiles", 0)
         try:
             out, solve_s = eng.solve([r.payload for r in reqs],
                                      bucket, key)
@@ -474,6 +503,8 @@ class ChemServer:
                 self._fail_future(r.future, exc)
             return
         solve_ms = solve_s * 1e3
+        compile_hit = (self._rec.counters.get("serve.compiles", 0)
+                       == compiles_before)
         self._rec.inc("serve.batches")
         self._rec.observe("serve.batch_occupancy", occupancy)
         self._rec.observe("serve.solve_ms", solve_ms)
@@ -484,6 +515,23 @@ class ChemServer:
                 self._rec.observe("serve.queue_wait_ms", wait_ms)
                 status = int(out["status"][i])
                 self._rec.inc(f"serve.status.{name_of(status)}")
+                if req.trace_id is not None:
+                    # the request's hot-path story as three spans:
+                    # submit → adoption → dispatch → program done
+                    t_adopt = (req.t_adopt if req.t_adopt is not None
+                               else t_form)
+                    trace.emit_span(
+                        self._rec, req.trace_id, "serve.admission",
+                        (t_adopt - req.t_submit) * 1e3,
+                        req_kind=kind, req_id=req.id)
+                    trace.emit_span(
+                        self._rec, req.trace_id, "serve.batch_window",
+                        (t_form - t_adopt) * 1e3)
+                    trace.emit_span(
+                        self._rec, req.trace_id, "serve.dispatch",
+                        solve_ms, req_kind=kind, bucket=bucket,
+                        occupancy=occupancy, compile_hit=compile_hit,
+                        lane=i, status=name_of(status))
                 meta = dict(kind=kind, bucket=bucket,
                             occupancy=occupancy,
                             queue_wait_ms=wait_ms, solve_ms=solve_ms)
@@ -567,8 +615,13 @@ class ChemServer:
                 deadline_cut = True
                 break
             level = next_level
+            t_rung = time.perf_counter()
             out, status = eng.rescue_one(req.payload, key,
                                          level, elem_id)
+            trace.emit_span(
+                self._rec, req.trace_id, "serve.rescue_rung",
+                (time.perf_counter() - t_rung) * 1e3,
+                req_kind=req.kind, level=level, status=name_of(status))
             # keep value and status PAIRED: when every rung fails, the
             # result carries the last rung's value with the last rung's
             # status, never the hot path's diverged value under a

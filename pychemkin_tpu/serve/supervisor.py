@@ -37,10 +37,21 @@ future dies with it. :class:`Supervisor` closes that hole:
   (``GracefulStop`` end-to-end). Anything still unresolved after the
   child is gone fails typed ``ServerClosed``.
 
+- **Post-mortem**: every lost backend leaves a KILL REPORT artifact
+  (atomic JSON under ``PYCHEMKIN_KILL_REPORT_DIR`` or the
+  ``kill_report_dir`` kwarg): failure classification (crash / hang /
+  poison), last heartbeat age, the in-flight requests with their
+  TRACE ids (the handle into the JSONL sinks), and the respawn-budget
+  state. The backend's own flight recorder covers catchable deaths
+  (SIGTERM/atexit); the kill report covers the SIGKILL class the child
+  cannot witness.
+
 Telemetry: ``supervisor.spawn`` / ``supervisor.backend_lost`` /
-``supervisor.respawn_exhausted`` / ``supervisor.drain`` events;
-``supervisor.respawns`` / ``supervisor.resubmits`` /
-``supervisor.backend_lost_requests`` counters.
+``supervisor.respawn_exhausted`` / ``supervisor.drain`` /
+``supervisor.kill_report[_failed]`` events; ``supervisor.respawns`` /
+``supervisor.resubmits`` / ``supervisor.backend_lost_requests``
+counters; ``supervisor.resubmit`` / ``supervisor.backend_lost``
+trace spans under each affected request's trace id.
 """
 
 from __future__ import annotations
@@ -61,9 +72,16 @@ from ..resilience.driver import GracefulStop, is_poisoned
 from ..resilience.procfaults import REEXEC_COUNT_ENV
 from ..resilience.rescue import _env_int
 from ..resilience.status import SolveStatus, name_of
+from ..telemetry import trace
 from .errors import ServerClosed, TransportClosed
 from .futures import ServeFuture, make_result
 from .transport import PORT_MARKER, READY_MARKER, TransportClient
+
+#: directory the supervisor banks kill reports into (one JSON artifact
+#: per lost backend; see :meth:`Supervisor._write_kill_report`) — the
+#: SIGKILL-proof half of the crash flight recorder. Also settable per
+#: supervisor via the ``kill_report_dir`` kwarg.
+KILL_REPORT_DIR_ENV = "PYCHEMKIN_KILL_REPORT_DIR"
 
 
 class SupervisorError(RuntimeError):
@@ -83,6 +101,7 @@ class _InFlight:
     deadline: Optional[float]        # absolute perf_counter, or None
     attempts: int = 0                # wire sends so far
     generation_sent: int = -1        # backend generation last sent to
+    trace_id: Optional[str] = None   # distributed-tracing id (or None)
 
 
 class Supervisor:
@@ -106,7 +125,8 @@ class Supervisor:
                  retry_budget: int = 1,
                  spawn_timeout_s: float = 300.0,
                  default_tenant: str = "default",
-                 recorder=None):
+                 recorder=None,
+                 kill_report_dir: Optional[str] = None):
         self.config = dict(config or {})
         self.host = host
         self._backend_argv = backend_argv
@@ -122,6 +142,10 @@ class Supervisor:
         self.default_tenant = default_tenant
         self._rec = (recorder if recorder is not None
                      else telemetry.get_recorder())
+        self._kill_report_dir = (
+            kill_report_dir if kill_report_dir is not None
+            else os.environ.get(KILL_REPORT_DIR_ENV))
+        self._last_pong: Optional[float] = None
         self._lock = threading.RLock()
         self._proc: Optional[subprocess.Popen] = None
         self._client: Optional[TransportClient] = None
@@ -173,7 +197,13 @@ class Supervisor:
 
     def _spawn(self, generation: int) -> None:
         """Start a backend child and connect; raises
-        :class:`SupervisorError` on spawn/ready timeout."""
+        :class:`SupervisorError` on spawn/ready timeout (and when a
+        drain began — a respawn racing ``close()`` must not leave an
+        orphan child serving nobody)."""
+        with self._lock:
+            if self._draining:
+                raise SupervisorError(
+                    "supervisor draining; respawn refused")
         proc = subprocess.Popen(
             self._argv(), env=self._child_env(generation),
             stdout=subprocess.PIPE, text=True, bufsize=1)
@@ -203,11 +233,23 @@ class Supervisor:
                     f"{generation})")
         port = port_box["port"]
         client = TransportClient(self.host, port,
-                                 tenant=self.default_tenant)
-        hb = TransportClient(self.host, port)
+                                 tenant=self.default_tenant,
+                                 recorder=self._rec)
+        hb = TransportClient(self.host, port, recorder=self._rec)
         with self._lock:
             self._proc, self._port = proc, port
             self._client, self._hb = client, hb
+            draining = self._draining
+        if draining:
+            # close() raced this spawn past the entry check: it has
+            # already swept the OLD proc and will not see this one —
+            # tear the fresh child down here instead of orphaning it
+            for c in (client, hb):
+                c.close()
+            proc.kill()
+            proc.wait()
+            raise SupervisorError(
+                "supervisor draining; respawned child discarded")
         self._rec.event("supervisor.spawn", generation=generation,
                         pid=proc.pid, port=port)
 
@@ -272,6 +314,27 @@ class Supervisor:
             raise ServerClosed("no live backend")
         return client.stats(timeout=timeout)
 
+    def metrics(self, timeout: float = 30.0) -> Dict[str, Any]:
+        """The MERGED fleet-metrics snapshot for this supervised
+        backend: the backend's ``metrics`` reply (counters, histogram
+        summaries + mergeable states, tenants, uptime, generation)
+        with the supervisor's own respawn/re-submit/backend-lost
+        counters under ``"supervisor"`` — one scrape answers both
+        "how is the serving core doing" and "how often is it dying".
+        A dead/respawning backend yields ``{"error": ..,
+        "supervisor": ..}`` instead of raising: a scraper must keep
+        working exactly when the fleet is unhealthy."""
+        try:
+            with self._lock:
+                client = self._client
+            if client is None:
+                raise ServerClosed("no live backend")
+            reply = dict(client.metrics(timeout=timeout))
+        except Exception as exc:     # noqa: BLE001 — scrape must land
+            reply = {"error": f"{type(exc).__name__}: {exc}"}
+        reply["supervisor"] = self.stats()
+        return reply
+
     def install_signal_handlers(self) -> GracefulStop:
         """SIGTERM/SIGINT → graceful drain (flag only; the heartbeat
         thread notices and starts :meth:`close`)."""
@@ -280,12 +343,21 @@ class Supervisor:
     # -- request path ----------------------------------------------------
     def submit(self, kind: str, *, tenant: Optional[str] = None,
                deadline_ms: Optional[float] = None,
+               trace_id=trace.UNSET,
                **payload) -> ServeFuture:
         """Admit one request through the supervised backend. The
         returned future ALWAYS resolves: a value with its status, a
         ``BACKEND_LOST``/``DEADLINE_EXCEEDED`` status as data, or a
         typed error (overload, closed) — crash, hang, and poison are
-        absorbed by respawn + re-submission."""
+        absorbed by respawn + re-submission.
+
+        ``trace_id`` (or a fresh sampling draw when not given; an
+        explicit ``None`` stays unsampled at every hop) travels the
+        request's whole life — across the wire into the backend's
+        spans, AND through respawns: a re-submission emits a
+        ``supervisor.resubmit`` span under the SAME trace id, so a
+        healed or ``BACKEND_LOST`` request's trace shows the dead
+        generation it rode through."""
         with self._lock:
             if self._draining or self._dead:
                 raise ServerClosed(
@@ -297,7 +369,8 @@ class Supervisor:
                 kind=kind, tenant=tenant, payload=dict(payload),
                 future=ServeFuture(), t_submit=t_submit,
                 deadline=(None if deadline_ms is None
-                          else t_submit + float(deadline_ms) * 1e-3))
+                          else t_submit + float(deadline_ms) * 1e-3),
+                trace_id=trace.resolve_trace_id(trace_id))
             self._inflight[next(self._ids)] = entry
         self._try_send(entry)
         return entry.future
@@ -312,11 +385,19 @@ class Supervisor:
     def _resolve_status(self, entry: _InFlight, status: int) -> None:
         """Resolve an entry with a host-side status-as-data result."""
         self._remove(entry)
+        life_ms = (time.perf_counter() - entry.t_submit) * 1e3
+        if status == int(SolveStatus.BACKEND_LOST):
+            # the trace's terminal chapter: which generation died under
+            # the request and how many sends it burned getting there
+            trace.emit_span(self._rec, entry.trace_id,
+                            "supervisor.backend_lost", life_ms,
+                            req_kind=entry.kind,
+                            generation=self._respawns,
+                            attempts=entry.attempts)
         try:
             entry.future.set_result(make_result(
                 {}, status, kind=entry.kind, bucket=0, occupancy=0,
-                queue_wait_ms=(time.perf_counter()
-                               - entry.t_submit) * 1e3,
+                queue_wait_ms=life_ms,
                 solve_ms=0.0))
         except Exception:            # noqa: BLE001 — racing resolution
             pass
@@ -345,7 +426,8 @@ class Supervisor:
         try:
             wire_fut = client.submit(
                 entry.kind, tenant=entry.tenant,
-                deadline_ms=remaining_ms, **entry.payload)
+                deadline_ms=remaining_ms, trace_id=entry.trace_id,
+                **entry.payload)
         except TransportClosed:
             with self._lock:
                 entry.generation_sent = -1
@@ -404,7 +486,7 @@ class Supervisor:
                 pass
 
     def _heartbeat_loop(self) -> None:
-        last_pong = time.perf_counter()
+        last_pong = self._last_pong = time.perf_counter()
         hb_seen = self._hb
         while True:
             time.sleep(self.heartbeat_s)
@@ -423,9 +505,10 @@ class Supervisor:
                 continue             # respawn in progress
             if hb is not hb_seen:
                 hb_seen, last_pong = hb, time.perf_counter()
+                self._last_pong = last_pong
             try:
                 hb.ping(timeout=self.heartbeat_s)
-                last_pong = time.perf_counter()
+                last_pong = self._last_pong = time.perf_counter()
             except Exception:        # noqa: BLE001 — miss or torn conn
                 if (time.perf_counter() - last_pong
                         > self.hang_timeout_s):
@@ -466,6 +549,10 @@ class Supervisor:
             self._rec.event("supervisor.backend_lost", reason=reason,
                             rc=rc, generation=respawns,
                             n_inflight=len(self._inflight))
+            # the SIGKILL-proof half of the crash flight recorder: the
+            # dead child cannot dump its own state, so the supervisor
+            # banks the post-mortem from the outside
+            self._write_kill_report(reason, rc, respawns, proc.pid)
             if respawns >= self.max_respawns:
                 self._mark_dead(
                     f"respawn budget ({self.max_respawns}) exhausted "
@@ -481,6 +568,76 @@ class Supervisor:
                 return
             self._resubmit_all()
 
+    @staticmethod
+    def _classify_loss(reason: str) -> str:
+        """Failure-class taxonomy for kill reports, derived from the
+        same reason strings the ``supervisor.backend_lost`` event
+        carries: ``hang`` (heartbeat watchdog fired), ``poison``
+        (wedged-accelerator-client reply), ``crash`` (the child exited
+        on its own — SIGKILL preemption, OOM, segfault)."""
+        if "heartbeat" in reason:
+            return "hang"
+        if "poison" in reason.lower():
+            return "poison"
+        return "crash"
+
+    def _write_kill_report(self, reason: str, rc: Optional[int],
+                           generation: int,
+                           pid: Optional[int]) -> Optional[str]:
+        """Bank one kill-report artifact for a lost backend (atomic
+        JSON; see :data:`KILL_REPORT_DIR_ENV`). The backend's OWN
+        flight recorder cannot run for SIGKILL-class deaths, so this
+        is written from the outside: classification, last heartbeat
+        age, the in-flight requests (ids + trace ids — the handle into
+        the JSONL sinks), and the respawn-budget state. Failure to
+        write degrades observability, never the respawn."""
+        if not self._kill_report_dir:
+            return None
+        now = time.perf_counter()
+        with self._lock:
+            inflight = [
+                {"kind": e.kind, "tenant": e.tenant,
+                 "trace": e.trace_id, "attempts": e.attempts,
+                 "generation_sent": e.generation_sent,
+                 "age_ms": round((now - e.t_submit) * 1e3, 3),
+                 "deadline_remaining_ms": (
+                     None if e.deadline is None
+                     else round((e.deadline - now) * 1e3, 3))}
+                for e in self._inflight.values()]
+        report = {
+            "t": time.time(),
+            "classification": self._classify_loss(reason),
+            "reason": reason,
+            "rc": rc,
+            "generation": generation,
+            "backend_pid": pid,
+            "supervisor_pid": os.getpid(),
+            "last_heartbeat_age_s": (
+                None if self._last_pong is None
+                else round(now - self._last_pong, 3)),
+            "n_inflight": len(inflight),
+            "inflight": inflight,
+            "respawn_budget": {
+                "respawns": generation,
+                "max_respawns": self.max_respawns,
+                "remaining": max(self.max_respawns - generation, 0)},
+        }
+        path = os.path.join(
+            self._kill_report_dir,
+            f"kill_report_g{generation}_{pid or 0}.json")
+        try:
+            os.makedirs(self._kill_report_dir, exist_ok=True)
+            telemetry.atomic_write_json(path, report)
+        except OSError as exc:
+            self._rec.event("supervisor.kill_report_failed",
+                            path=path,
+                            error=f"{type(exc).__name__}: {exc}")
+            return None
+        self._rec.event("supervisor.kill_report", path=path,
+                        classification=report["classification"],
+                        generation=generation)
+        return path
+
     def _mark_dead(self, reason: str) -> None:
         with self._lock:
             self._dead = True
@@ -491,12 +648,17 @@ class Supervisor:
         for entry in entries:
             self._lost_requests += 1
             self._rec.inc("supervisor.backend_lost_requests")
+            life_ms = (time.perf_counter() - entry.t_submit) * 1e3
+            trace.emit_span(self._rec, entry.trace_id,
+                            "supervisor.backend_lost", life_ms,
+                            req_kind=entry.kind,
+                            generation=self._respawns,
+                            attempts=entry.attempts)
             try:
                 entry.future.set_result(make_result(
                     {}, int(SolveStatus.BACKEND_LOST),
                     kind=entry.kind, bucket=0, occupancy=0,
-                    queue_wait_ms=(time.perf_counter()
-                                   - entry.t_submit) * 1e3,
+                    queue_wait_ms=life_ms,
                     solve_ms=0.0))
             except Exception:        # noqa: BLE001 — racing resolution
                 pass
@@ -522,6 +684,14 @@ class Supervisor:
             if entry.attempts > 0:
                 self._resubmits += 1
                 self._rec.inc("supervisor.resubmits")
+                # child span under the ORIGINAL trace id: the healed
+                # request's story includes the generation that died
+                # holding it and the fresh one it was re-sent to
+                trace.emit_span(
+                    self._rec, entry.trace_id, "supervisor.resubmit",
+                    (time.perf_counter() - entry.t_submit) * 1e3,
+                    req_kind=entry.kind, generation=generation,
+                    attempt=entry.attempts)
             self._try_send(entry)
 
     # -- shutdown --------------------------------------------------------
@@ -562,6 +732,21 @@ class Supervisor:
                     if not self._inflight:
                         break
                 time.sleep(0.01)
+            # the monitor may have respawned a FRESH child between the
+            # death we drained and the _draining flag landing — a new
+            # generation this close() never SIGTERMed. Sweep it: an
+            # orphan backend serving nobody must not outlive its
+            # supervisor (_spawn also refuses once draining is set).
+            with self._lock:
+                cur = self._proc
+            if cur is not None and cur is not proc \
+                    and cur.poll() is None:
+                try:
+                    cur.kill()
+                except OSError:
+                    pass
+                cur.wait()
+                graceful = False
             self._close_clients()
             for t in (self._monitor, self._hb_thread):
                 if t is not None and t is not threading.current_thread():

@@ -39,10 +39,28 @@ Wire ops (requests carry ``id``; every reply echoes it):
 ``stats``      → ``stats_reply`` (per-server counters, per-tenant
                in-flight) — how acceptance tests prove deadline-
                expired requests never dispatched
+``metrics``    → ``metrics_reply``: the fleet-exposition snapshot —
+               counters, gauges, histogram SUMMARIES *and* raw
+               mergeable histogram STATES, per-tenant
+               inflight/quota, uptime_s, pid, and the backend
+               generation (the supervisor's re-exec stamp) — what
+               ``tools/chemtop.py`` polls and merges across backends
 ``drain``      → drains every ChemServer (in-flight requests resolve,
                replies flush), then ``drain_done``; the process-level
                half of ``GracefulStop`` end-to-end
 =============  ========================================================
+
+Tracing: a submit may carry a ``trace`` id (the client draws one per
+``PYCHEMKIN_TRACE_SAMPLE`` when the caller did not). The backend joins
+its serve-layer spans to that id and the reply echoes it; the client
+additionally emits a ``client.wire`` span for the observed round-trip
+— so one trace id follows the request across both processes' JSONL
+sinks. A backend started with ``PYCHEMKIN_TELEMETRY_PATH`` set attaches
+that JSONL sink to its default recorder (respawned generations append
+to the same file; each event line is one atomic O_APPEND write), and
+dumps a crash flight record (recent-event ring + counters) on
+SIGTERM/atexit when ``PYCHEMKIN_FLIGHT_DIR``/``PYCHEMKIN_FLIGHT_PATH``
+is set.
 
 Run as a backend process (what the supervisor spawns)::
 
@@ -58,8 +76,10 @@ post-respawn dispatches are still compile-cache hits.
 from __future__ import annotations
 
 import argparse
+import atexit
 import itertools
 import json
+import os
 import queue as _queue
 import socket
 import struct
@@ -74,6 +94,7 @@ from .. import telemetry
 from ..resilience import procfaults
 from ..resilience.driver import GracefulStop
 from ..resilience.procfaults import BackendPoisonedError
+from ..telemetry import trace
 from .errors import (
     ServeError,
     ServerClosed,
@@ -276,6 +297,7 @@ class TransportServer:
         self._hb_ordinal = itertools.count()
         self._closed = False
         self._drained = threading.Event()
+        self._t_start = time.time()
 
     # -- lifecycle -------------------------------------------------------
     def _server_for(self, mech_name: str) -> ChemServer:
@@ -388,6 +410,8 @@ class TransportServer:
                                  "n_inflight": n})
                 elif op == "stats":
                     writer.send(self._stats_reply(msg.get("id")))
+                elif op == "metrics":
+                    writer.send(self._metrics_reply(msg.get("id")))
                 elif op == "drain":
                     threading.Thread(
                         target=self._drain_and_ack,
@@ -425,6 +449,33 @@ class TransportServer:
                     if k.startswith("serve.")}
         return {"op": "stats_reply", "id": rid, "tenants": tenants,
                 "counters": counters}
+
+    def _metrics_reply(self, rid) -> Dict:
+        """The fleet-metrics exposition snapshot: everything a scraper
+        needs to merge this backend into a fleet view. Histograms ship
+        BOTH as summaries (human-readable) and raw mergeable states
+        (``telemetry.merge_histogram_states`` combines distributions
+        exactly across backends — merged percentiles come from merged
+        buckets, not averaged per-process percentiles). Read-only
+        snapshot: a periodic scrape must not rewrite the sink's
+        snapshot file under the event lock on the serving hot path."""
+        snap = self._rec.snapshot(write=False)
+        with self._quota_lock:
+            tenants = {t.name: {"inflight": t.inflight,
+                                "quota": t.quota}
+                       for t in self._tenants.values()}
+        return {"op": "metrics_reply", "id": rid,
+                "t": time.time(),
+                "pid": os.getpid(),
+                # the supervisor's re-exec stamp: 0 = original process,
+                # +1 per respawn — lets a scraper see a churning backend
+                "generation": procfaults.reexec_count(),
+                "uptime_s": round(time.time() - self._t_start, 3),
+                "tenants": tenants,
+                "counters": snap["counters"],
+                "gauges": snap["gauges"],
+                "histograms": snap["histograms"],
+                "histogram_states": self._rec.histogram_states()}
 
     def _overload_reply(self, rid, *, scope: str, queue_depth: int,
                         retry_after_ms: Optional[float],
@@ -471,9 +522,14 @@ class TransportServer:
                 message=f"tenant {tenant.name!r} quota "
                         f"({tenant.quota}) saturated"))
             return
+        # "trace" present (even as null) is the CLIENT's sampling
+        # decision and passes through un-redrawn; a frame from a
+        # tracing-unaware client (no key) lets this backend draw
+        tid = (msg["trace"] if "trace" in msg else trace.UNSET)
         try:
             fut = srv.submit(msg["kind"],
                              deadline_ms=msg.get("deadline_ms"),
+                             trace_id=tid,
                              **msg.get("payload", {}))
         except BaseException as exc:   # noqa: BLE001 — typed reply
             with self._quota_lock:
@@ -490,12 +546,13 @@ class TransportServer:
             writer.send(reply)
             return
 
-        def _reply(f: ServeFuture, _rid=rid, _tenant=tenant) -> None:
+        def _reply(f: ServeFuture, _rid=rid, _tenant=tenant,
+                   _tid=(None if tid is trace.UNSET else tid)) -> None:
             with self._quota_lock:
                 _tenant.inflight -= 1
             exc = f.exception()
             if exc is None:
-                out = {"op": "result", "id": _rid,
+                out = {"op": "result", "id": _rid, "trace": _tid,
                        "result": result_to_wire(f.result())}
             elif isinstance(exc, ServerOverloaded):
                 out = self._overload_reply(
@@ -532,15 +589,21 @@ class TransportClient:
 
     def __init__(self, host: str, port: int, *,
                  tenant: str = "default",
-                 connect_timeout_s: float = 30.0):
+                 connect_timeout_s: float = 30.0,
+                 recorder=None):
         self.tenant = tenant
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
         self._sock = socket.create_connection(
             (host, int(port)), timeout=connect_timeout_s)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
-        self._pending: Dict[int, Tuple[str, ServeFuture]] = {}
+        # rid -> (kind, future, trace id, perf_counter at send): the
+        # last two drive the client-side ``client.wire`` span
+        self._pending: Dict[int, Tuple[str, ServeFuture,
+                                       Optional[str], float]] = {}
         self._ids = itertools.count()
         self._closed = False
         self._rx = threading.Thread(target=self._recv_loop,
@@ -549,13 +612,15 @@ class TransportClient:
         self._rx.start()
 
     # -- plumbing --------------------------------------------------------
-    def _register(self, kind: str) -> Tuple[int, ServeFuture]:
+    def _register(self, kind: str, trace_id: Optional[str] = None
+                  ) -> Tuple[int, ServeFuture]:
         fut = ServeFuture()
         with self._plock:
             if self._closed:
                 raise TransportClosed("transport client closed")
             rid = next(self._ids)
-            self._pending[rid] = (kind, fut)
+            self._pending[rid] = (kind, fut, trace_id,
+                                  time.perf_counter())
         return rid, fut
 
     def _send(self, msg: Dict, rid: int, fut: ServeFuture) -> None:
@@ -586,8 +651,15 @@ class TransportClient:
             entry = self._pending.pop(rid, None)
         if entry is None:
             return                   # late reply for an abandoned id
-        _, fut = entry
+        kind, fut, tid, t_send = entry
         op = msg.get("op")
+        if op in ("result", "error"):
+            # the round trip as THIS process saw it: everything between
+            # handing the frame to the kernel and parsing the reply —
+            # serialization, network, backend queueing + solve
+            trace.emit_span(self._rec, tid, "client.wire",
+                            (time.perf_counter() - t_send) * 1e3,
+                            req_kind=kind, op=op)
         try:
             if op == "result":
                 fut.set_result(result_from_wire(msg["result"]))
@@ -602,7 +674,7 @@ class TransportClient:
         with self._plock:
             self._closed = True
             pending, self._pending = dict(self._pending), {}
-        for _, fut in pending.values():
+        for _, fut, _tid, _t in pending.values():
             try:
                 fut.set_exception(exc)
             except Exception:        # noqa: BLE001 — racing resolution
@@ -611,11 +683,14 @@ class TransportClient:
     # -- API -------------------------------------------------------------
     def submit(self, kind: str, *, tenant: Optional[str] = None,
                deadline_ms: Optional[float] = None,
+               trace_id=trace.UNSET,
                **payload) -> ServeFuture:
-        rid, fut = self._register(kind)
+        tid = trace.resolve_trace_id(trace_id)
+        rid, fut = self._register(kind, tid)
         self._send({"op": "submit", "id": rid,
                     "tenant": tenant or self.tenant, "kind": kind,
-                    "deadline_ms": deadline_ms, "payload": payload},
+                    "deadline_ms": deadline_ms, "trace": tid,
+                    "payload": payload},
                    rid, fut)
         return fut
 
@@ -629,6 +704,12 @@ class TransportClient:
 
     def stats(self, timeout: float = 30.0) -> Dict:
         return self._control("stats", timeout)
+
+    def metrics(self, timeout: float = 30.0) -> Dict:
+        """The backend's fleet-metrics snapshot (``metrics`` op):
+        counters, gauges, histogram summaries + mergeable states,
+        per-tenant inflight/quota, uptime, pid, generation."""
+        return self._control("metrics", timeout)
 
     def drain(self, timeout: float = 300.0) -> Dict:
         """Graceful remote drain; blocks until ``drain_done`` (every
@@ -677,6 +758,12 @@ READY_MARKER = "PYCHEMKIN_SERVE_READY"
 DEFAULT_CONFIG = {"tenants": {"default": {"mech": "h2o2"}},
                   "kinds": ["equilibrium"]}
 
+#: backend JSONL sink destination (attached to the default recorder at
+#: startup when set): respawned generations APPEND to the same file —
+#: each event is one O_APPEND write, so generations interleave whole
+#: lines and one trace id can be followed across a respawn
+TELEMETRY_PATH_ENV = "PYCHEMKIN_TELEMETRY_PATH"
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -700,6 +787,33 @@ def main(argv=None) -> int:
     chem_kwargs = dict(config.get("chem", {}))
     if config.get("engine_config"):
         chem_kwargs["engine_config"] = config["engine_config"]
+    tel_path = os.environ.get(TELEMETRY_PATH_ENV)
+    if tel_path:
+        # crash-safe JSONL sink on the default recorder (the recorder
+        # every ChemServer built below inherits): serve.batch events,
+        # trace.span events, supervisor-correlatable history
+        telemetry.configure(tel_path)
+
+    # crash flight recorder, catchable-death half: SIGTERM (graceful
+    # drain), drain-op exit, and any orderly interpreter exit dump the
+    # recent-event ring + counters; SIGKILL-class deaths are covered
+    # from the OUTSIDE by the supervisor's kill report
+    dumped = []
+
+    def _flight(reason: str) -> None:
+        if dumped:
+            return                   # first (most specific) reason wins
+        try:
+            path = telemetry.flight_recorder_dump(
+                reason, generation=procfaults.reexec_count())
+        except OSError:
+            return                   # bad destination: dying anyway
+        if path is not None:
+            dumped.append(path)
+            print(f"# flight recorder dumped to {path}",
+                  file=sys.stderr)
+
+    atexit.register(_flight, "atexit")
     ts = TransportServer(config["tenants"], host=args.host,
                          port=args.port, chem_kwargs=chem_kwargs)
     ts.start()
@@ -715,6 +829,7 @@ def main(argv=None) -> int:
     while not stop.requested and not ts.drained:
         time.sleep(0.05)
     ts.close()
+    _flight("graceful_stop" if stop.requested else "drained")
     stop.restore()
     return 0
 

@@ -63,6 +63,16 @@ Kinds emitted by the framework:
                      ``SolveStatus.BACKEND_LOST`` as data.
 - ``supervisor.drain``        — graceful supervisor shutdown
                      (graceful, respawns, resubmits, backend_lost).
+- ``supervisor.kill_report`` / ``supervisor.kill_report_failed`` — the
+                     supervisor banked a crash-flight-recorder kill
+                     report for a lost backend (path, classification)
+                     / could not write one (durability degraded, the
+                     respawn continues).
+- ``trace.span``     — one traced hop of one request (trace, span,
+                     dur_ms, optional parent + per-span fields); see
+                     :mod:`.trace` for the span-name catalogue and the
+                     ``PYCHEMKIN_TRACE_SAMPLE`` sampling knob. The
+                     event's ``t`` is the span END.
 
 Histograms (``MetricsRecorder.observe``; p50/p95/p99 under
 ``histograms`` in ``snapshot()``): ``serve.queue_wait_ms``,
@@ -84,13 +94,17 @@ fallback branch (a batched solve with several stagnated elements adds
 several to the former, one to the latter).
 """
 
+from . import trace
 from .recorder import (
     Histogram,
     MetricsRecorder,
     configure,
     device_counters_enabled,
     device_increment,
+    flight_recorder_dump,
+    flight_recorder_path,
     get_recorder,
+    merge_histogram_states,
     record_event,
 )
 from .sink import (
@@ -111,7 +125,11 @@ __all__ = [
     "device_counters_enabled",
     "device_increment",
     "dumps_line",
+    "flight_recorder_dump",
+    "flight_recorder_path",
     "get_recorder",
+    "merge_histogram_states",
     "read_jsonl",
     "record_event",
+    "trace",
 ]

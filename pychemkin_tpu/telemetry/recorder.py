@@ -37,11 +37,27 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from .sink import JsonlSink, timestamp
+from .sink import JsonlSink, atomic_write_json, timestamp
 
 #: environment switch for the device→host counter bridge; checked at
 #: trace time so disabling it removes the callback nodes entirely
 _DEVICE_COUNTERS_ENV = "PYCHEMKIN_TELEMETRY_DEVICE"
+
+#: ring-buffer cap for the in-memory event tail (see
+#: :class:`MetricsRecorder`): a long chaos soak emits events without
+#: bound, and the JSONL sink is the full record — memory only needs the
+#: recent tail a flight-recorder dump or ``last_event`` lookup wants
+EVENTS_CAP_ENV = "PYCHEMKIN_TELEMETRY_EVENTS_CAP"
+DEFAULT_EVENTS_CAP = 4096
+
+
+def _events_cap() -> int:
+    raw = os.environ.get(EVENTS_CAP_ENV)
+    try:
+        cap = int(raw) if raw else DEFAULT_EVENTS_CAP
+    except ValueError:
+        cap = DEFAULT_EVENTS_CAP
+    return max(cap, 1)
 
 
 def device_counters_enabled() -> bool:
@@ -125,6 +141,51 @@ class Histogram:
             "p99": round(self.percentile(99.0), 6),
         }
 
+    # -- mergeable wire form --------------------------------------------
+    def state(self) -> Dict[str, Any]:
+        """JSON-ready raw state (bucket counts keyed by edge index as
+        strings, exact count/sum/min/max). Unlike :meth:`summary`,
+        states MERGE exactly: two processes' histograms over the same
+        fixed edge set combine bucket-wise, so fleet percentiles are
+        computed from the merged distribution, not averaged from
+        per-process percentiles (which is statistically meaningless).
+        This is what the transport ``metrics`` op ships and what
+        ``chemtop`` merges across backends."""
+        return {"counts": {str(k): v for k, v in self.counts.items()},
+                "count": self.count, "sum": round(self.sum, 6),
+                "min": round(self.min, 6) if self.count else None,
+                "max": round(self.max, 6) if self.count else None}
+
+    def merge_state(self, state: Optional[Dict[str, Any]]) -> "Histogram":
+        """Fold one :meth:`state` dict in (empty/None states are
+        no-ops); returns self for chaining."""
+        if not state or not state.get("count"):
+            return self
+        for k, v in (state.get("counts") or {}).items():
+            self.counts[int(k)] += int(v)
+        self.count += int(state["count"])
+        self.sum += float(state.get("sum") or 0.0)
+        if state.get("min") is not None:
+            self.min = min(self.min, float(state["min"]))
+        if state.get("max") is not None:
+            self.max = max(self.max, float(state["max"]))
+        return self
+
+    @classmethod
+    def from_states(cls, states) -> "Histogram":
+        h = cls()
+        for s in states:
+            h.merge_state(s)
+        return h
+
+
+def merge_histogram_states(states) -> Dict[str, float]:
+    """Merge raw histogram states (see :meth:`Histogram.state`) from
+    several processes into ONE summary — the fleet-level
+    count/sum/mean/min/max/p50/p95/p99. Empty states contribute
+    nothing; disjoint bucket sets union; shared buckets add."""
+    return Histogram.from_states(states).summary()
+
 
 class MetricsRecorder:
     """Counters + gauges + histograms + device-fenced wall-clock timers
@@ -138,13 +199,17 @@ class MetricsRecorder:
     raise."""
 
     def __init__(self, sink: Optional[JsonlSink] = None,
-                 max_events: int = 256):
+                 max_events: Optional[int] = None):
         self.counters: Dict[str, int] = collections.defaultdict(int)
         self.gauges: Dict[str, float] = {}
         self.timers: Dict[str, float] = collections.defaultdict(float)
         self.histograms: Dict[str, Histogram] = {}
+        # bounded ring: the tail a flight-recorder dump wants, not the
+        # full record (that is the JSONL sink's job) — a long
+        # --transport --chaos soak must not grow backend memory with
+        # every event. Cap via PYCHEMKIN_TELEMETRY_EVENTS_CAP.
         self._events: collections.deque = collections.deque(
-            maxlen=max_events)
+            maxlen=_events_cap() if max_events is None else max_events)
         self._lock = threading.Lock()
         # events get their own lock: emit() does sink disk I/O, and
         # holding the metrics lock across a write/flush would stall
@@ -219,6 +284,9 @@ class MetricsRecorder:
         return ev
 
     def last_event(self, kind: str) -> Optional[Dict[str, Any]]:
+        """Most recent event of ``kind`` still in the RECENT TAIL (the
+        bounded ring; None once it aged out — the JSONL sink is the
+        full record)."""
         with self._event_lock:
             for ev in reversed(self._events):
                 if ev["kind"] == kind:
@@ -226,14 +294,27 @@ class MetricsRecorder:
         return None
 
     def events(self, kind: Optional[str] = None):
+        """The RECENT TAIL of events (bounded ring, cap
+        ``PYCHEMKIN_TELEMETRY_EVENTS_CAP``), oldest first — NOT the
+        full history; read the JSONL sink for that."""
         with self._event_lock:
             return [ev for ev in self._events
                     if kind is None or ev["kind"] == kind]
 
     # -- aggregate views -------------------------------------------------
-    def snapshot(self) -> Dict[str, Any]:
+    def histogram_states(self) -> Dict[str, Dict[str, Any]]:
+        """Raw (mergeable) histogram states — what the fleet ``metrics``
+        op ships so ``chemtop`` can merge distributions exactly across
+        backends (see :meth:`Histogram.state`)."""
+        with self._lock:
+            return {k: h.state() for k, h in self.histograms.items()}
+
+    def snapshot(self, write: bool = True) -> Dict[str, Any]:
         """Aggregate state as one JSON-ready dict; also rewritten
-        atomically to the sink's snapshot file when a sink is attached."""
+        atomically to the sink's snapshot file when a sink is attached.
+        ``write=False`` skips that disk write (and its event-lock
+        hold): the read-only form for periodic scrapers — a metrics
+        poll must not stall hot-path event emission behind file I/O."""
         with self._lock:
             snap = {
                 "t": timestamp(),
@@ -244,7 +325,7 @@ class MetricsRecorder:
                 "histograms": {k: h.summary()
                                for k, h in self.histograms.items()},
             }
-        if self._sink is not None:
+        if write and self._sink is not None:
             # under the sink-I/O lock: concurrent snapshots must not
             # interleave their last-writer-wins renames out of order
             with self._event_lock:
@@ -291,6 +372,57 @@ def configure(path: Optional[str] = None,
 
 def record_event(kind: str, **fields: Any) -> Dict[str, Any]:
     return _default.event(kind, **fields)
+
+
+#: flight-recorder dump destinations: an exact file path, or a
+#: directory (file named flight_<pid>.json — respawned backend
+#: generations are different pids, so each death keeps its own dump)
+FLIGHT_PATH_ENV = "PYCHEMKIN_FLIGHT_PATH"
+FLIGHT_DIR_ENV = "PYCHEMKIN_FLIGHT_DIR"
+
+
+def flight_recorder_path() -> Optional[str]:
+    """Where a flight dump would land, or None when disabled (neither
+    env var set and no explicit path given)."""
+    path = os.environ.get(FLIGHT_PATH_ENV)
+    if path:
+        return path
+    d = os.environ.get(FLIGHT_DIR_ENV)
+    if d:
+        return os.path.join(d, f"flight_{os.getpid()}.json")
+    return None
+
+
+def flight_recorder_dump(reason: str, recorder: Optional[MetricsRecorder]
+                         = None, path: Optional[str] = None,
+                         **fields: Any) -> Optional[str]:
+    """Dump the recorder's recent-event ring + aggregate counters as a
+    post-mortem artifact (atomic rewrite; crash-safe by construction).
+
+    This is the catchable-death half of the crash flight recorder: a
+    backend wires it to SIGTERM/atexit so a drain, a poison-triggered
+    exit, or any orderly death leaves its last ``EVENTS_CAP`` events on
+    disk. SIGKILL-class deaths cannot run this — for those the
+    SUPERVISOR writes a kill report from the outside (see
+    :meth:`pychemkin_tpu.serve.supervisor.Supervisor`). Returns the
+    path written, or None when no destination is configured."""
+    rec = recorder if recorder is not None else _default
+    path = path or flight_recorder_path()
+    if path is None:
+        return None
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with rec._lock:
+        aggregates = {
+            "counters": dict(rec.counters),
+            "gauges": dict(rec.gauges),
+            "histograms": {k: h.summary()
+                           for k, h in rec.histograms.items()},
+        }
+    atomic_write_json(path, {
+        "t": timestamp(), "reason": reason, "pid": os.getpid(),
+        **fields, **aggregates, "events": rec.events()})
+    return path
 
 
 def device_increment(name: str, value) -> None:

@@ -1,0 +1,194 @@
+"""Lightweight distributed request tracing over the JSONL event spine.
+
+The serving stack spans four processes per request (client → TCP
+transport → supervised backend → rescue thread, plus supervisor
+respawn/re-submission); when a request is slow, deadline-expired, or
+resolved ``BACKEND_LOST``, the per-process counters cannot say *where*
+the time or the loss went. This module adds the missing primitive: a
+**span** — one named, timed hop of one request — emitted as a
+``trace.span`` event through the existing crash-safe sink, so the full
+story of a request is reconstructable by grepping its trace id across
+the client / backend / supervisor JSONL files (and survives a SIGKILL
+mid-request, because every span already written is its own line).
+
+Design constraints, in order:
+
+- **Cheap when off.** Sampling is decided ONCE per request at submit
+  (``new_trace_id`` returns ``None`` for unsampled requests); every
+  instrumentation site takes the ``trace_id is None`` early-out, so an
+  unsampled request pays one ``if`` per hop — no dict builds, no JSON.
+- **No clock coupling.** Spans carry a duration; the event's own
+  wall-clock stamp ``t`` is the span's END, so ``start = t - dur_ms/1e3``
+  without requiring processes to share a monotonic clock.
+- **Schema = event schema.** A span is a plain recorder event
+  (``{"t", "kind": "trace.span", "trace", "span", "dur_ms", ...}``), so
+  the sink's torn-tail tolerance, the recorder's in-memory tail, and
+  ``read_jsonl`` all apply unchanged.
+
+Span names emitted by the framework (all carry ``trace``/``dur_ms``):
+
+=========================  =============================================
+``client.wire``            one wire round-trip as the TransportClient
+                           saw it (submit frame → result/error reply)
+``serve.admission``        submit → the batcher adopted the request
+``serve.batch_window``     adoption → the micro-batch group dispatched
+``serve.dispatch``         the padded program ran (fields: req_kind /
+                           bucket / occupancy / compile_hit / lane /
+                           status)
+``serve.expired``          the request was dropped at the deadline gate
+``serve.rescue_rung``      one rescue-ladder rung re-solve (fields:
+                           level / status)
+``rescue.rung``            one batch-sweep rescue rung
+                           (:func:`~pychemkin_tpu.resilience.rescue
+                           .run_rescue` with a ``trace_id``)
+``supervisor.resubmit``    the supervisor re-sent an in-flight request
+                           to a respawned backend (fields: generation /
+                           attempt) — the child span that makes a
+                           healed request show its dead generation
+``supervisor.backend_lost``  the request resolved ``BACKEND_LOST``
+                           (fields: generation)
+=========================  =============================================
+
+Sampling knob: ``PYCHEMKIN_TRACE_SAMPLE`` ∈ [0, 1] — the probability a
+submit draws a trace id. Default 1.0 (every request traced): tests and
+chaos soaks want the full story, and the serve bench's
+``trace_overhead_pct`` bounds the cost. Production fleets at high rates
+should export e.g. ``PYCHEMKIN_TRACE_SAMPLE=0.01``; the env var is read
+per draw, so a live process can be re-sampled via its environment
+without restart.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional
+
+from .sink import read_jsonl
+
+#: sampling probability env knob (see module docstring)
+TRACE_SAMPLE_ENV = "PYCHEMKIN_TRACE_SAMPLE"
+
+#: the event kind every span is emitted as
+SPAN_KIND = "trace.span"
+
+#: sentinel default for ``trace_id=`` kwargs: "the caller expressed no
+#: decision — draw one here". Distinct from an EXPLICIT ``None``
+#: ("upstream sampled this request out"), which must propagate through
+#: every hop without being re-drawn — otherwise a fleet at
+#: ``PYCHEMKIN_TRACE_SAMPLE=0.5`` would re-roll the dice per hop and
+#: emit orphan backend-only trace fragments no client record names.
+UNSET = object()
+
+
+def resolve_trace_id(trace_id) -> Optional[str]:
+    """The one place the draw-vs-propagate rule lives: a caller that
+    passed nothing (``UNSET``) gets a fresh sampling draw; an explicit
+    id — including an explicit unsampled ``None`` — passes through."""
+    return new_trace_id() if trace_id is UNSET else trace_id
+
+
+def sample_rate() -> float:
+    """The configured sampling probability, clamped to [0, 1]
+    (unparseable values fall back to the default 1.0)."""
+    raw = os.environ.get(TRACE_SAMPLE_ENV)
+    if not raw:
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        return 1.0
+    return min(max(rate, 0.0), 1.0)
+
+
+def new_trace_id() -> Optional[str]:
+    """Draw one request's trace id, or ``None`` when the sampling rate
+    says skip — the single decision every downstream span site keys on
+    (``None`` propagates through the wire and disables every hop's
+    emission with one ``if``)."""
+    rate = sample_rate()
+    if rate <= 0.0:
+        return None
+    if rate < 1.0 and random.random() >= rate:
+        return None
+    return uuid.uuid4().hex[:16]
+
+
+def emit_span(recorder, trace_id: Optional[str], span_name: str,
+              dur_ms: float, parent: Optional[str] = None,
+              **fields: Any) -> Optional[Dict[str, Any]]:
+    """Emit one span event on ``recorder`` (no-op for an unsampled —
+    ``None`` — trace id). The event's ``t`` stamp is the span END."""
+    if trace_id is None:
+        return None
+    if parent is not None:
+        fields["parent"] = parent
+    return recorder.event(SPAN_KIND, trace=trace_id, span=span_name,
+                          dur_ms=round(float(dur_ms), 3), **fields)
+
+
+@contextlib.contextmanager
+def span(recorder, trace_id: Optional[str], span_name: str,
+         parent: Optional[str] = None, **fields: Any):
+    """Time a block as one span (no-op when ``trace_id`` is None)."""
+    if trace_id is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        emit_span(recorder, trace_id, span_name,
+                  (time.perf_counter() - t0) * 1e3, parent, **fields)
+
+
+# ---------------------------------------------------------------------------
+# reconstruction (offline: tests, chemtop, loadgen exemplars, humans)
+
+def spans_from_events(events: Iterable[Dict[str, Any]],
+                      trace_id: Optional[str] = None
+                      ) -> Dict[str, List[Dict[str, Any]]]:
+    """Group ``trace.span`` events by trace id (optionally only
+    ``trace_id``), each list sorted by span START (``t - dur_ms/1e3``)
+    so the request's story reads top to bottom."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in events:
+        if ev.get("kind") != SPAN_KIND:
+            continue
+        tid = ev.get("trace")
+        if tid is None or (trace_id is not None and tid != trace_id):
+            continue
+        out.setdefault(tid, []).append(ev)
+    for spans_ in out.values():
+        spans_.sort(key=lambda ev: (float(ev.get("t", 0.0))
+                                    - float(ev.get("dur_ms", 0.0)) / 1e3))
+    return out
+
+
+def load_trace(paths, trace_id: str) -> List[Dict[str, Any]]:
+    """One request's spans, gathered across JSONL sink files (client /
+    backend / supervisor), start-sorted. Missing files are skipped —
+    a single-process setup has fewer sinks, not an error."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    events: List[Dict[str, Any]] = []
+    for p in paths:
+        try:
+            events.extend(read_jsonl(os.fspath(p)))
+        except FileNotFoundError:
+            continue
+    return spans_from_events(events, trace_id).get(trace_id, [])
+
+
+def breakdown(spans: Iterable[Dict[str, Any]]) -> Dict[str, float]:
+    """Per-stage time attribution: span name -> total ``dur_ms``
+    (a span name appearing twice — e.g. two rescue rungs — sums)."""
+    out: Dict[str, float] = {}
+    for ev in spans:
+        name = ev.get("span", "?")
+        out[name] = round(out.get(name, 0.0)
+                          + float(ev.get("dur_ms", 0.0)), 3)
+    return out

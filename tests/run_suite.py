@@ -54,12 +54,30 @@ Behaviour:
   CI on CPU this way; the file's deterministic tests scrub the env var
   themselves (autouse fixture), so the canned spec cannot leak into
   them;
+- under ``--chaos`` the children also get ``PYCHEMKIN_KILL_REPORT_DIR``
+  (a fresh temp dir unless the caller exported one), and after the run
+  the suite ASSERTS at least one ``kill_report*.json`` artifact exists
+  — the canned kill spec must leave a readable post-mortem, so the
+  crash flight recorder is CI-enforced, not just unit-tested; a chaos
+  run that banked no report fails with rc 1;
 - exit code is 0 iff every file's pytest exited 0 or 5 (with at least
   one 0);
 - a per-file line and a final summary are printed; the summary ends
   with every file's wall time sorted slowest-first, so the suite's
   budget under the tier-1 wall-clock cap stays visible as files are
-  added.
+  added;
+- child stdout is PUMPED through this process unbuffered (not
+  captured): the tier-1 gate greps the combined log for pytest dot
+  lines, so streaming fidelity is load-bearing — and the same bytes
+  are counted per file (``dots``: '.' characters on dot-progress
+  lines, the gate's own regex);
+- ``--summary-json PATH`` banks a machine-readable suite summary
+  (per-file rc / wall time / dots / retried, plus totals and — under
+  --chaos — the kill-report paths) via the telemetry layer's
+  ``atomic_write_json``, so the tier-1 DOTS_PASSED trend is diffable
+  across PRs instead of scraped from logs. The sink module is loaded
+  STANDALONE (importlib) because this orchestrator must never import
+  the package (``pychemkin_tpu/__init__`` imports jax).
 
 ``pytest tests/`` (the driver's command) is re-exec'ed into this runner
 by the multi-file branch of ``pytest_configure`` in ``tests/conftest.py``,
@@ -71,11 +89,42 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 
 FILE_TIMEOUT = int(os.environ.get("RUN_SUITE_FILE_TIMEOUT", "2400"))
+
+#: the tier-1 gate's own dot-line shape: a pytest progress line is
+#: pass/fail/error/skip/xfail marks, optionally a percent tag
+_DOT_LINE = re.compile(rb"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+
+
+def _count_dots(out: bytes) -> int:
+    """Passed-test count in a pytest -q log: '.' characters on
+    dot-progress lines (identical to the tier-1 DOTS_PASSED grep)."""
+    return sum(line.count(b".") for line in out.splitlines()
+               if _DOT_LINE.match(line.strip()))
+
+
+def _sink_module():
+    """``pychemkin_tpu.telemetry.sink`` loaded STANDALONE: the package
+    ``__init__`` imports jax, which this orchestrator must never do
+    (it must keep working while the accelerator client is wedged, and
+    must not burn suite wall budget importing it)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pychemkin_tpu", "telemetry", "sink.py")
+    spec = importlib.util.spec_from_file_location("_run_suite_sink",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 #: the --faults default injection spec: element 1 gets a NaN RHS that
 #: heals at rescue rung 1 — exercised by the env-gated tests of
@@ -135,6 +184,44 @@ def _split_args(argv):
     return selected, selectors, flags
 
 
+def _run_child(targets, flags, env):
+    """One child pytest: stdout pumped through unbuffered (the tier-1
+    dot grep reads the combined log live) AND counted for the
+    machine-readable summary. Returns (rc, dots)."""
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pytest"] + targets + flags,
+            env=env, stdout=subprocess.PIPE)
+    except OSError as exc:
+        print(f"# run_suite: spawn failed: {exc}", flush=True)
+        return 2, 0
+    buf = bytearray()
+
+    def _pump():
+        out = sys.stdout.buffer
+        while True:
+            chunk = proc.stdout.read(4096)
+            if not chunk:
+                return
+            buf.extend(chunk)
+            try:
+                out.write(chunk)
+                out.flush()
+            except (ValueError, OSError):
+                pass             # our stdout is gone; keep counting
+
+    pump = threading.Thread(target=_pump, daemon=True)
+    pump.start()
+    try:
+        rc = proc.wait(timeout=FILE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        rc = 124
+    pump.join(timeout=10.0)
+    return rc, _count_dots(bytes(buf))
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     stop_on_fail = any(a in ("-x", "--exitfirst") for a in argv)
@@ -142,6 +229,15 @@ def main(argv=None):
     chaos = "--chaos" in argv
     if faults or chaos:
         argv = [a for a in argv if a not in ("--faults", "--chaos")]
+    summary_json = None
+    if "--summary-json" in argv:
+        i = argv.index("--summary-json")
+        if i + 1 >= len(argv):
+            print("run_suite: --summary-json needs a path",
+                  file=sys.stderr)
+            return 2
+        summary_json = argv[i + 1]
+        del argv[i:i + 2]
 
     here = os.path.dirname(os.path.abspath(__file__))
     selected, selectors, flags = _split_args(argv)
@@ -166,17 +262,23 @@ def main(argv=None):
         return 2
 
     env = _child_env(faults=faults, chaos=chaos)
+    kill_dir = None
+    preexisting_reports = set()
+    if chaos:
+        # chaos children's supervisors bank kill reports here; the
+        # suite asserts at least one landed (the flight recorder is
+        # CI-enforced, not just unit-tested)
+        kill_dir = os.environ.get("PYCHEMKIN_KILL_REPORT_DIR")
+        if not kill_dir:
+            kill_dir = tempfile.mkdtemp(prefix="pychemkin_kill_")
+        env["PYCHEMKIN_KILL_REPORT_DIR"] = kill_dir
+        # only reports banked by THIS run count: a caller-provided dir
+        # may hold a previous run's artifacts, and a stale file must
+        # not green-light a broken flight recorder
+        preexisting_reports = set(glob.glob(
+            os.path.join(kill_dir, "kill_report*.json")))
     results = []
     t_suite = time.time()
-
-    def _run_child(targets):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-m", "pytest"] + targets + flags,
-                env=env, timeout=FILE_TIMEOUT)
-            return r.returncode
-        except subprocess.TimeoutExpired:
-            return 124
 
     for f in files:
         name = os.path.basename(f)
@@ -184,7 +286,7 @@ def main(argv=None):
         # node-id selectors only narrow files not otherwise selected
         targets = [f] if f in selected else selectors.get(f, [f])
         t0 = time.time()
-        rc = _run_child(targets)
+        rc, dots = _run_child(targets, flags, env)
         retried = False
         if rc < 0:
             # child died on a signal (OOM kill, sporadic XLA:CPU
@@ -193,13 +295,13 @@ def main(argv=None):
             # and is never retried, so real failures stay failures
             print(f"# run_suite: {name}: killed by signal {-rc}; "
                   "retrying once", flush=True)
-            rc = _run_child(targets)
+            rc, dots = _run_child(targets, flags, env)
             retried = True
         dt = time.time() - t0
         # rc=5 = "no tests collected" in this child's session (e.g. a
         # -k pattern deselecting the whole file): skipped, not failed
         ok = rc in (0, 5)
-        results.append((name, rc, dt, retried))
+        results.append((name, rc, dt, retried, dots))
         print(f"# run_suite: {name}: "
               f"{'no tests' if rc == 5 else 'ok' if ok else f'FAIL rc={rc}'}"
               f"{' (timeout)' if rc == 124 else ''}"
@@ -209,9 +311,9 @@ def main(argv=None):
         if not ok and stop_on_fail:
             break
 
-    n_fail = sum(1 for _, rc, _, _ in results if rc not in (0, 5))
-    n_empty = sum(1 for _, rc, _, _ in results if rc == 5)
-    n_retried = sum(1 for _, _, _, retried in results if retried)
+    n_fail = sum(1 for _, rc, _, _, _ in results if rc not in (0, 5))
+    n_empty = sum(1 for _, rc, _, _, _ in results if rc == 5)
+    n_retried = sum(1 for _, _, _, retried, _ in results if retried)
     total = time.time() - t_suite
     print(f"# run_suite: {len(results)} files, {n_fail} failed, "
           f"{n_empty} empty, {n_retried} retried, {total:.0f}s total",
@@ -221,17 +323,62 @@ def main(argv=None):
     # visible right where a new file's cost would show up
     print("# run_suite: per-file wall time (slowest first):",
           flush=True)
-    for name, _, dt, _ in sorted(results, key=lambda r: -r[2]):
+    for name, _, dt, _, _ in sorted(results, key=lambda r: -r[2]):
         print(f"# run_suite:   {dt:7.1f}s  {name}", flush=True)
     if n_fail:
-        for name, rc, _, _ in results:
+        for name, rc, _, _, _ in results:
             if rc not in (0, 5):
                 print(f"# run_suite:   FAILED {name} rc={rc}", flush=True)
-        return 1
-    if n_empty == len(results):
+        suite_rc = 1
+    elif n_empty == len(results):
         # nothing collected anywhere: surface pytest's own signal
-        return 5
-    return 0
+        suite_rc = 5
+    else:
+        suite_rc = 0
+
+    kill_reports = None
+    if chaos:
+        kill_reports = sorted(
+            p for p in glob.glob(
+                os.path.join(kill_dir, "kill_report*.json"))
+            if p not in preexisting_reports)
+        print(f"# run_suite: chaos kill reports: {len(kill_reports)} "
+              f"new in {kill_dir}", flush=True)
+        if not kill_reports:
+            # the canned kill spec fired but no post-mortem landed:
+            # the crash flight recorder is broken — that IS a failure
+            print("# run_suite: CHAOS FAILURE: no kill-report "
+                  "artifact was banked", flush=True)
+            if suite_rc in (0, 5):
+                suite_rc = 1
+
+    if summary_json:
+        summary = {
+            "t": time.time(),
+            "argv": argv,
+            "rc": suite_rc,
+            "total_s": round(total, 3),
+            "n_files": len(results),
+            "n_failed": n_fail,
+            "n_empty": n_empty,
+            "n_retried": n_retried,
+            "dots_passed": sum(d for *_x, d in results),
+            "files": [{"file": name, "rc": rc,
+                       "wall_s": round(dt, 3), "dots": dots,
+                       "retried": retried, "ok": rc in (0, 5)}
+                      for name, rc, dt, retried, dots in results],
+        }
+        if kill_reports is not None:
+            summary["kill_reports"] = kill_reports
+        try:
+            _sink_module().atomic_write_json(summary_json, summary)
+            print(f"# run_suite: summary banked to {summary_json}",
+                  flush=True)
+        except OSError as exc:
+            # a bad path degrades the artifact, never the verdict
+            print(f"# run_suite: summary bank FAILED: {exc}",
+                  flush=True)
+    return suite_rc
 
 
 if __name__ == "__main__":

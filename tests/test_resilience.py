@@ -431,20 +431,18 @@ class TestRunSuiteFaultsFlag:
 
         recorded = {}
 
-        def fake_run(cmd, env=None, timeout=None):
-            recorded["files"] = [a for a in cmd if a.endswith(".py")]
+        def fake_run_child(targets, flags, env):
+            recorded["files"] = [a for a in targets
+                                 if a.endswith(".py")]
             recorded["env"] = env
+            return 0, 1
 
-            class R:
-                returncode = 0
-            return R()
-
-        orig = rs.subprocess.run
-        rs.subprocess.run = fake_run
+        orig = rs._run_child
+        rs._run_child = fake_run_child
         try:
             rc = rs.main(["--faults"])
         finally:
-            rs.subprocess.run = orig
+            rs._run_child = orig
         assert rc == 0
         assert len(recorded["files"]) == 1
         assert recorded["files"][0].endswith("test_resilience.py")

@@ -63,6 +63,57 @@ def test_summary_lists_per_file_wall_time_slowest_first(tmp_path):
     assert slow_s >= 1.5
 
 
+def test_summary_json_banks_machine_readable_trend(tmp_path):
+    """ISSUE 8 satellite: --summary-json banks per-file rc / wall
+    time / DOTS / retried plus totals, so the tier-1 DOTS_PASSED trend
+    is diffable across PRs instead of scraped from logs."""
+    import json
+
+    f_two = tmp_path / "test_two_dots.py"
+    f_two.write_text("def test_a():\n    assert True\n"
+                     "def test_b():\n    assert True\n")
+    f_fail = tmp_path / "test_one_fail.py"
+    f_fail.write_text("def test_ok():\n    assert True\n"
+                      "def test_bad():\n    assert False\n")
+    out = str(tmp_path / "SUITE.json")
+    r = _run([str(f_two), str(f_fail), "--summary-json", out, "-q"])
+    assert r.returncode == 1
+    assert f"summary banked to {out}" in r.stdout
+    with open(out) as f:
+        summary = json.load(f)
+    assert summary["rc"] == 1
+    assert summary["n_files"] == 2
+    assert summary["n_failed"] == 1
+    by_file = {e["file"]: e for e in summary["files"]}
+    assert by_file["test_two_dots.py"]["rc"] == 0
+    assert by_file["test_two_dots.py"]["dots"] == 2
+    assert by_file["test_two_dots.py"]["ok"] is True
+    assert by_file["test_one_fail.py"]["rc"] == 1
+    assert by_file["test_one_fail.py"]["dots"] == 1   # the passing one
+    assert by_file["test_one_fail.py"]["ok"] is False
+    assert summary["dots_passed"] == 3
+    # the dot lines STILL stream through the combined log: the tier-1
+    # gate's grep keeps working unchanged
+    import re as _re
+    dot_lines = [ln for ln in r.stdout.splitlines()
+                 if _re.fullmatch(r"[.FEsx]+( *\[ *[0-9]+%\])?",
+                                  ln.strip())]
+    assert sum(ln.count(".") for ln in dot_lines) == 3
+
+
+def test_summary_json_path_not_passed_to_children(tmp_path):
+    """--summary-json PATH must be stripped from the child pytest
+    argv (a nonexistent path would otherwise become a pytest arg)."""
+    f_ok = tmp_path / "test_plain.py"
+    f_ok.write_text("def test_a():\n    assert True\n")
+    out = str(tmp_path / "nested" / "missing_dir" / "S.json")
+    r = _run([str(f_ok), "--summary-json", out])
+    # the suite itself passes; the bank into a missing dir degrades
+    # with a message, never the verdict
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "summary bank FAILED" in r.stdout
+
+
 def test_all_files_empty_returns_5(tmp_path):
     f_match, f_nomatch = _dummy_files(tmp_path)
     r = _run([f_match, f_nomatch, "-k", "zz_matches_nothing"])

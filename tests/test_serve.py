@@ -426,6 +426,101 @@ class TestDrain:
 
 
 # ---------------------------------------------------------------------------
+# request tracing (ISSUE 8)
+
+class TestTracing:
+    def test_request_life_emitted_as_spans(self, mech, Y_h2air):
+        """One served request leaves its whole hot-path story as
+        spans under ITS trace id: admission wait, batch window, and
+        the bucket dispatch with kind/bucket/occupancy/compile-hit."""
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, bucket_sizes=(1, 4),
+                                  max_delay_ms=5.0, recorder=rec)
+        server.warmup(["equilibrium"])
+        with server:
+            fut = server.submit("equilibrium", trace_id="tfixed01",
+                                **_eq_payload(Y_h2air))
+            res = fut.result(timeout=120)
+        assert res.ok
+        spans = {ev["span"]: ev for ev in rec.events("trace.span")
+                 if ev["trace"] == "tfixed01"}
+        assert set(spans) == {"serve.admission", "serve.batch_window",
+                              "serve.dispatch"}
+        disp = spans["serve.dispatch"]
+        assert disp["req_kind"] == "equilibrium"
+        assert disp["bucket"] == res.bucket
+        assert disp["occupancy"] == res.occupancy
+        assert disp["compile_hit"] is True       # warmed ladder
+        assert disp["status"] == "OK"
+        assert disp["dur_ms"] == pytest.approx(res.solve_ms, abs=0.01)
+        # admission + window ≈ the result's queue wait
+        wait = (spans["serve.admission"]["dur_ms"]
+                + spans["serve.batch_window"]["dur_ms"])
+        assert wait == pytest.approx(res.queue_wait_ms, abs=1.0)
+
+    def test_submit_draws_id_and_sampling_off_disables(
+            self, mech, Y_h2air, monkeypatch):
+        from pychemkin_tpu.telemetry import trace
+
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, bucket_sizes=(1, 4),
+                                  max_delay_ms=5.0, recorder=rec)
+        server.warmup(["equilibrium"])
+        with server:
+            # default sampling (1.0): a bare submit draws its own id
+            fut = server.submit("equilibrium", **_eq_payload(Y_h2air))
+            assert fut.result(timeout=120).ok
+            n_spans = len(rec.events("trace.span"))
+            assert n_spans >= 3
+            # sampled out: the whole request life emits NOTHING
+            monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0")
+            fut = server.submit("equilibrium",
+                                **_eq_payload(Y_h2air, 1350.0))
+            assert fut.result(timeout=120).ok
+            assert len(rec.events("trace.span")) == n_spans
+            # an EXPLICIT None (upstream sampled the request out) is
+            # honored even at sampling 1.0 — never re-drawn per hop
+            monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "1.0")
+            fut = server.submit("equilibrium", trace_id=None,
+                                **_eq_payload(Y_h2air, 1400.0))
+            assert fut.result(timeout=120).ok
+            assert len(rec.events("trace.span")) == n_spans
+
+    def test_rescue_rungs_emit_spans(self, mech):
+        """Each rescue-ladder rung is one span under the request's
+        trace id (fake engine: no solves, pure plumbing)."""
+        from pychemkin_tpu.serve.futures import ServeFuture
+
+        class _FakeEng:
+            max_rescue_rungs = 3
+
+            def rescue_one(self, payload, key, level, elem_id):
+                out = {"v": np.array([float(level)]),
+                       "status": np.array([2 if level < 2 else 0])}
+                return out, int(out["status"][0])
+
+            def value_at(self, out, i):
+                return {"v": float(out["v"][i])}
+
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, recorder=rec)
+        server._engines["fake"] = _FakeEng()
+        req = Request(kind="fake", key=(), payload={},
+                      future=ServeFuture(),
+                      t_submit=time.perf_counter(), trace_id="tr9")
+        server._rescue_one((req, (), {"v": 0.0}, 2, 0,
+                            dict(kind="fake", bucket=1, occupancy=1,
+                                 queue_wait_ms=0.0, solve_ms=0.0)))
+        res = req.future.result(timeout=5)
+        assert res.rescued and res.rescue_rungs == 2
+        rungs = [ev for ev in rec.events("trace.span")
+                 if ev["span"] == "serve.rescue_rung"]
+        assert [r["level"] for r in rungs] == [1, 2]
+        assert [r["status"] for r in rungs] == ["NEWTON_STALL", "OK"]
+        assert all(r["trace"] == "tr9" for r in rungs)
+
+
+# ---------------------------------------------------------------------------
 # load generator (shared core + CLI tool)
 
 class TestLoadgen:
@@ -509,6 +604,57 @@ class TestLoadgen:
             assert key in summary, key
         assert "NaN" not in json.dumps(summary)
 
+    def test_trace_exemplars_stuck_first_then_slowest(self):
+        """ISSUE 8 satellite: the summary names the stuck requests'
+        trace ids first, then the slowest resolved ones, each with its
+        span breakdown — a bad soak run points at the guilty stage."""
+        import json
+
+        from pychemkin_tpu.serve.futures import ServeFuture, make_result
+
+        class _Slowish:
+            def __init__(self):
+                self.tids = []
+                self.n = 0
+
+            def submit(self, kind, trace_id=None, **payload):
+                self.tids.append(trace_id)
+                self.n += 1
+                fut = ServeFuture()
+                if self.n == 2:        # request 2 never resolves
+                    return fut
+                fut.set_result(make_result(
+                    {"T": 1.0}, 0, kind=kind, bucket=1, occupancy=1,
+                    queue_wait_ms=0.1, solve_ms=float(self.n)))
+                return fut
+
+        srv = _Slowish()
+
+        def trace_events():
+            return [{"t": 1.0, "kind": "trace.span", "trace": t,
+                     "span": "serve.dispatch", "dur_ms": 2.5}
+                    for t in srv.tids if t]
+
+        summary = loadgen.run_load(
+            srv, [lambda i, rng: ("equilibrium", {})],
+            rate_hz=1000.0, n_requests=4,
+            rng=np.random.default_rng(0), result_timeout_s=0.05,
+            trace_events=trace_events, n_exemplars=3)
+        ex = summary["trace_exemplars"]
+        assert len(ex) == 3
+        # the stuck request leads (its trace shows the last stage that
+        # RAN), then resolved requests slowest-first
+        assert ex[0]["status"] == "TIMEOUT"
+        assert ex[0]["latency_ms"] is None
+        assert ex[1]["latency_ms"] >= ex[2]["latency_ms"]
+        # every submit drew a trace id (default sampling) and the
+        # breakdown was assembled from the span source
+        assert all(e["trace"] for e in ex)
+        assert set(srv.tids) >= {e["trace"] for e in ex}
+        assert ex[0]["breakdown"] == {"serve.dispatch": 2.5}
+        assert ex[0]["spans"][0]["span"] == "serve.dispatch"
+        assert "NaN" not in json.dumps(summary)
+
     def test_tool_banks_atomic_artifact(self, tmp_path):
         import json
 
@@ -528,6 +674,18 @@ class TestLoadgen:
         snap = art["telemetry"]
         assert snap["histograms"]["serve.queue_wait_ms"]["count"] > 0
         assert snap["counters"]["serve.batches"] >= 1
+        # ISSUE 8: the obs dir holds the crash-safe client sink the
+        # trace exemplars were assembled from
+        assert art["obs_dir"] == str(tmp_path / "LOADGEN_obs")
+        client_jsonl = os.path.join(art["obs_dir"], "client.jsonl")
+        assert os.path.exists(client_jsonl)
+        assert art["trace_exemplars"], "no trace exemplars banked"
+        best = art["trace_exemplars"][0]
+        assert best["trace"] and best["breakdown"]
+        from pychemkin_tpu.telemetry import trace as trace_mod
+        spans = trace_mod.load_trace(client_jsonl, best["trace"])
+        assert {s["span"] for s in spans} >= {
+            "serve.admission", "serve.batch_window", "serve.dispatch"}
 
     @pytest.mark.slow
     def test_soak_mixed_kinds(self, mech):

@@ -314,6 +314,179 @@ class TestTransportServer:
             cli.close()
             ts.close()
 
+    def test_metrics_op_fleet_snapshot(self, mech, Y_h2air):
+        """ISSUE 8: the ``metrics`` op exposes counters, mergeable
+        histogram states, per-tenant quota occupancy, uptime and the
+        backend generation — and a chemtop merge of the reply is
+        self-consistent."""
+        from tools import chemtop
+
+        rec = telemetry.MetricsRecorder()
+        ts, _ = self._server(mech, rec, {"default": {"mech": "h2o2",
+                                                     "quota": 7}})
+        cli = TransportClient("127.0.0.1", ts.port,
+                              recorder=telemetry.MetricsRecorder())
+        try:
+            assert cli.submit("equilibrium",
+                              **_eq_payload(Y_h2air)).result(
+                                  timeout=120).ok
+            m = cli.metrics()
+            assert m["op"] == "metrics_reply"
+            assert m["counters"]["serve.requests"] == 1
+            assert m["tenants"]["default"] == {"inflight": 0,
+                                               "quota": 7}
+            assert m["generation"] == 0          # no re-exec stamp
+            assert m["uptime_s"] >= 0.0
+            assert isinstance(m["pid"], int)
+            # raw states merge back to exactly the local summaries
+            states = m["histogram_states"]
+            assert states["serve.solve_ms"]["count"] == 1
+            assert telemetry.merge_histogram_states(
+                [states["serve.solve_ms"]]) == \
+                m["histograms"]["serve.solve_ms"]
+            # the chemtop merge of one backend is that backend
+            fleet = chemtop.merge_fleet([{**m, "port": ts.port}])
+            assert fleet["n_alive"] == 1
+            assert fleet["counters"]["serve.requests"] == 1
+            assert fleet["histograms"]["serve.solve_ms"] == \
+                m["histograms"]["serve.solve_ms"]
+            assert fleet["tenants"]["default"]["quota"] == 7
+        finally:
+            cli.close()
+            ts.close()
+
+    def test_chemtop_once_scrapes_live_backend(self, mech, Y_h2air,
+                                               tmp_path):
+        """chemtop one-shot mode against a live backend banks a fleet
+        snapshot whose counters match the server's recorder."""
+        from tools import chemtop
+
+        rec = telemetry.MetricsRecorder()
+        ts, _ = self._server(mech, rec, {"default": {"mech": "h2o2"}})
+        out = str(tmp_path / "FLEET.json")
+        try:
+            futs = [TransportClient("127.0.0.1", ts.port,
+                                    recorder=telemetry
+                                    .MetricsRecorder())
+                    for _ in range(1)]
+            try:
+                assert futs[0].submit(
+                    "equilibrium", **_eq_payload(Y_h2air)).result(
+                        timeout=120).ok
+            finally:
+                for c in futs:
+                    c.close()
+            rc = chemtop.main(["--ports", str(ts.port), "--once",
+                               "--out", out])
+            assert rc == 0
+        finally:
+            ts.close()
+        with open(out) as f:
+            fleet = json.load(f)
+        assert fleet["n_alive"] == 1
+        assert fleet["backends"][0]["port"] == ts.port
+        assert fleet["counters"]["serve.requests"] == \
+            rec.counters["serve.requests"]
+        assert fleet["counters"]["serve.batches"] == \
+            rec.counters["serve.batches"]
+
+    def test_trace_id_crosses_the_wire(self, mech, Y_h2air):
+        """ISSUE 8: the client's trace id reaches the backend's
+        serve-layer spans, and the client adds its own wire span —
+        one id joins both processes' stories."""
+        rec = telemetry.MetricsRecorder()          # "backend" recorder
+        crec = telemetry.MetricsRecorder()         # client recorder
+        ts, _ = self._server(mech, rec, {"default": {"mech": "h2o2"}})
+        cli = TransportClient("127.0.0.1", ts.port, recorder=crec)
+        try:
+            res = cli.submit("equilibrium", trace_id="wire42aa",
+                             **_eq_payload(Y_h2air)).result(timeout=120)
+            assert res.ok
+        finally:
+            cli.close()
+            ts.close()
+        backend_spans = {ev["span"]
+                         for ev in rec.events("trace.span")
+                         if ev["trace"] == "wire42aa"}
+        assert backend_spans >= {"serve.admission",
+                                 "serve.batch_window",
+                                 "serve.dispatch"}
+        (wire,) = [ev for ev in crec.events("trace.span")
+                   if ev["trace"] == "wire42aa"]
+        assert wire["span"] == "client.wire"
+        assert wire["req_kind"] == "equilibrium"
+        assert wire["op"] == "result"
+        # the wire round-trip bounds every backend-side stage
+        disp = [ev for ev in rec.events("trace.span")
+                if ev["trace"] == "wire42aa"
+                and ev["span"] == "serve.dispatch"]
+        assert wire["dur_ms"] >= disp[0]["dur_ms"]
+
+
+class TestChemtopMerge:
+    """Pure merge logic (no sockets): counters sum, histogram states
+    merge exactly, dead backends stay visible but contribute nothing."""
+
+    def _reply(self, port, n_req, solve_ms_values, generation=0):
+        h = telemetry.Histogram()
+        for v in solve_ms_values:
+            h.observe(v)
+        return {"port": port, "pid": 1000 + port,
+                "generation": generation, "uptime_s": 12.0,
+                "counters": {"serve.requests": n_req},
+                "tenants": {"default": {"inflight": 1, "quota": 8}},
+                "histograms": {"serve.solve_ms": h.summary()},
+                "histogram_states": {"serve.solve_ms": h.state()}}
+
+    def test_merge_two_backends_and_one_dead(self):
+        from tools import chemtop
+
+        a = self._reply(1, 10, [1.0, 2.0])
+        b = self._reply(2, 5, [100.0], generation=3)
+        dead = {"port": 3, "error": "ConnectionRefusedError: x"}
+        fleet = chemtop.merge_fleet([a, b, dead])
+        assert fleet["n_backends"] == 3 and fleet["n_alive"] == 2
+        assert fleet["counters"]["serve.requests"] == 15
+        assert fleet["tenants"]["default"] == {"inflight": 2,
+                                               "quota": 16}
+        ref = telemetry.Histogram()
+        for v in (1.0, 2.0, 100.0):
+            ref.observe(v)
+        assert fleet["histograms"]["serve.solve_ms"] == ref.summary()
+        gens = {b["port"]: b["generation"]
+                for b in fleet["backends"] if not b["error"]}
+        assert gens == {1: 0, 2: 3}
+        # render never throws on a mixed fleet
+        assert "chemtop" in chemtop.render(fleet)
+
+    def test_supervisor_block_folds_into_counters(self):
+        from tools import chemtop
+
+        rep = self._reply(1, 4, [1.0])
+        rep["supervisor"] = {"respawns": 2, "resubmits": 3,
+                             "backend_lost_requests": 1}
+        fleet = chemtop.merge_fleet([rep])
+        assert fleet["counters"]["supervisor.respawns"] == 2
+        assert fleet["counters"]["supervisor.resubmits"] == 3
+        assert fleet["counters"][
+            "supervisor.backend_lost_requests"] == 1
+
+    def test_supervisor_block_survives_dead_backend_reply(self):
+        """Supervisor.metrics()'s degraded form ({'error', 'supervisor'})
+        must still contribute its respawn story: churn counters matter
+        most exactly when the backend cannot answer."""
+        from tools import chemtop
+
+        dead = {"port": 9, "error": "TimeoutError: no metrics reply",
+                "supervisor": {"respawns": 3, "resubmits": 5,
+                               "backend_lost_requests": 2}}
+        fleet = chemtop.merge_fleet([dead])
+        assert fleet["n_alive"] == 0
+        assert fleet["counters"]["supervisor.respawns"] == 3
+        assert fleet["counters"]["supervisor.resubmits"] == 5
+        assert fleet["counters"][
+            "supervisor.backend_lost_requests"] == 2
+
 
 # ---------------------------------------------------------------------------
 # the supervisor over a stdlib-only fake backend (no jax in children)
@@ -508,6 +681,88 @@ class TestSupervisorFake:
         assert ev is not None and "crashed" in ev["reason"]
         assert rec.counters["supervisor.respawns"] == 1
 
+    def test_kill_report_banked_on_crash(self, fake_backend_path,
+                                         tmp_path):
+        """ISSUE 8: a lost backend leaves a kill-report artifact —
+        classification, heartbeat age, in-flight requests WITH their
+        trace ids, respawn-budget state."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=1,
+            max_respawns=2, env={"FAKE_DIE_ON_SUBMIT_GEN": "0"},
+            kill_report_dir=str(tmp_path))
+        with sup:
+            fut = sup.submit("equilibrium", trace_id="killtr01", T=1.0)
+            res = fut.result(timeout=60)
+            assert res.ok                       # healed by respawn
+        reports = sorted(p for p in os.listdir(str(tmp_path))
+                         if p.startswith("kill_report"))
+        assert len(reports) == 1, reports
+        with open(tmp_path / reports[0]) as f:
+            report = json.load(f)
+        assert report["classification"] == "crash"
+        assert report["generation"] == 0
+        assert report["respawn_budget"] == {"respawns": 0,
+                                            "max_respawns": 2,
+                                            "remaining": 2}
+        assert report["last_heartbeat_age_s"] is not None
+        assert report["n_inflight"] == 1
+        (entry,) = report["inflight"]
+        assert entry["trace"] == "killtr01"
+        assert entry["kind"] == "equilibrium"
+        ev = rec.last_event("supervisor.kill_report")
+        assert ev is not None and ev["classification"] == "crash"
+        # the healed request's trace shows the dead generation: the
+        # re-submission span rides the ORIGINAL trace id
+        resub = [e for e in rec.events("trace.span")
+                 if e["trace"] == "killtr01"
+                 and e["span"] == "supervisor.resubmit"]
+        assert len(resub) == 1 and resub[0]["generation"] == 1
+
+    def test_kill_report_hang_classification(self, fake_backend_path,
+                                             tmp_path):
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=1,
+            max_respawns=2, env={"FAKE_HANG_PING": "1"},
+            kill_report_dir=str(tmp_path))
+        with sup:
+            # wait for the respawn to COMPLETE (alive again), not just
+            # the counter: closing mid-spawn exercises a different path
+            _wait(lambda: (sup.stats()["respawns"] >= 1
+                           and sup.stats()["alive"]),
+                  what="hang-triggered respawn")
+        reports = [p for p in os.listdir(str(tmp_path))
+                   if p.startswith("kill_report")]
+        assert reports
+        with open(tmp_path / sorted(reports)[0]) as f:
+            report = json.load(f)
+        assert report["classification"] == "hang"
+        assert "heartbeat" in report["reason"]
+
+    def test_backend_lost_span_spans_generations(
+            self, fake_backend_path, tmp_path):
+        """A request that exhausts its retry budget resolves
+        BACKEND_LOST — and its trace carries the terminal
+        supervisor.backend_lost span."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=0,
+            max_respawns=3, env={"FAKE_DIE_ON_SUBMIT_GEN": "all"},
+            kill_report_dir=str(tmp_path))
+        with sup:
+            fut = sup.submit("equilibrium", trace_id="losttr01", T=1.0)
+            res = fut.result(timeout=60)
+            assert int(res.status) == int(SolveStatus.BACKEND_LOST)
+        lost = [e for e in rec.events("trace.span")
+                if e["trace"] == "losttr01"
+                and e["span"] == "supervisor.backend_lost"]
+        assert len(lost) == 1
+        assert lost[0]["attempts"] >= 1
+        # every death banked a report
+        assert [p for p in os.listdir(str(tmp_path))
+                if p.startswith("kill_report")]
+
     def test_backend_lost_after_retry_budget_exhausted(
             self, fake_backend_path):
         """ISSUE 7 fast-lane acceptance: a request whose re-submission
@@ -545,6 +800,42 @@ class TestSupervisorFake:
                 sup.submit("equilibrium", T=2.0)
             ev = rec.last_event("supervisor.respawn_exhausted")
             assert ev is not None
+
+    def test_close_racing_respawn_leaves_no_orphan(
+            self, fake_backend_path):
+        """Regression (found by ISSUE-8's kill-report tests): a
+        close() landing while the monitor is MID-RESPAWN must not
+        orphan the fresh child — _spawn refuses once draining is set,
+        and close() sweeps any generation it never SIGTERMed."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(
+            fake_backend_path, recorder=rec, retry_budget=1,
+            max_respawns=2, env={"FAKE_DIE_ON_SUBMIT_GEN": "0"})
+        with sup:
+            sup.submit("equilibrium", T=1.0)   # SIGKILLs generation 0
+            # deliberately racy: the counter bumps BEFORE the new
+            # child finishes spawning, so close() may land mid-spawn
+            _wait(lambda: sup.stats()["respawns"] >= 1,
+                  what="respawn begun")
+        # whatever child the supervisor last owned is DEAD: no orphan
+        # backend outlives its supervisor
+        with sup._lock:
+            proc = sup._proc
+        assert proc is not None
+        assert proc.poll() is not None
+
+    def test_metrics_scrape_survives_nonanswering_backend(
+            self, fake_backend_path):
+        """Supervisor.metrics() must land even when the backend cannot
+        answer the op (here: the fake speaks no ``metrics``): the
+        supervisor block still reports the respawn story."""
+        rec = telemetry.MetricsRecorder()
+        sup = _fake_supervisor(fake_backend_path, recorder=rec)
+        with sup:
+            m = sup.metrics(timeout=1.0)
+        assert "error" in m
+        assert m["supervisor"]["respawns"] == 0
+        assert m["supervisor"]["alive"] in (True, False)
 
     def test_hung_heartbeat_triggers_respawn(self, fake_backend_path):
         """Wedged-but-alive: the fake answers data-plane traffic but
@@ -590,24 +881,59 @@ class TestSupervisorFake:
 
 class TestRunSuiteChaosFlag:
     def test_chaos_flag_sets_child_env(self, tmp_path):
+        # the probe doubles as the kill-report plumbing check: the
+        # suite must export PYCHEMKIN_KILL_REPORT_DIR to children and
+        # assert an artifact landed there after the run
         probe = tmp_path / "test_probe_chaos_env.py"
         probe.write_text(
             "import json, os\n"
             "def test_env():\n"
             "    spec = json.loads("
             "os.environ['PYCHEMKIN_PROC_FAULTS'])\n"
-            "    assert spec[0]['mode'] == 'kill_backend_at_request'\n")
+            "    assert spec[0]['mode'] == 'kill_backend_at_request'\n"
+            "    kill_dir = os.environ['PYCHEMKIN_KILL_REPORT_DIR']\n"
+            "    path = os.path.join(kill_dir,\n"
+            "                        'kill_report_g0_999.json')\n"
+            "    with open(path, 'w') as f:\n"
+            "        json.dump({'classification': 'crash'}, f)\n")
         suite = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "run_suite.py")
         env = dict(os.environ)
         env.pop("PYCHEMKIN_PROC_FAULTS", None)
+        env.pop("PYCHEMKIN_KILL_REPORT_DIR", None)
         env["RUN_SUITE_FILE_TIMEOUT"] = "120"
         r = subprocess.run(
             [sys.executable, suite, "--chaos", str(probe)],
             capture_output=True, text=True, env=env, timeout=300)
         assert r.returncode == 0, r.stdout + r.stderr
+        assert "chaos kill reports: 1 new" in r.stdout
 
-    def test_chaos_flag_defaults_to_this_file(self):
+    def test_chaos_without_kill_report_fails_suite(self, tmp_path):
+        """ISSUE 8 satellite: a --chaos run that leaves NO kill-report
+        artifact fails — the crash flight recorder is CI-enforced, and
+        a STALE report from a previous run in a caller-provided dir
+        must not green-light a broken recorder."""
+        probe = tmp_path / "test_probe_no_report.py"
+        probe.write_text("def test_noop():\n    assert True\n")
+        kill_dir = tmp_path / "kills"
+        kill_dir.mkdir()
+        # a previous run's artifact: must NOT satisfy this run
+        (kill_dir / "kill_report_g0_7.json").write_text("{}")
+        suite = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "run_suite.py")
+        env = dict(os.environ)
+        env.pop("PYCHEMKIN_PROC_FAULTS", None)
+        env["PYCHEMKIN_KILL_REPORT_DIR"] = str(kill_dir)
+        env["RUN_SUITE_FILE_TIMEOUT"] = "120"
+        r = subprocess.run(
+            [sys.executable, suite, "--chaos", str(probe)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "chaos kill reports: 0 new" in r.stdout
+        assert "CHAOS FAILURE: no kill-report artifact" in r.stdout
+
+    def test_chaos_flag_defaults_to_this_file(self, tmp_path,
+                                              monkeypatch):
         import importlib.util
 
         suite_path = os.path.join(
@@ -619,25 +945,31 @@ class TestRunSuiteChaosFlag:
 
         recorded = {}
 
-        def fake_run(cmd, env=None, timeout=None):
+        def fake_run_child(targets, flags, env):
             recorded.setdefault("files", []).extend(
-                a for a in cmd if a.endswith(".py"))
+                a for a in targets if a.endswith(".py"))
             recorded["env"] = env
+            # a well-behaved chaos child banks a kill report
+            with open(os.path.join(env["PYCHEMKIN_KILL_REPORT_DIR"],
+                                   "kill_report_g0_1.json"), "w") as f:
+                json.dump({"classification": "crash"}, f)
+            return 0, 3
 
-            class R:
-                returncode = 0
-            return R()
-
-        orig = rs.subprocess.run
-        rs.subprocess.run = fake_run
+        orig = rs._run_child
+        rs._run_child = fake_run_child
+        # monkeypatch (not a bare pop): under `run_suite --chaos` the
+        # ambient value is load-bearing for LATER tests in this file
+        monkeypatch.setenv("PYCHEMKIN_KILL_REPORT_DIR", str(tmp_path))
         try:
             rc = rs.main(["--chaos"])
         finally:
-            rs.subprocess.run = orig
+            rs._run_child = orig
         assert rc == 0
         assert [os.path.basename(f) for f in recorded["files"]] == \
             ["test_serve_transport.py"]
         assert "PYCHEMKIN_PROC_FAULTS" in recorded["env"]
+        assert recorded["env"]["PYCHEMKIN_KILL_REPORT_DIR"] == \
+            str(tmp_path)
 
 
 # ---------------------------------------------------------------------------
@@ -757,9 +1089,15 @@ class TestChaosSoakAcceptance:
         assert ev is not None and ev["graceful"] is True
 
     def test_transport_loadgen_tool_banks_soak_artifact(self, tmp_path):
-        """tools/loadgen.py --transport --chaos end to end: the banked
-        artifact carries per-status counts plus the supervisor's
-        respawn/re-submit block."""
+        """tools/loadgen.py --transport --chaos end to end (ISSUE 7 +
+        the ISSUE 8 chaos-soak acceptance): the banked artifact carries
+        per-status counts plus the supervisor's respawn/re-submit
+        block; every resolved request's trace is reconstructable from
+        the obs dir's JSONL sinks with spans covering wire → admission
+        → batch → solve; the injected kill left a kill-report
+        artifact; and the banked ``metrics`` scrape (what chemtop
+        reads) is consistent with the artifact's per-status counts."""
+        from pychemkin_tpu.telemetry import trace as trace_mod
         from tools import loadgen as loadgen_tool
 
         out = str(tmp_path / "SOAK.json")
@@ -782,3 +1120,40 @@ class TestChaosSoakAcceptance:
         assert sum(art["status_counts"].values()) == art["n_served"]
         # strict JSON: the artifact parsed above, and no NaN literal
         assert "NaN" not in json.dumps(art)
+
+        # (a) trace reconstruction from the JSONL sinks: the client
+        # and backend sinks landed, and an exemplar's trace covers
+        # wire round-trip AND the backend's admission→batch→solve
+        obs = art["obs_dir"]
+        sinks = [os.path.join(obs, "client.jsonl"),
+                 os.path.join(obs, "backend.jsonl")]
+        assert all(os.path.exists(p) for p in sinks), sinks
+        assert art["trace_exemplars"]
+        resolved = [e for e in art["trace_exemplars"]
+                    if e["status"] != "TIMEOUT"]
+        assert resolved, art["trace_exemplars"]
+        spans = trace_mod.load_trace(sinks, resolved[0]["trace"])
+        names = {s["span"] for s in spans}
+        assert names >= {"client.wire", "serve.admission",
+                         "serve.batch_window", "serve.dispatch"}, names
+        assert resolved[0]["breakdown"]
+        # (b) the supervisor banked a kill report for the injected
+        # SIGKILL, classified as a crash, pointing at in-flight traces
+        assert art["kill_reports"], "no kill report banked"
+        with open(art["kill_reports"][0]) as f:
+            report = json.load(f)
+        assert report["classification"] == "crash"
+        assert report["respawn_budget"]["max_respawns"] >= 1
+        # (c) the banked metrics scrape is consistent with the
+        # artifact's per-status counts: the backend that answered was
+        # the respawned generation, and the supervisor block matches
+        metrics = art["metrics"]
+        assert metrics["supervisor"]["respawns"] == 1
+        assert metrics["generation"] == 1       # post-respawn scrape
+        counters = metrics.get("counters", {})
+        # the post-respawn backend's OK statuses cannot exceed the
+        # run's total OKs, and every resubmitted request landed there
+        assert counters.get("serve.status.OK", 0) <= \
+            art["status_counts"].get("OK", 0)
+        assert counters.get("serve.requests", 0) >= \
+            art["supervisor"]["resubmits"]

@@ -228,6 +228,231 @@ class TestSinkCrashSafety:
             assert json.load(f)["counters"]["n"] == 7
 
 
+class TestHistogramMerge:
+    """ISSUE 8 satellite: histogram-summary merge — fleet percentiles
+    must come from merged bucket STATES, not averaged per-process
+    percentiles."""
+
+    def test_empty_states_merge_to_empty(self):
+        assert telemetry.merge_histogram_states([]) == {"count": 0}
+        assert telemetry.merge_histogram_states(
+            [{"count": 0}, None, {}]) == {"count": 0}
+
+    def test_disjoint_buckets_union(self):
+        a, b = telemetry.Histogram(), telemetry.Histogram()
+        for v in (0.001, 0.002):
+            a.observe(v)
+        for v in (5000.0, 9000.0):
+            b.observe(v)
+        merged = telemetry.merge_histogram_states([a.state(),
+                                                   b.state()])
+        ref = telemetry.Histogram()
+        for v in (0.001, 0.002, 5000.0, 9000.0):
+            ref.observe(v)
+        assert merged == ref.summary()
+        assert merged["count"] == 4
+        assert merged["min"] == 0.001 and merged["max"] == 9000.0
+
+    def test_same_bucket_counts_add(self):
+        # values inside one log bucket: the merged median must behave
+        # as if one histogram had observed the combined stream
+        a, b = telemetry.Histogram(), telemetry.Histogram()
+        for _ in range(10):
+            a.observe(1.0)
+        for _ in range(10):
+            b.observe(1.01)
+        merged = telemetry.merge_histogram_states([a.state(),
+                                                   b.state()])
+        ref = telemetry.Histogram()
+        for _ in range(10):
+            ref.observe(1.0)
+        for _ in range(10):
+            ref.observe(1.01)
+        assert merged == ref.summary()
+        assert merged["count"] == 20
+
+    def test_empty_plus_full_is_identity(self):
+        h = telemetry.Histogram()
+        for v in (3.0, 7.0, 11.0):
+            h.observe(v)
+        merged = telemetry.merge_histogram_states(
+            [{"count": 0}, h.state()])
+        assert merged == h.summary()
+
+    def test_state_json_roundtrip(self):
+        # the wire form: states cross the metrics op as JSON
+        h = telemetry.Histogram()
+        for v in (0.5, 2.0, 80.0):
+            h.observe(v)
+        wired = json.loads(json.dumps(h.state()))
+        assert telemetry.merge_histogram_states([wired]) == h.summary()
+
+
+class TestTrace:
+    """ISSUE 8 tentpole: span records over the event spine."""
+
+    def test_sampling_knob(self, monkeypatch):
+        from pychemkin_tpu.telemetry import trace
+
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0")
+        assert trace.new_trace_id() is None
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "1.0")
+        tid = trace.new_trace_id()
+        assert isinstance(tid, str) and len(tid) == 16
+        assert trace.new_trace_id() != tid       # ids are unique
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "not-a-float")
+        assert trace.sample_rate() == 1.0        # unparseable → default
+        monkeypatch.delenv(trace.TRACE_SAMPLE_ENV)
+        assert trace.sample_rate() == 1.0
+
+    def test_span_context_emits_event(self):
+        from pychemkin_tpu.telemetry import trace
+
+        rec = MetricsRecorder()
+        with trace.span(rec, "t1", "serve.dispatch", req_kind="psr"):
+            time.sleep(0.002)
+        (ev,) = rec.events("trace.span")
+        assert ev["trace"] == "t1"
+        assert ev["span"] == "serve.dispatch"
+        assert ev["req_kind"] == "psr"
+        assert ev["dur_ms"] >= 2.0
+
+    def test_unsampled_is_noop(self):
+        from pychemkin_tpu.telemetry import trace
+
+        rec = MetricsRecorder()
+        with trace.span(rec, None, "x"):
+            pass
+        assert trace.emit_span(rec, None, "x", 1.0) is None
+        assert rec.events() == []
+
+    def test_reconstruction_and_breakdown(self):
+        from pychemkin_tpu.telemetry import trace
+
+        rec = MetricsRecorder()
+        trace.emit_span(rec, "tA", "serve.admission", 1.0)
+        trace.emit_span(rec, "tA", "serve.dispatch", 4.0)
+        trace.emit_span(rec, "tB", "serve.dispatch", 2.0)
+        trace.emit_span(rec, "tA", "serve.rescue_rung", 8.0, level=1)
+        rec.event("serve.batch", occupancy=3)    # non-span noise
+        by_trace = trace.spans_from_events(rec.events())
+        assert set(by_trace) == {"tA", "tB"}
+        assert len(by_trace["tA"]) == 3
+        assert trace.breakdown(by_trace["tA"]) == {
+            "serve.admission": 1.0, "serve.dispatch": 4.0,
+            "serve.rescue_rung": 8.0}
+
+    def test_load_trace_across_sink_files(self, tmp_path):
+        from pychemkin_tpu.telemetry import trace
+
+        a, b = str(tmp_path / "client.jsonl"), str(tmp_path
+                                                  / "backend.jsonl")
+        rec_a = MetricsRecorder(sink=JsonlSink(a))
+        rec_b = MetricsRecorder(sink=JsonlSink(b))
+        trace.emit_span(rec_a, "t9", "client.wire", 10.0)
+        trace.emit_span(rec_b, "t9", "serve.dispatch", 4.0)
+        trace.emit_span(rec_b, "zz", "serve.dispatch", 1.0)
+        spans = trace.load_trace(
+            [a, b, str(tmp_path / "missing.jsonl")], "t9")
+        assert [s["span"] for s in spans] in (
+            ["client.wire", "serve.dispatch"],
+            ["serve.dispatch", "client.wire"])
+        assert all(s["trace"] == "t9" for s in spans)
+
+
+class TestReadJsonlMixedTorn:
+    """ISSUE 8 satellite: a sink holding interleaved trace.span and
+    counter-style events with a torn final line (the one write a
+    SIGKILL can truncate) reads back every complete event."""
+
+    def test_mixed_kinds_with_torn_tail(self, tmp_path):
+        from pychemkin_tpu.telemetry import trace
+
+        p = str(tmp_path / "mixed.jsonl")
+        rec = MetricsRecorder(sink=JsonlSink(p))
+        trace.emit_span(rec, "tq", "serve.admission", 0.5)
+        rec.event("serve.batch", req_kind="psr", occupancy=4)
+        trace.emit_span(rec, "tq", "serve.dispatch", 3.0, lane=0)
+        rec.event("supervisor.spawn", generation=1, pid=123)
+        with open(p, "a") as f:                  # SIGKILL mid-span
+            f.write('{"t": 1.0, "kind": "trace.span", "trace": "tq", '
+                    '"span": "serve.resc')
+        evs = list(read_jsonl(p))
+        assert [e["kind"] for e in evs] == [
+            "trace.span", "serve.batch", "trace.span",
+            "supervisor.spawn"]
+        spans = trace.spans_from_events(evs)["tq"]
+        # both complete spans (start-sorted: both emitted at the same
+        # instant here, so the longer one has the earlier start)
+        assert sorted(s["span"] for s in spans) == [
+            "serve.admission", "serve.dispatch"]
+
+
+class TestEventsRingCap:
+    """ISSUE 8 satellite: the in-memory event tail is a bounded ring
+    with an env-tunable cap — a long soak cannot grow backend memory;
+    the JSONL sink stays the full record."""
+
+    def test_default_cap(self):
+        from pychemkin_tpu.telemetry import recorder as rec_mod
+
+        rec = MetricsRecorder()
+        assert rec._events.maxlen == rec_mod.DEFAULT_EVENTS_CAP == 4096
+
+    def test_env_cap_and_sink_keeps_full_record(self, monkeypatch,
+                                                tmp_path):
+        from pychemkin_tpu.telemetry import recorder as rec_mod
+
+        monkeypatch.setenv(rec_mod.EVENTS_CAP_ENV, "8")
+        p = str(tmp_path / "full.jsonl")
+        rec = MetricsRecorder(sink=JsonlSink(p))
+        for i in range(50):
+            rec.event("tick", i=i)
+        tail = rec.events("tick")
+        assert len(tail) == 8                    # bounded ring
+        assert [e["i"] for e in tail] == list(range(42, 50))
+        assert rec.last_event("tick")["i"] == 49
+        # the sink holds ALL 50: memory is bounded, the record is not
+        assert len(list(read_jsonl(p))) == 50
+
+    def test_bad_env_value_falls_back(self, monkeypatch):
+        from pychemkin_tpu.telemetry import recorder as rec_mod
+
+        monkeypatch.setenv(rec_mod.EVENTS_CAP_ENV, "zero")
+        assert MetricsRecorder()._events.maxlen == \
+            rec_mod.DEFAULT_EVENTS_CAP
+
+
+class TestFlightRecorderDump:
+    def test_dump_writes_ring_and_counters(self, monkeypatch,
+                                           tmp_path):
+        monkeypatch.setenv(telemetry.recorder.FLIGHT_DIR_ENV,
+                           str(tmp_path))
+        rec = MetricsRecorder()
+        rec.inc("serve.requests", 3)
+        rec.observe("serve.solve_ms", 5.0)
+        rec.event("serve.batch", occupancy=2)
+        path = telemetry.flight_recorder_dump("test_death", rec,
+                                              generation=2)
+        assert path == os.path.join(str(tmp_path),
+                                    f"flight_{os.getpid()}.json")
+        with open(path) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "test_death"
+        assert dump["generation"] == 2
+        assert dump["counters"]["serve.requests"] == 3
+        assert dump["histograms"]["serve.solve_ms"]["count"] == 1
+        assert dump["events"][-1]["kind"] == "serve.batch"
+
+    def test_disabled_without_destination(self, monkeypatch):
+        monkeypatch.delenv(telemetry.recorder.FLIGHT_DIR_ENV,
+                           raising=False)
+        monkeypatch.delenv(telemetry.recorder.FLIGHT_PATH_ENV,
+                           raising=False)
+        assert telemetry.flight_recorder_dump("x",
+                                              MetricsRecorder()) is None
+
+
 class TestDeviceCounterBridge:
     def test_device_increment_from_jit(self):
         rec = telemetry.get_recorder()
@@ -310,9 +535,10 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
 
 
 #: every key the serve_latency rung JSON must carry (ISSUE 5; soak
-#: counters extended by ISSUE 7): the online-path counterpart of
-#: RUNG_SCHEMA_KEYS — request-side latency percentiles, occupancy,
-#: rejection/timeout/rescue/deadline counts, compile counters
+#: counters extended by ISSUE 7; tracing keys by ISSUE 8): the
+#: online-path counterpart of RUNG_SCHEMA_KEYS — request-side latency
+#: percentiles, occupancy, rejection/timeout/rescue/deadline counts,
+#: compile counters, and the traced-vs-untraced overhead evidence
 SERVE_RUNG_KEYS = (
     "rung", "platform", "mech", "kinds", "warmup_s", "compiles",
     "n_batches", "queue_wait_ms", "solve_ms", "n_requests", "n_served",
@@ -321,6 +547,8 @@ SERVE_RUNG_KEYS = (
     "offered_s", "wall_s",
     "status_counts", "p50_ms", "p95_ms", "p99_ms", "mean_ms", "max_ms",
     "mean_occupancy", "max_occupancy",
+    "trace_sample", "untraced_p50_ms", "trace_overhead_pct",
+    "trace_stage_breakdown", "trace_exemplars",
 )
 
 
@@ -339,6 +567,16 @@ def _fake_serve_result():
         "wall_s": 0.4, "status_counts": {"OK": 20}, "p50_ms": 10.0,
         "p95_ms": 12.0, "p99_ms": 14.0, "mean_ms": 10.5, "max_ms": 15.0,
         "mean_occupancy": 2.2, "max_occupancy": 4,
+        "trace_sample": 1.0, "untraced_p50_ms": 9.8,
+        "trace_overhead_pct": 2.04,
+        "trace_stage_breakdown": {
+            "serve.dispatch": {"count": 9, "p50_ms": 8.0,
+                               "p99_ms": 9.5}},
+        "trace_exemplars": [
+            {"trace": "abc123", "kind": "ignition", "status": "OK",
+             "latency_ms": 15.0,
+             "spans": [{"span": "serve.dispatch", "dur_ms": 8.0}],
+             "breakdown": {"serve.dispatch": 8.0}}],
     }
 
 

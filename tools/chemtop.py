@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""chemtop — fleet-metrics scraper for serving backends.
+
+Polls the ``metrics`` op of one or more running transport backends
+(``pychemkin_tpu/serve/transport.py``) and merges the replies into ONE
+fleet snapshot: counters summed, per-tenant in-flight/quota summed,
+histograms merged from their RAW bucket states (so fleet p50/p95/p99
+come from the merged distribution, not averaged per-process
+percentiles), plus a per-backend liveness row (pid, generation —
+the supervisor's re-exec stamp, so a churning backend is visible —
+and uptime).
+
+Two modes:
+
+- ``--once``: one scrape, printed as a JSON line and (with ``--out``)
+  banked atomically — the CI/artifact mode; the chaos-soak acceptance
+  compares this against the loadgen artifact's per-status counts.
+- default: a top(1)-style loop rendering the fleet table every
+  ``--interval`` seconds (bank with ``--out`` to keep the latest
+  snapshot on disk across a kill).
+
+Usage::
+
+    python tools/chemtop.py --ports 41231 --once --out FLEET.json
+    python tools/chemtop.py --ports 41231,41232 --interval 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+# runnable as a script from anywhere (same bootstrap as bench.py)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from pychemkin_tpu import telemetry                    # noqa: E402
+from pychemkin_tpu.serve.transport import TransportClient  # noqa: E402
+
+
+def scrape(host: str, port: int, timeout: float = 30.0) -> Dict:
+    """One backend's ``metrics`` reply (op/id bookkeeping stripped);
+    an unreachable backend yields ``{"port", "error"}`` instead of
+    raising — a fleet view must survive one dead member."""
+    try:
+        client = TransportClient(host, port,
+                                 recorder=telemetry.MetricsRecorder())
+    except OSError as exc:
+        return {"port": port, "error": f"{type(exc).__name__}: {exc}"}
+    try:
+        reply = dict(client.metrics(timeout=timeout))
+    except Exception as exc:  # noqa: BLE001 — dead mid-scrape
+        return {"port": port, "error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        client.close()
+    reply.pop("op", None)
+    reply.pop("id", None)
+    reply["port"] = port
+    return reply
+
+
+def merge_fleet(replies: List[Dict]) -> Dict:
+    """Merge per-backend ``metrics`` replies into one fleet snapshot
+    (pure — unit-testable without sockets). Backends that answered
+    with an error still appear in ``backends`` but contribute no
+    counters."""
+    counters: Dict[str, int] = {}
+    tenants: Dict[str, Dict[str, int]] = {}
+    hist_states: Dict[str, List[Dict]] = {}
+    backends = []
+    for rep in replies:
+        row = {"port": rep.get("port"), "pid": rep.get("pid"),
+               "generation": rep.get("generation"),
+               "uptime_s": rep.get("uptime_s"),
+               "error": rep.get("error")}
+        backends.append(row)
+        # a supervisor-side merged reply (Supervisor.metrics) carries
+        # its respawn story even when the backend could not answer —
+        # fold it BEFORE the error skip: churn counters matter most
+        # exactly when the backend is dead/respawning
+        sup = rep.get("supervisor")
+        if sup:
+            for k in ("respawns", "resubmits",
+                      "backend_lost_requests"):
+                counters[f"supervisor.{k}"] = (
+                    counters.get(f"supervisor.{k}", 0)
+                    + int(sup.get(k, 0)))
+        if rep.get("error"):
+            continue
+        for k, v in (rep.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        for name, t in (rep.get("tenants") or {}).items():
+            agg = tenants.setdefault(name, {"inflight": 0, "quota": 0})
+            agg["inflight"] += int(t.get("inflight", 0))
+            agg["quota"] += int(t.get("quota", 0))
+        for name, state in (rep.get("histogram_states") or {}).items():
+            hist_states.setdefault(name, []).append(state)
+    return {
+        "t": time.time(),
+        "n_backends": len(backends),
+        "n_alive": sum(1 for b in backends if not b["error"]),
+        "backends": backends,
+        "counters": counters,
+        "tenants": tenants,
+        "histograms": {name: telemetry.merge_histogram_states(states)
+                       for name, states in sorted(hist_states.items())},
+    }
+
+
+def render(snapshot: Dict) -> str:
+    """Human top-style view of one merged snapshot."""
+    lines = [f"chemtop — {snapshot['n_alive']}/"
+             f"{snapshot['n_backends']} backends alive"]
+    for b in snapshot["backends"]:
+        state = (f"ERROR {b['error']}" if b["error"] else
+                 f"pid {b['pid']}  gen {b['generation']}  "
+                 f"up {b['uptime_s']:.0f}s")
+        lines.append(f"  :{b['port']}  {state}")
+    c = snapshot["counters"]
+    lines.append(
+        f"  requests {c.get('serve.requests', 0)}  "
+        f"batches {c.get('serve.batches', 0)}  "
+        f"compiles {c.get('serve.compiles', 0)}  "
+        f"rejected {c.get('serve.rejected', 0) + c.get('serve.tenant_rejected', 0)}  "
+        f"rescued {c.get('serve.rescued', 0)}  "
+        f"deadline_expired {c.get('serve.deadline_expired', 0)}")
+    for name in ("serve.queue_wait_ms", "serve.solve_ms"):
+        h = snapshot["histograms"].get(name)
+        if h and h.get("count"):
+            lines.append(
+                f"  {name}: n={h['count']}  p50={h['p50']:.3g}  "
+                f"p95={h['p95']:.3g}  p99={h['p99']:.3g}")
+    for name, t in sorted(snapshot["tenants"].items()):
+        lines.append(f"  tenant {name}: inflight {t['inflight']}"
+                     f"/{t['quota']}")
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--ports", required=True,
+                   help="comma list of backend ports to scrape")
+    p.add_argument("--once", action="store_true",
+                   help="one scrape: JSON line to stdout (CI mode)")
+    p.add_argument("--out", default=None,
+                   help="bank the merged snapshot here (atomic "
+                        "rewrite, every poll)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll interval in watch mode, s")
+    p.add_argument("--iterations", type=int, default=None,
+                   help="stop watch mode after N polls (default: "
+                        "until interrupted)")
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="per-backend scrape timeout, s")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    ports = [int(x) for x in args.ports.split(",") if x.strip()]
+    n = 0
+    while True:
+        snapshot = merge_fleet([scrape(args.host, port, args.timeout)
+                                for port in ports])
+        if args.out:
+            telemetry.atomic_write_json(args.out, snapshot)
+        if args.once:
+            print(json.dumps(snapshot), flush=True)
+            return 0 if snapshot["n_alive"] == len(ports) else 1
+        print(render(snapshot), flush=True)
+        n += 1
+        if args.iterations is not None and n >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,21 @@ The artifact carries the request-side latency distribution
 (p50/p95/p99/mean/max ms), occupancy, rejection/timeout/rescue counts,
 per-status counts, plus the server-side telemetry snapshot (in-process)
 or the supervisor + backend stats (transport).
+
+Observability (ISSUE 8): every run also banks an ``--obs-dir``
+(default ``<out stem>_obs/``) holding the crash-safe JSONL sinks —
+``client.jsonl`` (client/supervisor-side events incl. ``trace.span``
+wire/resubmit spans) and, in transport mode, ``backend.jsonl`` (the
+backend child's serve-layer spans, appended across respawned
+generations) — plus any supervisor kill reports and backend flight
+dumps. The artifact's ``trace_exemplars`` block names the slowest /
+stuck requests' trace ids with per-stage span breakdowns assembled
+from those sinks; follow one with::
+
+    grep <trace-id> <obs-dir>/*.jsonl
+
+and the artifact's ``metrics`` block (transport mode) is the same
+merged snapshot ``tools/chemtop.py`` scrapes live.
 """
 
 from __future__ import annotations
@@ -40,6 +55,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -79,6 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-request deadline budget, ms")
     p.add_argument("--out", default="LOADGEN.json",
                    help="artifact path (atomic rewrite)")
+    p.add_argument("--obs-dir", default=None,
+                   help="observability dir for JSONL trace sinks, kill "
+                        "reports, flight dumps (default: <out>_obs/)")
+    p.add_argument("--exemplars", type=int, default=5,
+                   help="slowest/stuck trace exemplars in the artifact")
     # -- supervised transport soak mode --------------------------------
     p.add_argument("--transport", action="store_true",
                    help="drive a SUPERVISED backend process over the "
@@ -103,9 +124,58 @@ def _engine_config() -> dict:
                          "max_steps_per_segment": 4000}}
 
 
-def _run_inprocess(args, kinds, bucket_sizes, rng, samplers):
+class _Obs:
+    """The run's observability surface: one dir holding the client (and
+    in transport mode, backend) JSONL sinks, kill reports, and flight
+    dumps — everything the artifact's trace exemplars are assembled
+    from, and everything a human greps a trace id across."""
+
+    def __init__(self, args):
+        self.dir = args.obs_dir or (
+            os.path.splitext(args.out)[0] + "_obs")
+        os.makedirs(self.dir, exist_ok=True)
+        self.client_jsonl = os.path.join(self.dir, "client.jsonl")
+        self.backend_jsonl = os.path.join(self.dir, "backend.jsonl")
+        # one run = one story: a reused obs dir must not bleed a
+        # previous run's spans into this run's exemplars, nor its
+        # post-mortems into this artifact's kill/flight lists
+        for path in (self.client_jsonl, self.backend_jsonl):
+            if os.path.exists(path):
+                os.unlink(path)
+        self._t0 = time.time()
+        self.recorder = telemetry.MetricsRecorder(
+            sink=telemetry.JsonlSink(self.client_jsonl))
+
+    def trace_events(self):
+        """All trace.span events banked so far, across every sink."""
+        events = []
+        for path in (self.client_jsonl, self.backend_jsonl):
+            if os.path.exists(path):
+                events.extend(e for e in telemetry.read_jsonl(path)
+                              if e.get("kind") == "trace.span")
+        return events
+
+    def artifacts(self) -> dict:
+        import glob as _glob
+
+        def _this_run(pattern):
+            # mtime-gated (small slack for clock granularity): stale
+            # post-mortems from an earlier run in the same dir are a
+            # different story, not this artifact's evidence
+            return sorted(
+                p for p in _glob.glob(os.path.join(self.dir, pattern))
+                if os.path.getmtime(p) >= self._t0 - 1.0)
+
+        return {
+            "obs_dir": self.dir,
+            "kill_reports": _this_run("kill_report*.json"),
+            "flight_records": _this_run("flight_*.json"),
+        }
+
+
+def _run_inprocess(args, kinds, bucket_sizes, rng, samplers, obs):
     mech = load_embedded(args.mech)
-    rec = telemetry.MetricsRecorder()
+    rec = obs.recorder
     server = serve.ChemServer(
         mech, bucket_sizes=bucket_sizes, max_batch_size=args.max_batch,
         max_delay_ms=args.delay_ms, queue_depth=args.queue_depth,
@@ -117,15 +187,17 @@ def _run_inprocess(args, kinds, bucket_sizes, rng, samplers):
         summary = loadgen.run_load(
             server, samplers, rate_hz=args.rate, n_requests=args.n,
             rng=rng, result_timeout_s=args.timeout,
-            deadline_ms=args.deadline_ms)
+            deadline_ms=args.deadline_ms,
+            trace_events=obs.trace_events,
+            n_exemplars=args.exemplars)
     return summary, {"warmup_compiles": warm,
                      "telemetry": rec.snapshot()}
 
 
-def _run_transport(args, kinds, bucket_sizes, rng, samplers):
+def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs):
     if args.chaos is not None:
         json.loads(args.chaos)       # fail fast on a typo'd spec
-    rec = telemetry.MetricsRecorder()
+    rec = obs.recorder
     config = {
         "tenants": {args.tenant: {"mech": args.mech,
                                   "quota": args.quota}},
@@ -136,12 +208,18 @@ def _run_transport(args, kinds, bucket_sizes, rng, samplers):
                  "queue_depth": args.queue_depth},
         "engine_config": _engine_config(),
     }
-    env = ({"PYCHEMKIN_PROC_FAULTS": args.chaos}
-           if args.chaos is not None else None)
+    # the backend child's own sinks: its serve-layer trace spans land
+    # in backend.jsonl (appended across respawned generations), and an
+    # orderly death dumps its flight record next to the kill reports
+    env = {"PYCHEMKIN_TELEMETRY_PATH": obs.backend_jsonl,
+           "PYCHEMKIN_FLIGHT_DIR": obs.dir}
+    if args.chaos is not None:
+        env["PYCHEMKIN_PROC_FAULTS"] = args.chaos
     sup = Supervisor(config, env_overrides=env,
                      retry_budget=args.retry_budget,
                      max_respawns=args.max_respawns,
-                     default_tenant=args.tenant, recorder=rec)
+                     default_tenant=args.tenant, recorder=rec,
+                     kill_report_dir=obs.dir)
     sup.install_signal_handlers()
     print(f"# loadgen: spawning supervised backend "
           f"(chaos={'on' if args.chaos else 'off'})", file=sys.stderr)
@@ -151,13 +229,18 @@ def _run_transport(args, kinds, bucket_sizes, rng, samplers):
         summary = loadgen.run_load(
             sup, samplers, rate_hz=args.rate, n_requests=args.n,
             rng=rng, result_timeout_s=args.timeout,
-            deadline_ms=args.deadline_ms)
+            deadline_ms=args.deadline_ms,
+            trace_events=obs.trace_events,
+            n_exemplars=args.exemplars)
         extra = {"transport": True,
                  "tenant": args.tenant,
                  "quota": args.quota,
                  "chaos": (json.loads(args.chaos)
                            if args.chaos else None),
-                 "supervisor": sup.stats()}
+                 "supervisor": sup.stats(),
+                 # the same merged snapshot chemtop scrapes live: the
+                 # backend metrics op + the supervisor's own counters
+                 "metrics": sup.metrics()}
         try:
             extra["backend"] = sup.server_stats()
         except Exception as exc:     # noqa: BLE001 — backend may be dead
@@ -173,9 +256,12 @@ def main(argv=None) -> int:
     mech = load_embedded(args.mech)
     rng = np.random.default_rng(args.seed)
     samplers = loadgen.default_samplers(mech, kinds)
+    obs = _Obs(args)
 
     runner = _run_transport if args.transport else _run_inprocess
-    summary, extra = runner(args, kinds, bucket_sizes, rng, samplers)
+    summary, extra = runner(args, kinds, bucket_sizes, rng, samplers,
+                            obs)
+    extra.update(obs.artifacts())
 
     artifact = {
         "tool": "loadgen",
@@ -191,7 +277,8 @@ def main(argv=None) -> int:
     }
     telemetry.atomic_write_json(args.out, artifact)
     print(json.dumps({k: v for k, v in artifact.items()
-                      if k != "telemetry"}), flush=True)
+                      if k not in ("telemetry", "metrics")}),
+          flush=True)
     print(f"# loadgen: artifact banked to {args.out}", file=sys.stderr)
     return 0
 
