@@ -46,6 +46,7 @@ from . import (  # noqa: E402
     parallel,
     resilience,
     serve,
+    surrogate,
     telemetry,
 )
 from .chemistry import (  # noqa: E402
@@ -131,6 +132,7 @@ __all__ = [
     "resilience",
     "serve",
     "set_verbose",
+    "surrogate",
     "telemetry",
     "verbose",
     "water_heat_vaporization",

@@ -64,6 +64,16 @@ untraced p50; the ISSUE-8 bound is within 5%) plus ``trace_stage_breakdown``,
 the per-span-name p50/p99 derived from the traced pass's spans — the
 per-stage cost attribution the stiffness-aware-scheduling work needs.
 
+After the serve rung, a ``surrogate_latency`` rung measures the neural
+fast path (``pychemkin_tpu/surrogate/``): it labels a small training
+box with the real solver, trains an MLP ensemble, serves it as a
+``surrogate_ignition`` engine SHARING the real ignition engine, and
+records (a) the in-domain stream's hit rate (verified surrogate
+answers / resolved surrogate requests), and (b) ``surrogate_p50_ms``
+vs ``solver_p50_ms`` — repeated ``solve_direct`` calls of both kinds
+at the SAME bucket-1 program shape, the honest per-request speedup of
+a hit. Its JSON rides in the summary under ``"surrogate_latency"``.
+
 Environment knobs:
   BENCH_LADDER      comma list of mech:B pairs (default
                     "h2o2:16,h2o2:256,h2o2:1024,h2o2:4096,
@@ -77,6 +87,14 @@ Environment knobs:
                     rung (default none); expired requests resolve
                     DEADLINE_EXCEEDED without consuming a batch slot
                     and the rung records n_deadline_expired
+  BENCH_SURROGATE   "0" disables the surrogate_latency rung (default
+                    on)
+  BENCH_SURROGATE_MECH   surrogate-rung mechanism (default h2o2)
+  BENCH_SURROGATE_N      surrogate-rung stream request count (64)
+  BENCH_SURROGATE_RATE   surrogate-rung offered rate, req/s (100)
+  BENCH_SURROGATE_TRAIN  labeled training conditions (192)
+  BENCH_SURROGATE_STEPS  Adam steps per ensemble member (1500)
+  BENCH_SURROGATE_TIMEOUT  rung subprocess timeout, s (default 600)
   BENCH_CHUNK       max batch elements per compiled call (default 256).
                     Larger B runs as sequential chunks of ONE cached
                     program, so compile time is flat in B, and a single
@@ -165,22 +183,14 @@ def _cpu_env():
 def _stoich_Y0(mech, mech_name):
     """Stoichiometric fuel/air mass fractions: CH4/air for GRI-3.0,
     H2/air otherwise (the h2o2 and grisyn fixtures both carry the H2/O2
-    subsystem as their live chemistry)."""
-    import jax.numpy as jnp
+    subsystem as their live chemistry). Delegates to the surrogate
+    dataset's ``phi_composition`` — the ONE place the recipe lives, so
+    a surrogate's trained feature box and this bench/loadgen
+    composition can never drift apart."""
+    from .surrogate.dataset import phi_composition
 
-    from .ops import thermo
-
-    names = list(mech.species_names)
-    X = np.zeros(len(names))
-    if mech_name == "gri30":
-        X[names.index("CH4")] = 1.0
-        X[names.index("O2")] = 2.0
-        X[names.index("N2")] = 7.52
-    else:
-        X[names.index("H2")] = 2.0
-        X[names.index("O2")] = 1.0
-        X[names.index("N2")] = 3.76
-    return np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+    fuel = "CH4" if mech_name == "gri30" else "H2"
+    return phi_composition(mech, 1.0, fuel=fuel)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -445,6 +455,112 @@ def _child_serve(mech_name: str, n_requests: int, rate_hz: float):
         untraced_p50_ms=p50_ref,
         trace_overhead_pct=overhead_pct,
         trace_stage_breakdown=breakdown,
+        **summary)), flush=True)
+
+
+def _child_surrogate(mech_name: str, n_requests: int, rate_hz: float):
+    """The surrogate_latency rung: label → train → serve → measure,
+    all in one subprocess (same isolation contract as every rung);
+    prints one JSON line.
+
+    The wrapped real ignition engine is SHARED with the surrogate
+    (``base_engine=``), so the solver-vs-surrogate p50 comparison and
+    any fallback re-solve run the exact same compiled bucket-1
+    program. Hit rate comes from the in-domain Poisson stream
+    (``n_surrogate_hit`` / resolved surrogate requests); the p50 pair
+    comes from repeated ``solve_direct`` calls of both kinds at
+    bucket 1 after warmup."""
+    import jax
+    import numpy as np_
+
+    from . import serve, surrogate, telemetry
+    from .mechanism import load_embedded
+    from .serve import loadgen
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    if platform != "cpu":
+        from .utils import enable_compilation_cache
+        enable_compilation_cache(partition="axon")
+    mech = load_embedded(mech_name)
+    n_train = int(os.environ.get("BENCH_SURROGATE_TRAIN", 192))
+    steps = int(os.environ.get("BENCH_SURROGATE_STEPS", 1500))
+    hidden = (32, 32)
+    n_members = 3
+    ign_cfg = {"rtol": 1e-6, "atol": 1e-10,
+               "max_steps_per_segment": 4000}
+    box = surrogate.SampleBox()
+
+    t0 = time.time()
+    data, _report = surrogate.generate_dataset(
+        mech, "ignition", n=n_train, seed=0, box=box,
+        chunk_size=min(64, n_train), solver_kwargs=ign_cfg)
+    label_s = time.time() - t0
+    t0 = time.time()
+    model, curves = surrogate.fit_surrogate(
+        data, hidden=hidden, steps=steps, n_members=n_members, seed=0)
+    train_s = time.time() - t0
+    print(f"# surrogate: labeled {int(data['valid'].sum())}/{n_train} "
+          f"in {label_s:.1f}s, trained in {train_s:.1f}s",
+          file=sys.stderr)
+
+    rec = telemetry.MetricsRecorder(max_events=max(4096, 8 * n_requests))
+    server = serve.ChemServer(
+        mech, bucket_sizes=(1, 8, 32), max_batch_size=32,
+        max_delay_ms=2.0, queue_depth=1024, recorder=rec,
+        engine_config={"ignition": ign_cfg})
+    base = server.engine("ignition")
+    server.configure_engine("surrogate_ignition", model=model,
+                            base_engine=base)
+    t0 = time.time()
+    server.warmup(["ignition", "surrogate_ignition"])
+    warmup_s = time.time() - t0
+
+    # per-request p50 of each kind at the SAME bucket-1 program
+    # shape; probes are not traffic — 15 repeats of one fixed payload
+    # must not pollute the hit/miss counters or the residual
+    # histogram the rung reports for the STREAM
+    def _direct_p50(kind, payload, n=15):
+        with server.engine(kind).suppress_accounting():
+            walls = [server.solve_direct(kind, bucket=1,
+                                         **payload).solve_ms
+                     for _ in range(n)]
+        return float(np_.median(walls))
+
+    Y0 = surrogate.phi_composition(mech, 1.0)[0]
+    probe = dict(T0=0.5 * (box.T[0] + box.T[1]), P0=1.01325e6, Y0=Y0,
+                 t_end=box.t_end)
+    surrogate_p50 = _direct_p50("surrogate_ignition", probe)
+    solver_p50 = _direct_p50("ignition", probe)
+
+    # in-domain open-loop stream: the hit-rate measurement (the
+    # default ignition sampler draws inside the default SampleBox)
+    samplers = loadgen.default_samplers(mech, ["surrogate_ignition"])
+    with server:
+        summary = loadgen.run_load(
+            server, samplers, rate_hz=rate_hz, n_requests=n_requests,
+            rng=np_.random.default_rng(0))
+    snap = rec.snapshot()
+    resolved_sur = (summary["n_surrogate_hit"]
+                    + summary["n_surrogate_fallback"])
+    hit_rate = (round(summary["n_surrogate_hit"] / resolved_sur, 4)
+                if resolved_sur else None)
+    print(json.dumps(dict(
+        rung="surrogate_latency", platform=platform, mech=mech_name,
+        n_train=n_train, n_valid=int(data["valid"].sum()),
+        hidden=list(hidden), train_steps=steps, n_members=n_members,
+        final_losses=[round(float(c[-1]), 6) for c in curves],
+        label_s=round(label_s, 1), train_s=round(train_s, 1),
+        warmup_s=round(warmup_s, 1),
+        hit_rate=hit_rate,
+        surrogate_p50_ms=round(surrogate_p50, 3),
+        solver_p50_ms=round(solver_p50, 3),
+        speedup_p50=(round(solver_p50 / surrogate_p50, 1)
+                     if surrogate_p50 else None),
+        bucket=1,
+        gate=dict(server.engine("surrogate_ignition").gate._asdict()),
+        compiles=snap["counters"].get("serve.compiles", 0),
+        residual=snap["histograms"].get("serve.surrogate.residual"),
         **summary)), flush=True)
 
 
@@ -900,10 +1016,43 @@ def _main_guarded():
                   + (":\n#   " + tail.replace("\n", "\n#   ")
                      if tail else ""), file=sys.stderr)
 
+    # neural-surrogate rung: label/train/serve the fast path and
+    # record hit rate + surrogate-vs-solver p50 at the same bucket —
+    # its own subprocess, same budget discipline as the serve rung
+    surrogate_rung = None
+    rem = _remaining(deadline)
+    if os.environ.get("BENCH_SURROGATE", "1") != "0" \
+            and (rem is None
+                 or rem > _BUDGET_RESERVE_S + _MIN_RUNG_WINDOW_S):
+        sur_mech = os.environ.get("BENCH_SURROGATE_MECH", "h2o2")
+        sur_n = int(os.environ.get("BENCH_SURROGATE_N", 64))
+        sur_rate = float(os.environ.get("BENCH_SURROGATE_RATE", 100))
+        sur_timeout = float(os.environ.get("BENCH_SURROGATE_TIMEOUT",
+                                           600))
+        if rem is not None:
+            sur_timeout = min(sur_timeout, rem - _BUDGET_RESERVE_S / 2)
+        rc, surrogate_rung, tail = _run_child(
+            ["surrogate", sur_mech, str(sur_n), str(sur_rate)],
+            sur_timeout, env=None if on_accel else _cpu_env())
+        if surrogate_rung:
+            telemetry.record_event("bench_surrogate", **surrogate_rung)
+            print(f"# surrogate_latency: hit_rate="
+                  f"{surrogate_rung.get('hit_rate')} "
+                  f"surrogate_p50={surrogate_rung.get('surrogate_p50_ms')}ms "
+                  f"solver_p50={surrogate_rung.get('solver_p50_ms')}ms",
+                  file=sys.stderr)
+        else:
+            print("# surrogate_latency rung "
+                  + ("timed out" if rc == -2 else f"failed rc={rc}")
+                  + (":\n#   " + tail.replace("\n", "\n#   ")
+                     if tail else ""), file=sys.stderr)
+
     out = _build_summary(results, baselines, is_fallback=is_fallback,
                          accel_err=accel_err, host_cpu=host_cpu)
     if serve_rung:
         out["serve_latency"] = serve_rung
+    if surrogate_rung:
+        out["surrogate_latency"] = surrogate_rung
     telemetry.record_event("bench_summary", **out)
     if bank_path:
         telemetry.atomic_write_json(bank_path, out)
@@ -919,6 +1068,9 @@ def _dispatch():
         _child_baseline(sys.argv[2], int(sys.argv[3]), float(sys.argv[4]))
     elif len(sys.argv) >= 5 and sys.argv[1] == "serve":
         _child_serve(sys.argv[2], int(sys.argv[3]), float(sys.argv[4]))
+    elif len(sys.argv) >= 5 and sys.argv[1] == "surrogate":
+        _child_surrogate(sys.argv[2], int(sys.argv[3]),
+                         float(sys.argv[4]))
     else:
         main()
 

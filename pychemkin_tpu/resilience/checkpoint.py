@@ -166,11 +166,7 @@ def save(path: str, *, sig: str, B: int, done_upto: int,
                 f"checkpoint result {key!r} has {arr.shape[0]} elements "
                 f"< done_upto={done_upto}")
         payload[_RESULT_PREFIX + key] = arr[:done_upto]
-    parent = os.path.dirname(os.path.abspath(path))
-    os.makedirs(parent, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}.npz"
-    np.savez(tmp, **payload)
-    os.replace(tmp, path)
+    telemetry.atomic_savez(path, **payload)
     rec = recorder if recorder is not None else telemetry.get_recorder()
     rec.event("checkpoint.save", label=label, path=path,
               done_upto=int(done_upto), B=int(B))

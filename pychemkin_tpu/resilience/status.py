@@ -51,6 +51,24 @@ they classify what the *serving layer* did with a request
                         across respawns (:mod:`pychemkin_tpu.serve
                         .supervisor`) — the caller gets this code
                         instead of a hang.
+
+One code is emitted by the neural-surrogate fast path
+(:mod:`pychemkin_tpu.surrogate`) — it IS produced inside a jitted
+batch function, but its value fields are ALWAYS NaN-masked, so no
+unverified prediction can ride it out:
+
+- ``SURROGATE_MISS``    a surrogate prediction failed its verification
+                        gate (out of the trained domain, ensemble
+                        disagreement, or Gibbs-residual check) — the
+                        value fields are NaN-masked and the request
+                        falls through to the wrapped real engine via
+                        the rescue hand-off. A caller sees it as a
+                        FINAL status only when the fallback could not
+                        run: rescue disabled, or the request's
+                        deadline expired before rescue rung 1 (the
+                        ``serve.rescue`` event then carries
+                        ``deadline_cut``; such requests count as
+                        neither surrogate hit nor fallback).
 """
 
 from __future__ import annotations
@@ -74,6 +92,9 @@ class SolveStatus(enum.IntEnum):
     # host-side serving-layer codes (never emitted by jitted solvers)
     DEADLINE_EXCEEDED = 7
     BACKEND_LOST = 8
+    # surrogate fast path: prediction failed its verification gate —
+    # value is NaN-masked; with rescue enabled the real engine re-solves
+    SURROGATE_MISS = 9
 
 
 #: every code, in priority order (highest first) — used by mergers;
@@ -82,6 +103,7 @@ class SolveStatus(enum.IntEnum):
 STATUS_PRIORITY = (
     SolveStatus.BACKEND_LOST,
     SolveStatus.DEADLINE_EXCEEDED,
+    SolveStatus.SURROGATE_MISS,
     SolveStatus.NONFINITE,
     SolveStatus.LINALG_UNSTABLE,
     SolveStatus.NEWTON_DIVERGED,

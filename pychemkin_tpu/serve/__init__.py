@@ -26,15 +26,28 @@ routing and per-tenant admission quotas, and :mod:`.supervisor` keeps
 a transport backend process alive — crash/hang/poison detection,
 budgeted respawn, in-flight re-submission (``BACKEND_LOST`` as data
 when the budget is spent), graceful SIGTERM drain end-to-end.
+
+Engine kinds are pluggable (:func:`.engines.register_engine`); the
+neural surrogate fast path (:mod:`pychemkin_tpu.surrogate`) registers
+``surrogate_ignition`` / ``surrogate_equilibrium`` engines that answer
+verified predictions directly and re-enqueue misses to the wrapped
+real engine through the rescue hand-off — statistically fast, never
+wrong (see :class:`.engines.SurrogateEngine`).
 """
 
 from .batcher import BatchPolicy
 from .buckets import DEFAULT_BUCKETS, bucket_for, pad_indices
 from .engines import (
     ENGINE_TYPES,
+    DuplicateEngineKindError,
     EquilibriumEngine,
+    EquilibriumSurrogateEngine,
     IgnitionEngine,
+    IgnitionSurrogateEngine,
     PSREngine,
+    SurrogateEngine,
+    register_engine,
+    registered_kinds,
 )
 from .errors import (
     ServeError,
@@ -51,9 +64,12 @@ __all__ = [
     "BatchPolicy",
     "ChemServer",
     "DEFAULT_BUCKETS",
+    "DuplicateEngineKindError",
     "ENGINE_TYPES",
     "EquilibriumEngine",
+    "EquilibriumSurrogateEngine",
     "IgnitionEngine",
+    "IgnitionSurrogateEngine",
     "PSREngine",
     "Request",
     "ServeError",
@@ -63,9 +79,12 @@ __all__ = [
     "ServerOverloaded",
     "Supervisor",
     "SupervisorError",
+    "SurrogateEngine",
     "TransportClient",
     "TransportClosed",
     "TransportServer",
     "bucket_for",
     "pad_indices",
+    "register_engine",
+    "registered_kinds",
 ]

@@ -29,6 +29,7 @@ zero injection nodes.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -44,6 +45,10 @@ from ..ops import reactors as reactor_ops
 from ..ops import thermo
 from ..resilience import faultinject
 from ..resilience.rescue import DEFAULT_LADDER
+from ..resilience.status import SolveStatus
+from ..surrogate import dataset as sg_dataset
+from ..surrogate import model as sg_model
+from ..surrogate import verify as sg_verify
 from .buckets import pad_indices
 
 
@@ -59,6 +64,47 @@ class Engine:
     #: payload fields stacked along the batch axis, in order
     fields: Tuple[str, ...] = ()
     max_rescue_rungs = 2
+    #: engine-preferred bucket ladder, or None for the server's. A
+    #: cheap engine (the surrogate MLP) declares tiny buckets so its
+    #: dispatches stay at minimal padded shapes; the server extends
+    #: the ladder with its own top so any admitted occupancy still
+    #: has a bucket (see ChemServer.engine)
+    bucket_ladder: Optional[Tuple[int, ...]] = None
+    #: when set, the server emits one extra ``trace.span`` of this
+    #: name per traced request after dispatch, carrying
+    #: :meth:`span_fields` — how the surrogate's verified/residual
+    #: story rides the standard tracing spine
+    trace_span_name: Optional[str] = None
+    #: whether this kind constructs with no ``engine_config`` entry —
+    #: consulted by ChemServer.warmup's no-kinds fallback, so plugin
+    #: engines stay warmable without editing the server (a surrogate
+    #: needs a trained model and opts out)
+    zero_config = True
+
+    def span_fields(self, out: Dict[str, np.ndarray],
+                    i: int) -> Dict[str, Any]:
+        """Per-lane extra fields for :attr:`trace_span_name` spans."""
+        return {}
+
+    def warm_dependencies(self) -> None:
+        """Compile any COMPANION programs this engine dispatches to
+        off its own ladder (called by ChemServer.warmup after the
+        engine's own rungs). The surrogate warms its base engine's
+        bucket-1 fallback here, so the first miss never pays a stiff
+        compile inside the rescue thread."""
+
+    @contextlib.contextmanager
+    def suppress_accounting(self):
+        """Dispatches inside this block are not traffic: engines with
+        per-request accounting (the surrogate's hit/miss counters and
+        residual histogram) skip it. Used by warmup, dependency
+        warming, and the bench's p50 probes."""
+        saved = self._warming
+        self._warming = True
+        try:
+            yield
+        finally:
+            self._warming = saved
 
     def __init__(self, mech, recorder=None):
         self.mech = mech
@@ -67,6 +113,10 @@ class Engine:
         self._jit_cache: Dict[Tuple, Any] = {}
         self._rescue_cache: Dict[Tuple, Any] = {}
         self._cache_lock = threading.Lock()
+        #: set by ChemServer.warmup around ladder compiles: engines
+        #: with per-request accounting (surrogate hit/miss) must not
+        #: count warmup's dummy payloads as traffic
+        self._warming = False
 
     # -- payload ---------------------------------------------------------
     def normalize(self, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -446,9 +496,303 @@ class PSREngine(Engine):
         return out, int(out["status"][0])
 
 
-#: engine registry: request kind -> constructor
-ENGINE_TYPES = {
-    IgnitionEngine.kind: IgnitionEngine,
-    EquilibriumEngine.kind: EquilibriumEngine,
-    PSREngine.kind: PSREngine,
-}
+class SurrogateEngine(Engine):
+    """Neural fast path wrapping a real ("base") engine kind.
+
+    The batch function is the trained MLP ensemble
+    (:mod:`pychemkin_tpu.surrogate`) plus the per-kind verification
+    gate (:mod:`pychemkin_tpu.surrogate.verify`): verified lanes carry
+    the prediction with ``SolveStatus.OK``; everything else is
+    NaN-masked and exits with ``SolveStatus.SURROGATE_MISS``, which the
+    server's existing rescue hand-off turns into a re-solve on the
+    wrapped real engine — rung 1 of this engine's ladder IS the base
+    engine's hot path at bucket 1 (so a fallback bit-matches
+    ``solve_direct`` of the base kind at that bucket), and deeper rungs
+    delegate to the base engine's own escalation. A miss therefore
+    costs one extra batch window, never a wrong answer.
+
+    Construction (via ``ChemServer`` ``engine_config``):
+
+    - ``model=`` a loaded :class:`~pychemkin_tpu.surrogate.model
+      .SurrogateModel`, or ``model_path=`` an npz from
+      ``tools/train_surrogate.py``. The model's ``mech_sig`` must
+      match the serving mechanism — a surrogate trained against a
+      different mechanism is refused with
+      :class:`~pychemkin_tpu.surrogate.dataset.DatasetSignatureError`.
+    - ``base_engine=`` an existing base-engine instance to SHARE (jit
+      caches and all — the bit-match-vs-solve_direct configuration),
+      or ``base_config=`` ctor kwargs to build a private one. Through
+      ``ChemServer`` config, prefer the JSON-safe
+      ``share_base_kind="<base>"`` key instead — the server resolves
+      it to ITS engine instance at build time (works over a transport
+      backend's wire config; see ``ChemServer.configure_engine``).
+    - gate thresholds (``domain_margin``/``ign_disagree_max``/
+      ``ign_t_end_frac``/``eq_resid_max``) override the
+      ``PYCHEMKIN_SURROGATE_*`` env knobs.
+
+    Telemetry: ``serve.surrogate.hit`` / ``.miss`` counters at solve,
+    ``serve.surrogate.fallback`` when rung 1 re-solves a miss, a
+    ``serve.surrogate.residual`` histogram (gate residual /
+    ensemble disagreement per lane), and one ``serve.surrogate`` trace
+    span per traced request carrying ``verified``/``residual``.
+    """
+
+    base_kind = "?"
+    trace_span_name = "serve.surrogate"
+    zero_config = False      # needs a trained model to construct
+    #: an MLP dispatch is microseconds — tiny buckets keep padded
+    #: waste (and the verify gate's work) proportional to occupancy
+    bucket_ladder = (1, 4, 16)
+
+    def __init__(self, mech, recorder=None, *, model=None,
+                 model_path=None, base_engine=None, base_config=None,
+                 domain_margin=None, ign_disagree_max=None,
+                 ign_t_end_frac=None, eq_resid_max=None):
+        super().__init__(mech, recorder)
+        if model is None:
+            if model_path is None:
+                raise ValueError(
+                    f"{self.kind}: need model= or model_path=")
+            model = sg_model.load_model(model_path)
+        if model.kind != self.base_kind:
+            raise ValueError(
+                f"{self.kind}: model was trained for kind "
+                f"{model.kind!r}, this engine wraps {self.base_kind!r}")
+        mech_sig = sg_dataset.mech_signature(mech)
+        if model.mech_sig != mech_sig:
+            raise sg_dataset.DatasetSignatureError(
+                f"{self.kind}: model mech_sig {model.mech_sig[:12]}… "
+                f"does not match the serving mechanism "
+                f"({mech_sig[:12]}…) — it was trained against "
+                "different chemistry; retrain before serving")
+        self.model = model
+        if base_engine is not None:
+            if base_engine.kind != self.base_kind:
+                raise ValueError(
+                    f"{self.kind}: base_engine is {base_engine.kind!r},"
+                    f" expected {self.base_kind!r}")
+            self.base = base_engine
+        else:
+            self.base = ENGINE_TYPES[self.base_kind](
+                mech, recorder, **(base_config or {}))
+        self.fields = self.base.fields
+        # rung 1 = the base engine's hot path; deeper rungs = its ladder
+        self.max_rescue_rungs = 1 + self.base.max_rescue_rungs
+        self.gate = sg_verify.gate_config(
+            domain_margin=domain_margin,
+            ign_disagree_max=ign_disagree_max,
+            ign_t_end_frac=ign_t_end_frac,
+            eq_resid_max=eq_resid_max)
+
+    # -- payload: the surrogate speaks the base engine's schema ----------
+    def normalize(self, payload):
+        return self.base.normalize(payload)
+
+    def group_key(self, payload):
+        return self.base.group_key(payload)
+
+    def dummy_payload(self):
+        return self.base.dummy_payload()
+
+    # -- batched predict + verify ----------------------------------------
+    def solve(self, payloads, bucket, key):
+        out, solve_s = super().solve(payloads, bucket, key)
+        if self._warming:
+            # ladder warmup dispatches a dummy payload per rung; it
+            # must not pollute the hit/miss/residual accounting the
+            # acceptance contract sums against live traffic
+            return out, solve_s
+        # hit/miss accounting over the REAL lanes only (padding lanes
+        # are edge duplicates, not requests)
+        ver = np.asarray(out["verified"][:len(payloads)], bool)
+        hits = int(ver.sum())
+        if hits:
+            self._rec.inc("serve.surrogate.hit", hits)
+        if len(payloads) - hits:
+            self._rec.inc("serve.surrogate.miss", len(payloads) - hits)
+        for r in np.asarray(out["residual"][:len(payloads)],
+                            np.float64):
+            if np.isfinite(r):
+                self._rec.observe("serve.surrogate.residual", float(r))
+        return out, solve_s
+
+    def span_fields(self, out, i):
+        r = float(out["residual"][i])
+        # non-finite residuals (a far-out-of-domain extrapolation) ride
+        # as null: the JSONL sink must stay strict-JSON parseable
+        return {"verified": bool(out["verified"][i]),
+                "residual": round(r, 6) if np.isfinite(r) else None}
+
+    def value_at(self, out, i):
+        val = self.base.value_at(out, i)
+        # present on surrogate output only — a fallback's value comes
+        # from the base engine's out dict and is marked False
+        ver = out.get("verified")
+        val["surrogate"] = bool(ver[i]) if ver is not None else False
+        return val
+
+    def warm_dependencies(self):
+        # the fallback program: ONE bucket-1 base solve, compiled now
+        # so the first miss costs a batch window — never a stiff
+        # integrator compile inside the rescue thread. Shared
+        # base_engine instances may already be warm (jit cache hit).
+        dummy = self.base.normalize(self.base.dummy_payload())
+        with self.base.suppress_accounting():
+            self.base.solve([dummy], 1, self.base.group_key(dummy))
+
+    # -- miss hand-off: the wrapped real engine --------------------------
+    def rescue_one(self, payload, key, level, elem_id):
+        if level == 1:
+            # the fallback: ONE batch-1 solve on the shared base
+            # engine — the same compiled program solve_direct(base
+            # kind, bucket=1) runs, so results bit-match it
+            out, _ = self.base.solve([payload], 1, key)
+            self._rec.inc("serve.surrogate.fallback")
+            return out, int(out["status"][0])
+        return self.base.rescue_one(payload, key, level - 1, elem_id)
+
+
+class IgnitionSurrogateEngine(SurrogateEngine):
+    """Learned ignition delay over the :class:`IgnitionEngine` payload.
+    Gate: in-domain bound + ensemble trust interval + horizon fit
+    (:func:`pychemkin_tpu.surrogate.verify.ignition_gate`)."""
+
+    kind = "surrogate_ignition"
+    base_kind = "ignition"
+
+    def _make_batch_fn(self, key):
+        model, gate = self.model, self.gate
+
+        def fn(T0s, P0s, Y0s, t_ends):
+            self._count_trace()
+            feats = sg_model.features(T0s, P0s, Y0s)
+            preds = sg_model.predict(model, feats)[..., 0]   # [M, B]
+            ok, disagree = sg_verify.ignition_gate(
+                model, feats, preds, t_ends, gate)
+            t_pred = 10.0 ** jnp.mean(preds, axis=0)
+            times = jnp.where(ok, t_pred, jnp.nan)
+            status = jnp.where(
+                ok, jnp.int32(SolveStatus.OK),
+                jnp.int32(SolveStatus.SURROGATE_MISS))
+            return {"times": times, "ok": ok, "status": status,
+                    "verified": ok, "residual": disagree}
+
+        return fn
+
+
+class EquilibriumSurrogateEngine(SurrogateEngine):
+    """Learned constrained equilibrium over the
+    :class:`EquilibriumEngine` payload (the model's trained
+    ``option`` only). Gate: in-domain bound + element-potential/Gibbs
+    residual of the PREDICTED state
+    (:func:`pychemkin_tpu.surrogate.verify.equilibrium_gate`)."""
+
+    kind = "surrogate_equilibrium"
+    base_kind = "equilibrium"
+
+    def __init__(self, mech, recorder=None, **kwargs):
+        super().__init__(mech, recorder, **kwargs)
+        self.option = int(self.model.meta.get("option", 1))
+        if self.option != 1:
+            # the batch fn passes the request's (T, P) through as the
+            # equilibrium state and the Gibbs gate evaluates at that
+            # (T, P) — only valid for the fixed-(T,P) constraint pair.
+            # Other options need a predicted (T, P) head first.
+            raise ValueError(
+                f"{self.kind}: model was labeled under equilibrium "
+                f"option {self.option}; only option 1 (fixed T,P) is "
+                "currently servable")
+
+    def normalize(self, payload):
+        norm = super().normalize(payload)
+        if norm["option"] != self.option:
+            raise ValueError(
+                f"{self.kind}: model was trained for equilibrium "
+                f"option {self.option}, got {norm['option']} — submit "
+                "to the real engine for other constraint pairs")
+        return norm
+
+    def _make_batch_fn(self, key):
+        model, gate, mech = self.model, self.gate, self.mech
+
+        def fn(Ts, Ps, Ys):
+            self._count_trace()
+            Yn = Ys / jnp.maximum(jnp.sum(Ys, axis=1, keepdims=True),
+                                  1e-30)
+            feats = sg_model.features(Ts, Ps, Yn)
+            ln_x = jnp.mean(sg_model.predict(model, feats),
+                            axis=0)                        # [B, KK]
+            x = jnp.exp(ln_x)
+            X = x / jnp.maximum(jnp.sum(x, axis=1, keepdims=True),
+                                1e-30)
+            b = jax.vmap(lambda Y: eq_ops.element_moles(mech, Y))(Yn)
+            ok, resid = sg_verify.equilibrium_gate(
+                mech, model, feats, Ts, Ps, X, b, gate)
+            wbar = jnp.maximum(X @ mech.wt, 1e-30)
+            Y_eq = X * mech.wt / wbar[:, None]
+            h = jax.vmap(lambda T, Y: thermo.mixture_enthalpy_mass(
+                mech, T, Y))(Ts, Y_eq)
+
+            def mask(a):
+                # unverified lanes must carry NO prediction: NaN, not
+                # a plausible-looking wrong answer
+                return jnp.where(ok if a.ndim == 1 else ok[:, None],
+                                 a, jnp.nan)
+
+            status = jnp.where(ok, jnp.int32(SolveStatus.OK),
+                               jnp.int32(SolveStatus.SURROGATE_MISS))
+            return {"T": Ts, "P": Ps, "X": mask(X), "Y": mask(Y_eq),
+                    "h": mask(h), "converged": ok, "status": status,
+                    "verified": ok, "residual": resid}
+
+        return fn
+
+
+class DuplicateEngineKindError(ValueError):
+    """A second engine registered an already-taken request kind —
+    almost always two plugins colliding; pass ``replace=True`` to
+    :func:`register_engine` only when shadowing is intended."""
+
+
+#: engine registry: request kind -> constructor. Populated through
+#: :func:`register_engine`; read by ChemServer at lazy engine build.
+ENGINE_TYPES: Dict[str, Any] = {}
+
+
+def register_engine(kind: str, ctor, *, replace: bool = False) -> None:
+    """Register an engine constructor for request kind ``kind``.
+
+    ``ctor`` is called as ``ctor(mech, recorder, **engine_config)``
+    (the :class:`Engine` constructor shape). Registering an
+    already-taken kind raises :class:`DuplicateEngineKindError` unless
+    ``replace=True`` — a silent overwrite would reroute live traffic.
+    """
+    if not kind or not isinstance(kind, str):
+        raise ValueError(f"engine kind must be a non-empty string, "
+                         f"got {kind!r}")
+    if not replace and kind in ENGINE_TYPES:
+        raise DuplicateEngineKindError(
+            f"engine kind {kind!r} is already registered "
+            f"({ENGINE_TYPES[kind]!r}); pass replace=True to shadow it")
+    ENGINE_TYPES[kind] = ctor
+
+
+def registered_kinds() -> Tuple[str, ...]:
+    """Every registered request kind, sorted."""
+    return tuple(sorted(ENGINE_TYPES))
+
+
+def zero_config_kinds() -> Tuple[str, ...]:
+    """Registered kinds constructible with no ``engine_config`` entry
+    (``ctor.zero_config``, default True so plugin engines keep the old
+    warm-everything default) — ChemServer.warmup's no-kinds fallback
+    set. Surrogate kinds opt out: without a trained model they can
+    neither warm nor serve."""
+    return tuple(sorted(
+        kind for kind, ctor in ENGINE_TYPES.items()
+        if getattr(ctor, "zero_config", True)))
+
+
+for _cls in (IgnitionEngine, EquilibriumEngine, PSREngine,
+             IgnitionSurrogateEngine, EquilibriumSurrogateEngine):
+    register_engine(_cls.kind, _cls)

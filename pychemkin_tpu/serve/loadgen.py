@@ -38,26 +38,54 @@ def stoich_h2_air_Y(mech) -> np.ndarray:
     return _stoich_Y0(mech, "h2air")
 
 
+#: a surrogate kind speaks its base kind's payload schema — the
+#: sampler prefix rule keeps default_samplers covering EVERY
+#: registered engine kind without enumerating surrogates
+SURROGATE_PREFIX = "surrogate_"
+
+
 def default_samplers(mech, kinds: Sequence[str], *,
                      T_range=(1250.0, 1400.0), P=1.01325e6,
                      t_end=4e-4, tau_range=(3e-4, 3e-3),
                      eq_T_range=(900.0, 2000.0),
+                     eq_surrogate_T_range=(1250.0, 1400.0),
                      option=1) -> List[Sampler]:
-    """One sampler per requested kind over physically sane ranges."""
-    Y0 = stoich_h2_air_Y(mech)
+    """One sampler per requested kind over physically sane ranges.
+
+    Covers every registered engine kind, surrogate kinds included: a
+    ``surrogate_<base>`` kind draws its base kind's payload (the
+    surrogate engines share the base schema), with the surrogate
+    equilibrium sampler staying inside the default trained box
+    (``eq_surrogate_T_range`` — the plain equilibrium range spans far
+    outside any surrogate's training data, which would make a mixed
+    stream all-fallback instead of mixed hit/fallback).
+
+    Compositions come from the ONE fuel/air recipe
+    (:func:`pychemkin_tpu.surrogate.dataset.phi_composition`, default
+    fuel) — the same source the surrogate training boxes sample, so a
+    stream offered to a surrogate kind is in-domain for a model
+    trained on the default box whatever the mechanism's fuel is."""
+    from ..surrogate.dataset import phi_composition
+
+    Y0 = phi_composition(mech, 1.0)[0]
     out: List[Sampler] = []
     for kind in kinds:
-        if kind == "ignition":
+        base = (kind[len(SURROGATE_PREFIX):]
+                if kind.startswith(SURROGATE_PREFIX) else kind)
+        if base == "ignition":
             def s(i, rng, _k=kind):
                 return _k, dict(
                     T0=float(rng.uniform(*T_range)), P0=P, Y0=Y0,
                     t_end=t_end)
-        elif kind == "equilibrium":
-            def s(i, rng, _k=kind):
+        elif base == "equilibrium":
+            rng_T = (eq_surrogate_T_range if kind != base
+                     else eq_T_range)
+
+            def s(i, rng, _k=kind, _T=rng_T):
                 return _k, dict(
-                    T=float(rng.uniform(*eq_T_range)), P=P, Y=Y0,
+                    T=float(rng.uniform(*_T)), P=P, Y=Y0,
                     option=option)
-        elif kind == "psr":
+        elif base == "psr":
             def s(i, rng, _k=kind):
                 return _k, dict(
                     tau=float(rng.uniform(*tau_range)), P=P, Y_in=Y0,
@@ -150,6 +178,8 @@ def run_load(server, samplers: Sequence[Sampler], *,
     n_timeout = 0
     n_error = 0
     n_resolved = 0
+    n_surrogate_hit = 0
+    n_surrogate_fallback = 0
     for i, kind, fut, t_sub, tid in records:
         try:
             res = fut.result(timeout=result_timeout_s)
@@ -185,6 +215,14 @@ def run_load(server, samplers: Sequence[Sampler], *,
         status_counts[res.status_name] = (
             status_counts.get(res.status_name, 0) + 1)
         n_rescued += int(res.rescued)
+        if kind.startswith(SURROGATE_PREFIX):
+            # hit = answered on the fast path; fallback = the rescue
+            # hand-off re-solved it on the real engine (deadline-
+            # expired surrogate requests are neither)
+            if res.rescue_rungs == 0 and res.ok:
+                n_surrogate_hit += 1
+            elif res.rescue_rungs > 0:
+                n_surrogate_fallback += 1
         resolved_reqs.append((latency, tid, kind, res.status_name))
     wall_s = time.perf_counter() - t0
 
@@ -230,6 +268,8 @@ def run_load(server, samplers: Sequence[Sampler], *,
         "n_timeout": n_timeout,
         "n_error": n_error,
         "n_rescued": n_rescued,
+        "n_surrogate_hit": n_surrogate_hit,
+        "n_surrogate_fallback": n_surrogate_fallback,
         "rate_hz": rate_hz,
         "offered_s": round(offered_s, 3),
         "wall_s": round(wall_s, 3),

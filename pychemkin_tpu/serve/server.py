@@ -70,7 +70,7 @@ from ..resilience.driver import GracefulStop
 from ..resilience.status import SolveStatus, name_of
 from ..telemetry import trace
 from . import batcher, buckets
-from .engines import ENGINE_TYPES, Engine
+from .engines import ENGINE_TYPES, Engine, zero_config_kinds
 from .errors import ServerClosed, ServerOverloaded
 from .futures import Request, ServeFuture, ServeResult, make_result
 
@@ -114,7 +114,8 @@ class ChemServer:
             maxsize=self.queue_depth)
         self._rescue_q: "_queue.Queue[Any]" = _queue.Queue()
         self._stop = GracefulStop()
-        self._lock = threading.Lock()
+        # reentrant: engine() recurses to resolve share_base_kind
+        self._lock = threading.RLock()
         self._worker: Optional[threading.Thread] = None
         self._rescuer: Optional[threading.Thread] = None
         self._started = False
@@ -134,11 +135,47 @@ class ChemServer:
                     raise ValueError(
                         f"unknown request kind {kind!r}; expected one "
                         f"of {sorted(ENGINE_TYPES)}")
-                eng = ENGINE_TYPES[kind](
-                    self.mech, self._rec,
-                    **self._engine_config.get(kind, {}))
+                cfg = dict(self._engine_config.get(kind, {}))
+                share = cfg.pop("share_base_kind", None)
+                if share is not None:
+                    # JSON-safe sharing: resolve a kind NAME to this
+                    # server's (possibly lazily built) engine instance
+                    # — jit caches shared, so a surrogate fallback
+                    # runs the exact program solve_direct(base) uses,
+                    # even when the config arrived over the wire
+                    cfg.setdefault("base_engine", self.engine(share))
+                eng = ENGINE_TYPES[kind](self.mech, self._rec, **cfg)
+                if eng.bucket_ladder is not None:
+                    # engine-preferred ladder (a cheap engine batches
+                    # at tiny padded shapes), unioned with the
+                    # server's so any occupancy the policy admits
+                    # still has a bucket without over-padding
+                    eng.bucket_ladder = buckets.normalize_ladder(
+                        tuple(eng.bucket_ladder) + self.buckets)
                 self._engines[kind] = eng
             return eng
+
+    def configure_engine(self, kind: str, **ctor_kwargs) -> None:
+        """Set constructor kwargs for a kind that has not been built
+        yet — the way to attach a surrogate engine that SHARES this
+        server's base engine (jit caches and all, so fallbacks
+        bit-match ``solve_direct`` of the base kind)::
+
+            server.configure_engine("surrogate_ignition",
+                                    model_path="IGN.npz",
+                                    share_base_kind="ignition")
+
+        ``share_base_kind`` is resolved to the named kind's engine
+        INSTANCE at build time (JSON-safe — it works through a
+        transport backend's wire config too); passing an explicit
+        ``base_engine=`` instance is equivalent in-process.
+        """
+        with self._lock:
+            if kind in self._engines:
+                raise ValueError(
+                    f"engine {kind!r} is already built; configure "
+                    "before first use")
+            self._engine_config[kind] = dict(ctor_kwargs)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ChemServer":
@@ -358,19 +395,28 @@ class ChemServer:
         group key differs from the engine default (e.g. a non-default
         equilibrium ``option``: each option is its own program).
         Returns {kind: programs compiled this call}."""
-        if bucket_sizes is not None:
-            ladder = [int(b) for b in bucket_sizes]
-        else:
-            # only buckets dispatch can reach: occupancy is capped at
-            # max_batch_size, so any bucket above its rung is a
-            # program the batcher can never request
-            reach = buckets.bucket_for(self.policy.max_batch_size,
-                                       self.buckets)
-            ladder = [b for b in self.buckets if b <= reach]
         compiled = {}
-        for kind in (kinds if kinds is not None else
-                     sorted(self._engines) or sorted(ENGINE_TYPES)):
+        # the no-kinds fallback warms built engines, else everything
+        # this server can construct: the zero-config built-ins plus
+        # whatever engine_config makes constructible (a surrogate kind
+        # without a model cannot warm OR serve)
+        default_kinds = (sorted(self._engines)
+                         or sorted(set(zero_config_kinds())
+                                   | set(self._engine_config)))
+        for kind in (kinds if kinds is not None else default_kinds):
             eng = self.engine(kind)
+            if bucket_sizes is not None:
+                ladder = [int(b) for b in bucket_sizes]
+            else:
+                # only buckets dispatch can reach FOR THIS ENGINE:
+                # occupancy is capped at max_batch_size, so any bucket
+                # above its rung (on the engine's own ladder, when it
+                # declares one) is a program the batcher can never
+                # request
+                eng_ladder = eng.bucket_ladder or self.buckets
+                reach = buckets.bucket_for(self.policy.max_batch_size,
+                                           eng_ladder)
+                ladder = [b for b in eng_ladder if b <= reach]
             # .get, not [.]: counters is a defaultdict and an unlocked
             # missing-key read would INSERT, racing a live snapshot()
             before = self._rec.counters.get(
@@ -378,8 +424,14 @@ class ChemServer:
             dummy = eng.normalize(
                 (payloads or {}).get(kind) or eng.dummy_payload())
             key = eng.group_key(dummy)
-            for b in ladder:
-                eng.solve([dummy], b, key)
+            with eng.suppress_accounting():
+                for b in ladder:
+                    eng.solve([dummy], b, key)
+                # companion programs off the engine's own ladder —
+                # e.g. the surrogate's bucket-1 fallback on its base
+                # engine, so the first miss never compiles in the
+                # rescue thread
+                eng.warm_dependencies()
             compiled[kind] = (self._rec.counters.get(
                 f"serve.compiles.{kind}", 0) - before)
         return compiled
@@ -481,7 +533,8 @@ class ChemServer:
             return
         eng = self._engines[kind]
         occupancy = len(reqs)
-        bucket = buckets.bucket_for(occupancy, self.buckets)
+        bucket = buckets.bucket_for(occupancy,
+                                    eng.bucket_ladder or self.buckets)
         t_form = time.perf_counter()
         # .get: counters is a defaultdict and an unlocked missing-key
         # read would INSERT, racing a live snapshot()
@@ -532,6 +585,13 @@ class ChemServer:
                         solve_ms, req_kind=kind, bucket=bucket,
                         occupancy=occupancy, compile_hit=compile_hit,
                         lane=i, status=name_of(status))
+                    if eng.trace_span_name:
+                        # engine-declared extra span (e.g. the
+                        # surrogate's verified/residual verdict)
+                        trace.emit_span(
+                            self._rec, req.trace_id,
+                            eng.trace_span_name, solve_ms,
+                            req_kind=kind, **eng.span_fields(out, i))
                 meta = dict(kind=kind, bucket=bucket,
                             occupancy=occupancy,
                             queue_wait_ms=wait_ms, solve_ms=solve_ms)

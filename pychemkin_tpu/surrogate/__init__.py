@@ -1,0 +1,87 @@
+"""Neural surrogate fast path: learned answers for the hot request
+kinds, with the hard guarantee that **no unverified surrogate answer
+ever leaves the server**.
+
+The stiff-ODE DNN line (arXiv:2104.01914) shows small learned
+surrogates can replace the stiff integrator for well-trodden request
+regions at a fraction of the cost. This package supplies the four
+pieces, each riding an existing production-spine subsystem:
+
+- :mod:`.dataset` — sample (T, P, composition) boxes and label them by
+  running the REAL solvers under the durable sweep driver:
+  generation is checkpointed, resumable after SIGKILL, and banked as
+  signed npz shards (the training-data flywheel).
+- :mod:`.model` / :mod:`.train` — dependency-free JAX MLP ensembles
+  (plain-pytree params, npz serialization, handwritten Adam);
+  ``tools/train_surrogate.py`` is the CLI.
+- :mod:`.verify` — per-kind cheap acceptance gates (equilibrium:
+  element-potential/Gibbs residual of the predicted state; ignition:
+  in-domain bound + ensemble-disagreement trust interval). The gate's
+  boolean mask is the ONLY thing standing between a prediction and
+  the client.
+- :class:`pychemkin_tpu.serve.engines.SurrogateEngine` — serves the
+  model as a new engine kind; verified hits answer directly, misses
+  re-enqueue to the wrapped real engine through the existing rescue
+  hand-off (``SolveStatus.SURROGATE_MISS`` as data), so a miss costs
+  one extra batch window — never a wrong answer.
+"""
+
+from .dataset import (
+    DatasetSignatureError,
+    SampleBox,
+    generate_dataset,
+    load_shard,
+    load_shards,
+    mech_signature,
+    phi_composition,
+    problem_signature,
+    sample_inputs,
+    save_shard,
+)
+from .model import (
+    SurrogateModel,
+    features,
+    init_mlp,
+    load_model,
+    mlp_apply,
+    predict,
+    save_model,
+)
+from .train import fit_surrogate, train_member, training_curve_artifact
+from .verify import (
+    GateConfig,
+    equilibrium_gate,
+    equilibrium_residual,
+    gate_config,
+    ignition_gate,
+    in_domain,
+)
+
+__all__ = [
+    "DatasetSignatureError",
+    "GateConfig",
+    "SampleBox",
+    "SurrogateModel",
+    "equilibrium_gate",
+    "equilibrium_residual",
+    "features",
+    "fit_surrogate",
+    "gate_config",
+    "generate_dataset",
+    "ignition_gate",
+    "in_domain",
+    "init_mlp",
+    "load_model",
+    "load_shard",
+    "load_shards",
+    "mech_signature",
+    "mlp_apply",
+    "phi_composition",
+    "predict",
+    "problem_signature",
+    "sample_inputs",
+    "save_model",
+    "save_shard",
+    "train_member",
+    "training_curve_artifact",
+]

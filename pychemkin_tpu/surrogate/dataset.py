@@ -1,0 +1,378 @@
+"""Surrogate training data: sampled (T, P, composition) boxes labeled
+by the REAL solvers under the durable sweep driver.
+
+Dataset generation is just another sweep, so it rides the whole PR 4
+durability contract for free
+(:func:`pychemkin_tpu.resilience.driver.run_vmapped_sweep_job`):
+checkpoint banking per chunk, graceful SIGTERM → resumable rc 75,
+retry/backoff, SIGKILL-safe resume that bit-matches an uninterrupted
+run (inputs are deterministic from the seed, chunk layouts identical,
+banked chunks adopted verbatim). This is the training-data flywheel:
+every production sweep the driver runs is future label material.
+
+A finished generation banks ONE npz **shard** carrying:
+
+- ``x``/``y``    feature/target arrays (the shared feature map of
+                 :func:`pychemkin_tpu.surrogate.model.features`;
+                 log-time targets for ignition delay, log-mole-fraction
+                 targets for equilibrium),
+- ``valid``      per-row label mask (the solver's per-element
+                 ``SolveStatus`` verdict — failed labels are never
+                 silently trained on),
+- ``sig``        the PROBLEM signature
+                 (:func:`problem_signature`: mechanism + box + seed +
+                 solver configuration) — a stale shard can't silently
+                 train against a different mechanism: every loader
+                 checks it (:func:`load_shards`) and so does the
+                 serving layer at model-attach time,
+- ``lo``/``hi``  the sampled box in FEATURE space (the verification
+                 gate's in-domain bound; :mod:`.verify`).
+
+Shards concatenate (:func:`load_shards`), so repeated generations over
+time — different seeds, widened boxes — grow one training set as long
+as their problem identity matches.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import equilibrium as eq_ops
+from ..ops import reactors as reactor_ops
+from ..resilience import checkpoint
+from ..resilience.driver import run_vmapped_sweep_job
+from .. import telemetry
+from ..resilience.status import SolveStatus, status_counts
+from .model import X_FLOOR, features
+
+#: shard-file layout version; an old shard REFUSES to load (unlike a
+#: checkpoint, a training shard is an input, not an optimization)
+SHARD_VERSION = 1
+
+#: the request kinds a dataset can label
+KINDS = ("ignition", "equilibrium")
+
+#: per-kind default solver configuration for labeling — the serving
+#: protocol's knobs (tight enough to trust, cheap enough to sweep)
+DEFAULT_SOLVER_KWARGS = {
+    "ignition": {"rtol": 1e-6, "atol": 1e-10,
+                 "max_steps_per_segment": 4000},
+    "equilibrium": {"option": 1, "n_iter": 80},
+}
+
+
+class DatasetSignatureError(RuntimeError):
+    """A shard/model's problem signature does not match: the data was
+    generated for a different mechanism, box, seed, or solver
+    configuration. Refusing loudly is the whole point — a silently
+    mismatched dataset would train a surrogate against the wrong
+    chemistry."""
+
+
+class SampleBox(NamedTuple):
+    """The sampled (T, P, composition) box. Composition is
+    parameterized by fuel/air equivalence ratio ``phi`` (H2/air for the
+    h2o2/grisyn fixture family, CH4/air when the mechanism carries
+    CH4), so the box stays low-dimensional while the feature map sees
+    full log-concentration inputs. ``t_end`` is the ignition
+    integration horizon (ignition kind only)."""
+    T: Tuple[float, float] = (1250.0, 1400.0)
+    P: Tuple[float, float] = (0.9e6, 1.2e6)
+    phi: Tuple[float, float] = (0.85, 1.15)
+    t_end: float = 4e-4
+
+
+def phi_composition(mech, phi, fuel: Optional[str] = None) -> np.ndarray:
+    """Mass fractions for fuel/air at equivalence ratio(s) ``phi``
+    (batched). THE one place the fuel/air recipe lives —
+    ``benchmarks._stoich_Y0`` (and through it the loadgen samplers)
+    delegate here, so the trained feature box and the traffic the
+    samplers offer can never drift apart. ``fuel`` defaults to CH4
+    when the mechanism carries it (ch4global, GRI-3.0), else H2 (the
+    h2o2/grisyn fixture family's live chemistry)."""
+    from ..ops import thermo
+
+    names = list(mech.species_names)
+    if fuel is None:
+        fuel = "CH4" if "CH4" in names else "H2"
+    phi = np.atleast_1d(np.asarray(phi, np.float64))
+    X = np.zeros((phi.shape[0], len(names)))
+    if fuel == "CH4":
+        X[:, names.index("CH4")] = phi          # CH4 + 2 O2
+        X[:, names.index("O2")] = 2.0
+        X[:, names.index("N2")] = 7.52
+    elif fuel == "H2":
+        X[:, names.index("H2")] = 2.0 * phi     # 2 H2 + O2
+        X[:, names.index("O2")] = 1.0
+        X[:, names.index("N2")] = 3.76
+    else:
+        raise ValueError(f"unknown fuel {fuel!r}; expected CH4 or H2")
+    X = X / X.sum(axis=1, keepdims=True)
+    return np.asarray(jax.vmap(
+        lambda x: thermo.X_to_Y(mech, x))(jnp.asarray(X)))
+
+
+def sample_inputs(mech, box: SampleBox, n: int,
+                  seed: int) -> Dict[str, np.ndarray]:
+    """Deterministic input draw: uniform T and phi, log-uniform P.
+    The SAME (box, n, seed) always yields the same inputs — the
+    property the driver's bit-match resume contract rests on."""
+    rng = np.random.default_rng(seed)
+    T = rng.uniform(*box.T, size=n)
+    P = np.exp(rng.uniform(np.log(box.P[0]), np.log(box.P[1]), size=n))
+    phi = rng.uniform(*box.phi, size=n)
+    return {"T": T, "P": P, "phi": phi,
+            "Y": phi_composition(mech, phi),
+            "t_end": np.full(n, box.t_end)}
+
+
+def mech_signature(mech) -> str:
+    """Mechanism-only identity — every array leaf plus species names.
+    The serve layer compares this at model-attach time so a surrogate
+    can never answer for a mechanism it was not trained on."""
+    return checkpoint.signature("surrogate-mech", tree=mech)
+
+
+def problem_signature(mech, kind: str, box: SampleBox, n: int,
+                      seed: int,
+                      solver_kwargs: Optional[Dict] = None) -> str:
+    """The dataset's problem identity: mechanism, kind, box, draw seed
+    and size, and the labeling solver's configuration — everything that
+    determines the labels, nothing about execution layout (the
+    checkpoint discipline of :mod:`pychemkin_tpu.resilience`)."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown dataset kind {kind!r}; expected one "
+                         f"of {KINDS}")
+    kw = dict(DEFAULT_SOLVER_KWARGS[kind])
+    kw.update(solver_kwargs or {})
+    return checkpoint.config_signature(
+        "surrogate-dataset", kind, int(n), int(seed), tuple(box),
+        cfg=kw, tree=mech)
+
+
+# ---------------------------------------------------------------------------
+# labeling solvers (one jitted program per job: every driver chunk is
+# edge-padded to the same length, so the whole sweep is one compile)
+
+def _ignition_index_solve(mech, inputs, kw):
+    fn = jax.jit(lambda T, P, Y, te: reactor_ops.ignition_delay_sweep(
+        mech, "CONP", "ENRG", T, P, Y, te, **kw))
+
+    def index_solve(idx):
+        times, ok, status = fn(
+            jnp.asarray(inputs["T"][idx]), jnp.asarray(inputs["P"][idx]),
+            jnp.asarray(inputs["Y"][idx]),
+            jnp.asarray(inputs["t_end"][idx]))
+        return {"time_s": np.asarray(times), "ok": np.asarray(ok),
+                "status": np.asarray(status)}
+
+    return index_solve, ("time_s", "ok", "status")
+
+
+def _equilibrium_index_solve(mech, inputs, kw):
+    option = int(kw.pop("option", 1))
+    fn = jax.jit(jax.vmap(lambda T, P, Y: eq_ops.equilibrate(
+        mech, T, P, Y, option=option, **kw)))
+
+    def index_solve(idx):
+        res = fn(jnp.asarray(inputs["T"][idx]),
+                 jnp.asarray(inputs["P"][idx]),
+                 jnp.asarray(inputs["Y"][idx]))
+        return {"X_eq": np.asarray(res.X),
+                "residual": np.asarray(res.residual),
+                "status": np.asarray(res.status)}
+
+    return index_solve, ("X_eq", "residual", "status")
+
+
+def generate_dataset(mech, kind: str, *, n: int, seed: int = 0,
+                     box: Optional[SampleBox] = None,
+                     out_path: Optional[str] = None,
+                     checkpoint_path: Optional[str] = None,
+                     chunk_size: Optional[int] = None,
+                     solver_kwargs: Optional[Dict] = None,
+                     recorder=None, job_report: Optional[dict] = None,
+                     **driver_kwargs):
+    """Label ``n`` sampled conditions with the real solver under the
+    durable driver; returns ``(shard, report)``.
+
+    With ``out_path`` the shard is banked there atomically and — unless
+    ``checkpoint_path`` overrides — the labeling job checkpoints to
+    ``<out_path>.ck.npz``, so a SIGKILL mid-generation resumes after
+    the last banked chunk and the finished shard bit-matches an
+    uninterrupted run (``resume_count`` lands in the ``report``).
+    Driver knobs (``max_retries``, ``reexec_argv``, ...) pass through
+    ``driver_kwargs``.
+    """
+    box = box if box is not None else SampleBox()
+    sig = problem_signature(mech, kind, box, n, seed, solver_kwargs)
+    kw = dict(DEFAULT_SOLVER_KWARGS[kind])
+    kw.update(solver_kwargs or {})
+    inputs = sample_inputs(mech, box, n, seed)
+    if checkpoint_path is None and out_path is not None:
+        checkpoint_path = out_path + ".ck.npz"
+
+    # the constraint option is a LABEL-defining knob: record it before
+    # the equilibrium solver factory pops it, so it rides the shard
+    # into the trained model's meta (the serve engine refuses requests
+    # for any other option)
+    option = int(kw.get("option", 1)) if kind == "equilibrium" else -1
+    make = (_ignition_index_solve if kind == "ignition"
+            else _equilibrium_index_solve)
+    index_solve, result_keys = make(mech, inputs, kw)
+    results, report = run_vmapped_sweep_job(
+        index_solve, int(n), chunk_size=chunk_size,
+        checkpoint_path=checkpoint_path, signature=sig,
+        result_keys=result_keys, label=f"surrogate_dataset_{kind}",
+        recorder=recorder, job_report=job_report, **driver_kwargs)
+
+    shard = _build_shard(mech, kind, box, inputs, results, sig, option)
+    if out_path is not None:
+        save_shard(out_path, shard)
+    return shard, report
+
+
+def _build_shard(mech, kind, box, inputs, results, sig,
+                 option: int = -1) -> Dict:
+    feats = np.asarray(features(inputs["T"], inputs["P"], inputs["Y"]))
+    if kind == "ignition":
+        t = np.asarray(results["time_s"], np.float64)
+        valid = (np.asarray(results["ok"], bool)
+                 & (np.asarray(results["status"])
+                    == int(SolveStatus.OK))
+                 & np.isfinite(t) & (t > 0.0)
+                 & (t < inputs["t_end"]))
+        # log-time targets; invalid rows carry a placeholder the mask
+        # excludes from every consumer
+        y = np.where(valid, np.log10(np.where(valid, t, 1.0)),
+                     0.0)[:, None]
+    else:
+        X_eq = np.asarray(results["X_eq"], np.float64)
+        valid = (np.asarray(results["status"])
+                 == int(SolveStatus.OK)) & np.all(
+                     np.isfinite(X_eq), axis=1)
+        y = np.log(np.maximum(X_eq, X_FLOOR))
+    # the trained-domain box in FEATURE space: what verify.in_domain
+    # gates against — evaluated at the SAMPLED box's corners (every
+    # feature is monotone in each of T, P, phi), not the draw's
+    # min/max, so a small shard doesn't understate its coverage
+    cT, cP, cphi = (g.ravel() for g in np.meshgrid(
+        np.asarray(box.T), np.asarray(box.P), np.asarray(box.phi)))
+    corner_feats = np.asarray(
+        features(cT, cP, phi_composition(mech, cphi)))
+    lo = corner_feats.min(axis=0)
+    hi = corner_feats.max(axis=0)
+    return {
+        "v": SHARD_VERSION, "kind": kind, "sig": sig,
+        "mech_sig": mech_signature(mech),
+        "x": feats, "y": y, "valid": valid,
+        "lo": lo, "hi": hi,
+        "t_end": float(box.t_end),
+        "option": int(option),        # -1 = not an equilibrium shard
+        "status_counts": status_counts(results["status"]),
+    }
+
+
+def save_shard(path: str, shard: Dict) -> None:
+    """Atomically bank one shard (tmp + ``os.replace``). The on-disk
+    schema matches the in-memory one key for key (``status_counts``
+    rides as a JSON string) — a consumer written against
+    ``generate_dataset``'s return works unchanged on a loaded
+    shard."""
+    import json as _json
+
+    payload = {
+        "v": np.asarray(shard["v"]),
+        "kind": np.asarray(shard["kind"]),
+        "sig": np.asarray(shard["sig"]),
+        "mech_sig": np.asarray(shard["mech_sig"]),
+        "x": np.asarray(shard["x"]),
+        "y": np.asarray(shard["y"]),
+        "valid": np.asarray(shard["valid"]),
+        "lo": np.asarray(shard["lo"]),
+        "hi": np.asarray(shard["hi"]),
+        "t_end": np.asarray(shard["t_end"]),
+        "option": np.asarray(int(shard.get("option", -1))),
+        "status_counts": np.asarray(
+            _json.dumps(shard.get("status_counts", {}))),
+    }
+    telemetry.atomic_savez(path, **payload)
+
+
+def load_shard(path: str) -> Dict:
+    """Load one shard; raises on a torn/old file (a training input is
+    never an optional optimization)."""
+    import json as _json
+
+    with np.load(path, allow_pickle=False) as f:
+        if int(f["v"]) != SHARD_VERSION:
+            raise DatasetSignatureError(
+                f"shard {path} has layout version {int(f['v'])}, "
+                f"expected {SHARD_VERSION}")
+        return {"v": int(f["v"]), "kind": str(f["kind"]),
+                "sig": str(f["sig"]), "mech_sig": str(f["mech_sig"]),
+                "x": np.asarray(f["x"]), "y": np.asarray(f["y"]),
+                "valid": np.asarray(f["valid"]),
+                "lo": np.asarray(f["lo"]), "hi": np.asarray(f["hi"]),
+                "t_end": float(f["t_end"]),
+                "option": int(f["option"]),
+                "status_counts": _json.loads(str(f["status_counts"]))}
+
+
+def load_shards(paths: Sequence[str], *,
+                expect_sig: Optional[str] = None,
+                expect_mech_sig: Optional[str] = None) -> Dict:
+    """Concatenate shards into one training set.
+
+    Every shard must agree on ``kind`` and ``mech_sig`` (and match
+    ``expect_mech_sig``/``expect_sig`` when given) — the signature
+    check that stops a stale shard from training against a different
+    mechanism. Shards from DIFFERENT boxes/seeds of the same mechanism
+    concatenate fine (that is the flywheel); their feature boxes merge
+    to the union."""
+    if not paths:
+        raise ValueError("need at least one shard path")
+    shards = [load_shard(p) for p in paths]
+    first = shards[0]
+    for p, s in zip(paths, shards):
+        if s["kind"] != first["kind"]:
+            raise DatasetSignatureError(
+                f"shard {p} labels kind {s['kind']!r}, expected "
+                f"{first['kind']!r}")
+        if s["mech_sig"] != first["mech_sig"]:
+            raise DatasetSignatureError(
+                f"shard {p} was generated against a different "
+                "mechanism (mech_sig mismatch)")
+        if expect_mech_sig is not None \
+                and s["mech_sig"] != expect_mech_sig:
+            raise DatasetSignatureError(
+                f"shard {p} does not match the current mechanism "
+                "(mech_sig mismatch) — regenerate the dataset")
+        if expect_sig is not None and s["sig"] != expect_sig:
+            raise DatasetSignatureError(
+                f"shard {p} has problem signature {s['sig'][:12]}…, "
+                f"expected {expect_sig[:12]}… — different box/seed/"
+                "solver configuration")
+        if s.get("option", -1) != first.get("option", -1):
+            raise DatasetSignatureError(
+                f"shard {p} was labeled with equilibrium option "
+                f"{s.get('option')}, the first shard with "
+                f"{first.get('option')} — one model serves one "
+                "constraint pair")
+    return {
+        "kind": first["kind"],
+        "sig": first["sig"],
+        "mech_sig": first["mech_sig"],
+        "x": np.concatenate([s["x"] for s in shards]),
+        "y": np.concatenate([s["y"] for s in shards]),
+        "valid": np.concatenate([s["valid"] for s in shards]),
+        "lo": np.min(np.stack([s["lo"] for s in shards]), axis=0),
+        "hi": np.max(np.stack([s["hi"] for s in shards]), axis=0),
+        "t_end": first["t_end"],
+        "option": first.get("option", -1),
+        "n_shards": len(shards),
+    }

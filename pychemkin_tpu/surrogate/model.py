@@ -1,0 +1,203 @@
+"""Dependency-free JAX MLP surrogates: params as a plain pytree, npz
+serialization, shared feature construction.
+
+The model layer is deliberately tiny — no flax/optax/haiku (the
+container bakes in jax only, and a serving hot path wants zero extra
+import weight): a member's parameters are a list of ``(W, b)`` pairs,
+an ensemble is a tuple of members, and the whole
+:class:`SurrogateModel` (members + normalization + trained-domain box
++ problem signatures) round-trips through ONE flat ``.npz`` file with
+the same tmp+``os.replace`` atomicity as every other banked artifact.
+
+Two signatures ride inside the model and make staleness loud instead
+of silent:
+
+- ``sig``      the DATASET problem signature
+  (:func:`pychemkin_tpu.surrogate.dataset.problem_signature`): what
+  inputs/solver configuration produced the labels.
+- ``mech_sig`` the mechanism-only identity
+  (:func:`~pychemkin_tpu.surrogate.dataset.mech_signature`): the
+  serving layer refuses to attach a surrogate trained against a
+  different mechanism (see
+  :class:`pychemkin_tpu.serve.engines.SurrogateEngine`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry import atomic_savez
+
+#: model-file layout version; bump on incompatible key changes
+MODEL_VERSION = 1
+
+#: floor under mass fractions before the log-concentration features —
+#: species absent from a mixture must map to a FINITE feature value
+Y_FLOOR = 1e-12
+
+#: floor under predicted mole fractions (matches the equilibrium
+#: kernel's numerically-absent convention)
+X_FLOOR = 1e-30
+
+
+class Normalization(NamedTuple):
+    """Feature/target whitening captured at fit time (std floored so a
+    constant feature — e.g. a fixed-composition dataset's inert
+    species column — normalizes to zero instead of dividing by 0)."""
+    x_mean: Any
+    x_std: Any
+    y_mean: Any
+    y_std: Any
+
+
+class SurrogateModel(NamedTuple):
+    """A trained ensemble plus everything serving needs to trust it."""
+    kind: str                       # base request kind ("ignition", ...)
+    members: Tuple[Any, ...]        # ensemble: each a [(W, b), ...] list
+    norm: Normalization
+    lo: Any                         # [F] per-feature trained-domain min
+    hi: Any                         # [F] per-feature trained-domain max
+    sig: str                        # dataset problem signature
+    mech_sig: str                   # mechanism-only identity
+    meta: Dict[str, Any]            # extra static facts (option, t_end…)
+
+
+def features(T, P, Y):
+    """The shared surrogate feature map for (T, P, composition) boxes:
+    ``[1000/T, log10 P, log10 Y_k...]`` — Arrhenius-like inverse
+    temperature plus log-pressure plus LOG-concentration inputs (the
+    stiff-ODE DNN line's representation; arXiv:2104.01914), so targets
+    that span decades see near-linear structure. Batched over the
+    leading axis; ``Y`` is ``[..., KK]`` mass fractions."""
+    T = jnp.asarray(T, jnp.float64)
+    P = jnp.asarray(P, jnp.float64)
+    Y = jnp.asarray(Y, jnp.float64)
+    cols = [1000.0 / T, jnp.log10(P)]
+    logY = jnp.log10(jnp.maximum(Y, Y_FLOOR))
+    return jnp.concatenate(
+        [jnp.stack(cols, axis=-1), logY], axis=-1)
+
+
+def init_mlp(key, sizes: Sequence[int]) -> List[Tuple[Any, Any]]:
+    """Glorot-initialized MLP parameters for layer widths ``sizes``
+    (``[n_in, hidden..., n_out]``)."""
+    sizes = [int(s) for s in sizes]
+    if len(sizes) < 2:
+        raise ValueError(f"need at least in/out sizes, got {sizes}")
+    params = []
+    for n_in, n_out in zip(sizes[:-1], sizes[1:]):
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / (n_in + n_out))
+        W = scale * jax.random.normal(sub, (n_in, n_out), jnp.float64)
+        params.append((W, jnp.zeros((n_out,), jnp.float64)))
+    return params
+
+
+def mlp_apply(params, x):
+    """Forward pass: tanh hidden layers, linear output. ``x`` is
+    ``[..., F]`` (already normalized)."""
+    for W, b in params[:-1]:
+        x = jnp.tanh(x @ W + b)
+    W, b = params[-1]
+    return x @ W + b
+
+
+def predict(model: SurrogateModel, feats):
+    """Every ensemble member's denormalized prediction for raw
+    features ``feats`` ``[..., F]``; returns ``[M, ..., O]``. The
+    caller takes the mean as the answer and the spread as the
+    trust/disagreement signal (:mod:`.verify`)."""
+    xn = (feats - model.norm.x_mean) / model.norm.x_std
+    preds = jnp.stack([mlp_apply(m, xn) for m in model.members])
+    return preds * model.norm.y_std + model.norm.y_mean
+
+
+def layer_sizes(member) -> List[int]:
+    """Recover ``[n_in, hidden..., n_out]`` from one member's params."""
+    return [int(member[0][0].shape[0])] + [int(W.shape[1])
+                                           for W, _ in member]
+
+
+# ---------------------------------------------------------------------------
+# npz serialization (flat keys; tmp + os.replace atomicity)
+
+def _meta_items(meta: Dict[str, Any]):
+    # meta values are scalars/strings only — enough for option ids,
+    # protocol constants; anything array-shaped belongs in the dataset
+    for k, v in sorted(meta.items()):
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            yield k, v
+        else:
+            raise TypeError(
+                f"model meta value {k!r} must be a scalar, got "
+                f"{type(v).__name__}")
+
+
+def save_model(path: str, model: SurrogateModel) -> None:
+    """Atomically write the whole model to one ``.npz``."""
+    payload: Dict[str, np.ndarray] = {
+        "v": np.asarray(MODEL_VERSION),
+        "kind": np.asarray(model.kind),
+        "sig": np.asarray(model.sig),
+        "mech_sig": np.asarray(model.mech_sig),
+        "x_mean": np.asarray(model.norm.x_mean),
+        "x_std": np.asarray(model.norm.x_std),
+        "y_mean": np.asarray(model.norm.y_mean),
+        "y_std": np.asarray(model.norm.y_std),
+        "lo": np.asarray(model.lo),
+        "hi": np.asarray(model.hi),
+        "n_members": np.asarray(len(model.members)),
+    }
+    for mi, member in enumerate(model.members):
+        payload[f"m{mi}_n_layers"] = np.asarray(len(member))
+        for li, (W, b) in enumerate(member):
+            payload[f"m{mi}_W{li}"] = np.asarray(W)
+            payload[f"m{mi}_b{li}"] = np.asarray(b)
+    for k, v in _meta_items(model.meta):
+        payload[f"meta_{k}"] = np.asarray("" if v is None else v)
+    atomic_savez(path, **payload)
+
+
+def _meta_value(raw: str):
+    for cast in (int, float):
+        try:
+            return cast(raw)
+        except ValueError:
+            continue
+    if raw in ("True", "False"):
+        return raw == "True"
+    return raw or None
+
+
+def load_model(path: str) -> SurrogateModel:
+    """Load a model written by :func:`save_model`. Unlike checkpoint
+    manifests, a surrogate model is NOT an optimization — a torn or
+    wrong-version file raises (serving must fail loudly rather than
+    answer from a half-loaded net)."""
+    with np.load(path, allow_pickle=False) as f:
+        if int(f["v"]) != MODEL_VERSION:
+            raise ValueError(
+                f"surrogate model {path} has layout version "
+                f"{int(f['v'])}, expected {MODEL_VERSION}")
+        members = []
+        for mi in range(int(f["n_members"])):
+            member = []
+            for li in range(int(f[f"m{mi}_n_layers"])):
+                member.append((jnp.asarray(f[f"m{mi}_W{li}"]),
+                               jnp.asarray(f[f"m{mi}_b{li}"])))
+            members.append(member)
+        meta = {k[len("meta_"):]: _meta_value(str(f[k]))
+                for k in f.files if k.startswith("meta_")}
+        return SurrogateModel(
+            kind=str(f["kind"]), members=tuple(members),
+            norm=Normalization(
+                x_mean=jnp.asarray(f["x_mean"]),
+                x_std=jnp.asarray(f["x_std"]),
+                y_mean=jnp.asarray(f["y_mean"]),
+                y_std=jnp.asarray(f["y_std"])),
+            lo=jnp.asarray(f["lo"]), hi=jnp.asarray(f["hi"]),
+            sig=str(f["sig"]), mech_sig=str(f["mech_sig"]), meta=meta)

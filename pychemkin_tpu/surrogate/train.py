@@ -1,0 +1,158 @@
+"""Surrogate training: handwritten Adam over the plain-pytree MLP.
+
+No optimizer library — Adam is ~15 lines over ``jax.tree_util`` and
+the container bakes in jax only. The whole optimization (minibatch
+draw, value-and-grad, moment updates) is one ``lax.scan`` under
+``jit``, so even CI's tiny nets (2×32 hidden, ≤200 steps) train in
+well under a second after the one-time trace.
+
+Ensembles are M independent fits from different init/minibatch keys
+over the SAME data — the disagreement between members is the
+trust-interval signal :mod:`.verify` gates ignition predictions on
+(an out-of-distribution input pulls the members apart; in-distribution
+they collapse onto the data).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dataset import DatasetSignatureError
+from .model import Normalization, SurrogateModel, init_mlp, mlp_apply
+
+
+def _adam_update(params, grads, m, v, step, *, lr, b1=0.9, b2=0.999,
+                 eps=1e-8):
+    m = jax.tree_util.tree_map(
+        lambda mi, g: b1 * mi + (1.0 - b1) * g, m, grads)
+    v = jax.tree_util.tree_map(
+        lambda vi, g: b2 * vi + (1.0 - b2) * g * g, v, grads)
+    # bias-corrected step size folds both corrections into one scalar
+    scale = lr * jnp.sqrt(1.0 - b2 ** step) / (1.0 - b1 ** step)
+    params = jax.tree_util.tree_map(
+        lambda p, mi, vi: p - scale * mi / (jnp.sqrt(vi) + eps),
+        params, m, v)
+    return params, m, v
+
+
+def train_member(key, Xn, Yn, sizes: Sequence[int], *,
+                 steps: int = 400, lr: float = 1e-2,
+                 batch_size: Optional[int] = None,
+                 l2: float = 1e-6) -> Tuple[Any, np.ndarray]:
+    """Fit one MLP on NORMALIZED (Xn, Yn); returns ``(params,
+    per-step losses)``. Deterministic in ``key`` (init + minibatch
+    schedule both derive from it)."""
+    N = int(Xn.shape[0])
+    if N == 0:
+        raise ValueError("cannot train on an empty dataset")
+    bs = min(int(batch_size or 64), N)
+    key, init_key = jax.random.split(jnp.asarray(key))
+    params = init_mlp(init_key, sizes)
+    Xn = jnp.asarray(Xn, jnp.float64)
+    Yn = jnp.asarray(Yn, jnp.float64)
+
+    def loss_fn(p, xb, yb):
+        err = mlp_apply(p, xb) - yb
+        reg = sum(jnp.sum(W * W) for W, _ in p)
+        return jnp.mean(err * err) + l2 * reg
+
+    def step_fn(carry, step_key):
+        p, m, v, t = carry
+        idx = jax.random.randint(step_key, (bs,), 0, N)
+        loss, grads = jax.value_and_grad(loss_fn)(p, Xn[idx], Yn[idx])
+        p, m, v = _adam_update(p, grads, m, v, t + 1, lr=lr)
+        return (p, m, v, t + 1), loss
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (params, _, _, _), losses = jax.lax.scan(
+        step_fn, (params, zeros, zeros, jnp.array(0.0)),
+        jax.random.split(key, int(steps)))
+    return params, np.asarray(losses)
+
+
+def fit_surrogate(data: Dict, *, hidden: Sequence[int] = (32, 32),
+                  steps: int = 400, lr: float = 1e-2,
+                  n_members: int = 3, seed: int = 0,
+                  batch_size: Optional[int] = None,
+                  l2: float = 1e-6
+                  ) -> Tuple[SurrogateModel, List[np.ndarray]]:
+    """Fit an ensemble on a dataset/shard dict (``x``/``y``/``valid``/
+    ``lo``/``hi``/``sig``/``mech_sig``/``kind`` — the
+    :mod:`.dataset` schema); returns ``(model, loss curves)``.
+
+    Only ``valid`` rows (solver status OK) are fitted. Normalization
+    stats and the trained-domain box ride inside the returned
+    :class:`~pychemkin_tpu.surrogate.model.SurrogateModel` — the model
+    file is self-contained for serving."""
+    valid = np.asarray(data["valid"], bool)
+    X = np.asarray(data["x"], np.float64)[valid]
+    Y = np.asarray(data["y"], np.float64)[valid]
+    if X.shape[0] < 2:
+        raise DatasetSignatureError(
+            f"dataset has {X.shape[0]} valid labeled rows — not enough "
+            "to fit (check the box against the solver's ignition "
+            "horizon / convergence)")
+    # std floored: a constant feature (fixed-composition box) must
+    # normalize to zero, not divide by zero
+    x_mean, x_std = X.mean(0), np.maximum(X.std(0), 1e-8)
+    y_mean, y_std = Y.mean(0), np.maximum(Y.std(0), 1e-8)
+    Xn = (X - x_mean) / x_std
+    Yn = (Y - y_mean) / y_std
+    sizes = [X.shape[1]] + [int(h) for h in hidden] + [Y.shape[1]]
+
+    members, curves = [], []
+    for m in range(int(n_members)):
+        params, losses = train_member(
+            jax.random.PRNGKey(seed * 1000 + m), Xn, Yn, sizes,
+            steps=steps, lr=lr, batch_size=batch_size, l2=l2)
+        members.append(params)
+        curves.append(losses)
+    meta = {"t_end": data.get("t_end"), "n_train": int(X.shape[0]),
+            "hidden": ",".join(str(int(h)) for h in hidden),
+            "steps": int(steps), "seed": int(seed)}
+    if data.get("option", -1) >= 0:
+        # equilibrium: the constraint pair the labels were solved
+        # under — the serve engine refuses any other option
+        meta["option"] = int(data["option"])
+    model = SurrogateModel(
+        kind=data["kind"], members=tuple(members),
+        norm=Normalization(
+            x_mean=jnp.asarray(x_mean), x_std=jnp.asarray(x_std),
+            y_mean=jnp.asarray(y_mean), y_std=jnp.asarray(y_std)),
+        lo=jnp.asarray(data["lo"]), hi=jnp.asarray(data["hi"]),
+        sig=data["sig"], mech_sig=data["mech_sig"],
+        meta=meta)
+    return model, curves
+
+
+def training_curve_artifact(model: SurrogateModel,
+                            curves: List[np.ndarray], *,
+                            wall_s: float,
+                            max_points: int = 200) -> Dict:
+    """The JSON-ready training-curve artifact the CLI banks via
+    :func:`pychemkin_tpu.telemetry.atomic_write_json` — per-member
+    loss curves (subsampled to ``max_points``), final losses, and the
+    model's identity block."""
+    def _sub(c):
+        c = np.asarray(c, np.float64)
+        if c.shape[0] > max_points:
+            idx = np.linspace(0, c.shape[0] - 1, max_points).astype(int)
+            c = c[idx]
+        return [round(float(v), 8) for v in c]
+
+    return {
+        "tool": "train_surrogate",
+        "kind": model.kind,
+        "sig": model.sig,
+        "mech_sig": model.mech_sig,
+        "meta": model.meta,
+        "n_members": len(model.members),
+        "wall_s": round(float(wall_s), 3),
+        "final_losses": [round(float(np.asarray(c)[-1]), 8)
+                         for c in curves],
+        "curves": [_sub(c) for c in curves],
+    }

@@ -1,0 +1,156 @@
+"""Per-kind surrogate acceptance gates: the ONLY thing standing
+between a neural prediction and the client.
+
+The serving contract ("statistically fast, never wrong") rests on the
+asymmetry these gates exploit: *verifying* a candidate answer is far
+cheaper than *computing* one. Each gate returns a boolean mask per
+batch element — verified lanes answer directly, everything else is
+NaN-masked and falls through to the real solver.
+
+- **equilibrium** — physics check on the PREDICTED state, reusing the
+  element-potential formulation of
+  :mod:`pychemkin_tpu.ops.equilibrium`: at equilibrium the
+  dimensionless chemical potentials ``mu_k/RT = g_k/RT + ln x_k +
+  ln(P/Patm)`` lie exactly in the row space of the element matrix
+  (``mu = ncf @ lam`` — the condition the real Newton drives to zero),
+  and the predicted composition must conserve the inlet's element
+  moles. The gate is the abundance-weighted residual of both, one
+  weighted least-squares per element — O(KK·MM²) against the solver's
+  80 Newton iterations of Jacobian + solve.
+- **ignition delay** — no cheap physics residual exists for a scalar
+  delay, so the gate is epistemic: the input must lie inside the
+  TRAINED feature box (in-domain bound), the ensemble members must
+  agree (trust-interval disagreement in log10-time), and the predicted
+  delay must fit inside the request's integration horizon (a real
+  solve would otherwise report "not ignited", which the surrogate
+  cannot).
+
+Environment knobs (read when a gate config is built — engine
+construction time; explicit kwargs win):
+
+- ``PYCHEMKIN_SURROGATE_DOMAIN_MARGIN``  fraction of each feature's
+  trained span allowed OUTSIDE the box (default 0.0: strict).
+- ``PYCHEMKIN_SURROGATE_IGN_DISAGREE``   max ensemble std of
+  log10(delay/s) (default 0.1 ≈ ±26 %).
+- ``PYCHEMKIN_SURROGATE_IGN_TEND_FRAC``  predicted delay must be below
+  this fraction of the request's ``t_end`` (default 0.8).
+- ``PYCHEMKIN_SURROGATE_EQ_RESID``       max equilibrium
+  element-potential/element-balance residual (default 0.05).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from ..constants import P_ATM
+from ..ops import linalg, thermo
+from ..resilience.rescue import _env_float
+
+_TINY = 1e-30
+
+
+class GateConfig(NamedTuple):
+    """Resolved gate thresholds (env defaults frozen at engine build —
+    a compiled program bakes them in; rebuild the engine to re-read)."""
+    domain_margin: float = 0.0
+    ign_disagree_max: float = 0.1
+    ign_t_end_frac: float = 0.8
+    eq_resid_max: float = 0.05
+
+
+def gate_config(*, domain_margin: Optional[float] = None,
+                ign_disagree_max: Optional[float] = None,
+                ign_t_end_frac: Optional[float] = None,
+                eq_resid_max: Optional[float] = None) -> GateConfig:
+    """Thresholds from explicit kwargs, else env, else defaults."""
+    def pick(val, env, default):
+        return float(val) if val is not None \
+            else _env_float(env, default)
+
+    return GateConfig(
+        domain_margin=pick(domain_margin,
+                           "PYCHEMKIN_SURROGATE_DOMAIN_MARGIN", 0.0),
+        ign_disagree_max=pick(ign_disagree_max,
+                              "PYCHEMKIN_SURROGATE_IGN_DISAGREE", 0.1),
+        ign_t_end_frac=pick(ign_t_end_frac,
+                            "PYCHEMKIN_SURROGATE_IGN_TEND_FRAC", 0.8),
+        eq_resid_max=pick(eq_resid_max,
+                          "PYCHEMKIN_SURROGATE_EQ_RESID", 0.05))
+
+
+def in_domain(lo, hi, feats, margin: float = 0.0):
+    """Per-element mask: every feature inside the trained box,
+    stretched by ``margin`` × its span on each side. Batched over the
+    leading axis of ``feats`` [..., F]."""
+    span = jnp.maximum(hi - lo, _TINY)
+    ok = ((feats >= lo - margin * span)
+          & (feats <= hi + margin * span))
+    return jnp.all(ok, axis=-1)
+
+
+def ignition_gate(model, feats, preds_log10, t_end, cfg: GateConfig):
+    """The ignition acceptance mask. ``preds_log10`` is the ensemble's
+    per-member log10(delay/s) predictions ``[M, B]``; returns
+    ``(verified [B], disagreement [B])`` — disagreement is the
+    ensemble std in log10 units, the value the serving layer records
+    in the residual histogram."""
+    disagree = jnp.std(preds_log10, axis=0)
+    mean_log10 = jnp.mean(preds_log10, axis=0)
+    t_pred = 10.0 ** mean_log10
+    ok = (in_domain(model.lo, model.hi, feats, cfg.domain_margin)
+          & (disagree <= cfg.ign_disagree_max)
+          & (t_pred <= cfg.ign_t_end_frac * t_end)
+          & jnp.isfinite(mean_log10))
+    return ok, disagree
+
+
+def equilibrium_residual(mech, T, P, X, b):
+    """Element-potential + element-balance residual of ONE predicted
+    equilibrium state (vmap for batches).
+
+    ``X`` is the predicted mole-fraction vector, ``b`` the inlet's
+    element moles per gram. The chemical potentials of the predicted
+    state are projected onto the element matrix by abundance-weighted
+    least squares (the element-potential representation the real
+    solver iterates on); the residual combines the weighted rms of
+    what the projection cannot explain with the scaled element-balance
+    error of the predicted composition."""
+    MM = mech.ncf.shape[1]
+    X = jnp.maximum(X, 0.0)
+    X = X / jnp.maximum(jnp.sum(X), _TINY)
+    g = thermo.g_RT(mech, T)
+    mu = g + jnp.log(jnp.maximum(X, _TINY)) + jnp.log(
+        jnp.maximum(P, _TINY) / P_ATM)
+    # abundance weights: trace species carry log-floor noise, the
+    # Gibbs condition is only meaningful where moles actually are
+    W = jnp.maximum(X, 1e-6)
+    A = mech.ncf
+    AtWA = A.T @ (W[:, None] * A) + 1e-10 * jnp.eye(MM)
+    lam = linalg.solve(AtWA, A.T @ (W * mu))
+    r = mu - A @ lam
+    r_mu = jnp.sqrt(jnp.sum(W * r * r) / jnp.maximum(jnp.sum(W), _TINY))
+    # element conservation: moles of each element in the predicted
+    # composition (per gram) must match the inlet's
+    wbar = jnp.maximum(jnp.dot(X, mech.wt), _TINY)
+    b_pred = A.T @ (X / wbar)
+    b_tot = jnp.maximum(jnp.sum(b), _TINY)
+    b_scale = jnp.maximum(b, 1e-6 * b_tot)
+    r_el = jnp.sqrt(jnp.mean(((b_pred - b) / b_scale) ** 2))
+    return r_mu + r_el
+
+
+def equilibrium_gate(mech, model, feats, T, P, X_pred, b,
+                     cfg: GateConfig):
+    """The equilibrium acceptance mask (batched): in-domain AND the
+    Gibbs/element residual of the predicted state under
+    :func:`equilibrium_residual` below the threshold. Returns
+    ``(verified [B], residual [B])``."""
+    import jax
+
+    resid = jax.vmap(lambda t, p, x, bb: equilibrium_residual(
+        mech, t, p, x, bb))(T, P, X_pred, b)
+    ok = (in_domain(model.lo, model.hi, feats, cfg.domain_margin)
+          & jnp.isfinite(resid) & (resid <= cfg.eq_resid_max))
+    return ok, resid

@@ -76,14 +76,24 @@ Kinds emitted by the framework:
 
 Histograms (``MetricsRecorder.observe``; p50/p95/p99 under
 ``histograms`` in ``snapshot()``): ``serve.queue_wait_ms``,
-``serve.solve_ms``, ``serve.batch_occupancy``. The serving layer also
-maintains the ``serve.queue_depth`` gauge and ``serve.requests`` /
-``serve.rejected`` / ``serve.deadline_expired`` / ``serve.batches`` /
-``serve.rescued`` / ``serve.abandoned`` / ``serve.status.<NAME>`` /
+``serve.solve_ms``, ``serve.batch_occupancy``, and — when a surrogate
+engine serves — ``serve.surrogate.residual`` (the verification gate's
+residual / ensemble disagreement per live lane). The serving layer
+also maintains the ``serve.queue_depth`` gauge and ``serve.requests``
+/ ``serve.rejected`` / ``serve.deadline_expired`` / ``serve.batches``
+/ ``serve.rescued`` / ``serve.abandoned`` / ``serve.status.<NAME>`` /
 ``serve.compiles[.*]`` counters; the transport layer adds
-``serve.tenant_rejected[.<tenant>]`` (quota refusals) and the
-supervisor ``supervisor.respawns`` / ``supervisor.resubmits`` /
-``supervisor.backend_lost_requests``.
+``serve.tenant_rejected[.<tenant>]`` (quota refusals), the supervisor
+``supervisor.respawns`` / ``supervisor.resubmits`` /
+``supervisor.backend_lost_requests``, and the surrogate fast path
+``serve.surrogate.hit`` / ``serve.surrogate.miss`` (prediction failed
+its gate) / ``serve.surrogate.fallback`` (miss re-solved on the real
+engine) — ``hit + fallback`` accounts for every resolved surrogate
+request except a miss whose fallback could not run (rescue disabled,
+or the deadline expired before the fallback rung): those resolve
+``SURROGATE_MISS`` with a NaN value and count as neither. The fleet
+hit-rate gauge in ``tools/chemtop.py`` derives from the summed
+counters.
 
 Counters maintained on the default recorder include the pivot-free-LU
 residual-check outcomes, bridged from device via
@@ -110,6 +120,7 @@ from .recorder import (
 from .sink import (
     JsonlSink,
     append_jsonl,
+    atomic_savez,
     atomic_write_json,
     dumps_line,
     read_jsonl,
@@ -120,6 +131,7 @@ __all__ = [
     "JsonlSink",
     "MetricsRecorder",
     "append_jsonl",
+    "atomic_savez",
     "atomic_write_json",
     "configure",
     "device_counters_enabled",

@@ -67,6 +67,26 @@ def atomic_write_json(path: str, obj: Any) -> str:
     return path
 
 
+def atomic_savez(path: str, **arrays: Any) -> str:
+    """:func:`atomic_write_json`'s discipline applied to npz payloads
+    (tmp unique per process+thread, fsync'd, ``os.replace``) — the ONE
+    place the array-artifact atomicity recipe lives (checkpoint
+    manifests, surrogate shards/models). A concurrent kill leaves
+    either the old complete file or a torn tmp, never a half-written
+    ``path``."""
+    import numpy as np
+
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}.npz"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
 def append_jsonl(path: str, obj: Dict[str, Any]) -> None:
     """One-shot crash-safe append of a single event (opens/closes the
     file; use :class:`JsonlSink` for streams of events)."""

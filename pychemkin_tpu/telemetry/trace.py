@@ -36,6 +36,10 @@ Span names emitted by the framework (all carry ``trace``/``dur_ms``):
                            bucket / occupancy / compile_hit / lane /
                            status)
 ``serve.expired``          the request was dropped at the deadline gate
+``serve.surrogate``        the surrogate fast path's verdict on this
+                           request (fields: verified / residual) —
+                           emitted alongside ``serve.dispatch`` for
+                           surrogate-kind requests
 ``serve.rescue_rung``      one rescue-ladder rung re-solve (fields:
                            level / status)
 ``rescue.rung``            one batch-sweep rescue rung

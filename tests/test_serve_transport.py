@@ -487,6 +487,44 @@ class TestChemtopMerge:
         assert fleet["counters"][
             "supervisor.backend_lost_requests"] == 2
 
+    def test_surrogate_gauge_sums_and_rates(self):
+        """ISSUE-10 satellite: the fleet snapshot derives the
+        surrogate hit-rate gauge from SUMMED counters; a dead backend
+        contributes nothing (its counters never merge)."""
+        from tools import chemtop
+
+        a = self._reply(1, 10, [1.0])
+        a["counters"].update({"serve.surrogate.hit": 30,
+                              "serve.surrogate.miss": 10,
+                              "serve.surrogate.fallback": 10})
+        b = self._reply(2, 5, [2.0])
+        b["counters"].update({"serve.surrogate.hit": 10,
+                              "serve.surrogate.miss": 30,
+                              "serve.surrogate.fallback": 30})
+        dead = {"port": 3, "error": "ConnectionRefusedError: x",
+                "counters": {"serve.surrogate.hit": 999}}
+        fleet = chemtop.merge_fleet([a, b, dead])
+        sur = fleet["surrogate"]
+        assert sur["hit"] == 40 and sur["fallback"] == 40
+        assert sur["miss"] == 40
+        assert sur["hit_rate"] == 0.5      # 40 / (40 + 40), not 999
+        # the gauge renders
+        assert "surrogate: hit 40" in chemtop.render(fleet)
+        assert "hit_rate 50.0%" in chemtop.render(fleet)
+
+    def test_surrogate_gauge_no_traffic_is_null(self):
+        """Zero surrogate traffic (or an all-dead fleet) yields a null
+        hit rate, never a division crash, and render stays quiet."""
+        from tools import chemtop
+
+        fleet = chemtop.merge_fleet([self._reply(1, 4, [1.0])])
+        assert fleet["surrogate"] == {"hit": 0, "miss": 0,
+                                      "fallback": 0, "hit_rate": None}
+        assert "surrogate:" not in chemtop.render(fleet)
+        dead_fleet = chemtop.merge_fleet(
+            [{"port": 9, "error": "TimeoutError: x"}])
+        assert dead_fleet["surrogate"]["hit_rate"] is None
+
 
 # ---------------------------------------------------------------------------
 # the supervisor over a stdlib-only fake backend (no jax in children)

@@ -1,0 +1,871 @@
+"""Neural surrogate fast path (ISSUE 10): model/train/dataset units,
+verification gates, the serve-layer SurrogateEngine acceptance
+contract, engine-registry pluggability, and dataset durability under
+process chaos.
+
+Everything in the fast lane uses TINY nets (<= 2x32 hidden, <= 200
+Adam steps) and the h2o2 mechanism so the whole file fits the tier-1
+wall budget; the loadgen soak variant is slow-marked.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu import serve, surrogate as sg, telemetry
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import equilibrium as eq_ops
+from pychemkin_tpu.resilience import checkpoint
+from pychemkin_tpu.resilience.status import SolveStatus
+from pychemkin_tpu.serve import engines as serve_engines
+from pychemkin_tpu.serve import loadgen
+from pychemkin_tpu.serve.futures import make_result
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: labeling solver knobs shared by every fixture (the serve protocol's)
+IGN_CFG = {"rtol": 1e-6, "atol": 1e-10, "max_steps_per_segment": 4000}
+
+#: the fast-lane training box (matches the default SampleBox so the
+#: default loadgen ignition sampler draws in-domain)
+BOX = sg.SampleBox()
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def ign_data(mech):
+    shard, report = sg.generate_dataset(
+        mech, "ignition", n=48, seed=0, box=BOX, chunk_size=48,
+        solver_kwargs=IGN_CFG)
+    assert report.resume_count == 0
+    return shard
+
+
+@pytest.fixture(scope="module")
+def ign_model(ign_data):
+    model, curves = sg.fit_surrogate(
+        ign_data, hidden=(16, 16), steps=200, n_members=2, seed=0)
+    return model
+
+
+@pytest.fixture(scope="module")
+def eq_data(mech):
+    shard, _ = sg.generate_dataset(
+        mech, "equilibrium", n=32, seed=0, box=BOX, chunk_size=16)
+    return shard
+
+
+@pytest.fixture(scope="module")
+def eq_model(eq_data):
+    model, _ = sg.fit_surrogate(
+        eq_data, hidden=(16, 16), steps=200, n_members=2, seed=0)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# model: init/apply/predict + npz round-trip
+
+
+class TestModel:
+    def test_init_and_apply_shapes(self):
+        params = sg.init_mlp(jax.random.PRNGKey(0), [3, 8, 2])
+        assert [W.shape for W, _ in params] == [(3, 8), (8, 2)]
+        out = sg.mlp_apply(params, jnp.ones((5, 3)))
+        assert out.shape == (5, 2)
+
+    def test_features_shape_and_floor(self, mech):
+        KK = mech.n_species
+        Y = np.zeros((4, KK))        # all-absent species must stay
+        Y[:, 0] = 1.0                # finite through the log
+        f = np.asarray(sg.features(np.full(4, 1300.0),
+                                   np.full(4, 1e6), Y))
+        assert f.shape == (4, KK + 2)
+        assert np.all(np.isfinite(f))
+
+    def test_save_load_roundtrip_bit_exact(self, tmp_path, ign_model):
+        path = str(tmp_path / "model.npz")
+        sg.save_model(path, ign_model)
+        loaded = sg.load_model(path)
+        assert loaded.kind == ign_model.kind
+        assert loaded.sig == ign_model.sig
+        assert loaded.mech_sig == ign_model.mech_sig
+        assert loaded.meta["n_train"] == ign_model.meta["n_train"]
+        for a, b in zip(jax.tree_util.tree_leaves(loaded.members),
+                        jax.tree_util.tree_leaves(ign_model.members)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(loaded.lo),
+                                      np.asarray(ign_model.lo))
+        # predictions are bit-identical through the round-trip
+        feats = jnp.asarray(np.asarray(ign_model.lo)[None, :])
+        np.testing.assert_array_equal(
+            np.asarray(sg.predict(loaded, feats)),
+            np.asarray(sg.predict(ign_model, feats)))
+
+    def test_wrong_version_refuses(self, tmp_path, ign_model):
+        path = str(tmp_path / "model.npz")
+        sg.save_model(path, ign_model)
+        with np.load(path) as f:
+            payload = {k: f[k] for k in f.files}
+        payload["v"] = np.asarray(99)
+        np.savez(path, **payload)
+        with pytest.raises(ValueError, match="layout version"):
+            sg.load_model(path)
+
+
+class TestTrain:
+    def test_loss_decreases_and_seed_determinism(self):
+        rng = np.random.default_rng(0)
+        X = rng.uniform(-1, 1, (256, 2))
+        Y = (np.sin(3 * X[:, :1]) + X[:, 1:] ** 2)
+        data = {"kind": "ignition", "sig": "s", "mech_sig": "m",
+                "x": X, "y": Y, "valid": np.ones(256, bool),
+                "lo": X.min(0), "hi": X.max(0), "t_end": 1.0}
+        m1, c1 = sg.fit_surrogate(data, hidden=(16,), steps=150,
+                                  n_members=2, seed=0)
+        assert np.mean(c1[0][-10:]) < np.mean(c1[0][:10]) / 5
+        m2, _ = sg.fit_surrogate(data, hidden=(16,), steps=150,
+                                 n_members=2, seed=0)
+        for a, b in zip(jax.tree_util.tree_leaves(m1.members),
+                        jax.tree_util.tree_leaves(m2.members)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # members start from different keys -> different params
+        w_a = np.asarray(m1.members[0][0][0])
+        w_b = np.asarray(m1.members[1][0][0])
+        assert not np.array_equal(w_a, w_b)
+
+    def test_empty_dataset_refuses(self):
+        data = {"kind": "ignition", "sig": "s", "mech_sig": "m",
+                "x": np.zeros((4, 2)), "y": np.zeros((4, 1)),
+                "valid": np.zeros(4, bool), "lo": np.zeros(2),
+                "hi": np.ones(2), "t_end": 1.0}
+        with pytest.raises(sg.DatasetSignatureError,
+                           match="valid labeled rows"):
+            sg.fit_surrogate(data, steps=10)
+
+
+# ---------------------------------------------------------------------------
+# dataset: determinism, shard banking, signatures, driver durability
+
+
+class TestDataset:
+    def test_sample_inputs_deterministic(self, mech):
+        a = sg.sample_inputs(mech, BOX, 16, seed=3)
+        b = sg.sample_inputs(mech, BOX, 16, seed=3)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+        c = sg.sample_inputs(mech, BOX, 16, seed=4)
+        assert not np.array_equal(a["T"], c["T"])
+
+    def test_shard_schema_and_roundtrip(self, tmp_path, mech, eq_data):
+        assert eq_data["kind"] == "equilibrium"
+        assert eq_data["x"].shape[0] == 32
+        assert eq_data["y"].shape == (32, mech.n_species)
+        assert eq_data["valid"].dtype == bool
+        path = str(tmp_path / "shard.npz")
+        sg.save_shard(path, eq_data)
+        loaded = sg.load_shard(path)
+        np.testing.assert_array_equal(loaded["x"], eq_data["x"])
+        np.testing.assert_array_equal(loaded["y"], eq_data["y"])
+        assert loaded["sig"] == eq_data["sig"]
+        # the on-disk schema matches the in-memory one key for key
+        assert loaded["option"] == eq_data["option"] == 1
+        assert loaded["status_counts"] == eq_data["status_counts"]
+        assert loaded["status_counts"].get("OK", 0) > 0
+
+    def test_ignition_targets_are_log_time(self, ign_data):
+        valid = ign_data["valid"]
+        assert valid.sum() >= 40          # the box is designed to ignite
+        y = ign_data["y"][valid][:, 0]
+        # h2o2 in this box ignites in ~1e-5..4e-4 s
+        assert np.all((y > -6.0) & (y < -3.0))
+
+    def test_problem_signature_sensitivity(self, mech):
+        base = sg.problem_signature(mech, "ignition", BOX, 32, 0)
+        assert sg.problem_signature(mech, "ignition", BOX, 32, 1) != base
+        assert sg.problem_signature(mech, "equilibrium", BOX, 32,
+                                    0) != base
+        other_box = sg.SampleBox(T=(900.0, 1000.0))
+        assert sg.problem_signature(mech, "ignition", other_box, 32,
+                                    0) != base
+        with pytest.raises(ValueError, match="unknown dataset kind"):
+            sg.problem_signature(mech, "flame", BOX, 32, 0)
+
+    def test_load_shards_concat_and_reject(self, tmp_path, mech,
+                                           eq_data):
+        a = str(tmp_path / "a.npz")
+        sg.save_shard(a, eq_data)
+        both = sg.load_shards([a, a])
+        assert both["x"].shape[0] == 64
+        assert both["n_shards"] == 2
+        # wrong expected problem signature -> typed refusal
+        with pytest.raises(sg.DatasetSignatureError,
+                           match="problem signature"):
+            sg.load_shards([a], expect_sig="deadbeef")
+        # mechanism swap -> typed refusal (the stale-dataset guard)
+        with pytest.raises(sg.DatasetSignatureError,
+                           match="mech_sig"):
+            sg.load_shards([a], expect_mech_sig="not-this-mech")
+        doctored = dict(eq_data)
+        doctored["mech_sig"] = "other"
+        b = str(tmp_path / "b.npz")
+        sg.save_shard(b, doctored)
+        with pytest.raises(sg.DatasetSignatureError,
+                           match="different *mechanism"):
+            sg.load_shards([a, b])
+
+    def test_equilibrium_option_rides_shard_into_model(self, tmp_path,
+                                                       mech):
+        """A non-default constraint option is a label-defining knob:
+        it must ride the shard into the trained model's meta, and the
+        serve engine (which passes (T,P) through and gates at the
+        request's (T,P) — an option-1 assumption) must REFUSE such a
+        model instead of silently serving wrong-option predictions."""
+        shard, _ = sg.generate_dataset(
+            mech, "equilibrium", n=8, seed=0, box=BOX, chunk_size=8,
+            solver_kwargs={"option": 2})
+        assert shard["option"] == 2
+        model, _ = sg.fit_surrogate(shard, hidden=(8,), steps=20,
+                                    n_members=1, seed=0)
+        assert model.meta["option"] == 2
+        with pytest.raises(ValueError, match="only option 1"):
+            serve_engines.EquilibriumSurrogateEngine(
+                mech, telemetry.MetricsRecorder(), model=model)
+        # mixing shards of different options is refused at load
+        a = str(tmp_path / "opt2.npz")
+        sg.save_shard(a, shard)
+        b = str(tmp_path / "opt1.npz")
+        shard1, _ = sg.generate_dataset(
+            mech, "equilibrium", n=8, seed=0, box=BOX, chunk_size=8)
+        sg.save_shard(b, shard1)
+        with pytest.raises(sg.DatasetSignatureError,
+                           match="equilibrium option"):
+            sg.load_shards([a, b])
+
+    def test_resume_short_circuit_bit_matches(self, tmp_path, mech):
+        """A complete checkpoint resumes as a pure short-circuit: the
+        rerun adopts every banked element verbatim and the shard is
+        bit-identical."""
+        out1 = str(tmp_path / "s1.npz")
+        ck = str(tmp_path / "job.ck.npz")
+        shard1, rep1 = sg.generate_dataset(
+            mech, "equilibrium", n=12, seed=0, box=BOX, chunk_size=4,
+            out_path=out1, checkpoint_path=ck)
+        assert rep1.resume_count == 0 and rep1.chunks_run == 3
+        out2 = str(tmp_path / "s2.npz")
+        shard2, rep2 = sg.generate_dataset(
+            mech, "equilibrium", n=12, seed=0, box=BOX, chunk_size=4,
+            out_path=out2, checkpoint_path=ck)
+        assert rep2.resume_count == 1 and rep2.chunks_run == 0
+        for k in ("x", "y", "valid", "lo", "hi"):
+            np.testing.assert_array_equal(shard1[k], shard2[k])
+        assert shard1["sig"] == shard2["sig"]
+
+
+# real-process chaos: SIGKILL mid-generation, resume, bit-match
+# (satellite: dataset durability; ISSUE-10 acceptance criterion)
+
+_GEN_SCRIPT = textwrap.dedent(f"""
+    import json, sys
+    sys.path.insert(0, {PKG_ROOT!r})
+    from pychemkin_tpu.mechanism import load_embedded
+    from pychemkin_tpu import surrogate as sg
+
+    mech = load_embedded("h2o2")
+    shard, rep = sg.generate_dataset(
+        mech, "equilibrium", n=12, seed=0, chunk_size=4,
+        out_path=sys.argv[1], checkpoint_path=sys.argv[2])
+    print(json.dumps({{"resume_count": rep.resume_count,
+                       "chunks_run": rep.chunks_run,
+                       "sig": shard["sig"]}}))
+""")
+
+
+def _child_env(**extra):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(JAX_PLATFORMS="cpu", **extra)
+    return env
+
+
+def _run_gen(tmp_path, out, ck, faults=None, timeout=300):
+    script = tmp_path / "gen_job.py"
+    script.write_text(_GEN_SCRIPT)
+    env = _child_env()
+    if faults is not None:
+        env["PYCHEMKIN_PROC_FAULTS"] = json.dumps(faults)
+    return subprocess.run(
+        [sys.executable, str(script), out, ck],
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+class TestDatasetChaos:
+    def test_sigkill_resume_bit_matches_uninterrupted(self, tmp_path):
+        """SIGKILL the generation job mid-sweep (after chunk 1 banks),
+        resume it, and the finished shard must BIT-match an
+        uninterrupted run's — with resume_count == 1 in the report."""
+        out = str(tmp_path / "interrupted.npz")
+        ck = str(tmp_path / "job.ck.npz")
+        r = _run_gen(tmp_path, out, ck,
+                     faults=[{"mode": "kill_at_chunk", "chunk": 1}])
+        assert r.returncode == -signal.SIGKILL, r.stderr
+        assert not os.path.exists(out)        # died before the shard
+        assert checkpoint.peek(ck)["done_upto"] == 8
+        r2 = _run_gen(tmp_path, out, ck)
+        assert r2.returncode == 0, r2.stderr
+        rep = json.loads(r2.stdout.strip().splitlines()[-1])
+        assert rep["resume_count"] == 1
+        assert rep["chunks_run"] == 1         # only the tail chunk
+        out_ref = str(tmp_path / "clean.npz")
+        r3 = _run_gen(tmp_path, out_ref, str(tmp_path / "ref.ck.npz"))
+        assert r3.returncode == 0, r3.stderr
+        got = sg.load_shard(out)
+        ref = sg.load_shard(out_ref)
+        for k in ("x", "y", "valid", "lo", "hi"):
+            np.testing.assert_array_equal(got[k], ref[k])
+        assert got["sig"] == ref["sig"]
+
+    def test_mech_swap_rejected_by_signature(self, tmp_path, mech,
+                                             eq_data):
+        """A banked shard refuses to train against a different
+        mechanism: the expect check raises the typed error."""
+        path = str(tmp_path / "shard.npz")
+        sg.save_shard(path, eq_data)
+        grisyn = load_embedded("grisyn")
+        with pytest.raises(sg.DatasetSignatureError, match="mech_sig"):
+            sg.load_shards(
+                [path], expect_mech_sig=sg.mech_signature(grisyn))
+        # and the serve layer refuses to ATTACH a swapped-mech model
+        model, _ = sg.fit_surrogate(eq_data, hidden=(8,), steps=20,
+                                    n_members=1, seed=0)
+        with pytest.raises(sg.DatasetSignatureError,
+                           match="different chemistry"):
+            serve_engines.EquilibriumSurrogateEngine(
+                grisyn, telemetry.MetricsRecorder(), model=model)
+
+
+# ---------------------------------------------------------------------------
+# verification gates
+
+
+class TestVerify:
+    def test_in_domain_box_and_margin(self):
+        lo = jnp.asarray([0.0, 0.0])
+        hi = jnp.asarray([1.0, 2.0])
+        feats = jnp.asarray([[0.5, 1.0], [1.05, 1.0], [-0.2, 1.0]])
+        np.testing.assert_array_equal(
+            np.asarray(sg.in_domain(lo, hi, feats)),
+            [True, False, False])
+        np.testing.assert_array_equal(
+            np.asarray(sg.in_domain(lo, hi, feats, margin=0.1)),
+            [True, True, False])
+
+    def test_gate_config_env_and_override(self, monkeypatch):
+        monkeypatch.setenv("PYCHEMKIN_SURROGATE_IGN_DISAGREE", "0.02")
+        monkeypatch.setenv("PYCHEMKIN_SURROGATE_DOMAIN_MARGIN", "0.05")
+        cfg = sg.gate_config()
+        assert cfg.ign_disagree_max == 0.02
+        assert cfg.domain_margin == 0.05
+        assert cfg.eq_resid_max == 0.05            # default
+        cfg2 = sg.gate_config(ign_disagree_max=0.5)
+        assert cfg2.ign_disagree_max == 0.5        # kwarg wins
+
+    def test_ignition_gate_rules(self, ign_model):
+        model = ign_model
+        F = int(np.asarray(model.lo).shape[0])
+        mid = 0.5 * (np.asarray(model.lo) + np.asarray(model.hi))
+        feats = jnp.asarray(np.stack([mid, mid, mid,
+                                      mid + 100.0]))   # last: OOD
+        # members: rows agree except element 1 (disagreement) and
+        # element 2 (prediction beyond the horizon)
+        preds = jnp.asarray([[-4.0, -4.0, -1.0, -4.0],
+                             [-4.0, -3.0, -1.0, -4.0]])
+        t_end = jnp.full(4, 4e-4)
+        cfg = sg.GateConfig()
+        ok, dis = sg.ignition_gate(model, feats, preds, t_end, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(ok), [True, False, False, False])
+        assert float(dis[1]) == pytest.approx(0.5)
+
+    def test_equilibrium_residual_separates_truth(self, mech):
+        T, P = 1500.0, 1.01325e6
+        Y = sg.phi_composition(mech, 1.0)[0]
+        b = eq_ops.element_moles(mech, jnp.asarray(Y))
+        res = eq_ops.equilibrate(mech, T, P, jnp.asarray(Y), option=1)
+        r_true = float(sg.equilibrium_residual(
+            mech, res.T, res.P, res.X, b))
+        assert r_true < 1e-3
+        # deplete the major product (H2O): both the Gibbs condition
+        # and the element balance must light up
+        X_bad = np.asarray(res.X).copy()
+        X_bad[list(mech.species_names).index("H2O")] *= 0.7
+        X_bad /= X_bad.sum()
+        r_bad = float(sg.equilibrium_residual(
+            mech, res.T, res.P, jnp.asarray(X_bad), b))
+        assert r_bad > 10 * r_true
+        assert r_bad > 0.05        # fails the default gate
+
+
+# ---------------------------------------------------------------------------
+# engine registry pluggability (satellite)
+
+
+class TestEngineRegistry:
+    def test_builtins_and_surrogates_registered(self):
+        kinds = serve.registered_kinds()
+        for k in ("ignition", "equilibrium", "psr",
+                  "surrogate_ignition", "surrogate_equilibrium"):
+            assert k in kinds
+
+    def test_duplicate_kind_rejected_typed(self):
+        with pytest.raises(serve.DuplicateEngineKindError,
+                           match="already registered"):
+            serve.register_engine("ignition", object)
+        # the original stays in place
+        assert serve.ENGINE_TYPES["ignition"] \
+            is serve_engines.IgnitionEngine
+
+    def test_replace_and_restore(self):
+        sentinel = object()
+        serve.register_engine("ignition", sentinel, replace=True)
+        try:
+            assert serve.ENGINE_TYPES["ignition"] is sentinel
+        finally:
+            serve.register_engine(
+                "ignition", serve_engines.IgnitionEngine, replace=True)
+
+    def test_bad_kind_rejected(self):
+        with pytest.raises(ValueError, match="non-empty string"):
+            serve.register_engine("", object)
+
+    def test_zero_config_kinds_follow_registry(self):
+        """The no-arg warmup fallback set is derived from the
+        registry (ctor.zero_config), not a hardcoded list — a plugin
+        registering a zero-config kind is warmable by default, and
+        the model-requiring surrogates opt out."""
+        kinds = serve_engines.zero_config_kinds()
+        assert set(kinds) >= {"equilibrium", "ignition", "psr"}
+        assert not any(k.startswith("surrogate_") for k in kinds)
+
+        class _Plugin:
+            zero_config = True
+
+        serve.register_engine("plugin_kind", _Plugin)
+        try:
+            assert "plugin_kind" in serve_engines.zero_config_kinds()
+        finally:
+            del serve.ENGINE_TYPES["plugin_kind"]
+
+
+# ---------------------------------------------------------------------------
+# loadgen coverage of surrogate kinds (satellite)
+
+
+class _FakeFuture:
+    def __init__(self, result):
+        self._r = result
+
+    def result(self, timeout=None):
+        return self._r
+
+    def add_done_callback(self, cb):
+        cb(self)
+
+
+class _FakeServer:
+    """Duck-typed server: surrogate kinds alternate hit/fallback."""
+
+    def __init__(self):
+        self.n = 0
+        self.kinds = []
+
+    def submit(self, kind, trace_id=None, deadline_ms=None, **payload):
+        self.n += 1
+        self.kinds.append(kind)
+        fallback = kind.startswith("surrogate_") and self.n % 3 == 0
+        res = make_result(
+            {"surrogate": not fallback}, 0, kind=kind, bucket=1,
+            occupancy=1, queue_wait_ms=0.1, solve_ms=0.5,
+            rescued=fallback, rescue_rungs=1 if fallback else 0)
+        return _FakeFuture(res)
+
+
+class TestLoadgenSurrogate:
+    def test_default_samplers_cover_surrogate_kinds(self, mech):
+        kinds = ["ignition", "surrogate_ignition",
+                 "surrogate_equilibrium", "surrogate_psr"]
+        samplers = loadgen.default_samplers(mech, kinds)
+        rng = np.random.default_rng(0)
+        drawn = [s(0, rng)[0] for s in samplers]
+        assert drawn == kinds
+        # surrogate payloads speak the base schema
+        _, payload = samplers[1](0, rng)
+        assert set(payload) == {"T0", "P0", "Y0", "t_end"}
+        _, payload = samplers[2](0, rng)
+        assert set(payload) == {"T", "P", "Y", "option"}
+        with pytest.raises(ValueError, match="no default sampler"):
+            loadgen.default_samplers(mech, ["surrogate_flame"])
+
+    def test_run_load_counts_hits_and_fallbacks(self):
+        samplers = [lambda i, rng: ("surrogate_ignition", {}),
+                    lambda i, rng: ("ignition", {})]
+        server = _FakeServer()
+        summary = loadgen.run_load(
+            server, samplers, rate_hz=1e5, n_requests=30,
+            rng=np.random.default_rng(0))
+        assert summary["n_served"] == 30
+        assert summary["n_surrogate_fallback"] > 0
+        assert summary["n_surrogate_hit"] > 0
+        # every resolved surrogate request is exactly one of the two
+        n_sur_submitted = sum(
+            1 for k in server.kinds if k.startswith("surrogate_"))
+        assert (summary["n_surrogate_hit"]
+                + summary["n_surrogate_fallback"]) == n_sur_submitted
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE-10 end-to-end serve acceptance (fast lane, chaos-free)
+
+
+def _mixed_stream(server, mech, n_in=12, n_out=4, seed=7):
+    """Submit a mixed in-domain / out-of-domain surrogate_ignition
+    stream; returns [(tag, payload, future)]. Out-of-domain requests
+    leave the COMPOSITION box (phi 2.0, far above the trained 1.15) —
+    the log-concentration features catch it, while T0 stays in a range
+    the real-engine fallback solves quickly."""
+    rng = np.random.default_rng(seed)
+    subs = []
+    for _ in range(n_in):
+        subs.append(("in", dict(
+            T0=float(rng.uniform(*BOX.T)), P0=1.01325e6,
+            Y0=sg.phi_composition(mech, float(rng.uniform(0.9, 1.1))
+                                  )[0],
+            t_end=BOX.t_end)))
+    for _ in range(n_out):
+        subs.append(("out", dict(
+            T0=float(rng.uniform(*BOX.T)), P0=1.01325e6,
+            Y0=sg.phi_composition(mech, 2.0)[0], t_end=BOX.t_end)))
+    out = []
+    for tag, payload in subs:
+        out.append((tag, payload,
+                    server.submit("surrogate_ignition", **payload)))
+    return out
+
+
+def _counter_delta(rec, before, name):
+    return rec.snapshot()["counters"].get(name, 0) - before.get(name, 0)
+
+
+class TestServeAcceptance:
+    """ISSUE-10 acceptance: trained h2o2 surrogate engine, mixed
+    stream, (a) every surrogate answer passed its gate, (b) every miss
+    fell through to the real engine and bit-matches solve_direct at
+    the same bucket, (c) zero unverified surrogate values returned,
+    (d) hit + fallback == n_requests in the recorder."""
+
+    @pytest.fixture(scope="class")
+    def served(self, mech, ign_model):
+        # one warmed, started server for the whole class (warmup
+        # compiles the stiff integrator — too heavy per-test); tests
+        # account against counter DELTAS
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 8), max_batch_size=8,
+            max_delay_ms=5.0, recorder=rec,
+            engine_config={"ignition": IGN_CFG})
+        base = server.engine("ignition")
+        server.configure_engine("surrogate_ignition", model=ign_model,
+                                base_engine=base)
+        server.warmup(["ignition", "surrogate_ignition"])
+        server.start()
+        yield server
+        server.close()
+
+    def test_mixed_stream_contract(self, mech, served):
+        before = dict(served.snapshot()["counters"])
+        results = [(tag, payload, fut.result(timeout=300))
+                   for tag, payload, fut in
+                   _mixed_stream(served, mech)]
+        n_requests = len(results)
+        hits = [(p, r) for _, p, r in results if r.rescue_rungs == 0]
+        falls = [(p, r) for _, p, r in results if r.rescue_rungs > 0]
+        assert len(hits) + len(falls) == n_requests
+        # every out-of-domain request fell through; the in-domain box
+        # was trained exactly here, so hits dominate
+        assert all(r.rescue_rungs > 0
+                   for tag, _, r in results if tag == "out")
+        assert len(hits) >= 8
+        # (a) every surrogate-answered request passed its gate: OK
+        # status and the verified marker
+        for _, r in hits:
+            assert r.ok and r.status == int(SolveStatus.OK)
+            assert r.value["surrogate"] is True
+            assert np.isfinite(r.value["ignition_delay_ms"])
+        # (b) every miss re-solved on the REAL engine, bit-matching
+        # solve_direct at the same bucket (1); and (c) no unverified
+        # surrogate value leaked — the fallback value is the solver's
+        for p, r in falls:
+            assert r.value.get("surrogate", False) is False
+            ref = served.solve_direct("ignition", bucket=1, **p)
+            assert r.value["ignition_time_s"] \
+                == ref.value["ignition_time_s"]
+            assert r.status == ref.status
+            assert r.rescued and r.rescue_rungs == 1
+        # (d) the recorder's books balance over this stream
+        d_hit = _counter_delta(served._rec, before,
+                               "serve.surrogate.hit")
+        d_fall = _counter_delta(served._rec, before,
+                                "serve.surrogate.fallback")
+        d_miss = _counter_delta(served._rec, before,
+                                "serve.surrogate.miss")
+        assert d_hit + d_fall == n_requests
+        assert d_hit == len(hits)
+        assert d_miss == len(falls)
+        # the residual histogram observed live lanes (warmup excluded)
+        hist = served.snapshot()["histograms"].get(
+            "serve.surrogate.residual")
+        assert hist and hist["count"] >= n_requests
+
+    def test_surrogate_trace_span(self, mech, served):
+        Y0 = sg.phi_composition(mech, 1.0)[0]
+        fut = served.submit("surrogate_ignition", trace_id="t0001",
+                            T0=1300.0, P0=1.01325e6, Y0=Y0,
+                            t_end=BOX.t_end)
+        res = fut.result(timeout=120)
+        assert res.ok
+        spans = [e for e in served._rec.events("trace.span")
+                 if e["trace"] == "t0001"]
+        names = {e["span"] for e in spans}
+        assert "serve.surrogate" in names
+        sur = [e for e in spans if e["span"] == "serve.surrogate"][0]
+        assert sur["verified"] is True
+        assert sur["residual"] >= 0.0
+
+    def test_surrogate_dispatches_at_tiny_buckets(self, mech,
+                                                  ign_model):
+        """The surrogate engine's declared ladder pads a 3-request
+        batch to bucket 4, not the server ladder's 8 (submits queue
+        BEFORE start, so one batch adopts all three)."""
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 8), max_batch_size=8,
+            max_delay_ms=5.0, recorder=telemetry.MetricsRecorder(),
+            engine_config={"ignition": IGN_CFG})
+        server.configure_engine("surrogate_ignition", model=ign_model,
+                                base_engine=server.engine("ignition"))
+        eng = server.engine("surrogate_ignition")
+        assert eng.bucket_ladder == (1, 4, 8, 16)
+        Y0 = sg.phi_composition(mech, 1.0)[0]
+        futs = [server.submit("surrogate_ignition", T0=t,
+                              P0=1.01325e6, Y0=Y0, t_end=BOX.t_end)
+                for t in (1300.0, 1310.0, 1320.0)]
+        with server:
+            results = [f.result(timeout=120) for f in futs]
+        assert [r.occupancy for r in results] == [3, 3, 3]
+        assert {r.bucket for r in results} == {4}
+
+    def test_share_base_kind_resolves_to_server_engine(self, mech,
+                                                       ign_model):
+        """The JSON-safe sharing key: engine_config can name the base
+        KIND instead of passing an instance, and the server resolves
+        it to its own (lazily built) engine — the wiring a transport
+        backend's wire config uses."""
+        server = serve.ChemServer(
+            mech, recorder=telemetry.MetricsRecorder(),
+            engine_config={
+                "ignition": IGN_CFG,
+                "surrogate_ignition": {
+                    "model": ign_model,
+                    "share_base_kind": "ignition"}})
+        sur = server.engine("surrogate_ignition")
+        assert sur.base is server.engine("ignition")
+
+    def test_warming_surrogate_warms_base_fallback(self, mech,
+                                                   ign_model):
+        """Warming ONLY the surrogate kind must also compile the base
+        engine's bucket-1 fallback program — the first miss costs a
+        batch window, never a stiff-integrator compile inside the
+        rescue thread (zero recompiles after warmup, miss included)."""
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 8), max_batch_size=8,
+            max_delay_ms=2.0, recorder=rec,
+            engine_config={"ignition": IGN_CFG})
+        server.configure_engine(
+            "surrogate_ignition", model=ign_model,
+            base_engine=server.engine("ignition"))
+        server.warmup(["surrogate_ignition"])     # base NOT listed
+        compiles_after_warmup = rec.snapshot()["counters"].get(
+            "serve.compiles", 0)
+        assert rec.snapshot()["counters"].get(
+            "serve.compiles.ignition", 0) >= 1    # the fallback rung
+        Y0 = sg.phi_composition(mech, 2.0)[0]     # composition OOD
+        with server:
+            res = server.submit(
+                "surrogate_ignition", T0=1300.0, P0=1.01325e6, Y0=Y0,
+                t_end=BOX.t_end).result(timeout=120)
+        assert res.rescued and res.rescue_rungs == 1
+        assert rec.snapshot()["counters"].get(
+            "serve.compiles", 0) == compiles_after_warmup
+
+    def test_unverified_value_is_nan_even_without_rescue(
+            self, mech, ign_model):
+        """Belt and braces for 'no unverified answer ever leaves':
+        with the rescue ladder disabled, a miss resolves with
+        SURROGATE_MISS as data and a NaN value — never the raw
+        prediction."""
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 8), max_batch_size=8,
+            max_delay_ms=2.0, rescue=False, recorder=rec,
+            engine_config={"ignition": IGN_CFG})
+        server.configure_engine("surrogate_ignition", model=ign_model,
+                                base_engine=server.engine("ignition"))
+        Y0 = sg.phi_composition(mech, 2.0)[0]    # composition OOD
+        with server:
+            fut = server.submit("surrogate_ignition", T0=1300.0,
+                                P0=1.01325e6, Y0=Y0, t_end=BOX.t_end)
+            res = fut.result(timeout=120)
+        assert res.status == int(SolveStatus.SURROGATE_MISS)
+        assert res.status_name == "SURROGATE_MISS"
+        assert not res.ok
+        assert res.value["surrogate"] is False
+        assert np.isnan(res.value["ignition_time_s"])
+
+
+class TestEquilibriumSurrogateServe:
+    def test_hits_and_fallbacks(self, mech, eq_model):
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 8), max_batch_size=8,
+            max_delay_ms=5.0, recorder=rec)
+        base = server.engine("equilibrium")
+        server.configure_engine("surrogate_equilibrium",
+                                model=eq_model, base_engine=base)
+        server.warmup(["equilibrium", "surrogate_equilibrium"])
+        Y0 = sg.phi_composition(mech, 1.0)[0]
+        rng = np.random.default_rng(5)
+        with server:
+            in_futs = [(dict(T=float(rng.uniform(*BOX.T)),
+                             P=1.01325e6, Y=Y0), None)
+                       for _ in range(6)]
+            in_futs = [(p, server.submit("surrogate_equilibrium", **p))
+                       for p, _ in in_futs]
+            # far outside the trained temperature box
+            out_p = dict(T=2600.0, P=1.01325e6, Y=Y0)
+            out_fut = server.submit("surrogate_equilibrium", **out_p)
+            in_res = [(p, f.result(timeout=120)) for p, f in in_futs]
+            out_res = out_fut.result(timeout=120)
+        hits = [(p, r) for p, r in in_res if r.rescue_rungs == 0]
+        assert len(hits) >= 3          # tiny net, generous gate
+        for _, r in hits:
+            assert r.ok and r.value["surrogate"] is True
+            assert np.all(np.isfinite(r.value["X"]))
+        # the out-of-domain request fell through and bit-matches the
+        # real engine at bucket 1
+        assert out_res.rescue_rungs == 1 and not out_res.value.get(
+            "surrogate", False)
+        ref = server.solve_direct("equilibrium", bucket=1, **out_p)
+        np.testing.assert_array_equal(out_res.value["X"],
+                                      ref.value["X"])
+        assert out_res.value["T"] == ref.value["T"]
+        server.close()
+
+    def test_untrained_option_rejected_at_submit(self, mech, eq_model):
+        server = serve.ChemServer(
+            mech, recorder=telemetry.MetricsRecorder())
+        server.configure_engine("surrogate_equilibrium",
+                                model=eq_model)
+        Y0 = sg.phi_composition(mech, 1.0)[0]
+        with pytest.raises(ValueError, match="trained for equilibrium "
+                                             "option"):
+            server.submit("surrogate_equilibrium", T=1300.0,
+                          P=1.01325e6, Y=Y0, option=5)
+
+    def test_wrong_kind_model_rejected(self, mech, ign_model):
+        with pytest.raises(ValueError, match="trained for kind"):
+            serve_engines.EquilibriumSurrogateEngine(
+                mech, telemetry.MetricsRecorder(), model=ign_model)
+
+
+# ---------------------------------------------------------------------------
+# training CLI
+
+
+class TestTrainSurrogateCLI:
+    def test_generate_train_bank(self, tmp_path, monkeypatch):
+        from tools import train_surrogate as cli
+
+        out = str(tmp_path / "model.npz")
+        rc = cli.main([
+            "--mech", "h2o2", "--kind", "equilibrium", "--n", "16",
+            "--chunk", "8", "--hidden", "8", "--steps", "40",
+            "--members", "2", "--out", out])
+        assert rc == 0
+        model = sg.load_model(out)
+        assert model.kind == "equilibrium"
+        assert len(model.members) == 2
+        curve_path = str(tmp_path / "model_curve.json")
+        with open(curve_path) as f:
+            artifact = json.load(f)
+        assert artifact["tool"] == "train_surrogate"
+        assert len(artifact["final_losses"]) == 2
+        assert len(artifact["curves"][0]) <= 200
+        assert artifact["sig"] == model.sig
+        # the labeling shard + its checkpoint were banked alongside
+        shard_path = str(tmp_path / "model_shard.npz")
+        assert sg.load_shard(shard_path)["sig"] == model.sig
+        # retrain from the banked shard (the flywheel path)
+        out2 = str(tmp_path / "model2.npz")
+        rc = cli.main([
+            "--mech", "h2o2", "--kind", "equilibrium",
+            "--shards", shard_path, "--hidden", "8", "--steps", "40",
+            "--members", "1", "--out", out2])
+        assert rc == 0
+        assert sg.load_model(out2).sig == model.sig
+
+
+# ---------------------------------------------------------------------------
+# loadgen soak (slow lane): the tool drives a mixed surrogate/solver
+# stream end to end and banks the artifact with the new counters
+
+
+@pytest.mark.slow
+class TestLoadgenSoak:
+    def test_mixed_surrogate_solver_stream(self, tmp_path, mech,
+                                           ign_model):
+        from tools import loadgen as loadgen_tool
+
+        model_path = str(tmp_path / "model.npz")
+        sg.save_model(model_path, ign_model)
+        out = str(tmp_path / "LOADGEN.json")
+        rc = loadgen_tool.main([
+            "--mech", "h2o2", "--kinds",
+            "surrogate_ignition,ignition", "--surrogate-model",
+            model_path, "--rate", "60", "--n", "40", "--seed", "0",
+            "--buckets", "1,8", "--max-batch", "8", "--out", out])
+        assert rc == 0
+        with open(out) as f:
+            artifact = json.load(f)
+        assert artifact["n_served"] == 40
+        assert artifact["n_timeout"] == 0
+        n_sur = (artifact["n_surrogate_hit"]
+                 + artifact["n_surrogate_fallback"])
+        assert n_sur > 0
+        # in-domain default sampler: the surrogate stream is mostly hits
+        assert artifact["n_surrogate_hit"] >= n_sur * 0.5
+        # the server-side books balance with the artifact
+        counters = artifact["telemetry"]["counters"]
+        assert (counters.get("serve.surrogate.hit", 0)
+                + counters.get("serve.surrogate.fallback", 0)) == n_sur
+

@@ -543,7 +543,8 @@ SERVE_RUNG_KEYS = (
     "rung", "platform", "mech", "kinds", "warmup_s", "compiles",
     "n_batches", "queue_wait_ms", "solve_ms", "n_requests", "n_served",
     "n_rejected", "n_rejected_with_hint", "n_timeout", "n_error",
-    "n_rescued", "deadline_ms", "n_deadline_expired", "rate_hz",
+    "n_rescued", "n_surrogate_hit", "n_surrogate_fallback",
+    "deadline_ms", "n_deadline_expired", "rate_hz",
     "offered_s", "wall_s",
     "status_counts", "p50_ms", "p95_ms", "p99_ms", "mean_ms", "max_ms",
     "mean_occupancy", "max_occupancy",
@@ -562,7 +563,9 @@ def _fake_serve_result():
         "solve_ms": {"count": 9, "p50": 8.0, "p95": 9.0, "p99": 9.5},
         "n_requests": 20, "n_served": 20, "n_rejected": 0,
         "n_rejected_with_hint": 0, "n_timeout": 0, "n_error": 0,
-        "n_rescued": 0, "deadline_ms": None, "n_deadline_expired": 0,
+        "n_rescued": 0, "n_surrogate_hit": 0,
+        "n_surrogate_fallback": 0,
+        "deadline_ms": None, "n_deadline_expired": 0,
         "rate_hz": 100.0, "offered_s": 0.2,
         "wall_s": 0.4, "status_counts": {"OK": 20}, "p50_ms": 10.0,
         "p95_ms": 12.0, "p99_ms": 14.0, "mean_ms": 10.5, "max_ms": 15.0,
@@ -577,6 +580,44 @@ def _fake_serve_result():
              "latency_ms": 15.0,
              "spans": [{"span": "serve.dispatch", "dur_ms": 8.0}],
              "breakdown": {"serve.dispatch": 8.0}}],
+    }
+
+
+#: every key the surrogate_latency rung JSON must carry (ISSUE 10):
+#: training provenance, the hit-rate evidence, and the surrogate-vs-
+#: solver p50 pair at the same bucket, plus the stream summary keys
+SURROGATE_RUNG_KEYS = (
+    "rung", "platform", "mech", "n_train", "n_valid", "hidden",
+    "train_steps", "n_members", "final_losses", "label_s", "train_s",
+    "warmup_s", "hit_rate", "surrogate_p50_ms", "solver_p50_ms",
+    "speedup_p50", "bucket", "gate", "compiles", "residual",
+    "n_requests", "n_served", "n_surrogate_hit",
+    "n_surrogate_fallback", "status_counts", "p50_ms", "p99_ms",
+)
+
+
+def _fake_surrogate_result():
+    return {
+        "rung": "surrogate_latency", "platform": "tpu",
+        "mech": "h2o2", "n_train": 192, "n_valid": 192,
+        "hidden": [32, 32], "train_steps": 1500, "n_members": 3,
+        "final_losses": [0.0005, 0.0002, 0.0004],
+        "label_s": 7.0, "train_s": 2.0, "warmup_s": 10.0,
+        "hit_rate": 1.0, "surrogate_p50_ms": 0.07,
+        "solver_p50_ms": 98.0, "speedup_p50": 1400.0, "bucket": 1,
+        "gate": {"domain_margin": 0.0, "ign_disagree_max": 0.1,
+                 "ign_t_end_frac": 0.8, "eq_resid_max": 0.05},
+        "compiles": 7,
+        "residual": {"count": 32, "p50": 0.0007, "p95": 0.0015,
+                     "p99": 0.0017},
+        "n_requests": 32, "n_served": 32, "n_rejected": 0,
+        "n_rejected_with_hint": 0, "n_timeout": 0, "n_error": 0,
+        "n_rescued": 0, "n_surrogate_hit": 32,
+        "n_surrogate_fallback": 0, "rate_hz": 100.0,
+        "offered_s": 0.3, "wall_s": 0.4, "status_counts": {"OK": 32},
+        "p50_ms": 3.0, "p95_ms": 3.6, "p99_ms": 4.0, "mean_ms": 3.0,
+        "max_ms": 4.2, "mean_occupancy": 1.7, "max_occupancy": 3,
+        "trace_exemplars": [],
     }
 
 
@@ -604,6 +645,8 @@ class TestBenchBanking:
                            "ignitions_per_sec": 2.0}, ""
             if args[0] == "serve":
                 return 0, _fake_serve_result(), ""
+            if args[0] == "surrogate":
+                return 0, _fake_surrogate_result(), ""
             assert args[0] == "config"
             i = calls["n"]
             calls["n"] += 1
@@ -638,6 +681,12 @@ class TestBenchBanking:
         for key in SERVE_RUNG_KEYS:
             assert key in serve_rung, f"serve rung missing {key}"
         assert all("serve_latency" not in s for s in summaries[:-1])
+        # ... and so does the surrogate_latency rung (ISSUE 10)
+        surrogate_rung = summaries[-1]["surrogate_latency"]
+        for key in SURROGATE_RUNG_KEYS:
+            assert key in surrogate_rung, f"surrogate rung missing {key}"
+        assert all("surrogate_latency" not in s
+                   for s in summaries[:-1])
         # configs_run schema: the resilience counters ride along into
         # every banked summary (partial lines included)
         for summary in summaries:
@@ -782,6 +831,31 @@ class TestServeRungSchema:
         assert rung["queue_wait_ms"]["count"] == rung["n_served"]
         assert rung["p50_ms"] <= rung["p99_ms"] <= rung["max_ms"]
         assert rung["status_counts"].get("OK", 0) == rung["n_served"]
+
+
+class TestSurrogateRungSchema:
+    @pytest.mark.slow
+    def test_child_surrogate_emits_full_schema_on_cpu(self, capfd,
+                                                      monkeypatch):
+        """The REAL surrogate_latency child must emit every schema key
+        AND clear the ISSUE-10 acceptance bars on this container's
+        CPU: hit_rate >= 0.5 on the in-domain stream and surrogate p50
+        at least 5x below the wrapped solver's p50 at the same
+        bucket."""
+        monkeypatch.setenv("BENCH_SURROGATE_TRAIN", "96")
+        monkeypatch.setenv("BENCH_SURROGATE_STEPS", "800")
+        benchmarks._child_surrogate("h2o2", 24, 150.0)
+        rung = _summary_lines(capfd.readouterr().out)[-1]
+        for key in SURROGATE_RUNG_KEYS:
+            assert key in rung, f"missing surrogate rung key {key}"
+        assert rung["rung"] == "surrogate_latency"
+        assert rung["hit_rate"] is not None
+        assert rung["hit_rate"] >= 0.5
+        assert rung["surrogate_p50_ms"] * 5 <= rung["solver_p50_ms"]
+        assert rung["speedup_p50"] >= 5
+        assert (rung["n_surrogate_hit"]
+                + rung["n_surrogate_fallback"]) == rung["n_served"]
+        assert rung["bucket"] == 1
 
 
 class TestDriverEventSchema:

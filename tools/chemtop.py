@@ -100,6 +100,18 @@ def merge_fleet(replies: List[Dict]) -> Dict:
             agg["quota"] += int(t.get("quota", 0))
         for name, state in (rep.get("histogram_states") or {}).items():
             hist_states.setdefault(name, []).append(state)
+    # surrogate fast-path gauge: fleet hit rate from the SUMMED
+    # counters (never averaged per-backend rates), fallbacks alongside
+    # — a dropping hit rate is the signal to retrain/widen the box
+    hit = counters.get("serve.surrogate.hit", 0)
+    fallback = counters.get("serve.surrogate.fallback", 0)
+    surrogate = {
+        "hit": hit,
+        "miss": counters.get("serve.surrogate.miss", 0),
+        "fallback": fallback,
+        "hit_rate": (round(hit / (hit + fallback), 4)
+                     if hit + fallback else None),
+    }
     return {
         "t": time.time(),
         "n_backends": len(backends),
@@ -107,6 +119,7 @@ def merge_fleet(replies: List[Dict]) -> Dict:
         "backends": backends,
         "counters": counters,
         "tenants": tenants,
+        "surrogate": surrogate,
         "histograms": {name: telemetry.merge_histogram_states(states)
                        for name, states in sorted(hist_states.items())},
     }
@@ -129,6 +142,14 @@ def render(snapshot: Dict) -> str:
         f"rejected {c.get('serve.rejected', 0) + c.get('serve.tenant_rejected', 0)}  "
         f"rescued {c.get('serve.rescued', 0)}  "
         f"deadline_expired {c.get('serve.deadline_expired', 0)}")
+    sur = snapshot.get("surrogate") or {}
+    if (sur.get("hit", 0) + sur.get("fallback", 0)
+            + sur.get("miss", 0)):
+        rate = sur.get("hit_rate")
+        lines.append(
+            f"  surrogate: hit {sur['hit']}  miss {sur['miss']}  "
+            f"fallback {sur['fallback']}  "
+            f"hit_rate {'n/a' if rate is None else f'{rate:.1%}'}")
     for name in ("serve.queue_wait_ms", "serve.solve_ms"):
         h = snapshot["histograms"].get(name)
         if h and h.get("count"):

@@ -76,8 +76,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mech", default="h2o2",
                    help="embedded mechanism name (default h2o2)")
     p.add_argument("--kinds", default="equilibrium",
-                   help="comma list of request kinds "
-                        "(ignition,psr,equilibrium)")
+                   help="comma list of request kinds (ignition, psr, "
+                        "equilibrium, surrogate_ignition, "
+                        "surrogate_equilibrium)")
+    p.add_argument("--surrogate-model", default=None,
+                   help="trained model npz (tools/train_surrogate.py) "
+                        "— required when --kinds names a surrogate_* "
+                        "kind; enables a mixed surrogate/solver "
+                        "stream")
     p.add_argument("--rate", type=float, default=100.0,
                    help="offered arrival rate, requests/s")
     p.add_argument("--n", type=int, default=200,
@@ -122,6 +128,27 @@ def build_parser() -> argparse.ArgumentParser:
 def _engine_config() -> dict:
     return {"ignition": {"rtol": 1e-6, "atol": 1e-10,
                          "max_steps_per_segment": 4000}}
+
+
+def _surrogate_config(args, kinds, cfg) -> dict:
+    """Add the surrogate entries to engine config ``cfg`` (validated
+    against --surrogate-model). Both paths use the JSON-safe
+    ``share_base_kind`` wiring: the (local or backend-side) ChemServer
+    resolves it to ITS base engine instance, so warmup compiles the
+    stiff program once and fallbacks bit-match ``solve_direct`` of
+    the base kind."""
+    surrogate_kinds = [k for k in kinds
+                       if k.startswith(loadgen.SURROGATE_PREFIX)]
+    if surrogate_kinds and not args.surrogate_model:
+        raise SystemExit(
+            f"--kinds includes {surrogate_kinds} but no "
+            "--surrogate-model was given (train one with "
+            "tools/train_surrogate.py)")
+    for kind in surrogate_kinds:
+        cfg[kind] = {
+            "model_path": args.surrogate_model,
+            "share_base_kind": kind[len(loadgen.SURROGATE_PREFIX):]}
+    return cfg
 
 
 class _Obs:
@@ -179,7 +206,9 @@ def _run_inprocess(args, kinds, bucket_sizes, rng, samplers, obs):
     server = serve.ChemServer(
         mech, bucket_sizes=bucket_sizes, max_batch_size=args.max_batch,
         max_delay_ms=args.delay_ms, queue_depth=args.queue_depth,
-        recorder=rec, engine_config=_engine_config())
+        recorder=rec,
+        engine_config=_surrogate_config(args, kinds,
+                                        _engine_config()))
     print(f"# loadgen: warming {kinds} over buckets {bucket_sizes}",
           file=sys.stderr)
     warm = server.warmup(kinds)
@@ -198,6 +227,7 @@ def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs):
     if args.chaos is not None:
         json.loads(args.chaos)       # fail fast on a typo'd spec
     rec = obs.recorder
+    engine_config = _surrogate_config(args, kinds, _engine_config())
     config = {
         "tenants": {args.tenant: {"mech": args.mech,
                                   "quota": args.quota}},
@@ -206,7 +236,7 @@ def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs):
                  "max_batch_size": args.max_batch,
                  "max_delay_ms": args.delay_ms,
                  "queue_depth": args.queue_depth},
-        "engine_config": _engine_config(),
+        "engine_config": engine_config,
     }
     # the backend child's own sinks: its serve-layer trace spans land
     # in backend.jsonl (appended across respawned generations), and an

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""train_surrogate — dataset generation + ensemble training CLI for
+the neural surrogate fast path (``pychemkin_tpu/surrogate/``).
+
+Two stages, each skippable:
+
+1. **Label** — sample a (T, P, phi) box and run the REAL solver over
+   it under the durable sweep driver: generation is checkpointed
+   (``<shard>.ck.npz``), SIGKILL/SIGTERM-resumable (rc 75), and banks
+   a signed npz shard. Pass ``--shards`` to reuse/concatenate
+   previously banked shards instead (the flywheel: every sweep adds
+   training data) — their problem signatures are verified against the
+   current mechanism so a stale shard can never silently train
+   against different chemistry.
+2. **Fit** — train an MLP ensemble (plain-pytree params, handwritten
+   Adam), save the self-contained model npz (normalization,
+   trained-domain box, signatures ride inside), and bank a
+   training-curve artifact (atomic JSON) next to it.
+
+Usage::
+
+    python tools/train_surrogate.py --mech h2o2 --kind ignition \
+        --n 512 --seed 0 --out IGN_SURROGATE.npz
+    python tools/train_surrogate.py --mech h2o2 --kind ignition \
+        --shards shard_a.npz,shard_b.npz --out IGN_SURROGATE.npz
+
+Serve the result::
+
+    server.configure_engine("surrogate_ignition",
+                            model_path="IGN_SURROGATE.npz",
+                            base_engine=server.engine("ignition"))
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as a script from anywhere (same bootstrap as bench.py)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from pychemkin_tpu import surrogate, telemetry        # noqa: E402
+from pychemkin_tpu.mechanism import load_embedded     # noqa: E402
+from pychemkin_tpu.resilience.driver import JobInterrupted  # noqa: E402
+
+
+def _range(text: str):
+    lo, hi = (float(x) for x in text.split(","))
+    return (lo, hi)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mech", default="h2o2",
+                   help="embedded mechanism name (default h2o2)")
+    p.add_argument("--kind", default="ignition",
+                   choices=list(surrogate.dataset.KINDS))
+    # -- dataset box ----------------------------------------------------
+    p.add_argument("--n", type=int, default=512,
+                   help="conditions to sample and label")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--T-range", type=_range, default=(1250.0, 1400.0),
+                   metavar="LO,HI", help="temperature box, K")
+    p.add_argument("--P-range", type=_range, default=(0.9e6, 1.2e6),
+                   metavar="LO,HI", help="pressure box, dyne/cm^2")
+    p.add_argument("--phi-range", type=_range, default=(0.85, 1.15),
+                   metavar="LO,HI", help="equivalence-ratio box")
+    p.add_argument("--t-end", type=float, default=4e-4,
+                   help="ignition integration horizon, s")
+    p.add_argument("--chunk", type=int, default=64,
+                   help="labeling sweep chunk size (driver banking "
+                        "cadence)")
+    p.add_argument("--shard-out", default=None,
+                   help="bank the labeled shard here (default: "
+                        "<out stem>_shard.npz)")
+    p.add_argument("--shards", default=None,
+                   help="comma list of EXISTING shards to train on "
+                        "instead of generating")
+    # -- training -------------------------------------------------------
+    p.add_argument("--hidden", default="32,32",
+                   help="comma list of hidden-layer widths")
+    p.add_argument("--steps", type=int, default=1500,
+                   help="Adam steps per ensemble member")
+    p.add_argument("--members", type=int, default=3,
+                   help="ensemble size (disagreement = trust signal)")
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--train-seed", type=int, default=0)
+    p.add_argument("--out", default="SURROGATE.npz",
+                   help="model npz path (atomic rewrite)")
+    p.add_argument("--curve-out", default=None,
+                   help="training-curve JSON artifact (default: "
+                        "<out stem>_curve.json)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    mech = load_embedded(args.mech)
+    stem = os.path.splitext(args.out)[0]
+    t0 = time.time()
+
+    if args.shards:
+        paths = [s for s in args.shards.split(",") if s.strip()]
+        print(f"# train_surrogate: loading {len(paths)} shard(s)",
+              file=sys.stderr)
+        data = surrogate.load_shards(
+            paths, expect_mech_sig=surrogate.mech_signature(mech))
+    else:
+        box = surrogate.SampleBox(T=args.T_range, P=args.P_range,
+                                  phi=args.phi_range, t_end=args.t_end)
+        shard_out = args.shard_out or f"{stem}_shard.npz"
+        job_report: dict = {}
+        print(f"# train_surrogate: labeling {args.n} {args.kind} "
+              f"conditions (checkpointed at {shard_out}.ck.npz)",
+              file=sys.stderr)
+        try:
+            data, report = surrogate.generate_dataset(
+                mech, args.kind, n=args.n, seed=args.seed, box=box,
+                out_path=shard_out, chunk_size=args.chunk,
+                job_report=job_report)
+        except JobInterrupted as e:
+            # the documented resumable contract: rerun the same
+            # command to resume labeling after the banked chunk
+            print(f"# train_surrogate: interrupted — {e}",
+                  file=sys.stderr)
+            return e.rc
+        print(f"# train_surrogate: labeled "
+              f"{int(data['valid'].sum())}/{args.n} valid "
+              f"(resume_count={report.resume_count})", file=sys.stderr)
+
+    hidden = [int(h) for h in args.hidden.split(",") if h.strip()]
+    model, curves = surrogate.fit_surrogate(
+        data, hidden=hidden, steps=args.steps, lr=args.lr,
+        n_members=args.members, seed=args.train_seed)
+    surrogate.save_model(args.out, model)
+
+    artifact = surrogate.training_curve_artifact(
+        model, curves, wall_s=time.time() - t0)
+    curve_out = args.curve_out or f"{stem}_curve.json"
+    telemetry.atomic_write_json(curve_out, artifact)
+    print(json.dumps({k: v for k, v in artifact.items()
+                      if k != "curves"}), flush=True)
+    print(f"# train_surrogate: model -> {args.out}; curves -> "
+          f"{curve_out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
