@@ -243,6 +243,15 @@ def _child_config(mech_name: str, B: int, repeats: int):
     # default since ISSUE 6) or "ad" for A/B-ing the retired dense
     # jacfwd build; the rung JSON records which one the timing measured
     jac_mode = os.environ.get("BENCH_JAC_MODE", "analytic")
+    # ROP kernel mode the traces in this child actually take: the
+    # resolved PYCHEMKIN_ROP_MODE/auto decision GATED on the record
+    # carrying a staged kernel (a degraded unstaged parse runs dense
+    # whatever the env says) — so a banked rung is self-describing
+    # about which primal kinetics kernel its timing measured
+    from .ops import kinetics as _kinetics
+    rop_mode = _kinetics.resolve_rop_mode()
+    if mech.rop_stage is None:
+        rop_mode = "dense"
 
     def sweep(stats=None, job_report=None, checkpoint_path=None):
         return parallel.sharded_ignition_sweep(
@@ -329,6 +338,7 @@ def _child_config(mech_name: str, B: int, repeats: int):
         # assembly exploits (ops/jacobian.py) — so a banked rung is
         # self-describing about WHICH Jacobian path its timing measured
         jac_mode=jac_mode,
+        rop_mode=rop_mode,
         nu_nnz_frac=sparsity["nu_nnz_frac"],
         n_species_active=sparsity["n_species_active"],
         n_failed=rescue_report.n_failed,
@@ -811,6 +821,7 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
         "n_ignited": best["n_ignited"],
         "mfu_pct": best.get("mfu_pct"),
         "jac_mode": best.get("jac_mode"),
+        "rop_mode": best.get("rop_mode"),
         "steps_per_sec": best.get("steps_per_sec"),
         "baseline_ignitions_per_sec": round(baseline_ips, 4),
         "baseline_kind": baseline_kind,
@@ -820,7 +831,7 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
                                    "compile_s", "run_s", "mfu_pct",
                                    "steps_per_sec", "n_steps",
                                    "n_rejected", "n_newton", "platform",
-                                   "jac_mode", "nu_nnz_frac",
+                                   "jac_mode", "rop_mode", "nu_nnz_frac",
                                    "n_species_active",
                                    "n_failed", "n_rescued",
                                    "n_abandoned", "status_counts",

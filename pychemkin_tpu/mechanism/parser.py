@@ -28,6 +28,7 @@ import numpy as np
 
 from ..constants import AVOGADRO, P_ATM, R_CAL
 from ..logger import logger
+from . import staging
 from .record import (
     FALLOFF_CHEM_ACT,
     FALLOFF_LINDEMANN,
@@ -851,7 +852,7 @@ class MechanismParser:
                 polar[k] = tr.polar
                 zrot[k] = tr.zrot
 
-        return MechanismRecord(
+        record = MechanismRecord(
             element_names=tuple(self.elements),
             species_names=tuple(self.species),
             reaction_equations=tuple(equations),
@@ -875,6 +876,11 @@ class MechanismParser:
             geom=geom, eps_k=eps_k, sigma=sigma, dipole=dipole,
             polar=polar, zrot=zrot,
         )
+        # mechanism-specialized kernel staging: attach the sparse-kernel
+        # index sets (signature-keyed memo/disk cache — a second parse
+        # of the same mechanism re-stages nothing); failure degrades to
+        # an unstaged record and the dense kinetics fallback
+        return staging.attach_rop_stage(record)
 
     def _check_balance(self, nu_f, nu_r, ncf, equations) -> None:
         """Element balance check per reaction (the native preprocessor's

@@ -137,6 +137,20 @@ class MechanismRecord:
     nu_nnz_frac: float = dataclasses.field(
         default=None, metadata={"static": True})   # nnz(nu)/size(nu)
 
+    # ---- staged sparse-kernel index sets (static, parse-time) --------------
+    # A mechanism.staging.StagedRopKernel: the COO/compact-row index
+    # machinery of the sparse kinetics path (ops/kinetics.py) and the
+    # analytical Jacobian's triple-product contraction, emitted once per
+    # mechanism signature and cached next to the XLA persistent cache.
+    # None on hand-built records (dense fallback). The stage carries
+    # index STRUCTURE only — coefficient values are gathered from the
+    # live leaves at trace time, so rate-data edits (with_A_factor /
+    # with_rate_multipliers) keep it valid; only a change to the
+    # stoichiometric sparsity pattern itself would stale it, and such a
+    # record should be re-staged (or left unstaged) by its builder.
+    rop_stage: Any = dataclasses.field(
+        default=None, metadata={"static": True})
+
     # ---- transport ----------------------------------------------------------
     geom: Any = None       # [KK] int: 0 atom / 1 linear / 2 nonlinear
     eps_k: Any = None      # [KK] LJ well depth / kB, K

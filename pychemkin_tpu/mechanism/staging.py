@@ -1,0 +1,353 @@
+"""Mechanism-specialized kernel staging — parse-time emission of the
+sparse ROP/RHS/Jacobian index machinery, cached on disk by mechanism
+signature.
+
+pyJac (arXiv:1605.03262) and Pyrometheus (arXiv:2503.24286) generate
+mechanism-specialized source code; here the analog of "codegen" is the
+set of STATIC index sets a mechanism's sparsity defines — which rows
+carry falloff blending, which are reversible, the COO entry lists of
+the ``ord @ lnC`` concentration products, the ``nu^T`` contraction and
+the Jacobian triple products. Emitting them is a Python loop over all
+II reactions (milliseconds for GRI-scale, the dominant host cost of a
+parse after the text pass), and they are pure functions of the
+mechanism — so they are staged ONCE per mechanism:
+
+- **in memory**: a process-wide memo keyed by the mechanism signature,
+  so re-parsing the same file re-stages nothing;
+- **on disk**: an npz per signature next to the persistent XLA
+  compilation cache (``<repo>/.jax_cache/kernel_staging/``), so a
+  second process — a respawned serve backend, a driver re-exec — loads
+  the staged kernel instead of re-emitting it, the same contract the
+  XLA cache provides for the compiled programs these index sets feed.
+
+The staged object carries only index STRUCTURE (plus row subsets); the
+kinetics kernels gather coefficient values from the live record leaves
+at trace time, so a record whose rate data was replaced
+(``with_rate_multipliers``) keeps a valid stage — only a change to the
+stoichiometric SPARSITY pattern itself would invalidate it, and any
+such change alters the signature and misses the cache.
+
+Degradation contract: a corrupted, truncated, or stale cache entry is
+re-staged (with a ``staging.cache_corrupt`` telemetry event) — never a
+crash, never a wrong kernel; an unwritable cache directory degrades to
+memory-only staging.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+import numpy as np
+
+from .. import telemetry
+
+#: schema version of the staged npz — bump on any layout change so old
+#: entries read as stale and re-stage instead of misindexing
+_STAGE_VERSION = 1
+
+#: env override of the on-disk staging cache directory (tests point it
+#: at a tmp dir; empty string disables the disk layer entirely)
+STAGING_DIR_ENV = "PYCHEMKIN_STAGING_DIR"
+
+_ARRAY_FIELDS = (
+    # ord_f / ord_r nonzero entries, sorted by reaction row (the
+    # segment ids of the concentration-product segment-sums)
+    "of_rxn", "of_sp", "of_frac",
+    "or_rxn", "or_sp", "or_frac",
+    # compact reversible-row machinery for kr = kf / Kc: rev_rows is
+    # the reversible-row subset; kc_* are the nu entries restricted to
+    # those rows with segment id = index INTO rev_rows (sorted).
+    # (There is deliberately NO staged index set for the nu^T q
+    # species contraction: it stays a dense matvec on every platform —
+    # see kinetics._nu_T_contract for the measurements.)
+    "rev_rows", "kc_seg", "kc_rxn", "kc_sp",
+    # structural row subsets (self-contained copies — the record's
+    # jac_* fields may be stripped on hand-modified records)
+    "falloff_rows", "tb_rows", "revp_rows",
+    # Jacobian COO triple products (ops/jacobian.py:_StoichCOO): one
+    # entry per structurally nonzero (rxn, product ko, reactant ki)
+    # triple, sorted by the flattened output segment ko*KK + ki
+    "jac_rxn", "jac_ko", "jac_ki", "jac_seg",
+)
+
+#: fields whose values must be ascending (they feed segment-sums
+#: declared ``indices_are_sorted=True``, whose output is undefined on
+#: unsorted ids) — validated on every cache load
+_SORTED_FIELDS = ("of_rxn", "or_rxn", "kc_seg", "jac_seg")
+
+
+class StagedRopKernel:
+    """The staged sparse-kernel index sets of one mechanism.
+
+    Lives on ``MechanismRecord.rop_stage`` as a STATIC pytree field:
+    hashable and comparable by the mechanism signature alone, so jit
+    caching over the record keys on mechanism identity, not on array
+    contents."""
+
+    __slots__ = ("sig", "II", "KK") + _ARRAY_FIELDS
+
+    def __init__(self, sig: str, II: int, KK: int, **arrays: Any):
+        self.sig = sig
+        self.II = int(II)
+        self.KK = int(KK)
+        for name in _ARRAY_FIELDS:
+            arr = np.asarray(arrays[name])
+            arr.setflags(write=False)
+            setattr(self, name, arr)
+
+    def __hash__(self):
+        return hash(self.sig)
+
+    def __eq__(self, other):
+        return (isinstance(other, StagedRopKernel)
+                and other.sig == self.sig)
+
+    def __repr__(self):
+        return (f"StagedRopKernel(sig={self.sig[:12]}…, II={self.II}, "
+                f"KK={self.KK}, nnz_ord={self.of_rxn.size}"
+                f"+{self.or_rxn.size}, nnz_kc={self.kc_rxn.size}, "
+                f"jac_triples={self.jac_rxn.size})")
+
+
+def mechanism_signature(record) -> str:
+    """The mechanism's identity hash — every array leaf plus species
+    names (the same recipe the surrogate/serving layers key on via
+    :func:`pychemkin_tpu.resilience.checkpoint.signature`). Static
+    fields (including an already-attached stage) are not leaves, so
+    the signature is stable across staging itself."""
+    from ..resilience import checkpoint
+
+    return checkpoint.signature("rop-stage", _STAGE_VERSION, tree=record)
+
+
+def stage_rop_kernel(record, sig: str | None = None) -> StagedRopKernel:
+    """Emit the staged kernel from a record's concrete stoichiometry
+    leaves (the parse-time "codegen" pass). Pure numpy — requires
+    concrete arrays, so this runs at parse time, never under a trace."""
+    from .record import FALLOFF_NONE, TB_NONE
+
+    if sig is None:
+        sig = mechanism_signature(record)
+    nu_f = np.asarray(record.nu_f)
+    nu_r = np.asarray(record.nu_r)
+    ord_f = np.asarray(record.order_f if record.order_f is not None
+                       else record.nu_f)
+    ord_r = np.asarray(record.order_r if record.order_r is not None
+                       else record.nu_r)
+    nu = nu_r - nu_f
+    II, KK = nu.shape
+
+    def _entries(mat, frac_entries):
+        rxn, sp = np.nonzero(mat)          # C-order: sorted by row
+        frac = np.zeros(rxn.size, dtype=bool)
+        fset = set(frac_entries or ())
+        if fset:
+            frac = np.array([(int(i), int(k)) in fset
+                             for i, k in zip(rxn, sp)])
+        return (rxn.astype(np.int32), sp.astype(np.int32), frac)
+
+    of_rxn, of_sp, of_frac = _entries(ord_f, record.ford_frac_entries)
+    or_rxn, or_sp, or_frac = _entries(ord_r, record.rord_frac_entries)
+
+    n_rxn, n_sp = np.nonzero(nu)
+    reversible = np.asarray(record.reversible).astype(bool)
+    rev_rows = np.where(reversible)[0].astype(np.int32)
+    # nu entries restricted to reversible rows; segment id = compact
+    # index into rev_rows (np.nonzero row-major order is already
+    # sorted by row, hence by compact index)
+    kc_mask = reversible[n_rxn]
+    kc_rxn = n_rxn[kc_mask].astype(np.int32)
+    kc_sp = n_sp[kc_mask].astype(np.int32)
+    compact = np.full(II, -1, dtype=np.int32)
+    compact[rev_rows] = np.arange(rev_rows.size, dtype=np.int32)
+    kc_seg = compact[kc_rxn]
+
+    has_rev = np.asarray(record.has_rev_params).astype(bool)
+    revp_rows = np.where(reversible & has_rev)[0].astype(np.int32)
+    falloff_rows = np.where(
+        np.asarray(record.falloff_type) != FALLOFF_NONE)[0].astype(np.int32)
+    tb_rows = np.where(
+        (np.asarray(record.tb_type) != TB_NONE)
+        | (np.asarray(record.falloff_type) != FALLOFF_NONE))[0].astype(
+            np.int32)
+
+    # Jacobian triple products — same construction (and the same
+    # sorted-by-seg order) as ops/jacobian.py:_stoich_coo's per-trace
+    # loop, emitted once here instead of on every trace
+    j_rxn, j_ko, j_ki = [], [], []
+    for i in range(II):
+        kos = np.nonzero(nu[i])[0]
+        kis = np.nonzero((ord_f[i] != 0) | (ord_r[i] != 0))[0]
+        if not kos.size or not kis.size:
+            continue
+        ko_g, ki_g = np.meshgrid(kos, kis, indexing="ij")
+        j_rxn.append(np.full(ko_g.size, i))
+        j_ko.append(ko_g.ravel())
+        j_ki.append(ki_g.ravel())
+    if j_rxn:
+        j_rxn = np.concatenate(j_rxn)
+        j_ko = np.concatenate(j_ko)
+        j_ki = np.concatenate(j_ki)
+        j_seg = j_ko * KK + j_ki
+        order = np.argsort(j_seg, kind="stable")
+        j_rxn, j_ko, j_ki, j_seg = (j_rxn[order], j_ko[order],
+                                    j_ki[order], j_seg[order])
+    else:
+        j_rxn = j_ko = j_ki = j_seg = np.zeros(0, dtype=np.int64)
+
+    telemetry.get_recorder().inc("staging.emit")
+    return StagedRopKernel(
+        sig, II, KK,
+        of_rxn=of_rxn, of_sp=of_sp, of_frac=of_frac,
+        or_rxn=or_rxn, or_sp=or_sp, or_frac=or_frac,
+        rev_rows=rev_rows, kc_seg=kc_seg, kc_rxn=kc_rxn, kc_sp=kc_sp,
+        falloff_rows=falloff_rows, tb_rows=tb_rows, revp_rows=revp_rows,
+        jac_rxn=j_rxn.astype(np.int32), jac_ko=j_ko.astype(np.int32),
+        jac_ki=j_ki.astype(np.int32), jac_seg=j_seg.astype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# signature-keyed cache: process memo + on-disk npz
+
+_MEMO: dict = {}
+_MEMO_LOCK = threading.Lock()
+
+
+def staging_cache_dir() -> str | None:
+    """Directory of the on-disk staging cache — a sibling of the
+    persistent XLA compilation cache partitions. The staged index sets
+    are pure host-independent numpy, so unlike the XLA entries they
+    need no CPU-feature partitioning. ``PYCHEMKIN_STAGING_DIR``
+    overrides; set EMPTY to disable the disk layer."""
+    env = os.environ.get(STAGING_DIR_ENV)
+    if env is not None:
+        return env or None
+    from ..utils.cache import _default_dir
+
+    return os.path.join(_default_dir(), "kernel_staging")
+
+
+def _cache_path(sig: str) -> str | None:
+    d = staging_cache_dir()
+    if not d:
+        return None
+    return os.path.join(d, f"rop_{sig[:32]}.npz")
+
+
+def _load_entry(path: str, sig: str) -> StagedRopKernel | None:
+    """Load and validate one cache entry; None means miss (absent) and
+    raising ValueError means corrupt/stale (caller re-stages)."""
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        meta = {"sig", "version", "II", "KK"}
+        missing = (meta | set(_ARRAY_FIELDS)) - set(z.files)
+        if missing:
+            raise ValueError(f"missing keys {sorted(missing)}")
+        if str(z["sig"]) != sig:
+            raise ValueError("signature mismatch (stale entry)")
+        if int(z["version"]) != _STAGE_VERSION:
+            raise ValueError("stage version mismatch")
+        II, KK = int(z["II"]), int(z["KK"])
+        arrays = {name: z[name] for name in _ARRAY_FIELDS}
+    # index-bound sanity: a bit-rotted entry must never become an
+    # out-of-bounds (or silently clamped) gather in a compiled kernel
+    bounds = {"of_rxn": II, "or_rxn": II, "kc_rxn": II,
+              "rev_rows": II, "falloff_rows": II, "tb_rows": II,
+              "revp_rows": II, "jac_rxn": II,
+              "of_sp": KK, "or_sp": KK, "kc_sp": KK,
+              "jac_ko": KK, "jac_ki": KK, "jac_seg": KK * KK,
+              "kc_seg": max(int(arrays["rev_rows"].size), 1)}
+    for name, bound in bounds.items():
+        a = arrays[name]
+        if a.size and (int(a.min()) < 0 or int(a.max()) >= bound):
+            raise ValueError(f"{name} indices out of bounds")
+    # sortedness + internal consistency: the segment ids feed
+    # segment-sums declared indices_are_sorted=True (undefined output
+    # on unsorted ids), and jac_seg must BE ko*KK + ki — an in-bounds
+    # permutation or a decoupled seg array is still a wrong kernel
+    for name in _SORTED_FIELDS:
+        if np.any(np.diff(arrays[name]) < 0):
+            raise ValueError(f"{name} not ascending")
+    if not np.array_equal(
+            arrays["jac_seg"],
+            arrays["jac_ko"].astype(np.int64) * KK + arrays["jac_ki"]):
+        raise ValueError("jac_seg inconsistent with (jac_ko, jac_ki)")
+    return StagedRopKernel(sig, II, KK, **arrays)
+
+
+def _save_entry(path: str, st: StagedRopKernel) -> None:
+    telemetry.atomic_savez(
+        path, sig=np.asarray(st.sig), version=np.asarray(_STAGE_VERSION),
+        II=np.asarray(st.II), KK=np.asarray(st.KK),
+        **{name: getattr(st, name) for name in _ARRAY_FIELDS})
+
+
+def load_or_stage(record, sig: str | None = None) -> StagedRopKernel:
+    """The staging entry point: memo hit → disk hit → emit (+bank).
+
+    Every failure mode of the disk layer degrades to re-emission:
+    corrupt/stale entries are overwritten (``staging.cache_corrupt``
+    event), I/O errors skip the disk layer (``staging.cache_error``
+    event). The returned kernel is always freshly validated or freshly
+    emitted — never a blind deserialization."""
+    rec = telemetry.get_recorder()
+    if sig is None:
+        sig = mechanism_signature(record)
+    with _MEMO_LOCK:
+        st = _MEMO.get(sig)
+    if st is not None:
+        rec.inc("staging.hit")
+        rec.inc("staging.memo_hit")
+        return st
+
+    path = _cache_path(sig)
+    if path is not None:
+        try:
+            st = _load_entry(path, sig)
+        except Exception as e:  # noqa: BLE001 — any torn/foreign file
+            rec.event("staging.cache_corrupt", path=path,
+                      error=f"{type(e).__name__}: {e}")
+            rec.inc("staging.cache_corrupt")
+            st = None
+        if st is not None:
+            rec.inc("staging.hit")
+            rec.inc("staging.cache_hit")
+            with _MEMO_LOCK:
+                _MEMO[sig] = st
+            return st
+
+    st = stage_rop_kernel(record, sig=sig)
+    if path is not None:
+        try:
+            _save_entry(path, st)
+        except OSError as e:
+            rec.event("staging.cache_error", path=path,
+                      error=f"{type(e).__name__}: {e}")
+    with _MEMO_LOCK:
+        _MEMO[sig] = st
+    return st
+
+
+def attach_rop_stage(record):
+    """Return ``record`` with its staged kernel attached (the parser's
+    final step). Never raises: a staging failure logs a telemetry event
+    and returns the record unstaged — the kinetics kernels then take
+    the dense fallback, which is always correct."""
+    import dataclasses
+
+    try:
+        st = load_or_stage(record)
+    except Exception as e:  # noqa: BLE001 — staging must never kill a parse
+        telemetry.get_recorder().event(
+            "staging.failed", error=f"{type(e).__name__}: {e}")
+        return record
+    return dataclasses.replace(record, rop_stage=st)
+
+
+def clear_memo() -> None:
+    """Drop the in-process memo (tests exercising the disk layer)."""
+    with _MEMO_LOCK:
+        _MEMO.clear()

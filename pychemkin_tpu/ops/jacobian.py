@@ -118,11 +118,15 @@ class _StoichCOO(NamedTuple):
 def _stoich_coo(mech):
     """Build the COO entry set from concrete stoichiometry leaves.
 
-    Trace-time numpy on the record's arrays: ``None`` when the record is
-    itself traced (dense-matmul fallback) or on TPU, where the MXU
-    matmul beats gather/scatter and the dense contraction stays the
-    right mapping. Rebuilt per trace (a few ms of host work, amortized
-    by the jit cache)."""
+    Records carrying a parse-time staged kernel
+    (:mod:`pychemkin_tpu.mechanism.staging`) reuse its triple-product
+    index set — no per-trace Python loop, just a vectorized gather of
+    the coefficient values from the live leaves. Otherwise trace-time
+    numpy on the record's arrays: ``None`` when the record is itself
+    traced (dense-matmul fallback) or on TPU, where the MXU matmul
+    beats gather/scatter and the dense contraction stays the right
+    mapping. Rebuilt per trace (host work amortized by the jit
+    cache)."""
     if jax.default_backend() == "tpu":
         return None
     try:
@@ -135,6 +139,16 @@ def _stoich_coo(mech):
     except jax.errors.TracerArrayConversionError:
         return None
     nu = nu_r - nu_f
+    st = getattr(mech, "rop_stage", None)
+    if st is not None:
+        if st.jac_rxn.size == 0:
+            return None
+        cf = nu[st.jac_rxn, st.jac_ko] * ord_f[st.jac_rxn, st.jac_ki]
+        cr = nu[st.jac_rxn, st.jac_ko] * ord_r[st.jac_rxn, st.jac_ki]
+        return _StoichCOO(rxn=jnp.asarray(st.jac_rxn, dtype=jnp.int32),
+                          seg=jnp.asarray(st.jac_seg, dtype=jnp.int32),
+                          cf=jnp.asarray(cf.astype(np.float64)),
+                          cr=jnp.asarray(cr.astype(np.float64)))
     KK = nu.shape[1]
     rxn, seg, cf, cr = [], [], [], []
     for i in range(nu.shape[0]):
@@ -280,6 +294,50 @@ def _rate_constant_derivatives(mech, T, M, kf, P) -> _RateConstDerivs:
 
     # --- reverse: thermo path kr = safe_exp(ln(max(kf,tiny)) - ln Kc),
     # explicit-REV rows are plain Arrhenius, irreversible rows are 0 ---
+    st = kinetics._sparse_stage(mech)
+    if st is not None:
+        # mechanism-specialized compaction: the whole reverse-derivative
+        # chain (ln Kc and its T-derivative via the staged nu entries,
+        # the log/exp ladder, the clamp indicators) runs on the
+        # reversible-row subset only and scatters back — row for row
+        # the same formulas as the dense block below
+        rev_rows = st.rev_rows
+        dkr_dT = jnp.zeros_like(kf)
+        dkr_dM = jnp.zeros_like(kf)
+        dkr_dP = jnp.zeros_like(kf)
+        if rev_rows.size:
+            # ln Kc + d(ln Kc)/dT from the SAME staged contraction the
+            # primal kr ladder runs (kinetics._staged_kc_terms): the
+            # derivative block stays mirror-consistent row for row
+            ln_Kc_rev, dln_kc_rev = kinetics._staged_kc_terms(
+                mech, st, T, with_dT=True)
+            kf_rev = kf[rev_rows]
+            kf_cr = jnp.maximum(kf_rev, _TINY)
+            i_kfr = (kf_rev > _TINY).astype(dtype)
+            ln_kr_rev = jnp.log(kf_cr) - ln_Kc_rev
+            cg_rev = _clip_ind(ln_kr_rev) * _safe_exp(ln_kr_rev)
+            dT_rev = cg_rev * (i_kfr * dkf_dT[rev_rows] / kf_cr
+                               - dln_kc_rev)
+            dM_rev = cg_rev * i_kfr * dkf_dM[rev_rows] / kf_cr
+            dP_rev = cg_rev * i_kfr * dkf_dP[rev_rows] / kf_cr
+            hasr = np.asarray(mech.has_rev_params)[rev_rows]
+            if hasr.any():
+                rA = jnp.asarray(np.asarray(mech.rev_A)[rev_rows])
+                rb = jnp.asarray(np.asarray(mech.rev_beta)[rev_rows])
+                rE = jnp.asarray(np.asarray(mech.rev_Ea_R)[rev_rows])
+                kr_exp_r = _arrhenius(rA, rb, rE, T, lnT)
+                dkr_exp_r = _arrhenius_dT(rA, rb, rE, T, lnT, kr_exp_r)
+                hasr_j = jnp.asarray(hasr)
+                dT_rev = jnp.where(hasr_j, dkr_exp_r, dT_rev)
+                dM_rev = jnp.where(hasr_j, 0.0, dM_rev)
+                dP_rev = jnp.where(hasr_j, 0.0, dP_rev)
+            dkr_dT = dkr_dT.at[rev_rows].set(dT_rev)
+            dkr_dM = dkr_dM.at[rev_rows].set(dM_rev)
+            dkr_dP = dkr_dP.at[rev_rows].set(dP_rev)
+        return _RateConstDerivs(dkf_dT=dkf_dT, dkf_dM=dkf_dM,
+                                dkf_dP=dkf_dP, dkr_dT=dkr_dT,
+                                dkr_dM=dkr_dM, dkr_dP=dkr_dP)
+
     ln_Kc = kinetics.ln_equilibrium_constants(mech, T)
     dln_kc = _dln_kc_dT(mech, T)
     kf_c = jnp.maximum(kf, _TINY)
@@ -406,7 +464,7 @@ def kinetics_derivatives(mech, T, C, P=None) -> KineticsDerivatives:
                 vals, coo.seg, num_segments=KK * KK,
                 indices_are_sorted=True).reshape(KK, KK)
             D = D * dln[None, :]
-            w_T = nu.T @ dq_dT
+            w_T = kinetics._nu_T_contract(mech, dq_dT)
         else:
             # dense contraction (TPU MXU / traced record): the dq/dT
             # column rides the same matmul
@@ -421,10 +479,11 @@ def kinetics_derivatives(mech, T, C, P=None) -> KineticsDerivatives:
                 mech.tb_eff[tb_rows]
     if r.P_from_C:
         # P = sum(C) R T reconstruction: dP/dC_k = R T for every k
-        vP = nu.T @ (r.tb_mult * (dkf_dP * r.prod_f - dkr_dP * r.prod_r))
+        vP = kinetics._nu_T_contract(
+            mech, r.tb_mult * (dkf_dP * r.prod_f - dkr_dP * r.prod_r))
         D = D + vP[:, None] * (R_GAS * T)
-    # bit-identical primal (same matvec as net_production_rates)
-    wdot = nu.T @ (r.qf - r.qr)
+    # bit-identical primal (same contraction as net_production_rates)
+    wdot = kinetics._nu_T_contract(mech, r.qf - r.qr)
     return KineticsDerivatives(wdot=wdot, dwdot_dC=D, dwdot_dT=w_T)
 
 

@@ -23,6 +23,7 @@ mol/(cm^3 s), activation temperatures K).
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 from typing import Any, NamedTuple
 
@@ -61,6 +62,101 @@ def _safe_exp(x):
     exp algorithm), and those NaNs poison reverse-mode AD even through
     jnp.where. exp(±85) ~ 1e∓37 is already numerical zero/saturation."""
     return jnp.exp(jnp.clip(x, -_EXP_CLIP, _EXP_CLIP))
+
+
+# ---------------------------------------------------------------------------
+# ROP kernel mode: dense MXU matmuls vs mechanism-specialized sparse
+# (COO segment-sums + compact row subsets, staged at parse time)
+
+#: env knob selecting the primal kinetics path: "sparse" | "dense" |
+#: "auto" (default — sparse on CPU, dense on TPU where the [II, KK]
+#: matmul IS the MXU mapping). Read at TRACE time, like the
+#: fault-injection specs: set it before the process (or trace) that
+#: should feel it.
+ROP_MODE_ENV = "PYCHEMKIN_ROP_MODE"
+
+
+class _RopModeState(threading.local):
+    """Trace-time override stack for the ROP kernel mode (thread-local
+    for the same reason as :class:`_AnalyticJVPState`: the serve layer
+    traces on several threads concurrently)."""
+
+    def __init__(self):
+        self.stack = [None]
+
+
+_ROP_MODE = _RopModeState()
+
+
+@contextlib.contextmanager
+def rop_mode(mode: str | None):
+    """Trace-time override of the ROP kernel mode: ``"sparse"`` /
+    ``"dense"`` force a path (subject to the record actually carrying a
+    staged kernel — see :func:`resolve_rop_mode`), ``None`` restores
+    the env/auto decision. Programs traced inside the block keep the
+    mode they were traced with."""
+    if mode not in ("sparse", "dense", None):
+        raise ValueError(f"unknown rop mode {mode!r}")
+    _ROP_MODE.stack.append(mode)
+    try:
+        yield
+    finally:
+        _ROP_MODE.stack.pop()
+
+
+def resolve_rop_mode() -> str:
+    """The effective ROP mode of a trace started now: the innermost
+    :func:`rop_mode` override, else ``PYCHEMKIN_ROP_MODE``, else auto
+    by platform (sparse on CPU, dense on TPU). Note "sparse" is a
+    REQUEST: records without a staged kernel (hand-built) and traced
+    records still take the dense fallback."""
+    override = _ROP_MODE.stack[-1]
+    if override is not None:
+        return override
+    m = os.environ.get(ROP_MODE_ENV, "auto").strip().lower() or "auto"
+    if m not in ("auto", "sparse", "dense"):
+        raise ValueError(
+            f"{ROP_MODE_ENV} must be 'sparse', 'dense' or 'auto', "
+            f"got {m!r}")
+    if m == "auto":
+        return "dense" if jax.default_backend() == "tpu" else "sparse"
+    return m
+
+
+def _sparse_stage(mech):
+    """The record's staged kernel when THIS trace should take the
+    sparse path, else None (dense fallback): requires mode "sparse", a
+    parse-time :class:`~pychemkin_tpu.mechanism.staging.StagedRopKernel`
+    on the record, and CONCRETE leaves — a record passed as a jit
+    argument (traced leaves) falls back to the dense kernels, whose
+    structure needs no trace-time numpy."""
+    st = getattr(mech, "rop_stage", None)
+    if st is None or resolve_rop_mode() != "sparse":
+        return None
+    try:
+        np.asarray(mech.nu_f)
+    except jax.errors.TracerArrayConversionError:
+        return None
+    return st
+
+
+def _nu_T_contract(mech, vec):
+    """The species contraction ``nu^T @ vec`` ([II] -> [KK]) — the one
+    site both its consumers (the primal ``wdot`` and the analytical
+    Jacobian's dq/dT column) route through, so the primal stays
+    bit-identical across them.
+
+    Deliberately a dense matvec on every platform: the [KK, II] matvec
+    is BLAS/MXU-backed and was MEASURED faster than every COO
+    formulation of this contraction at mechanism scale on XLA:CPU —
+    segment-sum scatter, prefix-sum boundaries, and ELL padded rows all
+    cost ~0.4 ms more per grisyn B=32 RHS than the 0.05 ms matvec once
+    composed into the full kernel (XLA:CPU's batched gather/scatter
+    lowering, not flop count, dominates at nnz ~1e3). The staged COO
+    entry sets earn their keep where sparsity genuinely wins: the
+    compact-row falloff/reverse subsets, the concentration-product
+    segment-sums, and the Jacobian triple products."""
+    return (mech.nu_r - mech.nu_f).T @ vec
 
 
 def _arrhenius(A, beta, Ea_R, T, lnT):
@@ -304,13 +400,157 @@ def _conc_product_args(mech, C, lnC):
     return arg_f, arg_r
 
 
+def _staged_kc_terms(mech, st, T, with_dT=False):
+    """ln Kc (and optionally its exact T-derivative) on the compact
+    reversible-row subset, via sorted segment-sums over the staged nu
+    entries. The ONE implementation both its consumers share — the
+    primal kr ladder below and the analytical Jacobian's
+    reverse-derivative block (``ops/jacobian.py``) — so the derivative
+    stays mirror-consistent with the primal row for row.
+
+    Returns ``(ln_Kc_rev, dln_kc_rev_or_None)``, each [nrev]."""
+    nu = np.asarray(mech.nu_r) - np.asarray(mech.nu_f)
+    coef = jnp.asarray(nu[st.kc_rxn, st.kc_sp])
+    n_rev = int(st.rev_rows.size)
+    g = thermo.g_RT(mech, T)
+    nu_g = jax.ops.segment_sum(coef * g[st.kc_sp], st.kc_seg,
+                               num_segments=n_rev,
+                               indices_are_sorted=True)
+    dnu = jnp.asarray(nu[st.rev_rows].sum(axis=1))
+    ln_Kc_rev = -nu_g + dnu * jnp.log(P_ATM / (R_GAS * T))
+    if not with_dT:
+        return ln_Kc_rev, None
+    # exact NASA-7 identity (see jacobian._dln_kc_dT): d(ln Kc)/dT =
+    # (nu @ h_RT - dnu) / T, restricted to the same rows
+    h = thermo.h_RT(mech, T)
+    nu_h = jax.ops.segment_sum(coef * h[st.kc_sp], st.kc_seg,
+                               num_segments=n_rev,
+                               indices_are_sorted=True)
+    return ln_Kc_rev, (nu_h - dnu) / T
+
+
+def _reverse_rates_sparse(mech, st, T, kf):
+    """kr on the compact reversible-row subset, scattered back to [II].
+
+    Row for row the same formulas as :func:`reverse_rate_constants`
+    (thermo ln Kc path, explicit-REV Arrhenius, 0 for irreversible) —
+    but ln Kc's ``nu @ g`` contraction runs as a segment-sum over the
+    staged nu entries of the reversible rows only, and the log/exp
+    chain touches nrev rows instead of all II (grisyn: 27 of 325)."""
+    kr = jnp.zeros((st.II,), kf.dtype)
+    rev = st.rev_rows
+    if rev.size == 0:
+        return kr
+    ln_Kc, _ = _staged_kc_terms(mech, st, T)
+    kf_rev = kf[rev]
+    ln_kr = jnp.log(jnp.maximum(kf_rev, _TINY)) - ln_Kc
+    kr_rev = _safe_exp(ln_kr)
+    if st.revp_rows.size:
+        kr_exp = _arrhenius(jnp.asarray(np.asarray(mech.rev_A)[rev]),
+                            jnp.asarray(np.asarray(mech.rev_beta)[rev]),
+                            jnp.asarray(np.asarray(mech.rev_Ea_R)[rev]),
+                            T, jnp.log(T))
+        hasr = np.asarray(mech.has_rev_params)[rev]
+        kr_rev = jnp.where(jnp.asarray(hasr), kr_exp, kr_rev)
+    return kr.at[rev].set(kr_rev)
+
+
+def _conc_product_args_sparse(mech, st, C, lnC):
+    """Sparse (arg_f, arg_r): sorted segment-sums over the staged
+    nonzero ``ord`` entries, with the fractional-FORD/RORD floor
+    applied PER ENTRY (entries flagged fractional read the
+    ``FRAC_ORDER_FLOOR``-clamped log-concentration — exactly the
+    correction :func:`_conc_product_args` adds on top of its dense
+    matmul)."""
+    ord_f = np.asarray(mech.order_f if mech.order_f is not None
+                       else mech.nu_f)
+    ord_r = np.asarray(mech.order_r if mech.order_r is not None
+                       else mech.nu_r)
+    need_hi = bool(st.of_frac.any() or st.or_frac.any())
+    lnC_hi = jnp.log(jnp.maximum(C, FRAC_ORDER_FLOOR)) if need_hi else None
+
+    def one(rxn, sp, frac, om):
+        if rxn.size == 0:
+            return jnp.zeros((st.II,), lnC.dtype)
+        coef = jnp.asarray(om[rxn, sp])
+        vals = coef * lnC[sp]
+        if frac.any():
+            vals = jnp.where(jnp.asarray(frac), coef * lnC_hi[sp], vals)
+        return jax.ops.segment_sum(vals, rxn, num_segments=st.II,
+                                   indices_are_sorted=True)
+
+    return (one(st.of_rxn, st.of_sp, st.of_frac, ord_f),
+            one(st.or_rxn, st.or_sp, st.or_frac, ord_r))
+
+
+def _rop_intermediates_sparse(mech, st, T, C, P) -> RopIntermediates:
+    """Mechanism-specialized sparse ROP evaluation (the staged CPU hot
+    path): compact row subsets for the expensive branches — falloff
+    blending on the falloff rows only (grisyn: 10 of 325), reverse
+    rates on the reversible rows only (27 of 325), third bodies on the
+    rows that carry them — and COO segment-sums for the concentration
+    products. Agrees with the dense kernel to summation-order roundoff
+    (property-tested at ~1e-12 scale-relative on f64)."""
+    II = st.II
+    dtype = C.dtype
+    tb = st.tb_rows
+    M = jnp.zeros((II,), dtype)
+    if tb.size:
+        tb_eff_rows = jnp.asarray(np.asarray(mech.tb_eff)[tb])
+        M = M.at[tb].set(tb_eff_rows @ C)
+    P_from_C = P is None and mech.plog_idx.shape[0] > 0
+    if P_from_C:
+        P = jnp.sum(C) * R_GAS * T
+
+    lnT = jnp.log(T)
+    kf = _arrhenius(mech.A, mech.beta, mech.Ea_R, T, lnT)
+    fo = st.falloff_rows
+    if fo.size:
+        k0 = _arrhenius(jnp.asarray(np.asarray(mech.low_A)[fo]),
+                        jnp.asarray(np.asarray(mech.low_beta)[fo]),
+                        jnp.asarray(np.asarray(mech.low_Ea_R)[fo]),
+                        T, lnT)
+        blend = falloff_blend(T, lnT, M[fo], kf[fo], k0,
+                              np.asarray(mech.falloff_type)[fo],
+                              np.asarray(mech.is_chem_act)[fo],
+                              np.asarray(mech.troe)[fo],
+                              np.asarray(mech.sri)[fo])
+        kf = kf.at[fo].set(blend)
+    if mech.plog_idx.shape[0] > 0:
+        kf = kf.at[mech.plog_idx].set(_plog_rate(mech, T, lnT,
+                                                 jnp.log(P)))
+    kr = _reverse_rates_sparse(mech, st, T, kf)
+
+    lnC = jnp.log(jnp.maximum(C, _TINY))
+    arg_f, arg_r = _conc_product_args_sparse(mech, st, C, lnC)
+    prod_f = _safe_exp(arg_f)
+    prod_r = _safe_exp(arg_r)
+    plain_tb = ((np.asarray(mech.tb_type) == TB_MIXTURE)
+                & (np.asarray(mech.falloff_type) == FALLOFF_NONE))
+    tb_mult = jnp.where(jnp.asarray(plain_tb), M, 1.0)
+    return RopIntermediates(
+        kf=kf, kr=kr, M=M, tb_mult=tb_mult,
+        prod_f=prod_f, prod_r=prod_r, arg_f=arg_f, arg_r=arg_r,
+        qf=tb_mult * kf * prod_f, qr=tb_mult * kr * prod_r,
+        lnC=lnC, P=P, P_from_C=P_from_C)
+
+
 def rop_intermediates(mech, T, C, P=None) -> RopIntermediates:
     """One rate-of-progress evaluation with every intermediate exposed.
 
     This is THE primal kinetics computation: :func:`rates_of_progress`
     is a thin wrapper, and the analytical Jacobian assembles
     dq/d(T, C) from these quantities in closed form instead of pushing
-    KK forward-mode tangents through this graph."""
+    KK forward-mode tangents through this graph.
+
+    Path selection is a trace-time decision (:func:`resolve_rop_mode`):
+    staged records take the mechanism-specialized sparse kernel on CPU
+    (compact falloff/reverse/third-body rows + COO segment-sums); TPU,
+    hand-built records, and traced records keep the dense masked-matmul
+    kernel below."""
+    st = _sparse_stage(mech)
+    if st is not None:
+        return _rop_intermediates_sparse(mech, st, T, C, P)
     M = third_body_concentrations(mech, C)
     P_from_C = P is None and mech.plog_idx.shape[0] > 0
     if P_from_C:
@@ -380,7 +620,7 @@ def net_production_rates(mech, T, C, P=None):
         from . import jacobian
         return jacobian.net_production_rates_analytic(mech, T, C, P)
     q, _, _ = rates_of_progress(mech, T, C, P)
-    return (mech.nu_r - mech.nu_f).T @ q
+    return _nu_T_contract(mech, q)
 
 
 def rop(mech, T, P, Y):

@@ -193,6 +193,127 @@ def factor(A, mixed: bool | None = None) -> Factorization:
     return Factorization(lu=lu, piv=piv, A=None)
 
 
+# ---------------------------------------------------------------------------
+# bordered (Schur-complement) factorization: M = [[A, b], [c^T, d]]
+#
+# Every Newton matrix of the 0-D solvers is bordered: the state is
+# [Y_1..Y_KK, T], so M = I - h*g*J (stiff stages, pseudo-transient
+# steps) and the PSR residual Jacobian all carry a KK x KK species
+# block A bordered by one temperature row/column. Block-eliminating the
+# T row/column through the Schur complement d_schur = d - c . A^{-1} b
+# lets :func:`factor`/:func:`solve_factored` work on the smaller,
+# better-conditioned species block — the T row/column couples every
+# species with O(h_k * dwdot/dT) entries that sit decades above the
+# species-species block and otherwise steer the (pivot-free, on TPU)
+# elimination — while each subsequent solve costs one triangular solve
+# on A plus two dot products.
+
+
+class BorderedFactorization(NamedTuple):
+    """Factor of a bordered matrix via block elimination of the last
+    row/column. ``fac`` is the :func:`factor` result of the leading
+    [N-1, N-1] block; ``v = A^{-1} b`` and the clamped Schur scalar are
+    precomputed so each solve is triangular-solve + dots. ``M`` keeps
+    the full matrix on the mixed-precision path (refinement residuals,
+    pivoted fallback) and is None on the exact-f64 CPU path. ``perm``
+    (exact path only) is the pivot sequence expanded ONCE into a
+    permutation so each solve runs the batch-vectorized scan sweeps
+    below instead of XLA:CPU's per-batch trsv loops."""
+    fac: Factorization
+    b: Any          # [..., N-1] border column
+    c: Any          # [..., N-1] border row
+    d: Any          # [...] corner
+    v: Any          # [..., N-1] = A^{-1} b
+    d_schur: Any    # [...] = clamp(d - c . v)
+    M: Any          # full matrix (mixed path) or None (exact path)
+    perm: Any       # [..., N-1] row permutation (exact path) or None
+
+
+def _block_solve(bf: "BorderedFactorization", r):
+    """Solve the species block A u = r from the bordered factor.
+
+    Exact CPU path: apply the precomputed row permutation and run the
+    same batch-vectorized scan sweeps as the pivot-free TPU path — in
+    f64, on the PIVOTED packed L\\U, so the result is the exact LAPACK
+    solution. Measured ~7x faster than ``lu_solve`` at the vmapped
+    [B, KK] Newton-direction shape this factor serves (XLA:CPU lowers
+    batched ``triangular_solve`` to per-batch substitution loops; the
+    scan sweeps keep the batch axis vectorized). Mixed path: the
+    standard factored solve."""
+    if bf.perm is not None:
+        return _solve_nopivot(bf.fac.lu,
+                              jnp.take_along_axis(r, bf.perm, -1))
+    return solve_factored(bf.fac, r, refine=0)
+
+
+def factor_bordered(M, mixed: bool | None = None) -> BorderedFactorization:
+    """Factor ``M`` ([..., N, N], N >= 2) by block elimination of the
+    last row/column over a :func:`factor` of the leading block.
+    Algebraically exact for ANY bordered matrix; the elimination order
+    simply pins the border variable last (no pivoting across the
+    border), with the Schur scalar clamped like the pivot-free
+    diagonal."""
+    A = M[..., :-1, :-1]
+    b = M[..., :-1, -1]
+    c = M[..., -1, :-1]
+    d = M[..., -1, -1]
+    fac = factor(A, mixed=mixed)
+    perm = None
+    if fac.A is None and fac.piv is not None:
+        from jax.lax.linalg import lu_pivots_to_permutation
+
+        perm = lu_pivots_to_permutation(fac.piv, A.shape[-1])
+    bf = BorderedFactorization(fac=fac, b=b, c=c, d=d, v=b, d_schur=d,
+                               M=M if fac.A is not None else None,
+                               perm=perm)
+    v = _block_solve(bf, b)
+    d_schur = _clamp(d - jnp.einsum("...i,...i->...", c, v))
+    return bf._replace(v=v, d_schur=d_schur)
+
+
+def _solve_bordered_once(bf: BorderedFactorization, r):
+    """One bordered triangular-solve round: u = A^{-1} r_Y, then
+    x_T = (r_T - c.u) / d_schur and x_Y = u - x_T v."""
+    r_Y = r[..., :-1]
+    r_T = r[..., -1]
+    u = _block_solve(bf, r_Y)
+    x_T = (r_T - jnp.einsum("...i,...i->...", bf.c, u)) / bf.d_schur
+    x_Y = u - x_T[..., None] * bf.v
+    return jnp.concatenate([x_Y, x_T[..., None]], axis=-1)
+
+
+def solve_bordered(bf: BorderedFactorization, r, refine: int | None = None,
+                   residual_check: bool = False):
+    """Solve M x = r from a :func:`factor_bordered` result (vector RHS
+    only — the Newton-direction shape). Mirrors
+    :func:`solve_factored`'s refinement/residual-check contract: on the
+    exact CPU path the block solves are exact and refinement is a
+    no-op; on the mixed-precision path ``refine`` f64 sweeps run
+    against the FULL bordered residual, and ``residual_check`` falls
+    back to the pivoted LU of the full matrix for systems that
+    stagnated."""
+    x = _solve_bordered_once(bf, r)
+    if bf.M is None:
+        return x
+    n_ref = _REFINE_STEPS if refine is None else refine
+    for _ in range(n_ref):
+        res = r - _matvec(bf.M, x)
+        x = x + _solve_bordered_once(bf, res)
+    if residual_check and n_ref > 0:
+        res = r - _matvec(bf.M, x)
+        rn = jnp.sqrt(jnp.sum(jnp.square(res), axis=-1))
+        bn = jnp.sqrt(jnp.sum(jnp.square(r), axis=-1))
+        stagnated = ~(rn <= _FALLBACK_RTOL * bn + 1e-300)
+        any_stagnated = jnp.any(stagnated)
+        telemetry.device_increment("linalg.refine_stagnated", stagnated)
+        telemetry.device_increment("linalg.pivot_fallback", any_stagnated)
+        x_fb = jax.lax.cond(any_stagnated,
+                            lambda: _pivoted_resolve(bf.M, r, n_ref),
+                            lambda: x)
+        x = jnp.where(stagnated[..., None], x_fb, x)
+    return x
+
+
 def _matvec(A, x):
     """A x for matrix RHS (``x.ndim == A.ndim``) and batched/unbatched
     vector RHS alike (plain ``@`` rejects [B, N, N] @ [B, N])."""
@@ -308,7 +429,8 @@ def solve(A, b, refine: int | None = None,
 
 
 def solve_with_info(A, b, refine: int | None = None, fault_mask=None,
-                    row_equilibrate: bool = False):
+                    row_equilibrate: bool = False,
+                    bordered: bool = False):
     """One-shot solve returning ``(x, unstable)``.
 
     ``unstable`` is a per-system traced bool: True when the FINAL
@@ -327,15 +449,27 @@ def solve_with_info(A, b, refine: int | None = None, fault_mask=None,
     headroom for the pivot-free f32 factor before the residual check
     has to bail, and leaves the solution of the original system
     unchanged.
+
+    ``bordered`` (vector RHS only) block-eliminates the last row/column
+    through :func:`factor_bordered` — the PSR direct-Newton systems are
+    [Y..., T]-bordered like the stiff-stage matrices — while the final
+    residual/instability check below still runs against the FULL
+    system, so a bordered solve that hurt accuracy is flagged exactly
+    like a bad factor.
     """
     if row_equilibrate:
         rs = 1.0 / jnp.maximum(jnp.max(jnp.abs(A), axis=-1), 1e-300)
         A = A * rs[..., :, None]
         b = b * (rs[..., :, None] if b.ndim == A.ndim else rs)
     n_ref = _REFINE_STEPS if refine is None else refine
-    fac = factor(A)
-    x = solve_factored(fac, b, refine=n_ref,
-                       residual_check=(fac.A is not None and n_ref > 0))
+    if bordered and b.ndim == A.ndim - 1 and A.shape[-1] >= 2:
+        bf = factor_bordered(A)
+        x = solve_bordered(bf, b, refine=n_ref,
+                           residual_check=(bf.M is not None and n_ref > 0))
+    else:
+        fac = factor(A)
+        x = solve_factored(fac, b, refine=n_ref,
+                           residual_check=(fac.A is not None and n_ref > 0))
     r = b - _matvec(A, x)
     n_sys_axes = 2 if b.ndim == A.ndim else 1
     axes = tuple(range(b.ndim - n_sys_axes, b.ndim))

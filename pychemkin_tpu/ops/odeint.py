@@ -173,6 +173,7 @@ class _Ctrl:
     max_steps_per_segment: int
     h0: float
     dt_min_rel: float = 5e-14
+    bordered: bool = True
 
 
 def _norm(x, w):
@@ -218,9 +219,11 @@ def _make_jac_fn(rhs, force_f64=False):
     return lambda t, y, a: jax.jacfwd(lambda yy: rhs(t, yy, a))(y)
 
 
-def _newton_stage(rhs, t_stage, y_base, z0, h, fac, args, weights):
+def _newton_stage(rhs, t_stage, y_base, z0, h, lin_solve, args, weights):
     """Solve the SDIRK stage equation z = h * f(t_stage, y_base + gamma*z)
-    by modified Newton with the factored M = I - h*gamma*J.
+    by modified Newton with the factored M = I - h*gamma*J
+    (``lin_solve``: the factored-solve closure — bordered Schur
+    elimination by default, plain LU otherwise).
 
     Returns (z, converged, n_iters, diverged) — ``diverged`` records a
     growing correction norm (vs merely failing to reach tolerance), the
@@ -229,9 +232,9 @@ def _newton_stage(rhs, t_stage, y_base, z0, h, fac, args, weights):
     def body(carry):
         z, _, it, prev_dn, _ = carry
         g = z - h * rhs(t_stage, y_base + _GAMMA * z, args)
-        # refine=0: a Newton direction only needs f32 solve accuracy
-        # (far below the 3e-2 weighted Newton tolerance)
-        dz = linalg.solve_factored(fac, -g, refine=0)
+        # refine=0 semantics: a Newton direction only needs f32 solve
+        # accuracy (far below the 3e-2 weighted Newton tolerance)
+        dz = lin_solve(-g)
         z_new = z + dz
         dn = _norm(dz, weights)
         dn = jnp.where(jnp.isfinite(dn), dn, jnp.inf)
@@ -369,19 +372,29 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
         # build M in J's dtype: on TPU J is f32 (see _make_jac_fn) and
         # the factorization consumes f32 anyway
         M = jnp.eye(n, dtype=J.dtype) - (h * _GAMMA).astype(J.dtype) * J
-        fac = linalg.factor(M)
+        if ctrl.bordered:
+            # structured Newton solve: the state is [Y..., T], so M is
+            # bordered — factor the KK x KK species block and eliminate
+            # the T row/column via the Schur complement (linalg)
+            bfac = linalg.factor_bordered(M)
+            lin_solve = lambda rv: linalg.solve_bordered(  # noqa: E731
+                bfac, rv, refine=0)
+        else:
+            fac = linalg.factor(M)
+            lin_solve = lambda rv: linalg.solve_factored(  # noqa: E731
+                fac, rv, refine=0)
 
         w = ctrl.atol + ctrl.rtol * jnp.abs(s.y)
 
         z0 = h * s.f
         z1, ok1, it1, dv1 = _newton_stage(rhs, s.t + _C[0] * h, s.y, z0, h,
-                                          fac, args, w)
+                                          lin_solve, args, w)
         y_base2 = s.y + _A21 * z1
         z2, ok2, it2, dv2 = _newton_stage(rhs, s.t + _C[1] * h, y_base2, z1,
-                                          h, fac, args, w)
+                                          h, lin_solve, args, w)
         y_base3 = s.y + _B1 * z1 + _B2 * z2
-        z3, ok3, it3, dv3 = _newton_stage(rhs, s.t + h, y_base3, z2, h, fac,
-                                          args, w)
+        z3, ok3, it3, dv3 = _newton_stage(rhs, s.t + h, y_base3, z2, h,
+                                          lin_solve, args, w)
         newton_ok = ok1 & ok2 & ok3
         newton_diverged = dv1 | dv2 | dv3
         if stall_inject is not None:
@@ -390,7 +403,7 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
         y_new = y_base3 + _B3 * z3        # stiffly accurate
         e_raw = _ERR_W[0] * z1 + _ERR_W[1] * z2 + _ERR_W[2] * z3
         # the (I - h*g*J)^-1 error filter is a smoother; f32 is plenty
-        e = linalg.solve_factored(fac, e_raw, refine=0)
+        e = lin_solve(e_raw)
         w_new = ctrl.atol + ctrl.rtol * jnp.maximum(jnp.abs(s.y),
                                                     jnp.abs(y_new))
         err = _norm(e, w_new)
@@ -463,7 +476,7 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
 
 def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
            events=(), max_steps_per_segment=100_000, h0=0.0, jac=None,
-           f64_jac=False, fault_elem=None, fault_level=0):
+           f64_jac=False, bordered=True, fault_elem=None, fault_level=0):
     """Integrate dy/dt = rhs(t, y, args) from ts[0] through ts[-1]; return
     the solution on the output grid ``ts`` plus event accumulators.
 
@@ -479,6 +492,11 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
     assembly of :mod:`pychemkin_tpu.ops.jacobian`); default is
     ``jax.jacfwd`` of the RHS. ``f64_jac`` forces the f64 AD Jacobian
     path (rescue escalation; ignored when ``jac`` is given).
+    ``bordered`` (default True) solves the Newton systems by block
+    elimination of the last state variable (the [Y..., T] border) over
+    a factorization of the leading block
+    (:func:`pychemkin_tpu.ops.linalg.factor_bordered`); False keeps the
+    full-matrix factorization.
     ``fault_elem``/``fault_level`` thread this element's original batch
     index and rescue rung into the fault-injection harness; both are
     inert (no graph nodes) unless injection is active at trace time.
@@ -500,7 +518,8 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
         pass  # traced grid: caller's responsibility
     atol_vec = jnp.broadcast_to(jnp.asarray(atol, dtype=y0.dtype), y0.shape)
     ctrl = _Ctrl(rtol=rtol, atol=atol_vec,
-                 max_steps_per_segment=max_steps_per_segment, h0=h0)
+                 max_steps_per_segment=max_steps_per_segment, h0=h0,
+                 bordered=bool(bordered) and y0.shape[0] >= 2)
 
     if jac is None:
         jac_fn = _make_jac_fn(rhs, force_f64=f64_jac)

@@ -171,8 +171,13 @@ def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
         r = resid_fn(y, args)
         J = _resid_jac(resid_fn, y, args, analytic_jac)
         J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-14 * jnp.eye(n)
+        # bordered: the PSR state is [Y..., T], so the Newton system is
+        # eliminated over the KK x KK species block with the T
+        # row/column folded through the Schur complement; the full-
+        # system residual check still guards the result
         dy, unstable = linalg.solve_with_info(
-            J, -jnp.where(jnp.isfinite(r), r, 1e6), fault_mask=fault_mask)
+            J, -jnp.where(jnp.isfinite(r), r, 1e6), fault_mask=fault_mask,
+            bordered=True)
         dy = jnp.where(jnp.isfinite(dy), dy, 0.0)
         if damping:
             # cap temperature moves at 150 K and fraction moves at 0.2
@@ -219,12 +224,14 @@ def _pseudo_transient_phase(rhs_fn, y0, args, n_steps, dt0, up_factor,
         J = _resid_jac(lambda yy, a: rhs_fn(0.0, yy, a), y, args,
                        analytic_jac)
         M = jnp.eye(n) - dt * J
-        fac = linalg.factor(jnp.where(jnp.isfinite(M), M, 0.0))
+        # bordered implicit-Euler matrix: same [Y..., T] structure as
+        # the direct-Newton phase, factored over the species block
+        fac = linalg.factor_bordered(jnp.where(jnp.isfinite(M), M, 0.0))
 
         def inner(carry_i, _):
             yc, bad = carry_i
             g = yc - y - dt * rhs_fn(0.0, yc, args)
-            dy = linalg.solve_factored(fac, -g)
+            dy = linalg.solve_bordered(fac, -g)
             bad = bad | ~jnp.all(jnp.isfinite(dy))
             yc = yc + jnp.where(jnp.isfinite(dy), dy, 0.0)
             yc = yc.at[:-1].set(jnp.clip(yc[:-1], species_floor, 1.0))

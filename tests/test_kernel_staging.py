@@ -1,0 +1,237 @@
+"""Kernel-staging cache contract (``pychemkin_tpu.mechanism.staging``,
+ISSUE 11).
+
+The staged sparse-kernel index sets are keyed by the mechanism
+signature and cached twice: a process memo (second parse of the same
+mechanism re-stages nothing) and an npz next to the XLA persistent
+cache (a respawned backend / driver re-exec loads instead of
+re-emitting). The degradation contract: corrupted, truncated, or stale
+entries re-stage with a telemetry event — never a crash, never a wrong
+kernel.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu import telemetry
+from pychemkin_tpu.mechanism import (
+    load_embedded,
+    load_mechanism_from_strings,
+    staging,
+)
+from pychemkin_tpu.ops import kinetics
+
+from test_jacobian import THERM_AB
+
+TINY_MECH = ("ELEMENTS\nH\nEND\nSPECIES\nA B\nEND\n"
+             "REACTIONS\nA<=>B 5.0E10 0.5 3000.0\n"
+             "A+M<=>B+M 1.0E10 0.0 0.0\nA/2.5/ B/0.5/\nEND\n")
+
+
+def _counters():
+    return dict(telemetry.get_recorder().snapshot(write=False)["counters"])
+
+
+def _delta(before, name):
+    return _counters().get(name, 0) - before.get(name, 0)
+
+
+@pytest.fixture
+def fresh_cache(tmp_path, monkeypatch):
+    """Point the staging disk cache at an empty tmp dir and drop the
+    process memo, so each test sees a cold cache."""
+    d = str(tmp_path / "staging")
+    monkeypatch.setenv(staging.STAGING_DIR_ENV, d)
+    staging.clear_memo()
+    yield d
+    staging.clear_memo()
+
+
+def _parse():
+    return load_mechanism_from_strings(TINY_MECH, thermo_text=THERM_AB)
+
+
+def _entry_path(rec):
+    return staging._cache_path(rec.rop_stage.sig)
+
+
+def _stages_equal(a, b):
+    assert a.sig == b.sig
+    for name in staging._ARRAY_FIELDS:
+        np.testing.assert_array_equal(getattr(a, name), getattr(b, name))
+
+
+class TestStagingCache:
+    def test_first_parse_emits_and_banks(self, fresh_cache):
+        before = _counters()
+        rec = _parse()
+        assert rec.rop_stage is not None
+        assert _delta(before, "staging.emit") == 1
+        assert os.path.exists(_entry_path(rec))
+
+    def test_second_parse_is_memo_hit(self, fresh_cache):
+        rec = _parse()
+        before = _counters()
+        rec2 = _parse()
+        assert _delta(before, "staging.emit") == 0
+        assert _delta(before, "staging.memo_hit") == 1
+        # the memo returns the SAME staged object: zero re-emission
+        assert rec2.rop_stage is rec.rop_stage
+
+    def test_disk_hit_after_memo_clear(self, fresh_cache):
+        rec = _parse()
+        staging.clear_memo()
+        before = _counters()
+        rec2 = _parse()
+        assert _delta(before, "staging.emit") == 0
+        assert _delta(before, "staging.cache_hit") == 1
+        _stages_equal(rec2.rop_stage, rec.rop_stage)
+
+    def test_corrupt_entry_restages_with_event(self, fresh_cache):
+        rec = _parse()
+        path = _entry_path(rec)
+        with open(path, "wb") as f:
+            f.write(b"this is not an npz archive")
+        staging.clear_memo()
+        before = _counters()
+        rec2 = _parse()
+        # degraded to re-emission, flagged, and the kernel is correct
+        assert _delta(before, "staging.cache_corrupt") == 1
+        assert _delta(before, "staging.emit") == 1
+        ev = telemetry.get_recorder().last_event("staging.cache_corrupt")
+        assert ev is not None and ev["path"] == path
+        _stages_equal(rec2.rop_stage, rec.rop_stage)
+        # the overwritten entry is valid again: next cold parse hits
+        staging.clear_memo()
+        before = _counters()
+        _parse()
+        assert _delta(before, "staging.cache_hit") == 1
+
+    def test_stale_signature_restages(self, fresh_cache):
+        rec = _parse()
+        path = _entry_path(rec)
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        arrays["sig"] = np.asarray("deadbeef" * 8)
+        np.savez(path, **arrays)
+        staging.clear_memo()
+        before = _counters()
+        rec2 = _parse()
+        assert _delta(before, "staging.cache_corrupt") == 1
+        assert _delta(before, "staging.emit") == 1
+        _stages_equal(rec2.rop_stage, rec.rop_stage)
+
+    def test_out_of_bounds_entry_restages(self, fresh_cache):
+        """A bit-rotted index array must be caught by validation, not
+        become an out-of-bounds gather inside a compiled kernel."""
+        rec = _parse()
+        path = _entry_path(rec)
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        bad = arrays["of_sp"].copy()
+        bad[0] = 999
+        arrays["of_sp"] = bad
+        np.savez(path, **arrays)
+        staging.clear_memo()
+        before = _counters()
+        rec2 = _parse()
+        assert _delta(before, "staging.cache_corrupt") == 1
+        _stages_equal(rec2.rop_stage, rec.rop_stage)
+
+    def test_inbounds_permutation_restages(self, fresh_cache):
+        """An IN-BOUNDS corruption (permuted segment ids / decoupled
+        jac_seg) must also be caught: the segment-sums declare
+        indices_are_sorted=True, so a permuted entry would be a
+        silently wrong kernel, not a crash."""
+        rec = _parse()
+        path = _entry_path(rec)
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        seg = arrays["jac_seg"].copy()
+        seg[0], seg[-1] = seg[-1], seg[0]
+        arrays["jac_seg"] = seg
+        np.savez(path, **arrays)
+        staging.clear_memo()
+        before = _counters()
+        rec2 = _parse()
+        assert _delta(before, "staging.cache_corrupt") == 1
+        _stages_equal(rec2.rop_stage, rec.rop_stage)
+
+    def test_disabled_disk_layer(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(staging.STAGING_DIR_ENV, "")
+        staging.clear_memo()
+        before = _counters()
+        rec = _parse()
+        assert rec.rop_stage is not None
+        assert _delta(before, "staging.emit") == 1
+        assert staging.staging_cache_dir() is None
+
+    def test_cross_mechanism_isolation(self, fresh_cache):
+        """Two different mechanisms stage under different signatures —
+        a cache entry can never answer for foreign chemistry."""
+        rec = _parse()
+        h2o2 = load_embedded("h2o2")
+        assert h2o2.rop_stage.sig != rec.rop_stage.sig
+        assert _entry_path(h2o2) != _entry_path(rec)
+
+
+class TestStagedRecordSemantics:
+    def test_stage_is_jit_static(self, fresh_cache):
+        """The staged kernel rides the record as STATIC pytree aux:
+        jit over a staged record compiles and the sparse path engages
+        (closure case) without hashing array contents."""
+        rec = _parse()
+        C = jnp.array([2e-6, 5e-7])
+        with kinetics.rop_mode("sparse"):
+            w = jax.jit(
+                lambda T: kinetics.net_production_rates(rec, T, C))(1100.0)
+        assert np.all(np.isfinite(np.asarray(w)))
+
+    def test_equality_and_hash_by_signature(self, fresh_cache):
+        rec = _parse()
+        staging.clear_memo()
+        rec2 = _parse()     # disk round-trip: distinct object, same sig
+        assert rec.rop_stage == rec2.rop_stage
+        assert hash(rec.rop_stage) == hash(rec2.rop_stage)
+        h2o2 = load_embedded("h2o2")
+        assert rec.rop_stage != h2o2.rop_stage
+
+    def test_emission_is_deterministic(self, fresh_cache):
+        rec = _parse()
+        _stages_equal(staging.stage_rop_kernel(rec),
+                      staging.stage_rop_kernel(rec))
+
+    def test_rate_edits_keep_stage(self, fresh_cache):
+        rec = _parse()
+        assert rec.with_A_factor(0, 2.0).rop_stage is rec.rop_stage
+        assert rec.with_rate_multipliers(3.0).rop_stage is rec.rop_stage
+
+    def test_attach_failure_degrades_to_unstaged(self, monkeypatch):
+        """A staging crash must never kill a parse: the record comes
+        back unstaged (dense fallback) with a telemetry event."""
+        def boom(record, sig=None):
+            raise RuntimeError("staging exploded")
+
+        monkeypatch.setattr(staging, "load_or_stage", boom)
+        rec = _parse()
+        assert rec.rop_stage is None
+        ev = telemetry.get_recorder().last_event("staging.failed")
+        assert ev is not None and "staging exploded" in ev["error"]
+
+    def test_index_structure_matches_record(self, fresh_cache):
+        rec = _parse()
+        st = rec.rop_stage
+        ord_f = np.asarray(rec.order_f)
+        rxn, sp = np.nonzero(ord_f)
+        np.testing.assert_array_equal(st.of_rxn, rxn)
+        np.testing.assert_array_equal(st.of_sp, sp)
+        rev = np.where(np.asarray(rec.reversible))[0]
+        np.testing.assert_array_equal(st.rev_rows, rev)
+        # tb rows: third body OR falloff, matching the record fields
+        np.testing.assert_array_equal(st.tb_rows,
+                                      np.asarray(rec.jac_tb_rows))

@@ -1,0 +1,339 @@
+"""Sparse-vs-dense agreement of the mechanism-specialized ROP kernels
+(``ops/kinetics.py``, ISSUE 11).
+
+The dense masked-matmul kernel is the oracle: the staged sparse path
+(compact falloff/reverse/third-body rows + COO segment-sum
+concentration products) must agree with it at f64 ~1e-12
+scale-relative on both embedded mechanisms, on the per-reaction-type
+tiny records, and in the ``_safe_exp``/zero-concentration clamp
+regions — and the dense fallback must engage (not miscompile) for
+records whose leaves are traced or that carry no staged kernel.
+End-to-end: ``solve_batch``/``solve_psr`` results agree dense-vs-sparse
+on both embedded mechanisms.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu.constants import P_ATM, R_GAS
+from pychemkin_tpu.mechanism import load_embedded, load_mechanism_from_strings
+from pychemkin_tpu.ops import jacobian, kinetics, psr, reactors, thermo
+
+from test_jacobian import THERM_AB
+
+#: f64 sparse-vs-dense bound: both paths run the same per-row scalar
+#: formulas; only summation order differs (segment-sum vs matvec), so
+#: the agreement is summation-roundoff tight
+TOL = 1e-12
+
+
+def _tiny(reactions, extra=""):
+    mech = ("ELEMENTS\nH\nEND\nSPECIES\nA B\nEND\n"
+            "REACTIONS" + extra + "\n" + reactions + "\nEND\n")
+    return load_mechanism_from_strings(mech, thermo_text=THERM_AB)
+
+
+@pytest.fixture(scope="module")
+def h2o2():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def grisyn():
+    return load_embedded("grisyn")
+
+
+@pytest.fixture(scope="module")
+def ch4global():
+    return load_embedded("ch4global")
+
+
+def _rel(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return float(np.abs(a - b).max() / max(np.abs(b).max(), 1e-300))
+
+
+def _both_modes(fn):
+    """Evaluate ``fn`` freshly traced under each ROP mode."""
+    with kinetics.rop_mode("dense"):
+        dense = jax.jit(lambda: fn())()
+    with kinetics.rop_mode("sparse"):
+        sparse = jax.jit(lambda: fn())()
+    return sparse, dense
+
+
+def _check_state(mech, T, C, P=None, tol=TOL):
+    """Sparse-vs-dense agreement of every ROP intermediate, the net
+    production rates, and the analytical Jacobian core at one state."""
+    assert mech.rop_stage is not None, "fixture must be parser-staged"
+
+    def eval_all():
+        r = kinetics.rop_intermediates(mech, T, C, P)
+        w = kinetics.net_production_rates(mech, T, C, P)
+        d = jacobian.kinetics_derivatives(mech, T, C, P)
+        return r.kf, r.kr, r.arg_f, r.arg_r, r.qf, r.qr, w, \
+            d.dwdot_dC, d.dwdot_dT
+
+    sp, de = _both_modes(eval_all)
+    names = ("kf", "kr", "arg_f", "arg_r", "qf", "qr", "wdot",
+             "dwdot_dC", "dwdot_dT")
+    for name, s, d in zip(names, sp, de):
+        assert _rel(s, d) < tol, (name, _rel(s, d))
+
+
+def _random_C(mech, seed, scale=1e-6):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(np.abs(rng.normal(scale, scale / 2,
+                                         mech.n_species)) + 1e-12)
+
+
+class TestModeResolution:
+    """The PYCHEMKIN_ROP_MODE knob and its trace-time override."""
+
+    def test_default_auto_by_platform(self, monkeypatch):
+        monkeypatch.delenv(kinetics.ROP_MODE_ENV, raising=False)
+        expect = "dense" if jax.default_backend() == "tpu" else "sparse"
+        assert kinetics.resolve_rop_mode() == expect
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv(kinetics.ROP_MODE_ENV, "dense")
+        assert kinetics.resolve_rop_mode() == "dense"
+        monkeypatch.setenv(kinetics.ROP_MODE_ENV, "sparse")
+        assert kinetics.resolve_rop_mode() == "sparse"
+
+    def test_invalid_env_rejected(self, monkeypatch):
+        monkeypatch.setenv(kinetics.ROP_MODE_ENV, "blas")
+        with pytest.raises(ValueError, match="PYCHEMKIN_ROP_MODE"):
+            kinetics.resolve_rop_mode()
+
+    def test_override_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv(kinetics.ROP_MODE_ENV, "dense")
+        with kinetics.rop_mode("sparse"):
+            assert kinetics.resolve_rop_mode() == "sparse"
+        assert kinetics.resolve_rop_mode() == "dense"
+
+    def test_invalid_override_rejected(self):
+        with pytest.raises(ValueError):
+            with kinetics.rop_mode("fast"):
+                pass
+
+    def test_sparse_requires_stage(self, h2o2):
+        bare = dataclasses.replace(h2o2, rop_stage=None)
+        with kinetics.rop_mode("sparse"):
+            assert kinetics._sparse_stage(bare) is None
+            assert kinetics._sparse_stage(h2o2) is h2o2.rop_stage
+
+
+class TestEmbeddedMechanisms:
+    """Full-mechanism sparse-vs-dense agreement at f64 tightness."""
+
+    @pytest.mark.parametrize("T", [400.0, 1200.0, 2800.0])
+    def test_h2o2(self, h2o2, T):
+        _check_state(h2o2, T, _random_C(h2o2, int(T)))
+
+    @pytest.mark.parametrize("T", [900.0, 1800.0])
+    def test_grisyn(self, grisyn, T):
+        _check_state(grisyn, T, _random_C(grisyn, int(T)))
+
+    def test_ch4global_fractional_ford(self, ch4global):
+        """The order-override mechanism: fractional-FORD entries carry
+        their own concentration floor through the sparse per-entry
+        path."""
+        _check_state(ch4global, 1600.0, _random_C(ch4global, 3))
+
+
+class TestReactionTypes:
+    """Per-type tiny records (same set as test_jacobian): a regression
+    in one compact-row correction cannot hide behind a full mechanism's
+    dominant rows."""
+
+    C2 = jnp.array([2e-6, 5e-7])
+
+    @pytest.mark.parametrize("rxn", [
+        "A<=>B 5.0E10 0.5 3000.0",                                 # plain rev
+        "A=>B 5.0E10 0.0 1000.0",                                  # irrev
+        "A<=>B 1.0E10 0.0 0.0\nREV/3.0E9 0.7 500.0/",              # REV
+        "A<=>B 5.0E10 0.0 0.0\nDUP\nA<=>B -2.0E10 0.3 100.0\nDUP",  # neg-A
+        "A+M<=>B+M 1.0E10 0.0 0.0\nA/2.5/ B/0.5/",                 # 3rd body
+        "A(+M)<=>B(+M) 1.0E12 0.0 0.0\nLOW/1.0E14 0.0 0.0/",       # Lindemann
+    ], ids=["plain", "irrev", "rev", "negA-dup", "third-body",
+            "lindemann"])
+    def test_type(self, rxn):
+        _check_state(_tiny(rxn), 1100.0, self.C2)
+
+    @pytest.mark.parametrize("extra", [
+        "LOW/1.0E16 -0.5 200.0/\nTROE/0.6 100.0 2000.0 5000.0/",
+        "LOW/1.0E16 0.0 0.0/\nTROE/0.7 150.0 1500.0/",
+        "LOW/1.0E16 0.0 0.0/\nSRI/0.5 300.0 1200.0 1.2 0.1/",
+    ], ids=["troe4", "troe3", "sri5"])
+    def test_falloff_blends(self, extra):
+        rec = _tiny("A(+M)<=>B(+M) 1.0E12 0.0 0.0\n" + extra)
+        _check_state(rec, 1100.0, jnp.array([5e-5, 2e-5]))
+
+    def test_chem_activated(self):
+        rec = _tiny("A(+M)<=>B(+M) 1.0E6 0.0 0.0\n"
+                    "HIGH/1.0E12 0.0 0.0/\nTROE/0.6 100.0 2000.0/")
+        _check_state(rec, 1000.0, jnp.array([1e-6, 1e-6]))
+
+    def test_plog_explicit_pressure(self):
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\n"
+                    "PLOG/0.1  1.0E8  0.0 1000.0/\n"
+                    "PLOG/1.0  1.0E10 0.5 2000.0/\n"
+                    "PLOG/10.0 1.0E12 0.0 3000.0/")
+        _check_state(rec, 1000.0, self.C2, P=0.4 * P_ATM)
+
+    def test_plog_reconstructed_pressure(self):
+        rec = _tiny("A<=>B 1.0E10 0.0 0.0\n"
+                    "PLOG/0.1  1.0E8  0.0 1000.0/\n"
+                    "PLOG/1.0  1.0E10 0.5 2000.0/\n"
+                    "PLOG/10.0 1.0E12 0.0 3000.0/")
+        T = 1000.0
+        C = jnp.array([1.0, 1.0]) * (0.4 * P_ATM / (R_GAS * T) / 2)
+        _check_state(rec, T, C, P=None)
+
+
+class TestClampRegions:
+    """The _safe_exp / floor clamp regions: the sparse path applies the
+    same clamps per entry, so agreement must hold where derivatives
+    are gated to zero."""
+
+    def test_conc_product_clamp_high(self):
+        rec = _tiny("A+A+A=>B+B+B 1.0E1 0.0 0.0")
+        T, C = 1000.0, jnp.array([1e13, 1e0])
+        with kinetics.rop_mode("sparse"):
+            r = kinetics.rop_intermediates(rec, T, C)
+        assert float(r.arg_f[0]) > 85.0
+        _check_state(rec, T, C)
+
+    def test_zero_concentration_floor(self):
+        rec = _tiny("A+B=>B+B 1.0E10 0.0 0.0\nA<=>B 1.0E8 0.0 0.0")
+        _check_state(rec, 1000.0, jnp.array([1e-6, 0.0]))
+
+    def test_arrhenius_exp_clamp(self):
+        # asymmetric concentrations: with C_A == C_B the net q cancels
+        # EXACTLY at the clamped ~1e36 rate-constant scale, and a
+        # last-ulp path difference would dominate the scale-relative
+        # norm of an identically-zero wdot
+        rec = _tiny("A<=>B 1.0E30 10.0 0.0")
+        _check_state(rec, 2000.0, jnp.array([1e-6, 3e-6]))
+
+
+class TestDenseFallback:
+    """The sparse path is a REQUEST: traced records and unstaged
+    records must take the dense kernels, never miscompile."""
+
+    def test_jit_over_traced_record(self, h2o2):
+        """A staged record passed as a jit ARGUMENT has traced leaves:
+        the trace-time numpy probe must fall back to the dense kernel
+        and still produce the right answer."""
+        T, C = 1200.0, _random_C(h2o2, 7)
+        with kinetics.rop_mode("sparse"):
+            w_traced = jax.jit(
+                lambda m: kinetics.net_production_rates(m, T, C))(h2o2)
+        with kinetics.rop_mode("dense"):
+            w_dense = kinetics.net_production_rates(h2o2, T, C)
+        assert _rel(w_traced, w_dense) < TOL
+
+    def test_jit_over_traced_record_jacobian(self, h2o2):
+        T, C = 1200.0, _random_C(h2o2, 8)
+        with kinetics.rop_mode("sparse"):
+            d = jax.jit(
+                lambda m: jacobian.kinetics_derivatives(m, T, C))(h2o2)
+        with kinetics.rop_mode("dense"):
+            d0 = jacobian.kinetics_derivatives(h2o2, T, C)
+        assert _rel(d.dwdot_dC, d0.dwdot_dC) < TOL
+        assert _rel(d.dwdot_dT, d0.dwdot_dT) < TOL
+
+    def test_handbuilt_record_unstaged(self, h2o2):
+        """Stripping the stage forces the dense kernel even under
+        sparse mode — and results match the staged sparse path."""
+        bare = dataclasses.replace(h2o2, rop_stage=None)
+        T, C = 1200.0, _random_C(h2o2, 9)
+        with kinetics.rop_mode("sparse"):
+            w_bare = kinetics.net_production_rates(bare, T, C)
+            w_staged = kinetics.net_production_rates(h2o2, T, C)
+        assert _rel(w_staged, w_bare) < TOL
+
+    def test_rate_multiplier_record_keeps_stage(self, h2o2):
+        """with_rate_multipliers edits rate data, not stoichiometry:
+        the staged index sets stay valid and the sparse kernel tracks
+        the new A-factors."""
+        mult = h2o2.with_rate_multipliers(2.0)
+        assert mult.rop_stage is h2o2.rop_stage
+        T, C = 1200.0, _random_C(h2o2, 10)
+
+        def eval_q():
+            return kinetics.rates_of_progress(mult, T, C)[0]
+
+        sp, de = _both_modes(eval_q)
+        assert _rel(sp, de) < TOL
+
+
+class TestEndToEnd:
+    """solve_batch / solve_psr dense-vs-sparse agreement — the
+    ISSUE-11 acceptance on both embedded mechanisms. The stiff solvers
+    take adaptively different step sequences under last-bit kernel
+    differences, so agreement here is solver-level, not roundoff-level."""
+
+    @staticmethod
+    def _ignition(mech, mech_name, t_end, T0):
+        names = list(mech.species_names)
+        X = np.zeros(len(names))
+        X[names.index("H2")] = 2.0
+        X[names.index("O2")] = 1.0
+        X[names.index("N2")] = 3.76
+        Y0 = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+
+        def run():
+            sol = reactors.solve_batch(mech, "CONP", "ENRG", T0,
+                                       1.01325e6, jnp.asarray(Y0), t_end,
+                                       n_out=2)
+            return sol.ignition_time, sol.T[-1], sol.Y[-1], sol.success
+
+        return run
+
+    @pytest.mark.parametrize("mech_name,t_end,T0", [
+        ("h2o2", 2e-4, 1200.0), ("grisyn", 5e-5, 1300.0)])
+    def test_solve_batch_agrees(self, request, mech_name, t_end, T0):
+        mech = request.getfixturevalue(
+            "h2o2" if mech_name == "h2o2" else "grisyn")
+        run = self._ignition(mech, mech_name, t_end, T0)
+        (tau_s, T_s, Y_s, ok_s), (tau_d, T_d, Y_d, ok_d) = \
+            _both_modes(run)
+        assert bool(np.asarray(ok_s)) and bool(np.asarray(ok_d))
+        assert np.asarray(T_s) == pytest.approx(np.asarray(T_d),
+                                                rel=1e-5)
+        assert _rel(Y_s, Y_d) < 1e-4
+        if np.isfinite(np.asarray(tau_d)):
+            assert np.asarray(tau_s) == pytest.approx(
+                np.asarray(tau_d), rel=1e-3)
+
+    @pytest.mark.parametrize("mech_name", ["h2o2", "grisyn"])
+    def test_solve_psr_agrees(self, request, mech_name):
+        mech = request.getfixturevalue(mech_name)
+        names = list(mech.species_names)
+        X = np.zeros(len(names))
+        X[names.index("H2")] = 2.0
+        X[names.index("O2")] = 1.0
+        X[names.index("N2")] = 3.76
+        Y_in = np.asarray(thermo.X_to_Y(mech, jnp.asarray(X / X.sum())))
+        h_in = float(thermo.mixture_enthalpy_mass(
+            mech, 700.0, jnp.asarray(Y_in)))
+
+        def run():
+            sol = psr.solve_psr(
+                mech, psr.MODE_TAU, "ENRG", P=1.01325e6,
+                Y_in=jnp.asarray(Y_in), h_in=h_in, T_guess=2200.0,
+                Y_guess=jnp.asarray(Y_in), tau=1e-3)
+            return sol.T, sol.Y, sol.converged
+
+        (T_s, Y_s, ok_s), (T_d, Y_d, ok_d) = _both_modes(run)
+        assert bool(np.asarray(ok_s)) == bool(np.asarray(ok_d))
+        assert np.asarray(T_s) == pytest.approx(np.asarray(T_d),
+                                                rel=1e-6)
+        assert _rel(Y_s, Y_d) < 1e-6

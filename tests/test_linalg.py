@@ -215,3 +215,100 @@ def test_matrix_rhs_column_semantics():
     fac32 = linalg.Factorization(lu=lu32, piv=None, A=jnp.asarray(M))
     X32 = np.asarray(linalg.solve_factored(fac32, jnp.asarray(B)))
     np.testing.assert_allclose(X32, X_ref, rtol=1e-9)
+
+
+class TestBorderedSolve:
+    """Bordered (Schur-complement) factorization — the structured
+    Newton solve of ISSUE 11: factor the leading [N-1, N-1] species
+    block, eliminate the border row/column through the Schur scalar.
+    Exact-path solves ride the batch-vectorized scan sweeps on the
+    PIVOTED factor (see linalg._block_solve)."""
+
+    @pytest.mark.parametrize("n", [2, 5, 54])
+    def test_exact_path_matches_numpy(self, n):
+        rng = np.random.default_rng(n)
+        M = _newton_like(rng, n)
+        b = rng.normal(size=n)
+        bf = linalg.factor_bordered(jnp.asarray(M))
+        x = np.asarray(linalg.solve_bordered(bf, jnp.asarray(b)))
+        np.testing.assert_allclose(M @ x, b, rtol=0,
+                                   atol=1e-9 * np.abs(b).max())
+
+    def test_batched_vmap_shape(self):
+        """The odeint shape: vmapped per-element factor + solve."""
+        import jax
+
+        rng = np.random.default_rng(3)
+        Ms = np.stack([_newton_like(rng, 11) for _ in range(6)])
+        bs = rng.normal(size=(6, 11))
+        bf = jax.vmap(linalg.factor_bordered)(jnp.asarray(Ms))
+        x = np.asarray(jax.vmap(linalg.solve_bordered)(bf,
+                                                       jnp.asarray(bs)))
+        x_ref = np.linalg.solve(Ms, bs[..., None])[..., 0]
+        np.testing.assert_allclose(x, x_ref, rtol=1e-8, atol=1e-12)
+
+    def test_mixed_path_refinement_recovers_f64(self):
+        rng = np.random.default_rng(7)
+        M = _newton_like(rng, 12)
+        b = rng.normal(size=12)
+        x_ref = np.linalg.solve(M, b)
+        bf = linalg.factor_bordered(jnp.asarray(M), mixed=True)
+        assert bf.M is not None        # full matrix kept for refinement
+        x0 = np.asarray(linalg.solve_bordered(bf, jnp.asarray(b),
+                                              refine=0))
+        x2 = np.asarray(linalg.solve_bordered(bf, jnp.asarray(b),
+                                              refine=2))
+        err0 = np.abs(x0 - x_ref).max()
+        err2 = np.abs(x2 - x_ref).max()
+        assert err2 < 1e-10 * max(np.abs(x_ref).max(), 1.0)
+        assert err2 <= err0
+
+    def test_decoupled_border(self):
+        """c = 0, b = 0 (a TGIV-style system): the border solves
+        independently and the species block is untouched by it."""
+        rng = np.random.default_rng(9)
+        M = _newton_like(rng, 6)
+        M[-1, :-1] = 0.0
+        M[:-1, -1] = 0.0
+        M[-1, -1] = 1.0
+        b = rng.normal(size=6)
+        bf = linalg.factor_bordered(jnp.asarray(M))
+        x = np.asarray(linalg.solve_bordered(bf, jnp.asarray(b)))
+        np.testing.assert_allclose(M @ x, b, rtol=0, atol=1e-10)
+        assert x[-1] == pytest.approx(b[-1])
+
+    def test_schur_scalar_clamped(self):
+        """A singular Schur complement (border linearly dependent on
+        the block) must clamp, not divide by zero into NaN."""
+        M = np.eye(4)
+        M[-1, -1] = 0.0
+        M[-1, 0] = 1.0
+        M[0, -1] = 1.0
+        M[0, 0] = 1.0    # d - c A^{-1} b = 0 - 1 = -1 ... make it 0:
+        M[-1, -1] = 1.0  # now d_schur = 1 - 1 = 0 -> clamped
+        b = np.ones(4)
+        bf = linalg.factor_bordered(jnp.asarray(M))
+        x = np.asarray(linalg.solve_bordered(bf, jnp.asarray(b)))
+        assert np.all(np.isfinite(x))
+
+    def test_solve_with_info_bordered_agrees(self):
+        rng = np.random.default_rng(11)
+        M = _newton_like(rng, 10)
+        b = rng.normal(size=10)
+        x_ref = np.linalg.solve(M, b)
+        x, unstable = linalg.solve_with_info(jnp.asarray(M),
+                                             jnp.asarray(b),
+                                             bordered=True,
+                                             row_equilibrate=True)
+        np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-8)
+        assert not bool(np.asarray(unstable))
+
+    def test_solve_with_info_bordered_flags_singular(self):
+        """The full-system instability check still guards a bordered
+        solve: a (numerically) singular system must flag unstable."""
+        M = np.ones((5, 5)) * 1e-3   # rank 1
+        b = np.arange(1.0, 6.0)      # NOT in range(M): residual can't vanish
+        _, unstable = linalg.solve_with_info(jnp.asarray(M),
+                                             jnp.asarray(b),
+                                             bordered=True)
+        assert bool(np.asarray(unstable))

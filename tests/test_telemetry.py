@@ -493,14 +493,15 @@ class TestDeviceCounterBridge:
 #: every key a bench rung JSON line must carry — the banked-summary
 #: schema consumers (post-mortems, VERDICT parsing) rely on, including
 #: the resilience counters added by ISSUE 3, the durability fields
-#: (driver-run sweeps) added by ISSUE 4, and the Jacobian-mode /
-#: mechanism-sparsity fields added by ISSUE 6
+#: (driver-run sweeps) added by ISSUE 4, the Jacobian-mode /
+#: mechanism-sparsity fields added by ISSUE 6, and the ROP kernel
+#: mode (sparse/dense primal kinetics path) added by ISSUE 11
 RUNG_SCHEMA_KEYS = (
     "platform", "n_chips", "mech", "B", "chunk", "compile_s", "run_s",
     "throughput", "rtol", "atol", "t_end", "n_ok", "n_ignited",
     "n_steps", "n_rejected", "n_newton", "steps_per_sec",
     "model_f32_gflop", "model_f64_gflop", "mfu_pct",
-    "jac_mode", "nu_nnz_frac", "n_species_active",
+    "jac_mode", "rop_mode", "nu_nnz_frac", "n_species_active",
     "n_failed", "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
 )
@@ -508,7 +509,7 @@ RUNG_SCHEMA_KEYS = (
 #: rung keys that _build_summary must forward into configs_run
 CONFIGS_RUN_KEYS = (
     "mech", "B", "chunk", "throughput", "mfu_pct", "n_failed",
-    "jac_mode", "nu_nnz_frac", "n_species_active",
+    "jac_mode", "rop_mode", "nu_nnz_frac", "n_species_active",
     "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
 )
@@ -523,8 +524,8 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
         "n_steps": 100 * B,
         "n_rejected": B, "n_newton": 400 * B, "steps_per_sec": 1e5,
         "model_f32_gflop": 1.0, "model_f64_gflop": 0.1, "mfu_pct": 1.5,
-        "jac_mode": "analytic", "nu_nnz_frac": 0.32,
-        "n_species_active": 10,
+        "jac_mode": "analytic", "rop_mode": "dense",
+        "nu_nnz_frac": 0.32, "n_species_active": 10,
         "n_failed": n_failed, "n_rescued": max(n_failed - 1, 0),
         "n_abandoned": min(n_failed, 1),
         "status_counts": ({"OK": B - 1, "NONFINITE": 1} if n_failed
@@ -812,6 +813,9 @@ class TestBenchRungSchema:
         assert rung["jac_mode"] == "analytic"
         assert 0.0 < rung["nu_nnz_frac"] < 1.0
         assert rung["n_species_active"] == 10   # h2o2: all 10 species
+        # ISSUE 11: the rung says which primal ROP kernel it timed
+        # (resolved PYCHEMKIN_ROP_MODE: sparse on this CPU child)
+        assert rung["rop_mode"] in ("sparse", "dense")
 
 
 class TestServeRungSchema:
@@ -943,10 +947,24 @@ class TestAblationTool:
         comp = art["components"]
         for key in ("rhs_f64", "rhs_f32", "jac_f64", "jac_f32",
                     "lu_nopivot_f32", "lu_pivoted_f32", "tri_solve_f32",
-                    "tri_solve_refine2"):
+                    "tri_solve_refine2",
+                    # ISSUE 11: sparse-kernel + bordered-solve components
+                    "rhs_sparse_f64", "rhs_sparse_f32", "jac_sparse_f64",
+                    "jac_sparse_f32", "lu_bordered", "solve_bordered"):
             assert comp[key]["run_s"] > 0.0
-        shares = art["attempt_model"]
-        total = (shares["jac_pct"] + shares["lu_pct"]
-                 + shares["newton_rhs_solve_pct"]
-                 + shares["err_filter_pct"])
-        assert abs(total - 100.0) < 0.5
+        # twin attempt models: sparse hot path + the PR-6-comparable
+        # dense twin + the retired AD build, each summing to 100%
+        for model in ("attempt_model", "attempt_model_dense",
+                      "attempt_model_ad"):
+            shares = art[model]
+            total = (shares["jac_pct"] + shares["lu_pct"]
+                     + shares["newton_rhs_solve_pct"]
+                     + shares["err_filter_pct"])
+            assert abs(total - 100.0) < 0.5
+        # the measured-Newton split rides every model
+        assert art["newton_measured"]["n_newton_per_attempt"] > 0
+        assert art["attempt_model"]["n_newton_measured"] == \
+            art["newton_measured"]["n_newton_per_attempt"]
+        assert art["attempt_model"]["attempt_s_measured"] > 0.0
+        assert art["sparse_vs_dense"]["rhs_speedup_f64"] > 0.0
+        assert art["staged"] is True

@@ -4,11 +4,29 @@ VERDICT round-5 weak #4/#9: the claims "the Jacobian build dominates the
 step cost" and "the f32 Jacobian path is the TPU win" existed only as
 builder prose. This tool turns them into a captured artifact: it times
 each component of one step attempt of the batched stiff integrator —
-RHS evaluation (f64 and f32), the batched ``jacfwd`` Jacobian, the
-pivot-free f32 LU vs the pivoted LU, the triangular solves with 0 and 2
-refinement sweeps — on a [B]-batched representative ignition state, and
-emits one JSON document (atomic tmp+rename via the telemetry sink) plus
-the same JSON on stdout.
+RHS evaluation (dense and mechanism-specialized sparse, f64 and f32),
+the analytical Jacobian under both ROP modes plus the retired
+``jacfwd`` build, the pivot-free f32 LU vs the pivoted LU vs the
+bordered (Schur-complement) factorization, and the triangular /
+bordered solves — on a [B]-batched representative ignition state, and
+emits one JSON document (atomic tmp+rename via the telemetry sink)
+plus the same JSON on stdout.
+
+Three attempt models ride in the artifact:
+
+- ``attempt_model``        — the hot path since ISSUE 11: sparse ROP
+  kernels + analytical Jacobian + bordered Newton solve;
+- ``attempt_model_dense``  — the ISSUE-6 hot path (dense ROP kernels,
+  analytical Jacobian, full-matrix LU), formula-identical to the
+  PR-6 artifact's ``attempt_model`` for cross-round comparability;
+- ``attempt_model_ad``     — the retired dense-AD build (the
+  ``f64_jac`` rescue rung).
+
+Each model reports both the historical ``n_newton_assumed = 6`` split
+(cross-round comparable) and, when ``--measure-newton`` ran (default),
+a second split using the per-attempt Newton iteration count MEASURED
+from a real short pre-ignition integration (odeint's ``n_newton`` /
+attempts from ``solution_stats``).
 
 Runs on whatever backend JAX selects; CI runs it on CPU (the component
 STRUCTURE and the FLOP model are platform-independent; only the
@@ -38,7 +56,8 @@ from pychemkin_tpu import telemetry                        # noqa: E402
 from pychemkin_tpu.benchmarks import _flop_model           # noqa: E402
 from pychemkin_tpu.mechanism import load_embedded          # noqa: E402
 from pychemkin_tpu.ops import (                            # noqa: E402
-    jacobian, linalg, reactors, thermo)
+    jacobian, kinetics, linalg, reactors, thermo)
+from pychemkin_tpu.ops import odeint as odeint_mod         # noqa: E402
 from pychemkin_tpu.ops.odeint import _GAMMA, _cast_floats  # noqa: E402
 
 
@@ -85,7 +104,8 @@ def _problem(mech_name: str, B: int):
     return mech, args, ys
 
 
-def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
+def run_ablation(mech_name: str, B: int, repeats: int,
+                 measure_newton: bool = True) -> dict:
     mech, args, ys = _problem(mech_name, B)
     N = mech.n_species + 1
     rhs = reactors.conp_enrg_rhs
@@ -109,10 +129,11 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
             lambda yy: rhs(jnp.float32(0.0), yy, args32))(y))(
             ys.astype(jnp.float32))
 
-    # the analytical closed-form assembly (ops/jacobian.py) — what the
-    # stiff hot path now runs by default (jac_mode="analytic"); the
-    # jac_f64/jac_f32 AD components above are the retired dense path,
-    # kept as the f64_jac rescue rung
+    # the analytical closed-form assembly (ops/jacobian.py) — the stiff
+    # hot path since ISSUE 6 (jac_mode="analytic"); timed under BOTH
+    # ROP modes (its internal rop_intermediates takes whichever kernel
+    # the trace-time mode selects). jac_f64/jac_f32 above are the
+    # retired dense-AD path, kept as the f64_jac rescue rung.
     def jac_analytic64(ys):
         return jax.vmap(lambda y: jacobian._batch_jac_core(
             "CONP", "ENRG", 0.0, y, args))(ys)
@@ -125,9 +146,10 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
     def newton_matrix(J):
         return jnp.eye(N, dtype=J.dtype) - (h * _GAMMA) * J
 
-    Ms64 = jax.jit(lambda ys: newton_matrix(jac64(ys)))(ys)
-    Ms64 = jax.block_until_ready(Ms64)
-    bs = rhs64(ys)
+    with kinetics.rop_mode("dense"):
+        Ms64 = jax.jit(lambda ys: newton_matrix(jac64(ys)))(ys)
+        Ms64 = jax.block_until_ready(Ms64)
+        bs = rhs64(ys)
 
     def lu_nopivot(Ms):
         return linalg._lu_nopivot(Ms.astype(jnp.float32))
@@ -135,9 +157,18 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
     def lu_pivoted(Ms):
         return jsl.lu_factor(Ms.astype(jnp.float32))[0]
 
+    def lu_bordered(Ms):
+        # the structured factorization the integrator now runs
+        # (platform path: exact scipy LU of the species block on CPU,
+        # pivot-free f32 on TPU) — factor + Schur complement, vmapped
+        # per element exactly as odeint traces it
+        return jax.vmap(linalg.factor_bordered)(Ms)
+
     lus = jax.jit(lu_nopivot)(Ms64)
     lus = jax.block_until_ready(lus)
     fac = linalg.Factorization(lu=lus, piv=None, A=Ms64)
+    bfac = jax.jit(lu_bordered)(Ms64)
+    bfac = jax.block_until_ready(bfac)
 
     def tri_solve(bs):
         return linalg._solve_nopivot(lus, bs.astype(jnp.float32))
@@ -146,60 +177,143 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
         return linalg.solve_factored(fac, bs, refine=2,
                                      residual_check=False)
 
+    def bordered_solve(bs):
+        # one Newton-direction solve from the prebuilt bordered factor
+        return jax.vmap(lambda bf, b: linalg.solve_bordered(
+            bf, b, refine=0))(bfac, bs)
+
     components = {}
-    for name, fn in [
-            ("rhs_f64", jax.jit(rhs64)),
-            ("rhs_f32", jax.jit(rhs32)),
-            ("jac_f64", jax.jit(jac64)),
-            ("jac_f32", jax.jit(jac32)),
-            ("jac_analytic_f64", jax.jit(jac_analytic64)),
-            ("jac_analytic_f32", jax.jit(jac_analytic32)),
-            ("lu_nopivot_f32", jax.jit(lu_nopivot)),
-            ("lu_pivoted_f32", jax.jit(lu_pivoted)),
-    ]:
-        compile_s, run_s = _timed(fn, (Ms64,) if name.startswith("lu")
-                                  else (ys,), repeats)
-        components[name] = {"compile_s": round(compile_s, 4),
-                            "run_s": round(run_s, 6)}
-        print(f"# {name}: {run_s*1e3:.3f} ms/call "
-              f"(compile {compile_s:.2f}s)", file=sys.stderr)
-    for name, fn in [("tri_solve_f32", jax.jit(tri_solve)),
-                     ("tri_solve_refine2", jax.jit(refined_solve))]:
-        compile_s, run_s = _timed(fn, (bs,), repeats)
+
+    def _run(name, fn, call_args):
+        compile_s, run_s = _timed(fn, call_args, repeats)
         components[name] = {"compile_s": round(compile_s, 4),
                             "run_s": round(run_s, 6)}
         print(f"# {name}: {run_s*1e3:.3f} ms/call "
               f"(compile {compile_s:.2f}s)", file=sys.stderr)
 
-    # one SDIRK3 step attempt = 1 Jacobian + 1 LU + (3 stages x ~2
-    # Newton iterations) x (1 f64 RHS + 1 triangular solve) + the error
-    # filter solve; shares from the measured component times. Two
-    # attempt models: the analytical Jacobian (jac_mode="analytic", the
-    # hot-path default since ISSUE 6) and the retired dense-AD build
-    # (the f64_jac rescue rung) — before/after in one artifact.
+    # dense-kernel components (the PR-6 twin's inputs): traced with the
+    # ROP mode pinned dense so the twin stays comparable across rounds
+    # regardless of platform/env defaults
+    with kinetics.rop_mode("dense"):
+        for name, fn in [
+                ("rhs_f64", jax.jit(rhs64)),
+                ("rhs_f32", jax.jit(rhs32)),
+                ("jac_f64", jax.jit(jac64)),
+                ("jac_f32", jax.jit(jac32)),
+                ("jac_analytic_f64", jax.jit(jac_analytic64)),
+                ("jac_analytic_f32", jax.jit(jac_analytic32)),
+        ]:
+            _run(name, fn, (ys,))
+    # mechanism-specialized sparse-kernel components (ISSUE 11).
+    # Fresh lambda wrappers: jit shares its trace cache for an
+    # identical function object, and the ROP mode is a trace-time
+    # decision invisible to that cache — re-jitting ``rhs64`` itself
+    # here would silently reuse the dense trace.
+    with kinetics.rop_mode("sparse"):
+        for name, fn in [
+                ("rhs_sparse_f64", jax.jit(lambda ys: rhs64(ys))),
+                ("rhs_sparse_f32", jax.jit(lambda ys: rhs32(ys))),
+                ("jac_sparse_f64",
+                 jax.jit(lambda ys: jac_analytic64(ys))),
+                ("jac_sparse_f32",
+                 jax.jit(lambda ys: jac_analytic32(ys))),
+        ]:
+            _run(name, fn, (ys,))
+    for name, fn in [("lu_nopivot_f32", jax.jit(lu_nopivot)),
+                     ("lu_pivoted_f32", jax.jit(lu_pivoted)),
+                     ("lu_bordered", jax.jit(lu_bordered))]:
+        _run(name, fn, (Ms64,))
+    for name, fn in [("tri_solve_f32", jax.jit(tri_solve)),
+                     ("tri_solve_refine2", jax.jit(refined_solve)),
+                     ("solve_bordered", jax.jit(bordered_solve))]:
+        _run(name, fn, (bs,))
+
+    # measured per-attempt Newton iteration count: a real (short,
+    # pre-ignition) integration of the same batched problem through
+    # odeint; n_newton / (n_steps + n_rejected) replaces the historical
+    # assumed 6 (= 3 stages x ~2 iterations) in the *_measured split
+    newton_measured = None
+    if measure_newton:
+        jac_fn = jacobian.batch_rhs_jacobian("CONP", "ENRG")
+        ts = jnp.array([0.0, 1e-6])
+        atol_vec = jnp.full((N,), 1e-12).at[-1].set(1e-8)
+        sol = jax.jit(jax.vmap(lambda y: odeint_mod.odeint(
+            rhs, y, ts, args, rtol=1e-6, atol=atol_vec,
+            jac=jac_fn)))(ys)
+        stats = odeint_mod.solution_stats(sol, label="ablate_measure",
+                                          emit=False)
+        attempts = stats["n_steps"] + stats["n_rejected"]
+        newton_measured = {
+            "t_horizon_s": 1e-6,
+            "n_steps": stats["n_steps"],
+            "n_rejected": stats["n_rejected"],
+            "n_newton": stats["n_newton"],
+            "n_newton_per_attempt": round(
+                stats["n_newton"] / max(attempts, 1), 3),
+        }
+        print(f"# measured newton/attempt: "
+              f"{newton_measured['n_newton_per_attempt']}",
+              file=sys.stderr)
+
+    # one SDIRK3 step attempt = 1 Jacobian + 1 factorization + (3
+    # stages x ~2 Newton iterations) x (1 f64 RHS + 1 solve) + the
+    # error filter solve; shares from the measured component times.
     n_newton = 6
     mixed = linalg.use_mixed_precision()
-    lu_key = "lu_nopivot_f32" if mixed else "lu_pivoted_f32"
-    t_lu = components[lu_key]["run_s"]
-    t_newton = n_newton * (components["rhs_f64"]["run_s"]
-                           + components["tri_solve_f32"]["run_s"])
-    t_err = components["tri_solve_f32"]["run_s"]
 
-    def attempt_model(jac_key):
+    def attempt_model(jac_key, lu_key, rhs_key, solve_key):
         t_jac = components[jac_key]["run_s"]
-        t_attempt = t_jac + t_lu + t_newton + t_err
-        return {
+        t_lu = components[lu_key]["run_s"]
+        t_rhs = components[rhs_key]["run_s"]
+        t_solve = components[solve_key]["run_s"]
+
+        def split(n):
+            t_newton = n * (t_rhs + t_solve)
+            t_attempt = t_jac + t_lu + t_newton + t_solve
+            return t_attempt, t_newton
+
+        t_attempt, t_newton = split(n_newton)
+        out = {
             "n_newton_assumed": n_newton,
             "jac_component": jac_key,
+            "lu_component": lu_key,
+            "rhs_component": rhs_key,
+            "solve_component": solve_key,
             "attempt_s": round(t_attempt, 6),
             "jac_pct": round(100 * t_jac / t_attempt, 2),
             "lu_pct": round(100 * t_lu / t_attempt, 2),
             "newton_rhs_solve_pct": round(100 * t_newton / t_attempt, 2),
-            "err_filter_pct": round(100 * t_err / t_attempt, 2),
+            "err_filter_pct": round(100 * t_solve / t_attempt, 2),
         }
+        if newton_measured is not None:
+            n_meas = newton_measured["n_newton_per_attempt"]
+            t_att_m, t_new_m = split(n_meas)
+            out["n_newton_measured"] = n_meas
+            out["attempt_s_measured"] = round(t_att_m, 6)
+            out["newton_rhs_solve_pct_measured"] = round(
+                100 * t_new_m / t_att_m, 2)
+        return out
 
+    lu_key = "lu_nopivot_f32" if mixed else "lu_pivoted_f32"
     f32_flop, f64_flop = _flop_model(mech, n_steps=1, n_rejected=0,
                                      n_newton=n_newton)
+
+    # the HOT PATH this platform actually runs: sparse ROP only where
+    # resolve_rop_mode() lands there for a staged record (CPU by
+    # default — on TPU the integrator runs the dense kernels, and the
+    # headline model must describe that path, not the sparse twin)
+    hot_mode = (kinetics.resolve_rop_mode()
+                if mech.rop_stage is not None else "dense")
+    if hot_mode == "sparse":
+        hot = attempt_model(
+            "jac_sparse_f32" if mixed else "jac_sparse_f64",
+            "lu_bordered",
+            "rhs_sparse_f32" if mixed else "rhs_sparse_f64",
+            "solve_bordered")
+    else:
+        hot = attempt_model(
+            "jac_analytic_f32" if mixed else "jac_analytic_f64",
+            "lu_bordered", "rhs_f64", "solve_bordered")
 
     out = {
         "tool": "ablate_step_cost",
@@ -210,13 +324,23 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
         "repeats": repeats,
         "components": components,
         "sparsity": jacobian.sparsity_stats(mech),
-        # the hot path's attempt (analytical Jacobian, the default)
-        "attempt_model": attempt_model(
-            "jac_analytic_f32" if mixed else "jac_analytic_f64"),
-        # the retired dense-AD attempt (f64_jac rescue rung) — the
-        # "before" split this artifact's earlier revisions reported
+        "newton_measured": newton_measured,
+        "staged": mech.rop_stage is not None,
+        "rop_mode": hot_mode,
+        # the hot path's attempt since ISSUE 11: the resolved ROP
+        # kernel (sparse on staged-CPU, dense on TPU) + analytical
+        # Jacobian + bordered (Schur-complement) solve
+        "attempt_model": hot,
+        # the ISSUE-6 hot path (dense ROP, analytical Jacobian, full
+        # LU) — formula-identical to the PR-6 artifact's attempt_model,
+        # the cross-round comparability twin
+        "attempt_model_dense": attempt_model(
+            "jac_analytic_f32" if mixed else "jac_analytic_f64",
+            lu_key, "rhs_f64", "tri_solve_f32"),
+        # the retired dense-AD attempt (f64_jac rescue rung)
         "attempt_model_ad": attempt_model(
-            "jac_f32" if mixed else "jac_f64"),
+            "jac_f32" if mixed else "jac_f64",
+            lu_key, "rhs_f64", "tri_solve_f32"),
         "analytic_vs_ad": {
             "jac_speedup_f64": round(
                 components["jac_f64"]["run_s"]
@@ -224,6 +348,23 @@ def run_ablation(mech_name: str, B: int, repeats: int) -> dict:
             "jac_speedup_f32": round(
                 components["jac_f32"]["run_s"]
                 / max(components["jac_analytic_f32"]["run_s"], 1e-12), 3),
+        },
+        "sparse_vs_dense": {
+            "rhs_speedup_f64": round(
+                components["rhs_f64"]["run_s"]
+                / max(components["rhs_sparse_f64"]["run_s"], 1e-12), 3),
+            "rhs_speedup_f32": round(
+                components["rhs_f32"]["run_s"]
+                / max(components["rhs_sparse_f32"]["run_s"], 1e-12), 3),
+            "jac_speedup_f64": round(
+                components["jac_analytic_f64"]["run_s"]
+                / max(components["jac_sparse_f64"]["run_s"], 1e-12), 3),
+            "bordered_vs_full_factor": round(
+                components[lu_key]["run_s"]
+                / max(components["lu_bordered"]["run_s"], 1e-12), 3),
+            "bordered_vs_tri_solve": round(
+                components["tri_solve_f32"]["run_s"]
+                / max(components["solve_bordered"]["run_s"], 1e-12), 3),
         },
         "f32_vs_f64": {
             "rhs_speedup": round(components["rhs_f64"]["run_s"]
@@ -250,10 +391,14 @@ def main(argv=None):
                    choices=["h2o2", "grisyn", "gri30"])
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--no-measure-newton", action="store_true",
+                   help="skip the real short integration that measures "
+                        "the per-attempt Newton iteration count")
     p.add_argument("--out", default="step_cost_ablation.json")
     args = p.parse_args(argv)
 
-    out = run_ablation(args.mech, args.batch, args.repeats)
+    out = run_ablation(args.mech, args.batch, args.repeats,
+                       measure_newton=not args.no_measure_newton)
     telemetry.atomic_write_json(args.out, out)
     telemetry.record_event("ablation", mech=args.mech, B=args.batch,
                            out=os.path.abspath(args.out))
