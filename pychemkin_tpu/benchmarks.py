@@ -95,6 +95,26 @@ Environment knobs:
   BENCH_SURROGATE_TRAIN  labeled training conditions (192)
   BENCH_SURROGATE_STEPS  Adam steps per ensemble member (1500)
   BENCH_SURROGATE_TIMEOUT  rung subprocess timeout, s (default 600)
+  BENCH_BATCH_EFF   "0" disables the batch_efficiency rung (default
+                    on): per-element time across B (default
+                    {32,64,128,256}) on a mixed-stiffness condition
+                    set, with a static-vs-scheduled twin per B — the
+                    tracked form of the BENCH_r05 B=256 per-element
+                    inversion and the stiffness-aware-scheduling
+                    evidence (pychemkin_tpu/schedule/)
+  BENCH_BATCH_EFF_MECH      batch-efficiency mechanism (grisyn)
+  BENCH_BATCH_EFF_BS        comma list of batch sizes (32,64,128,256)
+  BENCH_BATCH_EFF_SCHEDULE  scheduled twin's mode (sorted)
+  BENCH_BATCH_EFF_TIMEOUT   rung subprocess timeout, s (default 4000:
+                            the static B=256 twin on the screening
+                            mix IS the pathology being measured)
+  BENCH_EFF_CHUNK           scheduled twin's cohort chunk (default 64;
+                            the static twin uses BENCH_CHUNK)
+  BENCH_EFF_T               screening temperature range K (700,1500)
+  BENCH_EFF_MAX_STEPS       per-element step-attempt budget (10000) —
+                            caps the static twin's worst lane; capped
+                            lanes report BUDGET_EXHAUSTED identically
+                            in both twins (n_budget_capped per row)
   BENCH_CHUNK       max batch elements per compiled call (default 256).
                     Larger B runs as sequential chunks of ONE cached
                     program, so compile time is flat in B, and a single
@@ -252,6 +272,11 @@ def _child_config(mech_name: str, B: int, repeats: int):
     rop_mode = _kinetics.resolve_rop_mode()
     if mech.rop_stage is None:
         rop_mode = "dense"
+    # scheduling mode the sweep actually runs under (PYCHEMKIN_SCHEDULE
+    # resolved once here, threaded explicitly) — rung provenance, like
+    # jac_mode/rop_mode: a banked rung says which batch layout it timed
+    from . import schedule as _schedule
+    schedule_mode = _schedule.resolve_mode()
 
     def sweep(stats=None, job_report=None, checkpoint_path=None):
         return parallel.sharded_ignition_sweep(
@@ -259,7 +284,8 @@ def _child_config(mech_name: str, B: int, repeats: int):
             rtol=rtol, atol=atol, max_steps_per_segment=20_000,
             chunk_size=chunk, stats=stats, job_report=job_report,
             checkpoint_path=checkpoint_path,
-            solve_kwargs={"jac_mode": jac_mode})
+            solve_kwargs={"jac_mode": jac_mode},
+            schedule=schedule_mode)
 
     warmup_report: dict = {}
     t0 = time.time()
@@ -339,6 +365,7 @@ def _child_config(mech_name: str, B: int, repeats: int):
         # self-describing about WHICH Jacobian path its timing measured
         jac_mode=jac_mode,
         rop_mode=rop_mode,
+        schedule=schedule_mode,
         nu_nnz_frac=sparsity["nu_nnz_frac"],
         n_species_active=sparsity["n_species_active"],
         n_failed=rescue_report.n_failed,
@@ -572,6 +599,193 @@ def _child_surrogate(mech_name: str, n_requests: int, rate_hz: float):
         compiles=snap["counters"].get("serve.compiles", 0),
         residual=snap["histograms"].get("serve.surrogate.residual"),
         **summary)), flush=True)
+
+
+def _child_batch_eff(mech_name: str, bs_csv: str, schedule_mode: str):
+    """The batch_efficiency rung: per-element wall time across batch
+    sizes on a MIXED-stiffness condition set (wide T0/phi/P spread),
+    with a static-vs-scheduled twin at every B — the BENCH_r05
+    "grisyn B=256 slower per element than B=64" inversion as a
+    tracked artifact, plus the evidence that stiffness-aware
+    scheduling (cohort sorting + mid-sweep compaction,
+    pychemkin_tpu/schedule/) closes it. Prints one JSON line.
+
+    Twin discipline: both modes run in THIS process on the same
+    condition set, warmed separately, timed back to back — the
+    speedup column compares like with like. Answer fidelity rides in
+    every row: ``status_match`` (ok/status identical), ``bit_match``
+    (strict bitwise times equality vs the legacy shard program — the
+    same-program bitwise claim is property-tested in
+    tests/test_schedule.py) and ``times_max_rel_dev`` (the measured
+    cross-program deviation; ~1e-13 fusion-rounding territory when
+    not exactly zero)."""
+    import jax
+
+    from . import parallel, schedule, telemetry
+    from .mechanism import load_embedded
+    from .surrogate.dataset import phi_composition
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    if platform != "cpu":
+        from .utils import enable_compilation_cache
+        enable_compilation_cache(partition="axon")
+    _, t_end, rtol, atol = _PROTOCOL[mech_name]
+    mech = load_embedded(mech_name)
+    bs = sorted({int(b) for b in bs_csv.split(",") if b.strip()})
+    B_top = bs[-1]
+    rng = np.random.default_rng(0)
+    # the mixed-stiffness set: an ignition-SCREENING draw straddling
+    # the ignition boundary — wide temperature (cold lanes never
+    # ignite inside the horizon and are cheap; marginal lanes near
+    # the boundary take thousands of stiff induction steps), wide
+    # equivalence ratio, 1-2 atm. This is the production-traffic
+    # shape where a fixed batch layout pays its stiffest element's
+    # wall clock for every lane (measured max/mean step-attempt
+    # spread ~6x on grisyn vs ~1.3x for an igniting-only protocol)
+    t_cold, t_hot = (float(x) for x in os.environ.get(
+        "BENCH_EFF_T", "700,1500").split(","))
+    T0s = rng.uniform(t_cold, t_hot, B_top)
+    phis = rng.uniform(0.5, 2.0, B_top)
+    P0s = 1.01325e6 * (1.0 + rng.uniform(0.0, 1.0, B_top))
+    Y0s = np.stack([phi_composition(mech, float(p))[0] for p in phis])
+    chunk_static = int(os.environ.get("BENCH_CHUNK", 256))
+    chunk_sched = int(os.environ.get("BENCH_EFF_CHUNK", 64))
+    # bounded step budget: a super-marginal lane (predicted delay ~
+    # the horizon) exhausts at this many attempts with
+    # BUDGET_EXHAUSTED in BOTH twins — it caps the static twin's
+    # worst-case wall without touching the comparison's fairness
+    max_steps = int(os.environ.get("BENCH_EFF_MAX_STEPS", 10_000))
+    mesh = parallel.make_mesh()
+    rec = telemetry.get_recorder()
+    #: scheduling activity of the TIMED passes (see run())
+    sched_counts = {"cohorts": 0, "compactions": 0}
+
+    def sweep(mode, B, chunk, t_ends_arr):
+        return parallel.sharded_ignition_sweep(
+            mech, "CONP", "ENRG", T0s[:B], P0s[:B], Y0s[:B],
+            t_ends_arr, mesh=mesh, rtol=rtol, atol=atol,
+            max_steps_per_segment=max_steps, chunk_size=chunk,
+            schedule=mode)
+
+    def run(mode, B, chunk):
+        # compile-only warmup: the same programs at the same shapes,
+        # driven over a vanishing horizon (t_end is traced DATA, so
+        # the tiny sweep compiles exactly the programs the timed pass
+        # dispatches) — a full-cost warm pass would double a rung
+        # whose static twin is intentionally expensive
+        tiny = np.full(B, 1e-7)
+        sweep(mode, B, chunk, tiny)
+        if mode != "static":
+            # the compaction ladder's NARROW shapes never run at a
+            # tiny horizon (everything finishes in round 1): compile
+            # each rung explicitly with a width-sized tiny sweep
+            # (edge-padded indices — a ladder rung can exceed B_top
+            # when alignment rounds a tiny B up)
+            for w in schedule.compaction_ladder(min(chunk, B)):
+                sel = np.minimum(np.arange(w), B_top - 1)
+                schedule.compacted_ignition_sweep(
+                    mech, "CONP", "ENRG", T0s[sel], P0s[sel],
+                    Y0s[sel], np.full(w, 1e-7), ladder=(w,),
+                    rtol=rtol, atol=atol,
+                    max_steps_per_segment=max_steps)
+        # cohort/compaction counters: the TIMED pass's delta only —
+        # warmup sweeps plan cohorts too, and banking the process
+        # total would double-count what the measurements performed
+        c0 = {k: rec.snapshot(write=False)["counters"].get(k, 0)
+              for k in ("schedule.cohorts", "schedule.compactions")}
+        t0 = time.time()
+        times, ok, status = sweep(mode, B, chunk,
+                                  np.full(B, t_end))
+        wall = time.time() - t0
+        c1 = rec.snapshot(write=False)["counters"]
+        sched_counts["cohorts"] += c1.get("schedule.cohorts", 0) \
+            - c0["schedule.cohorts"]
+        sched_counts["compactions"] += \
+            c1.get("schedule.compactions", 0) \
+            - c0["schedule.compactions"]
+        return wall, np.asarray(times), np.asarray(ok), \
+            np.asarray(status)
+
+    per_B = []
+    all_match = True
+    for B in bs:
+        w_s, t_s, ok_s, st_s = run("static", B, chunk_static)
+        w_x, t_x, ok_x, st_x = run(schedule_mode, B, chunk_sched)
+        # answer fidelity, two strengths (see README "Stiffness-aware
+        # scheduling"): the STRICT bitwise claim is same-program
+        # (scheduled vs the unsorted kernel at full width; property-
+        # tested in tests/test_schedule.py) — across the legacy
+        # shard-program twin here, XLA's value-dependent fusion
+        # rounding can differ at ~1e-13 relative on GRI-scale
+        # mechanisms, so the rung records strict equality AND the
+        # measured deviation, with status/ok required identical
+        bit = bool(np.array_equal(t_s, t_x, equal_nan=True))
+        status_match = bool(np.array_equal(ok_s, ok_x)
+                            and np.array_equal(st_s, st_x))
+        # a lane whose attempt count sits AT the step budget is
+        # ambiguous between two compiled programs (the ~1e-13 state
+        # divergence flips BUDGET_EXHAUSTED<->OK at the boundary);
+        # count the flips so the artifact quantifies them instead of
+        # hiding behind one boolean
+        n_status_mismatch = int(np.sum(st_s != st_x))
+        # NaN-vs-finite disagreement (a min_slope-threshold lane the
+        # cross-program rounding flips) is a real answer mismatch —
+        # it must fail the match, not fall out of the rel-dev mask
+        finite_match = bool(np.array_equal(np.isfinite(t_s),
+                                           np.isfinite(t_x)))
+        both = np.isfinite(t_s) & np.isfinite(t_x)
+        rel_dev = (float(np.max(np.abs(t_s[both] - t_x[both])
+                                / np.abs(t_s[both])))
+                   if both.any() else 0.0)
+        match = (status_match and finite_match
+                 and (bit or rel_dev < 1e-9))
+        all_match = all_match and match
+        from .resilience.status import SolveStatus
+        row = dict(B=B,
+                   static_ms_per_elem=round(w_s / B * 1e3, 3),
+                   sched_ms_per_elem=round(w_x / B * 1e3, 3),
+                   speedup=round(w_s / w_x, 3),
+                   n_ok=int(ok_s.sum()),
+                   n_budget_capped=int(np.sum(
+                       st_s == int(SolveStatus.BUDGET_EXHAUSTED))),
+                   bit_match=bit,
+                   status_match=status_match,
+                   finite_match=finite_match,
+                   n_status_mismatch=n_status_mismatch,
+                   times_max_rel_dev=float(f"{rel_dev:.3g}"))
+        per_B.append(row)
+        print(f"# batch_eff {mech_name} B={B}: static "
+              f"{row['static_ms_per_elem']}ms/elem, {schedule_mode} "
+              f"{row['sched_ms_per_elem']}ms/elem "
+              f"({row['speedup']}x, bit={bit}, "
+              f"rel_dev={rel_dev:.2g})", file=sys.stderr)
+
+    by_B = {r["B"]: r for r in per_B}
+    top = by_B[B_top]
+
+    def _ratio(num, den):
+        return round(num / den, 3) if den else None
+
+    print(json.dumps(dict(
+        rung="batch_efficiency", platform=platform, mech=mech_name,
+        schedule=schedule_mode, Bs=bs, t_end=t_end, rtol=rtol,
+        atol=atol, seed=0, T_range=[t_cold, t_hot],
+        phi_range=[0.5, 2.0], max_steps=max_steps,
+        chunk_static=chunk_static, chunk_sched=chunk_sched,
+        round_len=schedule.compaction._round_len(),
+        per_B=per_B,
+        speedup_top=top["speedup"],
+        sched_top_vs_b64=_ratio(
+            top["sched_ms_per_elem"],
+            by_B.get(64, {}).get("sched_ms_per_elem")),
+        static_top_vs_b64=_ratio(
+            top["static_ms_per_elem"],
+            by_B.get(64, {}).get("static_ms_per_elem")),
+        answers_match=all_match,
+        cohorts=sched_counts["cohorts"],
+        compactions=sched_counts["compactions"])),
+        flush=True)
 
 
 def _child_baseline(mech_name: str, n_points: int, budget_s: float):
@@ -822,6 +1036,7 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
         "mfu_pct": best.get("mfu_pct"),
         "jac_mode": best.get("jac_mode"),
         "rop_mode": best.get("rop_mode"),
+        "schedule": best.get("schedule"),
         "steps_per_sec": best.get("steps_per_sec"),
         "baseline_ignitions_per_sec": round(baseline_ips, 4),
         "baseline_kind": baseline_kind,
@@ -831,8 +1046,8 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
                                    "compile_s", "run_s", "mfu_pct",
                                    "steps_per_sec", "n_steps",
                                    "n_rejected", "n_newton", "platform",
-                                   "jac_mode", "rop_mode", "nu_nnz_frac",
-                                   "n_species_active",
+                                   "jac_mode", "rop_mode", "schedule",
+                                   "nu_nnz_frac", "n_species_active",
                                    "n_failed", "n_rescued",
                                    "n_abandoned", "status_counts",
                                    "resume_count", "chunks_replayed",
@@ -1058,12 +1273,49 @@ def _main_guarded():
                   + (":\n#   " + tail.replace("\n", "\n#   ")
                      if tail else ""), file=sys.stderr)
 
+    # batch-efficiency rung: per-element time across batch sizes on a
+    # mixed-stiffness set, static vs scheduled twins (the BENCH_r05
+    # B=256 inversion as a tracked artifact) — own subprocess, same
+    # budget discipline as the serve/surrogate rungs
+    batch_eff_rung = None
+    rem = _remaining(deadline)
+    if os.environ.get("BENCH_BATCH_EFF", "1") != "0" \
+            and (rem is None
+                 or rem > _BUDGET_RESERVE_S + _MIN_RUNG_WINDOW_S):
+        eff_mech = os.environ.get("BENCH_BATCH_EFF_MECH", "grisyn")
+        eff_bs = os.environ.get("BENCH_BATCH_EFF_BS", "32,64,128,256")
+        eff_sched = os.environ.get("BENCH_BATCH_EFF_SCHEDULE",
+                                   "sorted")
+        eff_timeout = float(os.environ.get("BENCH_BATCH_EFF_TIMEOUT",
+                                           4000))
+        if rem is not None:
+            eff_timeout = min(eff_timeout, rem - _BUDGET_RESERVE_S / 2)
+        rc, batch_eff_rung, tail = _run_child(
+            ["batch_eff", eff_mech, eff_bs, eff_sched], eff_timeout,
+            env=None if on_accel else _cpu_env())
+        if batch_eff_rung:
+            telemetry.record_event("bench_batch_eff", **batch_eff_rung)
+            print(f"# batch_efficiency: speedup_top="
+                  f"{batch_eff_rung.get('speedup_top')} "
+                  f"sched_top_vs_b64="
+                  f"{batch_eff_rung.get('sched_top_vs_b64')} "
+                  f"answers_match="
+                  f"{batch_eff_rung.get('answers_match')}",
+                  file=sys.stderr)
+        else:
+            print("# batch_efficiency rung "
+                  + ("timed out" if rc == -2 else f"failed rc={rc}")
+                  + (":\n#   " + tail.replace("\n", "\n#   ")
+                     if tail else ""), file=sys.stderr)
+
     out = _build_summary(results, baselines, is_fallback=is_fallback,
                          accel_err=accel_err, host_cpu=host_cpu)
     if serve_rung:
         out["serve_latency"] = serve_rung
     if surrogate_rung:
         out["surrogate_latency"] = surrogate_rung
+    if batch_eff_rung:
+        out["batch_efficiency"] = batch_eff_rung
     telemetry.record_event("bench_summary", **out)
     if bank_path:
         telemetry.atomic_write_json(bank_path, out)
@@ -1082,6 +1334,8 @@ def _dispatch():
     elif len(sys.argv) >= 5 and sys.argv[1] == "surrogate":
         _child_surrogate(sys.argv[2], int(sys.argv[3]),
                          float(sys.argv[4]))
+    elif len(sys.argv) >= 5 and sys.argv[1] == "batch_eff":
+        _child_batch_eff(sys.argv[2], sys.argv[3], sys.argv[4])
     else:
         main()
 

@@ -343,22 +343,25 @@ class _StepState(NamedTuple):
     status: Any     # SolveStatus code, set once on first failure
 
 
-def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
-                   args, stall_inject=None):
-    """Advance from state.t to t_end with adaptive steps (vmap-safe).
+def _segment_fns(rhs, jac_fn, events, ctrl, t_end, budget, args,
+                 stall_inject=None):
+    """(cond, body) of the adaptive step loop toward ``t_end``.
 
-    ``stall_inject``: optional traced bool from the fault-injection
-    harness forcing every stage-Newton to report non-convergence."""
-    n = state.y.shape[0]
-    dtype = state.y.dtype
+    Shared by :func:`_solve_segment` (the one-shot ``while_loop`` of
+    ``odeint``) and :func:`sweep_round` (the round-bounded runner the
+    mid-sweep compaction scheduler drives), so a paused-and-resumed
+    step sequence is the SAME per-lane computation as an uninterrupted
+    one — the bit-match guarantee of stiffness-aware scheduling rests
+    on this sharing. ``budget`` is the absolute step-attempt cap
+    (``n_steps + n_rejected`` at which the lane gives up)."""
     dt_min = ctrl.dt_min_rel * jnp.maximum(jnp.abs(t_end), 1e-30)
-    budget = state.n_steps + state.n_rejected + ctrl.max_steps_per_segment
 
     def cond(s):
         return (s.t < t_end) & (~s.stalled) & (
             s.n_steps + s.n_rejected < budget)
 
     def body(s):
+        n = s.y.shape[0]
         active = s.t < t_end
         # h is the controller's ideal step; the step actually taken may be
         # clipped to the segment remainder (output point). The controller
@@ -461,6 +464,18 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
             status=status,
         )
 
+    return cond, body
+
+
+def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
+                   args, stall_inject=None):
+    """Advance from state.t to t_end with adaptive steps (vmap-safe).
+
+    ``stall_inject``: optional traced bool from the fault-injection
+    harness forcing every stage-Newton to report non-convergence."""
+    budget = state.n_steps + state.n_rejected + ctrl.max_steps_per_segment
+    cond, body = _segment_fns(rhs, jac_fn, events, ctrl, t_end, budget,
+                              args, stall_inject)
     out = jax.lax.while_loop(cond, body, state)
     # exiting short of t_end (budget exhausted or stall) is a failure; the
     # output point recorded for this segment would otherwise silently hold
@@ -570,3 +585,98 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
                        success=success, t_final=state.t,
                        stalled=state.stalled, n_newton=state.n_newton,
                        status=state.status)
+
+
+# ---------------------------------------------------------------------------
+# Round-bounded stepping: the primitive mid-sweep compaction is built on.
+#
+# A vmapped `odeint` runs its while_loop until EVERY lane reaches t_end,
+# so the whole batch pays the per-iteration cost of its stiffest lane's
+# step count. The sweep scheduler (pychemkin_tpu/schedule/) instead
+# drives the SAME step loop in bounded rounds: after each round the
+# finished lanes are harvested on the host and the still-active lanes
+# are gathered into a smaller compiled shape. The functions below share
+# `_segment_fns` with `_solve_segment`, so a lane stepped in rounds
+# takes bit-identical steps to one stepped in a single while_loop —
+# pausing at a loop-iteration boundary and resuming with the exact
+# carried state is the identity.
+#
+# Scope: the single-segment form only (output grid [t0, t_end], the
+# n_out=2 sweep hot path) — the attempt budget is the absolute
+# `ctrl.max_steps_per_segment` a single segment from zero counters has.
+
+def sweep_start(rhs, y0, t_end, args, ctrl: _Ctrl, events) -> _StepState:
+    """Per-lane initial :class:`_StepState` for a single-segment
+    integration of ``[0, t_end]`` — mirrors ``odeint``'s setup (initial
+    RHS, starting-step heuristic, event accumulators) exactly."""
+    events = tuple(events)
+    t0 = jnp.zeros((), dtype=y0.dtype)
+    t_span = jnp.maximum(t_end - t0, 1e-30)
+    f0 = rhs(t0, y0, args)
+    h_init = _initial_step(f0, y0, ctrl, t_span)
+    n_ev = max(len(events), 1)
+    if events:
+        acc_t0 = jnp.where(
+            jnp.array([ev.kind == "crossing" for ev in events]),
+            jnp.inf, jnp.nan).astype(y0.dtype)
+    else:
+        acc_t0 = jnp.full((n_ev,), jnp.nan, dtype=y0.dtype)
+    return _StepState(
+        t=t0, y=y0, f=f0, h=h_init,
+        n_steps=jnp.array(0), n_rejected=jnp.array(0),
+        n_newton=jnp.array(0), consec_rej=jnp.array(0),
+        acc_t=acc_t0,
+        acc_v=jnp.full((n_ev,), -jnp.inf, dtype=y0.dtype),
+        stalled=jnp.array(False),
+        status=jnp.int32(SolveStatus.OK))
+
+
+def sweep_round(rhs, jac_fn, events, ctrl: _Ctrl, state: _StepState,
+                t_end, args, round_len: int, stall_inject=None
+                ) -> _StepState:
+    """At most ``round_len`` step attempts of the ``_solve_segment``
+    loop toward ``t_end`` (vmap-safe; a finished/stalled lane is a
+    masked no-op exactly as in the one-shot loop)."""
+    cond, body = _segment_fns(rhs, jac_fn, events, ctrl, t_end,
+                              ctrl.max_steps_per_segment, args,
+                              stall_inject)
+
+    def rcond(carry):
+        s, k = carry
+        return cond(s) & (k < round_len)
+
+    def rbody(carry):
+        s, k = carry
+        return body(s), k + 1
+
+    out, _ = jax.lax.while_loop(rcond, rbody, (state, jnp.array(0)))
+    return out
+
+
+def sweep_done(state: _StepState, t_end, ctrl: _Ctrl):
+    """True once this lane will never step again: reached ``t_end``,
+    stalled, or exhausted the absolute attempt budget."""
+    return ((state.t >= t_end) | state.stalled
+            | (state.n_steps + state.n_rejected
+               >= ctrl.max_steps_per_segment))
+
+
+def sweep_finalize(state: _StepState, t_end, events):
+    """Terminal classification of a lane the round loop finished —
+    byte-for-byte the post-loop logic of ``_solve_segment`` + the
+    success computation of ``odeint``. Returns
+    ``(event_times, event_values, success, status)``."""
+    events = tuple(events)
+    short = state.t < t_end
+    status = jnp.where(
+        short & (state.status == jnp.int32(SolveStatus.OK)),
+        jnp.int32(SolveStatus.BUDGET_EXHAUSTED), state.status)
+    stalled = state.stalled | short
+    ev_t = state.acc_t
+    if events:
+        is_cross = jnp.array([ev.kind == "crossing" for ev in events])
+        ev_t = jnp.where(is_cross & ~jnp.isfinite(ev_t), jnp.nan, ev_t)
+    t_span = jnp.maximum(t_end - jnp.zeros((), dtype=state.y.dtype),
+                         1e-30)
+    success = (~stalled) & (state.t >= t_end - 1e-12 * t_span)
+    return ev_t, state.acc_v, success, status

@@ -398,3 +398,145 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         with linalg.forced_pivoted():
             return run()
     return run()
+
+
+# ---------------------------------------------------------------------------
+# Resumable sweep kernel: the per-lane init/advance/harvest triple the
+# stiffness-aware scheduler (pychemkin_tpu/schedule/) drives in bounded
+# rounds with mid-sweep compaction. Each function mirrors the exact
+# setup `solve_batch` feeds `odeint` for the n_out=2 sweep form, and
+# the stepping shares `odeint._segment_fns`, so a lane advanced in
+# rounds (at ANY batch shape) produces bit-identical results to the
+# one-shot vmapped `ignition_delay_sweep`.
+
+def sweep_lane_args(mech, problem, T0, P0, Y0):
+    """One sweep lane's ``(BatchArgs, y0, dtype)`` — byte-for-byte the
+    default-profile construction :func:`solve_batch` performs for the
+    sweep form (no explicit profiles, unit volume, adiabatic). Shared
+    by the resumable sweep kernel and the stiffness-cost predictor so
+    both see the exact RHS the production sweep integrates."""
+    dtype = jnp.result_type(jnp.asarray(Y0).dtype, jnp.float64)
+    Y0 = jnp.asarray(Y0, dtype=dtype)
+    T0 = jnp.asarray(T0, dtype=dtype)
+    P0 = jnp.asarray(P0, dtype=dtype)
+    if problem == "CONP":
+        constraint = constant_profile(P0)
+    else:
+        constraint = constant_profile(1.0)
+    t_start0 = jnp.asarray(0.0)
+    if problem == "CONP":
+        p_start, _ = profile_value_slope(constraint, t_start0)
+        rho0 = thermo.density(mech, T0, p_start, Y0)
+        mass = rho0 * 1.0
+    else:
+        v0, _ = profile_value_slope(constraint, t_start0)
+        rho0 = thermo.density(mech, T0, P0, Y0)
+        mass = rho0 * v0
+    args = BatchArgs(mech=mech, constraint=constraint,
+                     tprof=constant_profile(T0),
+                     qloss=constant_profile(0.0),
+                     area=constant_profile(0.0), mass=mass)
+    y0 = jnp.concatenate([Y0, T0[None]])
+    return args, y0, dtype
+
+
+class SweepKernel(NamedTuple):
+    """Jitted batched entry points over a sweep carry
+    ``(state, T0s, P0s, Y0s, t_ends, elems)`` (all leaves [n]-leading;
+    ``state`` is the batched integrator :class:`~.odeint._StepState`).
+
+    - ``init(T0s, P0s, Y0s, t_ends, elems) -> state``
+    - ``advance(state, T0s, P0s, Y0s, t_ends, elems) -> state`` — at
+      most ``round_len`` step attempts per lane
+    - ``harvest(state, T0s, P0s, Y0s, t_ends, elems) -> dict`` with
+      ``times/ok/status/done/n_steps/n_rejected/n_newton`` arrays
+
+    One compiled program per batch shape (jit shape-keyed cache), so a
+    fixed compaction ladder means zero new compiles after its shapes
+    have each run once.
+    """
+    init: Any
+    advance: Any
+    harvest: Any
+    round_len: int
+
+
+def ignition_sweep_kernel(mech, problem, energy, *, rtol=1e-6,
+                          atol=1e-12,
+                          ignition_mode=IGN_T_INFLECTION,
+                          ignition_kwargs=None,
+                          max_steps_per_segment=20_000, h0=0.0,
+                          jac_mode="analytic", fault_level=0,
+                          round_len=512) -> SweepKernel:
+    """Build the resumable-sweep kernel for one solver configuration.
+
+    ``elems`` threads each lane's ORIGINAL batch index into the fault
+    harness (inert unless injection is active at trace time), so a
+    cohort-permuted scheduled sweep keeps the same elements poisoned.
+    """
+    from .odeint import (_Ctrl, _make_jac_fn, sweep_done, sweep_finalize,
+                         sweep_round, sweep_start)
+
+    rhs_base = _RHS[(problem, energy)]
+    if jac_mode == "analytic":
+        jac = jacobian.batch_rhs_jacobian(problem, energy)
+    elif jac_mode == "ad":
+        jac = None
+    else:
+        raise ValueError(f"unknown jac_mode {jac_mode!r}")
+    ign_kwargs = dict(ignition_kwargs or {})
+    round_len = int(round_len)
+    if round_len < 1:
+        raise ValueError(f"round_len must be >= 1, got {round_len}")
+
+    def lane_setup(T0, P0, Y0, elem):
+        args, y0, dtype = sweep_lane_args(mech, problem, T0, P0, Y0)
+        events = ignition_events(ignition_mode, T0=T0, **ign_kwargs)
+        atol_vec = jnp.full(y0.shape, atol, dtype=dtype)
+        atol_vec = atol_vec.at[-1].set(jnp.maximum(atol * 1e6, 1e-8))
+        rhs = rhs_base
+        stall = None
+        if faultinject.enabled():
+            rhs = faultinject.wrap_rhs(rhs_base, elem, fault_level)
+            stall = faultinject.newton_stall_mask(elem, fault_level)
+        ctrl = _Ctrl(rtol=rtol, atol=atol_vec,
+                     max_steps_per_segment=max_steps_per_segment,
+                     h0=h0, bordered=y0.shape[0] >= 2)
+        jac_fn = jac if jac is not None else _make_jac_fn(rhs)
+        return rhs, jac_fn, events, args, y0, ctrl, stall
+
+    def lane_init(T0, P0, Y0, t_end, elem):
+        rhs, jac_fn, events, args, y0, ctrl, _ = lane_setup(
+            T0, P0, Y0, elem)
+        return sweep_start(rhs, y0, jnp.asarray(t_end, y0.dtype), args,
+                           ctrl, events)
+
+    def lane_advance(state, T0, P0, Y0, t_end, elem):
+        rhs, jac_fn, events, args, _, ctrl, stall = lane_setup(
+            T0, P0, Y0, elem)
+        return sweep_round(rhs, jac_fn, events, ctrl, state,
+                           jnp.asarray(t_end, state.y.dtype), args,
+                           round_len, stall)
+
+    def lane_harvest(state, T0, P0, Y0, t_end, elem):
+        _, _, events, _, _, ctrl, _ = lane_setup(T0, P0, Y0, elem)
+        t_end = jnp.asarray(t_end, state.y.dtype)
+        ev_t, ev_v, success, status = sweep_finalize(state, t_end,
+                                                     events)
+        ignition_time = ev_t[0]
+        if ignition_mode == IGN_T_INFLECTION:
+            min_slope = ign_kwargs.get("min_slope", 1e4)
+            ignition_time = jnp.where(ev_v[0] >= min_slope,
+                                      ignition_time, jnp.nan)
+        return {"times": ignition_time, "ok": success,
+                "status": status,
+                "done": sweep_done(state, t_end, ctrl),
+                "n_steps": state.n_steps,
+                "n_rejected": state.n_rejected,
+                "n_newton": state.n_newton}
+
+    return SweepKernel(
+        init=jax.jit(jax.vmap(lane_init)),
+        advance=jax.jit(jax.vmap(lane_advance)),
+        harvest=jax.jit(jax.vmap(lane_harvest)),
+        round_len=round_len)

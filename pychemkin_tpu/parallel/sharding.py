@@ -171,7 +171,9 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                            stats: Optional[SweepStats] = None,
                            checkpoint_path: Optional[str] = None,
                            job_report: Optional[dict] = None,
-                           driver_kwargs: Optional[dict] = None):
+                           driver_kwargs: Optional[dict] = None,
+                           schedule: Optional[str] = None,
+                           cost_fn=None):
     """Ignition-delay sweep sharded over a device mesh — the scaled-out
     form of :func:`pychemkin_tpu.ops.reactors.ignition_delay_sweep`.
 
@@ -208,6 +210,23 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     steps / rejected attempts / Newton iterations across the sweep (the
     measured inputs of the bench's FLOP/MFU model).
 
+    ``schedule``: stiffness-aware scheduling mode — ``"static"`` (the
+    plain chunked sweep), ``"sorted"``/``"adaptive"`` (conditions are
+    cost-sorted into cohort chunks by the Gershgorin predictor, and on
+    a single-device mesh each chunk additionally runs with mid-sweep
+    compaction so finished lanes stop consuming batch slots; see
+    :mod:`pychemkin_tpu.schedule`). Defaults to the
+    ``PYCHEMKIN_SCHEDULE`` env knob. Results are scattered back to
+    caller order; per lane they bit-match the same compiled kernel
+    run unsorted at full width, and agree with the static shard
+    program to identical ok/status (bitwise times at matched widths
+    on h2o2; within XLA fusion rounding, ~1e-13 relative, on
+    GRI-scale mechanisms — see README "Stiffness-aware scheduling").
+    ``cost_fn`` overrides the predictor (e.g.
+    :func:`pychemkin_tpu.schedule.surrogate_cost_predictor`); it is
+    called as ``cost_fn(mech, problem, energy, T0s, P0s, Y0s,
+    t_ends)`` and must return a [B] cost array.
+
     ``checkpoint_path``: an ``.npz`` manifest updated atomically after
     every completed chunk (or once, for an unchunked sweep); re-running
     the same sweep with the same path resumes after the last completed
@@ -219,9 +238,11 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     checkpoint/resume for long sweeps that SURVEY §5 calls for — a
     preempted 10k-point overnight sweep loses one chunk, not the night.
     """
+    from .. import schedule as _schedule
     from ..resilience import checkpoint as _checkpoint
     from ..resilience import driver as _driver
 
+    mode = _schedule.resolve_mode(schedule)
     if mesh is None:
         mesh = make_mesh()
     n_dev = mesh.devices.size
@@ -254,12 +275,57 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     else:
         chunk = max(n_dev, (chunk_size // n_dev) * n_dev)
 
+    # stiffness-aware scheduling: cost-sort the conditions so each
+    # driver chunk is a similar-cost cohort, and (single-device mesh,
+    # supported solver knobs) run each chunk with mid-sweep compaction
+    order = None
+    compact = False
+    if mode != "static" and B > 1:
+        predict = cost_fn if cost_fn is not None \
+            else _schedule.stiffness_costs
+        costs = predict(mech, problem, energy, np.asarray(T0s),
+                        np.asarray(P0s), np.asarray(Y0s),
+                        np.asarray(t_ends))
+        plan = _schedule.plan_cohorts(costs, chunk,
+                                      label="sharded_ignition_sweep")
+        order = plan.order
+        # compaction drives plain jitted shapes on the host; a multi-
+        # device mesh keeps the shard_map path (cohort sorting is the
+        # multi-chip half of the win), and unsupported solver knobs
+        # (rescue-ladder escalations ride solve_kwargs) fall back too
+        supported = {"rtol", "atol", "n_out", "ignition_mode",
+                     "ignition_kwargs", "max_steps_per_segment", "h0",
+                     "jac_mode"}
+        compact = (n_dev == 1 and set(kwargs) <= supported
+                   and kwargs.get("n_out", 2) == 2)
+        if job_report is not None:
+            job_report["schedule"] = mode
+            job_report["schedule_compaction"] = compact
+            job_report["schedule_cohorts"] = plan.n_cohorts
+
+    T0s_np, P0s_np = np.asarray(T0s), np.asarray(P0s)
+    Y0s_np, t_ends_np = np.asarray(Y0s), np.asarray(t_ends)
+
     def index_solve(idx):
         # idx is edge-padded to a fixed chunk length by the driver, so
         # one cached program serves every chunk; count only the
         # genuinely distinct elements into stats (the duplicates'
         # solver work would inflate the bench's steps/s and MFU)
         n = len(np.unique(idx)) if len(idx) else 0
+        if compact:
+            out = _schedule.compacted_ignition_sweep(
+                mech, problem, energy, T0s_np[idx], P0s_np[idx],
+                Y0s_np[idx], t_ends_np[idx],
+                elem_ids=np.asarray(idx),
+                label="sharded_ignition_sweep",
+                **{k: v for k, v in kwargs.items() if k != "n_out"})
+            if stats is not None:
+                uniq = np.unique(idx, return_index=True)[1]
+                stats.add(out["n_steps"][uniq].sum(),
+                          out["n_rejected"][uniq].sum(),
+                          out["n_newton"][uniq].sum())
+            return {"times": out["times"], "ok": out["ok"],
+                    "status": out["status"]}
         t, ok, st, n_steps, n_rej, n_newt = _solve_shard(
             mech, problem, energy, T0s[idx], P0s[idx], Y0s[idx],
             t_ends[idx], mesh, kwargs)
@@ -269,7 +335,7 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         return {"times": t, "ok": ok, "status": st}
 
     results, _report = _driver.run_vmapped_sweep_job(
-        index_solve, B, chunk_size=chunk,
+        index_solve, B, chunk_size=chunk, order=order,
         checkpoint_path=checkpoint_path, signature=sig,
         result_keys=("times", "ok", "status"), job_report=job_report,
         label="sharded_ignition_sweep", **(driver_kwargs or {}))

@@ -487,6 +487,7 @@ def edge_pad_indices(lo: int, hi: int, chunk: int) -> np.ndarray:
 def run_vmapped_sweep_job(index_solve: Callable[[np.ndarray],
                                                 Dict[str, np.ndarray]],
                           B: int, *, chunk_size: Optional[int] = None,
+                          order: Optional[Sequence[int]] = None,
                           **job_kwargs):
     """Durable chunked execution of an index-driven (vmapped) sweep —
     the shared scaffolding of the model-layer ``run_sweep`` surfaces.
@@ -498,6 +499,20 @@ def run_vmapped_sweep_job(index_solve: Callable[[np.ndarray],
     degenerate empty sweep: ``index_solve`` runs once with an empty
     index vector (a vmap over zero elements), preserving the plain
     empty-arrays contract without involving the driver.
+
+    ``order`` (a permutation of ``range(B)``) is the stiffness-aware
+    scheduling hook: chunks solve (and checkpoint) the elements in
+    ``order`` sequence — so a cost-sorted order makes every chunk a
+    similar-cost cohort — and the final results are scattered back to
+    CALLER order before the rescue hand-off and return. Values are
+    untouched by the permutation, so an ordered sweep's results are
+    bit-identical to the unordered one's, element for element. The
+    checkpoint signature is salted with the order (a manifest banks
+    schedule-order arrays; adopting it under a different order would
+    scramble lanes — the salt turns that into a clean re-solve).
+    Partial results riding a :class:`JobInterrupted` stay in SCHEDULE
+    order (the resume completes them; only terminal results are
+    scattered).
 
     All other keyword arguments go to :func:`run_sweep_job`.
     """
@@ -514,8 +529,38 @@ def run_vmapped_sweep_job(index_solve: Callable[[np.ndarray],
         return out, report
     chunk = B if chunk_size is None else max(1, min(int(chunk_size), B))
 
+    inverse = None
+    if order is not None:
+        order = np.asarray(order, dtype=np.int64)
+        if (order.shape != (B,)
+                or not np.array_equal(np.sort(order), np.arange(B))):
+            raise ValueError(
+                f"order must be a permutation of range({B})")
+        inverse = np.empty(B, dtype=np.int64)
+        inverse[order] = np.arange(B)
+        if job_kwargs.get("signature") is not None:
+            from ..schedule.cohorts import order_signature
+            job_kwargs["signature"] = (job_kwargs["signature"]
+                                       + ":order:"
+                                       + order_signature(order))
+        # rescue sees CALLER-order results: run it after the scatter,
+        # not on the schedule-order arrays run_sweep_job holds
+        rescue = job_kwargs.pop("rescue", None)
+    else:
+        rescue = None
+
     def solve_chunk(lo, hi):
-        out = index_solve(edge_pad_indices(lo, hi, chunk))
+        idx = edge_pad_indices(lo, hi, chunk)
+        if order is not None:
+            idx = order[idx]
+        out = index_solve(idx)
         return {k: np.asarray(v)[:hi - lo] for k, v in out.items()}
 
-    return run_sweep_job(solve_chunk, B, chunk_size=chunk, **job_kwargs)
+    results, report = run_sweep_job(solve_chunk, B, chunk_size=chunk,
+                                    **job_kwargs)
+    if inverse is not None:
+        results = {k: np.asarray(v)[inverse]
+                   for k, v in results.items()}
+        if rescue is not None:
+            rescue(results)
+    return results, report

@@ -1,0 +1,87 @@
+"""Stiffness-aware scheduling: cohort binning, mid-sweep compaction,
+and adaptive serving knobs.
+
+A vmapped batch integrates at the pace of its stiffest element: the
+``while_loop`` masks finished lanes into no-ops but keeps paying their
+per-iteration wall clock (the BENCH_r05 inversion — grisyn B=256 was
+*slower per element* than B=64). This package turns the fixed batch
+layout into a scheduled one, in three layers:
+
+- **Predict** (:mod:`.predictor`): a cheap per-condition cost estimate
+  — a Gershgorin bound on the analytic Jacobian at t=0 times the
+  integration horizon (one Jacobian evaluation per condition, vs the
+  thousands a solve performs), with the served surrogate ensemble as
+  an optional sharper predictor.
+- **Sort & compact** (:mod:`.cohorts`, :mod:`.compaction`): sweep
+  conditions are sorted into stiffness cohorts before chunking, so
+  each compiled chunk holds similar-cost elements, and long
+  integrations run as bounded step-rounds with finished lanes
+  compacted out of the batch between rounds (shapes stay on a fixed
+  bucket ladder — zero new compiles after each shape's first run).
+  A permutation layer scatters results back to caller order; the
+  per-lane step math is shared with the one-shot integrator
+  (``odeint._segment_fns``), so scheduled results BIT-MATCH the
+  unsorted compiled vmapped baseline.
+- **Adapt** (:mod:`.adaptive`): the serve layer's batch-window and
+  effective batch-size knobs are driven by the live occupancy /
+  solve-time histograms instead of being a fixed guess; every choice
+  stays on the warmed bucket ladder so steady traffic never compiles.
+
+Mode knob ``PYCHEMKIN_SCHEDULE`` (explicit call arguments win):
+
+- ``static``    (default) — the pre-scheduling behavior everywhere.
+- ``sorted``    — sweeps sort into cohorts and compact mid-sweep.
+- ``adaptive``  — ``sorted`` plus the serve layer's adaptive
+  window/batch-cap controller.
+
+Telemetry: ``schedule.cohorts`` (cohort chunks planned),
+``schedule.compactions`` (mid-sweep gathers), and
+``schedule.ladder_adjust`` (serve knob adjustments) counters, plus a
+``schedule`` field on every ``serve.dispatch`` trace span.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .adaptive import AdaptiveController
+from .cohorts import CohortPlan, order_signature, plan_cohorts
+from .compaction import compacted_ignition_sweep, compaction_ladder
+from .predictor import stiffness_costs, surrogate_cost_predictor
+
+#: valid PYCHEMKIN_SCHEDULE values
+MODES = ("static", "sorted", "adaptive")
+
+#: the scheduling mode knob (read at call time, so live processes
+#: re-resolve per sweep/server build)
+MODE_ENV = "PYCHEMKIN_SCHEDULE"
+
+#: the counters this package emits — schema-asserted in test_telemetry
+SCHEDULE_COUNTERS = ("schedule.cohorts", "schedule.compactions",
+                     "schedule.ladder_adjust")
+
+#: the trace-span field carrying the mode on serve dispatch spans
+SCHEDULE_SPAN_FIELD = "schedule"
+
+__all__ = [
+    "AdaptiveController", "CohortPlan", "MODES", "MODE_ENV",
+    "SCHEDULE_COUNTERS", "SCHEDULE_SPAN_FIELD",
+    "compacted_ignition_sweep", "compaction_ladder", "order_signature",
+    "plan_cohorts", "resolve_mode", "stiffness_costs",
+    "surrogate_cost_predictor",
+]
+
+
+def resolve_mode(mode: str | None = None) -> str:
+    """The active scheduling mode: the explicit argument when given,
+    else ``PYCHEMKIN_SCHEDULE``, else ``static``. An unknown value is
+    rejected loudly — a typo'd knob silently running static would fake
+    a scheduling A/B."""
+    raw = mode if mode is not None else os.environ.get(MODE_ENV,
+                                                       "static")
+    if raw not in MODES:
+        raise ValueError(
+            f"unknown schedule mode {raw!r} "
+            f"({'explicit' if mode is not None else MODE_ENV}); "
+            f"expected one of {MODES}")
+    return raw

@@ -1,0 +1,145 @@
+"""Adaptive serving knobs: the batch window and effective batch cap
+follow the live histograms instead of being a deployment-time guess.
+
+The PR 8 tracing work showed the serve queue, not the solver, owning
+latency under load (admission/batch-window spans dominating dispatch).
+The two knobs that control that tradeoff — how long the first request
+of a forming batch waits for company (``max_delay_ms``) and how large
+a batch may grow before dispatching (``max_batch_size``) — have fixed
+defaults. This controller retunes both from recent dispatches:
+
+- **window**: half the recent p50 batch solve time, clamped to
+  ``[window_min, window_max]`` — waiting much longer than half a
+  solve adds latency without adding meaningful occupancy; waiting
+  much less dispatches singletons under load.
+- **batch cap**: the smallest warmed ladder rung covering the recent
+  p95 occupancy, raised one rung when dispatches saturate the current
+  cap. The cap NEVER exceeds the cap the server warmed with, and
+  every value is a warmed rung — so adaptive mode provably triggers
+  zero new XLA compiles (the ``serve.compiles`` invariant of PR 5).
+
+The controller is pure bookkeeping (no jax, no threads): the server
+calls :meth:`observe_batch` after each dispatch and applies the
+returned knob dict when one is due. ``schedule.ladder_adjust`` counts
+applied adjustments; a ``schedule.adjust`` event carries old/new.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+
+
+class AdaptiveController:
+    """Window/batch-cap controller over a warmed bucket ladder.
+
+    ``ladder`` is the server's normalized bucket ladder;
+    ``max_batch_size`` / ``max_delay_ms`` are the server's configured
+    (and warmed) starting knobs — the cap ceiling and the window
+    anchor. ``adjust_every`` dispatches between retunes bounds both
+    the bookkeeping cost and the thrash rate."""
+
+    def __init__(self, ladder: Sequence[int], *, max_batch_size: int,
+                 max_delay_ms: float, adjust_every: int = 64,
+                 history: int = 256, recorder=None,
+                 window_bounds: Optional[tuple] = None):
+        self.ladder = tuple(sorted({int(b) for b in ladder}))
+        self.initial_cap = int(max_batch_size)
+        self.initial_window_ms = float(max_delay_ms)
+        self.cap = self.initial_cap
+        self.window_ms = self.initial_window_ms
+        self.adjust_every = max(1, int(adjust_every))
+        if window_bounds is None:
+            window_bounds = (min(0.25, self.initial_window_ms),
+                             max(8.0 * self.initial_window_ms,
+                                 self.initial_window_ms))
+        self.window_bounds = (float(window_bounds[0]),
+                              float(window_bounds[1]))
+        self._occ = collections.deque(maxlen=int(history))
+        self._solve_ms = collections.deque(maxlen=int(history))
+        self._since_adjust = 0
+        self.n_adjusts = 0
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
+
+    # -- observation -----------------------------------------------------
+    def observe_batch(self, occupancy: int, solve_ms: float
+                      ) -> Optional[Dict[str, float]]:
+        """Record one dispatched batch; every ``adjust_every``
+        dispatches, retune — returns ``{"max_delay_ms",
+        "max_batch_size"}`` when the knobs moved, else None."""
+        self._occ.append(int(occupancy))
+        self._solve_ms.append(float(solve_ms))
+        self._since_adjust += 1
+        if self._since_adjust < self.adjust_every:
+            return None
+        self._since_adjust = 0
+        return self._adjust()
+
+    def _warmed_rungs(self) -> List[int]:
+        return [b for b in self.ladder if b <= self.initial_cap]
+
+    def _adjust(self) -> Optional[Dict[str, float]]:
+        if not self._solve_ms:
+            return None
+        p50_solve = float(np.percentile(self._solve_ms, 50))
+        p95_occ = float(np.percentile(self._occ, 95))
+        new_window = float(np.clip(0.5 * p50_solve,
+                                   self.window_bounds[0],
+                                   self.window_bounds[1]))
+        rungs = self._warmed_rungs()
+        covering = [b for b in rungs if b >= p95_occ]
+        new_cap = min(covering) if covering else self.initial_cap
+        if new_cap < self.cap and p95_occ > 0.75 * new_cap:
+            # shrink hysteresis: only step the cap down when p95
+            # occupancy sits DECISIVELY inside the smaller rung —
+            # otherwise shrink-then-saturate-then-reopen oscillates
+            new_cap = self.cap
+        if p95_occ >= self.cap and self.cap < self.initial_cap:
+            # saturated at the current cap: open one warmed rung —
+            # occupancy is censored at the cap, so covering-rung
+            # selection alone can never climb back up. When no rung
+            # sits strictly between cap and the configured ceiling
+            # (initial_cap need not itself be a ladder rung), reopen
+            # to the ceiling — the cap must never pin BELOW it
+            above = [b for b in rungs if b > self.cap]
+            new_cap = max(new_cap,
+                          min(above) if above else self.initial_cap)
+        window_moved = (abs(new_window - self.window_ms)
+                        > 0.2 * max(self.window_ms, 1e-9))
+        if not window_moved and new_cap == self.cap:
+            return None
+        old = (self.window_ms, self.cap)
+        if window_moved:
+            self.window_ms = new_window
+        self.cap = new_cap
+        self.n_adjusts += 1
+        self._rec.inc("schedule.ladder_adjust")
+        self._rec.event("schedule.adjust",
+                        window_ms=round(self.window_ms, 3),
+                        max_batch=self.cap,
+                        prev_window_ms=round(old[0], 3),
+                        prev_max_batch=old[1],
+                        p50_solve_ms=round(p50_solve, 3),
+                        p95_occupancy=round(p95_occ, 2))
+        return {"max_delay_ms": self.window_ms,
+                "max_batch_size": self.cap}
+
+    # -- exposition ------------------------------------------------------
+    def state(self) -> Dict:
+        """JSON-ready controller state for metrics/chemtop."""
+        occ = list(self._occ)
+        return {
+            "window_ms": round(self.window_ms, 3),
+            "max_batch": self.cap,
+            "initial_window_ms": round(self.initial_window_ms, 3),
+            "initial_max_batch": self.initial_cap,
+            "ladder": list(self.ladder),
+            "adjusts": self.n_adjusts,
+            "occupancy_p50": (float(np.percentile(occ, 50))
+                              if occ else None),
+        }

@@ -1,0 +1,229 @@
+"""Mid-sweep compaction: stop paying batch slots for finished lanes.
+
+A vmapped stiff integration runs its ``while_loop`` until the LAST
+lane reaches the horizon; every iteration costs the full batch width.
+This driver instead advances the batch in bounded step-rounds
+(:func:`pychemkin_tpu.ops.reactors.ignition_sweep_kernel`), harvests
+finished lanes on the host between rounds, and gathers the still-
+active lanes into the smallest fitting bucket of a FIXED shape ladder
+— so a batch that starts 256 wide finishes its stragglers 32 wide,
+and the per-iteration cost tracks the live population instead of the
+initial one.
+
+Compiled-shape discipline: every shape the driver ever dispatches is a
+ladder rung (descending powers of two from the starting width), and
+the kernel's jitted entry points are shape-keyed — after each rung's
+first run (or a warmed persistent-XLA-cache hit) the sweep triggers
+zero new compiles. Padding lanes are edge duplicates of a live lane;
+their results are discarded by global-index bookkeeping.
+
+Bit-match: rounds share the one-shot integrator's step body
+(``odeint._segment_fns``) and lane values are independent of batch
+companions, so harvested results are bit-identical to the compiled
+unsorted vmapped sweep — property-tested in tests/test_schedule.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..ops import reactors
+from ..resilience import faultinject
+from ..resilience.driver import edge_pad_indices
+
+#: step attempts per round between host harvests; the knob trades host
+#: round-trip overhead (one gather + mask read per round) against
+#: compaction granularity
+ROUND_ENV = "PYCHEMKIN_COMPACT_ROUND"
+DEFAULT_ROUND_LEN = 512
+
+#: smallest compaction bucket — a HARD floor, not a tuning default:
+#: below ~8 lanes XLA:CPU lowers the batched step math differently
+#: (vectorization threshold), breaking the per-lane bitwise width-
+#: invariance the compaction contract rests on (measured: widths
+#: >= 8 are bit-invariant on both embedded mechanisms, widths 1-4
+#: are not). It also marks where per-iteration fixed cost dominates.
+MIN_BUCKET = 8
+
+#: resumable-sweep kernels keyed by full solver configuration (incl.
+#: the active fault specs — injection is a trace-time decision, so a
+#: kernel traced clean must not serve an injected sweep)
+_KERNEL_CACHE: Dict[Tuple, Any] = {}
+
+
+def _align(b: int) -> int:
+    """Round a width up to the MIN_BUCKET lane multiple — the bitwise
+    width-invariance domain (XLA:CPU peels non-multiple tails onto a
+    differently-rounding scalar path)."""
+    return -(-int(b) // MIN_BUCKET) * MIN_BUCKET
+
+
+def compaction_ladder(top: int, min_bucket: int = MIN_BUCKET
+                      ) -> Tuple[int, ...]:
+    """Descending shape ladder from ``top``: halving rungs, every rung
+    aligned to the MIN_BUCKET lane multiple and floored at
+    ``max(min_bucket, MIN_BUCKET)`` (raising ``min_bucket`` is a perf
+    knob; lowering it below the invariance floor is not possible)."""
+    top = int(top)
+    if top < 1:
+        raise ValueError(f"ladder top must be positive, got {top}")
+    floor = _align(max(int(min_bucket), MIN_BUCKET))
+    rungs = [_align(top)]
+    b = rungs[0] // 2
+    while _align(b) >= floor and len(rungs) < 6:
+        if _align(b) != rungs[-1]:
+            rungs.append(_align(b))
+        b //= 2
+    return tuple(rungs)
+
+
+def _round_len() -> int:
+    return int(os.environ.get(ROUND_ENV, DEFAULT_ROUND_LEN))
+
+
+def _kernel(mech, problem, energy, cfg: Tuple, kwargs: Dict):
+    key = (id(mech), problem, energy, cfg, faultinject.specs())
+    k = _KERNEL_CACHE.get(key)
+    if k is None:
+        k = _KERNEL_CACHE[key] = reactors.ignition_sweep_kernel(
+            mech, problem, energy, **kwargs)
+    return k
+
+
+def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
+                             t_ends, *, rtol=1e-6, atol=1e-12,
+                             ignition_mode=None, ignition_kwargs=None,
+                             max_steps_per_segment=20_000, h0=0.0,
+                             jac_mode="analytic",
+                             elem_ids: Optional[Sequence[int]] = None,
+                             fault_level: int = 0,
+                             ladder: Optional[Sequence[int]] = None,
+                             round_len: Optional[int] = None,
+                             recorder=None, label: str = ""
+                             ) -> Dict[str, np.ndarray]:
+    """Batched ignition-delay sweep with mid-sweep compaction.
+
+    Same contract as
+    :func:`~pychemkin_tpu.ops.reactors.ignition_delay_sweep` (results
+    bit-match it at the compiled-baseline level), returned as a dict
+    of [B] arrays ``times``/``ok``/``status`` plus the per-element
+    solver counters ``n_steps``/``n_rejected``/``n_newton`` the bench
+    FLOP model sums. ``elem_ids`` carries ORIGINAL batch indices for
+    fault injection — a cohort-permuted scheduled sweep passes the
+    caller ids so the same elements stay poisoned.
+    """
+    if ignition_mode is None:
+        ignition_mode = reactors.IGN_T_INFLECTION
+    T0s = np.atleast_1d(np.asarray(T0s, np.float64))
+    B = T0s.shape[0]
+    P0s = np.broadcast_to(np.asarray(P0s, np.float64), (B,))
+    Y0s = np.broadcast_to(np.asarray(Y0s, np.float64),
+                          (B, np.asarray(Y0s).shape[-1]))
+    t_ends = np.broadcast_to(np.asarray(t_ends, np.float64), (B,))
+    if elem_ids is None:
+        elem_ids = np.arange(B)
+    elem_ids = np.asarray(elem_ids, np.int64)
+    if elem_ids.shape != (B,):
+        raise ValueError(f"elem_ids must have shape ({B},), got "
+                         f"{elem_ids.shape}")
+    rl = int(round_len) if round_len is not None else _round_len()
+    kwargs = dict(rtol=rtol, atol=atol, ignition_mode=ignition_mode,
+                  ignition_kwargs=ignition_kwargs,
+                  max_steps_per_segment=max_steps_per_segment, h0=h0,
+                  jac_mode=jac_mode, fault_level=fault_level,
+                  round_len=rl)
+    cfg = (rtol, atol, str(ignition_mode),
+           tuple(sorted((ignition_kwargs or {}).items())),
+           max_steps_per_segment, h0, jac_mode, fault_level, rl)
+    kernel = _kernel(mech, problem, energy, cfg, kwargs)
+    if ladder is None:
+        ladder = compaction_ladder(B)
+    # the MIN_BUCKET floor/alignment is part of the bit-match
+    # contract (see above): an explicit ladder cannot opt into sub-8
+    # or non-8-multiple shapes — every rung is aligned up, deduped
+    rungs = tuple(sorted({_align(b) for b in ladder if int(b) >= 1},
+                         reverse=True))
+    if not rungs or rungs[0] < B:
+        rungs = (_align(max(B, MIN_BUCKET)),) + rungs
+    rec = recorder if recorder is not None else telemetry.get_recorder()
+
+    out = {
+        "times": np.full(B, np.nan),
+        "ok": np.zeros(B, bool),
+        "status": np.zeros(B, np.int32),
+        "n_steps": np.zeros(B, np.int64),
+        "n_rejected": np.zeros(B, np.int64),
+        "n_newton": np.zeros(B, np.int64),
+    }
+
+    def _gather(arrs, idx):
+        return [jax.tree_util.tree_map(lambda a: a[idx], c)
+                for c in arrs]
+
+    # start at the smallest rung holding the whole batch, edge-padded
+    width = min(b for b in rungs if b >= B)
+    pad = edge_pad_indices(0, B, width)
+    gidx = pad.copy()            # caller index carried by each lane
+    inputs = [jnp.asarray(a) for a in
+              _gather([T0s, P0s, Y0s, t_ends, elem_ids], pad)]
+    state = kernel.init(*inputs)
+
+    n_compactions = 0
+    rounds = 0
+    # each round advances every active lane by >=1 attempt (or it is
+    # done), so attempts bound the round count; the +8 covers the
+    # all-lanes-finish-early exits
+    max_rounds = -(-int(max_steps_per_segment) * 2 // max(rl, 1)) + 8
+    harvested = np.zeros(B, bool)
+    while True:
+        state = kernel.advance(state, *inputs)
+        h = {k: np.asarray(v) for k, v in
+             kernel.harvest(state, *inputs).items()}
+        rounds += 1
+        done = h["done"]
+        new = done & ~harvested[gidx]
+        if new.any():
+            # first write wins per caller index (pad duplicates carry
+            # identical trajectories, so any-write is equivalent; the
+            # mask keeps the bookkeeping single-touch)
+            sel = np.nonzero(new)[0]
+            _, first = np.unique(gidx[sel], return_index=True)
+            sel = sel[first]
+            tgt = gidx[sel]
+            for key in out:
+                out[key][tgt] = h[key][sel]
+            harvested[tgt] = True
+        active = ~done
+        n_active = len(set(gidx[active]))
+        if n_active == 0:
+            break
+        if rounds >= max_rounds:   # pragma: no cover — defensive
+            raise RuntimeError(
+                f"compacted sweep did not converge in {rounds} rounds "
+                f"({n_active} lanes still active)")
+        fitting = [b for b in rungs if b >= n_active]
+        bucket = min(fitting) if fitting else rungs[0]
+        if bucket < width:
+            sel = np.nonzero(active)[0]
+            # keep one lane per distinct caller index, drop stale pads
+            _, first = np.unique(gidx[sel], return_index=True)
+            sel = sel[np.sort(first)]
+            pad = np.concatenate(
+                [sel, np.repeat(sel[-1], bucket - sel.size)])
+            state = jax.tree_util.tree_map(lambda a: a[pad], state)
+            inputs = [jax.tree_util.tree_map(lambda a: a[pad], c)
+                      for c in inputs]
+            gidx = gidx[pad]
+            width = bucket
+            n_compactions += 1
+            rec.inc("schedule.compactions")
+    rec.event("schedule.compaction", label=label, B=B,
+              rounds=rounds, n_compactions=n_compactions,
+              ladder=list(rungs), round_len=rl)
+    return out

@@ -1,0 +1,100 @@
+"""Per-condition cost prediction: how the scheduler guesses which
+elements are expensive BEFORE paying for a solve.
+
+The default predictor is a mechanism-timescale estimate: a Gershgorin
+row bound on the analytic RHS Jacobian at the initial state
+(:func:`pychemkin_tpu.ops.jacobian.batch_rhs_jacobian` assembles it in
+closed form — two skinny matmuls, one evaluation per condition, vs the
+thousands a stiff solve performs). The bound caps the spectral radius
+of J, i.e. the fastest chemical timescale 1/|lambda_max|; multiplied
+by the integration horizon it is a dimensionless stiffness ratio — an
+upper proxy for how many stiff steps the controller will take. The
+ORDERING is what the scheduler consumes (cohorts form from ranks, not
+absolute costs), so a monotone-correlated proxy is enough.
+
+The served surrogate ensemble (PR 9) is an optional sharper predictor:
+it prices ignition delay in ~0.07 ms, and a later-igniting condition
+spends longer in the small-step induction window — pass the model to
+:func:`surrogate_cost_predictor` and hand the result to the sweep's
+``cost_fn``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import jacobian, reactors
+
+#: jitted predictor programs keyed by (mech identity, problem, energy)
+_COST_CACHE: Dict[Tuple, Any] = {}
+
+
+def _cost_fn(mech, problem: str, energy: str):
+    key = (id(mech), problem, energy)
+    fn = _COST_CACHE.get(key)
+    if fn is None:
+        jac_fn = jacobian.batch_rhs_jacobian(problem, energy)
+
+        def one(T0, P0, Y0, t_end):
+            args, y0, _ = reactors.sweep_lane_args(mech, problem, T0,
+                                                   P0, Y0)
+            J = jac_fn(jnp.zeros((), dtype=y0.dtype), y0, args)
+            # Gershgorin: max over rows of sum_j |J_ij| bounds the
+            # spectral radius — the fastest timescale's rate
+            rate = jnp.max(jnp.sum(jnp.abs(J), axis=1))
+            return rate * t_end
+
+        fn = _COST_CACHE[key] = jax.jit(jax.vmap(one))
+    return fn
+
+
+def stiffness_costs(mech, problem: str, energy: str, T0s, P0s, Y0s,
+                    t_ends) -> np.ndarray:
+    """Predicted relative cost [B] of each sweep condition: Gershgorin
+    spectral-radius bound of the analytic Jacobian at t=0, times the
+    integration horizon. All inputs broadcast along the batch axis
+    exactly like :func:`~pychemkin_tpu.ops.reactors
+    .ignition_delay_sweep`."""
+    T0s = np.atleast_1d(np.asarray(T0s, np.float64))
+    B = T0s.shape[0]
+    P0s = np.broadcast_to(np.asarray(P0s, np.float64), (B,))
+    Y0s = np.broadcast_to(np.asarray(Y0s, np.float64),
+                          (B, np.asarray(Y0s).shape[-1]))
+    t_ends = np.broadcast_to(np.asarray(t_ends, np.float64), (B,))
+    costs = _cost_fn(mech, problem, energy)(
+        jnp.asarray(T0s), jnp.asarray(P0s), jnp.asarray(Y0s),
+        jnp.asarray(t_ends))
+    return np.asarray(costs, np.float64)
+
+
+def surrogate_cost_predictor(model) -> Callable:
+    """A sharper cost predictor from a trained ignition-delay
+    surrogate (:mod:`pychemkin_tpu.surrogate`): predicted ignition
+    delay, clamped to the horizon. A later-igniting condition holds
+    the controller in its small-step induction window longer, so
+    predicted delay orders integration cost. Returns a callable with
+    the :func:`stiffness_costs` signature (mech/problem/energy are
+    accepted and ignored — the model already encodes the chemistry).
+    """
+    from ..surrogate import model as sg_model
+
+    def predict(mech, problem, energy, T0s, P0s, Y0s, t_ends
+                ) -> np.ndarray:
+        T0s = np.atleast_1d(np.asarray(T0s, np.float64))
+        B = T0s.shape[0]
+        P0s = np.broadcast_to(np.asarray(P0s, np.float64), (B,))
+        Y0s = np.broadcast_to(np.asarray(Y0s, np.float64),
+                              (B, np.asarray(Y0s).shape[-1]))
+        t_ends = np.broadcast_to(np.asarray(t_ends, np.float64), (B,))
+        feats = sg_model.features(jnp.asarray(T0s), jnp.asarray(P0s),
+                                  jnp.asarray(Y0s))
+        log_tau = jnp.mean(sg_model.predict(model, feats)[..., 0],
+                           axis=0)
+        tau = np.asarray(10.0 ** log_tau, np.float64)
+        return np.minimum(tau, t_ends)
+
+    return predict

@@ -96,13 +96,53 @@ def default_samplers(mech, kinds: Sequence[str], *,
     return out
 
 
+#: wide stiffness-mix draw ranges: the production-traffic shape where
+#: one batch mixes cheap near-equilibrium conditions with stiff cool
+#: inductions — what the scheduling layer exists to absorb
+STIFFNESS_MIX_T = (1100.0, 1450.0)
+STIFFNESS_MIX_PHI = (0.5, 2.0)
+
+
+def stiffness_mix_sampler(mech, kind: str = "ignition", *,
+                          T_range=STIFFNESS_MIX_T,
+                          phi_range=STIFFNESS_MIX_PHI,
+                          P=1.01325e6, t_end=4e-4):
+    """A ``(sampler, classify)`` pair for mixed-stiffness soaks: the
+    sampler draws ignition payloads over a WIDE (T0, phi) box (every
+    request gets its own equivalence-ratio composition), and the
+    classifier labels each request ``cool``/``mid``/``hot`` by initial
+    temperature tercile — cool lanes hold the stiff induction window
+    longest, so the per-cohort latency split in the artifact shows
+    what mixed-stiffness batching costs each class."""
+    from ..surrogate.dataset import phi_composition
+
+    t1 = T_range[0] + (T_range[1] - T_range[0]) / 3.0
+    t2 = T_range[0] + 2.0 * (T_range[1] - T_range[0]) / 3.0
+
+    def sampler(i, rng):
+        T0 = float(rng.uniform(*T_range))
+        phi = float(rng.uniform(*phi_range))
+        Y0 = phi_composition(mech, phi)[0]
+        return kind, dict(T0=T0, P0=P, Y0=Y0, t_end=t_end)
+
+    def classify(kind_, payload):
+        T0 = payload.get("T0")
+        if T0 is None:
+            return None
+        return "cool" if T0 < t1 else ("mid" if T0 < t2 else "hot")
+
+    return sampler, classify
+
+
 def run_load(server, samplers: Sequence[Sampler], *,
              rate_hz: float, n_requests: int,
              rng: np.random.Generator,
              result_timeout_s: float = 300.0,
              deadline_ms: Optional[float] = None,
              trace_events: Optional[Callable[[], List[Dict]]] = None,
-             n_exemplars: int = 5) -> Dict:
+             n_exemplars: int = 5,
+             classify: Optional[Callable[[str, Dict],
+                                         Optional[str]]] = None) -> Dict:
     """Drive ``server`` with an open-loop Poisson stream; returns the
     JSON-ready latency summary.
 
@@ -131,7 +171,13 @@ def run_load(server, samplers: Sequence[Sampler], *,
     when ``trace_events`` (a callable returning ``trace.span`` events,
     e.g. read from the JSONL sinks) is given, its per-stage span
     breakdown — so a bad soak run points at the guilty stage without
-    replaying it."""
+    replaying it.
+
+    ``classify`` optionally labels each request from its sampled
+    ``(kind, payload)`` (return None to leave a request unlabeled);
+    the summary then carries a ``cohorts`` block with the per-label
+    latency split (n/p50/p95/mean ms) — how the stiffness-mix soak
+    attributes latency to predicted-cost cohorts."""
     if not samplers:
         raise ValueError("need at least one payload sampler")
     arrivals = np.cumsum(rng.exponential(1.0 / rate_hz,
@@ -150,6 +196,7 @@ def run_load(server, samplers: Sequence[Sampler], *,
             time.sleep(min(target - now, 0.01))
         kind, payload = samplers[int(rng.integers(len(samplers)))](
             i, rng)
+        cohort = classify(kind, payload) if classify else None
         t_sub = time.perf_counter()
         tid = trace.new_trace_id()
         try:
@@ -166,7 +213,7 @@ def run_load(server, samplers: Sequence[Sampler], *,
         fut.add_done_callback(
             lambda f, j=i: done_at.__setitem__(
                 j, time.perf_counter()))
-        records.append((i, kind, fut, t_sub, tid))
+        records.append((i, kind, fut, t_sub, tid, cohort))
     offered_s = time.perf_counter() - t0
 
     lat_ms: List[float] = []
@@ -180,7 +227,8 @@ def run_load(server, samplers: Sequence[Sampler], *,
     n_resolved = 0
     n_surrogate_hit = 0
     n_surrogate_fallback = 0
-    for i, kind, fut, t_sub, tid in records:
+    cohort_lat: Dict[str, List[float]] = {}
+    for i, kind, fut, t_sub, tid, cohort in records:
         try:
             res = fut.result(timeout=result_timeout_s)
         except _cf.TimeoutError:
@@ -211,6 +259,8 @@ def run_load(server, samplers: Sequence[Sampler], *,
             time.sleep(1e-4)
         latency = (done_at[i] - t_sub) * 1e3
         lat_ms.append(latency)
+        if cohort is not None:
+            cohort_lat.setdefault(cohort, []).append(latency)
         occupancies.append(res.occupancy)
         status_counts[res.status_name] = (
             status_counts.get(res.status_name, 0) + 1)
@@ -260,9 +310,22 @@ def run_load(server, samplers: Sequence[Sampler], *,
         return (round(float(np.percentile(lat, q)), 3)
                 if lat_ms else None)
 
+    cohorts = None
+    if classify is not None:
+        cohorts = {}
+        for label, ls in sorted(cohort_lat.items()):
+            a = np.asarray(ls)
+            cohorts[label] = {
+                "n": int(a.size),
+                "p50_ms": round(float(np.percentile(a, 50)), 3),
+                "p95_ms": round(float(np.percentile(a, 95)), 3),
+                "mean_ms": round(float(a.mean()), 3),
+            }
+
     return {
         "n_requests": n_requests,
         "n_served": n_resolved,
+        **({"cohorts": cohorts} if classify is not None else {}),
         "n_rejected": n_rejected,
         "n_rejected_with_hint": n_rejected_with_hint,
         "n_timeout": n_timeout,

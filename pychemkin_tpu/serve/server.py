@@ -65,6 +65,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from .. import schedule as schedule_mod
 from .. import telemetry
 from ..resilience.driver import GracefulStop
 from ..resilience.status import SolveStatus, name_of
@@ -85,7 +86,14 @@ class ChemServer:
     ``{"ignition": {"rtol": 1e-5, "max_steps_per_segment": 4000}}``.
     Engines are built lazily on first use of a kind unless listed in
     ``kinds``. ``rescue=False`` disables the ladder: failed elements
-    resolve immediately with their hot-path status."""
+    resolve immediately with their hot-path status.
+
+    ``schedule`` (default: the ``PYCHEMKIN_SCHEDULE`` env knob) —
+    ``"adaptive"`` retunes ``max_delay_ms`` and the effective batch
+    cap from the live occupancy/solve-time histograms
+    (:class:`pychemkin_tpu.schedule.AdaptiveController`); every
+    adapted value stays on the warmed bucket ladder, so adaptive mode
+    adds zero XLA compiles after :meth:`warmup`."""
 
     def __init__(self, mech, *,
                  bucket_sizes: Sequence[int] = buckets.DEFAULT_BUCKETS,
@@ -96,13 +104,28 @@ class ChemServer:
                  max_rescue_rungs: Optional[int] = None,
                  recorder=None,
                  kinds: Sequence[str] = (),
-                 engine_config: Optional[Dict[str, Dict]] = None):
+                 engine_config: Optional[Dict[str, Dict]] = None,
+                 schedule: Optional[str] = None):
         self.mech = mech
         self.buckets = buckets.normalize_ladder(bucket_sizes)
         top = self.buckets[-1]
         self.policy = batcher.BatchPolicy(
             max_batch_size=min(int(max_batch_size or top), top),
             max_delay_ms=float(max_delay_ms))
+        # stiffness-aware scheduling (PYCHEMKIN_SCHEDULE): "adaptive"
+        # retunes the batch window and the effective batch cap from
+        # the live occupancy/solve-time histograms; every adapted
+        # value stays on the warmed bucket ladder, so adaptive mode
+        # provably adds zero XLA compiles after warmup
+        self.schedule_mode = schedule_mod.resolve_mode(schedule)
+        self._sched: Optional[schedule_mod.AdaptiveController] = None
+        if self.schedule_mode == "adaptive":
+            self._sched = schedule_mod.AdaptiveController(
+                self.buckets,
+                max_batch_size=self.policy.max_batch_size,
+                max_delay_ms=self.policy.max_delay_ms,
+                recorder=(recorder if recorder is not None
+                          else telemetry.get_recorder()))
         self.queue_depth = int(queue_depth)
         self.rescue_enabled = bool(rescue)
         self.max_rescue_rungs = max_rescue_rungs
@@ -561,6 +584,19 @@ class ChemServer:
         self._rec.inc("serve.batches")
         self._rec.observe("serve.batch_occupancy", occupancy)
         self._rec.observe("serve.solve_ms", solve_ms)
+        # per-bucket occupancy distribution: the fleet-exposition
+        # signal the adaptive ladder (and chemtop's schedule view)
+        # reads — how full each compiled shape actually runs
+        self._rec.observe(f"serve.occupancy.b{bucket}", occupancy)
+        if self._sched is not None:
+            knobs = self._sched.observe_batch(occupancy, solve_ms)
+            if knobs:
+                # worker-thread-only mutation; collect() re-reads
+                # self.policy every batch, so the new window/cap take
+                # effect at the next batch formation
+                self.policy = self.policy._replace(
+                    max_delay_ms=knobs["max_delay_ms"],
+                    max_batch_size=int(knobs["max_batch_size"]))
         n_handed_off = 0
         for i, req in enumerate(reqs):
             try:
@@ -584,7 +620,8 @@ class ChemServer:
                         self._rec, req.trace_id, "serve.dispatch",
                         solve_ms, req_kind=kind, bucket=bucket,
                         occupancy=occupancy, compile_hit=compile_hit,
-                        lane=i, status=name_of(status))
+                        lane=i, status=name_of(status),
+                        schedule=self.schedule_mode)
                     if eng.trace_span_name:
                         # engine-declared extra span (e.g. the
                         # surrogate's verified/residual verdict)
@@ -705,3 +742,24 @@ class ChemServer:
         """The attached recorder's aggregate snapshot (queue-depth
         gauge, latency/occupancy histograms, per-status counters)."""
         return self._rec.snapshot()
+
+    def schedule_state(self) -> Dict[str, Any]:
+        """The scheduling layer's live state, JSON-ready: mode, the
+        current (possibly adapted) window and batch cap, the bucket
+        ladder, and per-bucket occupancy p50 — what the transport
+        ``metrics`` op exposes and ``tools/chemtop.py`` renders."""
+        per_bucket = {}
+        for b in self.buckets:
+            h = self._rec.histogram_summary(f"serve.occupancy.b{b}")
+            if h.get("count"):
+                per_bucket[str(b)] = h.get("p50")
+        state: Dict[str, Any] = {
+            "mode": self.schedule_mode,
+            "window_ms": round(self.policy.max_delay_ms, 3),
+            "max_batch": self.policy.max_batch_size,
+            "ladder": list(self.buckets),
+            "bucket_occupancy_p50": per_bucket,
+        }
+        if self._sched is not None:
+            state["adaptive"] = self._sched.state()
+        return state

@@ -475,7 +475,13 @@ class TransportServer:
                 "counters": snap["counters"],
                 "gauges": snap["gauges"],
                 "histograms": snap["histograms"],
-                "histogram_states": self._rec.histogram_states()}
+                "histogram_states": self._rec.histogram_states(),
+                # per-mechanism scheduling state (mode, live window/
+                # batch-cap, ladder, per-bucket occupancy p50) — the
+                # adaptive-ladder view chemtop renders per backend
+                "schedule": {mech: srv.schedule_state()
+                             for mech, srv
+                             in sorted(self._servers.items())}}
 
     def _overload_reply(self, rid, *, scope: str, queue_depth: int,
                         retry_after_ms: Optional[float],

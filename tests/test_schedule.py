@@ -1,0 +1,488 @@
+"""Stiffness-aware scheduling tests (ISSUE 12): cost prediction,
+cohort planning, mid-sweep compaction, driver-order scatter, and the
+adaptive serve controller.
+
+The answer-fidelity contract, precisely:
+
+- **Same-program bitwise**: a sorted/compacted sweep bit-matches the
+  unsorted sweep run through the SAME compiled step kernel at full
+  width, in caller order — rounds share ``odeint._segment_fns`` and
+  lane math is batch-width-invariant on the ladder shapes, so
+  pausing, permuting, and compacting are identities. Property-tested
+  on BOTH embedded mechanisms, including rescue-ladder interaction.
+- **Cross-program**: against the legacy shard-program static path the
+  results agree with identical ok/status; times are bitwise-equal on
+  h2o2 and within XLA value-dependent fusion rounding (~1e-12
+  relative) on GRI-scale mechanisms — two compiled programs of the
+  same math, the same caveat that already separates eager from jitted
+  execution of the existing sweep.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu import parallel, schedule, telemetry
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import reactors
+from pychemkin_tpu.resilience import faultinject, rescue
+from pychemkin_tpu.resilience.driver import run_vmapped_sweep_job
+from pychemkin_tpu.resilience.faultinject import FaultSpec
+from pychemkin_tpu.schedule.adaptive import AdaptiveController
+from pychemkin_tpu.surrogate.dataset import phi_composition
+
+P_ATM = 1.01325e6
+
+
+@pytest.fixture(scope="module")
+def h2o2():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def grisyn():
+    return load_embedded("grisyn")
+
+
+def _mixed_conditions(mech, B, t_end, seed=0):
+    rng = np.random.default_rng(seed)
+    T0s = rng.uniform(1000.0, 1400.0, B)
+    P0s = P_ATM * (1.0 + rng.uniform(0.0, 1.0, B))
+    Y0s = np.stack([phi_composition(mech, float(p))[0]
+                    for p in rng.uniform(0.6, 1.6, B)])
+    t_ends = np.full(B, t_end)
+    return T0s, P0s, Y0s, t_ends
+
+
+def _kernel_baseline(mech, T0s, P0s, Y0s, t_ends, **kw):
+    """The unsorted vmapped baseline run through the SAME compiled
+    step kernel (full width, no sorting, no compaction) — the strict
+    bitwise reference of the scheduling contract."""
+    B = len(T0s)
+    return schedule.compacted_ignition_sweep(
+        mech, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+        ladder=(B,), **kw)
+
+
+# ---------------------------------------------------------------------------
+# mode knob
+
+class TestMode:
+    def test_default_static(self, monkeypatch):
+        monkeypatch.delenv(schedule.MODE_ENV, raising=False)
+        assert schedule.resolve_mode() == "static"
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv(schedule.MODE_ENV, "sorted")
+        assert schedule.resolve_mode() == "sorted"
+
+    def test_explicit_wins(self, monkeypatch):
+        monkeypatch.setenv(schedule.MODE_ENV, "sorted")
+        assert schedule.resolve_mode("adaptive") == "adaptive"
+
+    def test_invalid_rejected(self, monkeypatch):
+        monkeypatch.setenv(schedule.MODE_ENV, "sortd")
+        with pytest.raises(ValueError, match="sortd"):
+            schedule.resolve_mode()
+        with pytest.raises(ValueError, match="bogus"):
+            schedule.resolve_mode("bogus")
+
+
+# ---------------------------------------------------------------------------
+# predictor + cohorts
+
+class TestPredictor:
+    def test_costs_finite_positive_deterministic(self, h2o2):
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 6, 2e-3)
+        c1 = schedule.stiffness_costs(h2o2, "CONP", "ENRG", T0s, P0s,
+                                      Y0s, t_ends)
+        c2 = schedule.stiffness_costs(h2o2, "CONP", "ENRG", T0s, P0s,
+                                      Y0s, t_ends)
+        assert c1.shape == (6,)
+        assert np.all(np.isfinite(c1)) and np.all(c1 > 0)
+        assert np.array_equal(c1, c2)
+
+    def test_costs_scale_with_horizon(self, h2o2):
+        Y0 = phi_composition(h2o2, 1.0)[0]
+        c = schedule.stiffness_costs(
+            h2o2, "CONP", "ENRG", np.array([1200.0, 1200.0]), P_ATM,
+            Y0, np.array([1e-3, 2e-3]))
+        assert c[1] == pytest.approx(2.0 * c[0], rel=1e-12)
+
+    def test_costs_order_by_temperature(self, h2o2):
+        # the Gershgorin bound tracks the fastest local timescale:
+        # hotter initial states react faster — monotone in T0, which
+        # is all cohort formation needs (rank, not absolute cost)
+        Y0 = phi_composition(h2o2, 1.0)[0]
+        c = schedule.stiffness_costs(
+            h2o2, "CONP", "ENRG", np.linspace(1000.0, 1400.0, 5),
+            P_ATM, Y0, 2e-3)
+        assert np.all(np.diff(c) > 0)
+
+
+class TestCohorts:
+    def test_plan_is_stable_cost_sort(self):
+        plan = schedule.plan_cohorts(
+            np.array([3.0, 1.0, 2.0, 1.0]), chunk=2)
+        assert plan.order.tolist() == [1, 3, 2, 0]
+        assert plan.n_cohorts == 2
+        assert np.array_equal(plan.order[plan.inverse], np.arange(4))
+        assert not plan.is_identity
+
+    def test_nonfinite_costs_sort_last(self):
+        plan = schedule.plan_cohorts(
+            np.array([2.0, np.nan, 1.0, np.inf]), chunk=4)
+        assert plan.order.tolist() == [2, 0, 1, 3]
+
+    def test_counter_and_event(self):
+        rec = telemetry.MetricsRecorder()
+        schedule.plan_cohorts(np.arange(10.0), chunk=3, recorder=rec,
+                              label="t")
+        assert rec.counters["schedule.cohorts"] == 4
+        ev = rec.last_event("schedule.plan")
+        assert ev["n_cohorts"] == 4 and ev["B"] == 10
+
+    def test_order_signature_distinguishes(self):
+        a = schedule.order_signature(np.array([0, 1, 2]))
+        b = schedule.order_signature(np.array([2, 1, 0]))
+        assert a != b
+        assert schedule.order_signature(None) == "static"
+
+
+# ---------------------------------------------------------------------------
+# compaction: the bit-match property (ISSUE 12 acceptance)
+
+class TestCompaction:
+    def test_ladder_shape(self):
+        assert schedule.compaction_ladder(64) == (64, 32, 16, 8)
+        assert schedule.compaction_ladder(8) == (8,)
+        # rungs align UP to the 8-lane invariance multiple
+        assert schedule.compaction_ladder(12) == (16, 8)
+        # min_bucket can RAISE the floor, never lower it below 8
+        assert schedule.compaction_ladder(64, min_bucket=16) == \
+            (64, 32, 16)
+        assert schedule.compaction_ladder(64, min_bucket=2) == \
+            (64, 32, 16, 8)
+
+    def test_h2o2_bitmatch_vmapped_and_kernel(self, h2o2):
+        """Compacted results bit-match BOTH the legacy jitted vmapped
+        sweep (same starting width — the cross-program claim holds on
+        h2o2) and the same-kernel unsorted baseline."""
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 16, 2e-3)
+        fn = jax.jit(lambda T, P, Y, te: reactors.ignition_delay_sweep(
+            h2o2, "CONP", "ENRG", T, P, Y, te))
+        t_ref, ok_ref, st_ref = [np.asarray(x) for x in fn(
+            jnp.asarray(T0s), jnp.asarray(P0s), jnp.asarray(Y0s),
+            jnp.asarray(t_ends))]
+        rec = telemetry.MetricsRecorder()
+        out = schedule.compacted_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+            ladder=(16, 8), round_len=150, recorder=rec)
+        assert np.array_equal(out["times"], t_ref, equal_nan=True)
+        assert np.array_equal(out["ok"], ok_ref)
+        assert np.array_equal(out["status"], st_ref)
+        assert rec.counters["schedule.compactions"] >= 1
+        base = _kernel_baseline(h2o2, T0s, P0s, Y0s, t_ends,
+                                round_len=150)
+        assert np.array_equal(base["times"], out["times"],
+                              equal_nan=True)
+
+    def test_grisyn_bitmatch_kernel_baseline(self, grisyn):
+        """The same-program claim on the GRI-scale mechanism: sorted
+        order + compaction + round splitting change NOTHING bitwise
+        vs the unsorted full-width kernel run (short horizon keeps
+        this in the fast lane)."""
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(grisyn, 10, 2e-5)
+        base = _kernel_baseline(grisyn, T0s, P0s, Y0s, t_ends,
+                                round_len=400)      # width 16 (aligned)
+        order = np.argsort(schedule.stiffness_costs(
+            grisyn, "CONP", "ENRG", T0s, P0s, Y0s, t_ends),
+            kind="stable")
+        out = schedule.compacted_ignition_sweep(
+            grisyn, "CONP", "ENRG", T0s[order], P0s[order],
+            Y0s[order], t_ends[order], ladder=(16, 8),
+            round_len=100, elem_ids=order)
+        inv = np.empty(10, np.int64)
+        inv[order] = np.arange(10)
+        for key in ("times", "ok", "status"):
+            assert np.array_equal(np.asarray(out[key])[inv],
+                                  base[key], equal_nan=True), key
+
+    def test_counters_returned(self, h2o2):
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 4, 1e-4)
+        out = schedule.compacted_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+            ladder=(4,), round_len=5000)
+        assert out["n_steps"].shape == (4,)
+        assert np.all(out["n_steps"] > 0)
+        assert np.all(out["n_newton"] >= out["n_steps"])
+
+
+# ---------------------------------------------------------------------------
+# driver order plumbing
+
+class TestDriverOrder:
+    def _solve(self, calls=None):
+        def index_solve(idx):
+            if calls is not None:
+                calls.append(np.asarray(idx).copy())
+            return {"v": np.asarray(idx, np.float64) * 10.0}
+        return index_solve
+
+    def test_results_scattered_to_caller_order(self):
+        calls = []
+        order = np.array([3, 1, 0, 2])
+        results, _ = run_vmapped_sweep_job(
+            self._solve(calls), 4, chunk_size=2, order=order)
+        # solved in schedule order...
+        assert calls[0].tolist() == [3, 1]
+        assert calls[1].tolist() == [0, 2]
+        # ...returned in caller order
+        assert results["v"].tolist() == [0.0, 10.0, 20.0, 30.0]
+
+    def test_rescue_sees_caller_order(self):
+        seen = {}
+
+        def rescue_cb(results):
+            seen["v"] = results["v"].copy()
+
+        run_vmapped_sweep_job(self._solve(), 4, chunk_size=4,
+                              order=np.array([2, 3, 0, 1]),
+                              rescue=rescue_cb)
+        assert seen["v"].tolist() == [0.0, 10.0, 20.0, 30.0]
+
+    def test_bad_order_rejected(self):
+        with pytest.raises(ValueError, match="permutation"):
+            run_vmapped_sweep_job(self._solve(), 4,
+                                  order=np.array([0, 1, 1, 2]))
+
+    def test_order_salts_checkpoint_signature(self, tmp_path):
+        """A manifest banked under one order must not be adopted
+        under another (banked arrays are in schedule order)."""
+        path = str(tmp_path / "ck.npz")
+        order_a = np.array([1, 0, 3, 2])
+        run_vmapped_sweep_job(self._solve(), 4, chunk_size=2,
+                              order=order_a, checkpoint_path=path,
+                              signature="sig")
+        # same order DOES resume (pure short-circuit off the bank)
+        calls2 = []
+        res2, report2 = run_vmapped_sweep_job(
+            self._solve(calls2), 4, chunk_size=2, order=order_a,
+            checkpoint_path=path, signature="sig")
+        assert report2.resume_count >= 1
+        assert calls2 == []                      # nothing re-solved
+        assert res2["v"].tolist() == [0.0, 10.0, 20.0, 30.0]
+        # a DIFFERENT order must not adopt the bank (its arrays are
+        # in the old schedule order): clean re-solve, right answers
+        calls = []
+        results, report = run_vmapped_sweep_job(
+            self._solve(calls), 4, chunk_size=2,
+            order=np.array([3, 2, 1, 0]), checkpoint_path=path,
+            signature="sig")
+        assert report.resumed_upto == 0          # stale bank ignored
+        assert len(calls) == 2                   # solved from scratch
+        assert results["v"].tolist() == [0.0, 10.0, 20.0, 30.0]
+
+
+# ---------------------------------------------------------------------------
+# scheduled sharded sweep end to end (incl. rescue interaction)
+
+class TestScheduledSweep:
+    def test_sorted_sweep_matches_static(self, h2o2):
+        # chunk 8 = one aligned width on both paths: the static shard
+        # program and the scheduled kernel dispatch the same shapes,
+        # where the cross-program bitwise claim holds on h2o2
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 16, 2e-3)
+        mesh = parallel.make_mesh(1)
+        kw = dict(mesh=mesh, rtol=1e-6, atol=1e-12,
+                  max_steps_per_segment=20_000, chunk_size=8)
+        t_s, ok_s, st_s = parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+            schedule="static", **kw)
+        report = {}
+        t_x, ok_x, st_x = parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+            schedule="sorted", job_report=report, **kw)
+        assert np.array_equal(np.asarray(t_s), np.asarray(t_x),
+                              equal_nan=True)
+        assert np.array_equal(np.asarray(ok_s), np.asarray(ok_x))
+        assert np.array_equal(np.asarray(st_s), np.asarray(st_x))
+        assert report["schedule"] == "sorted"
+        assert report["schedule_compaction"] is True
+        assert report["schedule_cohorts"] == 2
+
+    def test_multi_device_mesh_sorts_without_compaction(self, h2o2):
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 8, 1e-4)
+        mesh = parallel.make_mesh()       # the 8-device virtual mesh
+        report = {}
+        t_x, ok_x, st_x = parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends, mesh=mesh,
+            schedule="sorted", job_report=report)
+        t_s, ok_s, st_s = parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends, mesh=mesh,
+            schedule="static")
+        assert report["schedule_compaction"] is False
+        assert np.array_equal(np.asarray(t_s), np.asarray(t_x),
+                              equal_nan=True)
+        assert np.array_equal(np.asarray(st_s), np.asarray(st_x))
+
+    def test_rescue_ladder_interaction(self, h2o2):
+        """A scheduled sweep with an injected failure feeds the SAME
+        elements to the rescue ladder as the static path, and the
+        rescued results agree in caller order — the fault tracks the
+        ORIGINAL element id through the cohort permutation."""
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 8, 2e-3)
+        mesh = parallel.make_mesh(1)
+        kw = dict(mesh=mesh, rtol=1e-6, atol=1e-12,
+                  max_steps_per_segment=20_000, chunk_size=8)
+        spec = FaultSpec(mode="nan_rhs", elements=(2,), heal_at=1)
+        with faultinject.inject(spec):
+            t_x, ok_x, st_x = parallel.sharded_ignition_sweep(
+                h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+                schedule="sorted", **kw)
+            # the shard path embeds no faults (it never threads
+            # elem ids); the scheduled path does — element 2, in
+            # CALLER order, must be the poisoned lane
+            assert int(st_x[2]) != 0
+            assert np.sum(np.asarray(st_x) != 0) == 1
+            times, ok, st, rep = rescue.resilient_ignition_sweep(
+                h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+                rtol=1e-6, atol=1e-12, max_steps_per_segment=20_000,
+                base_results={"times": np.array(t_x),
+                              "ok": np.array(ok_x),
+                              "status": np.array(st_x)})
+        assert rep.n_failed == 1 and rep.n_rescued == 1
+        clean = np.asarray(parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+            schedule="static", **kw)[0])
+        # healthy lanes are untouched by rescue and agree with an
+        # uninjected static sweep; the healed lane re-solved at the
+        # ladder's TIGHTER rtol, so it agrees to solver tolerance
+        healthy = np.arange(8) != 2
+        np.testing.assert_allclose(np.asarray(times)[healthy],
+                                   clean[healthy], rtol=1e-9)
+        assert np.asarray(times)[2] == pytest.approx(clean[2],
+                                                     rel=1e-3)
+        assert np.all(st == 0)
+
+
+# ---------------------------------------------------------------------------
+# adaptive controller (pure)
+
+class TestAdaptiveController:
+    def _ctl(self, **kw):
+        rec = telemetry.MetricsRecorder()
+        kw.setdefault("adjust_every", 8)
+        return AdaptiveController((1, 8, 32), max_batch_size=32,
+                                  max_delay_ms=2.0, recorder=rec,
+                                  **kw), rec
+
+    def test_window_follows_solve_time(self):
+        ctl, rec = self._ctl()
+        out = None
+        for _ in range(8):
+            out = ctl.observe_batch(occupancy=2, solve_ms=20.0)
+        assert out is not None
+        assert out["max_delay_ms"] == pytest.approx(10.0)
+        assert rec.counters["schedule.ladder_adjust"] == 1
+        assert rec.last_event("schedule.adjust")["max_batch"] == 8
+
+    def test_cap_tracks_p95_occupancy(self):
+        ctl, _ = self._ctl()
+        for _ in range(8):
+            out = ctl.observe_batch(occupancy=5, solve_ms=4.0)
+        assert out["max_batch_size"] == 8
+
+    def test_saturation_reopens_to_non_rung_ceiling(self):
+        """A configured cap BETWEEN ladder rungs (max_batch_size=6 on
+        a (1,8,32)... here (1,4,8) shape) must be recoverable: after
+        a lull shrinks the cap to a rung, saturation with no rung
+        strictly between cap and ceiling reopens to the ceiling
+        itself, never pinning below it."""
+        rec = telemetry.MetricsRecorder()
+        ctl = AdaptiveController((1, 4, 8), max_batch_size=6,
+                                 max_delay_ms=2.0, adjust_every=8,
+                                 recorder=rec)
+        for _ in range(8):
+            ctl.observe_batch(occupancy=2, solve_ms=4.0)
+        assert ctl.cap == 4                  # lull shrank it
+        for _ in range(16):
+            ctl.observe_batch(occupancy=4, solve_ms=4.0)
+        assert ctl.cap == 6                  # ceiling restored
+
+    def test_cap_never_exceeds_warmed_initial(self):
+        ctl, _ = self._ctl()
+        for _ in range(8):
+            out = ctl.observe_batch(occupancy=500, solve_ms=4.0)
+        assert (out or {}).get("max_batch_size", ctl.cap) <= 32
+
+    def test_saturated_cap_reopens_one_rung(self):
+        ctl, _ = self._ctl()
+        for _ in range(8):
+            ctl.observe_batch(occupancy=2, solve_ms=4.0)
+        assert ctl.cap == 8                  # stepped down
+        for _ in range(16):
+            out = ctl.observe_batch(occupancy=8, solve_ms=4.0)
+        assert ctl.cap == 32                 # saturation reopens
+
+    def test_no_churn_when_stable(self):
+        ctl, rec = self._ctl()
+        n = 0
+        for _ in range(64):
+            if ctl.observe_batch(occupancy=6, solve_ms=4.0):
+                n += 1
+        assert n <= 1                        # one settle, then quiet
+
+    def test_state_shape(self):
+        ctl, _ = self._ctl()
+        ctl.observe_batch(occupancy=3, solve_ms=5.0)
+        st = ctl.state()
+        assert st["ladder"] == [1, 8, 32]
+        assert st["initial_max_batch"] == 32
+        assert st["occupancy_p50"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# loadgen stiffness mix
+
+class TestStiffnessMix:
+    def test_sampler_and_classifier(self, h2o2):
+        from pychemkin_tpu.serve import loadgen
+        sampler, classify = loadgen.stiffness_mix_sampler(h2o2)
+        rng = np.random.default_rng(0)
+        labels = set()
+        for i in range(40):
+            kind, payload = sampler(i, rng)
+            assert kind == "ignition"
+            assert payload["Y0"].shape == (h2o2.n_species,)
+            labels.add(classify(kind, payload))
+        assert labels == {"cool", "mid", "hot"}
+        assert classify("ignition", {"tau": 1.0}) is None
+
+    def test_run_load_cohort_split(self, h2o2):
+        """Cohort latency split rides the summary via classify= —
+        against a fake server so the test costs milliseconds."""
+        from pychemkin_tpu.serve import loadgen
+        from pychemkin_tpu.serve.futures import ServeFuture, \
+            make_result
+
+        class FakeServer:
+            def submit(self, kind, trace_id=None, **payload):
+                fut = ServeFuture()
+                fut.set_result(make_result(
+                    {}, 0, kind=kind, bucket=1, occupancy=1,
+                    queue_wait_ms=0.0, solve_ms=1.0))
+                return fut
+
+        sampler, classify = loadgen.stiffness_mix_sampler(h2o2)
+        summary = loadgen.run_load(
+            FakeServer(), [sampler], rate_hz=5000.0, n_requests=30,
+            rng=np.random.default_rng(0), classify=classify)
+        cohorts = summary["cohorts"]
+        assert set(cohorts) <= {"cool", "mid", "hot"}
+        assert sum(c["n"] for c in cohorts.values()) == 30
+        for c in cohorts.values():
+            assert c["p50_ms"] >= 0.0

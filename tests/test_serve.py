@@ -340,6 +340,64 @@ class TestServing:
             assert {"p50", "p95", "p99"} <= set(snap["histograms"][h])
 
 
+    def test_adaptive_schedule_zero_compiles_and_span_field(
+            self, mech, Y_h2air):
+        """ISSUE-12 serve acceptance: with PYCHEMKIN_SCHEDULE=adaptive
+        the window/batch-cap knobs retune from live histograms, every
+        dispatch span carries the schedule mode, and — because every
+        adapted value stays on the warmed ladder — live traffic
+        triggers ZERO new XLA compiles after warmup."""
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(
+            mech, bucket_sizes=(1, 4, 8), max_delay_ms=50.0,
+            recorder=rec, schedule="adaptive")
+        assert server.schedule_mode == "adaptive"
+        # force frequent retunes so a short test exercises the path
+        server._sched.adjust_every = 2
+        server.warmup(["equilibrium"])
+        warm_compiles = rec.counters["serve.compiles"]
+        with server:
+            for wave in range(6):
+                futs = [server.submit_equilibrium(
+                    **_eq_payload(Y_h2air, 1000.0 + 50 * i))
+                    for i in range(3)]
+                for f in futs:
+                    assert f.result(timeout=60).ok
+        # adaptive knobs moved (window follows the stiff solve p50;
+        # the cap stepped down to the 4-rung covering occupancy 3)...
+        assert rec.counters.get("schedule.ladder_adjust", 0) >= 1
+        assert server.policy.max_batch_size in (4, 8)
+        # ...and never off the warmed ladder: zero new compiles
+        assert rec.counters["serve.compiles"] == warm_compiles
+        # dispatch spans carry the schedule mode + per-bucket
+        # occupancy histograms feed the chemtop schedule view
+        spans = [e for e in rec.events("trace.span")
+                 if e.get("span") == "serve.dispatch"]
+        assert spans and all(e["schedule"] == "adaptive"
+                             for e in spans)
+        state = server.schedule_state()
+        assert state["mode"] == "adaptive"
+        assert state["adaptive"]["adjusts"] >= 1
+        assert state["bucket_occupancy_p50"]
+        assert state["ladder"] == [1, 4, 8]
+
+    def test_static_schedule_state_and_span_default(self, mech,
+                                                    Y_h2air):
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(mech, bucket_sizes=(1, 4),
+                                  recorder=rec)
+        assert server.schedule_mode == "static"
+        server.warmup(["equilibrium"])
+        with server:
+            assert server.submit_equilibrium(
+                **_eq_payload(Y_h2air)).result(timeout=60).ok
+        st = server.schedule_state()
+        assert st["mode"] == "static" and "adaptive" not in st
+        spans = [e for e in rec.events("trace.span")
+                 if e.get("span") == "serve.dispatch"]
+        assert spans and all(e["schedule"] == "static"
+                             for e in spans)
+
     def test_warmup_skips_unreachable_buckets(self, mech, Y_h2air):
         # max_batch_size=1 means the batcher can never dispatch the
         # 4-bucket: warmup must not pay that compile

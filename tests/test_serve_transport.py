@@ -459,6 +459,48 @@ class TestChemtopMerge:
         # render never throws on a mixed fleet
         assert "chemtop" in chemtop.render(fleet)
 
+    def test_schedule_block_merges_per_mech(self):
+        """ISSUE-12: the adaptive-ladder state merges into the fleet
+        snapshot — per-backend window/cap side by side, per-bucket
+        occupancy p50 from the MERGED serve.occupancy.b* histograms,
+        and render() shows the schedule line."""
+        from tools import chemtop
+
+        def occ_hist(values):
+            h = telemetry.Histogram()
+            for v in values:
+                h.observe(v)
+            return h
+
+        a = self._reply(1, 10, [1.0])
+        b = self._reply(2, 5, [2.0], generation=1)
+        for rep, occs, window in ((a, [3, 4], 2.0), (b, [7, 8], 3.5)):
+            h = occ_hist(occs)
+            rep["histogram_states"]["serve.occupancy.b8"] = h.state()
+            rep["histograms"]["serve.occupancy.b8"] = h.summary()
+            rep["schedule"] = {"h2o2": {
+                "mode": "adaptive", "window_ms": window,
+                "max_batch": 8, "ladder": [1, 8, 32],
+                "bucket_occupancy_p50": {"8": occs[0]}}}
+        fleet = chemtop.merge_fleet([a, b])
+        sched = fleet["schedule"]["h2o2"]
+        assert sched["modes"] == ["adaptive"]
+        assert sched["window_ms"] == [2.0, 3.5]
+        assert sched["max_batch"] == [8, 8]
+        assert sched["ladder"] == [1, 8, 32]
+        # fleet per-bucket p50 comes from the MERGED distribution
+        ref = occ_hist([3, 4, 7, 8])
+        assert sched["bucket_occupancy_p50"]["8"] == \
+            ref.summary()["p50"]
+        # per-backend raw state rides each backend row
+        rows = {r["port"]: r for r in fleet["backends"]}
+        assert rows[1]["schedule"]["h2o2"]["window_ms"] == 2.0
+        assert "schedule[h2o2]" in chemtop.render(fleet)
+        # a schedule-less fleet (older backends) renders and merges
+        legacy = chemtop.merge_fleet([self._reply(4, 1, [1.0])])
+        assert legacy["schedule"] == {}
+        assert "schedule[" not in chemtop.render(legacy)
+
     def test_supervisor_block_folds_into_counters(self):
         from tools import chemtop
 

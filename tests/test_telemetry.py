@@ -501,7 +501,8 @@ RUNG_SCHEMA_KEYS = (
     "throughput", "rtol", "atol", "t_end", "n_ok", "n_ignited",
     "n_steps", "n_rejected", "n_newton", "steps_per_sec",
     "model_f32_gflop", "model_f64_gflop", "mfu_pct",
-    "jac_mode", "rop_mode", "nu_nnz_frac", "n_species_active",
+    "jac_mode", "rop_mode", "schedule",
+    "nu_nnz_frac", "n_species_active",
     "n_failed", "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
 )
@@ -509,7 +510,8 @@ RUNG_SCHEMA_KEYS = (
 #: rung keys that _build_summary must forward into configs_run
 CONFIGS_RUN_KEYS = (
     "mech", "B", "chunk", "throughput", "mfu_pct", "n_failed",
-    "jac_mode", "rop_mode", "nu_nnz_frac", "n_species_active",
+    "jac_mode", "rop_mode", "schedule",
+    "nu_nnz_frac", "n_species_active",
     "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
 )
@@ -525,6 +527,7 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
         "n_rejected": B, "n_newton": 400 * B, "steps_per_sec": 1e5,
         "model_f32_gflop": 1.0, "model_f64_gflop": 0.1, "mfu_pct": 1.5,
         "jac_mode": "analytic", "rop_mode": "dense",
+        "schedule": "static",
         "nu_nnz_frac": 0.32, "n_species_active": 10,
         "n_failed": n_failed, "n_rescued": max(n_failed - 1, 0),
         "n_abandoned": min(n_failed, 1),
@@ -622,6 +625,54 @@ def _fake_surrogate_result():
     }
 
 
+#: every key the batch_efficiency rung JSON must carry (ISSUE 12):
+#: the BENCH_r05 per-element inversion as a tracked artifact — one
+#: static-vs-scheduled twin row per batch size, the headline ratios,
+#: and the answer-fidelity evidence
+BATCH_EFF_RUNG_KEYS = (
+    "rung", "platform", "mech", "schedule", "Bs", "t_end", "rtol",
+    "atol", "seed", "T_range", "phi_range", "max_steps",
+    "chunk_static", "chunk_sched", "round_len",
+    "per_B", "speedup_top", "sched_top_vs_b64", "static_top_vs_b64",
+    "answers_match", "cohorts", "compactions",
+)
+
+#: keys of each per_B twin row in the batch_efficiency rung
+BATCH_EFF_ROW_KEYS = (
+    "B", "static_ms_per_elem", "sched_ms_per_elem", "speedup",
+    "n_ok", "n_budget_capped", "bit_match", "status_match",
+    "finite_match", "n_status_mismatch", "times_max_rel_dev",
+)
+
+
+def _fake_batch_eff_result():
+    return {
+        "rung": "batch_efficiency", "platform": "cpu",
+        "mech": "grisyn", "schedule": "sorted",
+        "Bs": [64, 256], "t_end": 0.05, "rtol": 1e-6, "atol": 1e-12,
+        "seed": 0, "T_range": [700.0, 1500.0],
+        "phi_range": [0.5, 2.0], "max_steps": 10_000,
+        "chunk_static": 256, "chunk_sched": 64,
+        "round_len": 512,
+        "per_B": [
+            {"B": 64, "static_ms_per_elem": 5400.0,
+             "sched_ms_per_elem": 1800.0, "speedup": 3.0,
+             "n_ok": 64, "n_budget_capped": 0, "bit_match": False,
+             "status_match": True, "finite_match": True,
+             "n_status_mismatch": 0,
+             "times_max_rel_dev": 1.1e-13},
+            {"B": 256, "static_ms_per_elem": 5800.0,
+             "sched_ms_per_elem": 1900.0, "speedup": 3.05,
+             "n_ok": 254, "n_budget_capped": 2, "bit_match": False,
+             "status_match": True, "finite_match": True,
+             "n_status_mismatch": 0,
+             "times_max_rel_dev": 1.3e-13}],
+        "speedup_top": 3.05, "sched_top_vs_b64": 1.06,
+        "static_top_vs_b64": 1.07, "answers_match": True,
+        "cohorts": 20, "compactions": 12,
+    }
+
+
 def _summary_lines(captured: str):
     out = []
     for line in captured.splitlines():
@@ -648,6 +699,8 @@ class TestBenchBanking:
                 return 0, _fake_serve_result(), ""
             if args[0] == "surrogate":
                 return 0, _fake_surrogate_result(), ""
+            if args[0] == "batch_eff":
+                return 0, _fake_batch_eff_result(), ""
             assert args[0] == "config"
             i = calls["n"]
             calls["n"] += 1
@@ -688,6 +741,14 @@ class TestBenchBanking:
             assert key in surrogate_rung, f"surrogate rung missing {key}"
         assert all("surrogate_latency" not in s
                    for s in summaries[:-1])
+        # ... and the batch_efficiency rung (ISSUE 12), rows included
+        eff_rung = summaries[-1]["batch_efficiency"]
+        for key in BATCH_EFF_RUNG_KEYS:
+            assert key in eff_rung, f"batch_eff rung missing {key}"
+        for row in eff_rung["per_B"]:
+            for key in BATCH_EFF_ROW_KEYS:
+                assert key in row, f"batch_eff row missing {key}"
+        assert all("batch_efficiency" not in s for s in summaries[:-1])
         # configs_run schema: the resilience counters ride along into
         # every banked summary (partial lines included)
         for summary in summaries:
@@ -835,6 +896,64 @@ class TestServeRungSchema:
         assert rung["queue_wait_ms"]["count"] == rung["n_served"]
         assert rung["p50_ms"] <= rung["p99_ms"] <= rung["max_ms"]
         assert rung["status_counts"].get("OK", 0) == rung["n_served"]
+
+
+class TestBatchEffRungSchema:
+    @pytest.mark.slow
+    def test_child_batch_eff_emits_full_schema_on_cpu(self, capfd):
+        """The REAL batch_efficiency child must emit every schema key
+        the fake banking tests rely on — tiny h2o2 twins keep the
+        slow-lane cost bounded while still exercising the full
+        static-vs-scheduled comparison, the fidelity columns, and the
+        cohort/compaction counters end to end."""
+        benchmarks._child_batch_eff("h2o2", "4,8", "sorted")
+        rung = _summary_lines(capfd.readouterr().out)[-1]
+        for key in BATCH_EFF_RUNG_KEYS:
+            assert key in rung, f"missing batch_eff rung key {key}"
+        assert rung["rung"] == "batch_efficiency"
+        assert [r["B"] for r in rung["per_B"]] == [4, 8]
+        for row in rung["per_B"]:
+            for key in BATCH_EFF_ROW_KEYS:
+                assert key in row, f"missing batch_eff row key {key}"
+            assert row["status_match"] is True
+            assert row["times_max_rel_dev"] < 1e-9
+        assert rung["answers_match"] is True
+        assert rung["cohorts"] >= 2
+        assert rung["schedule"] == "sorted"
+
+
+class TestScheduleTelemetry:
+    """ISSUE-12 telemetry contract: the schedule counters and the
+    dispatch-span field are stable, documented names."""
+
+    def test_counter_names_are_canonical(self):
+        from pychemkin_tpu import schedule
+        assert schedule.SCHEDULE_COUNTERS == (
+            "schedule.cohorts", "schedule.compactions",
+            "schedule.ladder_adjust")
+        assert schedule.SCHEDULE_SPAN_FIELD == "schedule"
+
+    def test_every_schedule_counter_has_an_emitter(self):
+        """Each documented counter is emitted by its layer: cohort
+        planning, compaction, and the adaptive controller — asserted
+        against the canonical tuple so a renamed counter breaks HERE,
+        not in a dashboard."""
+        import numpy as np
+
+        from pychemkin_tpu import schedule
+        from pychemkin_tpu.schedule.adaptive import AdaptiveController
+
+        rec = telemetry.MetricsRecorder()
+        schedule.plan_cohorts(np.arange(4.0), chunk=2, recorder=rec)
+        ctl = AdaptiveController((1, 8, 32), max_batch_size=32,
+                                 max_delay_ms=2.0, adjust_every=1,
+                                 recorder=rec)
+        ctl.observe_batch(occupancy=2, solve_ms=40.0)
+        assert rec.counters.get("schedule.cohorts", 0) >= 1
+        assert rec.counters.get("schedule.ladder_adjust", 0) >= 1
+        # schedule.compactions needs a real compacted solve; its
+        # emission is asserted in tests/test_schedule.py
+        # (TestCompaction.test_h2o2_bitmatch_vmapped_and_kernel)
 
 
 class TestSurrogateRungSchema:

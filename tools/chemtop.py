@@ -72,12 +72,14 @@ def merge_fleet(replies: List[Dict]) -> Dict:
     counters: Dict[str, int] = {}
     tenants: Dict[str, Dict[str, int]] = {}
     hist_states: Dict[str, List[Dict]] = {}
+    sched_by_mech: Dict[str, List[Dict]] = {}
     backends = []
     for rep in replies:
         row = {"port": rep.get("port"), "pid": rep.get("pid"),
                "generation": rep.get("generation"),
                "uptime_s": rep.get("uptime_s"),
-               "error": rep.get("error")}
+               "error": rep.get("error"),
+               "schedule": rep.get("schedule")}
         backends.append(row)
         # a supervisor-side merged reply (Supervisor.metrics) carries
         # its respawn story even when the backend could not answer —
@@ -100,6 +102,8 @@ def merge_fleet(replies: List[Dict]) -> Dict:
             agg["quota"] += int(t.get("quota", 0))
         for name, state in (rep.get("histogram_states") or {}).items():
             hist_states.setdefault(name, []).append(state)
+        for mech, st in (rep.get("schedule") or {}).items():
+            sched_by_mech.setdefault(mech, []).append(st)
     # surrogate fast-path gauge: fleet hit rate from the SUMMED
     # counters (never averaged per-backend rates), fallbacks alongside
     # — a dropping hit rate is the signal to retrain/widen the box
@@ -112,6 +116,27 @@ def merge_fleet(replies: List[Dict]) -> Dict:
         "hit_rate": (round(hit / (hit + fallback), 4)
                      if hit + fallback else None),
     }
+    histograms = {name: telemetry.merge_histogram_states(states)
+                  for name, states in sorted(hist_states.items())}
+    # adaptive-ladder state per mechanism: window/cap per backend
+    # (they adapt independently), ladder from the first answering
+    # backend, per-bucket occupancy p50 from the MERGED fleet
+    # histograms (serve.occupancy.b<bucket>), never averaged p50s
+    schedule: Dict[str, Dict] = {}
+    for mech, states in sorted(sched_by_mech.items()):
+        ladder = states[0].get("ladder") or []
+        per_bucket = {}
+        for b in ladder:
+            h = histograms.get(f"serve.occupancy.b{b}")
+            if h and h.get("count"):
+                per_bucket[str(b)] = h.get("p50")
+        schedule[mech] = {
+            "modes": sorted({s.get("mode") for s in states}),
+            "window_ms": [s.get("window_ms") for s in states],
+            "max_batch": [s.get("max_batch") for s in states],
+            "ladder": list(ladder),
+            "bucket_occupancy_p50": per_bucket,
+        }
     return {
         "t": time.time(),
         "n_backends": len(backends),
@@ -120,8 +145,8 @@ def merge_fleet(replies: List[Dict]) -> Dict:
         "counters": counters,
         "tenants": tenants,
         "surrogate": surrogate,
-        "histograms": {name: telemetry.merge_histogram_states(states)
-                       for name, states in sorted(hist_states.items())},
+        "schedule": schedule,
+        "histograms": histograms,
     }
 
 
@@ -150,6 +175,20 @@ def render(snapshot: Dict) -> str:
             f"  surrogate: hit {sur['hit']}  miss {sur['miss']}  "
             f"fallback {sur['fallback']}  "
             f"hit_rate {'n/a' if rate is None else f'{rate:.1%}'}")
+    for mech, s in sorted((snapshot.get("schedule") or {}).items()):
+        occ = "  ".join(f"b{b}={p:.3g}" for b, p in
+                        sorted(s["bucket_occupancy_p50"].items(),
+                               key=lambda kv: int(kv[0]))
+                        if p is not None)
+        windows = "/".join(f"{w:g}ms" for w in s["window_ms"]
+                           if w is not None)
+        lines.append(
+            f"  schedule[{mech}]: "
+            f"{'/'.join(m for m in s['modes'] if m)}  "
+            f"window {windows}  "
+            f"cap {'/'.join(str(c) for c in s['max_batch'])}  "
+            f"ladder {s['ladder']}"
+            + (f"  occ_p50 {occ}" if occ else ""))
     for name in ("serve.queue_wait_ms", "serve.solve_ms"):
         h = snapshot["histograms"].get(name)
         if h and h.get("count"):

@@ -20,10 +20,19 @@ Two targets:
   respawn/re-submit counters next to the per-status counts — the
   acceptance evidence that every admitted request resolved.
 
+``--stiffness-mix`` widens the ignition-family payload draw to a
+broad (T0, phi) box (each request gets its own equivalence-ratio
+composition) so the soak offers genuinely mixed-stiffness batches;
+the artifact then records the mix ranges plus a per-cohort
+(cool/mid/hot initial-temperature tercile) latency split and the
+server's live schedule state (mode, window, per-bucket occupancy).
+
 Usage::
 
     python tools/loadgen.py --mech h2o2 --kinds equilibrium,ignition \
         --rate 100 --n 200 --seed 0 --out LOADGEN.json
+    python tools/loadgen.py --kinds ignition --stiffness-mix \
+        --rate 50 --n 120 --out MIX.json
     python tools/loadgen.py --transport --deadline-ms 60000 \
         --chaos '[{"mode": "kill_backend_at_request", "request": 20}]' \
         --rate 50 --n 100 --out SOAK.json
@@ -84,6 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "— required when --kinds names a surrogate_* "
                         "kind; enables a mixed surrogate/solver "
                         "stream")
+    p.add_argument("--stiffness-mix", action="store_true",
+                   help="draw ignition-family payloads over a WIDE "
+                        "(T0, phi) range so the soak exercises mixed-"
+                        "stiffness batches; the artifact records the "
+                        "mix ranges and a per-cohort (cool/mid/hot "
+                        "initial-T tercile) latency split")
     p.add_argument("--rate", type=float, default=100.0,
                    help="offered arrival rate, requests/s")
     p.add_argument("--n", type=int, default=200,
@@ -200,7 +215,8 @@ class _Obs:
         }
 
 
-def _run_inprocess(args, kinds, bucket_sizes, rng, samplers, obs):
+def _run_inprocess(args, kinds, bucket_sizes, rng, samplers, obs,
+                   classify=None):
     mech = load_embedded(args.mech)
     rec = obs.recorder
     server = serve.ChemServer(
@@ -218,12 +234,15 @@ def _run_inprocess(args, kinds, bucket_sizes, rng, samplers, obs):
             rng=rng, result_timeout_s=args.timeout,
             deadline_ms=args.deadline_ms,
             trace_events=obs.trace_events,
-            n_exemplars=args.exemplars)
+            n_exemplars=args.exemplars, classify=classify)
+        sched = server.schedule_state()
     return summary, {"warmup_compiles": warm,
+                     "schedule": sched,
                      "telemetry": rec.snapshot()}
 
 
-def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs):
+def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs,
+                   classify=None):
     if args.chaos is not None:
         json.loads(args.chaos)       # fail fast on a typo'd spec
     rec = obs.recorder
@@ -261,7 +280,7 @@ def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs):
             rng=rng, result_timeout_s=args.timeout,
             deadline_ms=args.deadline_ms,
             trace_events=obs.trace_events,
-            n_exemplars=args.exemplars)
+            n_exemplars=args.exemplars, classify=classify)
         extra = {"transport": True,
                  "tenant": args.tenant,
                  "quota": args.quota,
@@ -285,12 +304,33 @@ def main(argv=None) -> int:
 
     mech = load_embedded(args.mech)
     rng = np.random.default_rng(args.seed)
-    samplers = loadgen.default_samplers(mech, kinds)
+    classify = None
+    stiffness_mix = None
+    if args.stiffness_mix:
+        ign_kinds = [k for k in kinds
+                     if (k[len(loadgen.SURROGATE_PREFIX):]
+                         if k.startswith(loadgen.SURROGATE_PREFIX)
+                         else k) == "ignition"]
+        if not ign_kinds:
+            raise SystemExit("--stiffness-mix needs an ignition-"
+                             "family kind in --kinds")
+        samplers = loadgen.default_samplers(
+            mech, [k for k in kinds if k not in ign_kinds])
+        for k in ign_kinds:
+            mix, classify = loadgen.stiffness_mix_sampler(mech, k)
+            samplers.append(mix)
+        stiffness_mix = {"T_range": list(loadgen.STIFFNESS_MIX_T),
+                         "phi_range": list(loadgen.STIFFNESS_MIX_PHI),
+                         "kinds": ign_kinds}
+    else:
+        samplers = loadgen.default_samplers(mech, kinds)
     obs = _Obs(args)
 
     runner = _run_transport if args.transport else _run_inprocess
     summary, extra = runner(args, kinds, bucket_sizes, rng, samplers,
-                            obs)
+                            obs, classify)
+    if stiffness_mix is not None:
+        extra["stiffness_mix"] = stiffness_mix
     extra.update(obs.artifacts())
 
     artifact = {
