@@ -25,9 +25,9 @@ _jax.config.update("jax_enable_x64", True)
 # grid size; sweeps compile large batched integrators), so every user of
 # the package gets disk-cached compiles, not just the bench/test entry
 # points. Opt out with PYCHEMKIN_NO_CACHE=1.
-import os as _os
+from . import knobs as _knobs
 
-if not _os.environ.get("PYCHEMKIN_NO_CACHE"):
+if not _knobs.value("PYCHEMKIN_NO_CACHE"):
     from .utils import enable_compilation_cache as _enable_cache
 
     try:

@@ -820,17 +820,20 @@ def _child_baseline(mech_name: str, n_points: int, budget_s: float):
             area=reactors.constant_profile(0.0),
             mass=float(thermo.density(mech, float(T0), P0,
                                       jnp.asarray(Y0))))
-        rhs = jax.jit(lambda t, y, a=args: reactors.conp_enrg_rhs(t, y, a))
+        rhs = jax.jit(  # chemlint: disable=jit-in-loop -- intentional: each T0's closure is its own (warmed) program; this ablation times solve cost, and the per-point jit is the documented fresh-lambdas baseline
+            lambda t, y, a=args: reactors.conp_enrg_rhs(t, y, a))
         # same Jacobian code the stiff solver runs — the baseline and
         # the sweep must time the same assembly, including under a
         # BENCH_JAC_MODE=ad A/B run (where the sweep's solves use the
         # retired jacfwd path, so the baseline must too)
         if os.environ.get("BENCH_JAC_MODE", "analytic") == "ad":
-            jac = jax.jit(lambda t, y, a=args: jax.jacfwd(
-                lambda yy: reactors.conp_enrg_rhs(t, yy, a))(y))
+            jac = jax.jit(  # chemlint: disable=jit-in-loop -- intentional: per-T0 ablation closure, warmed before timing (see rhs above)
+                lambda t, y, a=args: jax.jacfwd(
+                    lambda yy: reactors.conp_enrg_rhs(t, yy, a))(y))
         else:
             jac_fn = jacobian.batch_rhs_jacobian("CONP", "ENRG")
-            jac = jax.jit(lambda t, y, a=args: jac_fn(t, y, a))
+            jac = jax.jit(  # chemlint: disable=jit-in-loop -- intentional: per-T0 ablation closure, warmed before timing (see rhs above)
+                lambda t, y, a=args: jac_fn(t, y, a))
         y0 = np.concatenate([Y0, [float(T0)]])
         # warm the jits so compile time doesn't count against the baseline
         np.asarray(rhs(0.0, jnp.asarray(y0)))

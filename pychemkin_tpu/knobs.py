@@ -1,0 +1,372 @@
+"""Central registry of ``PYCHEMKIN_*`` environment knobs.
+
+Every environment variable the framework reads is declared HERE — name,
+type, default, one-line doc, and parse/validation semantics — and read
+through :func:`value` (or :func:`raw` for sites that own their parsing,
+e.g. the JSON fault specs). The ``chemlint`` static-analysis pass
+(:mod:`pychemkin_tpu.lint`) forbids raw ``os.environ`` / ``os.getenv``
+reads of ``PYCHEMKIN_*`` names anywhere else in the package, and
+cross-checks that the README knob table is exactly
+:func:`render_table`'s output — so a knob cannot exist without being
+documented, and a documented knob cannot silently stop existing.
+
+Semantics preserved from the pre-registry read sites:
+
+- **Per-call re-read.** Nothing is cached: :func:`value` consults
+  ``os.environ`` on every call, so live processes can be re-tuned via
+  their environment (``PYCHEMKIN_TRACE_SAMPLE`` is re-read per sampling
+  draw; the compaction round length per sweep).
+- **Loud rejection where the site rejected loudly.** Enum knobs
+  (``PYCHEMKIN_SCHEDULE``, ``PYCHEMKIN_ROP_MODE``) and strict numerics
+  (``PYCHEMKIN_COMPACT_ROUND``, the driver/rescue budgets) raise
+  ``ValueError`` naming the knob on an unparseable value — a typo'd
+  knob silently running defaults would fake an A/B.
+- **Documented silent fallbacks stay silent.** ``PYCHEMKIN_TRACE_SAMPLE``
+  and ``PYCHEMKIN_TELEMETRY_EVENTS_CAP`` historically fall back to
+  their defaults on garbage (observability must not take down a
+  serving process); their parsers keep that, and the table says so.
+
+This module is intentionally stdlib-only with no package-relative
+imports, so the lint orchestrator (and ``tests/run_suite.py``) can load
+it standalone via ``importlib`` without importing the package
+``__init__`` (which imports jax).
+
+Internal process stamps that are NOT knobs (``_PYCHEMKIN_DRIVER_REEXEC``,
+``_PYCHEMKIN_TEST_REEXEC``, ``_PYCHEMKIN_SUITE_CHILD``) are underscore-
+prefixed precisely so they stay outside this registry and outside the
+lint rule's ``PYCHEMKIN_*`` pattern.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "Knob", "REGISTRY", "register", "raw", "value", "names",
+    "render_table", "TABLE_BEGIN", "TABLE_END",
+]
+
+#: README markers the generated knob table lives between (the lint's
+#: ``knob-readme-drift`` rule compares the committed block against
+#: :func:`render_table`)
+TABLE_BEGIN = ("<!-- knob-table:begin (generated: "
+               "python -m pychemkin_tpu.lint --render-knobs) -->")
+TABLE_END = "<!-- knob-table:end -->"
+
+
+class Knob:
+    """One registered environment knob (see module docstring)."""
+
+    __slots__ = ("name", "ktype", "default", "doc", "parse", "group",
+                 "strict_empty")
+
+    def __init__(self, name: str, ktype: str, default: Any, doc: str,
+                 parse: Callable[[str], Any], group: str,
+                 strict_empty: bool = False):
+        self.name = name
+        self.ktype = ktype
+        self.default = default
+        self.doc = doc
+        self.parse = parse
+        self.group = group
+        self.strict_empty = strict_empty
+
+    def describe_default(self) -> str:
+        if self.default is None:
+            return "unset"
+        if isinstance(self.default, bool):
+            return "on" if self.default else "off"
+        return repr(self.default)
+
+
+#: the one registry; populated by the ``register`` calls below. The
+#: lint AST-extracts the registered names from this file, so names must
+#: be passed to ``register`` as string literals.
+REGISTRY: Dict[str, Knob] = {}
+
+
+def register(name: str, ktype: str, default: Any, doc: str,
+             parse: Callable[[str], Any], group: str,
+             strict_empty: bool = False) -> Knob:
+    if not name.startswith("PYCHEMKIN_"):
+        raise ValueError(
+            f"knob {name!r} must carry the PYCHEMKIN_ prefix")
+    if name in REGISTRY:
+        raise ValueError(f"knob {name!r} registered twice")
+    knob = REGISTRY[name] = Knob(name, ktype, default, doc, parse,
+                                 group, strict_empty)
+    return knob
+
+
+def _lookup(name: str) -> Knob:
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(
+            f"unregistered environment knob {name!r}; declare it in "
+            "pychemkin_tpu/knobs.py (the chemlint knob registry)")
+    return knob
+
+
+def raw(name: str) -> Optional[str]:
+    """The knob's raw environment string (``None`` when unset) — for
+    sites that own their parsing (JSON fault specs). Re-read per call."""
+    return os.environ.get(_lookup(name).name)
+
+
+def value(name: str) -> Any:
+    """The knob's parsed value: its default when unset or empty, else
+    ``parse(raw)`` with the knob's declared loud/fallback semantics.
+    Re-read from ``os.environ`` on every call (no caching)."""
+    knob = _lookup(name)
+    raw_ = os.environ.get(knob.name)
+    if raw_ is None:
+        return knob.default
+    if raw_ == "" and not knob.strict_empty:
+        # "" counts as unset for most typed knobs (the historical
+        # `int(raw) if raw else default` read sites). strict_empty
+        # knobs — the loud-rejection A/B switches — parse it and
+        # raise: a set-but-empty PYCHEMKIN_SCHEDULE (an unexpanded
+        # shell variable) silently running 'static' would fake an
+        # A/B. Path knobs where "" is MEANINGFUL use raw() instead.
+        return knob.default
+    return knob.parse(raw_)
+
+
+def names() -> List[str]:
+    return sorted(REGISTRY)
+
+
+# -- parser factories -------------------------------------------------------
+# each returns a callable str -> value embedding the knob's invalid-
+# value behavior ("raise" names the knob loudly; "default" keeps the
+# documented observability-must-not-crash fallback)
+
+def _int(name: str, on_invalid: str = "raise",
+         default: Any = None, lo: Optional[int] = None):
+    def parse(raw_: str) -> Any:
+        try:
+            v = int(raw_)
+        except ValueError:
+            if on_invalid == "default":
+                return default
+            raise ValueError(
+                f"{name} must be an integer, got {raw_!r}") from None
+        return v if lo is None else max(v, lo)
+    return parse
+
+
+def _float(name: str, on_invalid: str = "raise", default: Any = None,
+           clamp: Optional[tuple] = None):
+    def parse(raw_: str) -> Any:
+        try:
+            v = float(raw_)
+        except ValueError:
+            if on_invalid == "default":
+                return default
+            raise ValueError(
+                f"{name} must be a number, got {raw_!r}") from None
+        if clamp is not None:
+            v = min(max(v, clamp[0]), clamp[1])
+        return v
+    return parse
+
+
+def _enum(name: str, choices: tuple, normalize: bool = False,
+          empty_to: Optional[str] = None):
+    """``empty_to`` keeps the historical whitespace tolerance of a
+    site (``raw.strip().lower() or "auto"``) where it existed."""
+    def parse(raw_: str) -> str:
+        v = raw_.strip().lower() if normalize else raw_
+        if v == "" and empty_to is not None:
+            return empty_to
+        if v not in choices:
+            raise ValueError(
+                f"{name} must be one of {choices}, got {raw_!r}")
+        return v
+    return parse
+
+
+def _bool01(raw_: str) -> bool:
+    """The ``=0 disables`` convention: any set value other than "0" is
+    on (the default-on observability switches)."""
+    return raw_ != "0"
+
+
+def _flag(raw_: str) -> bool:
+    """Set-to-anything-nonempty means on (opt-in switches)."""
+    return bool(raw_)
+
+
+def _str(raw_: str) -> str:
+    return raw_
+
+
+# -- the knobs --------------------------------------------------------------
+# group: a README-table section heading; keep related knobs together.
+
+register(
+    "PYCHEMKIN_SCHEDULE", "enum: static / sorted / adaptive", "static",
+    "Stiffness-aware scheduling mode for sweeps and the serve layer; "
+    "explicit call arguments win. Invalid values reject loudly.",
+    _enum("PYCHEMKIN_SCHEDULE", ("static", "sorted", "adaptive")),
+    "scheduling", strict_empty=True)
+register(
+    "PYCHEMKIN_COMPACT_ROUND", "int", 512,
+    "Step-attempt budget of one compaction round in scheduled sweeps "
+    "(re-read per sweep).",
+    _int("PYCHEMKIN_COMPACT_ROUND"), "scheduling", strict_empty=True)
+
+register(
+    "PYCHEMKIN_ROP_MODE", "enum: auto / sparse / dense", "auto",
+    "Kinetics rate-of-progress kernel selection; 'auto' picks sparse "
+    "on CPU, dense on TPU. The rop_mode() trace-time override wins.",
+    _enum("PYCHEMKIN_ROP_MODE", ("auto", "sparse", "dense"),
+          normalize=True, empty_to="auto"),
+    "kinetics")
+
+register(
+    "PYCHEMKIN_NO_CACHE", "flag", False,
+    "Disable the persistent XLA compilation cache the package enables "
+    "at import.",
+    _flag, "caching")
+register(
+    "PYCHEMKIN_CACHE_DIR", "path", None,
+    "Relocate the persistent XLA compilation cache (does NOT override "
+    "the remote-compile safety refusal).",
+    _str, "caching")
+register(
+    "PYCHEMKIN_STAGING_DIR", "path", None,
+    "Directory of the staged-kinetics npz cache; set EMPTY to disable "
+    "the disk layer.",
+    _str, "caching")
+
+register(
+    "PYCHEMKIN_TRACE_SAMPLE", "float [0,1]", 1.0,
+    "Probability a submit draws a trace id; re-read per draw so live "
+    "processes re-sample without restart. Unparseable values fall "
+    "back to 1.0.",
+    _float("PYCHEMKIN_TRACE_SAMPLE", on_invalid="default",
+           default=1.0, clamp=(0.0, 1.0)),
+    "telemetry")
+register(
+    "PYCHEMKIN_TELEMETRY_DEVICE", "bool (0 disables)", True,
+    "Embed device->host counter callbacks in jitted programs; checked "
+    "at trace time, so disabling strips the callback nodes entirely.",
+    _bool01, "telemetry")
+register(
+    "PYCHEMKIN_TELEMETRY_EVENTS_CAP", "int", 4096,
+    "Ring-buffer cap for the recorder's in-memory event tail (the "
+    "JSONL sink is the full record). Unparseable values fall back to "
+    "the default.",
+    _int("PYCHEMKIN_TELEMETRY_EVENTS_CAP", on_invalid="default",
+         default=4096, lo=1),
+    "telemetry")
+register(
+    "PYCHEMKIN_TELEMETRY_PATH", "path", None,
+    "JSONL sink a transport backend attaches to its recorder at "
+    "startup.",
+    _str, "telemetry")
+register(
+    "PYCHEMKIN_FLIGHT_PATH", "path", None,
+    "Exact file path for crash flight-recorder dumps (wins over "
+    "PYCHEMKIN_FLIGHT_DIR).",
+    _str, "telemetry")
+register(
+    "PYCHEMKIN_FLIGHT_DIR", "path", None,
+    "Directory for crash flight-recorder dumps (file named "
+    "flight_<pid>.json, one per backend generation).",
+    _str, "telemetry")
+
+register(
+    "PYCHEMKIN_RESCUE", "bool (0 disables)", True,
+    "Enable the per-element rescue escalation ladder after batch "
+    "solves.",
+    _bool01, "resilience")
+register(
+    "PYCHEMKIN_RESCUE_MAX_ATTEMPTS", "int", None,
+    "Cap the rescue ladder depth (unset: the full ladder).",
+    _int("PYCHEMKIN_RESCUE_MAX_ATTEMPTS"), "resilience")
+register(
+    "PYCHEMKIN_RESCUE_ATTEMPT_TIMEOUT_S", "float", None,
+    "Cooperative per-rescue-attempt budget in seconds (unset: "
+    "unbounded).",
+    _float("PYCHEMKIN_RESCUE_ATTEMPT_TIMEOUT_S"), "resilience")
+register(
+    "PYCHEMKIN_DRIVER_RETRIES", "int", 2,
+    "In-process retries per sweep chunk before the driver escalates.",
+    _int("PYCHEMKIN_DRIVER_RETRIES"), "resilience")
+register(
+    "PYCHEMKIN_DRIVER_BACKOFF_S", "float", 0.5,
+    "Initial driver retry backoff in seconds (doubles per retry, "
+    "+25% jitter).",
+    _float("PYCHEMKIN_DRIVER_BACKOFF_S"), "resilience")
+register(
+    "PYCHEMKIN_DRIVER_BACKOFF_CAP_S", "float", 30.0,
+    "Ceiling on the driver's doubled retry backoff.",
+    _float("PYCHEMKIN_DRIVER_BACKOFF_CAP_S"), "resilience")
+register(
+    "PYCHEMKIN_DRIVER_MAX_REEXECS", "int", 1,
+    "Process re-exec escalations per durable sweep job.",
+    _int("PYCHEMKIN_DRIVER_MAX_REEXECS"), "resilience")
+register(
+    "PYCHEMKIN_FAULTS", "json spec", None,
+    "Element-level fault-injection spec (JSON object or list) for the "
+    "resilience test harness; checked at trace time.",
+    _str, "resilience")
+register(
+    "PYCHEMKIN_PROC_FAULTS", "json spec", None,
+    "Process-level fault-injection spec (JSON object or list): kill/"
+    "hang/poison a serving backend at a request ordinal.",
+    _str, "resilience")
+
+register(
+    "PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS", "int", 2,
+    "Backend respawn budget for a supervisor's lifetime.",
+    _int("PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS"), "serving")
+register(
+    "PYCHEMKIN_KILL_REPORT_DIR", "path", None,
+    "Directory the supervisor banks kill-report post-mortems into "
+    "(one atomic JSON per lost backend).",
+    _str, "serving")
+
+register(
+    "PYCHEMKIN_SURROGATE_DOMAIN_MARGIN", "float", 0.0,
+    "Fraction of each feature's trained span the surrogate acceptance "
+    "box is stretched by.",
+    _float("PYCHEMKIN_SURROGATE_DOMAIN_MARGIN"), "surrogate")
+register(
+    "PYCHEMKIN_SURROGATE_IGN_DISAGREE", "float", 0.1,
+    "Max ensemble std of log10(ignition delay) the surrogate gate "
+    "accepts.",
+    _float("PYCHEMKIN_SURROGATE_IGN_DISAGREE"), "surrogate")
+register(
+    "PYCHEMKIN_SURROGATE_IGN_TEND_FRAC", "float", 0.8,
+    "Predicted ignition delay must fall below this fraction of the "
+    "request horizon.",
+    _float("PYCHEMKIN_SURROGATE_IGN_TEND_FRAC"), "surrogate")
+register(
+    "PYCHEMKIN_SURROGATE_EQ_RESID", "float", 0.05,
+    "Max equilibrium Gibbs/element-balance residual of a predicted "
+    "state the gate accepts.",
+    _float("PYCHEMKIN_SURROGATE_EQ_RESID"), "surrogate")
+
+
+# -- README table -----------------------------------------------------------
+
+def render_table() -> str:
+    """The README env-knob table, generated from the registry (between
+    :data:`TABLE_BEGIN` / :data:`TABLE_END` markers; the lint fails on
+    drift). Grouped, then sorted by name inside each group."""
+    lines = ["| Knob | Type | Default | What it does |",
+             "| --- | --- | --- | --- |"]
+    groups: Dict[str, List[Knob]] = {}
+    for knob in REGISTRY.values():
+        groups.setdefault(knob.group, []).append(knob)
+    for group in sorted(groups):
+        lines.append(f"| **{group}** | | | |")
+        for knob in sorted(groups[group], key=lambda k: k.name):
+            lines.append(
+                f"| `{knob.name}` | {knob.ktype} | "
+                f"{knob.describe_default()} | {knob.doc} |")
+    return "\n".join(lines)

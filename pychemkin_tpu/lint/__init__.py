@@ -1,0 +1,153 @@
+"""chemlint — the repo-native static-analysis pass.
+
+An AST-based analyzer (stdlib ``ast``/``tokenize`` only — no jax, no
+third-party deps) that makes the repo's load-bearing dynamic contracts
+*statically checkable*:
+
+- **trace-safety / recompile hazards** (:mod:`.rules_trace`): Python
+  branches on traced values, tracer concretization, ``jax.jit`` built
+  inside loops, unhashable static args, jitted closures over mutable
+  module globals.
+- **env-knob registry** (:mod:`.rules_knobs`):
+  ``pychemkin_tpu/knobs.py`` is the only legal ``PYCHEMKIN_*`` reader;
+  the README knob table is generated from the registry and drift
+  fails.
+- **telemetry-schema consistency** (:mod:`.rules_telemetry`): every
+  literal counter/span/event name at an emit site derives from the
+  canonical schema (``telemetry/schema.py``) and vice versa.
+- **lock discipline** (:mod:`.rules_locks`): writes to
+  ``# guarded-by:`` annotated shared attributes must sit inside the
+  named ``with <lock>:`` block in thread-spawning modules.
+- **upgrade markers** (:mod:`.rules_markers`):
+  ``todo-on-upgrade(dist>=ver)`` comments fire when the image moves.
+
+Findings ratchet through a committed baseline
+(``tests/lint_baseline.json``): existing violations are recorded and
+allowed; any NEW violation — and any baseline entry whose violation
+was fixed without shrinking the baseline — fails the run. Suppress a
+single line with ``# chemlint: disable=<rule> -- <reason>`` (the
+reason is mandatory).
+
+Entry points::
+
+    python -m pychemkin_tpu.lint                 # lint + ratchet
+    python -m pychemkin_tpu.lint --write-baseline
+    python -m pychemkin_tpu.lint --render-knobs  # README knob table
+    tests/run_suite.py --lint                    # lint, then tests
+
+``tests/run_suite.py`` loads this package STANDALONE via importlib
+(package-spec with submodule search locations), so the orchestrator
+process never imports the jax-importing package ``__init__``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import (rules_knobs, rules_locks, rules_markers,  # noqa: F401
+               rules_telemetry, rules_trace)
+from .engine import (BASELINE_RELPATH, LintContext, RULES, Violation,
+                     compare_to_baseline, counts_of, discover_files,
+                     load_baseline, run_rules, write_baseline)
+
+__all__ = ["LintContext", "RULES", "Violation", "lint_tree", "main",
+           "repo_root"]
+
+
+def repo_root() -> str:
+    """The repo root this package file sits under."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def lint_tree(root: Optional[str] = None,
+              files: Optional[List[str]] = None) -> List[Violation]:
+    """All current violations (suppressions applied, baseline NOT
+    applied). ``files=None`` lints the default tree."""
+    root = root or repo_root()
+    full = files is None
+    ctx = LintContext(root, discover_files(root) if full else files,
+                      full=full)
+    return run_rules(ctx)
+
+
+def main(argv: Optional[List[str]] = None,
+         root: Optional[str] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m pychemkin_tpu.lint",
+        description="chemlint: repo-native static analysis with a "
+                    "ratchet baseline")
+    p.add_argument("paths", nargs="*",
+                   help="explicit files to lint (skips whole-tree "
+                        "rules and the baseline ratchet)")
+    p.add_argument("--root", default=None, help="repo root override")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline path (default {BASELINE_RELPATH})")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record the current violations as the new "
+                        "baseline and exit 0")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every violation raw (exit 1 if any)")
+    p.add_argument("--render-knobs", action="store_true",
+                   help="print the README env-knob table and exit")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    root = os.path.abspath(args.root or root or repo_root())
+
+    if args.render_knobs:
+        knobs = rules_knobs.load_knobs_module(root)
+        print(knobs.render_table())
+        return 0
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name}: {RULES[name].doc}")
+        return 0
+
+    if args.write_baseline and (args.paths or args.no_baseline):
+        p.error("--write-baseline applies to the full default tree; "
+                "it cannot be combined with explicit paths or "
+                "--no-baseline")
+
+    violations = lint_tree(root,
+                           files=args.paths or None)
+    if args.paths or args.no_baseline:
+        for v in violations:
+            print(v.render())
+        print(f"# chemlint: {len(violations)} violation(s)")
+        return 1 if violations else 0
+
+    baseline_path = args.baseline or os.path.join(root,
+                                                  BASELINE_RELPATH)
+    if args.write_baseline:
+        write_baseline(baseline_path, violations)
+        n = sum(n for files_ in counts_of(violations).values()
+                for n in files_.values())
+        print(f"# chemlint: baseline written to {baseline_path} "
+              f"({n} allowed violation(s))")
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    if baseline is None:
+        print(f"# chemlint: no baseline at {baseline_path}; run "
+              "`python -m pychemkin_tpu.lint --write-baseline` "
+              "and commit it", file=sys.stderr)
+        return 2
+    new, stale = compare_to_baseline(violations, baseline)
+    for v in new:
+        print(v.render())
+    for msg in stale:
+        print(f"stale-baseline: {msg}")
+    if new or stale:
+        print(f"# chemlint: FAIL — {len(new)} new violation(s), "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
+        return 1
+    n_allowed = sum(n for files_ in baseline.values()
+                    for n in files_.values())
+    print(f"# chemlint: OK — 0 new violations "
+          f"({n_allowed} baselined)")
+    return 0

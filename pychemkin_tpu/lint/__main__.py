@@ -1,0 +1,12 @@
+"""``python -m pychemkin_tpu.lint`` — see the package docstring.
+
+Note: running via ``-m`` imports the parent package ``__init__``
+(which imports jax); orchestrators that must stay jax-free load this
+package standalone instead (see ``tests/run_suite.py``).
+"""
+
+import sys
+
+from . import main
+
+sys.exit(main())

@@ -1,0 +1,432 @@
+"""chemlint core: module loading, rule registry, suppressions, baseline.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``) and free of
+package-relative imports into the jax-importing part of the tree, so
+the engine runs in orchestrator processes (``tests/run_suite.py``)
+that must never import jax.
+
+Concepts:
+
+- **ModuleInfo** — one parsed source file: AST, raw lines, the comment
+  map (via ``tokenize``, so ``#`` inside strings never confuses
+  directive parsing), module-level string constants (for resolving
+  ``os.environ.get(SOME_CONST)``-style indirection), and per-line
+  suppressions.
+- **Rules** — named checks registered with :func:`rule`. Every rule is
+  repo-scoped: it receives the :class:`LintContext` and iterates
+  ``ctx.modules`` itself (cross-module rules — schema staleness, README
+  drift — need the whole tree anyway). ``full_only`` rules are skipped
+  when linting an explicit file subset (fixture runs), where
+  whole-tree invariants are meaningless.
+- **Suppressions** — ``# chemlint: disable=<rule>[,<rule>] -- <reason>``
+  on the violating line. The reason string is REQUIRED: a suppression
+  without one is itself a violation (``suppress-needs-reason``), so
+  every silenced finding carries its justification in the diff.
+- **Baseline ratchet** — a committed JSON file mapping
+  ``rule -> {relpath: count}``. New violations (count above baseline)
+  fail; FIXED violations (count below baseline) also fail, demanding
+  the baseline shrink via ``--write-baseline`` — the ratchet only ever
+  tightens.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import (Any, Callable, Dict, Iterable, List, Optional,
+                    Set, Tuple)
+
+BASELINE_VERSION = 1
+
+#: default baseline location, relative to the repo root
+BASELINE_RELPATH = os.path.join("tests", "lint_baseline.json")
+
+#: directories under the repo root the default discovery walks
+DEFAULT_TARGETS = ("pychemkin_tpu",)
+
+_DIRECTIVE_RE = re.compile(r"#\s*chemlint:\s*(.*)$")
+_DISABLE_RE = re.compile(
+    r"disable=([A-Za-z0-9_,\- ]+?)(?:\s+--\s+(.+))?$")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Violation:
+    rule: str
+    path: str           # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.rule}: {self.path}:{self.line}: {self.message}"
+
+
+class ModuleInfo:
+    """One parsed source file (see module docstring)."""
+
+    def __init__(self, root: str, path: str):
+        self.path = os.path.abspath(path)
+        self.relpath = os.path.relpath(self.path, root).replace(
+            os.sep, "/")
+        with open(self.path, "r", encoding="utf-8") as fh:
+            self.source = fh.read()
+        self.lines = self.source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.source, filename=self.relpath)
+        except SyntaxError as exc:
+            self.syntax_error = exc
+        self._walk_cache: Optional[List[ast.AST]] = None
+        #: lineno -> comment text (including leading '#')
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass
+        #: module-level NAME = "string constant" bindings
+        self.consts: Dict[str, str] = {}
+        #: local import name -> canonical dotted module ("_os" -> "os",
+        #: "environ" -> "os.environ" for from-imports)
+        self.import_aliases: Dict[str, str] = {}
+        if self.tree is not None:
+            for node in self.tree.body:
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.consts[tgt.id] = node.value.value
+            for node in self.walk():
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        self.import_aliases[
+                            alias.asname or alias.name] = alias.name
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        self.import_aliases[
+                            alias.asname or alias.name] = (
+                            f"{node.module}.{alias.name}")
+        #: lineno -> set of rule names disabled there (reasons checked
+        #: separately; see directive_violations)
+        self.suppressions: Dict[int, Set[str]] = {}
+        self._directive_violations: List[Violation] = []
+        for lineno, text in self.comments.items():
+            m = _DIRECTIVE_RE.search(text)
+            if not m:
+                continue
+            body = m.group(1).strip()
+            if body.startswith("disable="):
+                dm = _DISABLE_RE.match(body)
+                if not dm:
+                    self._directive_violations.append(Violation(
+                        "suppress-syntax", self.relpath, lineno,
+                        f"unparseable chemlint directive: {body!r}"))
+                    continue
+                rules = {r.strip() for r in dm.group(1).split(",")
+                         if r.strip()}
+                if not dm.group(2) or not dm.group(2).strip():
+                    self._directive_violations.append(Violation(
+                        "suppress-needs-reason", self.relpath, lineno,
+                        "chemlint suppression needs a reason: "
+                        "# chemlint: disable=<rule> -- <why>"))
+                    continue
+                self.suppressions[lineno] = rules
+            # other directives (todo-on-upgrade) are parsed by their
+            # owning rule from self.comments
+
+    def walk(self) -> List[ast.AST]:
+        """Every AST node of the module, computed once — a dozen rules
+        iterate each module, and repeated ``ast.walk`` generators are
+        the analyzer's hottest path."""
+        if self._walk_cache is None:
+            self._walk_cache = ([] if self.tree is None
+                                else list(ast.walk(self.tree)))
+        return self._walk_cache
+
+    def resolve_str(self, node: ast.AST) -> Optional[str]:
+        """A string constant, directly or via a module-level NAME."""
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                        str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.consts.get(node.id)
+        return None
+
+    def guarded_attrs(self) -> Dict[str, Tuple[str, int]]:
+        """``# guarded-by: <lock>`` annotations: attribute name ->
+        (lock attribute name, annotation line). The annotation sits on
+        the line of an attribute assignment (conventionally the
+        ``__init__`` definition site)."""
+        out: Dict[str, Tuple[str, int]] = {}
+        if self.tree is None:
+            return out
+        anno_lines = {}
+        for lineno, text in self.comments.items():
+            m = _GUARDED_RE.search(text)
+            if m:
+                anno_lines[lineno] = m.group(1)
+        if not anno_lines:
+            return out
+        for node in self.walk():
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                end = getattr(node, "end_lineno", node.lineno)
+                lock = None
+                anno_line = None
+                for ln in range(node.lineno, end + 1):
+                    if ln in anno_lines:
+                        lock, anno_line = anno_lines[ln], ln
+                        break
+                if lock is None:
+                    continue
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    elts = (tgt.elts if isinstance(tgt, ast.Tuple)
+                            else [tgt])
+                    for t in elts:
+                        if isinstance(t, ast.Attribute):
+                            out[t.attr] = (lock, anno_line)
+        return out
+
+
+class LintContext:
+    """One lint run: the repo root, the parsed modules, and whether
+    this is the full default tree (whole-tree invariant rules skip
+    explicit-subset runs)."""
+
+    def __init__(self, root: str, files: Iterable[str],
+                 full: bool = True):
+        self.root = os.path.abspath(root)
+        self.full = full
+        self.modules: List[ModuleInfo] = [
+            ModuleInfo(self.root, f) for f in sorted(set(files))]
+        self._cache: Dict[str, Any] = {}
+
+    def module_at(self, relpath: str) -> Optional[ModuleInfo]:
+        relpath = relpath.replace(os.sep, "/")
+        for mod in self.modules:
+            if mod.relpath == relpath:
+                return mod
+        return None
+
+    def parse_repo_file(self, relpath: str) -> Optional[ModuleInfo]:
+        """A repo file by relative path, parsed on demand even when it
+        is outside the linted file set (schema, knobs, schedule)."""
+        mod = self.module_at(relpath)
+        if mod is not None:
+            return mod
+        path = os.path.join(self.root, relpath)
+        if not os.path.isfile(path):
+            return None
+        key = "file:" + relpath
+        if key not in self._cache:
+            self._cache[key] = ModuleInfo(self.root, path)
+        return self._cache[key]
+
+    def cached(self, key: str, build: Callable[[], Any]) -> Any:
+        if key not in self._cache:
+            self._cache[key] = build()
+        return self._cache[key]
+
+
+# -- rule registry ----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    fn: Callable[[LintContext], Iterable[Violation]]
+    full_only: bool = False
+
+
+RULES: Dict[str, Rule] = {}
+
+#: rule names that exist only as violation *outcomes* (directive
+#: parsing), valid targets for disable= even without a Rule entry
+META_RULES = ("suppress-needs-reason", "suppress-syntax",
+              "lock-annotation-orphan")
+
+
+def rule(name: str, doc: str, full_only: bool = False):
+    def deco(fn):
+        if name in RULES:
+            raise ValueError(f"rule {name!r} registered twice")
+        RULES[name] = Rule(name, doc, fn, full_only)
+        return fn
+    return deco
+
+
+def discover_files(root: str) -> List[str]:
+    out = []
+    for target in DEFAULT_TARGETS:
+        base = os.path.join(root, target)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__",)]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def run_rules(ctx: LintContext) -> List[Violation]:
+    """All violations on the context, suppressions applied, sorted."""
+    found: List[Violation] = []
+    for mod in ctx.modules:
+        if mod.syntax_error is not None:
+            found.append(Violation(
+                "syntax-error", mod.relpath,
+                mod.syntax_error.lineno or 1,
+                f"file does not parse: {mod.syntax_error.msg}"))
+        found.extend(mod._directive_violations)
+    for r in RULES.values():
+        if r.full_only and not ctx.full:
+            continue
+        found.extend(r.fn(ctx))
+    by_path = {m.relpath: m for m in ctx.modules}
+    kept = []
+    for v in found:
+        mod = by_path.get(v.path)
+        if (mod is not None and v.rule not in (
+                "suppress-needs-reason", "suppress-syntax")
+                and v.rule in mod.suppressions.get(v.line, ())):
+            continue
+        kept.append(v)
+    return sorted(set(kept))
+
+
+# -- baseline ratchet -------------------------------------------------------
+
+def counts_of(violations: Iterable[Violation]
+              ) -> Dict[str, Dict[str, int]]:
+    out: Dict[str, Dict[str, int]] = {}
+    for v in violations:
+        out.setdefault(v.rule, {})
+        out[v.rule][v.path] = out[v.rule].get(v.path, 0) + 1
+    return out
+
+
+def write_baseline(path: str,
+                   violations: Iterable[Violation]) -> None:
+    payload = {"version": BASELINE_VERSION,
+               "counts": counts_of(violations)}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def load_baseline(path: str) -> Optional[Dict[str, Dict[str, int]]]:
+    if not os.path.isfile(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if payload.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version "
+            f"{payload.get('version')!r}")
+    return {str(r): {str(p): int(n) for p, n in files.items()}
+            for r, files in payload.get("counts", {}).items()}
+
+
+def compare_to_baseline(violations: List[Violation],
+                        baseline: Dict[str, Dict[str, int]]
+                        ) -> Tuple[List[Violation], List[str]]:
+    """(new violations to report, stale-baseline messages).
+
+    Count-ratchet per (rule, file): more violations than the baseline
+    records -> every violation of that rule in that file is listed
+    (the injected one is among them, named by file and line); fewer ->
+    the fix must shrink the baseline (``--write-baseline``)."""
+    current = counts_of(violations)
+    new: List[Violation] = []
+    stale: List[str] = []
+    seen_pairs = set()
+    for rule_name, files in current.items():
+        base_files = baseline.get(rule_name, {})
+        for path, n in files.items():
+            seen_pairs.add((rule_name, path))
+            allowed = base_files.get(path, 0)
+            if n > allowed:
+                new.extend(v for v in violations
+                           if v.rule == rule_name and v.path == path)
+            elif n < allowed:
+                stale.append(
+                    f"{rule_name}: {path}: baseline allows {allowed} "
+                    f"but only {n} remain — shrink the baseline "
+                    "(python -m pychemkin_tpu.lint --write-baseline)")
+    for rule_name, files in baseline.items():
+        for path, allowed in files.items():
+            if (rule_name, path) not in seen_pairs and allowed > 0:
+                stale.append(
+                    f"{rule_name}: {path}: baseline allows {allowed} "
+                    f"but none remain — shrink the baseline "
+                    "(python -m pychemkin_tpu.lint --write-baseline)")
+    return sorted(set(new)), sorted(stale)
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Trailing name of a call target: ``f(...)`` -> 'f',
+    ``a.b.f(...)`` -> 'f'."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def dotted_name(node: ast.AST,
+                mod: Optional["ModuleInfo"] = None) -> Optional[str]:
+    """'os.environ.get' for nested attribute chains, else None. With
+    ``mod``, the leading name is canonicalized through the module's
+    import aliases (``_os.environ.get`` -> ``os.environ.get``)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        head = node.id
+        if mod is not None:
+            head = mod.import_aliases.get(head, head)
+        parts.append(head)
+        return ".".join(reversed(parts))
+    return None
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def iter_parents(tree: ast.AST):
+    """Yield (node, parent) pairs for the whole tree."""
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            yield child, parent
+
+
+def module_spawns_threads(mod: ModuleInfo) -> bool:
+    """True when the module creates threads OR locks — the modules
+    whose shared attributes the lock-discipline rule polices."""
+    if mod.tree is None:
+        return False
+    for node in mod.walk():
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func) or ""
+            if dn in ("threading.Thread", "threading.Lock",
+                      "threading.RLock", "threading.Condition"):
+                return True
+    return False

@@ -1,0 +1,190 @@
+"""Env-knob registry rules.
+
+``pychemkin_tpu/knobs.py`` is the only legal reader of ``PYCHEMKIN_*``
+environment variables (the registry: name, type, default, doc,
+validator — and the generated README table). These rules enforce the
+monopoly and the documentation loop:
+
+- ``knob-raw-env-read`` — any ``os.environ``/``os.getenv`` READ of a
+  ``PYCHEMKIN_*`` name outside knobs.py (resolving one level of
+  module-level string-constant indirection, the dominant idiom in this
+  repo: ``FOO_ENV = "PYCHEMKIN_FOO"; os.environ.get(FOO_ENV)``).
+  Writes (``os.environ[k] = v``, ``.pop``) stay legal — test harnesses
+  and benches configure children through the environment.
+- ``knob-unregistered`` — ``knobs.value("PYCHEMKIN_X")`` /
+  ``knobs.raw(...)`` with a name the registry never declares (the
+  registry is AST-extracted from knobs.py's literal ``register``
+  calls, so this runs without importing anything).
+- ``knob-readme-drift`` — the committed README table between the
+  knob-table markers must be byte-identical to ``render_table()``
+  (knobs.py is stdlib-only and loaded standalone via importlib, never
+  through the jax-importing package ``__init__``).
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Iterable, Optional, Set
+
+from .engine import (LintContext, Violation, call_name, dotted_name,
+                     rule)
+
+KNOBS_RELPATH = "pychemkin_tpu/knobs.py"
+
+#: call shapes that READ the environment
+_ENV_READ_CALLS = {"os.environ.get", "environ.get", "os.getenv",
+                   "getenv", "os.environ.setdefault",
+                   "environ.setdefault"}
+
+
+def load_knobs_module(root: str):
+    """Import knobs.py standalone by path (stdlib-only module; no
+    package import, so no jax)."""
+    path = os.path.join(root, KNOBS_RELPATH)
+    spec = importlib.util.spec_from_file_location(
+        f"_chemlint_knobs_{abs(hash(path))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def registered_knob_names(ctx: LintContext) -> Set[str]:
+    """Names passed as string literals to ``register(...)`` in
+    knobs.py (AST-extracted; no import)."""
+    def build() -> Set[str]:
+        mod = ctx.parse_repo_file(KNOBS_RELPATH)
+        out: Set[str] = set()
+        if mod is None or mod.tree is None:
+            return out
+        for node in mod.walk():
+            if (isinstance(node, ast.Call)
+                    and call_name(node) == "register" and node.args):
+                name = mod.resolve_str(node.args[0])
+                if name:
+                    out.add(name)
+        return out
+    return ctx.cached("knob-registry", build)
+
+
+def _env_key_of_read(node: ast.Call, mod) -> Optional[ast.AST]:
+    dn = dotted_name(node.func, mod)
+    if dn in _ENV_READ_CALLS and node.args:
+        return node.args[0]
+    return None
+
+
+@rule("knob-raw-env-read",
+      "raw os.environ/os.getenv read of a PYCHEMKIN_* name outside "
+      "the knobs.py registry")
+def check_raw_env_read(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        if mod.tree is None or mod.relpath == KNOBS_RELPATH:
+            continue
+        for node in mod.walk():
+            key_node = None
+            how = None
+            if isinstance(node, ast.Call):
+                key_node = _env_key_of_read(node, mod)
+                how = dotted_name(node.func, mod)
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)):
+                dn = dotted_name(node.value, mod)
+                if dn in ("os.environ", "os.environ.environ"):
+                    key_node = node.slice
+                    how = f"{dn}[...]"
+            elif isinstance(node, ast.Compare):
+                # "PYCHEMKIN_X" in os.environ — a read
+                for op, comp in zip(node.ops, node.comparators):
+                    if isinstance(op, (ast.In, ast.NotIn)):
+                        dn = dotted_name(comp, mod)
+                        if dn in ("os.environ", "os.environ.environ"):
+                            key_node = node.left
+                            how = f"in {dn}"
+            if key_node is None:
+                continue
+            name = mod.resolve_str(key_node)
+            if name is None and isinstance(key_node, ast.JoinedStr):
+                first = key_node.values[0] if key_node.values else None
+                if (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    name = first.value
+            if name and name.startswith("PYCHEMKIN_"):
+                yield Violation(
+                    "knob-raw-env-read", mod.relpath, node.lineno,
+                    f"raw environment read of {name!r} via {how} — "
+                    "read it through pychemkin_tpu.knobs "
+                    "(knobs.value/knobs.raw), the registry is the "
+                    "only legal PYCHEMKIN_* reader")
+
+
+@rule("knob-unregistered",
+      "knobs.value()/knobs.raw() called with a name the registry "
+      "never declares")
+def check_unregistered(ctx: LintContext) -> Iterable[Violation]:
+    registry = registered_knob_names(ctx)
+    for mod in ctx.modules:
+        if mod.tree is None or mod.relpath == KNOBS_RELPATH:
+            continue
+        for node in mod.walk():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("value", "raw")
+                    and node.args):
+                continue
+            base = dotted_name(node.func.value) or ""
+            if not base.split(".")[-1].endswith("knobs"):
+                continue
+            name = mod.resolve_str(node.args[0])
+            if name and name.startswith("PYCHEMKIN_") \
+                    and name not in registry:
+                yield Violation(
+                    "knob-unregistered", mod.relpath, node.lineno,
+                    f"knob {name!r} is not declared in "
+                    f"{KNOBS_RELPATH}; register it (name, type, "
+                    "default, doc) before reading it")
+
+
+@rule("knob-readme-drift",
+      "README knob table out of sync with the registry "
+      "(regenerate: python -m pychemkin_tpu.lint --render-knobs)",
+      full_only=True)
+def check_readme_drift(ctx: LintContext) -> Iterable[Violation]:
+    readme = os.path.join(ctx.root, "README.md")
+    if not os.path.isfile(readme):
+        yield Violation("knob-readme-drift", "README.md", 1,
+                        "README.md not found at the repo root")
+        return
+    try:
+        knobs = load_knobs_module(ctx.root)
+    except Exception as exc:  # noqa: BLE001 — any load failure is a finding
+        yield Violation(
+            "knob-readme-drift", KNOBS_RELPATH, 1,
+            f"knobs.py failed to load standalone: "
+            f"{type(exc).__name__}: {exc}")
+        return
+    with open(readme, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    lines = text.splitlines()
+    begin = end = None
+    for i, ln in enumerate(lines):
+        if ln.strip() == knobs.TABLE_BEGIN:
+            begin = i
+        elif ln.strip() == knobs.TABLE_END:
+            end = i
+    if begin is None or end is None or end <= begin:
+        yield Violation(
+            "knob-readme-drift", "README.md", 1,
+            "README is missing the knob-table markers "
+            f"({knobs.TABLE_BEGIN!r} ... {knobs.TABLE_END!r})")
+        return
+    committed = "\n".join(
+        ln for ln in lines[begin + 1:end]).strip("\n")
+    expected = knobs.render_table().strip("\n")
+    if committed != expected:
+        yield Violation(
+            "knob-readme-drift", "README.md", begin + 1,
+            "committed knob table differs from the registry — "
+            "regenerate with `python -m pychemkin_tpu.lint "
+            "--render-knobs` and paste between the markers")

@@ -1,0 +1,156 @@
+"""Lock-discipline rule: ``# guarded-by: <lock>`` annotations.
+
+The threaded serve layer (server / batcher / transport / supervisor)
+and the telemetry recorder mutate shared attributes from submitter,
+worker, rescue, heartbeat, and monitor threads. The convention: the
+attribute's definition line (in ``__init__``) carries a trailing
+``# guarded-by: <lock-attribute>`` comment; this rule then flags any
+WRITE to that attribute — plain/aug/tuple assignment, subscript
+store/delete, or a known mutating method call (``append``/``pop``/
+``clear``/``update``/...) — that is not lexically inside a
+``with <lock>:`` block, in any module that creates threads or locks.
+
+Scope notes (the honest limits of a lexical check):
+
+- matching is by ATTRIBUTE NAME module-wide, so cross-object
+  conventions work (``tenant.inflight`` guarded by the owning
+  server's ``_quota_lock``); two classes in one module sharing an
+  attribute name share its annotation — rename one instead.
+- ``__init__`` bodies are exempt (construction happens-before any
+  thread can see the object).
+- READS are not checked; the rule polices the write side, where a
+  missed lock tears counters and races snapshots.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import (LintContext, Violation, dotted_name,
+                     module_spawns_threads, rule, _GUARDED_RE)
+
+#: method calls that mutate their receiver in place
+MUTATORS = {"append", "extend", "insert", "add", "remove", "discard",
+            "pop", "popitem", "clear", "update", "setdefault",
+            "appendleft", "popleft", "sort", "reverse"}
+
+
+def _write_targets(node: ast.AST) -> List[Tuple[str, int]]:
+    """(attribute name, line) pairs this statement writes, for
+    attribute-shaped targets (incl. tuple unpack and subscripts on an
+    attribute)."""
+    out: List[Tuple[str, int]] = []
+
+    def of_target(tgt: ast.AST) -> None:
+        if isinstance(tgt, ast.Tuple):
+            for e in tgt.elts:
+                of_target(e)
+        elif isinstance(tgt, ast.Attribute):
+            out.append((tgt.attr, tgt.lineno))
+        elif isinstance(tgt, ast.Subscript):
+            if isinstance(tgt.value, ast.Attribute):
+                out.append((tgt.value.attr, tgt.lineno))
+
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            of_target(tgt)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        of_target(node.target)
+    elif isinstance(node, ast.Delete):
+        for tgt in node.targets:
+            of_target(tgt)
+    elif isinstance(node, ast.Expr) and isinstance(node.value,
+                                                  ast.Call):
+        call = node.value
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in MUTATORS
+                and isinstance(call.func.value, ast.Attribute)):
+            out.append((call.func.value.attr, call.lineno))
+    return out
+
+
+def _lock_names_of_with(node: ast.With) -> Set[str]:
+    out: Set[str] = set()
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Attribute):
+            out.add(expr.attr)
+        elif isinstance(expr, ast.Name):
+            out.add(expr.id)
+    return out
+
+
+class _Walker:
+    """Statement walk tracking the lexical with-lock stack and the
+    enclosing function-name stack."""
+
+    def __init__(self, guarded: Dict[str, Tuple[str, int]]):
+        self.guarded = guarded
+        self.hits: List[Tuple[str, str, int, str]] = []
+
+    def walk(self, node: ast.AST, locks: Set[str],
+             funcs: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_locks = locks
+            child_funcs = funcs
+            if isinstance(child, ast.With):
+                child_locks = locks | _lock_names_of_with(child)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                child_funcs = funcs + (child.name,)
+            for attr, line in _write_targets(child):
+                info = self.guarded.get(attr)
+                if info is None:
+                    continue
+                lock, _anno_line = info
+                if "__init__" in funcs:
+                    continue
+                if lock not in locks:
+                    self.hits.append((attr, lock, line,
+                                      funcs[-1] if funcs else "?"))
+            self.walk(child, child_locks, child_funcs)
+
+
+@rule("lock-guard",
+      "write to a `# guarded-by:` annotated shared attribute outside "
+      "a `with <lock>:` block in a thread-spawning module")
+def check_lock_guard(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        if mod.tree is None or not module_spawns_threads(mod):
+            continue
+        guarded = mod.guarded_attrs()
+        if not guarded:
+            continue
+        walker = _Walker(guarded)
+        walker.walk(mod.tree, set(), ())
+        for attr, lock, line, func in walker.hits:
+            yield Violation(
+                "lock-guard", mod.relpath, line,
+                f"write to `{attr}` (guarded-by: {lock}) outside a "
+                f"`with {lock}:` block in `{func}` — racing threads "
+                "tear this attribute; take the lock or annotate why "
+                "it is safe")
+
+
+@rule("lock-annotation-orphan",
+      "a `# guarded-by:` comment on a line with no attribute "
+      "assignment (the annotation binds to nothing)")
+def check_annotation_orphan(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        anno_lines = {
+            lineno for lineno, text in mod.comments.items()
+            if _GUARDED_RE.search(text)}
+        if not anno_lines:
+            continue
+        # guarded_attrs maps attr -> (lock, assign line); every
+        # annotation line must have produced at least one binding
+        bound = {line for _lock, line in mod.guarded_attrs().values()}
+        for lineno in sorted(anno_lines - bound):
+            yield Violation(
+                "lock-annotation-orphan", mod.relpath, lineno,
+                "`# guarded-by:` annotation is not attached to an "
+                "attribute assignment — put it on the attribute's "
+                "definition line")

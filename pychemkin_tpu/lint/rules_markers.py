@@ -1,0 +1,84 @@
+"""Version-gated TODO markers: ``todo-on-upgrade``.
+
+``# chemlint: todo-on-upgrade(jax>=0.6): remove the shard_map shim``
+stays silent while the installed distribution is below the bound and
+becomes a ratchet violation the moment the image upgrades — so a
+version shim cannot outlive its reason. The installed version comes
+from ``importlib.metadata`` (distribution metadata only; the package
+is never imported, so checking a jax marker costs no jax import).
+
+A marker naming a distribution that is not installed is skipped (the
+condition cannot be evaluated); a syntactically broken marker is its
+own violation — a TODO that can never fire is worse than none.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Optional, Tuple
+
+from .engine import LintContext, Violation, rule
+
+_MARKER_RE = re.compile(
+    r"todo-on-upgrade\(\s*([A-Za-z0-9_.\-]+)\s*"
+    r"(>=|<=|==|>|<)\s*([0-9][0-9A-Za-z.\-]*)\s*\)\s*:?\s*(.*)$")
+_ANY_MARKER_RE = re.compile(r"#\s*chemlint:\s*todo-on-upgrade")
+
+
+def _installed_version(dist: str) -> Optional[str]:
+    """Resolved separately so tests can monkeypatch it; metadata-only,
+    never an import of the distribution."""
+    import importlib.metadata as _md
+
+    try:
+        return _md.version(dist)
+    except _md.PackageNotFoundError:
+        return None
+
+
+def _ver_tuple(v: str) -> Tuple[int, ...]:
+    parts: List[int] = []
+    for chunk in v.split("."):
+        digits = re.match(r"\d+", chunk)
+        if digits is None:
+            break
+        parts.append(int(digits.group(0)))
+    return tuple(parts)
+
+
+def _satisfied(installed: str, op: str, bound: str) -> bool:
+    a, b = _ver_tuple(installed), _ver_tuple(bound)
+    # pad to common length so 0.6 == 0.6.0
+    n = max(len(a), len(b))
+    a += (0,) * (n - len(a))
+    b += (0,) * (n - len(b))
+    return {" >=": a >= b, ">=": a >= b, "<=": a <= b, "==": a == b,
+            ">": a > b, "<": a < b}[op]
+
+
+@rule("todo-on-upgrade",
+      "a version-gated TODO whose condition is now met (or whose "
+      "marker is malformed)")
+def check_todo_on_upgrade(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        for lineno, text in sorted(mod.comments.items()):
+            if not _ANY_MARKER_RE.search(text):
+                continue
+            m = _MARKER_RE.search(text)
+            if m is None:
+                yield Violation(
+                    "todo-on-upgrade", mod.relpath, lineno,
+                    "malformed todo-on-upgrade marker (expected "
+                    "`# chemlint: todo-on-upgrade(dist>=version): "
+                    f"note`): {text.strip()!r}")
+                continue
+            dist, op, bound, note = m.groups()
+            installed = _installed_version(dist)
+            if installed is None:
+                continue
+            if _satisfied(installed, op, bound):
+                yield Violation(
+                    "todo-on-upgrade", mod.relpath, lineno,
+                    f"upgrade TODO is due: {dist} {op} {bound} holds "
+                    f"(installed {installed}) — "
+                    f"{note.strip() or 'see marker'}")

@@ -1,0 +1,256 @@
+"""Telemetry-schema consistency rules.
+
+Counter/gauge/histogram/event/span names are the API between the
+emitting code and everything downstream (chemtop's fleet merge, the
+bench artifacts, the flight recorder, the tests' schema assertions).
+A typo'd name at an emit site doesn't error — the series silently
+forks and the dashboards show a hole. These rules pin every
+string-literal name at an emit site to the canonical schema
+(``pychemkin_tpu/telemetry/schema.py``), and the schema back to the
+tree:
+
+- ``telemetry-unknown-name`` — a literal (or literal-prefixed
+  f-string) name at an ``inc``/``gauge``/``observe``/``event``/
+  ``section``/``device_increment``/``record_event``/``emit_span``/
+  ``span`` call that the schema's exact sets and dynamic-prefix sets
+  cannot derive. Non-literal names (variables fed from schema tuples)
+  are skipped — the schema module itself is the source of those.
+- ``telemetry-schema-stale`` — a schema entry no string constant in
+  the whole tree mentions anymore: the emitting code was deleted or
+  renamed, so the schema (and whatever reads it) must shrink too.
+- ``telemetry-schedule-counters`` — the scheduling package's exported
+  ``SCHEDULE_COUNTERS`` tuple must be a subset of the schema's
+  counters (single source of truth, checked without importing jax).
+
+The schema module holds only literal tuples, so everything here is
+AST-extraction — no imports of instrumented modules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import (LintContext, ModuleInfo, Violation, call_name,
+                     rule)
+
+SCHEMA_RELPATH = "pychemkin_tpu/telemetry/schema.py"
+SCHEDULE_RELPATH = "pychemkin_tpu/schedule/__init__.py"
+
+#: method/function name -> (schema category, name-argument index)
+EMIT_SITES: Dict[str, Tuple[str, int]] = {
+    "inc": ("counters", 0),
+    "device_increment": ("counters", 0),
+    "gauge": ("gauges", 0),
+    "observe": ("histograms", 0),
+    "event": ("events", 0),
+    "record_event": ("events", 0),
+    "section": ("timers", 0),
+    "emit_span": ("spans", 2),
+    "span": ("spans", 2),
+}
+
+_CATEGORIES = ("counters", "gauges", "histograms", "events", "timers",
+               "spans")
+
+#: modules that define the emit primitives themselves (their internal
+#: pass-through calls carry variables, not names)
+_DEFINING_MODULES = {"pychemkin_tpu/telemetry/recorder.py",
+                     "pychemkin_tpu/telemetry/trace.py"}
+
+
+def _extract_sets(mod: ModuleInfo) -> Dict[str, Set[str]]:
+    """Module-level ``NAME = (...)`` tuples/sets/lists of string
+    literals, keyed by lowercase name (COUNTERS -> counters,
+    COUNTER_PREFIXES -> counters_prefixes)."""
+    out: Dict[str, Set[str]] = {}
+    if mod.tree is None:
+        return out
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+            continue
+        vals = set()
+        ok = True
+        for e in node.value.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                          str):
+                vals.add(e.value)
+            else:
+                ok = False
+        if not ok:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = vals
+    return out
+
+
+def load_schema(ctx: LintContext) -> Optional[Dict[str, Dict[str,
+                                                             Set[str]]]]:
+    """{category: {"exact": set, "prefixes": set}} from schema.py."""
+    def build():
+        mod = ctx.parse_repo_file(SCHEMA_RELPATH)
+        if mod is None or mod.tree is None:
+            return None
+        raw = _extract_sets(mod)
+        out: Dict[str, Dict[str, Set[str]]] = {}
+        for cat in _CATEGORIES:
+            upper = cat.upper()
+            # COUNTERS / COUNTER_PREFIXES naming: singular prefix set
+            prefix_key = upper[:-1] + "_PREFIXES" \
+                if upper.endswith("S") else upper + "_PREFIXES"
+            out[cat] = {"exact": raw.get(upper, set()),
+                        "prefixes": raw.get(prefix_key, set())}
+        return out
+    return ctx.cached("telemetry-schema", build)
+
+
+def _literal_names(node: ast.Call, idx: int, mod: ModuleInfo
+                   ) -> List[Tuple[str, bool]]:
+    """Statically resolvable names at arg ``idx`` as (name,
+    is_prefix_only) pairs: a literal/const, BOTH arms of a literal
+    conditional expression, or the leading literal of an f-string
+    (prefix match). Empty when nothing is resolvable."""
+    if len(node.args) <= idx:
+        return []
+    out: List[Tuple[str, bool]] = []
+
+    def resolve(arg: ast.AST) -> None:
+        name = mod.resolve_str(arg)
+        if name is not None:
+            out.append((name, False))
+            return
+        if isinstance(arg, ast.IfExp):
+            resolve(arg.body)
+            resolve(arg.orelse)
+            return
+        if isinstance(arg, ast.JoinedStr) and arg.values:
+            first = arg.values[0]
+            if (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str) and first.value):
+                out.append((first.value, True))
+
+    resolve(node.args[idx])
+    return out
+
+
+def _iter_emit_calls(mod: ModuleInfo):
+    for node in mod.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        site = EMIT_SITES.get(cname or "")
+        if site is None:
+            continue
+        yield node, cname, site
+
+
+@rule("telemetry-unknown-name",
+      "a literal counter/gauge/histogram/event/span name at an emit "
+      "site that the canonical schema cannot derive")
+def check_unknown_name(ctx: LintContext) -> Iterable[Violation]:
+    schema = load_schema(ctx)
+    if schema is None:
+        if ctx.full:
+            yield Violation(
+                "telemetry-unknown-name", SCHEMA_RELPATH, 1,
+                "canonical telemetry schema module is missing or "
+                "unparseable")
+        return
+    for mod in ctx.modules:
+        if mod.tree is None or mod.relpath in _DEFINING_MODULES \
+                or mod.relpath == SCHEMA_RELPATH:
+            continue
+        for node, cname, (cat, idx) in _iter_emit_calls(mod):
+            exact = schema[cat]["exact"]
+            prefixes = schema[cat]["prefixes"]
+            for name, prefix_only in _literal_names(node, idx, mod):
+                if prefix_only:
+                    if any(name.startswith(p) for p in prefixes):
+                        continue
+                    yield Violation(
+                        "telemetry-unknown-name", mod.relpath,
+                        node.lineno,
+                        f"dynamic {cat[:-1]} name starting {name!r} "
+                        f"(via .{cname}) matches no registered "
+                        f"prefix in {SCHEMA_RELPATH} — register the "
+                        "family prefix")
+                else:
+                    if name in exact or any(name.startswith(p)
+                                            for p in prefixes):
+                        continue
+                    yield Violation(
+                        "telemetry-unknown-name", mod.relpath,
+                        node.lineno,
+                        f"{cat[:-1]} name {name!r} (via .{cname}) "
+                        f"is not in the canonical schema "
+                        f"{SCHEMA_RELPATH} — a typo here silently "
+                        "forks the series; add it to the schema or "
+                        "fix the name")
+
+
+@rule("telemetry-schema-stale",
+      "a schema entry no longer referenced anywhere in the tree",
+      full_only=True)
+def check_schema_stale(ctx: LintContext) -> Iterable[Violation]:
+    schema = load_schema(ctx)
+    if schema is None:
+        return
+    schema_mod = ctx.parse_repo_file(SCHEMA_RELPATH)
+    referenced: Set[str] = set()
+    for mod in ctx.modules:
+        if mod.tree is None or mod.relpath == SCHEMA_RELPATH:
+            continue
+        for node in mod.walk():
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str):
+                referenced.add(node.value)
+    line_of: Dict[str, int] = {}
+    if schema_mod is not None and schema_mod.tree is not None:
+        for node in schema_mod.walk():
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str):
+                line_of.setdefault(node.value, node.lineno)
+    for cat in _CATEGORIES:
+        for name in sorted(schema[cat]["exact"]):
+            if name in referenced:
+                continue
+            # a name can also survive as a literal prefix + suffix —
+            # only exact constants count; prefixes checked below
+            yield Violation(
+                "telemetry-schema-stale", SCHEMA_RELPATH,
+                line_of.get(name, 1),
+                f"schema {cat[:-1]} {name!r} appears nowhere in the "
+                "tree — the emitting code is gone; shrink the schema")
+        for prefix in sorted(schema[cat]["prefixes"]):
+            if any(c.startswith(prefix) for c in referenced):
+                continue
+            yield Violation(
+                "telemetry-schema-stale", SCHEMA_RELPATH,
+                line_of.get(prefix, 1),
+                f"schema {cat[:-1]} prefix {prefix!r} matches no "
+                "string constant in the tree — the emitting family "
+                "is gone; shrink the schema")
+
+
+@rule("telemetry-schedule-counters",
+      "schedule.SCHEDULE_COUNTERS must be a subset of the schema's "
+      "counters", full_only=True)
+def check_schedule_counters(ctx: LintContext) -> Iterable[Violation]:
+    schema = load_schema(ctx)
+    sched = ctx.parse_repo_file(SCHEDULE_RELPATH)
+    if schema is None or sched is None or sched.tree is None:
+        return
+    sets_ = _extract_sets(sched)
+    counters = schema["counters"]["exact"]
+    prefixes = schema["counters"]["prefixes"]
+    for name in sorted(sets_.get("SCHEDULE_COUNTERS", ())):
+        if name in counters or any(name.startswith(p)
+                                   for p in prefixes):
+            continue
+        yield Violation(
+            "telemetry-schedule-counters", SCHEDULE_RELPATH, 1,
+            f"SCHEDULE_COUNTERS entry {name!r} is missing from the "
+            f"canonical schema {SCHEMA_RELPATH}")

@@ -1,0 +1,422 @@
+"""Trace-safety / recompile-hazard rules.
+
+JAX's tracing model makes a specific set of Python idioms silently
+expensive or wrong inside traced code: Python ``if``/``while`` on
+traced values raise ``TracerBoolConversionError`` at best and bake a
+constant at worst; ``.item()`` / ``float()`` / ``np.asarray`` force a
+device sync and block batching; ``jax.jit`` constructed inside a loop
+builds a fresh cache entry per iteration (the recompile hazard class
+behind the "fresh lambdas" ablation bug); a jitted closure over a
+mutable module global reads whatever the global held at TRACE time —
+mutations after warmup are silently ignored.
+
+Static scoping: a function counts as *traced* when it is decorated
+with ``jit`` (directly or via ``partial(jit, ...)``), passed by name
+to a trace entry point in the same module (``jit`` / ``vmap`` /
+``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` / ``lax.fori_loop``
+/ ``checkpoint``), or lexically nested inside a traced function.
+Parameters marked static via ``static_argnums`` / ``static_argnames``
+are exempt from taint. The analysis is intentionally heuristic — the
+ratchet baseline absorbs current (reviewed) hits; NEW code either
+avoids the idiom or suppresses with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import (LintContext, Violation, call_name, dotted_name,
+                     names_in, rule)
+
+_TRACE_ENTRY_CALLS = {"jit", "vmap", "pmap", "scan", "while_loop",
+                      "cond", "fori_loop", "checkpoint", "remat"}
+
+#: attribute accesses on a traced value that are static under tracing
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+_NUMPY_BASES = {"np", "numpy", "onp"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` (as an expression, not a call)."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return False
+
+
+def _jit_call_of_decorator(dec: ast.AST) -> Optional[ast.Call]:
+    """The ``partial(jit, ...)``/``jit(...)`` Call carrying static_*
+    kwargs, if the decorator is jit-shaped; bare ``@jit`` -> None."""
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            return dec
+        if (call_name(dec) == "partial" and dec.args
+                and _is_jit_expr(dec.args[0])):
+            return dec
+    return None
+
+
+def _static_names(call: Optional[ast.Call],
+                  fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names marked static on a jit call node."""
+    out: Set[str] = set()
+    if call is None:
+        return out
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+    for kw in call.keywords:
+        v = kw.value
+        if kw.arg == "static_argnames":
+            vals = (v.elts if isinstance(v, (ast.Tuple, ast.List))
+                    else [v])
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(
+                        e.value, str):
+                    out.add(e.value)
+        elif kw.arg == "static_argnums":
+            vals = (v.elts if isinstance(v, (ast.Tuple, ast.List))
+                    else [v])
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int) and 0 <= e.value < len(params):
+                    out.add(params[e.value])
+    return out
+
+
+def _collect_traced(mod) -> Dict[ast.FunctionDef, Set[str]]:
+    """Traced FunctionDefs -> their static parameter names."""
+    traced: Dict[ast.FunctionDef, Set[str]] = {}
+    defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for node in mod.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    traced[node] = set()
+                else:
+                    call = _jit_call_of_decorator(dec)
+                    if call is not None:
+                        traced[node] = _static_names(call, node)
+    # functions passed by name to trace entry points
+    for node in mod.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        if cname not in _TRACE_ENTRY_CALLS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                for fn in defs_by_name.get(arg.id, ()):
+                    st = (_static_names(node, fn)
+                          if cname == "jit" else set())
+                    traced.setdefault(fn, set()).update(st)
+    # nesting: a def inside a traced def is traced
+    changed = True
+    while changed:
+        changed = False
+        for outer in list(traced):
+            for inner in ast.walk(outer):
+                if (isinstance(inner, ast.FunctionDef)
+                        and inner is not outer
+                        and inner not in traced):
+                    traced[inner] = set()
+                    changed = True
+    return traced
+
+
+def _traced_of(ctx: LintContext, mod) -> Dict[ast.FunctionDef,
+                                              Set[str]]:
+    """Per-module traced-function map, memoized on the context —
+    three rules consult it, and the nesting fix-point walk is the
+    analyzer's single hottest loop."""
+    return ctx.cached("traced:" + mod.relpath,
+                      lambda: _collect_traced(mod))
+
+
+def _taint_of(ctx: LintContext, mod, fn: ast.FunctionDef,
+              statics: Set[str]) -> Set[str]:
+    """Memoized per-function taint set (branch + concretize rules
+    share it)."""
+    return ctx.cached(
+        f"taint:{mod.relpath}:{fn.lineno}:{fn.name}",
+        lambda: _propagate_taint(fn, _tainted_params(fn, statics)))
+
+
+def _tainted_params(fn: ast.FunctionDef, statics: Set[str]
+                    ) -> Set[str]:
+    names = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                             + fn.args.kwonlyargs)}
+    names -= statics
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _propagate_taint(fn: ast.FunctionDef, seed: Set[str]) -> Set[str]:
+    """One-pass forward propagation through simple assignments and
+    for-targets inside the function body."""
+    tainted = set(seed)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            if names_in(node.value) & tainted:
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        elif isinstance(node, ast.AugAssign):
+            if (names_in(node.value) & tainted
+                    and isinstance(node.target, ast.Name)):
+                tainted.add(node.target.id)
+        elif isinstance(node, ast.For):
+            if names_in(node.iter) & tainted:
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        tainted.add(n.id)
+    return tainted
+
+
+class _BranchTaint(ast.NodeVisitor):
+    """Names in a branch test that are used in a trace-unsafe way.
+
+    Exempt contexts — static under tracing, or python-level by
+    construction: ``x is (not) None``, ``isinstance``/``hasattr``/
+    ``callable``/``len`` calls, comparisons against string constants,
+    and ``.shape``/``.ndim``/``.dtype``/``.size`` attribute chains.
+    """
+
+    def __init__(self, tainted: Set[str]):
+        self.tainted = tainted
+        self.offending: Set[str] = set()
+        self._exempt = 0
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        operands = [node.left] + list(node.comparators)
+        exempt = (
+            all(isinstance(op, (ast.Is, ast.IsNot))
+                for op in node.ops)
+            or any(isinstance(o, ast.Constant)
+                   and isinstance(o.value, str) for o in operands))
+        if exempt:
+            self._exempt += 1
+            self.generic_visit(node)
+            self._exempt -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if call_name(node) in ("isinstance", "hasattr", "callable",
+                               "len", "getattr", "type"):
+            self._exempt += 1
+            self.generic_visit(node)
+            self._exempt -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in _STATIC_ATTRS:
+            self._exempt += 1
+            self.generic_visit(node)
+            self._exempt -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if self._exempt == 0 and node.id in self.tainted:
+            self.offending.add(node.id)
+
+
+@rule("trace-py-branch",
+      "Python if/while on a traced value inside a jit/vmap/scan-"
+      "reachable function (recompile or TracerBoolConversionError "
+      "hazard)")
+def check_py_branch(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        for fn, statics in _traced_of(ctx, mod).items():
+            tainted = _taint_of(ctx, mod, fn, statics)
+            if not tainted:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                vis = _BranchTaint(tainted)
+                vis.visit(node.test)
+                if vis.offending:
+                    kind = ("while"
+                            if isinstance(node, ast.While) else "if")
+                    names = ", ".join(sorted(vis.offending))
+                    yield Violation(
+                        "trace-py-branch", mod.relpath, node.lineno,
+                        f"python `{kind}` on possibly-traced "
+                        f"value(s) {names} inside traced function "
+                        f"`{fn.name}` — use lax.cond/lax.select or "
+                        "mark the argument static")
+
+
+@rule("trace-concretize",
+      ".item()/float()/int()/bool()/np.asarray on a traced operand "
+      "inside a traced function (forces a device sync / trace error)")
+def check_concretize(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        for fn, statics in _traced_of(ctx, mod).items():
+            tainted = _taint_of(ctx, mod, fn, statics)
+            if not tainted:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                bad: Optional[str] = None
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and names_in(node.func.value) & tainted):
+                    bad = ".item()"
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in ("float", "int", "bool")
+                      and node.args
+                      and names_in(node.args[0]) & tainted):
+                    bad = f"{node.func.id}()"
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in ("asarray", "array")
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id in _NUMPY_BASES
+                      and node.args
+                      and names_in(node.args[0]) & tainted):
+                    bad = f"np.{node.func.attr}()"
+                if bad:
+                    yield Violation(
+                        "trace-concretize", mod.relpath, node.lineno,
+                        f"{bad} on a possibly-traced operand inside "
+                        f"traced function `{fn.name}` — concretizes "
+                        "the tracer (host sync or TracerError)")
+
+
+@rule("jit-in-loop",
+      "jax.jit called inside a Python loop body (fresh cache entry "
+      "per iteration — the 'fresh lambdas' recompile hazard)")
+def check_jit_in_loop(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        loops = [n for n in mod.walk()
+                 if isinstance(n, (ast.For, ast.While))]
+        seen: Set[int] = set()   # nested loops re-visit inner calls
+        for loop in loops:
+            for node in ast.walk(loop):
+                if node is loop or not isinstance(node, ast.Call):
+                    continue
+                if _is_jit_expr(node.func) and id(node) not in seen:
+                    seen.add(id(node))
+                    yield Violation(
+                        "jit-in-loop", mod.relpath, node.lineno,
+                        "jax.jit(...) constructed inside a loop — "
+                        "each iteration builds a fresh jit wrapper "
+                        "and its own compile-cache entry; hoist the "
+                        "jitted callable out of the loop")
+
+
+@rule("jit-static-unhashable",
+      "a static_argnums/static_argnames parameter with a mutable "
+      "(unhashable) default — TypeError at first call")
+def check_static_unhashable(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        for node in mod.walk():
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            statics: Set[str] = set()
+            for dec in node.decorator_list:
+                call = _jit_call_of_decorator(dec)
+                if call is not None:
+                    statics |= _static_names(call, node)
+            if not statics:
+                continue
+            args = node.args.posonlyargs + node.args.args
+            defaults = node.args.defaults
+            offset = len(args) - len(defaults)
+            for i, default in enumerate(defaults):
+                pname = args[offset + i].arg
+                if pname in statics and isinstance(
+                        default, (ast.List, ast.Dict, ast.Set)):
+                    yield Violation(
+                        "jit-static-unhashable", mod.relpath,
+                        default.lineno,
+                        f"static parameter `{pname}` of "
+                        f"`{node.name}` defaults to an unhashable "
+                        "literal — jit static args must be hashable "
+                        "(use a tuple/frozenset/None)")
+            kwargs = node.args.kwonlyargs
+            for i, default in enumerate(node.args.kw_defaults):
+                if default is None:
+                    continue
+                pname = kwargs[i].arg
+                if pname in statics and isinstance(
+                        default, (ast.List, ast.Dict, ast.Set)):
+                    yield Violation(
+                        "jit-static-unhashable", mod.relpath,
+                        default.lineno,
+                        f"static parameter `{pname}` of "
+                        f"`{node.name}` defaults to an unhashable "
+                        "literal — jit static args must be hashable "
+                        "(use a tuple/frozenset/None)")
+
+
+def _mutable_globals(tree: ast.Module) -> Dict[str, int]:
+    """Module-level names bound to mutable literals (or list/dict/set
+    constructor calls) -> definition line."""
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            v = node.value
+            mutable = isinstance(v, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp))
+            if (isinstance(v, ast.Call)
+                    and call_name(v) in ("list", "dict", "set",
+                                         "defaultdict", "deque")):
+                mutable = True
+            if mutable:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = node.lineno
+    return out
+
+
+@rule("jit-mutable-global",
+      "a traced function reads a mutable module global — the value is "
+      "baked at trace time; later mutations are silently ignored")
+def check_mutable_global(ctx: LintContext) -> Iterable[Violation]:
+    for mod in ctx.modules:
+        if mod.tree is None:
+            continue
+        mutables = _mutable_globals(mod.tree)
+        if not mutables:
+            continue
+        for fn, _statics in _traced_of(ctx, mod).items():
+            local = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Store):
+                    local.add(node.id)
+            local |= {a.arg for a in (fn.args.posonlyargs
+                                      + fn.args.args
+                                      + fn.args.kwonlyargs)}
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in mutables
+                        and node.id not in local):
+                    yield Violation(
+                        "jit-mutable-global", mod.relpath,
+                        node.lineno,
+                        f"traced function `{fn.name}` closes over "
+                        f"mutable module global `{node.id}` "
+                        f"(defined line {mutables[node.id]}) — its "
+                        "contents are frozen into the trace; pass it "
+                        "as an argument or make it immutable")

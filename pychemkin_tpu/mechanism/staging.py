@@ -41,7 +41,7 @@ from typing import Any
 
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
 
 #: schema version of the staged npz — bump on any layout change so old
 #: entries read as stale and re-stage instead of misindexing
@@ -221,7 +221,9 @@ def staging_cache_dir() -> str | None:
     are pure host-independent numpy, so unlike the XLA entries they
     need no CPU-feature partitioning. ``PYCHEMKIN_STAGING_DIR``
     overrides; set EMPTY to disable the disk layer."""
-    env = os.environ.get(STAGING_DIR_ENV)
+    # raw(), not value(): "" is meaningful here (disable the disk
+    # layer), and value() folds "" into the unset default
+    env = knobs.raw(STAGING_DIR_ENV)
     if env is not None:
         return env or None
     from ..utils.cache import _default_dir

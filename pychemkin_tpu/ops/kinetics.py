@@ -23,7 +23,6 @@ mol/(cm^3 s), activation temperatures K).
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 from typing import Any, NamedTuple
 
@@ -31,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import knobs
 from ..constants import P_ATM, R_GAS
 from ..mechanism.record import (
     FALLOFF_CHEM_ACT,
@@ -113,11 +113,8 @@ def resolve_rop_mode() -> str:
     override = _ROP_MODE.stack[-1]
     if override is not None:
         return override
-    m = os.environ.get(ROP_MODE_ENV, "auto").strip().lower() or "auto"
-    if m not in ("auto", "sparse", "dense"):
-        raise ValueError(
-            f"{ROP_MODE_ENV} must be 'sparse', 'dense' or 'auto', "
-            f"got {m!r}")
+    # knobs.value validates membership and raises naming the knob
+    m = knobs.value(ROP_MODE_ENV)
     if m == "auto":
         return "dense" if jax.default_backend() == "tpu" else "sparse"
     return m

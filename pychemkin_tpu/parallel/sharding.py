@@ -37,6 +37,11 @@ except ImportError:                     # jax 0.4.x: experimental home
     from jax.experimental.shard_map import shard_map as _shard_map_impl
 
 
+# chemlint: todo-on-upgrade(jax>=0.6): remove the shard_map version
+# shim below (check_rep vs check_vma, experimental import above) —
+# once the image pins jax >= 0.6 the top-level API takes check_vma
+# directly and this wrapper is dead weight (see ROADMAP carried-
+# forward note)
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
     """Version-portable ``shard_map``: newer jax spells the replication
     check ``check_vma``, jax 0.4.x spells it ``check_rep`` (and hosts

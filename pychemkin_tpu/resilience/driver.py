@@ -61,10 +61,9 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
 from . import checkpoint, procfaults
 from .procfaults import REEXEC_COUNT_ENV, BackendPoisonedError
-from .rescue import _env_float, _env_int
 
 #: the documented resumable exit code (sysexits EX_TEMPFAIL): the job
 #: was interrupted AFTER banking — rerun the same command to resume
@@ -244,13 +243,13 @@ def run_sweep_job(solve_chunk: Callable[[int, int], Dict[str, np.ndarray]],
                          "(see run_vmapped_sweep_job for empty-sweep "
                          "handling)")
     if max_retries is None:
-        max_retries = _env_int("PYCHEMKIN_DRIVER_RETRIES", 2)
+        max_retries = knobs.value("PYCHEMKIN_DRIVER_RETRIES")
     if backoff_s is None:
-        backoff_s = _env_float("PYCHEMKIN_DRIVER_BACKOFF_S", 0.5)
+        backoff_s = knobs.value("PYCHEMKIN_DRIVER_BACKOFF_S")
     if backoff_cap_s is None:
-        backoff_cap_s = _env_float("PYCHEMKIN_DRIVER_BACKOFF_CAP_S", 30.0)
+        backoff_cap_s = knobs.value("PYCHEMKIN_DRIVER_BACKOFF_CAP_S")
     if max_reexecs is None:
-        max_reexecs = _env_int("PYCHEMKIN_DRIVER_MAX_REEXECS", 1)
+        max_reexecs = knobs.value("PYCHEMKIN_DRIVER_MAX_REEXECS")
     if checkpoint_path is not None and signature is None:
         raise ValueError("checkpoint_path requires a problem signature")
     if install_signals is None:

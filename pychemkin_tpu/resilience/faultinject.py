@@ -49,8 +49,9 @@ from __future__ import annotations
 
 import contextlib
 import json
-import os
 from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+from .. import knobs
 
 _ENV = "PYCHEMKIN_FAULTS"
 
@@ -81,7 +82,7 @@ _active: List[FaultSpec] = []
 
 
 def _env_specs() -> List[FaultSpec]:
-    raw = os.environ.get(_ENV)
+    raw = knobs.raw(_ENV)
     if not raw:
         return []
     data = json.loads(raw)

@@ -79,6 +79,8 @@ import sys
 import time
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
+from .. import knobs
+
 _ENV = "PYCHEMKIN_PROC_FAULTS"
 
 #: incremented by the driver on every subprocess re-exec; also how
@@ -150,7 +152,7 @@ _fired: Dict[Tuple, int] = {}
 
 
 def _env_specs() -> List[ProcFaultSpec]:
-    raw = os.environ.get(_ENV)
+    raw = knobs.raw(_ENV)
     if not raw:
         return []
     data = json.loads(raw)

@@ -46,13 +46,12 @@ Telemetry: counters ``resilience.rescued`` / ``resilience.abandoned``
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
 from . import faultinject
 from .status import SolveStatus, failed_mask, name_of, status_counts
 
@@ -96,17 +95,7 @@ class RescueReport(NamedTuple):
 
 
 def rescue_enabled() -> bool:
-    return os.environ.get("PYCHEMKIN_RESCUE", "1") != "0"
-
-
-def _env_int(name: str, default: Optional[int]) -> Optional[int]:
-    raw = os.environ.get(name)
-    return int(raw) if raw else default
-
-
-def _env_float(name: str, default: Optional[float]) -> Optional[float]:
-    raw = os.environ.get(name)
-    return float(raw) if raw else default
+    return knobs.value("PYCHEMKIN_RESCUE")
 
 
 def run_rescue(solve_subset, results: Dict[str, np.ndarray], *,
@@ -131,10 +120,10 @@ def run_rescue(solve_subset, results: Dict[str, np.ndarray], *,
     """
     # explicit call arguments win; the env knobs only fill in defaults
     if max_attempts is None:
-        max_attempts = _env_int("PYCHEMKIN_RESCUE_MAX_ATTEMPTS", None)
+        max_attempts = knobs.value("PYCHEMKIN_RESCUE_MAX_ATTEMPTS")
     if attempt_timeout_s is None:
-        attempt_timeout_s = _env_float(
-            "PYCHEMKIN_RESCUE_ATTEMPT_TIMEOUT_S", None)
+        attempt_timeout_s = knobs.value(
+            "PYCHEMKIN_RESCUE_ATTEMPT_TIMEOUT_S")
     status = np.asarray(results["status"])
     n_elements = int(status.size)
     base_failed = failed_mask(status)

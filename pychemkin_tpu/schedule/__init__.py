@@ -42,8 +42,7 @@ Telemetry: ``schedule.cohorts`` (cohort chunks planned),
 
 from __future__ import annotations
 
-import os
-
+from .. import knobs
 from .adaptive import AdaptiveController
 from .cohorts import CohortPlan, order_signature, plan_cohorts
 from .compaction import compacted_ignition_sweep, compaction_ladder
@@ -77,11 +76,12 @@ def resolve_mode(mode: str | None = None) -> str:
     else ``PYCHEMKIN_SCHEDULE``, else ``static``. An unknown value is
     rejected loudly — a typo'd knob silently running static would fake
     a scheduling A/B."""
-    raw = mode if mode is not None else os.environ.get(MODE_ENV,
-                                                       "static")
-    if raw not in MODES:
+    if mode is None:
+        # registry-validated: an unknown env value raises naming the
+        # knob and the valid choices
+        return knobs.value(MODE_ENV)
+    if mode not in MODES:
         raise ValueError(
-            f"unknown schedule mode {raw!r} "
-            f"({'explicit' if mode is not None else MODE_ENV}); "
+            f"unknown schedule mode {mode!r} (explicit); "
             f"expected one of {MODES}")
-    return raw
+    return mode

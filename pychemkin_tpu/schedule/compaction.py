@@ -25,14 +25,13 @@ unsorted vmapped sweep — property-tested in tests/test_schedule.py.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
 from ..ops import reactors
 from ..resilience import faultinject
 from ..resilience.driver import edge_pad_indices
@@ -84,7 +83,7 @@ def compaction_ladder(top: int, min_bucket: int = MIN_BUCKET
 
 
 def _round_len() -> int:
-    return int(os.environ.get(ROUND_ENV, DEFAULT_ROUND_LEN))
+    return int(knobs.value(ROUND_ENV))
 
 
 def _kernel(mech, problem, energy, cfg: Tuple, kwargs: Dict):

@@ -131,8 +131,9 @@ class ChemServer:
         self.max_rescue_rungs = max_rescue_rungs
         self._rec = (recorder if recorder is not None
                      else telemetry.get_recorder())
-        self._engine_config = dict(engine_config or {})
-        self._engines: Dict[str, Engine] = {}
+        self._engine_config = dict(
+            engine_config or {})         # guarded-by: _lock
+        self._engines: Dict[str, Engine] = {}  # guarded-by: _lock
         self._queue: "_queue.Queue[Request]" = _queue.Queue(
             maxsize=self.queue_depth)
         self._rescue_q: "_queue.Queue[Any]" = _queue.Queue()
@@ -141,8 +142,8 @@ class ChemServer:
         self._lock = threading.RLock()
         self._worker: Optional[threading.Thread] = None
         self._rescuer: Optional[threading.Thread] = None
-        self._started = False
-        self._closed = False
+        self._started = False            # guarded-by: _lock
+        self._closed = False             # guarded-by: _lock
         self._worker_done = False
         self._worker_exc: Optional[BaseException] = None
         self._rescuer_done = False
@@ -284,7 +285,11 @@ class ChemServer:
             # never started: nothing will ever serve the queue
             self._fail_queued(ServerClosed("server closed before start"))
         self._stop.restore()
-        self._closed = True
+        # under the lock: a start() racing this close() checks _closed
+        # while holding it — an unlocked flip here could let start()
+        # spawn threads that no close() will ever join
+        with self._lock:
+            self._closed = True
         self._rec.event("serve.drain", drained=drain,
                         queue_depth=self._queue.qsize())
         self._rec.gauge("serve.queue_depth", self._queue.qsize())

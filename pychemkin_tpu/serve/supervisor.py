@@ -67,10 +67,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from .. import telemetry
+from .. import knobs, telemetry
 from ..resilience.driver import GracefulStop, is_poisoned
 from ..resilience.procfaults import REEXEC_COUNT_ENV
-from ..resilience.rescue import _env_int
 from ..resilience.status import SolveStatus, name_of
 from ..telemetry import trace
 from .errors import ServerClosed, TransportClosed
@@ -134,8 +133,8 @@ class Supervisor:
         self.heartbeat_s = float(heartbeat_s)
         self.hang_timeout_s = float(hang_timeout_s)
         if max_respawns is None:
-            max_respawns = _env_int(
-                "PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS", 2)
+            max_respawns = knobs.value(
+                "PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS")
         self.max_respawns = int(max_respawns)
         self.retry_budget = int(retry_budget)
         self.spawn_timeout_s = float(spawn_timeout_s)
@@ -144,22 +143,22 @@ class Supervisor:
                      else telemetry.get_recorder())
         self._kill_report_dir = (
             kill_report_dir if kill_report_dir is not None
-            else os.environ.get(KILL_REPORT_DIR_ENV))
-        self._last_pong: Optional[float] = None
+            else knobs.value(KILL_REPORT_DIR_ENV))
+        self._last_pong: Optional[float] = None  # guarded-by: _lock
         self._lock = threading.RLock()
-        self._proc: Optional[subprocess.Popen] = None
-        self._client: Optional[TransportClient] = None
-        self._hb: Optional[TransportClient] = None
-        self._port: Optional[int] = None
-        self._inflight: Dict[int, _InFlight] = {}
+        self._proc: Optional[subprocess.Popen] = None  # guarded-by: _lock
+        self._client: Optional[TransportClient] = None  # guarded-by: _lock
+        self._hb: Optional[TransportClient] = None  # guarded-by: _lock
+        self._port: Optional[int] = None         # guarded-by: _lock
+        self._inflight: Dict[int, _InFlight] = {}  # guarded-by: _lock
         self._ids = itertools.count()
-        self._respawns = 0
-        self._resubmits = 0
-        self._lost_requests = 0
-        self._lost_reason: Optional[str] = None
-        self._draining = False
-        self._dead = False
-        self._started = False
+        self._respawns = 0                       # guarded-by: _lock
+        self._resubmits = 0                      # guarded-by: _lock
+        self._lost_requests = 0                  # guarded-by: _lock
+        self._lost_reason: Optional[str] = None  # guarded-by: _lock
+        self._draining = False                   # guarded-by: _lock
+        self._dead = False                       # guarded-by: _lock
+        self._started = False                    # guarded-by: _lock
         self._monitor: Optional[threading.Thread] = None
         self._hb_thread: Optional[threading.Thread] = None
         self._stop = GracefulStop()
@@ -486,8 +485,12 @@ class Supervisor:
                 pass
 
     def _heartbeat_loop(self) -> None:
-        last_pong = self._last_pong = time.perf_counter()
-        hb_seen = self._hb
+        # _last_pong is read by the monitor thread's kill report, so
+        # every write happens under the lock (chemlint: lock-guard)
+        last_pong = time.perf_counter()
+        with self._lock:
+            self._last_pong = last_pong
+            hb_seen = self._hb
         while True:
             time.sleep(self.heartbeat_s)
             with self._lock:
@@ -505,10 +508,13 @@ class Supervisor:
                 continue             # respawn in progress
             if hb is not hb_seen:
                 hb_seen, last_pong = hb, time.perf_counter()
-                self._last_pong = last_pong
+                with self._lock:
+                    self._last_pong = last_pong
             try:
                 hb.ping(timeout=self.heartbeat_s)
-                last_pong = self._last_pong = time.perf_counter()
+                last_pong = time.perf_counter()
+                with self._lock:
+                    self._last_pong = last_pong
             except Exception:        # noqa: BLE001 — miss or torn conn
                 if (time.perf_counter() - last_pong
                         > self.hang_timeout_s):
@@ -595,6 +601,7 @@ class Supervisor:
             return None
         now = time.perf_counter()
         with self._lock:
+            last_pong = self._last_pong
             inflight = [
                 {"kind": e.kind, "tenant": e.tenant,
                  "trace": e.trace_id, "attempts": e.attempts,
@@ -613,8 +620,8 @@ class Supervisor:
             "backend_pid": pid,
             "supervisor_pid": os.getpid(),
             "last_heartbeat_age_s": (
-                None if self._last_pong is None
-                else round(now - self._last_pong, 3)),
+                None if last_pong is None
+                else round(now - last_pong, 3)),
             "n_inflight": len(inflight),
             "inflight": inflight,
             "respawn_budget": {
@@ -645,8 +652,13 @@ class Supervisor:
             self._inflight.clear()
         self._rec.event("supervisor.respawn_exhausted", reason=reason,
                         n_inflight=len(entries))
+        # under the lock: submit/monitor threads also bump loss
+        # counters, and stats() snapshots them mid-traffic — an
+        # unlocked += is a read-modify-write that drops updates.
+        # One batched acquisition, not one per entry.
+        with self._lock:
+            self._lost_requests += len(entries)
         for entry in entries:
-            self._lost_requests += 1
             self._rec.inc("supervisor.backend_lost_requests")
             life_ms = (time.perf_counter() - entry.t_submit) * 1e3
             trace.emit_span(self._rec, entry.trace_id,
@@ -676,13 +688,15 @@ class Supervisor:
                 # the per-request budget is spent: resolve with
                 # BACKEND_LOST as data instead of riding respawns
                 # forever
-                self._lost_requests += 1
+                with self._lock:
+                    self._lost_requests += 1
                 self._rec.inc("supervisor.backend_lost_requests")
                 self._resolve_status(entry,
                                      int(SolveStatus.BACKEND_LOST))
                 continue
             if entry.attempts > 0:
-                self._resubmits += 1
+                with self._lock:
+                    self._resubmits += 1
                 self._rec.inc("supervisor.resubmits")
                 # child span under the ORIGINAL trace id: the healed
                 # request's story includes the generation that died

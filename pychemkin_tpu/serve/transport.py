@@ -90,7 +90,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
 from ..resilience import procfaults
 from ..resilience.driver import GracefulStop
 from ..resilience.procfaults import BackendPoisonedError
@@ -255,7 +255,7 @@ class _Tenant:
         self.name = name
         self.mech = mech
         self.quota = int(quota)
-        self.inflight = 0
+        self.inflight = 0            # guarded-by: _quota_lock
 
 
 class TransportServer:
@@ -286,7 +286,8 @@ class TransportServer:
         self._rec = (recorder if recorder is not None
                      else telemetry.get_recorder())
         self._chem_kwargs = dict(chem_kwargs or {})
-        self._servers: Dict[str, ChemServer] = dict(servers or {})
+        self._servers: Dict[str, ChemServer] = dict(
+            servers or {})               # guarded-by: _lock
         self._host, self._port = host, int(port)
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
@@ -295,7 +296,11 @@ class TransportServer:
         self._lock = threading.Lock()
         self._req_ordinal = itertools.count()
         self._hb_ordinal = itertools.count()
-        self._closed = False
+        # single-writer shutdown flag (owner thread flips it once; the
+        # accept loop only reads) — distinct name from the client's
+        # _plock-guarded _closed so the guarded-by annotation cannot
+        # blur across the two classes in this module
+        self._shutdown = False
         self._drained = threading.Event()
         self._t_start = time.time()
 
@@ -355,9 +360,9 @@ class TransportServer:
 
     def close(self) -> None:
         """Drain, stop accepting, drop connections."""
-        if self._closed:
+        if self._shutdown:
             return
-        self._closed = True
+        self._shutdown = True
         self.drain()
         if self._listener is not None:
             try:
@@ -380,7 +385,7 @@ class TransportServer:
 
     # -- connection handling ---------------------------------------------
     def _accept_loop(self) -> None:
-        while not self._closed:
+        while not self._shutdown:
             try:
                 conn, addr = self._listener.accept()
             except OSError:
@@ -608,10 +613,11 @@ class TransportClient:
         self._plock = threading.Lock()
         # rid -> (kind, future, trace id, perf_counter at send): the
         # last two drive the client-side ``client.wire`` span
-        self._pending: Dict[int, Tuple[str, ServeFuture,
-                                       Optional[str], float]] = {}
+        self._pending: Dict[int, Tuple[
+            str, ServeFuture,
+            Optional[str], float]] = {}  # guarded-by: _plock
         self._ids = itertools.count()
-        self._closed = False
+        self._closed = False             # guarded-by: _plock
         self._rx = threading.Thread(target=self._recv_loop,
                                     name="transport-client-recv",
                                     daemon=True)
@@ -793,7 +799,7 @@ def main(argv=None) -> int:
     chem_kwargs = dict(config.get("chem", {}))
     if config.get("engine_config"):
         chem_kwargs["engine_config"] = config["engine_config"]
-    tel_path = os.environ.get(TELEMETRY_PATH_ENV)
+    tel_path = knobs.value(TELEMETRY_PATH_ENV)
     if tel_path:
         # crash-safe JSONL sink on the default recorder (the recorder
         # every ChemServer built below inherits): serve.batch events,

@@ -44,9 +44,9 @@ from typing import Any, NamedTuple, Optional
 
 import jax.numpy as jnp
 
+from .. import knobs
 from ..constants import P_ATM
 from ..ops import linalg, thermo
-from ..resilience.rescue import _env_float
 
 _TINY = 1e-30
 
@@ -64,20 +64,20 @@ def gate_config(*, domain_margin: Optional[float] = None,
                 ign_disagree_max: Optional[float] = None,
                 ign_t_end_frac: Optional[float] = None,
                 eq_resid_max: Optional[float] = None) -> GateConfig:
-    """Thresholds from explicit kwargs, else env, else defaults."""
-    def pick(val, env, default):
-        return float(val) if val is not None \
-            else _env_float(env, default)
+    """Thresholds from explicit kwargs, else env, else the registry
+    defaults (pychemkin_tpu.knobs owns default + parse semantics)."""
+    def pick(val, env):
+        return float(val) if val is not None else knobs.value(env)
 
     return GateConfig(
         domain_margin=pick(domain_margin,
-                           "PYCHEMKIN_SURROGATE_DOMAIN_MARGIN", 0.0),
+                           "PYCHEMKIN_SURROGATE_DOMAIN_MARGIN"),
         ign_disagree_max=pick(ign_disagree_max,
-                              "PYCHEMKIN_SURROGATE_IGN_DISAGREE", 0.1),
+                              "PYCHEMKIN_SURROGATE_IGN_DISAGREE"),
         ign_t_end_frac=pick(ign_t_end_frac,
-                            "PYCHEMKIN_SURROGATE_IGN_TEND_FRAC", 0.8),
+                            "PYCHEMKIN_SURROGATE_IGN_TEND_FRAC"),
         eq_resid_max=pick(eq_resid_max,
-                          "PYCHEMKIN_SURROGATE_EQ_RESID", 0.05))
+                          "PYCHEMKIN_SURROGATE_EQ_RESID"))
 
 
 def in_domain(lo, hi, feats, margin: float = 0.0):

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from .. import knobs
 from .sink import JsonlSink, atomic_write_json, timestamp
 
 #: environment switch for the device→host counter bridge; checked at
@@ -52,19 +53,16 @@ DEFAULT_EVENTS_CAP = 4096
 
 
 def _events_cap() -> int:
-    raw = os.environ.get(EVENTS_CAP_ENV)
-    try:
-        cap = int(raw) if raw else DEFAULT_EVENTS_CAP
-    except ValueError:
-        cap = DEFAULT_EVENTS_CAP
-    return max(cap, 1)
+    # registry parse: int, floor 1, unparseable falls back to the
+    # default — a garbage cap must not take down a serving process
+    return knobs.value(EVENTS_CAP_ENV)
 
 
 def device_counters_enabled() -> bool:
     """Whether jitted code should embed device→host counter callbacks
     (default on; export ``PYCHEMKIN_TELEMETRY_DEVICE=0`` to strip them
     from compiled programs)."""
-    return os.environ.get(_DEVICE_COUNTERS_ENV, "1") != "0"
+    return knobs.value(_DEVICE_COUNTERS_ENV)
 
 
 #: histogram bucket edges: log-spaced, 8 buckets per decade over
@@ -200,16 +198,19 @@ class MetricsRecorder:
 
     def __init__(self, sink: Optional[JsonlSink] = None,
                  max_events: Optional[int] = None):
-        self.counters: Dict[str, int] = collections.defaultdict(int)
-        self.gauges: Dict[str, float] = {}
-        self.timers: Dict[str, float] = collections.defaultdict(float)
-        self.histograms: Dict[str, Histogram] = {}
+        self.counters: Dict[str, int] = collections.defaultdict(
+            int)                         # guarded-by: _lock
+        self.gauges: Dict[str, float] = {}  # guarded-by: _lock
+        self.timers: Dict[str, float] = collections.defaultdict(
+            float)                       # guarded-by: _lock
+        self.histograms: Dict[str, Histogram] = {}  # guarded-by: _lock
         # bounded ring: the tail a flight-recorder dump wants, not the
         # full record (that is the JSONL sink's job) — a long
         # --transport --chaos soak must not grow backend memory with
         # every event. Cap via PYCHEMKIN_TELEMETRY_EVENTS_CAP.
         self._events: collections.deque = collections.deque(
-            maxlen=_events_cap() if max_events is None else max_events)
+            maxlen=(_events_cap() if max_events is None
+                    else max_events))    # guarded-by: _event_lock
         self._lock = threading.Lock()
         # events get their own lock: emit() does sink disk I/O, and
         # holding the metrics lock across a write/flush would stall
@@ -384,10 +385,10 @@ FLIGHT_DIR_ENV = "PYCHEMKIN_FLIGHT_DIR"
 def flight_recorder_path() -> Optional[str]:
     """Where a flight dump would land, or None when disabled (neither
     env var set and no explicit path given)."""
-    path = os.environ.get(FLIGHT_PATH_ENV)
+    path = knobs.value(FLIGHT_PATH_ENV)
     if path:
         return path
-    d = os.environ.get(FLIGHT_DIR_ENV)
+    d = knobs.value(FLIGHT_DIR_ENV)
     if d:
         return os.path.join(d, f"flight_{os.getpid()}.json")
     return None

@@ -1,0 +1,183 @@
+"""Canonical telemetry name schema — every counter/gauge/histogram/
+event/span name the framework emits, in one place.
+
+This module is the contract between the emitting code and everything
+downstream of it: chemtop's fleet merge, the bench artifacts, the
+flight recorder, test assertions, and human grep. The ``chemlint``
+static pass (:mod:`pychemkin_tpu.lint`) enforces it in BOTH
+directions without importing this module (pure AST extraction — only
+literal tuples may live here):
+
+- every string-literal name at an emit site (``inc``/``gauge``/
+  ``observe``/``event``/``emit_span``/``device_increment``/...) must
+  be an exact entry or extend a registered ``*_PREFIXES`` family;
+- every entry here must still be referenced somewhere in the tree —
+  deleting an emitting subsystem forces the schema (and dashboards)
+  to shrink with it.
+
+Dynamic families (``serve.status.<NAME>``, ``odeint.status.<NAME>``,
+per-tenant / per-kind / per-bucket series) are declared as prefixes:
+the runtime suffix is data (a status name, a tenant, a bucket), the
+prefix is schema.
+
+The scheduling package's exported ``SCHEDULE_COUNTERS`` tuple is
+cross-checked as a subset of :data:`COUNTERS` by the lint, so the two
+cannot drift.
+"""
+
+from __future__ import annotations
+
+# -- counters ---------------------------------------------------------------
+
+COUNTERS = (
+    "checkpoint.resumes",
+    "checkpoint.save_failures",
+    "checkpoint.saves",
+    "driver.retries",
+    "flame.programs_built",
+    "flame.solves",
+    "linalg.pivot_fallback",
+    "linalg.refine_stagnated",
+    "model.failed_solves",
+    "model.solves",
+    "network.cluster_reject",
+    "odeint.newton",
+    "odeint.rejected",
+    "odeint.solves",
+    "odeint.stalled",
+    "odeint.steps",
+    "resilience.abandoned",
+    "resilience.rescued",
+    "schedule.cohorts",
+    "schedule.compactions",
+    "schedule.ladder_adjust",
+    "serve.abandoned",
+    "serve.batch_errors",
+    "serve.batches",
+    "serve.compiles",
+    "serve.deadline_expired",
+    "serve.rejected",
+    "serve.requests",
+    "serve.rescued",
+    "serve.surrogate.fallback",
+    "serve.surrogate.hit",
+    "serve.surrogate.miss",
+    "serve.tenant_rejected",
+    "serve.transport.reply_dropped",
+    "supervisor.backend_lost_requests",
+    "supervisor.respawns",
+    "supervisor.resubmits",
+    "staging.cache_corrupt",
+    "staging.cache_hit",
+    "staging.emit",
+    "staging.hit",
+    "staging.memo_hit",
+)
+
+#: dynamic counter families: the suffix is runtime data (a status
+#: name, an engine kind, a tenant id)
+COUNTER_PREFIXES = (
+    "model.status.",
+    "odeint.status.",
+    "resilience.status.",
+    "serve.compiles.",
+    "serve.status.",
+    "serve.tenant_rejected.",
+)
+
+# -- gauges -----------------------------------------------------------------
+
+GAUGES = (
+    "serve.queue_depth",
+)
+
+GAUGE_PREFIXES = ()
+
+# -- histograms -------------------------------------------------------------
+
+HISTOGRAMS = (
+    "serve.batch_occupancy",
+    "serve.queue_wait_ms",
+    "serve.solve_ms",
+    "serve.surrogate.residual",
+)
+
+#: per-bucket occupancy distributions: serve.occupancy.b<bucket>
+HISTOGRAM_PREFIXES = (
+    "serve.occupancy.b",
+)
+
+# -- events -----------------------------------------------------------------
+
+EVENTS = (
+    "bench_batch_eff",
+    "bench_config",
+    "bench_serve",
+    "bench_start",
+    "bench_summary",
+    "bench_surrogate",
+    "checkpoint.resume",
+    "checkpoint.save",
+    "checkpoint.save_failed",
+    "cluster_reject",
+    "driver.interrupted",
+    "driver.reexec",
+    "driver.reexec_failed",
+    "driver.retry",
+    "flame",
+    "odeint",
+    "rescue",
+    "schedule.adjust",
+    "schedule.compaction",
+    "schedule.plan",
+    "serve.batch",
+    "serve.batch_error",
+    "serve.close_timeout",
+    "serve.demux_error",
+    "serve.drain",
+    "serve.rescue",
+    "serve.transport.drain",
+    "serve.worker_crashed",
+    "solve",
+    "staging.cache_corrupt",
+    "staging.cache_error",
+    "staging.failed",
+    "supervisor.backend_lost",
+    "supervisor.drain",
+    "supervisor.kill_report",
+    "supervisor.kill_report_failed",
+    "supervisor.respawn_exhausted",
+    "supervisor.spawn",
+    "trace.span",
+)
+
+EVENT_PREFIXES = ()
+
+# -- timers (recorder.section blocks) ---------------------------------------
+
+TIMERS = ()
+
+TIMER_PREFIXES = ()
+
+# -- trace spans ------------------------------------------------------------
+
+SPANS = (
+    "client.wire",
+    "rescue.rung",
+    "serve.admission",
+    "serve.batch_window",
+    "serve.dispatch",
+    "serve.expired",
+    "serve.rescue_rung",
+    "serve.surrogate",
+    "supervisor.backend_lost",
+    "supervisor.resubmit",
+)
+
+SPAN_PREFIXES = ()
+
+__all__ = [
+    "COUNTERS", "COUNTER_PREFIXES", "GAUGES", "GAUGE_PREFIXES",
+    "HISTOGRAMS", "HISTOGRAM_PREFIXES", "EVENTS", "EVENT_PREFIXES",
+    "TIMERS", "TIMER_PREFIXES", "SPANS", "SPAN_PREFIXES",
+]

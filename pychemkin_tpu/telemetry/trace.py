@@ -71,6 +71,7 @@ import time
 import uuid
 from typing import Any, Dict, Iterable, List, Optional
 
+from .. import knobs
 from .sink import read_jsonl
 
 #: sampling probability env knob (see module docstring)
@@ -97,15 +98,10 @@ def resolve_trace_id(trace_id) -> Optional[str]:
 
 def sample_rate() -> float:
     """The configured sampling probability, clamped to [0, 1]
-    (unparseable values fall back to the default 1.0)."""
-    raw = os.environ.get(TRACE_SAMPLE_ENV)
-    if not raw:
-        return 1.0
-    try:
-        rate = float(raw)
-    except ValueError:
-        return 1.0
-    return min(max(rate, 0.0), 1.0)
+    (unparseable values fall back to the default 1.0). Read through
+    the knob registry PER CALL, so a live process is re-sampled via
+    its environment without restart."""
+    return knobs.value(TRACE_SAMPLE_ENV)
 
 
 def new_trace_id() -> Optional[str]:

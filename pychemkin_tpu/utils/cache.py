@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import os
 
+from .. import knobs
+
 def _default_dir() -> str:
     """Repo-local ``.jax_cache`` when the package's parent is writable
     (the development/driver layout); otherwise a per-user cache dir so a
@@ -95,7 +97,7 @@ def enable_compilation_cache(cache_dir: str | None = None,
         # explicit partition from a backend-verified caller does
         return None
     if cache_dir is None:
-        cache_dir = os.environ.get("PYCHEMKIN_CACHE_DIR")
+        cache_dir = knobs.value("PYCHEMKIN_CACHE_DIR")
     if cache_dir is None:
         env = partition or _env_fingerprint()
         cache_dir = os.path.join(_default_dir(), env)

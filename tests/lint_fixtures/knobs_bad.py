@@ -1,0 +1,42 @@
+"""Positive fixtures for the env-knob registry rules.
+
+Every read of a ``PYCHEMKIN_*`` environment name in this file is a
+``knob-raw-env-read`` violation (knobs.py holds the only legal read
+sites), covering each read shape the rule resolves; the last function
+is a ``knob-unregistered`` violation.
+"""
+
+import os
+from os import environ
+
+from pychemkin_tpu import knobs
+
+SCHEDULE_ENV = "PYCHEMKIN_SCHEDULE"
+
+
+def direct_get():
+    return os.environ.get("PYCHEMKIN_SCHEDULE")      # knob-raw-env-read
+
+
+def getenv_read():
+    return os.getenv("PYCHEMKIN_ROP_MODE", "auto")   # knob-raw-env-read
+
+
+def aliased_get():
+    return environ.get("PYCHEMKIN_STAGING_DIR")      # knob-raw-env-read
+
+
+def const_indirection():
+    return os.environ.get(SCHEDULE_ENV)              # knob-raw-env-read
+
+
+def subscript_read():
+    return os.environ["PYCHEMKIN_CACHE_DIR"]         # knob-raw-env-read
+
+
+def membership_read():
+    return "PYCHEMKIN_NO_CACHE" in os.environ        # knob-raw-env-read
+
+
+def unregistered_knob():
+    return knobs.value("PYCHEMKIN_NOT_A_KNOB")       # knob-unregistered

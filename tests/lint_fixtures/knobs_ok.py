@@ -1,0 +1,33 @@
+"""Negative fixtures for the env-knob registry rules.
+
+Registry reads, environment WRITES (test harnesses configure children
+through the env — writes stay legal), underscore-prefixed process
+stamps, and non-PYCHEMKIN names are all allowed.
+"""
+
+import os
+
+from pychemkin_tpu import knobs
+
+
+def registered_read():
+    return knobs.value("PYCHEMKIN_SCHEDULE")
+
+
+def registered_raw():
+    return knobs.raw("PYCHEMKIN_FAULTS")
+
+
+def env_writes():
+    os.environ["PYCHEMKIN_SCHEDULE"] = "sorted"      # writes are legal
+    os.environ.pop("PYCHEMKIN_SCHEDULE", None)       # so are deletes
+
+
+def internal_stamp():
+    # underscore-prefixed process stamps are deliberately not knobs
+    return os.environ.get("_PYCHEMKIN_SUITE_CHILD")
+
+
+def bench_harness_knob():
+    # BENCH_* harness knobs live outside the registry
+    return os.environ.get("BENCH_REPEATS", "1")

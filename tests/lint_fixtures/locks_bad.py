@@ -1,0 +1,42 @@
+"""Positive fixtures for the lock-discipline rules.
+
+The module spawns threads, so every write to a ``# guarded-by:``
+annotated attribute outside its ``with`` block is a ``lock-guard``
+violation (plain/aug assignment, in-place mutator call, subscript
+store); the dangling comment in ``Orphaned`` is a
+``lock-annotation-orphan``.
+"""
+
+import threading
+
+
+def _work():
+    pass
+
+
+class Worker:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0                  # guarded-by: _lock
+        self._items = []                 # guarded-by: _lock
+        self._thread = threading.Thread(target=_work)
+
+    def bump_unlocked(self):
+        self._count += 1                 # VIOLATION: aug-assign, no lock
+
+    def mutate_unlocked(self):
+        self._items.append(1)            # VIOLATION: mutator, no lock
+        self._items[0] = 2               # VIOLATION: subscript, no lock
+
+    def locked_ok(self):
+        with self._lock:
+            self._count += 1
+
+
+class Orphaned:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self.value = _work()

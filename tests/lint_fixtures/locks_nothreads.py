@@ -1,0 +1,15 @@
+"""Single-threaded module: ``lock-guard`` must stay silent.
+
+The annotation convention is meaningful only where threads (or locks)
+exist — this module creates neither, so the unlocked write below is
+NOT a violation even though the attribute carries an annotation.
+"""
+
+
+class Sequential:
+
+    def __init__(self):
+        self._count = 0                  # guarded-by: _lock
+
+    def bump(self):
+        self._count += 1                 # no threads here: allowed

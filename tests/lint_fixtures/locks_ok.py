@@ -1,0 +1,32 @@
+"""Negative fixtures for the lock-discipline rule.
+
+All writes to guarded attributes sit inside the named ``with`` block,
+or inside ``__init__`` (construction happens-before any thread can
+see the object).
+"""
+
+import threading
+
+
+def _work():
+    pass
+
+
+class Worker:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0                  # guarded-by: _lock
+        self._items = []                 # guarded-by: _lock
+        self._count = 1                  # __init__ writes are exempt
+        self._thread = threading.Thread(target=_work)
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+            self._items.append(self._count)
+
+    def reset(self):
+        with self._lock:
+            self._items.clear()
+            self._count = 0

@@ -1,0 +1,13 @@
+"""``todo-on-upgrade`` marker fixtures: the broken and the inert.
+
+The first marker names a distribution that is not installed, so its
+condition cannot be evaluated and it is SKIPPED; the second is
+syntactically broken, which is its own violation (a TODO that can
+never fire is worse than none).
+"""
+
+# chemlint: todo-on-upgrade(chemlint-not-a-real-dist>=9.9): skipped, dist absent
+UNEVALUABLE = 1
+
+# chemlint: todo-on-upgrade jax 0.6 remove the shim
+MALFORMED = 2

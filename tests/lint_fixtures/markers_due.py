@@ -1,0 +1,9 @@
+"""A well-formed version-gated TODO for the marker-rule tests.
+
+The tests monkeypatch the analyzer's installed-version probe: below
+the bound the marker is silent, at/above it the marker becomes a
+``todo-on-upgrade`` violation.
+"""
+
+# chemlint: todo-on-upgrade(chemlint-fake-dist>=1.0): drop the compatibility shim
+SHIM = object()

@@ -1,0 +1,16 @@
+"""Suppression-directive fixtures.
+
+A reasoned suppression silences the violation on its line; a
+reasonless one is itself a ``suppress-needs-reason`` violation AND
+leaves the underlying violation standing.
+"""
+
+import os
+
+
+def suppressed_with_reason():
+    return os.environ.get("PYCHEMKIN_SCHEDULE")  # chemlint: disable=knob-raw-env-read -- fixture: demonstrates a reasoned suppression
+
+
+def suppressed_without_reason():
+    return os.environ.get("PYCHEMKIN_ROP_MODE")  # chemlint: disable=knob-raw-env-read

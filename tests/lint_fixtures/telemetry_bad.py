@@ -1,0 +1,16 @@
+"""Positive fixtures for the telemetry-schema rules.
+
+Every literal name at an emit site here is absent from the canonical
+schema (``pychemkin_tpu/telemetry/schema.py``) — six
+``telemetry-unknown-name`` violations covering counters, gauges,
+histograms, events, spans, and an unregistered dynamic-prefix family.
+"""
+
+
+def emit_bad(rec, tid, bucket):
+    rec.inc("serve.requets")                   # typo of serve.requests
+    rec.gauge("serve.queue_depht", 3)          # typo of serve.queue_depth
+    rec.observe("serve.solve_sec", 1.0)        # unknown histogram
+    rec.event("serve.unheard_of_event")        # unknown event
+    rec.inc(f"bogus.family.{bucket}")          # unregistered prefix
+    emit_span(rec, tid, "serve.unknown_span")  # unknown span  # noqa: F821

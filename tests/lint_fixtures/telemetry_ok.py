@@ -1,0 +1,22 @@
+"""Negative fixtures for the telemetry-schema rules.
+
+Exact schema names, module-constant indirection, registered dynamic
+prefixes (plain f-string and literal-conditional forms), and
+non-literal names (variables — the schema module is their source) are
+all derivable and must not be flagged.
+"""
+
+SOLVE_MS = "serve.solve_ms"
+
+
+def emit_ok(rec, tid, status, bucket):
+    rec.inc("serve.requests")                      # exact counter
+    rec.inc(f"odeint.status.{status}")             # registered prefix
+    rec.inc("serve.requests" if status
+            else "serve.rejected")                 # both arms exact
+    rec.gauge("serve.queue_depth", 0)              # exact gauge
+    rec.observe(SOLVE_MS, 2.5)                     # const indirection
+    rec.observe(f"serve.occupancy.b{bucket}", 1)   # histogram prefix
+    rec.event("serve.batch", n=1)                  # exact event
+    rec.inc("serve.status." + status)              # non-literal: skipped
+    emit_span(rec, tid, "serve.dispatch", ms=1.0)  # exact span  # noqa: F821

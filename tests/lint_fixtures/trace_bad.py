@@ -1,0 +1,48 @@
+"""Positive fixtures for the trace-safety rules.
+
+Every construct in this file is a violation chemlint must flag. The
+file is PARSED by the analyzer tests, never imported or executed —
+the jax calls here never run.
+"""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def branch_on_traced(x, n):
+    if x > 0:                            # trace-py-branch (if)
+        return x
+    while n:                             # trace-py-branch (while)
+        n = n - 1
+    return n
+
+
+@jax.jit
+def concretize(x):
+    a = float(x)                         # trace-concretize float()
+    b = x.item()                         # trace-concretize .item()
+    c = np.asarray(x)                    # trace-concretize np.asarray
+    return a + b + c.sum()
+
+
+def rebuild_per_iteration(points, fn):
+    out = []
+    for p in points:
+        out.append(jax.jit(fn)(p))       # jit-in-loop
+    return out
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def unhashable_static(x, cfg=[1, 2]):    # jit-static-unhashable
+    return x
+
+
+_TABLE = {"a": 1}
+
+
+@jax.jit
+def closes_over_mutable(x):
+    return x + _TABLE["a"]               # jit-mutable-global

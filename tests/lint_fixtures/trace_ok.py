@@ -1,0 +1,45 @@
+"""Negative fixtures for the trace-safety rules.
+
+Every construct in this file is a known-safe idiom the analyzer must
+NOT flag: static shape/dtype branches, ``is None`` dispatch, branches
+on statically-marked parameters, jit hoisted out of the loop, and
+closures over immutable module globals.
+"""
+
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def static_shape_branch(x):
+    if x.shape[0] > 4:                   # .shape is static under tracing
+        return x[:4]
+    return x
+
+
+@jax.jit
+def none_dispatch(x, aux=None):
+    if aux is None:                      # identity check: python-level
+        return x
+    return x + aux
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_arg_branch(x, mode="fast"):
+    if mode == "fast":                   # `mode` is a static argument
+        return x
+    return 2 * x
+
+
+def hoisted(points, fn):
+    jf = jax.jit(fn)                     # built once, outside the loop
+    return [jf(p) for p in points]
+
+
+_FROZEN = ("a", "b")
+
+
+@jax.jit
+def reads_immutable(x):
+    return x if len(_FROZEN) else -x     # tuple global: not mutable

@@ -54,6 +54,13 @@ Behaviour:
   CI on CPU this way; the file's deterministic tests scrub the env var
   themselves (autouse fixture), so the canned spec cannot leak into
   them;
+- ``--lint`` runs the chemlint static-analysis ratchet
+  (``pychemkin_tpu/lint``, importlib-loaded STANDALONE like the
+  summary sink — this orchestrator never imports jax) BEFORE the
+  pytest children: any new violation against
+  ``tests/lint_baseline.json`` fails the suite immediately, naming
+  the rule, file, and line. ``--lint-only`` stops after the analyzer
+  (the fast CI pre-gate);
 - under ``--chaos`` the children also get ``PYCHEMKIN_KILL_REPORT_DIR``
   (a fresh temp dir unless the caller exported one), and after the run
   the suite ASSERTS at least one ``kill_report*.json`` artifact exists
@@ -125,6 +132,39 @@ def _sink_module():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _lint_module():
+    """``pychemkin_tpu.lint`` loaded STANDALONE as a package (spec
+    with submodule search locations, so its relative imports resolve)
+    — same contract as the sink: the orchestrator never imports the
+    jax-importing package ``__init__``. The analyzer is stdlib-ast
+    only, so the whole lint pass costs ~2 s of pure parsing."""
+    import importlib.util
+
+    pkg_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pychemkin_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        "_run_suite_chemlint", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_run_suite_chemlint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_lint() -> int:
+    """The chemlint ratchet gate: returns the analyzer's exit code
+    (0 clean, 1 new violations / stale baseline, 2 setup error)."""
+    try:
+        rc = _lint_module().main([])
+    except Exception as exc:  # noqa: BLE001 — a broken analyzer FAILS
+        print(f"# run_suite: chemlint crashed: "
+              f"{type(exc).__name__}: {exc}", flush=True)
+        return 2
+    print(f"# run_suite: chemlint rc={rc}", flush=True)
+    return rc
 
 #: the --faults default injection spec: element 1 gets a NaN RHS that
 #: heals at rescue rung 1 — exercised by the env-gated tests of
@@ -227,8 +267,21 @@ def main(argv=None):
     stop_on_fail = any(a in ("-x", "--exitfirst") for a in argv)
     faults = "--faults" in argv
     chaos = "--chaos" in argv
-    if faults or chaos:
-        argv = [a for a in argv if a not in ("--faults", "--chaos")]
+    lint = "--lint" in argv
+    lint_only = "--lint-only" in argv
+    if faults or chaos or lint or lint_only:
+        argv = [a for a in argv
+                if a not in ("--faults", "--chaos", "--lint",
+                             "--lint-only")]
+    if lint or lint_only:
+        # the static-analysis ratchet runs BEFORE any pytest child: a
+        # new violation fails the suite immediately, naming the rule,
+        # file, and line (importlib-standalone — no jax import here)
+        lint_rc = _run_lint()
+        if lint_rc != 0:
+            return lint_rc
+        if lint_only:
+            return 0
     summary_json = None
     if "--summary-json" in argv:
         i = argv.index("--summary-json")

@@ -1,0 +1,539 @@
+"""chemlint: the analyzer's own test suite (ISSUE 13).
+
+Fast-lane placement is deliberate: this file sorts near the top of the
+test alphabet and never imports jax — the lint package is loaded
+STANDALONE via importlib (same contract as ``tests/run_suite.py``), so
+the whole file is pure-AST work and the live-tree ratchet gate below
+always lands inside the suite's wall-clock cap.
+
+Covers:
+
+- every rule family against the positive/negative fixtures in
+  ``tests/lint_fixtures/``;
+- the suppression directive (reason required) and version-gated
+  ``todo-on-upgrade`` markers (including the live jax shard_map shim);
+- the baseline-ratchet engine (new fails, baselined passes, fixed
+  demands a shrink) and its CLI loop on a scratch repo copy;
+- the ISSUE 13 acceptance injections: a raw ``PYCHEMKIN_*`` env read,
+  an unregistered counter at an emit site, and a guarded-attribute
+  write outside its lock each make the analyzer exit non-zero naming
+  the rule, file, and line;
+- static regressions for the real lock-discipline fixes the rule
+  turned up in the serve layer.
+"""
+
+import contextlib
+import importlib.util
+import json
+import os
+import re
+import shutil
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "lint_fixtures")
+
+SUPERVISOR = "pychemkin_tpu/serve/supervisor.py"
+SERVER = "pychemkin_tpu/serve/server.py"
+TRANSPORT = "pychemkin_tpu/serve/transport.py"
+RECORDER = "pychemkin_tpu/telemetry/recorder.py"
+SHARDING = "pychemkin_tpu/parallel/sharding.py"
+
+
+def _load_lint():
+    """The lint package loaded standalone (no ``pychemkin_tpu``
+    package import, hence no jax) — the run_suite orchestrator
+    contract, exercised here as well as relied on."""
+    name = "_test_chemlint_pkg"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(REPO, "pychemkin_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+LINT = _load_lint()
+
+
+def _lint_fix(*names):
+    return LINT.lint_tree(
+        REPO, files=[os.path.join(FIXDIR, n) for n in names])
+
+
+def _by_rule(violations):
+    out = {}
+    for v in violations:
+        out.setdefault(v.rule, []).append(v)
+    return out
+
+
+def _probe_lines(fixture, needle):
+    """1-based line numbers of a fixture's marked probe lines."""
+    path = os.path.join(FIXDIR, fixture)
+    with open(path, "r", encoding="utf-8") as fh:
+        return [i for i, ln in enumerate(fh, start=1) if needle in ln]
+
+
+# -- rule families against the fixtures -------------------------------------
+
+class TestTraceRules:
+
+    def test_bad_fixture_flags_every_hazard(self):
+        by = _by_rule(_lint_fix("trace_bad.py"))
+        assert len(by.get("trace-py-branch", [])) == 2
+        assert len(by.get("trace-concretize", [])) == 3
+        assert len(by.get("jit-in-loop", [])) == 1
+        assert len(by.get("jit-static-unhashable", [])) == 1
+        assert len(by.get("jit-mutable-global", [])) == 1
+        assert sum(len(v) for v in by.values()) == 8
+
+    def test_violations_carry_file_and_line(self):
+        for v in _lint_fix("trace_bad.py"):
+            assert v.path == "tests/lint_fixtures/trace_bad.py"
+            assert v.line > 0
+            assert f":{v.line}:" in v.render()
+
+    def test_branch_names_the_function_and_fix(self):
+        (v,) = [v for v in _lint_fix("trace_bad.py")
+                if v.rule == "trace-py-branch"
+                and v.line in _probe_lines("trace_bad.py",
+                                           "trace-py-branch (if)")]
+        assert "branch_on_traced" in v.message
+        assert "lax.cond" in v.message
+
+    def test_ok_fixture_is_clean(self):
+        assert _lint_fix("trace_ok.py") == []
+
+
+class TestKnobRules:
+
+    def test_bad_fixture_flags_every_read_shape(self):
+        by = _by_rule(_lint_fix("knobs_bad.py"))
+        raws = by.get("knob-raw-env-read", [])
+        assert len(raws) == 6
+        expected = set(_probe_lines("knobs_bad.py",
+                                    "# knob-raw-env-read"))
+        assert {v.line for v in raws} == expected
+        (unreg,) = by.get("knob-unregistered", [])
+        assert "PYCHEMKIN_NOT_A_KNOB" in unreg.message
+
+    def test_ok_fixture_is_clean(self):
+        assert _lint_fix("knobs_ok.py") == []
+
+    def test_ast_registry_matches_runtime_registry(self):
+        """The lint's AST extraction of knobs.py and the standalone-
+        loaded module must agree on the registered names."""
+        ctx = LINT.LintContext(REPO, [], full=False)
+        ast_names = LINT.rules_knobs.registered_knob_names(ctx)
+        runtime = LINT.rules_knobs.load_knobs_module(REPO)
+        assert ast_names == set(runtime.names())
+        assert "PYCHEMKIN_TRACE_SAMPLE" in ast_names
+
+
+class TestTelemetryRules:
+
+    def test_bad_fixture_flags_every_category(self):
+        vs = _lint_fix("telemetry_bad.py")
+        assert {v.rule for v in vs} == {"telemetry-unknown-name"}
+        assert len(vs) == 6
+        blob = "\n".join(v.message for v in vs)
+        for name in ("serve.requets", "serve.queue_depht",
+                     "serve.solve_sec", "serve.unheard_of_event",
+                     "serve.unknown_span"):
+            assert name in blob
+        (dyn,) = [v for v in vs if "bogus.family." in v.message]
+        assert "matches no registered prefix" in dyn.message
+
+    def test_ok_fixture_is_clean(self):
+        assert _lint_fix("telemetry_ok.py") == []
+
+
+class TestLockRules:
+
+    def test_bad_fixture_flags_unlocked_writes(self):
+        by = _by_rule(_lint_fix("locks_bad.py"))
+        guards = by.get("lock-guard", [])
+        assert len(guards) == 3
+        assert {v.line for v in guards} == set(
+            _probe_lines("locks_bad.py", "# VIOLATION"))
+        for v in guards:
+            assert "with _lock" in v.message
+        (orphan,) = by.get("lock-annotation-orphan", [])
+        assert sum(len(v) for v in by.values()) == 4
+
+    def test_ok_fixture_is_clean(self):
+        assert _lint_fix("locks_ok.py") == []
+
+    def test_threadless_module_is_exempt(self):
+        assert _lint_fix("locks_nothreads.py") == []
+
+
+class TestSuppressions:
+
+    def test_reason_silences_reasonless_fails(self):
+        by = _by_rule(_lint_fix("suppress.py"))
+        # the reasoned suppression silenced its violation entirely
+        (needs,) = by.get("suppress-needs-reason", [])
+        (raw,) = by.get("knob-raw-env-read", [])
+        # ...and the reasonless line keeps the underlying violation
+        assert raw.line == needs.line
+        assert sum(len(v) for v in by.values()) == 2
+
+
+class TestUpgradeMarkers:
+
+    def test_malformed_marker_is_a_violation(self):
+        (v,) = _lint_fix("markers_bad.py")
+        assert v.rule == "todo-on-upgrade"
+        assert "malformed" in v.message
+
+    def test_due_marker_fires_only_at_the_bound(self, monkeypatch):
+        assert _lint_fix("markers_due.py") == []   # dist not installed
+        monkeypatch.setattr(LINT.rules_markers, "_installed_version",
+                            lambda dist: "0.9.9")
+        assert _lint_fix("markers_due.py") == []   # below the bound
+        monkeypatch.setattr(LINT.rules_markers, "_installed_version",
+                            lambda dist: "1.2.0")
+        (v,) = _lint_fix("markers_due.py")
+        assert v.rule == "todo-on-upgrade"
+        assert "upgrade TODO is due" in v.message
+        assert "compatibility shim" in v.message
+
+    def test_live_shard_map_shim_marker(self, monkeypatch):
+        """ISSUE 13 carried-forward: the jax 0.4.x shard_map shim in
+        parallel/sharding.py is tagged, silent on this image, and
+        surfaces the moment the image moves to jax >= 0.6."""
+        with open(os.path.join(REPO, SHARDING), encoding="utf-8") as fh:
+            src = fh.read()
+        assert "todo-on-upgrade(jax>=0.6)" in src
+        live = LINT.lint_tree(REPO, files=[os.path.join(REPO, SHARDING)])
+        assert [v for v in live if v.rule == "todo-on-upgrade"] == []
+        monkeypatch.setattr(
+            LINT.rules_markers, "_installed_version",
+            lambda dist: "0.6.2" if dist == "jax" else None)
+        (v,) = [v for v in LINT.lint_tree(
+            REPO, files=[os.path.join(REPO, SHARDING)])
+            if v.rule == "todo-on-upgrade"]
+        assert "shard_map" in v.message
+
+
+class TestKnobRegistrySemantics:
+    """The registry preserves each migrated site's historical empty/
+    invalid-value behavior (jax-free: knobs.py loads standalone)."""
+
+    @pytest.fixture(autouse=True)
+    def _knobs(self):
+        self.knobs = LINT.rules_knobs.load_knobs_module(REPO)
+
+    def test_unset_and_blank_fall_back_to_default(self, monkeypatch):
+        monkeypatch.delenv("PYCHEMKIN_TELEMETRY_EVENTS_CAP",
+                           raising=False)
+        assert self.knobs.value("PYCHEMKIN_TELEMETRY_EVENTS_CAP") \
+            == 4096
+        monkeypatch.setenv("PYCHEMKIN_TELEMETRY_EVENTS_CAP", "")
+        assert self.knobs.value("PYCHEMKIN_TELEMETRY_EVENTS_CAP") \
+            == 4096
+
+    def test_strict_knobs_reject_set_but_empty(self, monkeypatch):
+        # a set-but-empty A/B switch (an unexpanded shell variable)
+        # silently running the default would fake the A/B
+        monkeypatch.setenv("PYCHEMKIN_SCHEDULE", "")
+        with pytest.raises(ValueError, match="PYCHEMKIN_SCHEDULE"):
+            self.knobs.value("PYCHEMKIN_SCHEDULE")
+        monkeypatch.setenv("PYCHEMKIN_COMPACT_ROUND", "")
+        with pytest.raises(ValueError,
+                           match="PYCHEMKIN_COMPACT_ROUND"):
+            self.knobs.value("PYCHEMKIN_COMPACT_ROUND")
+
+    def test_rop_mode_keeps_whitespace_tolerance(self, monkeypatch):
+        # historical site: raw.strip().lower() or "auto"
+        monkeypatch.setenv("PYCHEMKIN_ROP_MODE", " ")
+        assert self.knobs.value("PYCHEMKIN_ROP_MODE") == "auto"
+        monkeypatch.setenv("PYCHEMKIN_ROP_MODE", "Dense")
+        assert self.knobs.value("PYCHEMKIN_ROP_MODE") == "dense"
+        monkeypatch.setenv("PYCHEMKIN_ROP_MODE", "weird")
+        with pytest.raises(ValueError, match="PYCHEMKIN_ROP_MODE"):
+            self.knobs.value("PYCHEMKIN_ROP_MODE")
+
+    def test_observability_fallbacks_stay_silent(self, monkeypatch):
+        monkeypatch.setenv("PYCHEMKIN_TRACE_SAMPLE", "garbage")
+        assert self.knobs.value("PYCHEMKIN_TRACE_SAMPLE") == 1.0
+        monkeypatch.setenv("PYCHEMKIN_TRACE_SAMPLE", "7")
+        assert self.knobs.value("PYCHEMKIN_TRACE_SAMPLE") == 1.0
+        monkeypatch.setenv("PYCHEMKIN_TELEMETRY_EVENTS_CAP", "junk")
+        assert self.knobs.value("PYCHEMKIN_TELEMETRY_EVENTS_CAP") \
+            == 4096
+
+    def test_unregistered_name_raises(self):
+        with pytest.raises(KeyError, match="PYCHEMKIN_NOPE"):
+            self.knobs.value("PYCHEMKIN_NOPE")
+        with pytest.raises(KeyError, match="PYCHEMKIN_NOPE"):
+            self.knobs.raw("PYCHEMKIN_NOPE")
+
+
+# -- ratchet engine ----------------------------------------------------------
+
+def _v(rule="knob-raw-env-read", path="pkg/mod.py", line=3):
+    return LINT.Violation(rule, path, line, "msg")
+
+
+class TestRatchetEngine:
+
+    def test_new_violation_fails(self):
+        new, stale = LINT.engine.compare_to_baseline([_v()], {})
+        assert new == [_v()] and stale == []
+
+    def test_baselined_violation_passes(self):
+        new, stale = LINT.engine.compare_to_baseline(
+            [_v()], {"knob-raw-env-read": {"pkg/mod.py": 1}})
+        assert new == [] and stale == []
+
+    def test_fixed_violation_demands_shrink(self):
+        new, stale = LINT.engine.compare_to_baseline(
+            [], {"knob-raw-env-read": {"pkg/mod.py": 1}})
+        assert new == []
+        (msg,) = stale
+        assert "shrink the baseline" in msg
+
+    def test_partial_fix_also_demands_shrink(self):
+        new, stale = LINT.engine.compare_to_baseline(
+            [_v()], {"knob-raw-env-read": {"pkg/mod.py": 2}})
+        assert new == [] and len(stale) == 1
+
+    def test_extra_violation_reports_whole_rule_file_group(self):
+        vs = [_v(line=3), _v(line=9)]
+        new, _ = LINT.engine.compare_to_baseline(
+            vs, {"knob-raw-env-read": {"pkg/mod.py": 1}})
+        # count-ratchet: the injected one is among those listed
+        assert new == sorted(vs)
+
+    def test_baseline_roundtrip_and_version_gate(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        LINT.engine.write_baseline(path, [_v(), _v(line=9)])
+        assert LINT.engine.load_baseline(path) == {
+            "knob-raw-env-read": {"pkg/mod.py": 2}}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"version": 99, "counts": {}}, fh)
+        try:
+            LINT.engine.load_baseline(path)
+        except ValueError as exc:
+            assert "unsupported version" in str(exc)
+        else:
+            raise AssertionError("version gate did not trip")
+
+
+# -- the live tree ------------------------------------------------------------
+
+class TestLiveTree:
+
+    def test_live_tree_matches_baseline(self, capsys):
+        """THE tier-1 ratchet gate: the shipped tree must be clean
+        against the committed baseline (AST-only; ~2 s)."""
+        rc = LINT.main([])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 new violations" in out
+
+    def test_baseline_is_committed(self):
+        with open(os.path.join(REPO, "tests", "lint_baseline.json"),
+                  encoding="utf-8") as fh:
+            payload = json.load(fh)
+        assert payload["version"] == 1
+
+    def test_write_baseline_refuses_explicit_paths(self, capsys):
+        with pytest.raises(SystemExit):
+            LINT.main(["--write-baseline",
+                       os.path.join(FIXDIR, "knobs_bad.py")])
+        assert "cannot be combined" in capsys.readouterr().err
+
+    def test_render_knobs_matches_readme_block(self, capsys):
+        rc = LINT.main(["--render-knobs"])
+        assert rc == 0
+        table = capsys.readouterr().out.strip("\n")
+        knobs = LINT.rules_knobs.load_knobs_module(REPO)
+        with open(os.path.join(REPO, "README.md"),
+                  encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+        begin = lines.index(knobs.TABLE_BEGIN)
+        end = lines.index(knobs.TABLE_END)
+        assert "\n".join(lines[begin + 1:end]).strip("\n") == table
+
+
+# -- regressions for the real lock fixes (serve layer) -----------------------
+
+def _guarded(relpath):
+    mod = LINT.engine.ModuleInfo(REPO, os.path.join(REPO, relpath))
+    return mod.guarded_attrs()
+
+
+def _lock_hits(relpath, attr):
+    mod = LINT.engine.ModuleInfo(REPO, os.path.join(REPO, relpath))
+    walker = LINT.rules_locks._Walker(mod.guarded_attrs())
+    walker.walk(mod.tree, set(), ())
+    return [h for h in walker.hits if h[0] == attr]
+
+
+class TestLockFixRegressions:
+    """Each genuine race the lock-discipline rule turned up stays
+    fixed: the attribute stays annotated AND every write sits inside
+    its lock — deleting either the annotation or the ``with`` re-fails
+    these tests directly, independent of the ratchet baseline."""
+
+    def test_supervisor_heartbeat_stamp_writes_locked(self):
+        # fix: _heartbeat_loop stamped _last_pong unlocked while the
+        # monitor's kill report read it under the lock
+        assert _guarded(SUPERVISOR)["_last_pong"][0] == "_lock"
+        assert _lock_hits(SUPERVISOR, "_last_pong") == []
+
+    def test_supervisor_loss_counters_locked(self):
+        # fix: _lost_requests / _resubmits were unlocked += read-
+        # modify-writes racing stats() snapshots
+        for attr in ("_lost_requests", "_resubmits", "_respawns"):
+            assert _guarded(SUPERVISOR)[attr][0] == "_lock"
+            assert _lock_hits(SUPERVISOR, attr) == []
+
+    def test_server_close_flag_flipped_under_lock(self):
+        # fix: close() flipped _closed outside the lock start() takes
+        # to check it — the race could leak worker threads
+        assert _guarded(SERVER)["_closed"][0] == "_lock"
+        assert _lock_hits(SERVER, "_closed") == []
+
+    def test_transport_and_recorder_annotations_live(self):
+        assert _guarded(TRANSPORT)["_pending"][0] == "_plock"
+        assert _guarded(TRANSPORT)["inflight"][0] == "_quota_lock"
+        rec = _guarded(RECORDER)
+        assert rec["counters"][0] == "_lock"
+        assert rec["_events"][0] == "_event_lock"
+
+    def test_serve_layer_is_lock_clean(self):
+        files = [os.path.join(REPO, p) for p in
+                 (SUPERVISOR, SERVER, TRANSPORT, RECORDER)]
+        vs = LINT.lint_tree(REPO, files=files)
+        assert [v for v in vs if v.rule == "lock-guard"] == []
+
+
+# -- acceptance injections on a scratch copy ---------------------------------
+
+def _make_scratch(tmp_path):
+    """*.py mirror of pychemkin_tpu plus README and the committed
+    baseline — everything a full lint run consults."""
+    src_pkg = os.path.join(REPO, "pychemkin_tpu")
+    for dirpath, dirnames, filenames in os.walk(src_pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        rel = os.path.relpath(dirpath, REPO)
+        os.makedirs(os.path.join(str(tmp_path), rel), exist_ok=True)
+        for fn in filenames:
+            if fn.endswith(".py"):
+                shutil.copy(os.path.join(dirpath, fn),
+                            os.path.join(str(tmp_path), rel, fn))
+    shutil.copy(os.path.join(REPO, "README.md"),
+                os.path.join(str(tmp_path), "README.md"))
+    os.makedirs(os.path.join(str(tmp_path), "tests"), exist_ok=True)
+    shutil.copy(os.path.join(REPO, "tests", "lint_baseline.json"),
+                os.path.join(str(tmp_path), "tests",
+                             "lint_baseline.json"))
+    return str(tmp_path)
+
+
+@contextlib.contextmanager
+def _appended(path, text):
+    with open(path, "r", encoding="utf-8") as fh:
+        orig = fh.read()
+    n_lines = orig.count("\n")
+    try:
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(text)
+        yield n_lines
+    finally:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(orig)
+
+
+def _expect_named_failure(capsys, scratch, rule, relpath, after_line):
+    rc = LINT.main(["--root", scratch])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    m = re.search(rf"{re.escape(rule)}: {re.escape(relpath)}:(\d+):",
+                  out)
+    assert m, f"no {rule} finding naming {relpath} in:\n{out}"
+    assert int(m.group(1)) > after_line
+    return out
+
+
+class TestAcceptanceInjections:
+    """ISSUE 13 acceptance: each injected hazard makes the analyzer
+    exit non-zero naming the rule, file, and line."""
+
+    def test_scratch_copy_starts_clean(self, tmp_path, capsys):
+        scratch = _make_scratch(tmp_path)
+        assert LINT.main(["--root", scratch]) == 0
+        capsys.readouterr()
+
+    def test_raw_env_read_injection_and_ratchet_cycle(self, tmp_path,
+                                                      capsys):
+        scratch = _make_scratch(tmp_path)
+        target = os.path.join(scratch,
+                              "pychemkin_tpu/schedule/compaction.py")
+        inject = ("\n\ndef _chemlint_probe():\n"
+                  "    import os\n"
+                  "    return os.getenv(\"PYCHEMKIN_SCHEDULE\")\n")
+        with _appended(target, inject) as n_lines:
+            _expect_named_failure(
+                capsys, scratch, "knob-raw-env-read",
+                "pychemkin_tpu/schedule/compaction.py", n_lines)
+            # ratchet forward: record it, and the run goes green
+            assert LINT.main(["--root", scratch,
+                              "--write-baseline"]) == 0
+            assert LINT.main(["--root", scratch]) == 0
+            out = capsys.readouterr().out
+            assert "1 baselined" in out
+        # the violation is fixed (file restored): the stale baseline
+        # entry now fails until the baseline shrinks
+        assert LINT.main(["--root", scratch]) == 1
+        out = capsys.readouterr().out
+        assert "stale-baseline" in out
+        assert LINT.main(["--root", scratch, "--write-baseline"]) == 0
+        assert LINT.main(["--root", scratch]) == 0
+        capsys.readouterr()
+
+    def test_unregistered_counter_injection(self, tmp_path, capsys):
+        scratch = _make_scratch(tmp_path)
+        target = os.path.join(scratch, SERVER)
+        inject = ("\n\ndef _chemlint_probe(rec):\n"
+                  "    rec.inc(\"serve.typo_counter_xyz\")\n")
+        with _appended(target, inject) as n_lines:
+            out = _expect_named_failure(
+                capsys, scratch, "telemetry-unknown-name", SERVER,
+                n_lines)
+            assert "serve.typo_counter_xyz" in out
+
+    def test_unlocked_guarded_write_injection(self, tmp_path, capsys):
+        scratch = _make_scratch(tmp_path)
+        target = os.path.join(scratch, SUPERVISOR)
+        inject = ("\n\ndef _chemlint_probe(sup):\n"
+                  "    sup._lost_requests += 1\n")
+        with _appended(target, inject) as n_lines:
+            out = _expect_named_failure(
+                capsys, scratch, "lock-guard", SUPERVISOR, n_lines)
+            assert "_lost_requests" in out
+
+    def test_readme_drift_injection(self, tmp_path, capsys):
+        scratch = _make_scratch(tmp_path)
+        readme = os.path.join(scratch, "README.md")
+        with open(readme, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        assert "| `PYCHEMKIN_SCHEDULE` |" in text
+        with open(readme, "w", encoding="utf-8") as fh:
+            fh.write(text.replace("| `PYCHEMKIN_SCHEDULE` |",
+                                  "| `PYCHEMKIN_SCHEDUEL` |"))
+        rc = LINT.main(["--root", scratch])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "knob-readme-drift" in out

@@ -165,3 +165,25 @@ def test_signal_killed_twice_still_fails(tmp_path):
     assert r.returncode == 1
     assert "retrying once" in r.stdout
     assert "FAILED test_always_kill.py rc=-9" in r.stdout
+
+
+def test_lint_only_gate_passes_on_live_tree():
+    """ISSUE 13 satellite: ``run_suite --lint-only`` runs the chemlint
+    ratchet standalone (the orchestrator never imports jax) and exits
+    0 on the shipped tree; no pytest child is spawned."""
+    r = _run(["--lint-only"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "chemlint rc=0" in r.stdout
+    assert "per-file wall time" not in r.stdout
+
+
+def test_lint_runs_before_the_children(tmp_path):
+    """``--lint`` runs the analyzer BEFORE any pytest child: the
+    chemlint line precedes the child run in the suite output."""
+    f_ok = tmp_path / "test_tiny.py"
+    f_ok.write_text("def test_fine():\n    assert True\n")
+    r = _run(["--lint", str(f_ok)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    lint_at = r.stdout.index("chemlint rc=0")
+    child_at = r.stdout.index("test_tiny.py")
+    assert lint_at < child_at

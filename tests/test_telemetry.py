@@ -302,6 +302,14 @@ class TestTrace:
         assert trace.new_trace_id() != tid       # ids are unique
         monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "not-a-float")
         assert trace.sample_rate() == 1.0        # unparseable → default
+        # ISSUE 13: the knobs.py registry preserves per-draw re-read
+        # semantics (each call above saw a different env value with no
+        # restart) and the documented clamp to [0, 1]
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "7")
+        assert trace.sample_rate() == 1.0
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "-3")
+        assert trace.sample_rate() == 0.0
+        assert trace.new_trace_id() is None      # clamped-to-0 draw
         monkeypatch.delenv(trace.TRACE_SAMPLE_ENV)
         assert trace.sample_rate() == 1.0
 
