@@ -115,6 +115,16 @@ Environment knobs:
                             caps the static twin's worst lane; capped
                             lanes report BUDGET_EXHAUSTED identically
                             in both twins (n_budget_capped per row)
+  BENCH_PROFILE     "0" disables the profile_overhead rung (default
+                    on): profile-off vs profile-on twins of the same
+                    n_out=2 sweep — the ISSUE-14 bound that
+                    harvesting per-lane physics costs <= 5% and
+                    leaves primal results bitwise identical
+  BENCH_PROFILE_MECH      profile-overhead mechanism (grisyn)
+  BENCH_PROFILE_B         profile-overhead batch size (64)
+  BENCH_PROFILE_REPEATS   timed repetitions per twin (2)
+  BENCH_PROFILE_MAX_STEPS per-element step-attempt budget (20000)
+  BENCH_PROFILE_TIMEOUT   rung subprocess timeout, s (default 900)
   BENCH_CHUNK       max batch elements per compiled call (default 256).
                     Larger B runs as sequential chunks of ONE cached
                     program, so compile time is flat in B, and a single
@@ -188,6 +198,20 @@ def _flop_model(mech, n_steps, n_rejected, n_newton):
     f32 = attempts * (N * c_rhs + (2.0 / 3.0) * N ** 3 + 4 * N * N)
     f64 = (n_newton + attempts) * c_rhs + n_newton * 2 * N * N
     return f32, f64
+
+
+def _calibration_block():
+    """The container-speed microprobe block banked into every rung's
+    JSON (``pychemkin_tpu/utils/calibration.py``): the fingerprint
+    ``tools/perf_ledger.py`` divides out so cross-PR captures
+    compare despite container drift. A failed probe degrades to None
+    — calibration must never take down a rung."""
+    try:
+        from .utils import calibration
+        return calibration.probe()
+    except Exception as exc:  # noqa: BLE001 — artifact, not verdict
+        print(f"# calibration probe failed: {exc}", file=sys.stderr)
+        return None
 
 
 def _cpu_env():
@@ -277,6 +301,11 @@ def _child_config(mech_name: str, B: int, repeats: int):
     # jac_mode/rop_mode: a banked rung says which batch layout it timed
     from . import schedule as _schedule
     schedule_mode = _schedule.resolve_mode()
+    # solve-profile mode the traces in this child actually take
+    # (PYCHEMKIN_SOLVE_PROFILE at trace time) — rung provenance: a
+    # banked rung says whether its timing paid the profile harvest
+    from .ops import odeint as _odeint
+    solve_profile = "on" if _odeint.solve_profile_enabled() else "off"
 
     def sweep(stats=None, job_report=None, checkpoint_path=None):
         return parallel.sharded_ignition_sweep(
@@ -366,6 +395,8 @@ def _child_config(mech_name: str, B: int, repeats: int):
         jac_mode=jac_mode,
         rop_mode=rop_mode,
         schedule=schedule_mode,
+        solve_profile=solve_profile,
+        calibration=_calibration_block(),
         nu_nnz_frac=sparsity["nu_nnz_frac"],
         n_species_active=sparsity["n_species_active"],
         n_failed=rescue_report.n_failed,
@@ -402,7 +433,15 @@ def _child_serve(mech_name: str, n_requests: int, rate_hz: float):
     configuration); ``trace_overhead_pct`` is its p50 relative to the
     untraced pass, and ``trace_stage_breakdown`` is the per-span-name
     p50/p99 of the traced pass — request-level per-stage cost
-    attribution."""
+    attribution.
+
+    A third pass runs the same stream against a SOLVE-PROFILED server
+    (``PYCHEMKIN_SOLVE_PROFILE=1``; fresh jit caches, warmed under
+    the knob): ``profile_overhead_pct`` bounds what harvesting
+    per-lane physics costs the request path (ISSUE-14 bound: <= 5%
+    at the official rung params), and
+    ``n_profiled_dispatch_spans`` counts dispatch spans carrying lane
+    physics — the span-to-fleet acceptance evidence."""
     import jax
     import numpy as np_  # shadow-safe alias (module-level np exists)
 
@@ -469,6 +508,45 @@ def _child_serve(mech_name: str, n_requests: int, rate_hz: float):
                 os.environ.pop(trace_mod.TRACE_SAMPLE_ENV, None)
             else:
                 os.environ[trace_mod.TRACE_SAMPLE_ENV] = saved
+
+    # pass 3 — the SAME stream against a solve-profiled server
+    # (PYCHEMKIN_SOLVE_PROFILE=1): the knob is a trace-time decision,
+    # so a fresh server (fresh jit caches, warmed under the knob)
+    # runs the profiled programs; profile_overhead_pct is its p50 vs
+    # the traced pass 1 — the ISSUE-14 "observing the integration
+    # must not perturb it" bound (<= 5% at the official rung params)
+    from .ops import odeint as odeint_mod
+
+    saved_prof = os.environ.get(odeint_mod.SOLVE_PROFILE_ENV)
+    os.environ[odeint_mod.SOLVE_PROFILE_ENV] = "1"
+    try:
+        rec_prof = telemetry.MetricsRecorder(
+            max_events=max(4096, 8 * n_requests))
+        server_prof = serve.ChemServer(
+            mech, bucket_sizes=(1, 8, 32), max_batch_size=32,
+            max_delay_ms=2.0, queue_depth=1024, recorder=rec_prof,
+            engine_config={"ignition": {"rtol": 1e-6, "atol": 1e-10,
+                                        "max_steps_per_segment":
+                                            4000}})
+        server_prof.warmup(kinds)
+        with server_prof:
+            profiled = loadgen.run_load(
+                server_prof, samplers, rate_hz=rate_hz,
+                n_requests=n_requests,
+                rng=np_.random.default_rng(0),
+                deadline_ms=deadline_ms,
+                trace_events=lambda: rec_prof.events("trace.span"))
+    finally:
+        if saved_prof is None:
+            os.environ.pop(odeint_mod.SOLVE_PROFILE_ENV, None)
+        else:
+            os.environ[odeint_mod.SOLVE_PROFILE_ENV] = saved_prof
+    # at least one dispatch span of the profiled pass must bottom out
+    # in lane physics — the span-to-fleet acceptance evidence
+    n_profiled_spans = sum(
+        1 for ev in rec_prof.events("trace.span")
+        if ev.get("span") == "serve.dispatch"
+        and ev.get("n_newton") is not None)
     breakdown = {
         name: {"count": h.count,
                "p50_ms": round(h.percentile(50.0), 3),
@@ -478,10 +556,18 @@ def _child_serve(mech_name: str, n_requests: int, rate_hz: float):
     overhead_pct = (
         round((p50 - p50_ref) / p50_ref * 100.0, 2)
         if p50 is not None and p50_ref else None)
+    p50_prof = profiled.get("p50_ms")
+    profile_overhead_pct = (
+        round((p50_prof - p50) / p50 * 100.0, 2)
+        if p50_prof is not None and p50 else None)
     print(json.dumps(dict(
         rung="serve_latency", platform=platform, mech=mech_name,
         kinds=kinds, warmup_s=round(warmup_s, 1),
         deadline_ms=deadline_ms,
+        profile_p50_ms=p50_prof,
+        profile_overhead_pct=profile_overhead_pct,
+        n_profiled_dispatch_spans=n_profiled_spans,
+        calibration=_calibration_block(),
         compiles=snap["counters"].get("serve.compiles", 0),
         n_batches=snap["counters"].get("serve.batches", 0),
         n_deadline_expired=snap["counters"].get(
@@ -598,6 +684,7 @@ def _child_surrogate(mech_name: str, n_requests: int, rate_hz: float):
         gate=dict(server.engine("surrogate_ignition").gate._asdict()),
         compiles=snap["counters"].get("serve.compiles", 0),
         residual=snap["histograms"].get("serve.surrogate.residual"),
+        calibration=_calibration_block(),
         **summary)), flush=True)
 
 
@@ -784,8 +871,83 @@ def _child_batch_eff(mech_name: str, bs_csv: str, schedule_mode: str):
             by_B.get(64, {}).get("static_ms_per_elem")),
         answers_match=all_match,
         cohorts=sched_counts["cohorts"],
-        compactions=sched_counts["compactions"])),
+        compactions=sched_counts["compactions"],
+        calibration=_calibration_block())),
         flush=True)
+
+
+def _child_profile_overhead(mech_name: str, B: int):
+    """The profile_overhead rung: the SAME n_out=2 ignition sweep
+    timed with the solve profile off and on (explicit ``profile=``
+    argument — two compiled twins in one process, each warmed on its
+    own program), plus a bitwise primal-equality check between the
+    twins. Prints one JSON line with ``profile_overhead_pct`` — the
+    ISSUE-14 acceptance bound (<= 5% at the official rung params:
+    grisyn B=64) that harvesting per-lane physics does not perturb
+    the integration it observes."""
+    import jax
+    import jax.numpy as jnp
+
+    from .mechanism import load_embedded
+    from .ops import reactors
+
+    (t_lo, t_hi), t_end, rtol, atol = _PROTOCOL[mech_name]
+    devices = jax.devices()
+    platform = devices[0].platform
+    if platform != "cpu":
+        from .utils import enable_compilation_cache
+        enable_compilation_cache(partition="axon")
+    mech = load_embedded(mech_name)
+    Y0 = _stoich_Y0(mech, mech_name)
+    T0s = np.linspace(t_lo, t_hi, B)
+    rng = np.random.default_rng(0)
+    P0s = 1.01325e6 * (1.0 + rng.uniform(0.0, 1.0, B))
+    max_steps = int(os.environ.get("BENCH_PROFILE_MAX_STEPS", 20_000))
+
+    def build(profile):
+        return jax.jit(lambda T, P, te: reactors.ignition_delay_sweep(
+            mech, "CONP", "ENRG", T, P, Y0, te, rtol=rtol, atol=atol,
+            max_steps_per_segment=max_steps, profile=profile))
+
+    fn_off, fn_on = build(False), build(True)
+    args = (jnp.asarray(T0s), jnp.asarray(P0s),
+            jnp.full(B, t_end))
+
+    def timed(fn):
+        t0 = time.time()
+        out = jax.block_until_ready(fn(*args))
+        compile_s = time.time() - t0
+        walls = []
+        for _ in range(int(os.environ.get("BENCH_PROFILE_REPEATS",
+                                          2))):
+            t0 = time.time()
+            out = jax.block_until_ready(fn(*args))
+            walls.append(time.time() - t0)
+        return min(walls), compile_s, out
+
+    run_off, compile_off, out_off = timed(fn_off)
+    run_on, compile_on, out_on = timed(fn_on)
+    overhead_pct = round((run_on - run_off) / run_off * 100.0, 2)
+    # the primal contract, checked on the artifact itself: the
+    # profiled twin's (times, ok, status) must be BIT-identical
+    bit_match = all(
+        np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+        for a, b in zip(out_off, out_on[:3]))
+    prof = out_on[3]
+    print(json.dumps(dict(
+        rung="profile_overhead", platform=platform, mech=mech_name,
+        B=B, t_end=t_end, rtol=rtol, atol=atol,
+        max_steps=max_steps,
+        run_off_s=round(run_off, 3), run_on_s=round(run_on, 3),
+        compile_off_s=round(compile_off, 1),
+        compile_on_s=round(compile_on, 1),
+        profile_overhead_pct=overhead_pct,
+        primal_bit_match=bool(bit_match),
+        n_lanes_profiled=int(np.asarray(prof["n_steps"]).size),
+        dt_min_min=float(np.nanmin(np.asarray(prof["dt_min"]))),
+        stiffness_max=float(np.nanmax(np.asarray(
+            prof["stiffness"]))),
+        calibration=_calibration_block())), flush=True)
 
 
 def _child_baseline(mech_name: str, n_points: int, budget_s: float):
@@ -1040,6 +1202,8 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
         "jac_mode": best.get("jac_mode"),
         "rop_mode": best.get("rop_mode"),
         "schedule": best.get("schedule"),
+        "solve_profile": best.get("solve_profile"),
+        "calibration": best.get("calibration"),
         "steps_per_sec": best.get("steps_per_sec"),
         "baseline_ignitions_per_sec": round(baseline_ips, 4),
         "baseline_kind": baseline_kind,
@@ -1050,6 +1214,7 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
                                    "steps_per_sec", "n_steps",
                                    "n_rejected", "n_newton", "platform",
                                    "jac_mode", "rop_mode", "schedule",
+                                   "solve_profile",
                                    "nu_nnz_frac", "n_species_active",
                                    "n_failed", "n_rescued",
                                    "n_abandoned", "status_counts",
@@ -1311,6 +1476,38 @@ def _main_guarded():
                   + (":\n#   " + tail.replace("\n", "\n#   ")
                      if tail else ""), file=sys.stderr)
 
+    # profile-overhead rung: profile-off vs profile-on twins of the
+    # official B=64 grisyn sweep (ISSUE-14 acceptance: overhead <= 5%
+    # and primal results bitwise identical) — own subprocess, same
+    # budget discipline
+    profile_rung = None
+    rem = _remaining(deadline)
+    if os.environ.get("BENCH_PROFILE", "1") != "0" \
+            and (rem is None
+                 or rem > _BUDGET_RESERVE_S + _MIN_RUNG_WINDOW_S):
+        prof_mech = os.environ.get("BENCH_PROFILE_MECH", "grisyn")
+        prof_B = int(os.environ.get("BENCH_PROFILE_B", 64))
+        prof_timeout = float(os.environ.get("BENCH_PROFILE_TIMEOUT",
+                                            900))
+        if rem is not None:
+            prof_timeout = min(prof_timeout,
+                               rem - _BUDGET_RESERVE_S / 2)
+        rc, profile_rung, tail = _run_child(
+            ["profile_overhead", prof_mech, str(prof_B)],
+            prof_timeout, env=None if on_accel else _cpu_env())
+        if profile_rung:
+            telemetry.record_event("bench_profile", **profile_rung)
+            print(f"# profile_overhead: "
+                  f"{profile_rung.get('profile_overhead_pct')}% "
+                  f"bit_match="
+                  f"{profile_rung.get('primal_bit_match')}",
+                  file=sys.stderr)
+        else:
+            print("# profile_overhead rung "
+                  + ("timed out" if rc == -2 else f"failed rc={rc}")
+                  + (":\n#   " + tail.replace("\n", "\n#   ")
+                     if tail else ""), file=sys.stderr)
+
     out = _build_summary(results, baselines, is_fallback=is_fallback,
                          accel_err=accel_err, host_cpu=host_cpu)
     if serve_rung:
@@ -1319,6 +1516,8 @@ def _main_guarded():
         out["surrogate_latency"] = surrogate_rung
     if batch_eff_rung:
         out["batch_efficiency"] = batch_eff_rung
+    if profile_rung:
+        out["profile_overhead"] = profile_rung
     telemetry.record_event("bench_summary", **out)
     if bank_path:
         telemetry.atomic_write_json(bank_path, out)
@@ -1339,6 +1538,8 @@ def _dispatch():
                          float(sys.argv[4]))
     elif len(sys.argv) >= 5 and sys.argv[1] == "batch_eff":
         _child_batch_eff(sys.argv[2], sys.argv[3], sys.argv[4])
+    elif len(sys.argv) >= 4 and sys.argv[1] == "profile_overhead":
+        _child_profile_overhead(sys.argv[2], int(sys.argv[3]))
     else:
         main()
 

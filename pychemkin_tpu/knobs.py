@@ -263,6 +263,14 @@ register(
          default=4096, lo=1),
     "telemetry")
 register(
+    "PYCHEMKIN_SOLVE_PROFILE", "flag", False,
+    "Harvest per-lane solver physics (SolveProfile: attempts, Newton "
+    "iters, min/final dt, stalled flag, Gershgorin stiffness) from "
+    "inside the jitted solve kernels. Checked at TRACE time: off "
+    "compiles exactly today's programs; on adds harvested outputs "
+    "only — primal results are bit-identical either way.",
+    _flag, "telemetry")
+register(
     "PYCHEMKIN_TELEMETRY_PATH", "path", None,
     "JSONL sink a transport backend attaches to its recorder at "
     "startup.",

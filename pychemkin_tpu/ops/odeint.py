@@ -48,10 +48,53 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
 from ..resilience import faultinject
 from ..resilience.status import SolveStatus, status_counts
 from . import linalg
+
+#: trace-time switch for the in-kernel solver-physics profile (see
+#: :func:`solve_profile_enabled`)
+SOLVE_PROFILE_ENV = "PYCHEMKIN_SOLVE_PROFILE"
+
+
+def solve_profile_enabled() -> bool:
+    """Whether solve kernels should harvest the per-lane
+    :class:`SolveProfile` aux outputs. Checked at TRACE time (like the
+    device-counter bridge): off means the compiled program is exactly
+    the pre-profile one; on appends extra harvested outputs only —
+    the primal results are bit-identical either way (property-tested
+    in tests/test_solve_profile.py on both embedded mechanisms)."""
+    return bool(knobs.value(SOLVE_PROFILE_ENV))
+
+
+class SolveProfile(NamedTuple):
+    """Per-lane solver-physics profile harvested from inside a jitted
+    solve — the span-to-fleet observability payload of ISSUE 14.
+    Every field is per-element (scalars under ``vmap`` become [B]
+    arrays). ``stiffness`` is the Gershgorin row bound of the RHS
+    Jacobian sampled at the FINAL state (1/s — the fastest chemical
+    timescale's rate, the same proxy the cost predictor uses at t=0);
+    ``dt_min`` is the smallest ACCEPTED step. ``rescue_rung`` is 0
+    from the hot kernel; the host-side rescue ladder stamps the rung
+    that finally resolved the lane."""
+    n_steps: Any
+    n_rejected: Any
+    n_newton: Any
+    dt_min: Any
+    dt_final: Any
+    stalled: Any
+    status: Any
+    stiffness: Any
+    rescue_rung: Any = 0
+
+
+def gershgorin_rate(J):
+    """Gershgorin spectral-radius bound of a Jacobian: the fastest
+    local timescale's rate [1/s] — the stiffness proxy shared by the
+    scheduler's cost predictor (at t=0) and the solve profile (at
+    harvest)."""
+    return jnp.max(jnp.sum(jnp.abs(J), axis=1))
 
 # ---------------------------------------------------------------------------
 # SDIRK3 (Alexander 1977): gamma is the root of
@@ -122,29 +165,79 @@ class ODESolution(NamedTuple):
     stalled: Any = None   # diagnostic: True if the step loop gave up
     n_newton: Any = None  # total Newton iterations (for FLOP accounting)
     status: Any = None    # per-element SolveStatus code (int32)
+    #: in-kernel profile extras (PYCHEMKIN_SOLVE_PROFILE; None when
+    #: the profile is off at trace time)
+    dt_min: Any = None    # smallest accepted step [s]
+    dt_final: Any = None  # controller step at exit [s]
+    stiffness: Any = None  # Gershgorin rate at the final state [1/s]
 
 
-def solution_stats(sol: "ODESolution", *, label: str = "",
+def solution_stats(sol, *, label: str = "", kind: str | None = None,
                    wall_s: float | None = None, recorder=None,
                    emit: bool = True) -> dict:
-    """Host-side aggregate of a (possibly vmapped) :class:`ODESolution`
-    into one JSON-ready dict of per-solve counters; recorded as an
-    ``odeint`` telemetry event on ``recorder`` (default recorder) when
-    ``emit``. This is the counter surface the FLOP/MFU model and
-    ``solve_report()`` consume."""
-    n_elems = int(np.asarray(sol.n_steps).size)
+    """Host-side aggregate of one (possibly vmapped)
+    :class:`ODESolution` — or a sequence of them, possibly of MIXED
+    kinds — into one JSON-ready dict of per-solve counters; recorded
+    as an ``odeint`` telemetry event on ``recorder`` (default
+    recorder) when ``emit``. This is the counter surface the FLOP/MFU
+    model and ``solve_report()`` consume.
+
+    Mixed-kind Newton accounting is EXPLICIT: solutions that track
+    ``n_newton`` (implicit solves) sum into ``n_newton`` and the
+    ``odeint.newton`` counter — suffixed ``odeint.newton.<kind>``
+    when ``kind`` is given — while the elements of solutions that do
+    NOT track it are counted in ``n_newton_untracked`` and the
+    ``odeint.newton_untracked`` counter, never silently dropped (the
+    old ``n_newton is not None`` guard skipped the whole aggregate
+    when any member lacked the counter)."""
+    # an ODESolution is itself a (named) tuple: "sequence of
+    # solutions" means a plain list/tuple WITHOUT solution fields
+    if isinstance(sol, (list, tuple)) and not hasattr(sol, "n_steps"):
+        sols = list(sol)
+    else:
+        sols = [sol]
+    if not sols:
+        raise ValueError("solution_stats needs at least one solution")
+    n_elems = 0
+    n_steps = n_rejected = n_success = 0
+    n_newton = 0
+    newton_tracked = False
+    n_newton_untracked = 0
+    n_stalled = 0
+    stalled_tracked = False
+    status_arrays = []
+    for s in sols:
+        size = int(np.asarray(s.n_steps).size)
+        n_elems += size
+        n_steps += int(np.sum(np.asarray(s.n_steps)))
+        n_rejected += int(np.sum(np.asarray(s.n_rejected)))
+        n_success += int(np.sum(np.asarray(s.success)))
+        if s.n_newton is not None:
+            newton_tracked = True
+            n_newton += int(np.sum(np.asarray(s.n_newton)))
+        else:
+            n_newton_untracked += size
+        if s.stalled is not None:
+            stalled_tracked = True
+            n_stalled += int(np.sum(np.asarray(s.stalled)))
+        if s.status is not None:
+            status_arrays.append(np.asarray(s.status))
     stats = {
         "n_elements": n_elems,
-        "n_steps": int(np.sum(np.asarray(sol.n_steps))),
-        "n_rejected": int(np.sum(np.asarray(sol.n_rejected))),
-        "n_newton": (int(np.sum(np.asarray(sol.n_newton)))
-                     if sol.n_newton is not None else None),
-        "n_success": int(np.sum(np.asarray(sol.success))),
-        "n_stalled": (int(np.sum(np.asarray(sol.stalled)))
-                      if sol.stalled is not None else None),
+        "n_steps": n_steps,
+        "n_rejected": n_rejected,
+        "n_newton": n_newton if newton_tracked else None,
+        "n_newton_untracked": n_newton_untracked,
+        "n_success": n_success,
+        "n_stalled": n_stalled if stalled_tracked else None,
     }
-    if sol.status is not None:
-        stats["status_counts"] = status_counts(sol.status)
+    if kind is not None:
+        # "solve_kind", not "kind": the recorder's event() already
+        # uses "kind" for the event name itself
+        stats["solve_kind"] = kind
+    if status_arrays:
+        stats["status_counts"] = status_counts(
+            np.concatenate([a.reshape(-1) for a in status_arrays]))
     if wall_s is not None:
         stats["wall_s"] = round(float(wall_s), 6)
         if wall_s > 0:
@@ -156,8 +249,15 @@ def solution_stats(sol: "ODESolution", *, label: str = "",
         rec.inc("odeint.solves")
         rec.inc("odeint.steps", stats["n_steps"])
         rec.inc("odeint.rejected", stats["n_rejected"])
-        if stats["n_newton"] is not None:
-            rec.inc("odeint.newton", stats["n_newton"])
+        if newton_tracked:
+            rec.inc("odeint.newton", n_newton)
+            if kind is not None:
+                rec.inc(f"odeint.newton.{kind}", n_newton)
+        if n_newton_untracked:
+            # the elements whose solution kind carries no Newton
+            # counter — explicit, so a mixed aggregate never
+            # under-reports Newton work invisibly
+            rec.inc("odeint.newton_untracked", n_newton_untracked)
         if stats["n_stalled"]:
             rec.inc("odeint.stalled", stats["n_stalled"])
         for name, n in (stats.get("status_counts") or {}).items():
@@ -341,6 +441,11 @@ class _StepState(NamedTuple):
     acc_v: Any
     stalled: Any
     status: Any     # SolveStatus code, set once on first failure
+    #: smallest ACCEPTED step, carried only when the solve profile is
+    #: on at trace time (None — an empty pytree leaf — otherwise, so
+    #: profile-off loop carries are byte-identical to the pre-profile
+    #: build)
+    dt_min: Any = None
 
 
 def _segment_fns(rhs, jac_fn, events, ctrl, t_end, budget, args,
@@ -462,6 +567,12 @@ def _segment_fns(rhs, jac_fn, events, ctrl, t_end, budget, args,
             acc_t=acc_t, acc_v=acc_v,
             stalled=s.stalled | stalled,
             status=status,
+            # pure consumer of already-computed values: the profile
+            # carry reads (accept, h) and feeds nothing back into the
+            # primal update, so the step sequence is unchanged
+            dt_min=(None if s.dt_min is None else
+                    jnp.where(accept, jnp.minimum(s.dt_min, h),
+                              s.dt_min)),
         )
 
     return cond, body
@@ -491,7 +602,8 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
 
 def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
            events=(), max_steps_per_segment=100_000, h0=0.0, jac=None,
-           f64_jac=False, bordered=True, fault_elem=None, fault_level=0):
+           f64_jac=False, bordered=True, fault_elem=None, fault_level=0,
+           profile=None):
     """Integrate dy/dt = rhs(t, y, args) from ts[0] through ts[-1]; return
     the solution on the output grid ``ts`` plus event accumulators.
 
@@ -515,7 +627,14 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
     ``fault_elem``/``fault_level`` thread this element's original batch
     index and rescue rung into the fault-injection harness; both are
     inert (no graph nodes) unless injection is active at trace time.
+    ``profile`` (default: the ``PYCHEMKIN_SOLVE_PROFILE`` knob,
+    checked at trace time) additionally harvests the in-kernel
+    physics extras ``dt_min``/``dt_final``/``stiffness`` on the
+    returned solution; off leaves the compiled program exactly as
+    before and those fields ``None``.
     """
+    if profile is None:
+        profile = solve_profile_enabled()
     events = tuple(events)
     stall_inject = None
     if fault_elem is not None and faultinject.enabled():
@@ -563,6 +682,8 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
         acc_v=jnp.full((n_ev,), -jnp.inf, dtype=y0.dtype),
         stalled=jnp.array(False),
         status=jnp.int32(SolveStatus.OK),
+        dt_min=(jnp.asarray(jnp.inf, dtype=y0.dtype) if profile
+                else None),
     )
 
     def scan_body(st, t_target):
@@ -579,12 +700,21 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
         ev_t = jnp.where(is_cross & ~jnp.isfinite(ev_t), jnp.nan, ev_t)
 
     success = (~state.stalled) & (state.t >= ts[-1] - 1e-12 * t_span)
+    stiffness = None
+    if profile:
+        # stiffness proxy sampled at harvest: one extra Jacobian at
+        # the final state, downstream of every primal value — the
+        # same Gershgorin bound the scheduler's predictor uses at t=0
+        stiffness = gershgorin_rate(jac_fn(state.t, state.y, args))
     return ODESolution(ts=ts, ys=ys, event_times=ev_t,
                        event_values=state.acc_v,
                        n_steps=state.n_steps, n_rejected=state.n_rejected,
                        success=success, t_final=state.t,
                        stalled=state.stalled, n_newton=state.n_newton,
-                       status=state.status)
+                       status=state.status,
+                       dt_min=state.dt_min,
+                       dt_final=(state.h if profile else None),
+                       stiffness=stiffness)
 
 
 # ---------------------------------------------------------------------------
@@ -605,10 +735,14 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
 # n_out=2 sweep hot path) — the attempt budget is the absolute
 # `ctrl.max_steps_per_segment` a single segment from zero counters has.
 
-def sweep_start(rhs, y0, t_end, args, ctrl: _Ctrl, events) -> _StepState:
+def sweep_start(rhs, y0, t_end, args, ctrl: _Ctrl, events,
+                profile: bool = False) -> _StepState:
     """Per-lane initial :class:`_StepState` for a single-segment
     integration of ``[0, t_end]`` — mirrors ``odeint``'s setup (initial
-    RHS, starting-step heuristic, event accumulators) exactly."""
+    RHS, starting-step heuristic, event accumulators) exactly.
+    ``profile`` seeds the ``dt_min`` carry (PYCHEMKIN_SOLVE_PROFILE);
+    off keeps the carry structure byte-identical to the pre-profile
+    kernel."""
     events = tuple(events)
     t0 = jnp.zeros((), dtype=y0.dtype)
     t_span = jnp.maximum(t_end - t0, 1e-30)
@@ -628,7 +762,9 @@ def sweep_start(rhs, y0, t_end, args, ctrl: _Ctrl, events) -> _StepState:
         acc_t=acc_t0,
         acc_v=jnp.full((n_ev,), -jnp.inf, dtype=y0.dtype),
         stalled=jnp.array(False),
-        status=jnp.int32(SolveStatus.OK))
+        status=jnp.int32(SolveStatus.OK),
+        dt_min=(jnp.asarray(jnp.inf, dtype=y0.dtype) if profile
+                else None))
 
 
 def sweep_round(rhs, jac_fn, events, ctrl: _Ctrl, state: _StepState,

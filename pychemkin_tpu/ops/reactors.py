@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from ..constants import R_GAS
 from ..resilience import faultinject
 from . import jacobian, kinetics, linalg, thermo
-from .odeint import Event, odeint
+from .odeint import (Event, SolveProfile, gershgorin_rate, odeint,
+                     solve_profile_enabled)
 
 
 class Profile(NamedTuple):
@@ -221,6 +222,10 @@ class BatchSolution(NamedTuple):
     n_rejected: Any = None   # solver stats (FLOP/MFU accounting)
     n_newton: Any = None
     status: Any = None       # SolveStatus code (int32)
+    #: per-lane :class:`~pychemkin_tpu.ops.odeint.SolveProfile` when
+    #: the in-kernel physics profile is on (PYCHEMKIN_SOLVE_PROFILE),
+    #: else None — an aux output only, never part of the primal result
+    profile: Any = None
 
 
 def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
@@ -230,7 +235,8 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
                 area=0.0, ignition_mode=IGN_T_INFLECTION,
                 ignition_kwargs=None, t_start=0.0,
                 max_steps_per_segment=20_000, h0=0.0, f64_jac=False,
-                jac_mode="analytic", fault_elem=None, fault_level=0):
+                jac_mode="analytic", fault_elem=None, fault_level=0,
+                profile=None):
     """Solve one 0-D batch reactor; jit/vmap-safe core of the reference's
     ``BatchReactors.run()`` (batchreactor.py:1161).
 
@@ -248,8 +254,13 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
     genuinely different Jacobian path);
     ``fault_elem``/``fault_level`` thread fault injection (see
     :func:`pychemkin_tpu.ops.odeint.odeint`). The returned ``status``
-    is the per-element SolveStatus code.
+    is the per-element SolveStatus code. ``profile`` (default: the
+    ``PYCHEMKIN_SOLVE_PROFILE`` knob at trace time) attaches the
+    per-lane :class:`~pychemkin_tpu.ops.odeint.SolveProfile` aux
+    structure; primal results are bit-identical either way.
     """
+    if profile is None:
+        profile = solve_profile_enabled()
     rhs = _RHS[(problem, energy)]
     # the analytical Jacobian differentiates the CLEAN RHS: an injected
     # NaN fault must poison the Newton residuals (it does — odeint wraps
@@ -303,7 +314,7 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
     sol = odeint(rhs, y0, ts, args, rtol=rtol, atol=atol_vec, events=events,
                  max_steps_per_segment=max_steps_per_segment, h0=h0,
                  jac=jac, f64_jac=f64_jac, fault_elem=fault_elem,
-                 fault_level=fault_level)
+                 fault_level=fault_level, profile=profile)
 
     ignition_time = sol.event_times[0]
     if ignition_mode == IGN_T_INFLECTION:
@@ -330,11 +341,19 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
             Ys)
         Ps = rhos * R_GAS * Ts / wbars
 
+    prof = None
+    if profile:
+        prof = SolveProfile(
+            n_steps=sol.n_steps, n_rejected=sol.n_rejected,
+            n_newton=sol.n_newton, dt_min=sol.dt_min,
+            dt_final=sol.dt_final, stalled=sol.stalled,
+            status=sol.status, stiffness=sol.stiffness,
+            rescue_rung=jnp.int32(0))
     return BatchSolution(times=ts, T=Ts, P=Ps, volume=Vs, Y=Ys,
                          ignition_time=ignition_time,
                          n_steps=sol.n_steps, success=sol.success,
                          n_rejected=sol.n_rejected, n_newton=sol.n_newton,
-                         status=sol.status)
+                         status=sol.status, profile=prof)
 
 
 def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
@@ -344,7 +363,7 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                          max_steps_per_segment=20_000, h0=0.0,
                          f64_jac=False, pivoted_lu=False,
                          jac_mode="analytic", elem_ids=None,
-                         fault_level=0):
+                         fault_level=0, profile=False):
     """Batched ignition-delay computation over [B] initial conditions — the
     TPU answer to the reference's serial Python sweep loop
     (tests/integration_tests/ignitiondelay.py:127-144). Returns a triple
@@ -359,6 +378,14 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     fault injection — a rescue re-solve of a subset passes the original
     ids so the same elements stay poisoned; defaults to ``arange(B)``
     when injection is active, None (inert) otherwise.
+
+    ``profile=True`` (EXPLICIT — this arity-stable mid-level API does
+    not consult the env knob; the serve engines and the sweep kernel
+    do) returns a 4-tuple ``(times, ok, status, profile)`` where
+    ``profile`` is a dict of per-element [B] arrays
+    (``n_steps``/``n_rejected``/``n_newton``/``dt_min``/``dt_final``/
+    ``stiffness``). The first three elements are bit-identical to the
+    profile-off triple.
 
     All inputs broadcast along the leading batch axis.
     """
@@ -383,7 +410,14 @@ def ignition_delay_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                           ignition_kwargs=ignition_kwargs,
                           max_steps_per_segment=max_steps_per_segment,
                           h0=h0, f64_jac=f64_jac, jac_mode=jac_mode,
-                          fault_elem=elem, fault_level=fault_level)
+                          fault_elem=elem, fault_level=fault_level,
+                          profile=profile)
+        if profile:
+            p = sol.profile
+            return sol.ignition_time, sol.success, sol.status, {
+                "n_steps": p.n_steps, "n_rejected": p.n_rejected,
+                "n_newton": p.n_newton, "dt_min": p.dt_min,
+                "dt_final": p.dt_final, "stiffness": p.stiffness}
         return sol.ignition_time, sol.success, sol.status
 
     def run():
@@ -467,12 +501,18 @@ def ignition_sweep_kernel(mech, problem, energy, *, rtol=1e-6,
                           ignition_kwargs=None,
                           max_steps_per_segment=20_000, h0=0.0,
                           jac_mode="analytic", fault_level=0,
-                          round_len=512) -> SweepKernel:
+                          round_len=512,
+                          profile: bool = False) -> SweepKernel:
     """Build the resumable-sweep kernel for one solver configuration.
 
     ``elems`` threads each lane's ORIGINAL batch index into the fault
     harness (inert unless injection is active at trace time), so a
     cohort-permuted scheduled sweep keeps the same elements poisoned.
+    ``profile`` (the compaction driver resolves the
+    ``PYCHEMKIN_SOLVE_PROFILE`` knob before building) adds the
+    in-kernel physics extras ``dt_min``/``dt_final``/``stiffness`` to
+    the harvest dict; the carried state and every primal output stay
+    bit-identical to the profile-off kernel.
     """
     from .odeint import (_Ctrl, _make_jac_fn, sweep_done, sweep_finalize,
                          sweep_round, sweep_start)
@@ -509,7 +549,7 @@ def ignition_sweep_kernel(mech, problem, energy, *, rtol=1e-6,
         rhs, jac_fn, events, args, y0, ctrl, _ = lane_setup(
             T0, P0, Y0, elem)
         return sweep_start(rhs, y0, jnp.asarray(t_end, y0.dtype), args,
-                           ctrl, events)
+                           ctrl, events, profile=profile)
 
     def lane_advance(state, T0, P0, Y0, t_end, elem):
         rhs, jac_fn, events, args, _, ctrl, stall = lane_setup(
@@ -519,7 +559,8 @@ def ignition_sweep_kernel(mech, problem, energy, *, rtol=1e-6,
                            round_len, stall)
 
     def lane_harvest(state, T0, P0, Y0, t_end, elem):
-        _, _, events, _, _, ctrl, _ = lane_setup(T0, P0, Y0, elem)
+        _, jac_fn, events, args, _, ctrl, _ = lane_setup(
+            T0, P0, Y0, elem)
         t_end = jnp.asarray(t_end, state.y.dtype)
         ev_t, ev_v, success, status = sweep_finalize(state, t_end,
                                                      events)
@@ -528,12 +569,21 @@ def ignition_sweep_kernel(mech, problem, energy, *, rtol=1e-6,
             min_slope = ign_kwargs.get("min_slope", 1e4)
             ignition_time = jnp.where(ev_v[0] >= min_slope,
                                       ignition_time, jnp.nan)
-        return {"times": ignition_time, "ok": success,
-                "status": status,
-                "done": sweep_done(state, t_end, ctrl),
-                "n_steps": state.n_steps,
-                "n_rejected": state.n_rejected,
-                "n_newton": state.n_newton}
+        out = {"times": ignition_time, "ok": success,
+               "status": status,
+               "done": sweep_done(state, t_end, ctrl),
+               "n_steps": state.n_steps,
+               "n_rejected": state.n_rejected,
+               "n_newton": state.n_newton}
+        if profile:
+            # harvest-time extras only — downstream of every primal
+            # value; the Gershgorin sample is one extra Jacobian at
+            # the lane's final state
+            out["dt_min"] = state.dt_min
+            out["dt_final"] = state.h
+            out["stiffness"] = gershgorin_rate(
+                jac_fn(state.t, state.y, args))
+        return out
 
     return SweepKernel(
         init=jax.jit(jax.vmap(lane_init)),

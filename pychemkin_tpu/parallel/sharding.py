@@ -139,8 +139,13 @@ def _solve_shard(mech, problem, energy, T0s, P0s, Y0s, t_ends, mesh,
     mapped = _sweep_program_cache.get(cache_key)
     if mapped is None:
         def one(T0, P0, Y0, t_end):
+            # profile=False explicitly: this program's outputs never
+            # include the SolveProfile, and the cache key below does
+            # not carry the PYCHEMKIN_SOLVE_PROFILE knob — pinning
+            # the arg keeps the traced kernel knob-independent
+            # (profiled sweeps ride the compaction path instead)
             sol = reactor_ops.solve_batch(mech, problem, energy, T0, P0, Y0,
-                                          t_end, **kwargs)
+                                          t_end, profile=False, **kwargs)
             return (sol.ignition_time, sol.success, sol.status,
                     sol.n_steps, sol.n_rejected, sol.n_newton)
 
@@ -285,12 +290,19 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
     # supported solver knobs) run each chunk with mid-sweep compaction
     order = None
     compact = False
+    costs = None
+    #: realized per-element step attempts, filled by index_solve as
+    #: chunks execute (NaN where a checkpoint resume skipped the
+    #: chunk this process) — the measured half of the predictor-
+    #: calibration gauge
+    measured = None
     if mode != "static" and B > 1:
         predict = cost_fn if cost_fn is not None \
             else _schedule.stiffness_costs
         costs = predict(mech, problem, energy, np.asarray(T0s),
                         np.asarray(P0s), np.asarray(Y0s),
                         np.asarray(t_ends))
+        measured = np.full(B, np.nan)
         plan = _schedule.plan_cohorts(costs, chunk,
                                       label="sharded_ignition_sweep")
         order = plan.order
@@ -329,6 +341,9 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                 stats.add(out["n_steps"][uniq].sum(),
                           out["n_rejected"][uniq].sum(),
                           out["n_newton"][uniq].sum())
+            if measured is not None:
+                measured[np.asarray(idx)] = (out["n_steps"]
+                                             + out["n_rejected"])
             return {"times": out["times"], "ok": out["ok"],
                     "status": out["status"]}
         t, ok, st, n_steps, n_rej, n_newt = _solve_shard(
@@ -337,6 +352,8 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         if stats is not None:
             stats.add(n_steps[:n].sum(), n_rej[:n].sum(),
                       n_newt[:n].sum())
+        if measured is not None:
+            measured[np.asarray(idx)] = n_steps + n_rej
         return {"times": t, "ok": ok, "status": st}
 
     results, _report = _driver.run_vmapped_sweep_job(
@@ -344,6 +361,14 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         checkpoint_path=checkpoint_path, signature=sig,
         result_keys=("times", "ok", "status"), job_report=job_report,
         label="sharded_ignition_sweep", **(driver_kwargs or {}))
+    if measured is not None:
+        # live predictor calibration: predicted-vs-measured cost rank
+        # correlation, banked per scheduled sweep (gauge + event +
+        # job_report) — the continuously monitored form of the PR-11
+        # offline spearman validation
+        _schedule.bank_predictor_calibration(
+            costs, measured, label="sharded_ignition_sweep",
+            job_report=job_report)
     return results["times"], results["ok"], results["status"]
 
 

@@ -37,7 +37,13 @@ Mode knob ``PYCHEMKIN_SCHEDULE`` (explicit call arguments win):
 Telemetry: ``schedule.cohorts`` (cohort chunks planned),
 ``schedule.compactions`` (mid-sweep gathers), and
 ``schedule.ladder_adjust`` (serve knob adjustments) counters, plus a
-``schedule`` field on every ``serve.dispatch`` trace span.
+``schedule`` field on every ``serve.dispatch`` trace span. Every
+scheduled sweep additionally banks its predicted-vs-measured cost
+rank correlation (``schedule.predictor_corr`` gauge +
+``schedule.calibration`` event, mirrored into ``job_report``) — the
+live calibration signal that tells an operator when the Gershgorin
+predictor has gone stale and ``cost_fn`` should switch to the
+surrogate (:func:`bank_predictor_calibration`).
 """
 
 from __future__ import annotations
@@ -46,7 +52,8 @@ from .. import knobs
 from .adaptive import AdaptiveController
 from .cohorts import CohortPlan, order_signature, plan_cohorts
 from .compaction import compacted_ignition_sweep, compaction_ladder
-from .predictor import stiffness_costs, surrogate_cost_predictor
+from .predictor import (bank_predictor_calibration, spearman,
+                        stiffness_costs, surrogate_cost_predictor)
 
 #: valid PYCHEMKIN_SCHEDULE values
 MODES = ("static", "sorted", "adaptive")
@@ -65,8 +72,9 @@ SCHEDULE_SPAN_FIELD = "schedule"
 __all__ = [
     "AdaptiveController", "CohortPlan", "MODES", "MODE_ENV",
     "SCHEDULE_COUNTERS", "SCHEDULE_SPAN_FIELD",
-    "compacted_ignition_sweep", "compaction_ladder", "order_signature",
-    "plan_cohorts", "resolve_mode", "stiffness_costs",
+    "bank_predictor_calibration", "compacted_ignition_sweep",
+    "compaction_ladder", "order_signature", "plan_cohorts",
+    "resolve_mode", "spearman", "stiffness_costs",
     "surrogate_cost_predictor",
 ]
 

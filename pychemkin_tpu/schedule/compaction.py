@@ -33,6 +33,7 @@ import numpy as np
 
 from .. import knobs, telemetry
 from ..ops import reactors
+from ..ops.odeint import solve_profile_enabled
 from ..resilience import faultinject
 from ..resilience.driver import edge_pad_indices
 
@@ -113,7 +114,9 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
     bit-match it at the compiled-baseline level), returned as a dict
     of [B] arrays ``times``/``ok``/``status`` plus the per-element
     solver counters ``n_steps``/``n_rejected``/``n_newton`` the bench
-    FLOP model sums. ``elem_ids`` carries ORIGINAL batch indices for
+    FLOP model sums (and, when ``PYCHEMKIN_SOLVE_PROFILE`` is on,
+    the physics extras ``dt_min``/``dt_final``/``stiffness``).
+    ``elem_ids`` carries ORIGINAL batch indices for
     fault injection — a cohort-permuted scheduled sweep passes the
     caller ids so the same elements stay poisoned.
     """
@@ -132,14 +135,19 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
         raise ValueError(f"elem_ids must have shape ({B},), got "
                          f"{elem_ids.shape}")
     rl = int(round_len) if round_len is not None else _round_len()
+    # the in-kernel physics profile (PYCHEMKIN_SOLVE_PROFILE) is a
+    # trace-time decision, so it keys the kernel cache exactly like
+    # the fault specs: a kernel traced profile-off must not serve a
+    # profiled sweep (and vice versa)
+    prof = solve_profile_enabled()
     kwargs = dict(rtol=rtol, atol=atol, ignition_mode=ignition_mode,
                   ignition_kwargs=ignition_kwargs,
                   max_steps_per_segment=max_steps_per_segment, h0=h0,
                   jac_mode=jac_mode, fault_level=fault_level,
-                  round_len=rl)
+                  round_len=rl, profile=prof)
     cfg = (rtol, atol, str(ignition_mode),
            tuple(sorted((ignition_kwargs or {}).items())),
-           max_steps_per_segment, h0, jac_mode, fault_level, rl)
+           max_steps_per_segment, h0, jac_mode, fault_level, rl, prof)
     kernel = _kernel(mech, problem, energy, cfg, kwargs)
     if ladder is None:
         ladder = compaction_ladder(B)
@@ -160,6 +168,10 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
         "n_rejected": np.zeros(B, np.int64),
         "n_newton": np.zeros(B, np.int64),
     }
+    if prof:
+        out["dt_min"] = np.full(B, np.nan)
+        out["dt_final"] = np.full(B, np.nan)
+        out["stiffness"] = np.full(B, np.nan)
 
     def _gather(arrs, idx):
         return [jax.tree_util.tree_map(lambda a: a[idx], c)

@@ -21,13 +21,14 @@ spends longer in the small-step induction window — pass the model to
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import jacobian, reactors
+from .. import telemetry
+from ..ops import jacobian, odeint, reactors
 
 #: jitted predictor programs keyed by (mech identity, problem, energy)
 _COST_CACHE: Dict[Tuple, Any] = {}
@@ -44,8 +45,9 @@ def _cost_fn(mech, problem: str, energy: str):
                                                    P0, Y0)
             J = jac_fn(jnp.zeros((), dtype=y0.dtype), y0, args)
             # Gershgorin: max over rows of sum_j |J_ij| bounds the
-            # spectral radius — the fastest timescale's rate
-            rate = jnp.max(jnp.sum(jnp.abs(J), axis=1))
+            # spectral radius — the fastest timescale's rate (shared
+            # with the solve profile's harvest-time sample)
+            rate = odeint.gershgorin_rate(J)
             return rate * t_end
 
         fn = _COST_CACHE[key] = jax.jit(jax.vmap(one))
@@ -69,6 +71,75 @@ def stiffness_costs(mech, problem: str, energy: str, T0s, P0s, Y0s,
         jnp.asarray(T0s), jnp.asarray(P0s), jnp.asarray(Y0s),
         jnp.asarray(t_ends))
     return np.asarray(costs, np.float64)
+
+
+def spearman(a, b) -> Optional[float]:
+    """Spearman rank correlation of two 1-D arrays over their jointly
+    finite entries (pure numpy — average ranks for ties). None when
+    fewer than 3 finite pairs remain or either side is constant (rank
+    correlation is undefined there, and the gauge must say "no
+    signal", not fake a number)."""
+    a = np.asarray(a, np.float64).reshape(-1)
+    b = np.asarray(b, np.float64).reshape(-1)
+    if a.shape != b.shape:
+        raise ValueError(f"spearman needs same-shape arrays, got "
+                         f"{a.shape} vs {b.shape}")
+    m = np.isfinite(a) & np.isfinite(b)
+    a, b = a[m], b[m]
+    if a.size < 3:
+        return None
+
+    def ranks(x):
+        order = np.argsort(x, kind="stable")
+        r = np.empty(x.size, np.float64)
+        r[order] = np.arange(1, x.size + 1, dtype=np.float64)
+        # average ranks over ties so tied predictions don't pick up
+        # spurious (dis)agreement from sort order — O(n log n): mean
+        # ordinal rank per distinct value, scattered back
+        _, inv, counts = np.unique(x, return_inverse=True,
+                                   return_counts=True)
+        return (np.bincount(inv, weights=r) / counts)[inv]
+
+    ra, rb = ranks(a), ranks(b)
+    sa, sb = ra.std(), rb.std()
+    if sa == 0.0 or sb == 0.0:
+        return None
+    return float(np.mean((ra - ra.mean()) * (rb - rb.mean()))
+                 / (sa * sb))
+
+
+def bank_predictor_calibration(costs, measured, *, recorder=None,
+                               label: str = "",
+                               job_report: Optional[dict] = None
+                               ) -> Optional[float]:
+    """Bank one sweep's predicted-vs-measured cost rank correlation —
+    the LIVE calibration signal behind the scheduler's cost model
+    (PR-11's one-off offline spearman numbers, now monitored
+    continuously). ``costs`` are the predictor's per-element values,
+    ``measured`` the realized per-element step attempts (NaN where a
+    resumed-from-checkpoint chunk never executed this process).
+
+    Emits the ``schedule.predictor_corr`` gauge (only when a
+    correlation exists — a sweep too small to rank must not overwrite
+    a real reading with null) and a ``schedule.calibration`` event
+    either way, and mirrors the number into ``job_report`` — the
+    operator-facing signal for when to switch ``cost_fn`` to the
+    surrogate predictor. Returns the correlation (None = no
+    signal)."""
+    corr = spearman(costs, measured)
+    rec = recorder if recorder is not None else telemetry.get_recorder()
+    n_measured = int(np.count_nonzero(
+        np.isfinite(np.asarray(measured, np.float64))))
+    if corr is not None:
+        rec.gauge("schedule.predictor_corr", round(corr, 4))
+    rec.event("schedule.calibration", label=label,
+              n=int(np.asarray(costs).size), n_measured=n_measured,
+              predictor_corr=(round(corr, 4) if corr is not None
+                              else None))
+    if job_report is not None:
+        job_report["predictor_corr"] = (round(corr, 4)
+                                        if corr is not None else None)
+    return corr
 
 
 def surrogate_cost_predictor(model) -> Callable:

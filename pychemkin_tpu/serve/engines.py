@@ -43,6 +43,7 @@ from ..ops import equilibrium as eq_ops
 from ..ops import psr as psr_ops
 from ..ops import reactors as reactor_ops
 from ..ops import thermo
+from ..ops.odeint import solve_profile_enabled
 from ..resilience import faultinject
 from ..resilience.rescue import DEFAULT_LADDER
 from ..resilience.status import SolveStatus
@@ -54,6 +55,13 @@ from .buckets import pad_indices
 
 def _f64(x) -> np.ndarray:
     return np.asarray(x, np.float64)
+
+
+#: per-lane solver-physics keys an engine's batch output MAY carry
+#: when the solve profile (PYCHEMKIN_SOLVE_PROFILE) is on at trace
+#: time; :meth:`Engine.profile_at` demuxes whichever are present
+PROFILE_KEYS = ("n_steps", "n_rejected", "n_newton", "dt_min",
+                "dt_final", "stiffness")
 
 
 class Engine:
@@ -144,13 +152,36 @@ class Engine:
         # locked check-then-act: the worker's first live batch and a
         # caller's solve_direct on the same cold key must share ONE
         # jit wrapper, or each traces its own program and the
-        # zero-recompiles-after-warmup counter invariant breaks
+        # zero-recompiles-after-warmup counter invariant breaks.
+        # The solve-profile knob is a trace-time decision, so it
+        # joins the cache key — a program traced profile-off must not
+        # serve a profiled request after an env flip (and the default
+        # profile-off key is exactly the pre-profile one)
+        cache_key = (key, solve_profile_enabled())
         with self._cache_lock:
-            fn = self._jit_cache.get(key)
+            fn = self._jit_cache.get(cache_key)
             if fn is None:
-                fn = self._jit_cache[key] = jax.jit(
+                fn = self._jit_cache[cache_key] = jax.jit(
                     self._make_batch_fn(key))
             return fn
+
+    def profile_at(self, out: Dict[str, np.ndarray],
+                   i: int) -> Optional[Dict[str, Any]]:
+        """Lane ``i``'s solver-physics profile as JSON-safe scalars,
+        or None when this engine's output carries none (profile off,
+        or a kind with no in-kernel profile — e.g. the fixed-
+        iteration equilibrium Newton)."""
+        prof: Dict[str, Any] = {}
+        for k in PROFILE_KEYS:
+            if k in out:
+                v = np.asarray(out[k][i])
+                if np.issubdtype(v.dtype, np.integer) or \
+                        np.issubdtype(v.dtype, np.bool_):
+                    prof[k] = int(v)
+                else:
+                    f = float(v)
+                    prof[k] = f if np.isfinite(f) else None
+        return prof or None
 
     def _make_batch_fn(self, key: Tuple):
         raise NotImplementedError
@@ -233,12 +264,23 @@ class IgnitionEngine(Engine):
     def _make_batch_fn(self, key):
         def fn(T0s, P0s, Y0s, t_ends):
             self._count_trace()
+            kwargs = dict(rtol=self.rtol, atol=self.atol,
+                          ignition_mode=self.ignition_mode,
+                          ignition_kwargs=self.ignition_kwargs,
+                          max_steps_per_segment=self.max_steps)
+            if solve_profile_enabled():
+                # trace-time branch (the jit cache is keyed on the
+                # knob): primal outputs are bit-identical; the lane
+                # physics ride as extra harvested arrays
+                times, ok, status, prof = \
+                    reactor_ops.ignition_delay_sweep(
+                        self.mech, self.problem, self.energy, T0s,
+                        P0s, Y0s, t_ends, profile=True, **kwargs)
+                return {"times": times, "ok": ok, "status": status,
+                        **prof}
             times, ok, status = reactor_ops.ignition_delay_sweep(
                 self.mech, self.problem, self.energy, T0s, P0s, Y0s,
-                t_ends, rtol=self.rtol, atol=self.atol,
-                ignition_mode=self.ignition_mode,
-                ignition_kwargs=self.ignition_kwargs,
-                max_steps_per_segment=self.max_steps)
+                t_ends, **kwargs)
             return {"times": times, "ok": ok, "status": status}
 
         return fn
@@ -442,8 +484,13 @@ class PSREngine(Engine):
             **self.solver_kwargs)
 
     def _result_dict(self, sol):
-        return {"T": sol.T, "Y": sol.Y, "residual": sol.residual,
-                "converged": sol.converged, "status": sol.status}
+        d = {"T": sol.T, "Y": sol.Y, "residual": sol.residual,
+             "converged": sol.converged, "status": sol.status}
+        if solve_profile_enabled():
+            # the PSR Newton's physics profile: iteration counts per
+            # phase (trace-time branch, cache keyed on the knob)
+            d["n_newton"] = sol.n_newton
+        return d
 
     def _make_batch_fn(self, key):
         def fn(taus, Ps, Y_ins, h_ins, T_gs, Y_gs):

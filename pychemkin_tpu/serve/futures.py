@@ -34,19 +34,27 @@ class ServeResult(NamedTuple):
     occupancy: int           # real requests in that batch
     queue_wait_ms: float     # submit -> batch formation
     solve_ms: float          # the batch's device solve wall time
+    #: this lane's solver-physics profile (PYCHEMKIN_SOLVE_PROFILE:
+    #: attempts / Newton iters / dt_min / stiffness, plus the rescue
+    #: rung that finally resolved it); None when profiling is off or
+    #: the kind carries no in-kernel profile. JSON-safe — rides the
+    #: wire reply unchanged.
+    profile: Optional[Dict[str, Any]] = None
 
 
 def make_result(value: Dict[str, Any], status: int, *, kind: str,
                 bucket: int, occupancy: int, queue_wait_ms: float,
                 solve_ms: float, rescued: bool = False,
-                rescue_rungs: int = 0) -> ServeResult:
+                rescue_rungs: int = 0,
+                profile: Optional[Dict[str, Any]] = None
+                ) -> ServeResult:
     status = int(status)
     return ServeResult(
         value=value, status=status, status_name=name_of(status),
         ok=status == 0, rescued=rescued, rescue_rungs=rescue_rungs,
         kind=kind, bucket=bucket, occupancy=occupancy,
         queue_wait_ms=round(queue_wait_ms, 3),
-        solve_ms=round(solve_ms, 3))
+        solve_ms=round(solve_ms, 3), profile=profile)
 
 
 class ServeFuture(concurrent.futures.Future):

@@ -407,7 +407,7 @@ class ChemServer:
         return make_result(
             eng.value_at(out, 0), int(out["status"][0]), kind=kind,
             bucket=bucket, occupancy=1, queue_wait_ms=0.0,
-            solve_ms=solve_s * 1e3)
+            solve_ms=solve_s * 1e3, profile=eng.profile_at(out, 0))
 
     # -- warmup ----------------------------------------------------------
     def warmup(self, kinds: Optional[Sequence[str]] = None,
@@ -609,6 +609,30 @@ class ChemServer:
                 self._rec.observe("serve.queue_wait_ms", wait_ms)
                 status = int(out["status"][i])
                 self._rec.inc(f"serve.status.{name_of(status)}")
+                # this lane's solver physics (PYCHEMKIN_SOLVE_PROFILE):
+                # carried on the dispatch span, the solve.* fleet
+                # histograms, and the ServeResult/wire reply — the
+                # below-dispatch story an operator reads when a batch
+                # is slow (which lane was stiff, what Newton burned)
+                prof = eng.profile_at(out, i)
+                if prof is not None:
+                    attempts = (prof.get("n_steps") or 0) + \
+                        (prof.get("n_rejected") or 0)
+                    if prof.get("n_newton") is not None and attempts:
+                        self._rec.observe(
+                            "solve.newton_per_attempt",
+                            prof["n_newton"] / attempts)
+                    if prof.get("dt_min") is not None:
+                        # nanoseconds: stiff accepted steps run
+                        # 1e-12..1e-2 s, and the shared log-bucket
+                        # edges span [1e-6, 1e9) — in ns the whole
+                        # physical range lands inside the buckets
+                        # (and summary rounding keeps 6 decimals)
+                        self._rec.observe("solve.dt_min_ns",
+                                          prof["dt_min"] * 1e9)
+                    if prof.get("n_steps") is not None:
+                        self._rec.observe("solve.steps_per_lane",
+                                          prof["n_steps"])
                 if req.trace_id is not None:
                     # the request's hot-path story as three spans:
                     # submit → adoption → dispatch → program done
@@ -626,7 +650,8 @@ class ChemServer:
                         solve_ms, req_kind=kind, bucket=bucket,
                         occupancy=occupancy, compile_hit=compile_hit,
                         lane=i, status=name_of(status),
-                        schedule=self.schedule_mode)
+                        schedule=self.schedule_mode,
+                        **(prof or {}))
                     if eng.trace_span_name:
                         # engine-declared extra span (e.g. the
                         # surrogate's verified/residual verdict)
@@ -636,7 +661,8 @@ class ChemServer:
                             req_kind=kind, **eng.span_fields(out, i))
                 meta = dict(kind=kind, bucket=bucket,
                             occupancy=occupancy,
-                            queue_wait_ms=wait_ms, solve_ms=solve_ms)
+                            queue_wait_ms=wait_ms, solve_ms=solve_ms,
+                            profile=prof)
                 if (status != int(SolveStatus.OK)
                         and self.rescue_enabled):
                     # off the hot path: the rescue thread owns this
@@ -738,6 +764,13 @@ class ChemServer:
                         req_id=req.id, rungs=level, rescued=rescued,
                         deadline_cut=deadline_cut,
                         status=name_of(status))
+        if meta.get("profile") is not None:
+            # the rung that finally resolved this lane completes its
+            # physics profile (0 = hot path; the hot-solve counters
+            # stay — they are the failure being explained)
+            meta = {**meta,
+                    "profile": {**meta["profile"],
+                                "rescue_rung": level}}
         self._resolve_future(req.future, make_result(
             value, status, rescued=rescued, rescue_rungs=level,
             **meta))

@@ -42,6 +42,7 @@ COUNTERS = (
     "model.solves",
     "network.cluster_reject",
     "odeint.newton",
+    "odeint.newton_untracked",
     "odeint.rejected",
     "odeint.solves",
     "odeint.stalled",
@@ -78,6 +79,7 @@ COUNTERS = (
 #: name, an engine kind, a tenant id)
 COUNTER_PREFIXES = (
     "model.status.",
+    "odeint.newton.",
     "odeint.status.",
     "resilience.status.",
     "serve.compiles.",
@@ -88,6 +90,7 @@ COUNTER_PREFIXES = (
 # -- gauges -----------------------------------------------------------------
 
 GAUGES = (
+    "schedule.predictor_corr",
     "serve.queue_depth",
 )
 
@@ -100,6 +103,9 @@ HISTOGRAMS = (
     "serve.queue_wait_ms",
     "serve.solve_ms",
     "serve.surrogate.residual",
+    "solve.dt_min_ns",
+    "solve.newton_per_attempt",
+    "solve.steps_per_lane",
 )
 
 #: per-bucket occupancy distributions: serve.occupancy.b<bucket>
@@ -112,6 +118,7 @@ HISTOGRAM_PREFIXES = (
 EVENTS = (
     "bench_batch_eff",
     "bench_config",
+    "bench_profile",
     "bench_serve",
     "bench_start",
     "bench_summary",
@@ -128,6 +135,7 @@ EVENTS = (
     "odeint",
     "rescue",
     "schedule.adjust",
+    "schedule.calibration",
     "schedule.compaction",
     "schedule.plan",
     "serve.batch",

@@ -1,0 +1,103 @@
+"""Container-speed calibration microprobe — the fingerprint that makes
+cross-PR perf artifacts comparable.
+
+Every bench capture in CHANGES.md carries some variant of "this
+container measures ~2x faster than the previous capture": the
+artifacts are a time series confounded by hardware drift. This module
+is the fix's first half: a FIXED, dependency-light microprobe that
+times the same two operations on every container —
+
+- ``gemm``: a pure-numpy f64 matrix multiply (BLAS throughput — the
+  dominant term of the solver's LU/Jacobian hot path on CPU);
+- ``pyloop``: a pure-Python arithmetic loop (interpreter/core speed —
+  the host-side driver and harness overhead term).
+
+The resulting ``calibration`` block is banked into every bench rung
+and suite summary; ``tools/perf_ledger.py`` (the second half) divides
+the raw timings out, so ``STEP_COST_*`` / ``BATCH_EFF_*`` / ``BENCH_*``
+artifacts become a NORMALIZED trajectory and a regression gate can
+compare captures from different containers.
+
+Deliberately stdlib + numpy only, with no package-relative imports:
+``tests/run_suite.py`` (which must never import the jax-importing
+package ``__init__``) and ``tools/perf_ledger.py`` both load this
+module standalone via ``importlib``, the same contract as
+``telemetry/sink.py``.
+"""
+
+from __future__ import annotations
+
+import platform
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+#: bump when the probe's workload changes — entries from different
+#: probe versions are never compared by the ledger
+PROBE_VERSION = 1
+
+#: GEMM size / repeat count: large enough to hit BLAS throughput,
+#: small enough that the whole probe stays well under a second
+_GEMM_N = 256
+_GEMM_REPS = 8
+_BEST_OF = 3
+
+#: pure-Python loop length for the interpreter-speed term
+_PYLOOP_N = 200_000
+
+#: the reference container's probe readings (this repo's CI image at
+#: ISSUE 14): normalization factors are probe/REF ratios, so ledger
+#: entries are "as if measured on the reference container". The
+#: absolute choice is arbitrary — only ratios matter.
+REF_GEMM_GFLOPS = 40.0
+REF_PYLOOP_MS = 10.0
+
+
+def probe() -> Dict[str, Any]:
+    """Run the microprobe; returns the JSON-ready ``calibration``
+    block. Deterministic workload (seeded inputs, best-of timing), so
+    two runs on one quiet container agree to a few percent."""
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((_GEMM_N, _GEMM_N))
+    b = rng.standard_normal((_GEMM_N, _GEMM_N))
+    a @ b  # warm BLAS thread pools / allocators out of the timing
+    best = float("inf")
+    for _ in range(_BEST_OF):
+        t0 = time.perf_counter()
+        for _ in range(_GEMM_REPS):
+            a = 0.5 * (a @ b)  # feed forward so nothing is dead code
+        best = min(best, (time.perf_counter() - t0) / _GEMM_REPS)
+    gemm_gflops = 2.0 * _GEMM_N ** 3 / best / 1e9
+
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(_PYLOOP_N):
+        acc += i * i & 1023
+    pyloop_ms = (time.perf_counter() - t0) * 1e3
+
+    return {
+        "probe_version": PROBE_VERSION,
+        "gemm_n": _GEMM_N,
+        "gemm_ms": round(best * 1e3, 4),
+        "gemm_gflops": round(gemm_gflops, 2),
+        "pyloop_ms": round(pyloop_ms, 3),
+        "pyloop_check": acc,         # guards against a dead-code loop
+        "machine": platform.machine(),
+        "t": time.time(),
+    }
+
+
+def speed_factor(calibration: Dict[str, Any] | None) -> float | None:
+    """How much faster this container's compute is than the reference
+    (1.0 = reference speed; 2.0 = twice as fast). None when the block
+    is missing or from an incompatible probe version — the ledger
+    marks such entries uncalibrated instead of guessing."""
+    if not calibration:
+        return None
+    if calibration.get("probe_version") != PROBE_VERSION:
+        return None
+    gflops = calibration.get("gemm_gflops")
+    if not gflops or gflops <= 0:
+        return None
+    return float(gflops) / REF_GEMM_GFLOPS

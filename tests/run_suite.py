@@ -84,7 +84,14 @@ Behaviour:
   ``atomic_write_json``, so the tier-1 DOTS_PASSED trend is diffable
   across PRs instead of scraped from logs. The sink module is loaded
   STANDALONE (importlib) because this orchestrator must never import
-  the package (``pychemkin_tpu/__init__`` imports jax).
+  the package (``pychemkin_tpu/__init__`` imports jax);
+- ``--perf-ledger PATH`` additionally banks the container-speed
+  calibration microprobe (``pychemkin_tpu/utils/calibration.py``,
+  importlib-standalone like the sink) alongside the suite verdict —
+  the fingerprint ``tools/perf_ledger.py`` divides out of perf
+  artifacts so cross-PR comparisons survive container drift. A
+  failed probe degrades the artifact (``calibration: null`` with the
+  error), never the suite verdict.
 
 ``pytest tests/`` (the driver's command) is re-exec'ed into this runner
 by the multi-file branch of ``pytest_configure`` in ``tests/conftest.py``,
@@ -129,6 +136,22 @@ def _sink_module():
         "pychemkin_tpu", "telemetry", "sink.py")
     spec = importlib.util.spec_from_file_location("_run_suite_sink",
                                                   path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _calibration_module():
+    """``pychemkin_tpu.utils.calibration`` loaded STANDALONE — same
+    never-import-the-package contract as the sink (stdlib + numpy
+    only; no jax)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pychemkin_tpu", "utils", "calibration.py")
+    spec = importlib.util.spec_from_file_location(
+        "_run_suite_calibration", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -291,6 +314,15 @@ def main(argv=None):
             return 2
         summary_json = argv[i + 1]
         del argv[i:i + 2]
+    perf_ledger_path = None
+    if "--perf-ledger" in argv:
+        i = argv.index("--perf-ledger")
+        if i + 1 >= len(argv):
+            print("run_suite: --perf-ledger needs a path",
+                  file=sys.stderr)
+            return 2
+        perf_ledger_path = argv[i + 1]
+        del argv[i:i + 2]
 
     here = os.path.dirname(os.path.abspath(__file__))
     selected, selectors, flags = _split_args(argv)
@@ -430,6 +462,34 @@ def main(argv=None):
         except OSError as exc:
             # a bad path degrades the artifact, never the verdict
             print(f"# run_suite: summary bank FAILED: {exc}",
+                  flush=True)
+
+    if perf_ledger_path:
+        # bank the calibration probe beside the suite verdict: the
+        # container fingerprint tools/perf_ledger.py needs to place
+        # this run on the normalized cross-PR perf trajectory
+        calibration = None
+        probe_error = None
+        try:
+            calibration = _calibration_module().probe()
+        except Exception as exc:  # noqa: BLE001 — artifact, not verdict
+            probe_error = f"{type(exc).__name__}: {exc}"
+        artifact = {
+            "t": time.time(),
+            "rc": suite_rc,
+            "dots_passed": sum(d for *_x, d in results),
+            "total_s": round(total, 3),
+            "calibration": calibration,
+        }
+        if probe_error:
+            artifact["calibration_error"] = probe_error
+        try:
+            _sink_module().atomic_write_json(perf_ledger_path,
+                                             artifact)
+            print("# run_suite: perf-ledger calibration banked to "
+                  f"{perf_ledger_path}", flush=True)
+        except OSError as exc:
+            print(f"# run_suite: perf-ledger bank FAILED: {exc}",
                   flush=True)
     return suite_rc
 

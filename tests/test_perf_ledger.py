@@ -1,0 +1,163 @@
+"""Calibrated perf-ledger tests (ISSUE 14): the container-speed
+microprobe, artifact extraction/normalization, and the --check
+regression gate — the missing cross-PR comparison spine for the
+committed ``BENCH_*`` / ``STEP_COST_*`` / ``BATCH_EFF_*`` artifacts.
+
+Everything here is jax-free by construction (the ledger and the probe
+must work from CI orchestrators that never import the package), so
+the file runs in seconds.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools import perf_ledger  # noqa: E402
+
+
+def _cal_module():
+    path = os.path.join(_REPO, "pychemkin_tpu", "utils",
+                        "calibration.py")
+    spec = importlib.util.spec_from_file_location("_t_cal", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCalibrationProbe:
+    def test_probe_shape_and_sanity(self):
+        cal = _cal_module()
+        p = cal.probe()
+        assert p["probe_version"] == cal.PROBE_VERSION
+        assert p["gemm_ms"] > 0 and p["gemm_gflops"] > 0
+        assert p["pyloop_ms"] > 0
+        # the loop result guards against dead-code elimination: a
+        # fixed workload has ONE right answer
+        assert p["pyloop_check"] == sum(i * i & 1023
+                                        for i in range(200_000))
+
+    def test_speed_factor(self):
+        cal = _cal_module()
+        assert cal.speed_factor(None) is None
+        assert cal.speed_factor({"probe_version": 99,
+                                 "gemm_gflops": 40.0}) is None
+        f = cal.speed_factor({"probe_version": cal.PROBE_VERSION,
+                              "gemm_gflops":
+                                  2 * cal.REF_GEMM_GFLOPS})
+        assert f == pytest.approx(2.0)
+
+
+class TestExtraction:
+    """The committed repo artifacts themselves are the fixtures: the
+    ledger must ingest the real history, not a synthetic one."""
+
+    def test_ingest_committed_artifacts(self):
+        ledger = perf_ledger.build_ledger(
+            perf_ledger.discover(_REPO))
+        assert ledger["n_entries"] >= 4
+        kinds = {e["kind"] for e in ledger["entries"]}
+        assert {"bench", "step_cost", "batch_eff"} <= kinds
+        for e in ledger["entries"]:
+            assert e["metrics"], e["artifact"]
+            # pre-ISSUE-14 artifacts carry no calibration: flagged,
+            # normalized None, never guessed
+            if not e["calibrated"]:
+                assert all(v is None
+                           for v in e["normalized"].values())
+
+    def test_step_cost_metrics(self):
+        entry = perf_ledger.extract(
+            os.path.join(_REPO, "STEP_COST_grisyn.json"))
+        assert entry["kind"] == "step_cost"
+        assert entry["mech"] == "grisyn"
+        assert entry["metrics"]["attempt_ms"] > 0
+
+    def test_unknown_file_is_skipped(self, tmp_path):
+        p = tmp_path / "weird.json"
+        p.write_text(json.dumps({"hello": 1}))
+        assert perf_ledger.extract(str(p)) is None
+        torn = tmp_path / "torn.json"
+        torn.write_text('{"parsed": {"value": ')
+        assert perf_ledger.extract(str(torn)) is None
+
+    def test_normalization_direction(self):
+        cal = _cal_module()
+        entry = {"kind": "step_cost", "platform": "cpu",
+                 "mech": "m", "B": 1, "artifact": "x.json",
+                 "metrics": {"attempt_ms": 10.0, "speedup_top": 3.0},
+                 "calibration": {
+                     "probe_version": cal.PROBE_VERSION,
+                     "gemm_gflops": 2 * cal.REF_GEMM_GFLOPS}}
+        out = perf_ledger._normalize(dict(entry), cal)
+        # a 2x-fast container: times double (as-if on the reference
+        # box), rates/speedups halve
+        assert out["normalized"]["attempt_ms"] == pytest.approx(20.0)
+        assert out["normalized"]["speedup_top"] == pytest.approx(1.5)
+
+
+class TestCheckGate:
+    @pytest.fixture()
+    def ledger(self):
+        return perf_ledger.build_ledger(perf_ledger.discover(_REPO))
+
+    def _fresh_capture(self, tmp_path, scale=1.0, with_cal=True):
+        """A fresh bench summary derived from the committed r04
+        capture, optionally degraded by ``scale``."""
+        doc = json.load(open(os.path.join(_REPO,
+                                          "BENCH_r04.json")))["parsed"]
+        doc = dict(doc)
+        doc["value"] = doc["value"] * scale
+        if with_cal:
+            doc["calibration"] = _cal_module().probe()
+        p = tmp_path / "fresh_capture.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_real_capture_passes(self, ledger, tmp_path):
+        rc, verdict = perf_ledger.check(
+            ledger, self._fresh_capture(tmp_path), band=1.5)
+        assert rc == 0
+        assert verdict["baseline"] == "BENCH_r04.json"
+        assert verdict["regressions"] == []
+        assert "throughput" in verdict["metrics"]
+
+    def test_synthetic_2x_regression_fails(self, ledger, tmp_path):
+        rc, verdict = perf_ledger.check(
+            ledger, self._fresh_capture(tmp_path, scale=0.5),
+            band=1.5)
+        assert rc == 1
+        assert "throughput" in verdict["regressions"]
+        assert verdict["metrics"]["throughput"]["worse_ratio"] == \
+            pytest.approx(2.0)
+
+    def test_no_baseline_passes_with_note(self, tmp_path):
+        empty = {"version": 1, "entries": []}
+        rc, verdict = perf_ledger.check(
+            empty, self._fresh_capture(tmp_path), band=1.5)
+        assert rc == 0
+        assert "no comparable baseline" in verdict["note"]
+
+    def test_unrecognizable_capture_rc2(self, ledger, tmp_path):
+        p = tmp_path / "junk.json"
+        p.write_text("{}")
+        rc, verdict = perf_ledger.check(ledger, str(p), band=1.5)
+        assert rc == 2 and "error" in verdict
+
+    def test_cli_roundtrip(self, tmp_path, capsys):
+        out = str(tmp_path / "ledger.json")
+        assert perf_ledger.main(["--root", _REPO, "--out", out]) == 0
+        banked = json.load(open(out))
+        assert banked["n_entries"] >= 4
+        cap = self._fresh_capture(tmp_path, scale=0.4)
+        rc = perf_ledger.main(["--ledger", out, "--check", cap])
+        assert rc == 1
+        verdict = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert verdict["regressions"] == ["throughput"]

@@ -101,6 +101,29 @@ def test_summary_json_banks_machine_readable_trend(tmp_path):
     assert sum(ln.count(".") for ln in dot_lines) == 3
 
 
+def test_perf_ledger_banks_calibration_probe(tmp_path):
+    """ISSUE 14 satellite: --perf-ledger banks the container-speed
+    calibration microprobe alongside the suite verdict — the
+    fingerprint tools/perf_ledger.py divides out of perf artifacts.
+    Jax-free by construction (the probe module loads standalone)."""
+    import json
+
+    f_ok = tmp_path / "test_ok.py"
+    f_ok.write_text("def test_a():\n    assert True\n")
+    out = str(tmp_path / "PERF.json")
+    r = _run([str(f_ok), "--perf-ledger", out, "-q"])
+    assert r.returncode == 0
+    assert f"perf-ledger calibration banked to {out}" in r.stdout
+    with open(out) as f:
+        artifact = json.load(f)
+    assert artifact["rc"] == 0
+    assert artifact["dots_passed"] == 1
+    cal = artifact["calibration"]
+    assert cal["probe_version"] == 1
+    assert cal["gemm_gflops"] > 0
+    assert cal["pyloop_ms"] > 0
+
+
 def test_summary_json_path_not_passed_to_children(tmp_path):
     """--summary-json PATH must be stripped from the child pytest
     argv (a nonexistent path would otherwise become a pytest arg)."""

@@ -126,12 +126,24 @@ class TestWireProtocol:
 
         res = make_result({"T": 1931.25, "Y": np.linspace(0, 1, 4)},
                           0, kind="equilibrium", bucket=8, occupancy=3,
-                          queue_wait_ms=1.25, solve_ms=7.5)
+                          queue_wait_ms=1.25, solve_ms=7.5,
+                          profile={"n_newton": 42, "n_steps": 10,
+                                   "dt_min": 1.25e-8,
+                                   "rescue_rung": 1})
         back = result_from_wire(json.loads(json.dumps(
             transport._jsonable(result_to_wire(res)))))
         assert back.status_name == "OK" and back.bucket == 8
         assert back.value["T"] == res.value["T"]
         np.testing.assert_array_equal(back.value["Y"], res.value["Y"])
+        # the solver-physics profile (ISSUE 14) rides the reply
+        # bit-exact — JSON-safe scalars by construction
+        assert back.profile == res.profile
+        # a LEGACY backend's reply has no profile key: the rebuilt
+        # result defaults it to None instead of crashing the client
+        legacy = transport._jsonable(result_to_wire(res))
+        legacy.pop("profile")
+        assert result_from_wire(
+            json.loads(json.dumps(legacy))).profile is None
 
 
 # ---------------------------------------------------------------------------
@@ -500,6 +512,56 @@ class TestChemtopMerge:
         legacy = chemtop.merge_fleet([self._reply(4, 1, [1.0])])
         assert legacy["schedule"] == {}
         assert "schedule[" not in chemtop.render(legacy)
+
+    def test_solver_panel_merges_and_legacy_renders_na(self):
+        """ISSUE-14: the solver panel — solve.* histograms merged
+        fleet-wide plus the per-backend predictor-calibration gauge.
+        A legacy profile-less backend contributes n/a entries and the
+        scrape/render never crash on the mix."""
+        from tools import chemtop
+
+        def hist(values):
+            h = telemetry.Histogram()
+            for v in values:
+                h.observe(v)
+            return h
+
+        a = self._reply(1, 10, [1.0])
+        b = self._reply(2, 5, [2.0])
+        legacy = self._reply(3, 2, [3.0])   # no solve.*, no gauge
+        for rep, newtons, corr in ((a, [5.0, 6.0], 0.82),
+                                   (b, [7.0], 0.57)):
+            h = hist(newtons)
+            rep["histogram_states"]["solve.newton_per_attempt"] = \
+                h.state()
+            rep["histograms"]["solve.newton_per_attempt"] = \
+                h.summary()
+            d = hist([31.5])   # dt_min in ns
+            rep["histogram_states"]["solve.dt_min_ns"] = d.state()
+            rep["gauges"] = {"schedule.predictor_corr": corr}
+        fleet = chemtop.merge_fleet([a, b, legacy])
+        sol = fleet["solver"]
+        # fleet percentiles from the MERGED distribution
+        ref = hist([5.0, 6.0, 7.0])
+        assert sol["newton_per_attempt"] == ref.summary()
+        assert sol["dt_min_ns"]["count"] == 2
+        # positional per-alive-backend gauge list; the legacy member
+        # is an explicit None, never dropped
+        assert sol["predictor_corr"] == [0.82, 0.57, None]
+        assert sol["steps_per_lane"] is None
+        out = chemtop.render(fleet)
+        assert "solver:" in out
+        assert "predictor_corr +0.82/+0.57" in out
+        assert "steps/lane p50 n/a" in out
+        # an all-legacy fleet has no solver line at all — and still
+        # merges and renders
+        old = chemtop.merge_fleet([self._reply(4, 1, [1.0])])
+        assert old["solver"]["newton_per_attempt"] is None
+        assert old["solver"]["predictor_corr"] == [None]
+        assert "solver:" not in chemtop.render(old)
+        # a dead backend contributes nothing to the gauge list
+        dead = chemtop.merge_fleet([a, {"port": 9, "error": "x"}])
+        assert dead["solver"]["predictor_corr"] == [0.82]
 
     def test_supervisor_block_folds_into_counters(self):
         from tools import chemtop

@@ -1,0 +1,416 @@
+"""Solver-depth observability tests (ISSUE 14): the in-kernel
+SolveProfile, its primal bit-identity contract, the serve-stack
+wiring, the mixed-kind solution_stats aggregation, and the
+predictor-calibration gauge.
+
+The central contract, property-tested on BOTH embedded mechanisms:
+``PYCHEMKIN_SOLVE_PROFILE`` is a trace-time decision that appends
+HARVESTED OUTPUTS only — every primal result (ignition times, states,
+success/status, step counters) is bit-identical with the profile on
+or off, including through the scheduled/compacted sweep and the
+rescue ladder.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pychemkin_tpu import parallel, schedule, serve, telemetry
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.ops import odeint, reactors
+from pychemkin_tpu.ops.odeint import SOLVE_PROFILE_ENV
+from pychemkin_tpu.resilience import faultinject, rescue
+from pychemkin_tpu.resilience.faultinject import FaultSpec
+from pychemkin_tpu.surrogate.dataset import phi_composition
+
+P_ATM = 1.01325e6
+
+
+@pytest.fixture(scope="module")
+def h2o2():
+    return load_embedded("h2o2")
+
+
+@pytest.fixture(scope="module")
+def grisyn():
+    return load_embedded("grisyn")
+
+
+@pytest.fixture(autouse=True)
+def _knob_off(monkeypatch):
+    """Each test starts with the profile knob unset; tests that want
+    it on set it explicitly."""
+    monkeypatch.delenv(SOLVE_PROFILE_ENV, raising=False)
+
+
+def _conditions(mech, B, seed=0):
+    rng = np.random.default_rng(seed)
+    T0s = rng.uniform(1000.0, 1400.0, B)
+    P0s = P_ATM * (1.0 + rng.uniform(0.0, 1.0, B))
+    Y0s = np.stack([phi_composition(mech, float(p))[0]
+                    for p in rng.uniform(0.6, 1.6, B)])
+    return T0s, P0s, Y0s
+
+
+# ---------------------------------------------------------------------------
+# the knob
+
+class TestKnob:
+    def test_default_off(self):
+        assert odeint.solve_profile_enabled() is False
+
+    def test_env_on(self, monkeypatch):
+        monkeypatch.setenv(SOLVE_PROFILE_ENV, "1")
+        assert odeint.solve_profile_enabled() is True
+
+
+# ---------------------------------------------------------------------------
+# primal bit-identity: solve_batch / sweeps
+
+class TestPrimalBitIdentity:
+    def test_solve_batch_h2o2(self, h2o2):
+        Y0 = phi_composition(h2o2, 1.0)[0]
+        kw = dict(n_out=11, rtol=1e-6, atol=1e-12)
+        off = reactors.solve_batch(h2o2, "CONP", "ENRG", 1200.0,
+                                   P_ATM, Y0, 2e-3, profile=False,
+                                   **kw)
+        on = reactors.solve_batch(h2o2, "CONP", "ENRG", 1200.0,
+                                  P_ATM, Y0, 2e-3, profile=True,
+                                  **kw)
+        for field in ("times", "T", "P", "volume", "Y",
+                      "ignition_time", "n_steps", "n_rejected",
+                      "n_newton", "status"):
+            assert np.array_equal(
+                np.asarray(getattr(off, field)),
+                np.asarray(getattr(on, field)),
+                equal_nan=True), field
+        assert off.profile is None
+        p = on.profile
+        assert float(p.dt_min) > 0
+        assert float(p.dt_final) > 0
+        assert float(p.stiffness) > 0
+        assert int(p.n_steps) == int(off.n_steps)
+
+    def test_vmapped_sweep_grisyn(self, grisyn):
+        """The GRI-scale mechanism, short horizon (the fast-lane
+        pattern of test_schedule): profiled jitted sweep bit-matches
+        the unprofiled one per lane."""
+        T0s, P0s, Y0s = _conditions(grisyn, 6)
+        t_ends = np.full(6, 2e-5)
+
+        def run(profile):
+            fn = jax.jit(lambda T, P, Y, te:
+                         reactors.ignition_delay_sweep(
+                             grisyn, "CONP", "ENRG", T, P, Y, te,
+                             profile=profile))
+            return fn(jnp.asarray(T0s), jnp.asarray(P0s),
+                      jnp.asarray(Y0s), jnp.asarray(t_ends))
+
+        t_off, ok_off, st_off = run(False)
+        t_on, ok_on, st_on, prof = run(True)
+        assert np.array_equal(np.asarray(t_off), np.asarray(t_on),
+                              equal_nan=True)
+        assert np.array_equal(np.asarray(ok_off), np.asarray(ok_on))
+        assert np.array_equal(np.asarray(st_off), np.asarray(st_on))
+        assert np.all(np.asarray(prof["stiffness"]) > 0)
+        assert np.all(np.asarray(prof["dt_min"])
+                      <= np.asarray(prof["dt_final"]))
+
+    def test_scheduled_sweep_with_rescue_h2o2(self, h2o2):
+        """The full ISSUE-14 property: a scheduled (sorted+compacted)
+        sweep with an injected nan_rhs failure produces bit-identical
+        primal results with the profile on vs off — through the
+        cohort permutation, the round-bounded kernel, AND the rescue
+        ladder re-solve."""
+        T0s, P0s, Y0s = _conditions(h2o2, 8)
+        t_ends = np.full(8, 2e-3)
+        mesh = parallel.make_mesh(1)
+        kw = dict(mesh=mesh, rtol=1e-6, atol=1e-12,
+                  max_steps_per_segment=20_000, chunk_size=8)
+        spec = FaultSpec(mode="nan_rhs", elements=(2,), heal_at=1)
+        results = {}
+        for mode in ("off", "on"):
+            if mode == "on":
+                os.environ[SOLVE_PROFILE_ENV] = "1"
+            else:
+                os.environ.pop(SOLVE_PROFILE_ENV, None)
+            try:
+                with faultinject.inject(spec):
+                    t, ok, st = parallel.sharded_ignition_sweep(
+                        h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+                        schedule="sorted", **kw)
+                    times, okr, str_, rep = \
+                        rescue.resilient_ignition_sweep(
+                            h2o2, "CONP", "ENRG", T0s, P0s, Y0s,
+                            t_ends, rtol=1e-6, atol=1e-12,
+                            max_steps_per_segment=20_000,
+                            base_results={"times": np.array(t),
+                                          "ok": np.array(ok),
+                                          "status": np.array(st)})
+            finally:
+                os.environ.pop(SOLVE_PROFILE_ENV, None)
+            assert rep.n_failed == 1 and rep.n_rescued == 1
+            results[mode] = (np.asarray(t), np.asarray(st),
+                             np.asarray(times), np.asarray(str_))
+        for a, b in zip(results["off"], results["on"]):
+            assert np.array_equal(a, b, equal_nan=True)
+
+    def test_compacted_profile_keys_h2o2(self, h2o2):
+        T0s, P0s, Y0s = _conditions(h2o2, 4)
+        t_ends = np.full(4, 1e-4)
+        os.environ[SOLVE_PROFILE_ENV] = "1"
+        try:
+            out = schedule.compacted_ignition_sweep(
+                h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+                ladder=(8,), round_len=5000)
+        finally:
+            os.environ.pop(SOLVE_PROFILE_ENV, None)
+        for key in ("dt_min", "dt_final", "stiffness"):
+            assert out[key].shape == (4,)
+            assert np.all(np.isfinite(out[key])), key
+        assert np.all(out["dt_min"] <= out["dt_final"])
+
+
+# ---------------------------------------------------------------------------
+# serve-stack wiring
+
+class TestServeWiring:
+    def _server(self, mech, rec):
+        return serve.ChemServer(
+            mech, bucket_sizes=(1, 8), max_batch_size=8,
+            recorder=rec,
+            engine_config={"ignition": {
+                "rtol": 1e-6, "atol": 1e-10,
+                "max_steps_per_segment": 4000}})
+
+    def test_dispatch_span_and_histograms_and_result(self, h2o2,
+                                                     monkeypatch):
+        monkeypatch.setenv(SOLVE_PROFILE_ENV, "1")
+        Y0 = phi_composition(h2o2, 1.0)[0]
+        rec = telemetry.MetricsRecorder()
+        server = self._server(h2o2, rec)
+        server.warmup(["ignition"])
+        with server:
+            res = server.submit_ignition(
+                T0=1250.0, P0=P_ATM, Y0=Y0,
+                t_end=4e-4).result(timeout=300)
+        # the ServeResult carries this lane's physics, JSON-safe
+        prof = res.profile
+        assert prof is not None
+        assert prof["n_newton"] > 0
+        assert 0 < prof["dt_min"] <= prof["dt_final"]
+        assert prof["stiffness"] > 0
+        # the dispatch span bottoms out in the same physics
+        disp = [ev for ev in rec.events("trace.span")
+                if ev["span"] == "serve.dispatch"]
+        assert disp and disp[-1]["n_newton"] == prof["n_newton"]
+        assert disp[-1]["dt_min"] == prof["dt_min"]
+        # the solve.* fleet histograms observed the lane (dt in ns so
+        # stiff steps land inside the shared log-bucket range and
+        # survive the 6-decimal summary rounding)
+        for name in ("solve.newton_per_attempt", "solve.dt_min_ns",
+                     "solve.steps_per_lane"):
+            assert rec.histogram_summary(name)["count"] >= 1, name
+        dt_h = rec.histogram_summary("solve.dt_min_ns")
+        assert dt_h["p50"] == pytest.approx(prof["dt_min"] * 1e9,
+                                            rel=1e-6)
+
+    def test_profile_off_no_profile_no_new_compiles(self, h2o2):
+        """Knob off: results carry no profile, no solve.* series
+        exist, and warmed traffic triggers ZERO new compiles — the
+        profile machinery is invisible until asked for."""
+        Y0 = phi_composition(h2o2, 1.0)[0]
+        rec = telemetry.MetricsRecorder()
+        server = self._server(h2o2, rec)
+        server.warmup(["ignition"])
+        compiles_before = rec.counters.get("serve.compiles", 0)
+        with server:
+            res = server.submit_ignition(
+                T0=1250.0, P0=P_ATM, Y0=Y0,
+                t_end=4e-4).result(timeout=300)
+        assert res.profile is None
+        assert rec.counters.get("serve.compiles", 0) == \
+            compiles_before
+        assert rec.histogram_summary(
+            "solve.newton_per_attempt") == {"count": 0}
+
+    def test_rescued_result_stamps_rescue_rung(self, h2o2,
+                                               monkeypatch):
+        """A hot-path failure resolved by the ladder carries the hot
+        solve's physics plus the rung that finally fixed it."""
+        monkeypatch.setenv(SOLVE_PROFILE_ENV, "1")
+        monkeypatch.setenv(
+            "PYCHEMKIN_FAULTS",
+            '[{"mode": "nan_rhs", "elements": [0], "heal_at": 1}]')
+        Y0 = phi_composition(h2o2, 1.0)[0]
+        rec = telemetry.MetricsRecorder()
+        server = self._server(h2o2, rec)
+        server.warmup(["ignition"])
+        with server:
+            res = server.submit_ignition(
+                T0=1250.0, P0=P_ATM, Y0=Y0,
+                t_end=4e-4).result(timeout=300)
+        assert res.rescued and res.rescue_rungs == 1
+        assert res.profile is not None
+        assert res.profile["rescue_rung"] == 1
+
+    def test_equilibrium_has_no_profile(self, h2o2, monkeypatch):
+        """A kind without an in-kernel profile (fixed-iteration
+        equilibrium Newton) resolves with profile None even when the
+        knob is on — n/a, never fabricated."""
+        monkeypatch.setenv(SOLVE_PROFILE_ENV, "1")
+        Y0 = phi_composition(h2o2, 1.0)[0]
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(h2o2, bucket_sizes=(1, 8),
+                                  max_batch_size=8, recorder=rec)
+        server.warmup(["equilibrium"])
+        with server:
+            res = server.submit_equilibrium(
+                T=1500.0, P=P_ATM, Y=Y0).result(timeout=300)
+        assert res.ok
+        assert res.profile is None
+
+    def test_psr_profile_carries_newton(self, h2o2, monkeypatch):
+        monkeypatch.setenv(SOLVE_PROFILE_ENV, "1")
+        Y0 = phi_composition(h2o2, 1.0)[0]
+        rec = telemetry.MetricsRecorder()
+        server = serve.ChemServer(h2o2, bucket_sizes=(1, 8),
+                                  max_batch_size=8, recorder=rec)
+        server.warmup(["psr"])
+        with server:
+            res = server.submit_psr(
+                tau=1e-3, P=P_ATM, Y_in=Y0,
+                T_in=1000.0).result(timeout=300)
+        assert res.profile is not None
+        assert res.profile["n_newton"] > 0
+
+
+# ---------------------------------------------------------------------------
+# mixed-kind solution_stats (ISSUE-14 satellite)
+
+class TestSolutionStats:
+    def _sol(self, n_newton):
+        return odeint.ODESolution(
+            ts=np.array([0.0, 1.0]), ys=np.zeros((2, 3)),
+            event_times=np.array([np.nan]),
+            event_values=np.array([0.0]),
+            n_steps=np.array([10, 20]),
+            n_rejected=np.array([1, 2]),
+            success=np.array([True, True]),
+            stalled=np.array([False, False]),
+            n_newton=n_newton, status=np.array([0, 0]))
+
+    def test_mixed_aggregation_explicit(self):
+        rec = telemetry.MetricsRecorder()
+        tracked = self._sol(np.array([40, 60]))
+        untracked = self._sol(None)
+        stats = odeint.solution_stats([tracked, untracked],
+                                      kind="batch", recorder=rec)
+        assert stats["n_elements"] == 4
+        assert stats["n_steps"] == 60
+        # tracked Newton work sums; the untracked elements are
+        # counted explicitly, never silently dropped
+        assert stats["n_newton"] == 100
+        assert stats["n_newton_untracked"] == 2
+        assert rec.counters["odeint.newton"] == 100
+        assert rec.counters["odeint.newton.batch"] == 100
+        assert rec.counters["odeint.newton_untracked"] == 2
+
+    def test_all_untracked_is_none_plus_counter(self):
+        rec = telemetry.MetricsRecorder()
+        stats = odeint.solution_stats(self._sol(None), recorder=rec)
+        assert stats["n_newton"] is None
+        assert stats["n_newton_untracked"] == 2
+        assert "odeint.newton" not in rec.counters
+        assert rec.counters["odeint.newton_untracked"] == 2
+
+    def test_single_tracked_unchanged(self):
+        rec = telemetry.MetricsRecorder()
+        stats = odeint.solution_stats(self._sol(np.array([4, 6])),
+                                      recorder=rec)
+        assert stats["n_newton"] == 10
+        assert stats["n_newton_untracked"] == 0
+        assert rec.counters["odeint.newton"] == 10
+        assert "odeint.newton_untracked" not in rec.counters
+
+
+# ---------------------------------------------------------------------------
+# predictor calibration (spearman + banking)
+
+class TestPredictorCalibration:
+    def test_spearman_monotone(self):
+        assert schedule.spearman([1, 2, 3, 4], [10, 20, 30, 99]) \
+            == pytest.approx(1.0)
+        assert schedule.spearman([1, 2, 3, 4], [9, 3, 2, 1]) \
+            == pytest.approx(-1.0)
+
+    def test_spearman_nan_and_degenerate(self):
+        # NaNs drop pairwise; < 3 finite pairs or constant side = None
+        assert schedule.spearman(
+            [1, 2, np.nan, 4, 5],
+            [2, 4, 9, 8, 10]) == pytest.approx(1.0)
+        assert schedule.spearman([1, 2], [3, 4]) is None
+        assert schedule.spearman([1, 1, 1], [1, 2, 3]) is None
+
+    def test_spearman_ties_average(self):
+        # tied predictions must not manufacture (dis)agreement
+        r = schedule.spearman([1, 1, 2, 2], [1, 2, 3, 4])
+        assert r == pytest.approx(0.8944, abs=1e-3)
+
+    def test_bank_gauge_event_and_job_report(self):
+        rec = telemetry.MetricsRecorder()
+        job = {}
+        corr = schedule.bank_predictor_calibration(
+            [1.0, 2.0, 3.0, 4.0], [10, 30, 20, 90],
+            recorder=rec, label="t", job_report=job)
+        assert corr == pytest.approx(0.8)
+        assert rec.gauges["schedule.predictor_corr"] == \
+            pytest.approx(0.8)
+        ev = rec.last_event("schedule.calibration")
+        assert ev["n"] == 4 and ev["n_measured"] == 4
+        assert job["predictor_corr"] == pytest.approx(0.8)
+
+    def test_bank_no_signal_keeps_gauge_unset(self):
+        rec = telemetry.MetricsRecorder()
+        job = {}
+        corr = schedule.bank_predictor_calibration(
+            [1.0, 2.0], [np.nan, np.nan], recorder=rec,
+            job_report=job)
+        assert corr is None
+        assert "schedule.predictor_corr" not in rec.gauges
+        assert rec.last_event("schedule.calibration")[
+            "predictor_corr"] is None
+        assert job["predictor_corr"] is None
+
+    def test_scheduled_sweep_banks_corr(self, h2o2):
+        T0s, P0s, Y0s = _conditions(h2o2, 8)
+        rec = telemetry.get_recorder()
+        job = {}
+        parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, np.full(8, 2e-3),
+            mesh=parallel.make_mesh(1), schedule="sorted",
+            chunk_size=8, job_report=job)
+        assert "predictor_corr" in job
+        ev = rec.last_event("schedule.calibration")
+        assert ev is not None and ev["n"] == 8
+        if job["predictor_corr"] is not None:
+            assert -1.0 <= job["predictor_corr"] <= 1.0
+            snap = rec.snapshot(write=False)
+            assert snap["gauges"]["schedule.predictor_corr"] == \
+                job["predictor_corr"]
+
+    def test_static_sweep_banks_nothing(self, h2o2):
+        T0s, P0s, Y0s = _conditions(h2o2, 4)
+        rec = telemetry.MetricsRecorder()
+        job = {}
+        # a recorder-less static sweep emits on the default recorder;
+        # assert via job_report only (no scheduling = no calibration)
+        parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, np.full(4, 1e-4),
+            mesh=parallel.make_mesh(1), schedule="static",
+            chunk_size=4, job_report=job)
+        assert "predictor_corr" not in job
+        assert rec.counters == {}

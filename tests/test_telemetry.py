@@ -509,7 +509,8 @@ RUNG_SCHEMA_KEYS = (
     "throughput", "rtol", "atol", "t_end", "n_ok", "n_ignited",
     "n_steps", "n_rejected", "n_newton", "steps_per_sec",
     "model_f32_gflop", "model_f64_gflop", "mfu_pct",
-    "jac_mode", "rop_mode", "schedule",
+    "jac_mode", "rop_mode", "schedule", "solve_profile",
+    "calibration",
     "nu_nnz_frac", "n_species_active",
     "n_failed", "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
@@ -518,11 +519,25 @@ RUNG_SCHEMA_KEYS = (
 #: rung keys that _build_summary must forward into configs_run
 CONFIGS_RUN_KEYS = (
     "mech", "B", "chunk", "throughput", "mfu_pct", "n_failed",
-    "jac_mode", "rop_mode", "schedule",
+    "jac_mode", "rop_mode", "schedule", "solve_profile",
     "nu_nnz_frac", "n_species_active",
     "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
 )
+
+#: the container-speed calibration block every rung banks (ISSUE 14:
+#: pychemkin_tpu/utils/calibration.py — what tools/perf_ledger.py
+#: divides out of the cross-PR trajectory)
+CALIBRATION_KEYS = (
+    "probe_version", "gemm_n", "gemm_ms", "gemm_gflops", "pyloop_ms",
+)
+
+
+def _fake_calibration():
+    return {"probe_version": 1, "gemm_n": 256, "gemm_ms": 0.7,
+            "gemm_gflops": 48.0, "pyloop_ms": 12.0,
+            "pyloop_check": 93099232, "machine": "x86_64",
+            "t": 1e9}
 
 
 def _fake_config_result(mech, B, platform="tpu", n_failed=0):
@@ -535,7 +550,8 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
         "n_rejected": B, "n_newton": 400 * B, "steps_per_sec": 1e5,
         "model_f32_gflop": 1.0, "model_f64_gflop": 0.1, "mfu_pct": 1.5,
         "jac_mode": "analytic", "rop_mode": "dense",
-        "schedule": "static",
+        "schedule": "static", "solve_profile": "off",
+        "calibration": _fake_calibration(),
         "nu_nnz_frac": 0.32, "n_species_active": 10,
         "n_failed": n_failed, "n_rescued": max(n_failed - 1, 0),
         "n_abandoned": min(n_failed, 1),
@@ -562,6 +578,8 @@ SERVE_RUNG_KEYS = (
     "mean_occupancy", "max_occupancy",
     "trace_sample", "untraced_p50_ms", "trace_overhead_pct",
     "trace_stage_breakdown", "trace_exemplars",
+    "profile_p50_ms", "profile_overhead_pct",
+    "n_profiled_dispatch_spans", "calibration",
 )
 
 
@@ -584,6 +602,9 @@ def _fake_serve_result():
         "mean_occupancy": 2.2, "max_occupancy": 4,
         "trace_sample": 1.0, "untraced_p50_ms": 9.8,
         "trace_overhead_pct": 2.04,
+        "profile_p50_ms": 10.2, "profile_overhead_pct": 2.0,
+        "n_profiled_dispatch_spans": 9,
+        "calibration": _fake_calibration(),
         "trace_stage_breakdown": {
             "serve.dispatch": {"count": 9, "p50_ms": 8.0,
                                "p99_ms": 9.5}},
@@ -603,6 +624,7 @@ SURROGATE_RUNG_KEYS = (
     "train_steps", "n_members", "final_losses", "label_s", "train_s",
     "warmup_s", "hit_rate", "surrogate_p50_ms", "solver_p50_ms",
     "speedup_p50", "bucket", "gate", "compiles", "residual",
+    "calibration",
     "n_requests", "n_served", "n_surrogate_hit",
     "n_surrogate_fallback", "status_counts", "p50_ms", "p99_ms",
 )
@@ -622,6 +644,7 @@ def _fake_surrogate_result():
         "compiles": 7,
         "residual": {"count": 32, "p50": 0.0007, "p95": 0.0015,
                      "p99": 0.0017},
+        "calibration": _fake_calibration(),
         "n_requests": 32, "n_served": 32, "n_rejected": 0,
         "n_rejected_with_hint": 0, "n_timeout": 0, "n_error": 0,
         "n_rescued": 0, "n_surrogate_hit": 32,
@@ -642,7 +665,7 @@ BATCH_EFF_RUNG_KEYS = (
     "atol", "seed", "T_range", "phi_range", "max_steps",
     "chunk_static", "chunk_sched", "round_len",
     "per_B", "speedup_top", "sched_top_vs_b64", "static_top_vs_b64",
-    "answers_match", "cohorts", "compactions",
+    "answers_match", "cohorts", "compactions", "calibration",
 )
 
 #: keys of each per_B twin row in the batch_efficiency rung
@@ -678,6 +701,32 @@ def _fake_batch_eff_result():
         "speedup_top": 3.05, "sched_top_vs_b64": 1.06,
         "static_top_vs_b64": 1.07, "answers_match": True,
         "cohorts": 20, "compactions": 12,
+        "calibration": _fake_calibration(),
+    }
+
+
+#: every key the profile_overhead rung JSON must carry (ISSUE 14):
+#: the profile-off/profile-on twin timings, the <= 5% overhead bound's
+#: evidence, and the primal bitwise-identity verdict
+PROFILE_RUNG_KEYS = (
+    "rung", "platform", "mech", "B", "t_end", "rtol", "atol",
+    "max_steps", "run_off_s", "run_on_s", "compile_off_s",
+    "compile_on_s", "profile_overhead_pct", "primal_bit_match",
+    "n_lanes_profiled", "dt_min_min", "stiffness_max", "calibration",
+)
+
+
+def _fake_profile_result():
+    return {
+        "rung": "profile_overhead", "platform": "cpu",
+        "mech": "grisyn", "B": 64, "t_end": 0.05, "rtol": 1e-6,
+        "atol": 1e-12, "max_steps": 20_000,
+        "run_off_s": 10.0, "run_on_s": 10.3,
+        "compile_off_s": 20.0, "compile_on_s": 22.0,
+        "profile_overhead_pct": 3.0, "primal_bit_match": True,
+        "n_lanes_profiled": 64, "dt_min_min": 2.1e-8,
+        "stiffness_max": 8.9e11,
+        "calibration": _fake_calibration(),
     }
 
 
@@ -709,6 +758,8 @@ class TestBenchBanking:
                 return 0, _fake_surrogate_result(), ""
             if args[0] == "batch_eff":
                 return 0, _fake_batch_eff_result(), ""
+            if args[0] == "profile_overhead":
+                return 0, _fake_profile_result(), ""
             assert args[0] == "config"
             i = calls["n"]
             calls["n"] += 1
@@ -757,6 +808,15 @@ class TestBenchBanking:
             for key in BATCH_EFF_ROW_KEYS:
                 assert key in row, f"batch_eff row missing {key}"
         assert all("batch_efficiency" not in s for s in summaries[:-1])
+        # ... and the profile_overhead rung (ISSUE 14), calibration
+        # block included
+        prof_rung = summaries[-1]["profile_overhead"]
+        for key in PROFILE_RUNG_KEYS:
+            assert key in prof_rung, f"profile rung missing {key}"
+        for key in CALIBRATION_KEYS:
+            assert key in prof_rung["calibration"], \
+                f"calibration block missing {key}"
+        assert all("profile_overhead" not in s for s in summaries[:-1])
         # configs_run schema: the resilience counters ride along into
         # every banked summary (partial lines included)
         for summary in summaries:
@@ -885,6 +945,13 @@ class TestBenchRungSchema:
         # ISSUE 11: the rung says which primal ROP kernel it timed
         # (resolved PYCHEMKIN_ROP_MODE: sparse on this CPU child)
         assert rung["rop_mode"] in ("sparse", "dense")
+        # ISSUE 14: the rung says whether its timing paid the solve
+        # profile, and carries the container-speed fingerprint
+        assert rung["solve_profile"] in ("on", "off")
+        for key in CALIBRATION_KEYS:
+            assert key in rung["calibration"], \
+                f"calibration block missing {key}"
+        assert rung["calibration"]["gemm_gflops"] > 0
 
 
 class TestServeRungSchema:
@@ -928,6 +995,27 @@ class TestBatchEffRungSchema:
         assert rung["answers_match"] is True
         assert rung["cohorts"] >= 2
         assert rung["schedule"] == "sorted"
+
+
+class TestProfileRungSchema:
+    @pytest.mark.slow
+    def test_child_profile_overhead_emits_full_schema_on_cpu(
+            self, capfd):
+        """The REAL profile_overhead child must emit every schema key
+        and clear the ISSUE-14 primal contract on this CPU: the
+        profiled twin's (times, ok, status) bit-match the unprofiled
+        twin's (tiny h2o2 twins keep the cost bounded; the official
+        grisyn B=64 params run in the bench)."""
+        benchmarks._child_profile_overhead("h2o2", 8)
+        rung = _summary_lines(capfd.readouterr().out)[-1]
+        for key in PROFILE_RUNG_KEYS:
+            assert key in rung, f"missing profile rung key {key}"
+        assert rung["rung"] == "profile_overhead"
+        assert rung["primal_bit_match"] is True
+        assert rung["n_lanes_profiled"] == 8
+        assert rung["profile_overhead_pct"] is not None
+        assert 0 < rung["dt_min_min"] < rung["t_end"]
+        assert rung["stiffness_max"] > 0
 
 
 class TestScheduleTelemetry:

@@ -315,6 +315,8 @@ def run_ablation(mech_name: str, B: int, repeats: int,
             "jac_analytic_f32" if mixed else "jac_analytic_f64",
             "lu_bordered", "rhs_f64", "solve_bordered")
 
+    from pychemkin_tpu.utils import calibration as _calibration
+
     out = {
         "tool": "ablate_step_cost",
         "platform": jax.devices()[0].platform,
@@ -322,6 +324,9 @@ def run_ablation(mech_name: str, B: int, repeats: int,
         "B": B,
         "n_state": N,
         "repeats": repeats,
+        # container-speed fingerprint: lets tools/perf_ledger.py
+        # place this capture on the normalized cross-PR trajectory
+        "calibration": _calibration.probe(),
         "components": components,
         "sparsity": jacobian.sparsity_stats(mech),
         "newton_measured": newton_measured,

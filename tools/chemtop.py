@@ -73,6 +73,7 @@ def merge_fleet(replies: List[Dict]) -> Dict:
     tenants: Dict[str, Dict[str, int]] = {}
     hist_states: Dict[str, List[Dict]] = {}
     sched_by_mech: Dict[str, List[Dict]] = {}
+    predictor_corr: List[Optional[float]] = []
     backends = []
     for rep in replies:
         row = {"port": rep.get("port"), "pid": rep.get("pid"),
@@ -94,6 +95,11 @@ def merge_fleet(replies: List[Dict]) -> Dict:
                     + int(sup.get(k, 0)))
         if rep.get("error"):
             continue
+        # per-backend predictor-calibration gauge (None for a legacy
+        # or sweep-less backend — rendered n/a, never dropped, so the
+        # list stays positional with the alive backends)
+        predictor_corr.append(
+            (rep.get("gauges") or {}).get("schedule.predictor_corr"))
         for k, v in (rep.get("counters") or {}).items():
             counters[k] = counters.get(k, 0) + int(v)
         for name, t in (rep.get("tenants") or {}).items():
@@ -118,6 +124,18 @@ def merge_fleet(replies: List[Dict]) -> Dict:
     }
     histograms = {name: telemetry.merge_histogram_states(states)
                   for name, states in sorted(hist_states.items())}
+    # solver panel: the below-dispatch physics a profiled fleet
+    # exposes (PYCHEMKIN_SOLVE_PROFILE) — merged solve.* histograms
+    # plus the per-backend predictor-calibration gauge. A legacy
+    # profile-less backend contributes None entries; the panel (and
+    # render) shows n/a instead of crashing the scrape.
+    solver = {
+        "newton_per_attempt": histograms.get(
+            "solve.newton_per_attempt"),
+        "dt_min_ns": histograms.get("solve.dt_min_ns"),
+        "steps_per_lane": histograms.get("solve.steps_per_lane"),
+        "predictor_corr": predictor_corr,
+    }
     # adaptive-ladder state per mechanism: window/cap per backend
     # (they adapt independently), ladder from the first answering
     # backend, per-bucket occupancy p50 from the MERGED fleet
@@ -146,6 +164,7 @@ def merge_fleet(replies: List[Dict]) -> Dict:
         "tenants": tenants,
         "surrogate": surrogate,
         "schedule": schedule,
+        "solver": solver,
         "histograms": histograms,
     }
 
@@ -189,6 +208,28 @@ def render(snapshot: Dict) -> str:
             f"cap {'/'.join(str(c) for c in s['max_batch'])}  "
             f"ladder {s['ladder']}"
             + (f"  occ_p50 {occ}" if occ else ""))
+    sol = snapshot.get("solver") or {}
+    corr = [c for c in (sol.get("predictor_corr") or [])
+            if c is not None]
+    has_series = any((sol.get(k) or {}).get("count")
+                     for k in ("newton_per_attempt", "dt_min_ns",
+                               "steps_per_lane"))
+    if has_series or corr:
+        # the solver panel: per-lane physics merged fleet-wide.
+        # Missing series (a legacy profile-less backend, or the knob
+        # off) render as n/a — a mixed fleet must stay scrapeable.
+        def _p50(key):
+            h = sol.get(key)
+            return (f"{h['p50']:.3g}" if h and h.get("count")
+                    else "n/a")
+
+        corr_txt = ("/".join(f"{c:+.2f}" for c in corr)
+                    if corr else "n/a")
+        lines.append(
+            f"  solver: newton/attempt p50 {_p50('newton_per_attempt')}"
+            f"  dt_min p50 {_p50('dt_min_ns')}ns"
+            f"  steps/lane p50 {_p50('steps_per_lane')}"
+            f"  predictor_corr {corr_txt}")
     for name in ("serve.queue_wait_ms", "serve.solve_ms"):
         h = snapshot["histograms"].get(name)
         if h and h.get("count"):

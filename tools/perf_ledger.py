@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""perf_ledger — the calibrated cross-PR performance ledger.
+
+Every round has banked perf artifacts (``BENCH_r*.json`` bench
+summaries, ``STEP_COST_*.json`` step-cost ablations,
+``BATCH_EFF_*.json`` batch-efficiency rungs), and every round's notes
+carry the same caveat: the container speed drifted, so raw numbers
+from different captures do not compare. This tool turns those
+artifacts into ONE normalized time series and gives CI the missing
+cross-PR regression gate:
+
+- **ingest** (default): scan the repo root (or ``--artifacts`` paths)
+  for known artifact families, extract each one's headline metrics,
+  divide out the container speed wherever the artifact carries a
+  ``calibration`` block (the fixed microprobe of
+  ``pychemkin_tpu/utils/calibration.py`` — banked into every rung
+  since ISSUE 14; older artifacts ride along flagged
+  ``calibrated: false``), and write the ledger JSON
+  (``--out``, default ``PERF_LEDGER.json``).
+
+- ``--check CAPTURE``: compare a fresh capture (a bench summary from
+  ``BENCH_BANK_PATH``, or any single artifact of a known family)
+  against the committed ledger's most recent comparable entry — same
+  family, mechanism, and platform. A metric that regresses beyond the
+  noise band (``--band``, default 1.5x — the stated tolerance for
+  timer noise plus residual calibration error) fails with rc 1 and
+  names the metric, the baseline artifact, and both values. When both
+  sides carry a calibration block the comparison is between
+  NORMALIZED values (container drift divided out); otherwise it falls
+  back to raw values and says so.
+
+Usage::
+
+    python tools/perf_ledger.py --out PERF_LEDGER.json
+    python tools/perf_ledger.py --check /tmp/bench_bank.json
+    python tools/perf_ledger.py --check BENCH_r05.json --band 2.0
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: ledger schema version
+LEDGER_VERSION = 1
+
+#: metric name -> better direction. "lower" metrics normalize by
+#: MULTIPLYING with the container speed factor (time as-if on the
+#: reference container), "higher" metrics by dividing.
+METRIC_DIRECTIONS: Dict[str, str] = {
+    "throughput": "higher",
+    "steps_per_sec": "higher",
+    "serve_p50_ms": "lower",
+    "serve_p99_ms": "lower",
+    "surrogate_p50_ms": "lower",
+    "attempt_ms": "lower",
+    "attempt_ms_measured": "lower",
+    "static_ms_per_elem_top": "lower",
+    "sched_ms_per_elem_top": "lower",
+    "speedup_top": "higher",
+}
+
+
+def _calibration_module():
+    """``pychemkin_tpu/utils/calibration.py`` loaded STANDALONE (the
+    ledger must work without importing the jax-importing package
+    ``__init__`` — same contract as run_suite's sink loading)."""
+    path = os.path.join(_REPO, "pychemkin_tpu", "utils",
+                        "calibration.py")
+    spec = importlib.util.spec_from_file_location("_perf_ledger_cal",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# extractors: one per artifact family -> (kind, context, metrics,
+# calibration) or None when the file is not of this family / carries
+# no usable numbers
+
+def _bench_summary(doc: Dict) -> Optional[Dict]:
+    """A bench summary: either the banked ``BENCH_BANK_PATH`` /
+    stdout-summary shape (flat, with ``metric``/``value``) or the
+    committed ``BENCH_r*.json`` wrapper (``{"parsed": summary}``)."""
+    summary = doc.get("parsed") if isinstance(doc.get("parsed"),
+                                              dict) else doc
+    if not isinstance(summary, dict) or "value" not in summary \
+            or "metric" not in summary:
+        return None
+    metrics: Dict[str, float] = {}
+    if summary.get("value"):
+        metrics["throughput"] = float(summary["value"])
+    if summary.get("steps_per_sec"):
+        metrics["steps_per_sec"] = float(summary["steps_per_sec"])
+    serve = summary.get("serve_latency") or {}
+    for src, dst in (("p50_ms", "serve_p50_ms"),
+                     ("p99_ms", "serve_p99_ms")):
+        if serve.get(src) is not None:
+            metrics[dst] = float(serve[src])
+    sur = summary.get("surrogate_latency") or {}
+    if sur.get("surrogate_p50_ms") is not None:
+        metrics["surrogate_p50_ms"] = float(sur["surrogate_p50_ms"])
+    if not metrics:
+        return None
+    # mech rides inside the headline metric string ("... (grisyn, ...")
+    mech = None
+    m = summary.get("metric", "")
+    if "(" in m:
+        mech = m.split("(", 1)[1].split(",", 1)[0].strip() or None
+    return {"kind": "bench",
+            "platform": summary.get("platform"),
+            "mech": mech, "B": summary.get("B"),
+            "metrics": metrics,
+            "calibration": summary.get("calibration")}
+
+
+def _step_cost(doc: Dict) -> Optional[Dict]:
+    if doc.get("tool") != "ablate_step_cost":
+        return None
+    am = doc.get("attempt_model") or {}
+    metrics: Dict[str, float] = {}
+    if am.get("attempt_s"):
+        metrics["attempt_ms"] = float(am["attempt_s"]) * 1e3
+    if am.get("attempt_s_measured"):
+        metrics["attempt_ms_measured"] = \
+            float(am["attempt_s_measured"]) * 1e3
+    if not metrics:
+        return None
+    return {"kind": "step_cost", "platform": doc.get("platform"),
+            "mech": doc.get("mech"), "B": doc.get("B"),
+            "metrics": metrics,
+            "calibration": doc.get("calibration")}
+
+
+def _batch_eff(doc: Dict) -> Optional[Dict]:
+    if doc.get("rung") != "batch_efficiency":
+        return None
+    per_B = doc.get("per_B") or []
+    metrics: Dict[str, float] = {}
+    if per_B:
+        top = max(per_B, key=lambda r: r.get("B", 0))
+        for src, dst in (("static_ms_per_elem",
+                          "static_ms_per_elem_top"),
+                         ("sched_ms_per_elem",
+                          "sched_ms_per_elem_top")):
+            if top.get(src) is not None:
+                metrics[dst] = float(top[src])
+    if doc.get("speedup_top") is not None:
+        metrics["speedup_top"] = float(doc["speedup_top"])
+    if not metrics:
+        return None
+    return {"kind": "batch_eff", "platform": doc.get("platform"),
+            "mech": doc.get("mech"), "B": None,
+            "metrics": metrics,
+            "calibration": doc.get("calibration")}
+
+
+_EXTRACTORS = (_bench_summary, _step_cost, _batch_eff)
+
+
+def extract(path: str) -> Optional[Dict]:
+    """One artifact file -> one ledger entry (or None when the file is
+    not a known perf-artifact family). Unreadable/torn files yield
+    None — a ledger build must survive one bad artifact."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    for ex in _EXTRACTORS:
+        entry = ex(doc)
+        if entry is not None:
+            entry["artifact"] = os.path.basename(path)
+            return entry
+    return None
+
+
+def _normalize(entry: Dict, cal_mod) -> Dict:
+    """Attach ``speed_factor``/``calibrated``/``normalized`` to one
+    extracted entry. Lower-is-better metrics scale UP on a fast
+    container (time as-if on the reference box); higher-is-better
+    scale down."""
+    factor = cal_mod.speed_factor(entry.get("calibration"))
+    entry["speed_factor"] = (round(factor, 4)
+                             if factor is not None else None)
+    entry["calibrated"] = factor is not None
+    normalized: Dict[str, Optional[float]] = {}
+    for name, raw in entry["metrics"].items():
+        if factor is None:
+            normalized[name] = None
+        elif METRIC_DIRECTIONS.get(name) == "higher":
+            normalized[name] = round(raw / factor, 4)
+        else:
+            normalized[name] = round(raw * factor, 4)
+    entry["normalized"] = normalized
+    return entry
+
+
+def discover(root: str) -> List[str]:
+    """The committed perf artifacts in ``root``, name-sorted (the
+    ``_rNN`` convention makes that chronological for the bench
+    series)."""
+    out = []
+    for name in sorted(os.listdir(root)):
+        if name.endswith(".json") and (
+                name.startswith("BENCH_")
+                or name.startswith("STEP_COST_")
+                or name.startswith("BATCH_EFF_")):
+            out.append(os.path.join(root, name))
+    return out
+
+
+def build_ledger(paths: List[str]) -> Dict:
+    cal_mod = _calibration_module()
+    entries = []
+    for p in paths:
+        entry = extract(p)
+        if entry is None:
+            print(f"# perf_ledger: skipping {os.path.basename(p)} "
+                  "(not a known perf artifact / no usable metrics)",
+                  file=sys.stderr)
+            continue
+        entries.append(_normalize(entry, cal_mod))
+    return {
+        "version": LEDGER_VERSION,
+        "probe_version": cal_mod.PROBE_VERSION,
+        "ref_gemm_gflops": cal_mod.REF_GEMM_GFLOPS,
+        "n_entries": len(entries),
+        "n_calibrated": sum(1 for e in entries if e["calibrated"]),
+        "entries": entries,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+
+def _baseline_for(ledger: Dict, capture: Dict) -> Optional[Dict]:
+    """Most recent ledger entry comparable to ``capture``: same
+    family and mechanism, same platform (a cpu-vs-tpu comparison is
+    not a regression signal), and not the capture artifact itself."""
+    best = None
+    for e in ledger.get("entries", []):
+        if e.get("kind") != capture.get("kind"):
+            continue
+        if e.get("mech") != capture.get("mech"):
+            continue
+        if e.get("platform") != capture.get("platform"):
+            continue
+        if e.get("artifact") == capture.get("artifact"):
+            continue
+        best = e                     # entries are chronological
+    return best
+
+
+def check(ledger: Dict, capture_path: str, band: float) -> Tuple[int,
+                                                                 Dict]:
+    """Gate one fresh capture against the ledger. Returns (rc,
+    verdict-dict); rc 1 = at least one metric regressed beyond
+    ``band``."""
+    cal_mod = _calibration_module()
+    capture = extract(capture_path)
+    if capture is None:
+        return 2, {"error": f"{capture_path} is not a recognizable "
+                            "perf artifact"}
+    capture = _normalize(capture, cal_mod)
+    baseline = _baseline_for(ledger, capture)
+    verdict: Dict[str, Any] = {
+        "capture": capture["artifact"],
+        "capture_calibrated": capture["calibrated"],
+        "band": band,
+        "baseline": baseline["artifact"] if baseline else None,
+        "metrics": {},
+        "regressions": [],
+    }
+    if baseline is None:
+        # nothing comparable committed yet: a pass WITH a visible
+        # reason, never a silent green
+        verdict["note"] = ("no comparable baseline (kind/mech/"
+                           "platform) in the ledger — nothing to "
+                           "gate against")
+        return 0, verdict
+    for name, raw in capture["metrics"].items():
+        base_raw = baseline["metrics"].get(name)
+        if base_raw is None:
+            continue
+        use_norm = (capture["normalized"].get(name) is not None
+                    and baseline["normalized"].get(name) is not None)
+        new = capture["normalized"][name] if use_norm else raw
+        old = (baseline["normalized"][name] if use_norm
+               else base_raw)
+        direction = METRIC_DIRECTIONS.get(name, "lower")
+        if old <= 0 or new <= 0:
+            continue
+        # ratio > 1 means WORSE in both directions
+        ratio = new / old if direction == "lower" else old / new
+        row = {"new": new, "baseline": old,
+               "normalized": use_norm, "direction": direction,
+               "worse_ratio": round(ratio, 4)}
+        verdict["metrics"][name] = row
+        if ratio > band:
+            verdict["regressions"].append(name)
+    return (1 if verdict["regressions"] else 0), verdict
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--root", default=_REPO,
+                   help="repo root holding the committed artifacts")
+    p.add_argument("--artifacts", nargs="*", default=None,
+                   help="explicit artifact paths (overrides "
+                        "discovery)")
+    p.add_argument("--out", default=None,
+                   help="write the ledger JSON here (default: "
+                        "PERF_LEDGER.json under --root for ingest; "
+                        "not written in --check mode unless given)")
+    p.add_argument("--ledger", default=None,
+                   help="use a previously built ledger JSON for "
+                        "--check instead of rebuilding from --root")
+    p.add_argument("--check", default=None, metavar="CAPTURE",
+                   help="gate a fresh capture against the ledger; "
+                        "rc 1 on regression beyond the band")
+    p.add_argument("--band", type=float, default=1.5,
+                   help="noise band for --check: fail when a metric "
+                        "is worse by more than this ratio "
+                        "(default 1.5)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.ledger:
+        with open(args.ledger) as f:
+            ledger = json.load(f)
+    else:
+        paths = (args.artifacts if args.artifacts
+                 else discover(args.root))
+        ledger = build_ledger(paths)
+    if args.check:
+        rc, verdict = check(ledger, args.check, args.band)
+        print(json.dumps(verdict))
+        if rc == 1:
+            print("# perf_ledger: REGRESSION beyond "
+                  f"{args.band:g}x band: "
+                  + ", ".join(verdict["regressions"]),
+                  file=sys.stderr)
+        return rc
+    out = args.out or os.path.join(args.root, "PERF_LEDGER.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ledger, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, out)
+    print(json.dumps({"ledger": out,
+                      "n_entries": ledger["n_entries"],
+                      "n_calibrated": ledger["n_calibrated"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
