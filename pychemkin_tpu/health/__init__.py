@@ -1,0 +1,58 @@
+"""Fleet health signals: windowed time-series, SLO burn rates, and
+typed operator signals derived from the existing metrics surfaces.
+
+Three layers (ISSUE 15), all stdlib + telemetry — no jax, no numpy —
+so the package runs in the chemtop/orchestrator process and inside
+the supervisor exactly like :mod:`pychemkin_tpu.lint` runs in the
+suite orchestrator:
+
+- :mod:`.timeseries` — a bounded ring of normalized fleet snapshots
+  plus the delta algebra: generation-aware counter deltas → rates
+  (a counter going DOWN means a respawn: clamp, count a restart,
+  never emit a negative rate), and histogram state subtraction
+  (``telemetry.subtract_histogram_states``) → true windowed
+  p50/p99 instead of since-boot percentiles.
+- :mod:`.signals` — the declarative rule engine: pure-dict rules
+  over the windowed view, typed :data:`~.signals.SIGNAL_NAMES`
+  signals with fire/clear hysteresis, transitions on the telemetry
+  spine as ``health.signal`` events.
+- :mod:`.monitor` — the thread-safe embeddable form (ring + engine +
+  JSONL history banking) the supervisor runs; chemtop's poll loop
+  drives the ring/engine directly.
+
+The consumers ROADMAP #3 (autoscaling) and #4 (surrogate flywheel)
+read these signals instead of re-inventing scraping: LADDER_SATURATED
+is the scale-up trigger, SURROGATE_RETRAIN the retrain trigger.
+"""
+
+from .monitor import HealthMonitor
+from .signals import (
+    DEFAULT_RULES,
+    EVALUATORS,
+    HealthEngine,
+    SEVERITIES,
+    SIGNAL_NAMES,
+    replay,
+    severity_rank,
+)
+from .timeseries import (
+    SnapshotRing,
+    WindowView,
+    normalize_sample,
+    pair_deltas,
+)
+
+__all__ = [
+    "DEFAULT_RULES",
+    "EVALUATORS",
+    "HealthEngine",
+    "HealthMonitor",
+    "SEVERITIES",
+    "SIGNAL_NAMES",
+    "SnapshotRing",
+    "WindowView",
+    "normalize_sample",
+    "pair_deltas",
+    "replay",
+    "severity_rank",
+]

@@ -1,0 +1,111 @@
+"""Thread-safe fleet-health monitor: ring + engine + JSONL history.
+
+The embeddable form of the health pipeline: the supervisor's sampler
+thread (and anything else that already holds a metrics reply) feeds
+:meth:`HealthMonitor.observe`, and the monitor normalizes the sample,
+banks it to the optional JSONL history file (one
+``{"t", "sample", "signals"}`` entry per poll — the artifact
+``chemtop --check-signals`` replays), and evaluates the rule engine.
+``health.signal`` transition events land on the recorder the monitor
+was built with, so a supervised soak's obs-dir sinks carry the signal
+timeline next to the trace spans.
+
+All mutation is serialized by one internal lock: the supervisor calls
+:meth:`observe` from its sampler thread, :meth:`note_backend_lost` /
+:meth:`note_respawned` from its monitor thread, and :meth:`state`
+from whatever thread answers ``metrics()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import knobs
+from ..telemetry import append_jsonl
+from .signals import HealthEngine
+from .timeseries import SnapshotRing, normalize_sample
+
+
+class HealthMonitor:
+    """One fleet's (or one supervised backend's) health state over
+    time. See module docstring; history banking failures degrade the
+    artifact, never the caller."""
+
+    def __init__(self, recorder=None,
+                 history_path: Optional[str] = None,
+                 rules=None, ring_cap: Optional[int] = None):
+        if ring_cap is None:
+            ring_cap = knobs.value("PYCHEMKIN_HEALTH_RING")
+        self.history_path = history_path
+        self._ring = SnapshotRing(cap=ring_cap)  # guarded-by: _lock
+        self._engine = HealthEngine(rules=rules,
+                                    recorder=recorder)  # guarded-by: _lock
+        self._history_error: Optional[str] = None  # guarded-by: _lock
+        self._n_samples = 0                        # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    # -- feeding ---------------------------------------------------------
+    def observe(self, reply: Optional[Dict[str, Any]],
+                t: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Feed one metrics reply (any surface shape — see
+        :func:`~.timeseries.normalize_sample`); returns the evaluated
+        per-signal state."""
+        sample = normalize_sample(reply, t=t)
+        with self._lock:
+            self._ring.append(sample)
+            signals = self._engine.evaluate(self._ring)
+            self._n_samples += 1
+            if self.history_path:
+                entry = {"t": sample["t"], "sample": sample,
+                         "signals": signals}
+                try:
+                    append_jsonl(self.history_path, entry)
+                except OSError as exc:
+                    self._history_error = (
+                        f"{type(exc).__name__}: {exc}")
+        return signals
+
+    def note_backend_lost(self, reason: str,
+                          t: Optional[float] = None
+                          ) -> List[Dict[str, Any]]:
+        """Record an authoritative down-sample the instant the
+        supervisor classifies a loss — BACKEND_DOWN must fire within
+        one poll of the death, not one scrape interval after."""
+        return self.observe({"error": reason}, t=t)
+
+    def note_respawned(self, generation: int,
+                       t: Optional[float] = None
+                       ) -> List[Dict[str, Any]]:
+        """Record an alive-sample the instant a respawn succeeds (the
+        clear half of the fired-then-cleared cycle). Partial: it
+        asserts liveness, not a scraped series view."""
+        return self.observe({"generation": int(generation),
+                             "partial": True}, t=t)
+
+    # -- read side -------------------------------------------------------
+    def state(self) -> Dict[str, Any]:
+        """JSON-ready monitor state: current signals, the transition
+        timeline, window restart count — what ``Supervisor.metrics()``
+        replies and the loadgen artifact carry under ``"health"``."""
+        with self._lock:
+            window = self._ring.window(
+                knobs.value("PYCHEMKIN_HEALTH_WINDOW_S"))
+            out = {
+                "t": time.time(),
+                "n_samples": self._n_samples,
+                "signals": self._engine.state(),
+                "timeline": self._engine.timeline(),
+                "restarts": window.restarts if window else 0,
+            }
+            if self.history_path:
+                out["history_path"] = self.history_path
+            if self._history_error:
+                out["history_error"] = self._history_error
+        return out
+
+    def firing(self, min_severity: str = "warn"
+               ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._engine.firing(min_severity)

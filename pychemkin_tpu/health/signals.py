@@ -1,0 +1,433 @@
+"""Typed operator signals: a declarative rule engine over the
+windowed fleet view.
+
+Each rule is a PURE DICT — ``{"name", "severity", "kind", ...params}``
+— evaluated once per poll against the :class:`~.timeseries.
+SnapshotRing`. ``kind`` names one of the registered evaluators below;
+operators add rules (or re-threshold shipped ones) by adding dicts,
+not code. Signal names are schema: every shipped rule's ``name`` must
+appear in ``telemetry.schema.HEALTH_SIGNALS`` (the chemlint
+``telemetry-health-signals`` rule enforces it), so a typo'd signal
+fails static analysis, not a 3 am page.
+
+Hysteresis: a rule FIRES after ``fire_for`` consecutive true polls
+and CLEARS after ``clear_for`` consecutive false polls (default
+``PYCHEMKIN_HEALTH_CLEAR_POLLS``), so a metric flapping around its
+threshold cannot page every poll. Transitions — and only transitions
+— land as ``health.signal`` events on the telemetry spine, carrying
+exactly ``telemetry.schema.HEALTH_EVENT_FIELDS``; the steady state is
+readable from :meth:`HealthEngine.state` instead.
+
+Shipped rules (thresholds are live ``PYCHEMKIN_HEALTH_*`` knobs,
+re-read per poll):
+
+- ``BACKEND_DOWN`` (page)       — a fleet member is dead or not
+  answering its scrape.
+- ``ERROR_BUDGET_BURN`` (page)  — multi-window burn rate on the
+  OK-fraction SLO (fast + slow window must BOTH burn, the classic
+  SRE pattern — fast catches the cliff, slow stops a blip paging).
+- ``SURROGATE_RETRAIN`` (warn)  — windowed surrogate hit rate below
+  threshold on enough live requests: the ROADMAP #4 retrain trigger.
+- ``PREDICTOR_DECALIBRATED`` (warn) — ``schedule.predictor_corr``
+  below floor: switch the scheduler ``cost_fn`` (ISSUE 14 signal).
+- ``LADDER_SATURATED`` (warn)   — top-bucket occupancy p95 pinned at
+  the cap for K polls: the ROADMAP #3 scale-up signal.
+- ``DEADLINE_PRESSURE`` (warn)  — deadline-expired fraction of the
+  windowed request stream above threshold.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import knobs
+from .timeseries import SnapshotRing, WindowView
+
+#: canonical shipped signal names — chemlint cross-checks this tuple
+#: (and every rule-dict "name" literal in this module) as a subset of
+#: ``telemetry.schema.HEALTH_SIGNALS``, mirroring SCHEDULE_COUNTERS
+SIGNAL_NAMES = (
+    "BACKEND_DOWN",
+    "ERROR_BUDGET_BURN",
+    "SURROGATE_RETRAIN",
+    "PREDICTOR_DECALIBRATED",
+    "LADDER_SATURATED",
+    "DEADLINE_PRESSURE",
+)
+
+#: severity ladder, least to most urgent; ``--check-signals`` gates on
+#: severity >= page
+SEVERITIES = ("info", "warn", "page")
+
+
+def severity_rank(severity: str) -> int:
+    try:
+        return SEVERITIES.index(severity)
+    except ValueError:
+        return 0
+
+
+def _round(v: Optional[float], nd: int = 4) -> Optional[float]:
+    return None if v is None else round(float(v), nd)
+
+
+def _window_s(rule: Dict[str, Any]) -> float:
+    return float(rule.get("window_s",
+                          knobs.value("PYCHEMKIN_HEALTH_WINDOW_S")))
+
+
+# -- evaluators -------------------------------------------------------------
+# each: fn(rule, ring) -> (condition, evidence); condition is the raw
+# per-poll truth BEFORE hysteresis, evidence a JSON-ready dict
+
+def _eval_backend_down(rule: Dict[str, Any], ring: SnapshotRing
+                       ) -> Tuple[bool, Dict[str, Any]]:
+    s = ring.latest()
+    if s is None:
+        return False, {}
+    cond = s["n_backends"] > 0 and s["n_alive"] < s["n_backends"]
+    return cond, {"n_alive": s["n_alive"],
+                  "n_backends": s["n_backends"],
+                  "errors": list(s.get("errors") or [])[:3]}
+
+
+def _burn(view: Optional[WindowView], bad_names, total_name: str,
+          slo_ok: float) -> Tuple[Optional[float], int, int]:
+    """(burn rate, bad delta, total delta) over one window; burn is
+    None when the window saw no requests."""
+    if view is None:
+        return None, 0, 0
+    total = view.delta(total_name)
+    bad = sum(view.delta(n) for n in bad_names)
+    if total <= 0:
+        return None, bad, total
+    budget = max(1.0 - float(slo_ok), 1e-9)
+    return (bad / total) / budget, bad, total
+
+
+def _eval_burn_rate(rule: Dict[str, Any], ring: SnapshotRing
+                    ) -> Tuple[bool, Dict[str, Any]]:
+    bad = tuple(rule.get("bad_counters",
+                         ("serve.deadline_expired",
+                          "serve.batch_errors",
+                          "supervisor.backend_lost_requests")))
+    total = rule.get("total_counter", "serve.requests")
+    slo = float(rule.get("slo_ok",
+                         knobs.value("PYCHEMKIN_HEALTH_SLO_OK")))
+    thr_fast = float(rule.get("burn_fast",
+                              knobs.value("PYCHEMKIN_HEALTH_BURN_FAST")))
+    thr_slow = float(rule.get("burn_slow",
+                              knobs.value("PYCHEMKIN_HEALTH_BURN_SLOW")))
+    fast_s = _window_s(rule)
+    slow_s = float(rule.get(
+        "slow_window_s", knobs.value("PYCHEMKIN_HEALTH_SLOW_WINDOW_S")))
+    fast, bad_f, n_f = _burn(ring.window(fast_s), bad, total, slo)
+    slow, bad_s, n_s = _burn(ring.window(slow_s), bad, total, slo)
+    cond = (fast is not None and slow is not None
+            and fast > thr_fast and slow > thr_slow)
+    return cond, {"burn_fast": _round(fast), "burn_slow": _round(slow),
+                  "bad_fast": bad_f, "n_fast": n_f,
+                  "bad_slow": bad_s, "n_slow": n_s,
+                  "slo_ok": slo, "thresholds": [thr_fast, thr_slow]}
+
+
+def _eval_ratio_below(rule: Dict[str, Any], ring: SnapshotRing
+                      ) -> Tuple[bool, Dict[str, Any]]:
+    view = ring.window(_window_s(rule))
+    num = rule.get("num_counter", "serve.surrogate.hit")
+    den = tuple(rule.get("den_counters",
+                         ("serve.surrogate.hit",
+                          "serve.surrogate.fallback")))
+    threshold = float(rule.get(
+        "threshold", knobs.value("PYCHEMKIN_HEALTH_HIT_RATE_MIN")))
+    min_n = int(rule.get("min_n",
+                         knobs.value("PYCHEMKIN_HEALTH_HIT_MIN_N")))
+    if view is None:
+        return False, {}
+    n = sum(view.delta(d) for d in den)
+    ratio = (view.delta(num) / n) if n else None
+    cond = n >= min_n and ratio is not None and ratio < threshold
+    return cond, {"ratio": _round(ratio), "n": n,
+                  "threshold": threshold, "min_n": min_n}
+
+
+def _eval_gauge_below(rule: Dict[str, Any], ring: SnapshotRing
+                      ) -> Tuple[bool, Dict[str, Any]]:
+    gauge = rule.get("gauge", "schedule.predictor_corr")
+    floor = float(rule.get("floor",
+                           knobs.value("PYCHEMKIN_HEALTH_CORR_MIN")))
+    view = ring.window(_window_s(rule))
+    if view is not None:
+        start, latest = view.gauge_trend(gauge)
+    else:
+        s = ring.latest()
+        start = None
+        latest = (s.get("gauges") or {}).get(gauge) if s else None
+    cond = latest is not None and float(latest) < floor
+    return cond, {"value": _round(latest), "floor": floor,
+                  "window_start": _round(start)}
+
+
+def _eval_occupancy_saturated(rule: Dict[str, Any], ring: SnapshotRing
+                              ) -> Tuple[bool, Dict[str, Any]]:
+    prefix = rule.get("hist_prefix", "serve.occupancy.b")
+    frac = float(rule.get("cap_frac", 0.99))
+    s = ring.latest()
+    view = ring.window(_window_s(rule))
+    if s is None or view is None:
+        return False, {}
+    caps = []
+    for name in (s.get("hist_states") or {}):
+        if name.startswith(prefix):
+            try:
+                caps.append(int(name[len(prefix):]))
+            except ValueError:
+                continue
+    if not caps:
+        return False, {}
+    cap = max(caps)            # the ladder's top rung is the scale-up
+    summary = view.hist_summary(f"{prefix}{cap}")
+    p95 = summary.get("p95")
+    cond = bool(summary.get("count")) and p95 is not None \
+        and p95 >= frac * cap
+    return cond, {"bucket": cap, "p95": _round(p95),
+                  "count": summary.get("count", 0), "cap_frac": frac}
+
+
+def _eval_fraction_above(rule: Dict[str, Any], ring: SnapshotRing
+                         ) -> Tuple[bool, Dict[str, Any]]:
+    view = ring.window(_window_s(rule))
+    num = rule.get("num_counter", "serve.deadline_expired")
+    den = rule.get("den_counter", "serve.requests")
+    threshold = float(rule.get(
+        "threshold", knobs.value("PYCHEMKIN_HEALTH_DEADLINE_FRAC")))
+    min_num = int(rule.get("min_num", 1))
+    if view is None:
+        return False, {}
+    n_num, n_den = view.delta(num), view.delta(den)
+    frac = (n_num / n_den) if n_den else None
+    cond = n_num >= min_num and frac is not None and frac > threshold
+    return cond, {"fraction": _round(frac), "num": n_num,
+                  "den": n_den, "threshold": threshold}
+
+
+#: evaluator registry: rule["kind"] -> evaluator. Operator rule dicts
+#: compose these kinds with their own counters/thresholds — adding a
+#: rule needs no code unless it needs a genuinely new SHAPE of check.
+EVALUATORS: Dict[str, Callable[[Dict[str, Any], SnapshotRing],
+                               Tuple[bool, Dict[str, Any]]]] = {
+    "backend_down": _eval_backend_down,
+    "burn_rate": _eval_burn_rate,
+    "ratio_below": _eval_ratio_below,
+    "gauge_below": _eval_gauge_below,
+    "occupancy_saturated": _eval_occupancy_saturated,
+    "fraction_above": _eval_fraction_above,
+}
+
+#: the shipped rule set — pure dicts; thresholds default to the
+#: PYCHEMKIN_HEALTH_* knobs inside the evaluators (re-read per poll,
+#: so a live fleet re-tunes via its environment). Death/respawn is
+#: unambiguous, so BACKEND_DOWN fires and clears in one poll; the
+#: saturation rule's fire_for comes from its knob at eval time.
+DEFAULT_RULES = (
+    {"name": "BACKEND_DOWN", "severity": "page",
+     "kind": "backend_down", "fire_for": 1, "clear_for": 1},
+    {"name": "ERROR_BUDGET_BURN", "severity": "page",
+     "kind": "burn_rate"},
+    {"name": "SURROGATE_RETRAIN", "severity": "warn",
+     "kind": "ratio_below"},
+    {"name": "PREDICTOR_DECALIBRATED", "severity": "warn",
+     "kind": "gauge_below"},
+    {"name": "LADDER_SATURATED", "severity": "warn",
+     "kind": "occupancy_saturated"},
+    {"name": "DEADLINE_PRESSURE", "severity": "warn",
+     "kind": "fraction_above"},
+)
+
+#: sparkline glyphs for the per-signal recent window (ok / firing)
+_SPARK_OK, _SPARK_FIRING = "·", "▇"
+RECENT_POLLS = 12
+
+
+class _RuleState:
+    __slots__ = ("consec_true", "consec_false", "firing", "fired_at",
+                 "cleared_at", "evidence", "recent")
+
+    def __init__(self):
+        self.consec_true = 0
+        self.consec_false = 0
+        self.firing = False
+        self.fired_at: Optional[float] = None
+        self.cleared_at: Optional[float] = None
+        self.evidence: Dict[str, Any] = {}
+        self.recent: List[bool] = []
+
+
+class HealthEngine:
+    """Evaluates a rule set against a ring once per poll, tracks
+    hysteresis, and emits ``health.signal`` events on transitions.
+
+    Single-threaded by design (the chemtop poll loop, or the
+    monitor's sampler thread under the monitor's lock); hand one
+    engine to one caller."""
+
+    def __init__(self, rules=None, recorder=None,
+                 max_timeline: int = 512):
+        self.rules: List[Dict[str, Any]] = [
+            dict(r) for r in (DEFAULT_RULES if rules is None
+                              else rules)]
+        for rule in self.rules:
+            if not rule.get("name"):
+                raise ValueError("health rule needs a 'name'")
+            kind = rule.get("kind")
+            if kind not in EVALUATORS:
+                raise ValueError(
+                    f"health rule {rule['name']!r}: unknown kind "
+                    f"{kind!r} (have {sorted(EVALUATORS)})")
+        self._rec = recorder
+        self._state: Dict[str, _RuleState] = {
+            r["name"]: _RuleState() for r in self.rules}
+        self._timeline: List[Dict[str, Any]] = []
+        self._max_timeline = int(max_timeline)
+
+    # -- evaluation ------------------------------------------------------
+    def _fire_for(self, rule: Dict[str, Any]) -> int:
+        if "fire_for" in rule:
+            return max(1, int(rule["fire_for"]))
+        if rule.get("kind") == "occupancy_saturated":
+            return max(1, int(knobs.value(
+                "PYCHEMKIN_HEALTH_SATURATED_POLLS")))
+        return 1
+
+    def _clear_for(self, rule: Dict[str, Any]) -> int:
+        if "clear_for" in rule:
+            return max(1, int(rule["clear_for"]))
+        return max(1, int(knobs.value("PYCHEMKIN_HEALTH_CLEAR_POLLS")))
+
+    def _transition(self, rule: Dict[str, Any], st: _RuleState,
+                    state: str, t: float) -> None:
+        record = {"t": t, "signal": rule["name"],
+                  "severity": rule.get("severity", "warn"),
+                  "state": state, "window_s": _window_s(rule),
+                  "evidence": dict(st.evidence),
+                  "fired_at": st.fired_at, "cleared_at": st.cleared_at}
+        self._timeline.append(record)
+        del self._timeline[:-self._max_timeline]
+        if self._rec is not None:
+            self._rec.event(
+                "health.signal", signal=record["signal"],
+                severity=record["severity"], state=state,
+                window_s=record["window_s"],
+                evidence=record["evidence"],
+                fired_at=st.fired_at, cleared_at=st.cleared_at)
+
+    def evaluate(self, ring: SnapshotRing,
+                 t: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One poll: run every rule, update hysteresis, emit
+        transition events; returns :meth:`state`. An evaluator crash
+        degrades that rule's poll to not-firing with the error in its
+        evidence — observability must not take down the poller."""
+        latest = ring.latest()
+        if t is None:
+            t = float(latest["t"]) if latest else time.time()
+        for rule in self.rules:
+            st = self._state[rule["name"]]
+            try:
+                cond, evidence = EVALUATORS[rule["kind"]](rule, ring)
+            except Exception as exc:  # noqa: BLE001 — degrade, never crash
+                cond, evidence = False, {
+                    "error": f"{type(exc).__name__}: {exc}"}
+            if cond or st.firing or "error" in evidence:
+                # evidence persists while relevant — including a
+                # crashed evaluator's error on a non-firing rule, or
+                # a permanently broken operator rule would be
+                # indistinguishable from a quiet one
+                st.evidence = evidence
+            st.recent.append(bool(cond))
+            del st.recent[:-RECENT_POLLS]
+            if cond:
+                st.consec_true += 1
+                st.consec_false = 0
+                if (not st.firing
+                        and st.consec_true >= self._fire_for(rule)):
+                    st.firing = True
+                    st.fired_at, st.cleared_at = t, None
+                    self._transition(rule, st, "fired", t)
+            else:
+                st.consec_false += 1
+                st.consec_true = 0
+                if (st.firing
+                        and st.consec_false >= self._clear_for(rule)):
+                    st.firing = False
+                    st.cleared_at = t
+                    self._transition(rule, st, "cleared", t)
+        return self.state()
+
+    # -- read side -------------------------------------------------------
+    def state(self) -> List[Dict[str, Any]]:
+        """Every rule's current signal state, JSON-ready (what the
+        ``metrics`` reply's ``health.signals`` and the banked history
+        entries carry)."""
+        out = []
+        for rule in self.rules:
+            st = self._state[rule["name"]]
+            out.append({
+                "signal": rule["name"],
+                "severity": rule.get("severity", "warn"),
+                "state": "firing" if st.firing else "ok",
+                "window_s": _window_s(rule),
+                "evidence": dict(st.evidence),
+                "fired_at": st.fired_at,
+                "cleared_at": st.cleared_at,
+                "recent": "".join(
+                    _SPARK_FIRING if b else _SPARK_OK
+                    for b in st.recent),
+            })
+        return out
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        """Bounded list of fire/clear transitions, oldest first."""
+        return list(self._timeline)
+
+    def firing(self, min_severity: str = "warn"
+               ) -> List[Dict[str, Any]]:
+        floor = severity_rank(min_severity)
+        return [s for s in self.state()
+                if s["state"] == "firing"
+                and severity_rank(s["severity"]) >= floor]
+
+
+def replay(samples, rules=None,
+           ring_cap: Optional[int] = None) -> Dict[str, Any]:
+    """Re-evaluate a banked history's samples through a fresh engine —
+    the pure core of ``chemtop --check-signals``. ``samples`` is an
+    iterable of normalized sample dicts (history entries' ``sample``
+    field). Returns the timeline, the final per-signal state, the
+    still-firing page-severity names, and per-signal ``cycles``
+    (fired AND later cleared at least once — the chaos-soak
+    acceptance shape)."""
+    ring = SnapshotRing(cap=ring_cap)
+    engine = HealthEngine(rules=rules, recorder=None)
+    n = 0
+    for sample in samples:
+        ring.append(sample)
+        engine.evaluate(ring)
+        n += 1
+    fired: Dict[str, int] = {}
+    cleared: Dict[str, int] = {}
+    for ev in engine.timeline():
+        which = fired if ev["state"] == "fired" else cleared
+        which[ev["signal"]] = which.get(ev["signal"], 0) + 1
+    final = engine.state()
+    return {
+        "n_samples": n,
+        "timeline": engine.timeline(),
+        "final": final,
+        "firing_page": [s["signal"] for s in final
+                        if s["state"] == "firing"
+                        and severity_rank(s["severity"])
+                        >= severity_rank("page")],
+        "cycles": {name: bool(cleared.get(name))
+                   for name in fired},
+    }

@@ -1,0 +1,373 @@
+"""Windowed fleet time-series: a bounded ring of metrics snapshots
+plus the delta algebra that turns point-in-time scrapes into rates and
+true windowed distributions.
+
+Every metrics surface the fleet exposes (the transport ``metrics`` op,
+``Supervisor.metrics()``, chemtop's merged fleet snapshot) is a
+since-boot scrape: counters are monotone totals, histograms are
+since-boot distributions. This module derives the quantities operators
+actually act on:
+
+- **counter deltas → rates**, generation-aware: a counter that goes
+  *down* between two scrapes means the emitting backend respawned —
+  the delta is clamped to the new value (everything counted since the
+  respawn) and the pair is counted as a ``restart``; a negative rate
+  is never emitted.
+- **histogram state subtraction → windowed percentiles**: consecutive
+  raw bucket states are differenced with
+  :func:`pychemkin_tpu.telemetry.subtract_histogram_states` (the
+  inverse of the PR-8 merge) and the differences re-merged, so the
+  p50/p99 of a :class:`WindowView` describe the last N seconds, not
+  the process lifetime. A non-monotone pair (respawn) falls back to
+  the post-restart state — the window never loses post-respawn
+  observations and never sees a negative bucket.
+
+Deliberately stdlib + telemetry only (no jax, no numpy): like
+:mod:`pychemkin_tpu.lint`, this runs in the chemtop/orchestrator
+process and in the supervisor, never on an accelerator path.
+
+Samples are plain JSON-ready dicts (see :func:`normalize_sample`), so
+the same shape rides the ring in memory, the JSONL history file on
+disk, and the replay path of ``chemtop --check-signals``.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..telemetry import recorder as _recorder
+
+Histogram = _recorder.Histogram
+HistogramSubtractionError = _recorder.HistogramSubtractionError
+subtract_histogram_states = _recorder.subtract_histogram_states
+
+#: default ring capacity (samples); at chemtop's default 2 s poll
+#: interval this is ~24 minutes of history — enough for the fast and
+#: a truncated slow burn window without unbounded growth
+DEFAULT_RING_CAP = 720
+
+
+def _mean(values: Iterable[Optional[float]]) -> Optional[float]:
+    vals = [float(v) for v in values if v is not None]
+    return (sum(vals) / len(vals)) if vals else None
+
+
+def normalize_sample(reply: Optional[Dict[str, Any]],
+                     t: Optional[float] = None) -> Dict[str, Any]:
+    """One canonical fleet-health sample from any of the metrics
+    surfaces: a chemtop merged fleet snapshot (``merge_fleet``), a
+    single backend's ``metrics`` reply, or ``Supervisor.metrics()``'s
+    degraded ``{"error", "supervisor"}`` form. A dead/unanswering
+    member normalizes to an alive-count of zero with empty counters —
+    the health layer must keep deriving exactly when the fleet is
+    unhealthy.
+
+    Shape: ``{"t", "n_alive", "n_backends", "generations", "errors",
+    "counters", "gauges", "hist_states"}`` — JSON-ready, so the same
+    dict rides the in-memory ring, the JSONL history file, and the
+    ``chemtop --check-signals`` replay."""
+    reply = dict(reply or {})
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, Optional[float]] = {}
+    hist_states: Dict[str, Dict[str, Any]] = {}
+    errors: List[str] = []
+    # ``scrape``: the sample's counter/histogram view is AUTHORITATIVE
+    # — it came from a real metrics exposition, so a series missing
+    # from it was genuinely zero/empty at that instant. Error replies
+    # and liveness-only fallbacks (``"partial": True`` — the
+    # supervisor's sampler when the backend cannot answer the op) are
+    # NOT: their missing series are holes, not zeros, and the window
+    # algebra carries the last known value across them instead.
+    scrape = not reply.get("error") and not reply.get("partial")
+    if "n_backends" in reply:            # chemtop merged fleet snapshot
+        n_backends = int(reply.get("n_backends") or 0)
+        n_alive = int(reply.get("n_alive") or 0)
+        generations = [b.get("generation")
+                       for b in (reply.get("backends") or [])
+                       if not b.get("error")]
+        errors = [str(b.get("error"))
+                  for b in (reply.get("backends") or [])
+                  if b.get("error")]
+        counters = {str(k): int(v)
+                    for k, v in (reply.get("counters") or {}).items()}
+        # the merged snapshot has no fleet gauge dict; derive the
+        # predictor-calibration gauge as the mean over alive backends
+        # (None when nobody reports it — legacy schedule-less fleet)
+        sol = reply.get("solver") or {}
+        gauges["schedule.predictor_corr"] = _mean(
+            sol.get("predictor_corr") or [])
+        hist_states = dict(reply.get("histogram_states") or {})
+        t = reply.get("t") if t is None else t
+        # a fleet view missing members is PARTIAL: its counter sums
+        # exclude the dead member's totals, so its missing/shrunken
+        # series are holes, not zeros
+        scrape = scrape and n_alive == n_backends
+    else:                                # one backend / supervisor reply
+        err = reply.get("error")
+        alive = not err
+        if err:
+            errors = [str(err)]
+        n_backends, n_alive = 1, (1 if alive else 0)
+        generations = ([reply.get("generation", 0)] if alive else [])
+        counters = {str(k): int(v)
+                    for k, v in (reply.get("counters") or {}).items()}
+        gauges = {str(k): v
+                  for k, v in (reply.get("gauges") or {}).items()}
+        hist_states = dict(reply.get("histogram_states") or {})
+        # a supervisor-side reply carries its respawn story even when
+        # the backend could not answer — fold it exactly like chemtop
+        # does, so restart/burn rules see churn counters either way
+        sup = reply.get("supervisor") or {}
+        for k in ("respawns", "resubmits", "backend_lost_requests"):
+            if k in sup:
+                counters[f"supervisor.{k}"] = (
+                    counters.get(f"supervisor.{k}", 0)
+                    + int(sup.get(k) or 0))
+    return {
+        "t": float(t if t is not None else time.time()),
+        "n_alive": n_alive,
+        "n_backends": n_backends,
+        "generations": generations,
+        "errors": errors,
+        "scrape": scrape,
+        "counters": counters,
+        "gauges": gauges,
+        "hist_states": hist_states,
+    }
+
+
+def _authoritative(sample: Dict[str, Any]) -> bool:
+    """Whether a sample's series view is complete (see the ``scrape``
+    flag above): alive and scraped — missing series meant zero."""
+    return bool(sample.get("n_alive")) and bool(
+        sample.get("scrape", True))
+
+
+def pair_deltas(prev: Dict[str, Any], cur: Dict[str, Any]
+                ) -> Tuple[Dict[str, int], bool]:
+    """Clamped counter deltas between two consecutive samples, plus
+    whether the pair shows a restart.
+
+    For each counter present in both samples: ``cur - prev`` when
+    monotone; when the counter went DOWN, the emitting backend
+    respawned mid-window — the delta clamps to the NEW value (it
+    counts everything since the respawn) and the pair is a restart.
+    A counter appearing for the first time contributes nothing (its
+    pre-window baseline is unknown); one vanishing (scrape hole)
+    contributes nothing rather than a negative."""
+    deltas: Dict[str, int] = {}
+    restart = False
+    prev_c = prev.get("counters") or {}
+    cur_c = cur.get("counters") or {}
+    for name, now in cur_c.items():
+        before = prev_c.get(name)
+        if before is None:
+            continue
+        now, before = int(now), int(before)
+        if now < before:
+            restart = True
+            deltas[name] = now
+        else:
+            deltas[name] = now - before
+    # a generation bump with no counter evidence (idle respawn) is
+    # still a restart — the supervisor stamps generations precisely
+    if sum(g or 0 for g in cur.get("generations") or []) > \
+            sum(g or 0 for g in prev.get("generations") or []):
+        restart = True
+    return deltas, restart
+
+
+class WindowView:
+    """A derived view over the samples of one time window (oldest
+    first, at least one sample): rates from clamped counter deltas,
+    windowed histogram summaries from subtracted states, and gauge
+    trends. Pure and cheap — built per evaluation, never cached
+    across polls.
+
+    The counter walk carries the LAST KNOWN value of every series
+    across non-authoritative samples (scrape holes, the supervisor's
+    liveness-only fallbacks), so a hole neither double-counts nor
+    zeroes a rate; a series first sighted after an authoritative
+    sample baselines at zero (it genuinely did not exist yet), while
+    one first sighted with no authoritative history baselines at its
+    own value (unknown pre-window total contributes nothing)."""
+
+    def __init__(self, samples: List[Dict[str, Any]]):
+        if not samples:
+            raise ValueError("WindowView needs at least one sample")
+        self.samples = samples
+        self.start = samples[0]
+        self.end = samples[-1]
+        self.duration_s = max(
+            0.0, float(self.end["t"]) - float(self.start["t"]))
+        self._deltas: Dict[str, int] = {}
+        self.restarts = 0
+        last: Dict[str, int] = {}
+        seen_auth = False
+        prev_gen_sum: Optional[int] = None
+        for i, sample in enumerate(samples):
+            auth_before = seen_auth
+            auth_sample = _authoritative(sample)
+            restart = False
+            gen_sum = sum(g or 0
+                          for g in sample.get("generations") or [])
+            if prev_gen_sum is not None and gen_sum > prev_gen_sum:
+                restart = True
+            for name, v in (sample.get("counters") or {}).items():
+                v = int(v)
+                base = last.get(name)
+                if base is None:
+                    # first in-window sighting: zero iff a prior
+                    # authoritative sample vouches it did not exist
+                    base = 0 if (i > 0 and auth_before) else v
+                if v < base:
+                    if not auth_sample:
+                        # a PARTIAL sample's shrunken sum (a fleet
+                        # member dropped out of the merge) is a hole,
+                        # not a respawn: carry the last known value,
+                        # never clamp-count the survivors' since-boot
+                        # totals into the window
+                        continue
+                    restart = True
+                    d = v            # clamp: everything since respawn
+                else:
+                    d = v - base
+                if i > 0 and d:
+                    self._deltas[name] = (
+                        self._deltas.get(name, 0) + d)
+                last[name] = v
+            if i > 0 and restart:
+                self.restarts += 1
+            prev_gen_sum = gen_sum
+            seen_auth = seen_auth or auth_sample
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    # -- counters --------------------------------------------------------
+    def delta(self, name: str) -> int:
+        """Windowed increase of a counter (never negative; respawn
+        pairs contribute their post-respawn totals)."""
+        return self._deltas.get(name, 0)
+
+    def rate(self, name: str) -> float:
+        """Windowed per-second rate of a counter (0.0 for a
+        zero-duration window — never negative, never a division
+        crash)."""
+        if self.duration_s <= 0.0:
+            return 0.0
+        return self.delta(name) / self.duration_s
+
+    # -- histograms ------------------------------------------------------
+    def hist_window(self, name: str) -> Histogram:
+        """The observations of the window as one merged
+        :class:`Histogram`: consecutive state differences re-merged
+        (carrying the last known state across holes), with a
+        non-monotone step (respawn) contributing the post-restart
+        state whole — never a negative bucket. Baseline mirrors the
+        counter walk: a series first sighted after an authoritative
+        sample counts whole (it was empty before); with no
+        authoritative history it becomes the silent baseline."""
+        h = Histogram()
+        last_state: Optional[Dict[str, Any]] = None
+        seen_auth = False
+        for i, sample in enumerate(self.samples):
+            auth_before = seen_auth
+            state = (sample.get("hist_states") or {}).get(name)
+            # PARTIAL samples' states are skipped outright: a merge
+            # missing a fleet member is a shrunken distribution whose
+            # failed subtraction would dump the survivors' since-boot
+            # buckets into the window via the restart fallback
+            if not _authoritative(sample):
+                state = None
+            if state and state.get("count"):
+                if last_state is None:
+                    if i > 0 and auth_before:
+                        h.merge_state(state)
+                else:
+                    try:
+                        h.merge_state(subtract_histogram_states(
+                            state, last_state))
+                    except HistogramSubtractionError:
+                        h.merge_state(state)
+                last_state = state
+            seen_auth = seen_auth or _authoritative(sample)
+        return h
+
+    def hist_summary(self, name: str) -> Dict[str, float]:
+        """Windowed count/sum/mean/min/max/p50/p95/p99 (``{"count":
+        0}`` when the window saw nothing)."""
+        return self.hist_window(name).summary()
+
+    # -- gauges ----------------------------------------------------------
+    def gauge(self, name: str) -> Optional[float]:
+        """Latest in-window value of a gauge (None when never set)."""
+        for sample in reversed(self.samples):
+            v = (sample.get("gauges") or {}).get(name)
+            if v is not None:
+                return float(v)
+        return None
+
+    def gauge_trend(self, name: str
+                    ) -> Tuple[Optional[float], Optional[float]]:
+        """(window-start value, latest value) of a gauge — the
+        rendered trend; either side None when unset."""
+        first = None
+        for sample in self.samples:
+            v = (sample.get("gauges") or {}).get(name)
+            if v is not None:
+                first = float(v)
+                break
+        return first, self.gauge(name)
+
+
+class SnapshotRing:
+    """Bounded ring of normalized fleet samples (oldest first).
+
+    NOT thread-safe by itself — the :class:`~pychemkin_tpu.health.
+    monitor.HealthMonitor` serializes access for multi-threaded
+    callers; chemtop's poll loop is single-threaded."""
+
+    def __init__(self, cap: Optional[int] = None):
+        self._ring: collections.deque = collections.deque(
+            maxlen=int(cap) if cap else DEFAULT_RING_CAP)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def append(self, sample: Dict[str, Any]) -> Dict[str, Any]:
+        """Append one normalized sample (see :func:`normalize_sample`;
+        raw replies — including merged fleet snapshots — are
+        normalized here for convenience). The sentinel is ``scrape``:
+        only :func:`normalize_sample` writes it, so a raw chemtop
+        merge (which carries ``n_alive``/``counters`` too) is still
+        recognized as raw."""
+        if "scrape" not in sample:
+            sample = normalize_sample(sample)
+        self._ring.append(sample)
+        return sample
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        return self._ring[-1] if self._ring else None
+
+    def window(self, seconds: float,
+               now: Optional[float] = None) -> Optional[WindowView]:
+        """The view over samples with ``t >= now - seconds`` (``now``
+        defaults to the latest sample's stamp). None until two samples
+        exist — one scrape has no deltas. A window longer than the
+        banked history degrades to everything banked (a young fleet's
+        1 h window IS its whole life)."""
+        if len(self._ring) < 2:
+            return None
+        if now is None:
+            now = float(self._ring[-1]["t"])
+        cutoff = now - float(seconds)
+        picked = [s for s in self._ring if float(s["t"]) >= cutoff]
+        if len(picked) < 2:
+            picked = list(self._ring)[-2:]
+        return WindowView(picked)
+
+    def samples(self) -> List[Dict[str, Any]]:
+        return list(self._ring)

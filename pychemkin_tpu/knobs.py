@@ -328,6 +328,108 @@ register(
     "hang/poison a serving backend at a request ordinal.",
     _str, "resilience")
 
+# -- health (pychemkin_tpu/health): fleet signals + thresholds -------------
+# observability-must-not-crash semantics throughout: unparseable
+# numbers fall back to their defaults (a garbage threshold must not
+# take down chemtop or a supervisor mid-incident)
+
+register(
+    "PYCHEMKIN_HEALTH_WINDOW_S", "float", 300.0,
+    "Fast evaluation window (seconds) for the health rule engine's "
+    "windowed rates/percentiles. Unparseable values fall back.",
+    _float("PYCHEMKIN_HEALTH_WINDOW_S", on_invalid="default",
+           default=300.0),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_SLOW_WINDOW_S", "float", 3600.0,
+    "Slow window (seconds) of the multi-window ERROR_BUDGET_BURN "
+    "rule; degrades to the banked history when younger than this. "
+    "Unparseable values fall back.",
+    _float("PYCHEMKIN_HEALTH_SLOW_WINDOW_S", on_invalid="default",
+           default=3600.0),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_SLO_OK", "float", 0.999,
+    "OK-fraction SLO target the burn-rate rule measures against "
+    "(budget = 1 - target). Unparseable values fall back.",
+    _float("PYCHEMKIN_HEALTH_SLO_OK", on_invalid="default",
+           default=0.999, clamp=(0.0, 1.0)),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_BURN_FAST", "float", 14.4,
+    "Fast-window burn-rate threshold of ERROR_BUDGET_BURN (14.4 "
+    "spends 2 percent of a 30-day budget in one hour, the classic "
+    "page point). Unparseable values fall back.",
+    _float("PYCHEMKIN_HEALTH_BURN_FAST", on_invalid="default",
+           default=14.4),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_BURN_SLOW", "float", 6.0,
+    "Slow-window burn-rate threshold of ERROR_BUDGET_BURN (both "
+    "windows must burn to fire). Unparseable values fall back.",
+    _float("PYCHEMKIN_HEALTH_BURN_SLOW", on_invalid="default",
+           default=6.0),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_HIT_RATE_MIN", "float", 0.7,
+    "Windowed surrogate hit-rate floor of SURROGATE_RETRAIN (the "
+    "ROADMAP #4 retrain trigger). Unparseable values fall back.",
+    _float("PYCHEMKIN_HEALTH_HIT_RATE_MIN", on_invalid="default",
+           default=0.7, clamp=(0.0, 1.0)),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_HIT_MIN_N", "int", 20,
+    "Minimum live (hit+fallback) requests in the window before "
+    "SURROGATE_RETRAIN may fire. Unparseable values fall back.",
+    _int("PYCHEMKIN_HEALTH_HIT_MIN_N", on_invalid="default",
+         default=20, lo=1),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_CORR_MIN", "float", 0.3,
+    "schedule.predictor_corr floor of PREDICTOR_DECALIBRATED (the "
+    "switch-cost_fn signal from ISSUE 14). Unparseable values fall "
+    "back.",
+    _float("PYCHEMKIN_HEALTH_CORR_MIN", on_invalid="default",
+           default=0.3),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_SATURATED_POLLS", "int", 3,
+    "Consecutive polls the top-bucket occupancy p95 must sit at the "
+    "cap before LADDER_SATURATED fires (the ROADMAP #3 scale-up "
+    "signal). Unparseable values fall back.",
+    _int("PYCHEMKIN_HEALTH_SATURATED_POLLS", on_invalid="default",
+         default=3, lo=1),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_DEADLINE_FRAC", "float", 0.05,
+    "Windowed deadline-expired fraction of requests above which "
+    "DEADLINE_PRESSURE fires. Unparseable values fall back.",
+    _float("PYCHEMKIN_HEALTH_DEADLINE_FRAC", on_invalid="default",
+           default=0.05, clamp=(0.0, 1.0)),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_CLEAR_POLLS", "int", 2,
+    "Default consecutive healthy polls before a firing signal clears "
+    "(hysteresis — a flapping metric cannot page every poll). "
+    "Unparseable values fall back.",
+    _int("PYCHEMKIN_HEALTH_CLEAR_POLLS", on_invalid="default",
+         default=2, lo=1),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_RING", "int", 720,
+    "Snapshot-ring capacity (samples) of the health time-series "
+    "(~24 min at chemtop's 2 s poll default). Unparseable values "
+    "fall back.",
+    _int("PYCHEMKIN_HEALTH_RING", on_invalid="default",
+         default=720, lo=2),
+    "health")
+register(
+    "PYCHEMKIN_HEALTH_HISTORY_DIR", "path", None,
+    "Directory supervisors bank their health-history JSONL into "
+    "(one health_<pid>_<n>.jsonl per supervisor; replayed by "
+    "chemtop --check-signals). Unset disables banking.",
+    _str, "health")
+
 register(
     "PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS", "int", 2,
     "Backend respawn budget for a supervisor's lifetime.",

@@ -21,6 +21,11 @@ tree:
 - ``telemetry-schedule-counters`` — the scheduling package's exported
   ``SCHEDULE_COUNTERS`` tuple must be a subset of the schema's
   counters (single source of truth, checked without importing jax).
+- ``telemetry-health-signals`` — the health package's exported
+  ``SIGNAL_NAMES`` tuple AND every rule-dict ``"name"`` string
+  literal in ``pychemkin_tpu/health/signals.py`` must appear in the
+  schema's ``HEALTH_SIGNALS``: a typo'd operator-signal name fails
+  chemlint, not a dashboard or a page at 3 am.
 
 The schema module holds only literal tuples, so everything here is
 AST-extraction — no imports of instrumented modules.
@@ -36,6 +41,7 @@ from .engine import (LintContext, ModuleInfo, Violation, call_name,
 
 SCHEMA_RELPATH = "pychemkin_tpu/telemetry/schema.py"
 SCHEDULE_RELPATH = "pychemkin_tpu/schedule/__init__.py"
+HEALTH_SIGNALS_RELPATH = "pychemkin_tpu/health/signals.py"
 
 #: method/function name -> (schema category, name-argument index)
 EMIT_SITES: Dict[str, Tuple[str, int]] = {
@@ -254,3 +260,42 @@ def check_schedule_counters(ctx: LintContext) -> Iterable[Violation]:
             "telemetry-schedule-counters", SCHEDULE_RELPATH, 1,
             f"SCHEDULE_COUNTERS entry {name!r} is missing from the "
             f"canonical schema {SCHEMA_RELPATH}")
+
+
+@rule("telemetry-health-signals",
+      "health signal names (SIGNAL_NAMES and every rule-dict 'name' "
+      "literal) must appear in the schema's HEALTH_SIGNALS",
+      full_only=True)
+def check_health_signals(ctx: LintContext) -> Iterable[Violation]:
+    schema_mod = ctx.parse_repo_file(SCHEMA_RELPATH)
+    health = ctx.parse_repo_file(HEALTH_SIGNALS_RELPATH)
+    if schema_mod is None or health is None or health.tree is None:
+        return
+    allowed = _extract_sets(schema_mod).get("HEALTH_SIGNALS", set())
+    exported = _extract_sets(health).get("SIGNAL_NAMES", set())
+    for name in sorted(exported - allowed):
+        yield Violation(
+            "telemetry-health-signals", HEALTH_SIGNALS_RELPATH, 1,
+            f"SIGNAL_NAMES entry {name!r} is missing from the "
+            f"canonical schema's HEALTH_SIGNALS ({SCHEMA_RELPATH})")
+    # every rule dict's literal "name" value: the shipped DEFAULT_RULES
+    # and any future literal rule spec in this module
+    for node in health.walk():
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, value in zip(node.keys, node.values):
+            if not (isinstance(key, ast.Constant)
+                    and key.value == "name"):
+                continue
+            if not (isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)):
+                continue
+            if value.value in allowed:
+                continue
+            yield Violation(
+                "telemetry-health-signals", HEALTH_SIGNALS_RELPATH,
+                value.lineno,
+                f"rule signal name {value.value!r} is not in the "
+                f"schema's HEALTH_SIGNALS ({SCHEMA_RELPATH}) — a "
+                "typo'd signal silently forks the alert series; add "
+                "it to the schema or fix the name")

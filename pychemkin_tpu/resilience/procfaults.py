@@ -79,7 +79,19 @@ import sys
 import time
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
-from .. import knobs
+try:
+    from .. import knobs
+except ImportError:
+    # the chaos fake backend (tests/test_serve_transport.py) loads
+    # this module STANDALONE via importlib — no package parent, no
+    # jax. Fall back to a raw read with knobs.raw semantics (re-read
+    # per call, None when unset) so env-driven chaos still activates.
+    class _StandaloneKnobs:
+        @staticmethod
+        def raw(name):
+            return os.environ.get(name)
+
+    knobs = _StandaloneKnobs()
 
 _ENV = "PYCHEMKIN_PROC_FAULTS"
 

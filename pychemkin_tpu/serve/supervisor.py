@@ -52,6 +52,22 @@ Telemetry: ``supervisor.spawn`` / ``supervisor.backend_lost`` /
 ``supervisor.resubmits`` / ``supervisor.backend_lost_requests``
 counters; ``supervisor.resubmit`` / ``supervisor.backend_lost``
 trace spans under each affected request's trace id.
+
+- **Health timeline** (ISSUE 15): every supervisor embeds a
+  :class:`pychemkin_tpu.health.HealthMonitor` — a sampler thread
+  banks a normalized health sample every ``health_sample_s`` (a
+  best-effort ``metrics`` scrape enriched with the supervisor's OWN
+  liveness knowledge, so a backend that cannot answer the op still
+  yields an authoritative alive/dead sample), the monitor loop pushes
+  an immediate down-sample at every classified loss and an
+  alive-sample at every successful respawn (``BACKEND_DOWN`` fires
+  within one poll of the SIGKILL and clears on respawn), and
+  :meth:`Supervisor.metrics` replies carry the evaluated signal
+  state + transition timeline under ``"health"``. With
+  ``health_history_path`` (or ``PYCHEMKIN_HEALTH_HISTORY_DIR``) the
+  sample/signal stream lands as a JSONL history file —
+  ``tools/chemtop.py --check-signals`` replays it, and
+  ``run_suite --chaos`` gates on the fired-then-cleared cycle.
 """
 
 from __future__ import annotations
@@ -68,6 +84,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import knobs, telemetry
+from ..health import HealthMonitor
 from ..resilience.driver import GracefulStop, is_poisoned
 from ..resilience.procfaults import REEXEC_COUNT_ENV
 from ..resilience.status import SolveStatus, name_of
@@ -81,6 +98,15 @@ from .transport import PORT_MARKER, READY_MARKER, TransportClient
 #: SIGKILL-proof half of the crash flight recorder. Also settable per
 #: supervisor via the ``kill_report_dir`` kwarg.
 KILL_REPORT_DIR_ENV = "PYCHEMKIN_KILL_REPORT_DIR"
+
+#: directory supervisors bank their health-history JSONL into (one
+#: ``health_<pid>_<n>.jsonl`` per supervisor; several supervisors in
+#: one process must not interleave one file). Also settable per
+#: supervisor via the ``health_history_path`` kwarg.
+HEALTH_HISTORY_DIR_ENV = "PYCHEMKIN_HEALTH_HISTORY_DIR"
+
+#: per-process supervisor ordinal for unique history file names
+_HEALTH_SEQ = itertools.count()
 
 
 class SupervisorError(RuntimeError):
@@ -125,7 +151,9 @@ class Supervisor:
                  spawn_timeout_s: float = 300.0,
                  default_tenant: str = "default",
                  recorder=None,
-                 kill_report_dir: Optional[str] = None):
+                 kill_report_dir: Optional[str] = None,
+                 health_history_path: Optional[str] = None,
+                 health_sample_s: float = 2.0):
         self.config = dict(config or {})
         self.host = host
         self._backend_argv = backend_argv
@@ -144,6 +172,15 @@ class Supervisor:
         self._kill_report_dir = (
             kill_report_dir if kill_report_dir is not None
             else knobs.value(KILL_REPORT_DIR_ENV))
+        if health_history_path is None:
+            health_dir = knobs.value(HEALTH_HISTORY_DIR_ENV)
+            if health_dir:
+                health_history_path = os.path.join(
+                    health_dir,
+                    f"health_{os.getpid()}_{next(_HEALTH_SEQ)}.jsonl")
+        self.health_sample_s = float(health_sample_s)
+        self._health = HealthMonitor(recorder=self._rec,
+                                     history_path=health_history_path)
         self._last_pong: Optional[float] = None  # guarded-by: _lock
         self._lock = threading.RLock()
         self._proc: Optional[subprocess.Popen] = None  # guarded-by: _lock
@@ -161,6 +198,8 @@ class Supervisor:
         self._started = False                    # guarded-by: _lock
         self._monitor: Optional[threading.Thread] = None
         self._hb_thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._health_scrape_ok = False           # guarded-by: _lock
         self._stop = GracefulStop()
 
     # -- spawning --------------------------------------------------------
@@ -264,8 +303,12 @@ class Supervisor:
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="supervisor-heartbeat",
             daemon=True)
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="supervisor-health",
+            daemon=True)
         self._monitor.start()
         self._hb_thread.start()
+        self._health_thread.start()
         return self
 
     def __enter__(self) -> "Supervisor":
@@ -318,8 +361,10 @@ class Supervisor:
         backend: the backend's ``metrics`` reply (counters, histogram
         summaries + mergeable states, tenants, uptime, generation)
         with the supervisor's own respawn/re-submit/backend-lost
-        counters under ``"supervisor"`` — one scrape answers both
-        "how is the serving core doing" and "how often is it dying".
+        counters under ``"supervisor"`` and the evaluated health
+        signal state + transition timeline under ``"health"`` — one
+        scrape answers "how is the serving core doing", "how often is
+        it dying", and "what should an operator do about it".
         A dead/respawning backend yields ``{"error": ..,
         "supervisor": ..}`` instead of raising: a scraper must keep
         working exactly when the fleet is unhealthy."""
@@ -332,7 +377,14 @@ class Supervisor:
         except Exception as exc:     # noqa: BLE001 — scrape must land
             reply = {"error": f"{type(exc).__name__}: {exc}"}
         reply["supervisor"] = self.stats()
+        reply["health"] = self._health.state()
         return reply
+
+    def health_state(self) -> Dict[str, Any]:
+        """The health monitor's JSON-ready state: evaluated signals,
+        the fire/clear transition timeline, windowed restart count
+        (what the loadgen soak artifact banks under ``"health"``)."""
+        return self._health.state()
 
     def install_signal_handlers(self) -> GracefulStop:
         """SIGTERM/SIGINT → graceful drain (flag only; the heartbeat
@@ -533,6 +585,87 @@ class Supervisor:
             if c is not None:
                 c.close()
 
+    def _health_loop(self) -> None:
+        """Bank one health sample every ``health_sample_s``: a
+        best-effort ``metrics`` scrape on a DEDICATED connection
+        (never the heartbeat's — a slow scrape must not starve the
+        watchdog), falling back to the supervisor's own liveness
+        knowledge when the backend cannot answer the op (a minimal
+        protocol backend — the test fake — still yields authoritative
+        alive/dead samples). Loss/respawn transitions are pushed
+        separately by the monitor loop, so BACKEND_DOWN does not wait
+        for the next tick here."""
+        scraper: Optional[TransportClient] = None
+        scraper_gen = -1
+        try:
+            while True:
+                with self._lock:
+                    if self._draining or self._dead:
+                        return
+                    port = self._port
+                    generation = self._respawns
+                    alive = (not self._dead and self._proc is not None
+                             and self._proc.poll() is None)
+                if not alive:
+                    self._health.observe(
+                        {"error": "backend not running"})
+                else:
+                    if scraper is not None and scraper_gen != generation:
+                        scraper.close()
+                        scraper = None
+                    reply = None
+                    try:
+                        if scraper is None and port is not None:
+                            scraper = TransportClient(
+                                self.host, port, recorder=self._rec)
+                            scraper_gen = generation
+                        if scraper is not None:
+                            reply = dict(scraper.metrics(
+                                timeout=min(self.health_sample_s,
+                                            5.0)))
+                            with self._lock:
+                                self._health_scrape_ok = True
+                    except Exception:  # noqa: BLE001 — degrade to liveness
+                        if scraper is not None:
+                            scraper.close()
+                        scraper = None
+                        reply = None
+                    if reply is None:
+                        # the scrape failed; RE-CHECK liveness before
+                        # vouching alive — the backend may have died
+                        # DURING the scrape, and an alive fallback
+                        # banked after the monitor's down-sample would
+                        # spuriously clear a firing BACKEND_DOWN
+                        with self._lock:
+                            still_alive = (
+                                not self._dead
+                                and self._proc is not None
+                                and self._proc.poll() is None)
+                        if still_alive:
+                            # alive by the supervisor's own evidence
+                            # even though the scrape failed: bank the
+                            # liveness + supervisor counters, not an
+                            # error ("partial": its missing backend
+                            # series are holes, not zeros — the window
+                            # algebra carries last-known values)
+                            reply = {"generation": generation,
+                                     "partial": True}
+                    if reply is None:
+                        self._health.observe(
+                            {"error": "backend not running"})
+                    else:
+                        reply["supervisor"] = self.stats()
+                        self._health.observe(reply)
+                deadline = time.perf_counter() + self.health_sample_s
+                while time.perf_counter() < deadline:
+                    with self._lock:
+                        if self._draining or self._dead:
+                            return
+                    time.sleep(min(0.05, self.health_sample_s))
+        finally:
+            if scraper is not None:
+                scraper.close()
+
     def _monitor_loop(self) -> None:
         while True:
             with self._lock:
@@ -555,6 +688,10 @@ class Supervisor:
             self._rec.event("supervisor.backend_lost", reason=reason,
                             rc=rc, generation=respawns,
                             n_inflight=len(self._inflight))
+            # authoritative down-sample at classification time:
+            # BACKEND_DOWN fires within one poll of the death, not one
+            # scrape interval later
+            self._health.note_backend_lost(reason)
             # the SIGKILL-proof half of the crash flight recorder: the
             # dead child cannot dump its own state, so the supervisor
             # banks the post-mortem from the outside
@@ -572,6 +709,9 @@ class Supervisor:
             except SupervisorError as exc:
                 self._mark_dead(str(exc))
                 return
+            # the clear half of the fired-then-cleared cycle, banked
+            # the instant the fresh generation is up
+            self._health.note_respawned(respawns + 1)
             self._resubmit_all()
 
     @staticmethod
@@ -722,6 +862,23 @@ class Supervisor:
                 already = False
                 self._draining = True
             proc = self._proc
+            client = self._client
+            scrape_ok = self._health_scrape_ok
+        if not already and scrape_ok and client is not None \
+                and proc is not None and proc.poll() is None:
+            # only when the backend has ever answered the op — a
+            # minimal-protocol backend must not tax every close with
+            # a doomed scrape's timeout
+            # one last health sample while the backend can still
+            # answer: the banked history's final cumulative state must
+            # cover the whole run, or windowed percentiles lose the
+            # tail observed after the last periodic sample
+            try:
+                reply = dict(client.metrics(timeout=5.0))
+                reply["supervisor"] = self.stats()
+                self._health.observe(reply)
+            except Exception:        # noqa: BLE001 — best effort only
+                pass
         graceful = True
         if not already and proc is not None:
             if proc.poll() is None:
@@ -762,7 +919,8 @@ class Supervisor:
                 cur.wait()
                 graceful = False
             self._close_clients()
-            for t in (self._monitor, self._hb_thread):
+            for t in (self._monitor, self._hb_thread,
+                      self._health_thread):
                 if t is not None and t is not threading.current_thread():
                     t.join(timeout=10.0)
             with self._lock:

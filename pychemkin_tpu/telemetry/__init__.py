@@ -68,6 +68,13 @@ Kinds emitted by the framework:
                      report for a lost backend (path, classification)
                      / could not write one (durability degraded, the
                      respawn continues).
+- ``health.signal``  — a typed operator signal fired or cleared
+                     (signal, severity, state, window_s, evidence,
+                     fired_at, cleared_at); emitted by the
+                     :mod:`pychemkin_tpu.health` rule engine from the
+                     chemtop poll loop and the supervisor's health
+                     sampler, so post-mortems and trace exemplars can
+                     be correlated with what the fleet looked like.
 - ``trace.span``     — one traced hop of one request (trace, span,
                      dur_ms, optional parent + per-span fields); see
                      :mod:`.trace` for the span-name catalogue and the
@@ -107,6 +114,7 @@ several to the former, one to the latter).
 from . import trace
 from .recorder import (
     Histogram,
+    HistogramSubtractionError,
     MetricsRecorder,
     configure,
     device_counters_enabled,
@@ -116,6 +124,7 @@ from .recorder import (
     get_recorder,
     merge_histogram_states,
     record_event,
+    subtract_histogram_states,
 )
 from .sink import (
     JsonlSink,
@@ -128,6 +137,7 @@ from .sink import (
 
 __all__ = [
     "Histogram",
+    "HistogramSubtractionError",
     "JsonlSink",
     "MetricsRecorder",
     "append_jsonl",
@@ -143,5 +153,6 @@ __all__ = [
     "merge_histogram_states",
     "read_jsonl",
     "record_event",
+    "subtract_histogram_states",
     "trace",
 ]

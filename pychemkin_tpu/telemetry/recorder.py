@@ -185,6 +185,91 @@ def merge_histogram_states(states) -> Dict[str, float]:
     return Histogram.from_states(states).summary()
 
 
+class HistogramSubtractionError(ValueError):
+    """``subtract_histogram_states(a, b)`` was asked for a windowed
+    difference where ``b`` is NOT a prefix of ``a`` — some bucket (or
+    the total count) would go negative. Counters only ever grow inside
+    one process generation, so a non-monotone pair means the emitting
+    process respawned between the two scrapes; the caller must treat
+    the window as reset, not trust a negative distribution."""
+
+
+def _empty_state() -> Dict[str, Any]:
+    return {"counts": {}, "count": 0, "sum": 0.0,
+            "min": None, "max": None}
+
+
+def subtract_histogram_states(a: Optional[Dict[str, Any]],
+                              b: Optional[Dict[str, Any]]
+                              ) -> Dict[str, Any]:
+    """The inverse of :func:`merge_histogram_states` on RAW states:
+    ``a - b`` where ``b`` is an earlier scrape of the same
+    still-growing histogram. The result is itself a mergeable state
+    describing exactly the observations made BETWEEN the two scrapes —
+    what windowed (last-N-seconds) percentiles are computed from,
+    instead of since-boot distributions.
+
+    Non-negative by construction: any bucket of ``b`` exceeding its
+    bucket in ``a`` (or a count/bucket-total mismatch) raises the
+    typed :class:`HistogramSubtractionError` — that shape means the
+    emitting process restarted between scrapes.
+
+    Exact min/max of the in-window observations are unknowable from
+    bucket counts alone, so the result carries CONSERVATIVE bounds
+    derived from the surviving buckets' edges (clamped by ``a``'s
+    exact bounds) — within one bucket boundary of the truth, which is
+    also the resolution of every percentile estimate. ``b`` empty
+    returns ``a`` unchanged (exact bounds)."""
+    a = a if a and a.get("count") else _empty_state()
+    b = b if b and b.get("count") else _empty_state()
+    a_counts = {int(k): int(v) for k, v in
+                (a.get("counts") or {}).items() if int(v)}
+    b_counts = {int(k): int(v) for k, v in
+                (b.get("counts") or {}).items() if int(v)}
+    if not b_counts and not b.get("count"):
+        # exact fast path: nothing to remove, a's bounds are exact
+        return {"counts": {str(k): v for k, v in a_counts.items()},
+                "count": int(a.get("count") or 0),
+                "sum": round(float(a.get("sum") or 0.0), 6),
+                "min": a.get("min"), "max": a.get("max")}
+    diff: Dict[int, int] = {}
+    for k, bv in b_counts.items():
+        av = a_counts.get(k, 0)
+        if bv > av:
+            raise HistogramSubtractionError(
+                f"bucket {k}: subtrahend has {bv} > minuend {av} — "
+                "the emitting process restarted between scrapes")
+    for k, av in a_counts.items():
+        d = av - b_counts.get(k, 0)
+        if d:
+            diff[k] = d
+    count = int(a.get("count") or 0) - int(b.get("count") or 0)
+    if count < 0 or count != sum(diff.values()):
+        raise HistogramSubtractionError(
+            f"count delta {count} does not match bucket delta "
+            f"{sum(diff.values())} — inconsistent states (restart?)")
+    if count == 0:
+        return _empty_state()
+    total = float(a.get("sum") or 0.0) - float(b.get("sum") or 0.0)
+    # conservative bounds from the surviving buckets: bucket k holds
+    # values in [edge[k-1], edge[k]); a's exact global bounds still
+    # bound every in-window value, so clamp by them
+    lo_idx, hi_idx = min(diff), max(diff)
+    lo = _HIST_EDGES[lo_idx - 1] if lo_idx > 0 else -math.inf
+    hi = _HIST_EDGES[hi_idx] if hi_idx < len(_HIST_EDGES) else math.inf
+    if a.get("min") is not None:
+        lo = max(lo, float(a["min"]))
+    if a.get("max") is not None:
+        hi = min(hi, float(a["max"]))
+    if not math.isfinite(lo):
+        lo = hi if math.isfinite(hi) else 0.0
+    if not math.isfinite(hi):
+        hi = lo
+    return {"counts": {str(k): v for k, v in diff.items()},
+            "count": count, "sum": round(total, 6),
+            "min": round(lo, 6), "max": round(hi, 6)}
+
+
 class MetricsRecorder:
     """Counters + gauges + histograms + device-fenced wall-clock timers
     + events.

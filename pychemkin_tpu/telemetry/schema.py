@@ -132,6 +132,7 @@ EVENTS = (
     "driver.reexec_failed",
     "driver.retry",
     "flame",
+    "health.signal",
     "odeint",
     "rescue",
     "schedule.adjust",
@@ -161,6 +162,38 @@ EVENTS = (
 
 EVENT_PREFIXES = ()
 
+# -- health signals ---------------------------------------------------------
+
+#: canonical operator-signal names the :mod:`pychemkin_tpu.health`
+#: rule engine may emit (the ``signal`` field of a ``health.signal``
+#: event, and the ``name`` of every shipped rule dict). The lint's
+#: ``telemetry-health-signals`` rule pins both the engine's exported
+#: ``SIGNAL_NAMES`` tuple and every rule-dict ``"name"`` literal in
+#: ``pychemkin_tpu/health/signals.py`` to this set, so a typo'd
+#: signal name fails chemlint, not production dashboards.
+HEALTH_SIGNALS = (
+    "BACKEND_DOWN",
+    "DEADLINE_PRESSURE",
+    "ERROR_BUDGET_BURN",
+    "LADDER_SATURATED",
+    "PREDICTOR_DECALIBRATED",
+    "SURROGATE_RETRAIN",
+)
+
+#: field names a ``health.signal`` event carries beyond the spine's
+#: ``t``/``kind`` — the contract between the rule engine and the
+#: downstream readers (chemtop's alerts panel, the loadgen artifact's
+#: signal timeline, flight-recorder correlation).
+HEALTH_EVENT_FIELDS = (
+    "signal",
+    "severity",
+    "state",
+    "window_s",
+    "evidence",
+    "fired_at",
+    "cleared_at",
+)
+
 # -- timers (recorder.section blocks) ---------------------------------------
 
 TIMERS = ()
@@ -187,5 +220,6 @@ SPAN_PREFIXES = ()
 __all__ = [
     "COUNTERS", "COUNTER_PREFIXES", "GAUGES", "GAUGE_PREFIXES",
     "HISTOGRAMS", "HISTOGRAM_PREFIXES", "EVENTS", "EVENT_PREFIXES",
+    "HEALTH_SIGNALS", "HEALTH_EVENT_FIELDS",
     "TIMERS", "TIMER_PREFIXES", "SPANS", "SPAN_PREFIXES",
 ]

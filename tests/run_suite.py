@@ -66,7 +66,14 @@ Behaviour:
   the suite ASSERTS at least one ``kill_report*.json`` artifact exists
   — the canned kill spec must leave a readable post-mortem, so the
   crash flight recorder is CI-enforced, not just unit-tested; a chaos
-  run that banked no report fails with rc 1;
+  run that banked no report fails with rc 1. The children additionally
+  get ``PYCHEMKIN_HEALTH_HISTORY_DIR`` (ISSUE 15), so every spawned
+  supervisor banks its health-history JSONL; when any landed, the
+  suite replays them via ``tools/chemtop.py --check-signals
+  --require-cycle BACKEND_DOWN`` (a subprocess — no jax here) and
+  fails unless some history shows the injected SIGKILL as a
+  fired-then-cleared BACKEND_DOWN signal — stale files are excluded
+  by the same preexisting-set gate as kill reports;
 - exit code is 0 iff every file's pytest exited 0 or 5 (with at least
   one 0);
 - a per-file line and a final summary are printed; the summary ends
@@ -349,6 +356,7 @@ def main(argv=None):
     env = _child_env(faults=faults, chaos=chaos)
     kill_dir = None
     preexisting_reports = set()
+    preexisting_health = set()
     if chaos:
         # chaos children's supervisors bank kill reports here; the
         # suite asserts at least one landed (the flight recorder is
@@ -357,11 +365,21 @@ def main(argv=None):
         if not kill_dir:
             kill_dir = tempfile.mkdtemp(prefix="pychemkin_kill_")
         env["PYCHEMKIN_KILL_REPORT_DIR"] = kill_dir
+        # chaos children's supervisors also bank their health-history
+        # JSONL (ISSUE 15): after the run the suite replays them via
+        # chemtop --check-signals and asserts the injected SIGKILL
+        # produced a fired-then-cleared BACKEND_DOWN signal
+        if not os.environ.get("PYCHEMKIN_HEALTH_HISTORY_DIR"):
+            env["PYCHEMKIN_HEALTH_HISTORY_DIR"] = kill_dir
+        health_dir = env["PYCHEMKIN_HEALTH_HISTORY_DIR"]
         # only reports banked by THIS run count: a caller-provided dir
         # may hold a previous run's artifacts, and a stale file must
-        # not green-light a broken flight recorder
+        # not green-light a broken flight recorder (the same gate
+        # covers stale health histories)
         preexisting_reports = set(glob.glob(
             os.path.join(kill_dir, "kill_report*.json")))
+        preexisting_health = set(glob.glob(
+            os.path.join(health_dir, "health_*.jsonl")))
     results = []
     t_suite = time.time()
 
@@ -422,6 +440,7 @@ def main(argv=None):
         suite_rc = 0
 
     kill_reports = None
+    health_histories = None
     if chaos:
         kill_reports = sorted(
             p for p in glob.glob(
@@ -436,6 +455,48 @@ def main(argv=None):
                   "artifact was banked", flush=True)
             if suite_rc in (0, 5):
                 suite_rc = 1
+        health_dir = env["PYCHEMKIN_HEALTH_HISTORY_DIR"]
+        health_histories = sorted(
+            p for p in glob.glob(
+                os.path.join(health_dir, "health_*.jsonl"))
+            if p not in preexisting_health)
+        print("# run_suite: chaos health histories: "
+              f"{len(health_histories)} new in {health_dir}",
+              flush=True)
+        if health_histories:
+            # replay every banked history through the rule engine: at
+            # least one supervisor must show the injected SIGKILL as a
+            # fired-then-cleared BACKEND_DOWN cycle (chemtop runs as a
+            # subprocess — this orchestrator never imports the
+            # jax-importing package). Zero histories SKIPS the gate
+            # deliberately: the chaos-flag unit tests run synthetic
+            # probe files that bank a kill report by hand but spawn no
+            # supervisors — only runs that actually exercised
+            # supervisors can be held to the cycle gate.
+            chemtop = os.path.join(os.path.dirname(here), "tools",
+                                   "chemtop.py")
+            try:
+                check = subprocess.run(
+                    [sys.executable, chemtop, "--check-signals",
+                     *health_histories,
+                     "--require-cycle", "BACKEND_DOWN"],
+                    env=env, capture_output=True, text=True,
+                    timeout=300)
+                check_rc = check.returncode
+                tail = (check.stdout or "").strip().splitlines()
+                if tail:
+                    print(f"# run_suite: check-signals: {tail[-1]}",
+                          flush=True)
+            except (OSError, subprocess.TimeoutExpired) as exc:
+                print(f"# run_suite: check-signals could not run: "
+                      f"{exc}", flush=True)
+                check_rc = 1
+            if check_rc != 0:
+                print("# run_suite: CHAOS FAILURE: no banked health "
+                      "history shows a fired-then-cleared "
+                      "BACKEND_DOWN signal", flush=True)
+                if suite_rc in (0, 5):
+                    suite_rc = 1
 
     if summary_json:
         summary = {
@@ -455,6 +516,8 @@ def main(argv=None):
         }
         if kill_reports is not None:
             summary["kill_reports"] = kill_reports
+        if health_histories is not None:
+            summary["health_histories"] = health_histories
         try:
             _sink_module().atomic_write_json(summary_json, summary)
             print(f"# run_suite: summary banked to {summary_json}",

@@ -514,6 +514,22 @@ class TestAcceptanceInjections:
                 n_lines)
             assert "serve.typo_counter_xyz" in out
 
+    def test_health_signal_name_injection(self, tmp_path, capsys):
+        """ISSUE 15 satellite: a rule dict naming a signal outside the
+        schema's HEALTH_SIGNALS fails the analyzer, naming the file
+        and line — a typo'd signal fails chemlint, not a dashboard."""
+        scratch = _make_scratch(tmp_path)
+        target = os.path.join(scratch,
+                              "pychemkin_tpu/health/signals.py")
+        inject = ("\n\nEXTRA_RULES = ("
+                  "{\"name\": \"BACKEND_DWON\", \"severity\": "
+                  "\"page\", \"kind\": \"backend_down\"},)\n")
+        with _appended(target, inject) as n_lines:
+            out = _expect_named_failure(
+                capsys, scratch, "telemetry-health-signals",
+                "pychemkin_tpu/health/signals.py", n_lines)
+            assert "BACKEND_DWON" in out
+
     def test_unlocked_guarded_write_injection(self, tmp_path, capsys):
         scratch = _make_scratch(tmp_path)
         target = os.path.join(scratch, SUPERVISOR)

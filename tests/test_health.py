@@ -1,0 +1,744 @@
+"""Fleet health tests (ISSUE 15): windowed time-series, generation-
+aware counter deltas, histogram state subtraction driving true
+windowed percentiles, the declarative signal engine with hysteresis,
+history replay (``chemtop --check-signals``), the chemtop health
+wiring, and the embeddable monitor.
+
+Everything here is fast-lane and socket-free: samples are synthetic
+fixtures (the exact two-scrape shapes the derivations must survive —
+counter resets, scrape holes, flapping thresholds). The real-process
+variants ride the ``--chaos`` and slow lanes of
+``tests/test_serve_transport.py``.
+"""
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pychemkin_tpu import health, knobs, telemetry
+from pychemkin_tpu.health import monitor as health_monitor
+from pychemkin_tpu.health import signals as health_signals
+from pychemkin_tpu.health import timeseries
+from pychemkin_tpu.telemetry import schema
+
+#: one log-spaced bucket is a factor of 10^(1/8): the resolution of
+#: every histogram-derived estimate, hence the acceptance tolerance
+BUCKET_FACTOR = 10.0 ** (1.0 / 8.0)
+
+
+def _hist_state(values):
+    h = telemetry.Histogram()
+    for v in values:
+        h.observe(v)
+    return h.state()
+
+
+def _backend_sample(t, counters=None, gauges=None, hists=None,
+                    generation=0, error=None):
+    """A normalized sample from a synthetic single-backend metrics
+    reply (the supervisor-monitor shape)."""
+    reply = {"generation": generation}
+    if error is not None:
+        reply = {"error": error}
+    if counters:
+        reply["counters"] = dict(counters)
+    if gauges:
+        reply["gauges"] = dict(gauges)
+    if hists:
+        reply["histogram_states"] = dict(hists)
+    return health.normalize_sample(reply, t=t)
+
+
+class TestPairDeltasAndWindow:
+    """ISSUE 15 satellite: generation-aware counter deltas — a
+    backend respawn mid-window (counter reset) yields a clamped rate
+    and a restart count, never a negative rate."""
+
+    def test_monotone_delta_and_rate(self):
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(
+            0.0, counters={"serve.requests": 100}))
+        ring.append(_backend_sample(
+            10.0, counters={"serve.requests": 160}))
+        view = ring.window(60.0)
+        assert view.delta("serve.requests") == 60
+        assert view.rate("serve.requests") == pytest.approx(6.0)
+        assert view.restarts == 0
+
+    def test_counter_reset_clamps_and_counts_restart(self):
+        # the two-scrape respawn fixture: 150 -> 5 means the backend
+        # died and a fresh one counted 5 since boot
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(
+            0.0, counters={"serve.requests": 150}))
+        ring.append(_backend_sample(
+            10.0, counters={"serve.requests": 5}, generation=1))
+        view = ring.window(60.0)
+        assert view.delta("serve.requests") == 5      # clamped
+        assert view.rate("serve.requests") >= 0.0     # never negative
+        assert view.restarts == 1
+
+    def test_mid_window_reset_sums_both_segments(self):
+        # 100→150 (+50), reset, 0→30 (+30): the window saw 80 real
+        # requests; the naive end-minus-start (-70) must never appear
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(0.0,
+                                    counters={"serve.requests": 100}))
+        ring.append(_backend_sample(5.0,
+                                    counters={"serve.requests": 150}))
+        ring.append(_backend_sample(10.0,
+                                    counters={"serve.requests": 30},
+                                    generation=1))
+        view = ring.window(60.0)
+        assert view.delta("serve.requests") == 50 + 30
+        assert view.restarts == 1
+
+    def test_generation_bump_alone_is_a_restart(self):
+        prev = _backend_sample(0.0, generation=0)
+        cur = _backend_sample(1.0, generation=1)
+        deltas, restart = health.pair_deltas(prev, cur)
+        assert restart is True and deltas == {}
+
+    def test_new_counter_after_authoritative_scrape_counts_whole(self):
+        # an authoritative scrape without the counter vouches it was
+        # ZERO then — the first sighting is all in-window traffic
+        # (the surrogate soak shape: hit/fallback appear mid-run)
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(0.0, counters={}))
+        ring.append(_backend_sample(
+            10.0, counters={"serve.surrogate.hit": 40}))
+        view = ring.window(60.0)
+        assert view.delta("serve.surrogate.hit") == 40
+        assert view.restarts == 0
+
+    def test_new_counter_without_authority_contributes_nothing(self):
+        # first sample is a liveness-only fallback: the counter's
+        # pre-window total is unknown, so its sighting is baseline
+        ring = health.SnapshotRing()
+        ring.append(health.normalize_sample(
+            {"generation": 0, "partial": True}, t=0.0))
+        ring.append(_backend_sample(
+            10.0, counters={"serve.surrogate.hit": 40}))
+        view = ring.window(60.0)
+        assert view.delta("serve.surrogate.hit") == 0
+        assert view.restarts == 0
+
+    def test_scrape_hole_carries_last_known_value(self):
+        # alive -> dead (empty counters) -> alive again: the hole
+        # neither zeroes nor double-counts — 50 -> 80 is +30
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(0.0,
+                                    counters={"serve.requests": 50}))
+        ring.append(_backend_sample(5.0, error="scrape timeout"))
+        ring.append(_backend_sample(10.0,
+                                    counters={"serve.requests": 80}))
+        view = ring.window(60.0)
+        assert view.delta("serve.requests") == 30
+        assert view.rate("serve.requests") >= 0.0
+
+    def test_fleet_member_death_is_a_hole_not_a_respawn(self):
+        # two-backend fleet, one dies: the merged sums SHRINK in a
+        # partial sample (n_alive < n_backends). That must not be
+        # clamp-counted as a respawn — the survivors' since-boot
+        # totals would spike every windowed rate (review finding)
+        def fleet(t, total, n_alive=2, hist=None):
+            snap = {
+                "t": t, "n_backends": 2, "n_alive": n_alive,
+                "backends": [{"port": 1, "generation": 0,
+                              "error": None}] * n_alive
+                + [{"port": 2, "generation": None, "error": "dead"}]
+                * (2 - n_alive),
+                "counters": {"serve.requests": total},
+                "histogram_states": (
+                    {"serve.solve_ms": hist} if hist else {}),
+            }
+            return health.normalize_sample(snap)
+
+        h_full = _hist_state([1.0] * 100)
+        h_partial = _hist_state([1.0] * 40)       # survivor only
+        h_recovered = _hist_state([1.0] * 100 + [2.0] * 10)
+        ring = health.SnapshotRing()
+        ring.append(fleet(0.0, 1000, hist=h_full))
+        ring.append(fleet(10.0, 500, n_alive=1, hist=h_partial))
+        ring.append(fleet(20.0, 1100, hist=h_recovered))
+        view = ring.window(60.0)
+        # 1000 -> (hole) -> 1100: exactly 100 in-window requests,
+        # not 500 + 600 from the clamp-then-regrow path
+        assert view.delta("serve.requests") == 100
+        assert view.restarts == 0
+        # the shrunken partial distribution never dumps the
+        # survivors' since-boot buckets into the window
+        assert view.hist_summary("serve.solve_ms")["count"] == 10
+
+    def test_partial_sample_between_scrapes_never_double_counts(self):
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(
+            0.0, counters={"serve.surrogate.hit": 25}))
+        ring.append(health.normalize_sample(
+            {"generation": 0, "partial": True}, t=5.0))
+        ring.append(_backend_sample(
+            10.0, counters={"serve.surrogate.hit": 30}))
+        view = ring.window(60.0)
+        assert view.delta("serve.surrogate.hit") == 5
+
+    def test_window_selection_and_degradation(self):
+        ring = health.SnapshotRing()
+        assert ring.window(60.0) is None           # no samples
+        ring.append(_backend_sample(0.0))
+        assert ring.window(60.0) is None           # one sample
+        for t in (100.0, 200.0, 300.0):
+            ring.append(_backend_sample(t))
+        # a 150 s window keeps only the recent samples
+        view = ring.window(150.0)
+        assert view.start["t"] >= 150.0
+        # a window longer than the history degrades to everything
+        assert len(ring.window(10_000.0)) == 4
+
+    def test_gauge_trend(self):
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(
+            0.0, gauges={"schedule.predictor_corr": 0.8}))
+        ring.append(_backend_sample(10.0))          # gauge unset
+        ring.append(_backend_sample(
+            20.0, gauges={"schedule.predictor_corr": 0.5}))
+        view = ring.window(60.0)
+        start, latest = view.gauge_trend("schedule.predictor_corr")
+        assert (start, latest) == (0.8, 0.5)
+        assert view.gauge("never.set") is None
+
+
+class TestWindowedHistograms:
+    """Windowed p50/p99 via state subtraction — the derivation the
+    since-boot summaries could never provide."""
+
+    def test_windowed_p99_matches_raw_reference_within_bucket(self):
+        # acceptance shape: windowed p99 from SUBTRACTED states vs a
+        # reference computed from the raw in-window observations
+        rng = np.random.default_rng(7)
+        before = 10.0 ** rng.uniform(0, 2, size=400)   # pre-window
+        inside = 10.0 ** rng.uniform(1, 3, size=600)   # in-window
+        h = telemetry.Histogram()
+        for v in before:
+            h.observe(v)
+        state_start = h.state()
+        for v in inside:
+            h.observe(v)
+        state_end = h.state()
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(
+            0.0, hists={"serve.solve_ms": state_start}))
+        ring.append(_backend_sample(
+            60.0, hists={"serve.solve_ms": state_end}))
+        view = ring.window(300.0)
+        windowed = view.hist_summary("serve.solve_ms")
+        assert windowed["count"] == inside.size
+        for q, key in ((50, "p50"), (99, "p99")):
+            ref = float(np.percentile(inside, q))
+            assert windowed[key] / ref < BUCKET_FACTOR * 1.01, (
+                key, windowed[key], ref)
+            assert ref / windowed[key] < BUCKET_FACTOR * 1.01, (
+                key, windowed[key], ref)
+
+    def test_restart_falls_back_to_post_reset_state(self):
+        # subtraction across a reset raises inside; the window view
+        # must absorb it by adopting the post-restart distribution
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(
+            0.0, hists={"serve.solve_ms": _hist_state([5.0] * 50)}))
+        ring.append(_backend_sample(
+            10.0, hists={"serve.solve_ms": _hist_state([100.0] * 3)},
+            generation=1))
+        view = ring.window(60.0)
+        s = view.hist_summary("serve.solve_ms")
+        assert s["count"] == 3
+        assert s["p50"] == pytest.approx(100.0, rel=0.35)
+
+    def test_missing_series_is_empty(self):
+        ring = health.SnapshotRing()
+        ring.append(_backend_sample(0.0))
+        ring.append(_backend_sample(10.0))
+        assert ring.window(60.0).hist_summary("nope") == {"count": 0}
+
+
+class TestNormalizeSample:
+    def test_fleet_snapshot_form(self):
+        snap = {
+            "t": 123.0, "n_backends": 3, "n_alive": 2,
+            "backends": [
+                {"port": 1, "generation": 0, "error": None},
+                {"port": 2, "generation": 2, "error": None},
+                {"port": 3, "generation": None, "error": "boom"}],
+            "counters": {"serve.requests": 7},
+            "solver": {"predictor_corr": [0.8, 0.6, None]},
+            "histogram_states": {"serve.solve_ms":
+                                 _hist_state([1.0])},
+        }
+        s = health.normalize_sample(snap)
+        assert (s["n_alive"], s["n_backends"]) == (2, 3)
+        assert s["t"] == 123.0
+        assert s["generations"] == [0, 2]
+        assert s["errors"] == ["boom"]
+        assert s["counters"] == {"serve.requests": 7}
+        # the fleet gauge is the mean over reporting backends
+        assert s["gauges"]["schedule.predictor_corr"] == \
+            pytest.approx(0.7)
+        assert "serve.solve_ms" in s["hist_states"]
+
+    def test_supervisor_degraded_form_folds_counters(self):
+        s = health.normalize_sample(
+            {"error": "TimeoutError: x",
+             "supervisor": {"respawns": 2, "resubmits": 3,
+                            "backend_lost_requests": 1}})
+        assert s["n_alive"] == 0 and s["n_backends"] == 1
+        assert s["counters"]["supervisor.respawns"] == 2
+        assert s["counters"]["supervisor.backend_lost_requests"] == 1
+
+    def test_sample_is_json_ready(self):
+        s = _backend_sample(1.0, counters={"c": 1},
+                            hists={"h": _hist_state([2.0])})
+        assert json.loads(json.dumps(s)) == s
+
+
+def _run_rules(samples, rules=None, recorder=None):
+    ring = health.SnapshotRing()
+    engine = health.HealthEngine(rules=rules, recorder=recorder)
+    states = []
+    for s in samples:
+        ring.append(s)
+        states.append({sig["signal"]: sig
+                       for sig in engine.evaluate(ring)})
+    return engine, states
+
+
+class TestShippedRules:
+    """Each shipped signal fires on its synthetic trigger and clears
+    when the trigger goes away — and a healthy idle stream fires
+    NOTHING (the no-false-page property)."""
+
+    def test_healthy_idle_stream_fires_nothing(self):
+        samples = [_backend_sample(
+            float(t), counters={"serve.requests": 100 + t},
+            gauges={"schedule.predictor_corr": 0.9})
+            for t in range(0, 120, 10)]
+        engine, _ = _run_rules(samples)
+        assert engine.timeline() == []
+        assert engine.firing("info") == []
+
+    def test_backend_down_fires_and_clears(self):
+        samples = [_backend_sample(0.0),
+                   _backend_sample(1.0, error="died"),
+                   _backend_sample(2.0, generation=1)]
+        engine, states = _run_rules(samples)
+        assert states[1]["BACKEND_DOWN"]["state"] == "firing"
+        assert states[1]["BACKEND_DOWN"]["severity"] == "page"
+        assert states[2]["BACKEND_DOWN"]["state"] == "ok"
+        assert [(e["signal"], e["state"])
+                for e in engine.timeline()] == \
+            [("BACKEND_DOWN", "fired"), ("BACKEND_DOWN", "cleared")]
+
+    def test_error_budget_burn_multiwindow(self):
+        # 20% of requests blow their deadline: burn ~200x the 0.1%
+        # budget on both windows -> page; then a clean stretch clears
+        samples = [_backend_sample(0.0, counters={
+            "serve.requests": 0, "serve.deadline_expired": 0})]
+        for i in range(1, 4):
+            samples.append(_backend_sample(i * 10.0, counters={
+                "serve.requests": 100 * i,
+                "serve.deadline_expired": 20 * i}))
+        # the clean stretch sits OUTSIDE the 300 s fast window: the
+        # slow window still remembers the incident, but the fast burn
+        # drops to zero and the multi-window AND un-pages
+        for i in range(4, 8):
+            samples.append(_backend_sample(400.0 + i * 100.0,
+                                           counters={
+                "serve.requests": 100 * 3 + 1000 * (i - 3),
+                "serve.deadline_expired": 60}))
+        engine, states = _run_rules(samples)
+        assert states[3]["ERROR_BUDGET_BURN"]["state"] == "firing"
+        ev = states[3]["ERROR_BUDGET_BURN"]["evidence"]
+        assert ev["burn_fast"] > 14.4 and ev["burn_slow"] > 6.0
+        assert states[-1]["ERROR_BUDGET_BURN"]["state"] == "ok"
+
+    def test_surrogate_retrain_needs_min_n_live_requests(self):
+        def sample(t, hit, fallback):
+            return _backend_sample(t, counters={
+                "serve.surrogate.hit": hit,
+                "serve.surrogate.fallback": fallback})
+        # 5 live requests: below min_n (20) -> silent even at 0% hit
+        engine, states = _run_rules(
+            [sample(0.0, 0, 0), sample(10.0, 0, 5)])
+        assert states[-1]["SURROGATE_RETRAIN"]["state"] == "ok"
+        # 40 live requests at 25% hit rate -> retrain signal
+        engine, states = _run_rules(
+            [sample(0.0, 0, 0), sample(10.0, 10, 30)])
+        sig = states[-1]["SURROGATE_RETRAIN"]
+        assert sig["state"] == "firing"
+        assert sig["evidence"]["ratio"] == pytest.approx(0.25)
+        assert sig["evidence"]["n"] == 40
+
+    def test_predictor_decalibrated_below_floor(self):
+        def sample(t, corr):
+            return _backend_sample(
+                t, gauges={"schedule.predictor_corr": corr})
+        engine, states = _run_rules(
+            [sample(0.0, 0.8), sample(10.0, 0.1), sample(20.0, 0.1),
+             sample(30.0, 0.7), sample(40.0, 0.7)])
+        assert states[1]["PREDICTOR_DECALIBRATED"]["state"] == "firing"
+        assert states[1]["PREDICTOR_DECALIBRATED"]["evidence"][
+            "value"] == pytest.approx(0.1)
+        # clears after CLEAR_POLLS healthy polls (default 2)
+        assert states[3]["PREDICTOR_DECALIBRATED"]["state"] == "firing"
+        assert states[4]["PREDICTOR_DECALIBRATED"]["state"] == "ok"
+
+    def test_ladder_saturated_needs_k_polls(self):
+        # occupancy of the top bucket pinned at the cap: censored p95
+        # == cap; fires only after SATURATED_POLLS consecutive polls
+        k = knobs.value("PYCHEMKIN_HEALTH_SATURATED_POLLS")
+        samples = [_backend_sample(
+            float(i * 10),
+            hists={"serve.occupancy.b8":
+                   _hist_state([8.0] * (10 * (i + 1)))})
+            for i in range(k + 2)]
+        engine, states = _run_rules(samples)
+        # conditions start at the 2nd sample (first has no window):
+        # not yet fired one poll before the threshold...
+        assert states[k - 1]["LADDER_SATURATED"]["state"] == "ok"
+        # ...fired once K consecutive saturated polls accumulated
+        assert states[k]["LADDER_SATURATED"]["state"] == "firing"
+        ev = states[k]["LADDER_SATURATED"]["evidence"]
+        assert ev["bucket"] == 8 and ev["p95"] >= 8 * 0.99
+
+    def test_ladder_not_saturated_below_cap(self):
+        samples = [_backend_sample(
+            float(i * 10),
+            hists={"serve.occupancy.b8":
+                   _hist_state([3.0] * (10 * (i + 1)))})
+            for i in range(6)]
+        engine, _ = _run_rules(samples)
+        assert engine.firing("info") == []
+
+    def test_deadline_pressure_fraction(self):
+        samples = [
+            _backend_sample(0.0, counters={
+                "serve.requests": 0, "serve.deadline_expired": 0}),
+            _backend_sample(10.0, counters={
+                "serve.requests": 100, "serve.deadline_expired": 8})]
+        engine, states = _run_rules(samples)
+        sig = states[-1]["DEADLINE_PRESSURE"]
+        assert sig["state"] == "firing"
+        assert sig["evidence"]["fraction"] == pytest.approx(0.08)
+
+
+class TestEngineMechanics:
+    def test_flapping_metric_cannot_page_every_poll(self):
+        # condition alternates true/false every poll: with clear
+        # hysteresis (2 healthy polls) the signal fires ONCE and
+        # stays firing — one page, not one per poll
+        def sample(t, corr):
+            return _backend_sample(
+                t, gauges={"schedule.predictor_corr": corr})
+        samples = [sample(float(i * 10), 0.1 if i % 2 else 0.9)
+                   for i in range(12)]
+        engine, _ = _run_rules(samples)
+        transitions = [e for e in engine.timeline()
+                       if e["signal"] == "PREDICTOR_DECALIBRATED"]
+        assert len(transitions) == 1
+        assert transitions[0]["state"] == "fired"
+
+    def test_unknown_kind_rejected_loudly(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            health.HealthEngine(rules=[
+                {"name": "X", "severity": "warn", "kind": "nope"}])
+
+    def test_evaluator_crash_degrades_not_raises(self):
+        # a rule with garbage params must not take down the poller —
+        # and the crash must be VISIBLE in the rule's evidence, or a
+        # permanently broken rule is indistinguishable from a quiet
+        # one (review finding)
+        rules = [{"name": "BACKEND_DOWN", "severity": "page",
+                  "kind": "ratio_below", "min_n": "not-an-int"}]
+        engine, states = _run_rules(
+            [_backend_sample(0.0, counters={"serve.surrogate.hit": 1}),
+             _backend_sample(10.0,
+                             counters={"serve.surrogate.hit": 2})],
+            rules=rules)
+        sig = states[-1]["BACKEND_DOWN"]
+        assert sig["state"] == "ok"
+        assert "error" in sig["evidence"], sig
+
+    def test_operator_rule_dict_composes_evaluators(self):
+        # the declarative extension path: a rule dict re-using a
+        # shipped evaluator kind against different counters
+        rules = [{"name": "DEADLINE_PRESSURE", "severity": "info",
+                  "kind": "fraction_above",
+                  "num_counter": "serve.rejected",
+                  "den_counter": "serve.requests",
+                  "threshold": 0.5, "window_s": 60.0}]
+        samples = [
+            _backend_sample(0.0, counters={"serve.requests": 0,
+                                           "serve.rejected": 0}),
+            _backend_sample(10.0, counters={"serve.requests": 10,
+                                            "serve.rejected": 9})]
+        engine, states = _run_rules(samples, rules=rules)
+        assert states[-1]["DEADLINE_PRESSURE"]["state"] == "firing"
+        assert states[-1]["DEADLINE_PRESSURE"]["severity"] == "info"
+
+    def test_transition_events_carry_schema_fields(self):
+        rec = telemetry.MetricsRecorder()
+        _run_rules([_backend_sample(0.0),
+                    _backend_sample(1.0, error="died"),
+                    _backend_sample(2.0, generation=1)],
+                   recorder=rec)
+        events = rec.events("health.signal")
+        assert [e["state"] for e in events] == ["fired", "cleared"]
+        for ev in events:
+            extra = set(ev) - {"t", "kind"}
+            assert extra == set(schema.HEALTH_EVENT_FIELDS), extra
+
+    def test_signal_names_match_schema(self):
+        assert set(health.SIGNAL_NAMES) <= set(schema.HEALTH_SIGNALS)
+        shipped = {r["name"] for r in health.DEFAULT_RULES}
+        assert shipped == set(health.SIGNAL_NAMES)
+
+
+class TestReplayAndCheckSignals:
+    def _history(self, tmp_path, samples, name="health_1_0.jsonl"):
+        path = str(tmp_path / name)
+        ring = health.SnapshotRing()
+        engine = health.HealthEngine()
+        for s in samples:
+            ring.append(s)
+            telemetry.append_jsonl(path, {
+                "t": s["t"], "sample": s,
+                "signals": engine.evaluate(ring)})
+        return path
+
+    def test_replay_reports_cycles_and_firing(self):
+        verdict = health.replay([
+            _backend_sample(0.0),
+            _backend_sample(1.0, error="died"),
+            _backend_sample(2.0, generation=1)])
+        assert verdict["cycles"] == {"BACKEND_DOWN": True}
+        assert verdict["firing_page"] == []
+        assert verdict["n_samples"] == 3
+
+    def test_check_signals_rc_on_firing_page(self, tmp_path):
+        from tools import chemtop
+
+        path = self._history(tmp_path, [
+            _backend_sample(0.0),
+            _backend_sample(1.0, error="died"),
+            _backend_sample(2.0, error="still dead")])
+        verdict = chemtop.check_signals([path], [])
+        assert verdict["rc"] == 1
+        assert verdict["firing_page"][path] == ["BACKEND_DOWN"]
+
+    def test_check_signals_require_cycle(self, tmp_path):
+        from tools import chemtop
+
+        cycled = self._history(tmp_path, [
+            _backend_sample(0.0),
+            _backend_sample(1.0, error="died"),
+            _backend_sample(2.0, generation=1)], "health_1_1.jsonl")
+        healthy = self._history(tmp_path, [
+            _backend_sample(0.0), _backend_sample(1.0)],
+            "health_1_2.jsonl")
+        # the cycle may live in ANY of the checked histories
+        verdict = chemtop.check_signals([healthy, cycled],
+                                        ["BACKEND_DOWN"])
+        assert verdict["rc"] == 0
+        assert verdict["cycled"] == ["BACKEND_DOWN"]
+        # a healthy-only set misses the required cycle
+        verdict = chemtop.check_signals([healthy], ["BACKEND_DOWN"])
+        assert verdict["rc"] == 1
+        assert verdict["missing_cycles"] == ["BACKEND_DOWN"]
+
+    def test_check_signals_cli_roundtrip(self, tmp_path):
+        from tools import chemtop
+
+        path = self._history(tmp_path, [
+            _backend_sample(0.0),
+            _backend_sample(1.0, error="died"),
+            _backend_sample(2.0, generation=1)])
+        rc = chemtop.main(["--check-signals", path,
+                           "--require-cycle", "BACKEND_DOWN"])
+        assert rc == 0
+
+
+class TestChemtopHealthWiring:
+    """merge_fleet's raw-state block and the windowed predictor_corr
+    trend rendering (ISSUE 15 satellite: the panel showed per-backend
+    point values only)."""
+
+    def _reply(self, port, corr=None, solve_ms=()):
+        rep = {"port": port, "pid": 1000 + port, "generation": 0,
+               "uptime_s": 5.0, "counters": {"serve.requests": 1},
+               "tenants": {}, "histograms": {}, "histogram_states": {}}
+        if corr is not None:
+            rep["gauges"] = {"schedule.predictor_corr": corr}
+        if solve_ms:
+            rep["histogram_states"]["serve.solve_ms"] = \
+                _hist_state(solve_ms)
+            rep["histograms"]["serve.solve_ms"] = \
+                telemetry.merge_histogram_states(
+                    [rep["histogram_states"]["serve.solve_ms"]])
+        return rep
+
+    def test_ring_append_normalizes_raw_fleet_snapshot(self):
+        # review finding: a raw merge_fleet snapshot carries n_alive
+        # AND counters, so the auto-normalize sentinel must be the
+        # 'scrape' key only normalize_sample writes — otherwise the
+        # appended sample keeps 'histogram_states' (not 'hist_states')
+        # and every histogram/gauge rule goes silently blind
+        from tools import chemtop
+
+        raw = chemtop.merge_fleet([{
+            "port": 1, "pid": 1, "generation": 0, "uptime_s": 1.0,
+            "counters": {"serve.requests": 3}, "tenants": {},
+            "histograms": {}, "histogram_states":
+                {"serve.solve_ms": _hist_state([2.0])}}])
+        ring = health.SnapshotRing()
+        stored = ring.append(dict(raw))
+        assert "scrape" in stored
+        assert "serve.solve_ms" in stored["hist_states"]
+        assert stored["generations"] == [0]
+
+    def test_merge_fleet_carries_merged_raw_states(self):
+        from tools import chemtop
+
+        fleet = chemtop.merge_fleet([
+            self._reply(1, solve_ms=[1.0, 2.0]),
+            self._reply(2, solve_ms=[100.0])])
+        ref = telemetry.Histogram()
+        for v in (1.0, 2.0, 100.0):
+            ref.observe(v)
+        merged = fleet["histogram_states"]["serve.solve_ms"]
+        assert telemetry.merge_histogram_states([merged]) == \
+            ref.summary()
+
+    def test_windowed_fleet_percentiles_from_two_scrapes(self):
+        from tools import chemtop
+
+        early = chemtop.merge_fleet([self._reply(1,
+                                                 solve_ms=[1.0] * 50)])
+        late = chemtop.merge_fleet([
+            self._reply(1, solve_ms=[1.0] * 50 + [100.0] * 50)])
+        ring = health.SnapshotRing()
+        ring.append(health.normalize_sample(early, t=0.0))
+        ring.append(health.normalize_sample(late, t=10.0))
+        windowed = ring.window(60.0).hist_summary("serve.solve_ms")
+        # the window saw ONLY the 50 late observations at 100 ms —
+        # a since-boot summary would report p50 = 1 ms here
+        assert windowed["count"] == 50
+        assert windowed["p50"] == pytest.approx(100.0, rel=0.35)
+
+    def test_render_shows_windowed_corr_trend(self):
+        from tools import chemtop
+
+        early = chemtop.merge_fleet([self._reply(1, corr=0.80)])
+        late = chemtop.merge_fleet([self._reply(1, corr=0.50)])
+        ring = health.SnapshotRing()
+        ring.append(health.normalize_sample(early, t=0.0))
+        ring.append(health.normalize_sample(late, t=120.0))
+        out = chemtop.render(late, view=ring.window(300.0))
+        assert "predictor_corr +0.50" in out
+        assert "fleet +0.50" in out
+        assert "Δ-0.30/120s" in out
+        # a legacy schedule-less fleet keeps n/a and shows no trend
+        legacy = chemtop.merge_fleet([self._reply(1)])
+        legacy["counters"]["serve.requests"] = 1
+        ring2 = health.SnapshotRing()
+        ring2.append(health.normalize_sample(legacy, t=0.0))
+        ring2.append(health.normalize_sample(legacy, t=10.0))
+        out = chemtop.render(legacy, view=ring2.window(300.0))
+        assert "fleet" not in out
+
+    def test_render_alerts_panel(self):
+        from tools import chemtop
+
+        fleet = chemtop.merge_fleet([{"port": 9, "error": "boom"}])
+        ring = health.SnapshotRing()
+        engine = health.HealthEngine()
+        ring.append(health.normalize_sample(fleet, t=0.0))
+        signals = engine.evaluate(ring)
+        out = chemtop.render(fleet, signals=signals)
+        assert "ALERT [page] BACKEND_DOWN" in out
+        # nothing firing -> no alert lines
+        healthy = chemtop.merge_fleet([self._reply(1)])
+        assert "ALERT" not in chemtop.render(
+            healthy, signals=health.HealthEngine().state())
+
+
+class TestHealthMonitor:
+    def test_observe_bank_and_state(self, tmp_path):
+        path = str(tmp_path / "health_0_0.jsonl")
+        rec = telemetry.MetricsRecorder()
+        mon = health_monitor.HealthMonitor(recorder=rec,
+                                           history_path=path)
+        mon.observe({"generation": 0,
+                     "counters": {"serve.requests": 10}}, t=0.0)
+        mon.note_backend_lost("SIGKILL", t=1.0)
+        mon.note_respawned(1, t=2.0)
+        state = mon.state()
+        assert state["n_samples"] == 3
+        assert state["restarts"] >= 1
+        assert [(e["signal"], e["state"])
+                for e in state["timeline"]] == \
+            [("BACKEND_DOWN", "fired"), ("BACKEND_DOWN", "cleared")]
+        assert mon.firing("page") == []
+        # the banked history replays to the same verdict
+        entries = list(telemetry.read_jsonl(path))
+        assert len(entries) == 3
+        assert {"t", "sample", "signals"} <= set(entries[0])
+        verdict = health.replay([e["sample"] for e in entries])
+        assert verdict["cycles"] == {"BACKEND_DOWN": True}
+
+    def test_history_write_failure_degrades(self, tmp_path):
+        mon = health_monitor.HealthMonitor(
+            history_path=str(tmp_path / "no_dir" / "x.jsonl"))
+        mon.observe({"generation": 0})
+        assert "history_error" in mon.state()
+
+    def test_supervisor_history_path_from_env_dir(self, tmp_path,
+                                                  monkeypatch):
+        from pychemkin_tpu.serve.supervisor import Supervisor
+
+        monkeypatch.setenv("PYCHEMKIN_HEALTH_HISTORY_DIR",
+                           str(tmp_path))
+        sup = Supervisor({"tenants": {"default": {"mech": "h2o2"}}})
+        path = sup._health.history_path
+        assert path is not None and path.startswith(str(tmp_path))
+        assert os.path.basename(path).startswith(
+            f"health_{os.getpid()}_")
+        # two supervisors in one process never share a file
+        sup2 = Supervisor({"tenants": {"default": {"mech": "h2o2"}}})
+        assert sup2._health.history_path != path
+
+
+class TestHealthKnobs:
+    def test_thresholds_are_live(self, monkeypatch):
+        monkeypatch.setenv("PYCHEMKIN_HEALTH_HIT_RATE_MIN", "0.2")
+        samples = [
+            _backend_sample(0.0, counters={
+                "serve.surrogate.hit": 0,
+                "serve.surrogate.fallback": 0}),
+            _backend_sample(10.0, counters={
+                "serve.surrogate.hit": 10,
+                "serve.surrogate.fallback": 30})]
+        engine, states = _run_rules(samples)
+        # 25% hit rate is fine against a 20% floor
+        assert states[-1]["SURROGATE_RETRAIN"]["state"] == "ok"
+
+    def test_garbage_threshold_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PYCHEMKIN_HEALTH_WINDOW_S", "garbage")
+        assert knobs.value("PYCHEMKIN_HEALTH_WINDOW_S") == 300.0
+        monkeypatch.setenv("PYCHEMKIN_HEALTH_SATURATED_POLLS", "x")
+        assert knobs.value("PYCHEMKIN_HEALTH_SATURATED_POLLS") == 3
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"] + sys.argv[1:]))

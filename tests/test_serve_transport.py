@@ -1143,6 +1143,50 @@ class TestEnvDrivenChaos:
             assert sup.stats()["respawns"] == 1
             assert sup.stats()["resubmits"] >= 1
 
+    def test_health_history_banks_backend_down_cycle(
+            self, fake_backend_path, tmp_path):
+        """ISSUE 15 satellite (real-process chaos variant): the
+        supervisor's embedded health monitor sees the SIGKILL as a
+        fired-then-cleared BACKEND_DOWN within one poll, banks the
+        JSONL history run_suite's chemtop --check-signals gate
+        replays, and a respawn mid-window never yields a negative
+        windowed rate."""
+        from pychemkin_tpu import health
+
+        assert procfaults.enabled()
+        (spec,) = procfaults.specs("kill_backend_at_request")
+        hist = str(tmp_path / "health_chaos.jsonl")
+        sup = _fake_supervisor(
+            fake_backend_path, retry_budget=1, max_respawns=2,
+            env={"FAKE_PROCFAULTS_PATH": PROCFAULTS_PATH},
+            health_history_path=hist, health_sample_s=0.2)
+        with sup:
+            for i in range(spec.request + 2):
+                assert sup.submit("equilibrium",
+                                  T=float(i)).result(timeout=60).ok
+            # the loss and the respawn both banked immediately —
+            # BACKEND_DOWN fired and cleared without waiting a tick
+            timeline = [(e["signal"], e["state"])
+                        for e in sup.health_state()["timeline"]]
+            assert ("BACKEND_DOWN", "fired") in timeline
+            assert ("BACKEND_DOWN", "cleared") in timeline
+            assert sup.health_state()["restarts"] >= 1
+        entries = list(telemetry.read_jsonl(hist))
+        assert len(entries) >= 3
+        samples = [e["sample"] for e in entries]
+        verdict = health.replay(samples)
+        assert verdict["cycles"].get("BACKEND_DOWN") is True
+        assert not verdict["firing_page"]
+        # generation-aware deltas: the respawn shows as a restart and
+        # every windowed rate stays non-negative
+        ring = health.SnapshotRing()
+        for s in samples:
+            ring.append(s)
+        view = ring.window(10_000.0)
+        assert view.restarts >= 1
+        for name in set().union(*(s["counters"] for s in samples)):
+            assert view.rate(name) >= 0.0, name
+
 
 # ---------------------------------------------------------------------------
 # ISSUE 7 chaos-soak acceptance (slow lane: real backend, real solves)
@@ -1292,6 +1336,41 @@ class TestChaosSoakAcceptance:
         metrics = art["metrics"]
         assert metrics["supervisor"]["respawns"] == 1
         assert metrics["generation"] == 1       # post-respawn scrape
+        # (d) ISSUE 15 fleet-health acceptance: the soak's banked
+        # health history shows the injected SIGKILL as a
+        # fired-then-cleared BACKEND_DOWN cycle (and nothing left
+        # paging), the artifact carries the same timeline, and the
+        # windowed solve-time distribution derived by SUBTRACTING
+        # histogram states across the run matches the backend's own
+        # full distribution within one log-bucket boundary
+        from pychemkin_tpu import health as health_pkg
+
+        timeline = [(e["signal"], e["state"])
+                    for e in art["health"]["timeline"]]
+        assert ("BACKEND_DOWN", "fired") in timeline
+        assert ("BACKEND_DOWN", "cleared") in timeline
+        hist_path = os.path.join(obs, "health.jsonl")
+        assert os.path.exists(hist_path)
+        samples = [e["sample"]
+                   for e in telemetry.read_jsonl(hist_path)]
+        verdict = health_pkg.replay(samples)
+        assert verdict["cycles"].get("BACKEND_DOWN") is True
+        assert not verdict["firing_page"]
+        ring = health_pkg.SnapshotRing()
+        for s in samples:
+            ring.append(s)
+        view = ring.window(10_000.0)
+        assert view.restarts >= 1
+        windowed = view.hist_summary("serve.solve_ms")
+        # the baseline sample predates traffic, so the window covers
+        # every post-respawn observation the final scrape holds (the
+        # pre-kill generation's observations died with it)
+        since_boot = metrics["histograms"]["serve.solve_ms"]
+        assert windowed["count"] == since_boot["count"]
+        bucket = 10.0 ** (1.0 / 8.0)
+        assert max(windowed["p99"] / since_boot["p99"],
+                   since_boot["p99"] / windowed["p99"]) < \
+            bucket * 1.01
         counters = metrics.get("counters", {})
         # the post-respawn backend's OK statuses cannot exceed the
         # run's total OKs, and every resubmitted request landed there
@@ -1299,3 +1378,80 @@ class TestChaosSoakAcceptance:
             art["status_counts"].get("OK", 0)
         assert counters.get("serve.requests", 0) >= \
             art["supervisor"]["resubmits"]
+
+    def test_healthy_soak_fires_no_signals(self, tmp_path):
+        """ISSUE 15 acceptance (no-false-page property): a healthy
+        soak of the same shape as the chaos one — no kill, no
+        deadline pressure — must fire ZERO signals, in the live
+        timeline and under replay."""
+        from pychemkin_tpu import health as health_pkg
+        from tools import loadgen as loadgen_tool
+
+        out = str(tmp_path / "HEALTHY.json")
+        rc = loadgen_tool.main([
+            "--transport", "--mech", "h2o2", "--kinds", "equilibrium",
+            "--rate", "40", "--n", "12", "--seed", "0",
+            "--buckets", "1,8", "--max-batch", "8",
+            "--deadline-ms", "240000", "--out", out])
+        assert rc == 0
+        with open(out) as f:
+            art = json.load(f)
+        assert art["supervisor"]["respawns"] == 0
+        assert art["health"]["timeline"] == []
+        assert all(s["state"] == "ok"
+                   for s in art["health"]["signals"])
+        samples = [e["sample"] for e in telemetry.read_jsonl(
+            os.path.join(art["obs_dir"], "health.jsonl"))]
+        assert len(samples) >= 2
+        verdict = health_pkg.replay(samples)
+        assert verdict["timeline"] == []
+        assert verdict["firing_page"] == []
+
+    def test_surrogate_miss_heavy_soak_fires_retrain(self, tmp_path,
+                                                     monkeypatch):
+        """ISSUE 15 acceptance (b): a surrogate-miss-heavy tail — a
+        DELIBERATELY narrow trained box under the default payload
+        draw — pushes the windowed hit rate through the knob floor on
+        live (non-warmup) traffic, and SURROGATE_RETRAIN fires: the
+        exact retrain trigger ROADMAP #4 names."""
+        from pychemkin_tpu import health as health_pkg
+        from pychemkin_tpu import surrogate as sg
+        from tools import loadgen as loadgen_tool
+
+        mech = load_embedded("h2o2")
+        # train on a sliver of the default T box: most default-box
+        # draws land out of domain and take the verified fallback
+        box = sg.SampleBox(T=(1250.0, 1270.0))
+        shard, _ = sg.generate_dataset(mech, "equilibrium", n=24,
+                                       seed=0, box=box, chunk_size=24)
+        model, _ = sg.fit_surrogate(shard, hidden=(16, 16),
+                                    steps=150, n_members=2, seed=0)
+        model_path = str(tmp_path / "eq_model.npz")
+        sg.save_model(model_path, model)
+        # a short soak offers ~24 live requests; the shipped min_n of
+        # 20 is tuned for production windows, not a CI soak
+        monkeypatch.setenv("PYCHEMKIN_HEALTH_HIT_MIN_N", "8")
+        out = str(tmp_path / "MISS.json")
+        rc = loadgen_tool.main([
+            "--transport", "--mech", "h2o2",
+            "--kinds", "surrogate_equilibrium",
+            "--surrogate-model", model_path,
+            "--rate", "40", "--n", "24", "--seed", "1",
+            "--buckets", "1,8", "--max-batch", "8",
+            "--deadline-ms", "240000", "--out", out])
+        assert rc == 0
+        with open(out) as f:
+            art = json.load(f)
+        # the tail really was miss-heavy, and every miss fell back to
+        # the real engine (live traffic, not warmup)
+        assert art["n_surrogate_fallback"] > art["n_surrogate_hit"]
+        samples = [e["sample"] for e in telemetry.read_jsonl(
+            os.path.join(art["obs_dir"], "health.jsonl"))]
+        verdict = health_pkg.replay(samples)
+        fired = [e for e in verdict["timeline"]
+                 if e["signal"] == "SURROGATE_RETRAIN"
+                 and e["state"] == "fired"]
+        assert fired, verdict["timeline"]
+        ev = fired[0]["evidence"]
+        assert ev["n"] >= 8
+        assert ev["ratio"] < ev["threshold"]

@@ -288,6 +288,140 @@ class TestHistogramMerge:
         assert telemetry.merge_histogram_states([wired]) == h.summary()
 
 
+class TestHistogramSubtract:
+    """ISSUE 15 satellite: state SUBTRACTION — the inverse of the
+    PR-8 merge, property-tested in its mirror image. Without it,
+    windowed (last-N-seconds) percentiles were impossible: summaries
+    cannot be differenced, only raw bucket states can."""
+
+    def _grow(self, early_values, late_values):
+        h = telemetry.Histogram()
+        for v in early_values:
+            h.observe(v)
+        early = h.state()
+        for v in late_values:
+            h.observe(v)
+        return early, h.state()
+
+    def test_subtract_then_merge_identity(self):
+        early, late = self._grow([0.01, 0.5, 3.0, 3.1],
+                                 [40.0, 41.0, 7000.0])
+        diff = telemetry.subtract_histogram_states(late, early)
+        merged = telemetry.Histogram()
+        merged.merge_state(diff)
+        merged.merge_state(early)
+        full = telemetry.Histogram()
+        full.merge_state(late)
+        # buckets, count, and sum restore exactly; the difference's
+        # min/max are bucket-edge conservative, so percentiles agree
+        # to the bucket resolution by construction
+        assert merged.counts == full.counts
+        assert merged.count == full.count
+        assert merged.sum == pytest.approx(full.sum)
+
+    def test_difference_is_the_in_window_distribution(self):
+        early, late = self._grow([1.0] * 100, [900.0] * 10)
+        diff = telemetry.subtract_histogram_states(late, early)
+        s = telemetry.merge_histogram_states([diff])
+        assert s["count"] == 10
+        # one log bucket is a factor 10^(1/8): the windowed median
+        # must be the late cohort's value to bucket resolution
+        assert s["p50"] == pytest.approx(900.0, rel=0.4)
+        # a since-boot summary would put the median at 1.0 here
+        assert telemetry.merge_histogram_states([late])["p50"] == \
+            pytest.approx(1.0, rel=0.4)
+
+    def test_empty_subtrahend_is_exact_identity(self):
+        _, late = self._grow([], [2.0, 5.0, 9.0])
+        for empty in (None, {}, {"count": 0}):
+            diff = telemetry.subtract_histogram_states(late, empty)
+            assert telemetry.merge_histogram_states([diff]) == \
+                telemetry.merge_histogram_states([late])
+
+    def test_equal_states_subtract_to_empty(self):
+        _, late = self._grow([], [2.0, 5.0])
+        diff = telemetry.subtract_histogram_states(late, late)
+        assert diff["count"] == 0
+        assert telemetry.merge_histogram_states([diff]) == {"count": 0}
+
+    def test_non_monotone_raises_typed_error(self):
+        early, late = self._grow([1.0, 2.0], [3.0])
+        # a restarted process's state is NOT a prefix of the old one
+        with pytest.raises(telemetry.HistogramSubtractionError):
+            telemetry.subtract_histogram_states(early, late)
+        # the typed error is a ValueError, so legacy callers that
+        # guard broadly still catch it
+        assert issubclass(telemetry.HistogramSubtractionError,
+                          ValueError)
+
+    def test_disjoint_bucket_raises(self):
+        a = telemetry.Histogram()
+        a.observe(1.0)
+        b = telemetry.Histogram()
+        b.observe(5000.0)
+        with pytest.raises(telemetry.HistogramSubtractionError):
+            telemetry.subtract_histogram_states(a.state(), b.state())
+
+    def test_json_roundtrip(self):
+        early, late = self._grow([0.5, 2.0], [80.0, 81.0])
+        diff = telemetry.subtract_histogram_states(
+            json.loads(json.dumps(late)),
+            json.loads(json.dumps(early)))
+        wired = json.loads(json.dumps(diff))
+        assert telemetry.merge_histogram_states([wired]) == \
+            telemetry.merge_histogram_states([diff])
+
+    def test_windowed_percentile_against_numpy_reference(self):
+        # the acceptance tolerance: windowed p50/p99 from subtracted
+        # states within ONE bucket boundary of the raw reference
+        rng = np.random.default_rng(3)
+        pre = 10.0 ** rng.uniform(-1, 2, size=500)
+        win = 10.0 ** rng.uniform(0, 3, size=800)
+        early, late = self._grow(pre, win)
+        diff = telemetry.subtract_histogram_states(late, early)
+        s = telemetry.merge_histogram_states([diff])
+        bucket = 10.0 ** (1.0 / 8.0)
+        for q, key in ((50, "p50"), (99, "p99")):
+            ref = float(np.percentile(win, q))
+            assert max(s[key] / ref, ref / s[key]) < bucket * 1.01, (
+                key, s[key], ref)
+
+
+class TestHealthSchema:
+    """ISSUE 15 satellite: the health signal/event names are schema,
+    asserted here so the emitting engine and the canonical tuples
+    cannot drift (chemlint enforces the static half)."""
+
+    def test_signal_names_ride_canonical_tuple(self):
+        from pychemkin_tpu import health
+        from pychemkin_tpu.telemetry import schema
+
+        assert set(health.SIGNAL_NAMES) <= set(schema.HEALTH_SIGNALS)
+        # every schema signal is shipped (prune the schema with the
+        # rules, exactly like the stale-entry lint for series names)
+        assert set(schema.HEALTH_SIGNALS) == set(health.SIGNAL_NAMES)
+        assert "health.signal" in schema.EVENTS
+
+    def test_event_fields_match_emitted_events(self):
+        from pychemkin_tpu import health
+        from pychemkin_tpu.telemetry import schema
+
+        rec = MetricsRecorder()
+        ring = health.SnapshotRing()
+        engine = health.HealthEngine(recorder=rec)
+        for reply, t in (({"generation": 0}, 0.0),
+                         ({"error": "died"}, 1.0),
+                         ({"generation": 1}, 2.0)):
+            ring.append(health.normalize_sample(reply, t=t))
+            engine.evaluate(ring)
+        events = rec.events("health.signal")
+        assert events, "no transition events emitted"
+        for ev in events:
+            assert set(ev) - {"t", "kind"} == \
+                set(schema.HEALTH_EVENT_FIELDS)
+            assert ev["signal"] in schema.HEALTH_SIGNALS
+
+
 class TestTrace:
     """ISSUE 8 tentpole: span records over the event spine."""
 

@@ -10,19 +10,38 @@ percentiles), plus a per-backend liveness row (pid, generation —
 the supervisor's re-exec stamp, so a churning backend is visible —
 and uptime).
 
-Two modes:
+Three modes:
 
 - ``--once``: one scrape, printed as a JSON line and (with ``--out``)
   banked atomically — the CI/artifact mode; the chaos-soak acceptance
   compares this against the loadgen artifact's per-status counts.
-- default: a top(1)-style loop rendering the fleet table every
+- default (watch): a top(1)-style loop rendering the fleet table every
   ``--interval`` seconds (bank with ``--out`` to keep the latest
-  snapshot on disk across a kill).
+  snapshot on disk across a kill). Each poll also feeds the fleet
+  health pipeline (``pychemkin_tpu/health``): the snapshot ring turns
+  since-boot counters/histograms into windowed rates and true
+  last-N-seconds percentiles, the rule engine evaluates the typed
+  operator signals (BACKEND_DOWN, ERROR_BUDGET_BURN, ...) with
+  hysteresis, and the render grows an alerts panel with a per-signal
+  recent-window sparkline. ``--history PATH`` banks one
+  ``{"t", "sample", "signals"}`` JSONL entry per poll — the soak
+  artifact the check mode replays.
+- ``--check-signals H1.jsonl [H2.jsonl ...]``: CI mode, no scraping —
+  replay banked histories through a fresh rule engine and print a
+  JSON verdict. Exit 1 when any history ends with a FIRING
+  severity>=page signal; with ``--require-cycle NAME`` (repeatable)
+  exit 0 iff every named signal fired AND cleared in at least one
+  history — the chaos-soak gate shape (``run_suite --chaos`` asserts
+  the injected SIGKILL produced a fired-then-cleared BACKEND_DOWN).
 
 Usage::
 
     python tools/chemtop.py --ports 41231 --once --out FLEET.json
-    python tools/chemtop.py --ports 41231,41232 --interval 2
+    python tools/chemtop.py --ports 41231,41232 --interval 2 \
+        --history FLEET_HEALTH.jsonl
+    python tools/chemtop.py --check-signals FLEET_HEALTH.jsonl
+    python tools/chemtop.py --check-signals obs/health_*.jsonl \
+        --require-cycle BACKEND_DOWN
 """
 
 from __future__ import annotations
@@ -39,7 +58,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-from pychemkin_tpu import telemetry                    # noqa: E402
+from pychemkin_tpu import health, knobs, telemetry     # noqa: E402
 from pychemkin_tpu.serve.transport import TransportClient  # noqa: E402
 
 
@@ -124,6 +143,12 @@ def merge_fleet(replies: List[Dict]) -> Dict:
     }
     histograms = {name: telemetry.merge_histogram_states(states)
                   for name, states in sorted(hist_states.items())}
+    # the RAW merged bucket states ride along too: the health layer's
+    # snapshot ring subtracts consecutive fleet states to derive true
+    # windowed percentiles — summaries alone cannot be differenced
+    merged_states = {
+        name: telemetry.Histogram.from_states(states).state()
+        for name, states in sorted(hist_states.items())}
     # solver panel: the below-dispatch physics a profiled fleet
     # exposes (PYCHEMKIN_SOLVE_PROFILE) — merged solve.* histograms
     # plus the per-backend predictor-calibration gauge. A legacy
@@ -166,13 +191,27 @@ def merge_fleet(replies: List[Dict]) -> Dict:
         "schedule": schedule,
         "solver": solver,
         "histograms": histograms,
+        "histogram_states": merged_states,
     }
 
 
-def render(snapshot: Dict) -> str:
-    """Human top-style view of one merged snapshot."""
+def render(snapshot: Dict, view=None, signals=None) -> str:
+    """Human top-style view of one merged snapshot. ``view`` (a
+    health ``WindowView`` from the watch loop's ring) adds windowed
+    trends — notably the fleet ``predictor_corr`` latest vs
+    window-start; ``signals`` (the engine's per-signal state) adds
+    the alerts panel with a per-signal recent sparkline."""
     lines = [f"chemtop — {snapshot['n_alive']}/"
              f"{snapshot['n_backends']} backends alive"]
+    for sig in (signals or []):
+        if sig["state"] != "firing":
+            continue
+        ev = "  ".join(f"{k}={v}" for k, v in
+                       sorted((sig.get("evidence") or {}).items()))
+        lines.append(
+            f"  ALERT [{sig['severity']}] {sig['signal']} "
+            f"{sig.get('recent', '')}"
+            + (f"  {ev}" if ev else ""))
     for b in snapshot["backends"]:
         state = (f"ERROR {b['error']}" if b["error"] else
                  f"pid {b['pid']}  gen {b['generation']}  "
@@ -225,11 +264,22 @@ def render(snapshot: Dict) -> str:
 
         corr_txt = ("/".join(f"{c:+.2f}" for c in corr)
                     if corr else "n/a")
+        # windowed trend of the fleet calibration gauge: latest vs
+        # window-start (ISSUE 15 fix — the point values alone cannot
+        # show decay). Legacy schedule-less backends stay n/a.
+        trend_txt = ""
+        if view is not None:
+            start, latest = view.gauge_trend("schedule.predictor_corr")
+            if latest is not None:
+                delta = (f"  Δ{latest - start:+.2f}"
+                         f"/{view.duration_s:.0f}s"
+                         if start is not None else "")
+                trend_txt = f"  fleet {latest:+.2f}{delta}"
         lines.append(
             f"  solver: newton/attempt p50 {_p50('newton_per_attempt')}"
             f"  dt_min p50 {_p50('dt_min_ns')}ns"
             f"  steps/lane p50 {_p50('steps_per_lane')}"
-            f"  predictor_corr {corr_txt}")
+            f"  predictor_corr {corr_txt}{trend_txt}")
     for name in ("serve.queue_wait_ms", "serve.solve_ms"):
         h = snapshot["histograms"].get(name)
         if h and h.get("count"):
@@ -245,8 +295,9 @@ def render(snapshot: Dict) -> str:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--ports", required=True,
-                   help="comma list of backend ports to scrape")
+    p.add_argument("--ports", default=None,
+                   help="comma list of backend ports to scrape "
+                        "(required unless --check-signals)")
     p.add_argument("--once", action="store_true",
                    help="one scrape: JSON line to stdout (CI mode)")
     p.add_argument("--out", default=None,
@@ -259,12 +310,85 @@ def build_parser() -> argparse.ArgumentParser:
                         "until interrupted)")
     p.add_argument("--timeout", type=float, default=30.0,
                    help="per-backend scrape timeout, s")
+    p.add_argument("--history", default=None,
+                   help="watch mode: bank one {t, sample, signals} "
+                        "JSONL entry per poll (the --check-signals "
+                        "artifact)")
+    p.add_argument("--window", type=float, default=None,
+                   help="health window seconds (default: the "
+                        "PYCHEMKIN_HEALTH_WINDOW_S knob)")
+    p.add_argument("--check-signals", nargs="+", default=None,
+                   metavar="HISTORY",
+                   help="CI mode: replay banked history JSONL "
+                        "file(s) through a fresh rule engine; rc 1 "
+                        "on any history ending with a firing "
+                        "severity>=page signal")
+    p.add_argument("--require-cycle", action="append", default=[],
+                   metavar="SIGNAL",
+                   help="with --check-signals: rc 0 iff each named "
+                        "signal fired AND cleared in at least one "
+                        "history (the chaos-soak gate)")
     return p
+
+
+def check_signals(paths: List[str], require_cycle: List[str]) -> Dict:
+    """Replay banked health histories (pure: no sockets). Returns the
+    verdict dict ``main`` prints; ``rc`` inside is the process exit
+    code — with ``require_cycle`` the gate is cycle presence, else no
+    history may END with a firing severity>=page signal."""
+    per_file = {}
+    cycled = set()
+    firing_page = {}
+    for path in paths:
+        entries = list(telemetry.read_jsonl(path))
+        samples = [e.get("sample") for e in entries
+                   if isinstance(e.get("sample"), dict)]
+        verdict = health.replay(samples)
+        per_file[path] = {
+            "n_samples": verdict["n_samples"],
+            "firing_page": verdict["firing_page"],
+            "cycles": verdict["cycles"],
+            "transitions": [
+                {"t": ev["t"], "signal": ev["signal"],
+                 "state": ev["state"]}
+                for ev in verdict["timeline"]],
+        }
+        cycled.update(name for name, ok in verdict["cycles"].items()
+                      if ok)
+        if verdict["firing_page"]:
+            firing_page[path] = verdict["firing_page"]
+    missing = [name for name in require_cycle if name not in cycled]
+    if require_cycle:
+        rc = 1 if missing else 0
+    else:
+        rc = 1 if firing_page else 0
+    return {"mode": "check-signals", "rc": rc,
+            "files": per_file, "cycled": sorted(cycled),
+            "require_cycle": require_cycle,
+            "missing_cycles": missing,
+            "firing_page": firing_page}
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.check_signals:
+        verdict = check_signals(args.check_signals,
+                                args.require_cycle)
+        print(json.dumps(verdict), flush=True)
+        return verdict["rc"]
+    if not args.ports:
+        print("chemtop: --ports is required (or --check-signals)",
+              file=sys.stderr)
+        return 2
     ports = [int(x) for x in args.ports.split(",") if x.strip()]
+    window_s = (args.window if args.window is not None
+                else knobs.value("PYCHEMKIN_HEALTH_WINDOW_S"))
+    # the watch loop's health pipeline: ring + rule engine over the
+    # merged snapshots; signal transitions land on a local recorder
+    # (and in --history entries) rather than a backend's sink
+    ring = health.SnapshotRing(
+        cap=knobs.value("PYCHEMKIN_HEALTH_RING"))
+    engine = health.HealthEngine(recorder=telemetry.MetricsRecorder())
     n = 0
     while True:
         snapshot = merge_fleet([scrape(args.host, port, args.timeout)
@@ -274,7 +398,15 @@ def main(argv=None) -> int:
         if args.once:
             print(json.dumps(snapshot), flush=True)
             return 0 if snapshot["n_alive"] == len(ports) else 1
-        print(render(snapshot), flush=True)
+        sample = ring.append(health.normalize_sample(snapshot))
+        signals = engine.evaluate(ring)
+        if args.history:
+            telemetry.append_jsonl(args.history,
+                                   {"t": sample["t"],
+                                    "sample": sample,
+                                    "signals": signals})
+        print(render(snapshot, view=ring.window(window_s),
+                     signals=signals), flush=True)
         n += 1
         if args.iterations is not None and n >= args.iterations:
             return 0

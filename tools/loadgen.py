@@ -56,6 +56,14 @@ from those sinks; follow one with::
 
 and the artifact's ``metrics`` block (transport mode) is the same
 merged snapshot ``tools/chemtop.py`` scrapes live.
+
+Fleet health (ISSUE 15): in transport mode the supervisor's embedded
+health monitor banks ``health.jsonl`` in the obs dir (one
+``{"t", "sample", "signals"}`` entry per sample — replay with
+``python tools/chemtop.py --check-signals <obs>/health.jsonl``), and
+the artifact's ``health`` block carries the evaluated signal state
+plus the fire/clear transition timeline: a chaos soak shows its
+``BACKEND_DOWN`` fired-then-cleared cycle right in the artifact.
 """
 
 from __future__ import annotations
@@ -178,10 +186,13 @@ class _Obs:
         os.makedirs(self.dir, exist_ok=True)
         self.client_jsonl = os.path.join(self.dir, "client.jsonl")
         self.backend_jsonl = os.path.join(self.dir, "backend.jsonl")
+        self.health_jsonl = os.path.join(self.dir, "health.jsonl")
         # one run = one story: a reused obs dir must not bleed a
         # previous run's spans into this run's exemplars, nor its
-        # post-mortems into this artifact's kill/flight lists
-        for path in (self.client_jsonl, self.backend_jsonl):
+        # post-mortems into this artifact's kill/flight lists, nor a
+        # stale health timeline into this run's signal verdict
+        for path in (self.client_jsonl, self.backend_jsonl,
+                     self.health_jsonl):
             if os.path.exists(path):
                 os.unlink(path)
         self._t0 = time.time()
@@ -268,7 +279,10 @@ def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs,
                      retry_budget=args.retry_budget,
                      max_respawns=args.max_respawns,
                      default_tenant=args.tenant, recorder=rec,
-                     kill_report_dir=obs.dir)
+                     kill_report_dir=obs.dir,
+                     # the soak's health timeline: one JSONL entry per
+                     # sample, replayable by chemtop --check-signals
+                     health_history_path=obs.health_jsonl)
     sup.install_signal_handlers()
     print(f"# loadgen: spawning supervised backend "
           f"(chaos={'on' if args.chaos else 'off'})", file=sys.stderr)
@@ -289,7 +303,10 @@ def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs,
                  "supervisor": sup.stats(),
                  # the same merged snapshot chemtop scrapes live: the
                  # backend metrics op + the supervisor's own counters
-                 "metrics": sup.metrics()}
+                 "metrics": sup.metrics(),
+                 # the evaluated signal state + fire/clear timeline —
+                 # what fired during the soak and whether it cleared
+                 "health": sup.health_state()}
         try:
             extra["backend"] = sup.server_stats()
         except Exception as exc:     # noqa: BLE001 — backend may be dead
