@@ -296,6 +296,13 @@ def _child_config(mech_name: str, B: int, repeats: int):
     rop_mode = _kinetics.resolve_rop_mode()
     if mech.rop_stage is None:
         rop_mode = "dense"
+    # fused-kernel mode the Newton attempts in this child actually
+    # take: the resolved PYCHEMKIN_FUSE_MODE/auto decision GATED on
+    # the record being staged, exactly like rop_mode above — rung
+    # provenance for the RHS+Jacobian kernel layout
+    fuse_mode = ("fused" if _kinetics.fused_enabled(mech) else "split")
+    if jac_mode != "analytic":
+        fuse_mode = "split"     # the AD path never fuses
     # scheduling mode the sweep actually runs under (PYCHEMKIN_SCHEDULE
     # resolved once here, threaded explicitly) — rung provenance, like
     # jac_mode/rop_mode: a banked rung says which batch layout it timed
@@ -394,6 +401,8 @@ def _child_config(mech_name: str, B: int, repeats: int):
         # self-describing about WHICH Jacobian path its timing measured
         jac_mode=jac_mode,
         rop_mode=rop_mode,
+        fuse_mode=fuse_mode,
+        n_devices=n_chips,
         schedule=schedule_mode,
         solve_profile=solve_profile,
         calibration=_calibration_block(),
@@ -1201,6 +1210,8 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
         "mfu_pct": best.get("mfu_pct"),
         "jac_mode": best.get("jac_mode"),
         "rop_mode": best.get("rop_mode"),
+        "fuse_mode": best.get("fuse_mode"),
+        "n_devices": best.get("n_devices"),
         "schedule": best.get("schedule"),
         "solve_profile": best.get("solve_profile"),
         "calibration": best.get("calibration"),
@@ -1213,7 +1224,8 @@ def _build_summary(results, baselines, *, is_fallback, accel_err,
                                    "compile_s", "run_s", "mfu_pct",
                                    "steps_per_sec", "n_steps",
                                    "n_rejected", "n_newton", "platform",
-                                   "jac_mode", "rop_mode", "schedule",
+                                   "jac_mode", "rop_mode", "fuse_mode",
+                                   "n_devices", "schedule",
                                    "solve_profile",
                                    "nu_nnz_frac", "n_species_active",
                                    "n_failed", "n_rescued",

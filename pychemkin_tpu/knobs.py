@@ -216,12 +216,29 @@ register(
     "Step-attempt budget of one compaction round in scheduled sweeps "
     "(re-read per sweep).",
     _int("PYCHEMKIN_COMPACT_ROUND"), "scheduling", strict_empty=True)
+register(
+    "PYCHEMKIN_MESH_COMPACT", "bool (0 disables)", True,
+    "Allow mid-sweep compaction to re-bin survivors ACROSS a "
+    "multi-device mesh (global gather / re-shard between rounds); "
+    "=0 falls back to the sort-only multi-device path.",
+    _bool01, "scheduling")
 
 register(
     "PYCHEMKIN_ROP_MODE", "enum: auto / sparse / dense", "auto",
     "Kinetics rate-of-progress kernel selection; 'auto' picks sparse "
     "on CPU, dense on TPU. The rop_mode() trace-time override wins.",
     _enum("PYCHEMKIN_ROP_MODE", ("auto", "sparse", "dense"),
+          normalize=True, empty_to="auto"),
+    "kinetics")
+register(
+    "PYCHEMKIN_FUSE_MODE", "enum: auto / fused / split", "auto",
+    "Fused RHS+Jacobian kernel selection for Newton attempts; 'fused' "
+    "evaluates the ROP ladder once and feeds both the species "
+    "contraction and the derivative blocks, 'split' keeps the twin "
+    "RHS/Jacobian programs (the bit-identity oracle). 'auto' fuses on "
+    "staged records where the platform solves the Jacobian in f64. The "
+    "fuse_mode() trace-time override wins.",
+    _enum("PYCHEMKIN_FUSE_MODE", ("auto", "fused", "split"),
           normalize=True, empty_to="auto"),
     "kinetics")
 

@@ -353,3 +353,50 @@ def clear_memo() -> None:
     """Drop the in-process memo (tests exercising the disk layer)."""
     with _MEMO_LOCK:
         _MEMO.clear()
+    with _FUSED_LOCK:
+        _FUSED_MEMO.clear()
+
+
+# ---------------------------------------------------------------------------
+# fused RHS+Jacobian kernel builder (per signature x variant)
+
+_FUSED_MEMO: dict = {}
+_FUSED_LOCK = threading.Lock()
+
+
+def build_fused_kernel(record, problem: str, energy: str):
+    """The per-signature fused-kernel builder: ONE program computing
+    ``(f, J)`` for a batch-reactor variant from a single shared
+    rate-of-progress evaluation (ops/jacobian.py:fused_rhs_jacobian),
+    memoized on ``(signature, problem, energy, mixed-precision)``.
+
+    The memo exists for trace caching, not build cost: ``jax.jit``
+    keys its trace cache on the FUNCTION OBJECT, so every solve of the
+    same mechanism/variant must receive the same closure back — a
+    fresh ``fused_rhs_jacobian()`` per call would retrace (and
+    recompile) per solve. Keying on the signature (not ``id(record)``)
+    keeps re-parses of the same file on the one compiled program, the
+    same identity contract the staged index sets use.
+
+    Requires a staged record (``rop_stage`` present); raises
+    ``ValueError`` otherwise — callers gate on
+    :func:`pychemkin_tpu.ops.kinetics.fused_enabled`, which also
+    enforces concrete leaves."""
+    st = getattr(record, "rop_stage", None)
+    if st is None:
+        raise ValueError("build_fused_kernel needs a staged record "
+                         "(rop_stage is None)")
+    # lazy: mechanism must not import ops at module level (ops imports
+    # mechanism records); resolving here keeps package init acyclic
+    from ..ops import jacobian, linalg
+
+    key = (st.sig, problem, energy, bool(linalg.use_mixed_precision()))
+    with _FUSED_LOCK:
+        fj = _FUSED_MEMO.get(key)
+        if fj is None:
+            fj = jacobian.fused_rhs_jacobian(problem, energy)
+            _FUSED_MEMO[key] = fj
+            telemetry.get_recorder().inc("staging.fused_built")
+        else:
+            telemetry.get_recorder().inc("staging.fused_hit")
+    return fj

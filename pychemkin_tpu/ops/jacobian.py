@@ -555,11 +555,20 @@ def net_production_rates_analytic(mech, T, C, P=None):
 # closed-form batch-reactor RHS Jacobians (the odeint hot path)
 
 
-def _batch_jac_core(problem, energy, t, y, args):
+def _batch_jac_core(problem, energy, t, y, args, *, with_rhs=False):
     """Closed-form d(rhs)/dy for the reactors.py RHS variants — exact
     chain rule of the corresponding ``conp_/conv_*_rhs`` code path (the
     derivations mirror the RHS expressions term by term; agreement with
-    ``jacfwd`` is property-tested across all four variants)."""
+    ``jacfwd`` is property-tested across all four variants).
+
+    With ``with_rhs=True`` returns ``(f, J)``: the Jacobian assembly
+    already evaluates every ingredient of the RHS (one shared
+    rate-of-progress ladder feeds both), so the fused variant assembles
+    ``f`` from the SAME intermediates the corresponding ``*_rhs``
+    function computes — expression-identical term by term, with no T
+    clamp indicator applied to f (the RHS variants apply none). With
+    the default ``with_rhs=False`` the traced graph is exactly the
+    historical Jacobian-only program (the split-path oracle)."""
     # local import: reactors imports THIS module at top level, so a
     # module-level import here would be a genuine cycle at package init
     from . import reactors
@@ -613,9 +622,13 @@ def _batch_jac_core(problem, energy, t, y, args):
         # T rides its profile: rhs[-1] = Tdot(t); no y-dependence, and
         # the species block does not see y[-1] at all
         zcol = jnp.zeros((KK + 1,), dtype=dtype)
-        return jnp.concatenate(
+        J = jnp.concatenate(
             [jnp.concatenate([J_YY, jnp.zeros((1, KK), dtype=dtype)],
                              axis=0), zcol[:, None]], axis=1)
+        if not with_rhs:
+            return J
+        _, Tdot = reactors.profile_value_slope(args.tprof, t)
+        return jnp.concatenate([dYdt, Tdot[None]]), J
 
     ql, _ = reactors.profile_value_slope(args.qloss, t)
     ar, _ = reactors.profile_value_slope(args.area, t)
@@ -663,7 +676,10 @@ def _batch_jac_core(problem, energy, t, y, args):
 
     top = jnp.concatenate([J_YY, (J_YT * mT)[:, None]], axis=1)
     bot = jnp.concatenate([J_TY, (J_TT * mT)[None]])[None, :]
-    return jnp.concatenate([top, bot], axis=0)
+    J = jnp.concatenate([top, bot], axis=0)
+    if not with_rhs:
+        return J
+    return jnp.concatenate([dYdt, dTdt[None]]), J
 
 
 def batch_rhs_jacobian(problem, energy):
@@ -689,3 +705,37 @@ def batch_rhs_jacobian(problem, energy):
         return _batch_jac_core(problem, energy, t, y, args)
 
     return jac_fn
+
+
+def fused_rhs_jacobian(problem, energy):
+    """Fused RHS+Jacobian for one batch-reactor RHS variant:
+    ``fj_fn(t, y, args) -> (f, J)`` from ONE shared rate-of-progress
+    evaluation — the Newton attempt's historical RHS/Jacobian twin
+    programs collapse into a single kernel (``PYCHEMKIN_FUSE_MODE``;
+    see :func:`pychemkin_tpu.ops.kinetics.resolve_fuse_mode`).
+
+    The f-branch is expression-identical to the corresponding
+    ``reactors.conp_/conv_*_rhs`` (same intermediates, same order), so
+    primal integration results match the split path bit-for-bit on
+    CPU/f64. Callers that only need one output still pay nothing extra:
+    XLA dead-code-eliminates the unused branch per call site.
+
+    Mixed-precision note: the split twins run the RHS in f64 and the
+    Jacobian assembly in f32 (``batch_rhs_jacobian``) — two dtypes one
+    shared ladder cannot serve. Here the core runs f64 and only J is
+    cast to f32 for the Newton preconditioner; ``resolve_fuse_mode``'s
+    "auto" therefore never picks fused on mixed-precision platforms
+    (an explicit "fused" trades the f32 assembly for the shared
+    ladder)."""
+    if (problem, energy) not in (("CONP", "ENRG"), ("CONP", "TGIV"),
+                                 ("CONV", "ENRG"), ("CONV", "TGIV")):
+        raise ValueError(f"unknown RHS variant {(problem, energy)!r}")
+
+    def fj_fn(t, y, args):
+        f, J = _batch_jac_core(problem, energy, t, y, args,
+                               with_rhs=True)
+        if linalg.use_mixed_precision():
+            J = J.astype(jnp.float32)
+        return f, J
+
+    return fj_fn

@@ -137,6 +137,81 @@ def _sparse_stage(mech):
     return st
 
 
+# ---------------------------------------------------------------------------
+# Fused RHS+Jacobian mode: one ROP ladder feeding both the species
+# contraction (primal wdot) and the closed-form derivative blocks,
+# instead of the historical RHS/Jacobian twin programs per Newton attempt.
+
+#: env knob selecting the Newton-attempt kernel layout: "fused" |
+#: "split" | "auto" (default — fused on staged records where the
+#: platform keeps the Jacobian in f64, split elsewhere). Read at TRACE
+#: time, exactly like PYCHEMKIN_ROP_MODE.
+FUSE_MODE_ENV = "PYCHEMKIN_FUSE_MODE"
+
+
+class _FuseModeState(threading.local):
+    """Trace-time override stack for the fused-kernel mode (thread-local
+    for the same reason as :class:`_RopModeState`)."""
+
+    def __init__(self):
+        self.stack = [None]
+
+
+_FUSE_MODE = _FuseModeState()
+
+
+@contextlib.contextmanager
+def fuse_mode(mode: str | None):
+    """Trace-time override of the fused-kernel mode: ``"fused"`` /
+    ``"split"`` force a layout (subject to the record actually carrying
+    a staged kernel — see :func:`fused_enabled`), ``None`` restores the
+    env/auto decision. Programs traced inside the block keep the mode
+    they were traced with."""
+    if mode not in ("fused", "split", None):
+        raise ValueError(f"unknown fuse mode {mode!r}")
+    _FUSE_MODE.stack.append(mode)
+    try:
+        yield
+    finally:
+        _FUSE_MODE.stack.pop()
+
+
+def resolve_fuse_mode() -> str:
+    """The effective fuse mode of a trace started now: the innermost
+    :func:`fuse_mode` override, else ``PYCHEMKIN_FUSE_MODE``, else auto
+    by platform. Auto fuses where the Jacobian is solved in f64 (one
+    dtype for both outputs of the shared ladder); on mixed-precision
+    platforms the split twins keep their separate f64-RHS/f32-Jacobian
+    cast contract, so auto stays "split" there. Note "fused" is a
+    REQUEST: records without a staged kernel still take the split
+    twins — see :func:`fused_enabled`."""
+    override = _FUSE_MODE.stack[-1]
+    if override is not None:
+        return override
+    m = knobs.value(FUSE_MODE_ENV)
+    if m == "auto":
+        from . import linalg
+        return "split" if linalg.use_mixed_precision() else "fused"
+    return m
+
+
+def fused_enabled(mech) -> bool:
+    """True when a trace started now should emit the fused RHS+Jacobian
+    program for this record: resolved mode "fused" AND a parse-time
+    staged kernel on the record with CONCRETE leaves (the same gate as
+    :func:`_sparse_stage` — a record passed as a jit argument falls
+    back to the split twins, whose wiring needs no trace-time numpy)."""
+    if getattr(mech, "rop_stage", None) is None:
+        return False
+    if resolve_fuse_mode() != "fused":
+        return False
+    try:
+        np.asarray(mech.nu_f)
+    except jax.errors.TracerArrayConversionError:
+        return False
+    return True
+
+
 def _nu_T_contract(mech, vec):
     """The species contraction ``nu^T @ vec`` ([II] -> [KK]) — the one
     site both its consumers (the primal ``wdot`` and the analytical

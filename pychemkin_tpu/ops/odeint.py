@@ -602,8 +602,8 @@ def _solve_segment(rhs, jac_fn, events, ctrl, state: _StepState, t_end,
 
 def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
            events=(), max_steps_per_segment=100_000, h0=0.0, jac=None,
-           f64_jac=False, bordered=True, fault_elem=None, fault_level=0,
-           profile=None):
+           fj=None, f64_jac=False, bordered=True, fault_elem=None,
+           fault_level=0, profile=None):
     """Integrate dy/dt = rhs(t, y, args) from ts[0] through ts[-1]; return
     the solution on the output grid ``ts`` plus event accumulators.
 
@@ -619,6 +619,13 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
     assembly of :mod:`pychemkin_tpu.ops.jacobian`); default is
     ``jax.jacfwd`` of the RHS. ``f64_jac`` forces the f64 AD Jacobian
     path (rescue escalation; ignored when ``jac`` is given).
+    ``fj(t, y, args) -> (f, J)`` supplies a FUSED RHS+Jacobian program
+    (:func:`pychemkin_tpu.ops.jacobian.fused_rhs_jacobian`): when set,
+    BOTH the rhs and jac used inside the solver route through it — a
+    Newton attempt then emits one kernel, not RHS+Jacobian twins, and
+    XLA dead-code-eliminates the unused branch at sites needing only
+    one output. ``rhs`` must still be passed (events, diagnostics, API
+    symmetry) but is shadowed; ``jac``/``f64_jac`` are ignored.
     ``bordered`` (default True) solves the Newton systems by block
     elimination of the last state variable (the [Y..., T] border) over
     a factorization of the leading block
@@ -636,6 +643,16 @@ def odeint(rhs, y0, ts, args=None, *, rtol=1e-6, atol=1e-12,
     if profile is None:
         profile = solve_profile_enabled()
     events = tuple(events)
+    if fj is not None:
+        # route EVERY rhs/jac evaluation through the fused program's
+        # branches (f0 seed, Newton stages, event samples): one traced
+        # function, so sites needing only f (or only J) DCE the other
+        # branch, and a full Newton-attempt site shares the ladder.
+        # Shadowing happens BEFORE fault wrapping so injected faults
+        # corrupt the fused f-branch exactly as they would the split
+        # rhs — while the Jacobian stays clean, as on the split path.
+        rhs = lambda t, y, a, _fj=fj: _fj(t, y, a)[0]   # noqa: E731
+        jac = lambda t, y, a, _fj=fj: _fj(t, y, a)[1]   # noqa: E731
     stall_inject = None
     if fault_elem is not None and faultinject.enabled():
         rhs = faultinject.wrap_rhs(rhs, fault_elem, fault_level)

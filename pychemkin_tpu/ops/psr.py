@@ -147,11 +147,17 @@ def _resid_jac(resid_fn, y, args, analytic):
 
 def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
                   species_floor, damping=True, fault_mask=None,
-                  analytic_jac=True):
+                  analytic_jac=True, fused=False):
     """Damped Newton with masked convergence; returns
     (y, converged, n, lin_unstable) — ``lin_unstable`` is the linear
     solver's stagnation flag from the LAST iteration (the
-    LINALG_UNSTABLE escalation signal when the phase also failed)."""
+    LINALG_UNSTABLE escalation signal when the phase also failed).
+
+    ``fused`` evaluates residual and Jacobian through ONE
+    ``jax.linearize`` of the residual per iteration — the primal comes
+    out of the linearization (identical expression graph, shared ROP
+    ladder) instead of a second, independent residual trace; the split
+    twin layout (default) is the bit-identity oracle."""
     n = y0.shape[0]
 
     def step_norm(dy, y):
@@ -168,8 +174,14 @@ def _newton_phase(resid_fn, y0, args, weights, n_iter, T_max,
 
     def body(carry):
         y, _, it, _ = carry
-        r = resid_fn(y, args)
-        J = _resid_jac(resid_fn, y, args, analytic_jac)
+        if fused:
+            with kinetics.analytic_jacobian(analytic_jac):
+                r, lin = jax.linearize(lambda yy: resid_fn(yy, args), y)
+            # lin(e_j) is COLUMN j of J; the vmap stacks them as rows
+            J = jnp.transpose(jax.vmap(lin)(jnp.eye(n, dtype=y.dtype)))
+        else:
+            r = resid_fn(y, args)
+            J = _resid_jac(resid_fn, y, args, analytic_jac)
         J = jnp.where(jnp.isfinite(J), J, 0.0) + 1e-14 * jnp.eye(n)
         # bordered: the PSR state is [Y..., T], so the Newton system is
         # eliminated over the KK x KK species block with the T
@@ -316,10 +328,16 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
     y0 = jnp.concatenate([jnp.asarray(Y_guess, jnp.float64),
                           jnp.asarray(T_guess, jnp.float64)[None]])
 
+    # fused Newton iterations: residual+Jacobian from one linearize per
+    # iteration (PYCHEMKIN_FUSE_MODE; gated on the record being staged
+    # exactly like the batch-reactor path)
+    fused = analytic_jac and kinetics.fused_enabled(mech)
+
     y1, conv1, n1, unst1 = _newton_phase(resid, y0, mech_args, weights,
                                          n_newton, T_max, species_floor,
                                          fault_mask=fault_mask,
-                                         analytic_jac=analytic_jac)
+                                         analytic_jac=analytic_jac,
+                                         fused=fused)
 
     # pseudo-transient rescue for unconverged elements; a no-op (masked)
     # when phase 1 already converged
@@ -331,7 +349,8 @@ def solve_psr(mech, mode, energy, *, P, Y_in, h_in, T_guess, Y_guess,
     y2, conv2, n2, unst2 = _newton_phase(resid, y_pt, mech_args, weights,
                                          n_newton, T_max, species_floor,
                                          fault_mask=fault_mask,
-                                         analytic_jac=analytic_jac)
+                                         analytic_jac=analytic_jac,
+                                         fused=fused)
     y = jnp.where(conv1, y1, y2)
     converged = conv1 | conv2
     lin_unstable = jnp.where(conv1, unst1, unst2)

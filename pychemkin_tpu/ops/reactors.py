@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import R_GAS
+from ..mechanism import staging
 from ..resilience import faultinject
 from . import jacobian, kinetics, linalg, thermo
 from .odeint import (Event, SolveProfile, gershgorin_rate, odeint,
@@ -267,8 +268,15 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
     # the rhs itself), not silently flow through a Jacobian whose closed
     # form does not model the fault
     jac = None
+    fj = None
     if jac_mode == "analytic" and not f64_jac:
-        jac = jacobian.batch_rhs_jacobian(problem, energy)
+        if kinetics.fused_enabled(mech):
+            # one fused (f, J) program per Newton attempt instead of
+            # RHS+Jacobian twins (PYCHEMKIN_FUSE_MODE; split oracle
+            # below stays bit-identical — same expressions, one trace)
+            fj = staging.build_fused_kernel(mech, problem, energy)
+        else:
+            jac = jacobian.batch_rhs_jacobian(problem, energy)
     elif jac_mode not in ("analytic", "ad"):
         raise ValueError(f"unknown jac_mode {jac_mode!r}")
     dtype = jnp.result_type(jnp.asarray(Y0).dtype, jnp.float64)
@@ -313,7 +321,7 @@ def solve_batch(mech, problem, energy, T0, P0, Y0, t_end, *,
     atol_vec = atol_vec.at[-1].set(jnp.maximum(atol * 1e6, 1e-8))
     sol = odeint(rhs, y0, ts, args, rtol=rtol, atol=atol_vec, events=events,
                  max_steps_per_segment=max_steps_per_segment, h0=h0,
-                 jac=jac, f64_jac=f64_jac, fault_elem=fault_elem,
+                 jac=jac, fj=fj, f64_jac=f64_jac, fault_elem=fault_elem,
                  fault_level=fault_level, profile=profile)
 
     ignition_time = sol.event_times[0]
@@ -519,7 +527,15 @@ def ignition_sweep_kernel(mech, problem, energy, *, rtol=1e-6,
 
     rhs_base = _RHS[(problem, energy)]
     if jac_mode == "analytic":
-        jac = jacobian.batch_rhs_jacobian(problem, energy)
+        if kinetics.fused_enabled(mech):
+            # fused (f, J): both lane roles route through one program
+            # (same contract as odeint's fj= path — the f-branch gets
+            # fault-wrapped below, the Jacobian branch stays clean)
+            fj = staging.build_fused_kernel(mech, problem, energy)
+            rhs_base = lambda t, y, a: fj(t, y, a)[0]   # noqa: E731
+            jac = lambda t, y, a: fj(t, y, a)[1]        # noqa: E731
+        else:
+            jac = jacobian.batch_rhs_jacobian(problem, energy)
     elif jac_mode == "ad":
         jac = None
     else:

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import knobs
+
 try:                                    # jax >= 0.6: top-level name
     from jax import shard_map as _shard_map_impl
 except ImportError:                     # jax 0.4.x: experimental home
@@ -306,15 +308,19 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
         plan = _schedule.plan_cohorts(costs, chunk,
                                       label="sharded_ignition_sweep")
         order = plan.order
-        # compaction drives plain jitted shapes on the host; a multi-
-        # device mesh keeps the shard_map path (cohort sorting is the
-        # multi-chip half of the win), and unsupported solver knobs
-        # (rescue-ladder escalations ride solve_kwargs) fall back too
+        # compaction drives cohort chunks through the shape-ladder
+        # kernel: single-device as plain jitted programs, multi-device
+        # shard_mapped over the mesh with global survivor re-binning
+        # between rounds (PYCHEMKIN_MESH_COMPACT=0 keeps the sort-only
+        # shard path). Unsupported solver knobs (rescue-ladder
+        # escalations ride solve_kwargs) fall back to the shard path.
         supported = {"rtol", "atol", "n_out", "ignition_mode",
                      "ignition_kwargs", "max_steps_per_segment", "h0",
                      "jac_mode"}
-        compact = (n_dev == 1 and set(kwargs) <= supported
-                   and kwargs.get("n_out", 2) == 2)
+        compact = (set(kwargs) <= supported
+                   and kwargs.get("n_out", 2) == 2
+                   and (n_dev == 1
+                        or bool(knobs.value("PYCHEMKIN_MESH_COMPACT"))))
         if job_report is not None:
             job_report["schedule"] = mode
             job_report["schedule_compaction"] = compact
@@ -334,6 +340,7 @@ def sharded_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s, t_ends, *,
                 mech, problem, energy, T0s_np[idx], P0s_np[idx],
                 Y0s_np[idx], t_ends_np[idx],
                 elem_ids=np.asarray(idx),
+                mesh=mesh if n_dev > 1 else None,
                 label="sharded_ignition_sweep",
                 **{k: v for k, v in kwargs.items() if k != "n_out"})
             if stats is not None:

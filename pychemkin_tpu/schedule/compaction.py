@@ -19,8 +19,12 @@ their results are discarded by global-index bookkeeping.
 
 Bit-match: rounds share the one-shot integrator's step body
 (``odeint._segment_fns``) and lane values are independent of batch
-companions, so harvested results are bit-identical to the compiled
-unsorted vmapped sweep — property-tested in tests/test_schedule.py.
+companions, so harvested results match the compiled unsorted vmapped
+sweep up to XLA:CPU's per-program-width fusion rounding — bitwise
+where the rung widths lower identically (property-tested on both
+embedded mechanisms in tests/test_schedule.py), at worst ~1e-13
+relative on GRI-scale mechanisms across widely differing widths
+(see the MIN_BUCKET note).
 """
 
 from __future__ import annotations
@@ -45,10 +49,15 @@ DEFAULT_ROUND_LEN = 512
 
 #: smallest compaction bucket — a HARD floor, not a tuning default:
 #: below ~8 lanes XLA:CPU lowers the batched step math differently
-#: (vectorization threshold), breaking the per-lane bitwise width-
-#: invariance the compaction contract rests on (measured: widths
-#: >= 8 are bit-invariant on both embedded mechanisms, widths 1-4
-#: are not). It also marks where per-iteration fixed cost dominates.
+#: (vectorization threshold), far outside the rounding band the
+#: compaction contract allows. Above the floor, per-lane width-
+#: invariance is mechanism-dependent: h2o2 (11 states) is bitwise
+#: across all widths >= 8, while grisyn (54 states) can pick up
+#: ~1e-13-relative fusion-rounding differences between widely
+#: differing program widths (measured 8 vs 64 — the band the
+#: batch-efficiency rung already documents; adjacent rungs like
+#: 16 vs 8 bit-match, see tests/test_schedule.py). The floor also
+#: marks where per-iteration fixed cost dominates.
 MIN_BUCKET = 8
 
 #: resumable-sweep kernels keyed by full solver configuration (incl.
@@ -57,28 +66,36 @@ MIN_BUCKET = 8
 _KERNEL_CACHE: Dict[Tuple, Any] = {}
 
 
-def _align(b: int) -> int:
-    """Round a width up to the MIN_BUCKET lane multiple — the bitwise
-    width-invariance domain (XLA:CPU peels non-multiple tails onto a
+def _align(b: int, unit: int = MIN_BUCKET) -> int:
+    """Round a width up to the ``unit`` lane multiple (``unit`` itself
+    always a MIN_BUCKET multiple) — keeps every rung on the vectorized
+    lowering path (XLA:CPU peels non-multiple tails onto a
     differently-rounding scalar path)."""
-    return -(-int(b) // MIN_BUCKET) * MIN_BUCKET
+    return -(-int(b) // unit) * unit
 
 
-def compaction_ladder(top: int, min_bucket: int = MIN_BUCKET
+def compaction_ladder(top: int, min_bucket: int = MIN_BUCKET,
+                      lane_multiple: int = MIN_BUCKET
                       ) -> Tuple[int, ...]:
     """Descending shape ladder from ``top``: halving rungs, every rung
-    aligned to the MIN_BUCKET lane multiple and floored at
-    ``max(min_bucket, MIN_BUCKET)`` (raising ``min_bucket`` is a perf
-    knob; lowering it below the invariance floor is not possible)."""
+    aligned to the ``lane_multiple`` (itself rounded up to a MIN_BUCKET
+    multiple) and floored at ``max(min_bucket, lane_multiple)``
+    (raising ``min_bucket`` is a perf knob; lowering it below the
+    invariance floor is not possible). A multi-device sweep passes
+    ``lane_multiple = MIN_BUCKET * n_devices`` so every rung divides
+    evenly into identically-shaped, 8-aligned per-shard blocks — the
+    ladder is then the SAME on every device and zero new programs
+    compile after each rung's first run."""
     top = int(top)
     if top < 1:
         raise ValueError(f"ladder top must be positive, got {top}")
-    floor = _align(max(int(min_bucket), MIN_BUCKET))
-    rungs = [_align(top)]
+    unit = _align(max(int(lane_multiple), MIN_BUCKET))
+    floor = _align(max(int(min_bucket), unit), unit)
+    rungs = [_align(top, unit)]
     b = rungs[0] // 2
-    while _align(b) >= floor and len(rungs) < 6:
-        if _align(b) != rungs[-1]:
-            rungs.append(_align(b))
+    while _align(b, unit) >= floor and len(rungs) < 6:
+        if _align(b, unit) != rungs[-1]:
+            rungs.append(_align(b, unit))
         b //= 2
     return tuple(rungs)
 
@@ -96,6 +113,40 @@ def _kernel(mech, problem, energy, cfg: Tuple, kwargs: Dict):
     return k
 
 
+#: shard_map-wrapped kernel entry points, one triple per
+#: (kernel, mesh-devices): the jit objects must be LONG-LIVED so the
+#: per-rung shape cache survives across sweeps (zero new compiles
+#: after warmup is part of the multi-device contract)
+_MESH_PROGRAM_CACHE: Dict[Tuple, Any] = {}
+
+
+def _mesh_programs(kernel, mesh):
+    """The kernel's ``(init, advance, harvest)`` wrapped in one
+    ``shard_map`` over the mesh batch axis: each device runs the plain
+    lane programs on its ``width // n_devices`` block — lane values
+    never depend on batch companions or shard placement, so harvested
+    results agree with the single-device sweep up to XLA:CPU's
+    per-program-width fusion rounding (bitwise on h2o2, ~1e-13
+    relative on grisyn; see the MIN_BUCKET note)."""
+    # lazy: parallel.sharding routes INTO this module (compact path),
+    # so a top-level import here would be a genuine cycle
+    from ..parallel.sharding import BATCH_AXIS, shard_map
+    key = (id(kernel), tuple(d.id for d in mesh.devices.flat))
+    progs = _MESH_PROGRAM_CACHE.get(key)
+    if progs is None:
+        spec = jax.sharding.PartitionSpec(BATCH_AXIS)
+
+        def _wrap(fn, n_args):
+            return jax.jit(shard_map(
+                fn, mesh=mesh, in_specs=(spec,) * n_args,
+                out_specs=spec, check_vma=False))
+
+        progs = (_wrap(kernel.init, 5), _wrap(kernel.advance, 6),
+                 _wrap(kernel.harvest, 6))
+        _MESH_PROGRAM_CACHE[key] = progs
+    return progs
+
+
 def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
                              t_ends, *, rtol=1e-6, atol=1e-12,
                              ignition_mode=None, ignition_kwargs=None,
@@ -105,13 +156,16 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
                              fault_level: int = 0,
                              ladder: Optional[Sequence[int]] = None,
                              round_len: Optional[int] = None,
+                             mesh=None,
                              recorder=None, label: str = ""
                              ) -> Dict[str, np.ndarray]:
     """Batched ignition-delay sweep with mid-sweep compaction.
 
     Same contract as
     :func:`~pychemkin_tpu.ops.reactors.ignition_delay_sweep` (results
-    bit-match it at the compiled-baseline level), returned as a dict
+    match it at the compiled-baseline level, up to the
+    per-program-width rounding band in the module docstring), returned
+    as a dict
     of [B] arrays ``times``/``ok``/``status`` plus the per-element
     solver counters ``n_steps``/``n_rejected``/``n_newton`` the bench
     FLOP model sums (and, when ``PYCHEMKIN_SOLVE_PROFILE`` is on,
@@ -119,6 +173,23 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
     ``elem_ids`` carries ORIGINAL batch indices for
     fault injection — a cohort-permuted scheduled sweep passes the
     caller ids so the same elements stay poisoned.
+
+    ``mesh`` (a ``jax.sharding.Mesh`` over the batch axis) runs every
+    round shard_mapped across its devices and re-bins survivors
+    GLOBALLY between rounds: finished lanes anywhere on the mesh free
+    batch slots everywhere, instead of stranding per-shard stragglers.
+    Ladder rungs are aligned to ``MIN_BUCKET * n_devices`` so each
+    shard's block is 8-aligned and identically shaped on every device;
+    re-binning is a host gather + re-scatter of the carried state
+    (O(width) state bytes per compaction, same bookkeeping as the
+    single-device path). Per-lane math never depends on batch
+    companions or shard placement, so caller-order results match the
+    single-device sweep through the same kernel up to per-program-width
+    fusion rounding: bitwise on h2o2 (property-tested), ~1e-13
+    relative on GRI-scale mechanisms — the same band the
+    batch-efficiency rung documents. Statuses agree except for lanes
+    sitting exactly on the step-budget boundary, where a last-bit
+    difference can flip ``BUDGET_EXHAUSTED`` <-> ``OK``.
     """
     if ignition_mode is None:
         ignition_mode = reactors.IGN_T_INFLECTION
@@ -149,16 +220,39 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
            tuple(sorted((ignition_kwargs or {}).items())),
            max_steps_per_segment, h0, jac_mode, fault_level, rl, prof)
     kernel = _kernel(mech, problem, energy, cfg, kwargs)
+    n_dev = int(mesh.size) if mesh is not None else 1
+    if n_dev <= 1:
+        mesh = None                 # 1-device mesh == plain path
+        n_dev = 1
+    unit = _align(MIN_BUCKET * n_dev)
     if ladder is None:
-        ladder = compaction_ladder(B)
+        ladder = compaction_ladder(B, lane_multiple=unit)
     # the MIN_BUCKET floor/alignment is part of the bit-match
     # contract (see above): an explicit ladder cannot opt into sub-8
     # or non-8-multiple shapes — every rung is aligned up, deduped
-    rungs = tuple(sorted({_align(b) for b in ladder if int(b) >= 1},
-                         reverse=True))
+    # (on a mesh, up to the per-shard-identical 8*n_dev multiple)
+    rungs = tuple(sorted({_align(b, unit) for b in ladder
+                          if int(b) >= 1}, reverse=True))
     if not rungs or rungs[0] < B:
-        rungs = (_align(max(B, MIN_BUCKET)),) + rungs
+        rungs = (_align(max(B, unit), unit),) + rungs
     rec = recorder if recorder is not None else telemetry.get_recorder()
+
+    if mesh is None:
+        init_p, advance_p, harvest_p = (kernel.init, kernel.advance,
+                                        kernel.harvest)
+        place = None
+    else:
+        init_p, advance_p, harvest_p = _mesh_programs(kernel, mesh)
+        from ..parallel.sharding import BATCH_AXIS
+        named = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(BATCH_AXIS))
+
+        def place(tree):
+            # commit every (re-binned) carry to the mesh sharding so
+            # each rung's program compiles exactly once — an eagerly
+            # gathered, uncommitted carry would key a second cache
+            # entry for the same shape
+            return jax.device_put(tree, named)
 
     out = {
         "times": np.full(B, np.nan),
@@ -183,7 +277,9 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
     gidx = pad.copy()            # caller index carried by each lane
     inputs = [jnp.asarray(a) for a in
               _gather([T0s, P0s, Y0s, t_ends, elem_ids], pad)]
-    state = kernel.init(*inputs)
+    if place is not None:
+        inputs = [place(a) for a in inputs]
+    state = init_p(*inputs)
 
     n_compactions = 0
     rounds = 0
@@ -193,9 +289,9 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
     max_rounds = -(-int(max_steps_per_segment) * 2 // max(rl, 1)) + 8
     harvested = np.zeros(B, bool)
     while True:
-        state = kernel.advance(state, *inputs)
+        state = advance_p(state, *inputs)
         h = {k: np.asarray(v) for k, v in
-             kernel.harvest(state, *inputs).items()}
+             harvest_p(state, *inputs).items()}
         rounds += 1
         done = h["done"]
         new = done & ~harvested[gidx]
@@ -227,14 +323,20 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
             sel = sel[np.sort(first)]
             pad = np.concatenate(
                 [sel, np.repeat(sel[-1], bucket - sel.size)])
+            # the gather is GLOBAL on a mesh: survivor lanes from any
+            # shard re-bin into any slot of the next (smaller) rung
             state = jax.tree_util.tree_map(lambda a: a[pad], state)
             inputs = [jax.tree_util.tree_map(lambda a: a[pad], c)
                       for c in inputs]
+            if place is not None:
+                state = place(state)
+                inputs = [place(a) for a in inputs]
+                rec.inc("schedule.mesh_rebins")
             gidx = gidx[pad]
             width = bucket
             n_compactions += 1
             rec.inc("schedule.compactions")
     rec.event("schedule.compaction", label=label, B=B,
               rounds=rounds, n_compactions=n_compactions,
-              ladder=list(rungs), round_len=rl)
+              ladder=list(rungs), round_len=rl, n_devices=n_dev)
     return out

@@ -51,6 +51,7 @@ COUNTERS = (
     "resilience.rescued",
     "schedule.cohorts",
     "schedule.compactions",
+    "schedule.mesh_rebins",
     "schedule.ladder_adjust",
     "serve.abandoned",
     "serve.batch_errors",
@@ -71,6 +72,8 @@ COUNTERS = (
     "staging.cache_corrupt",
     "staging.cache_hit",
     "staging.emit",
+    "staging.fused_built",
+    "staging.fused_hit",
     "staging.hit",
     "staging.memo_hit",
 )

@@ -38,5 +38,13 @@ def membership_read():
     return "PYCHEMKIN_NO_CACHE" in os.environ        # knob-raw-env-read
 
 
+def fuse_mode_read():
+    return os.environ.get("PYCHEMKIN_FUSE_MODE")     # knob-raw-env-read
+
+
+def mesh_compact_read():
+    return os.getenv("PYCHEMKIN_MESH_COMPACT", "1")  # knob-raw-env-read
+
+
 def unregistered_knob():
     return knobs.value("PYCHEMKIN_NOT_A_KNOB")       # knob-unregistered

@@ -92,6 +92,15 @@ Behaviour:
   across PRs instead of scraped from logs. The sink module is loaded
   STANDALONE (importlib) because this orchestrator must never import
   the package (``pychemkin_tpu/__init__`` imports jax);
+- ``--mesh N`` forces an N-way host-device mesh in every child:
+  ``--xla_force_host_platform_device_count=N`` is exported through the
+  child's ``XLA_FLAGS`` (replacing any inherited device-count flag;
+  conftest keeps its hands off when one is already present), and the
+  count is recorded as ``mesh`` in the --summary-json artifact. The
+  fast lane is ``--mesh 2 -m 'not slow'`` (every multi-device code
+  path on the cheapest real mesh); the slow soak is ``--mesh 8 -m
+  slow`` — the forced 8-device CPU mesh the ISSUE-16 cross-shard
+  re-binning contract is validated on;
 - ``--perf-ledger PATH`` additionally banks the container-speed
   calibration microprobe (``pychemkin_tpu/utils/calibration.py``,
   importlib-standalone like the sink) alongside the suite verdict —
@@ -210,7 +219,7 @@ CHAOS_ENV_SPEC = ('[{"mode": "kill_backend_at_request", '
                   '"request": 2}]')
 
 
-def _child_env(faults=False, chaos=False):
+def _child_env(faults=False, chaos=False, mesh=None):
     env = dict(os.environ)
     # never dial the TPU tunnel from test children (hung-tunnel hazard;
     # tests are pinned to the virtual-CPU mesh anyway)
@@ -219,6 +228,16 @@ def _child_env(faults=False, chaos=False):
     # tell the child conftest it is already isolated: no re-exec needed
     env["_PYCHEMKIN_TEST_REEXEC"] = "1"
     env["_PYCHEMKIN_SUITE_CHILD"] = "1"
+    if mesh:
+        # --mesh N: every child sees an N-way forced-host-device mesh.
+        # conftest only appends its own device-count flag when XLA_FLAGS
+        # does not already carry one, so the value set here wins. Any
+        # caller-exported device count is replaced, not duplicated —
+        # XLA takes the FIRST occurrence of a repeated flag.
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={int(mesh)}")
+        env["XLA_FLAGS"] = " ".join(flags)
     if faults:
         env.setdefault("PYCHEMKIN_FAULTS", FAULTS_ENV_SPEC)
     if chaos:
@@ -321,6 +340,23 @@ def main(argv=None):
             return 2
         summary_json = argv[i + 1]
         del argv[i:i + 2]
+    mesh = None
+    if "--mesh" in argv:
+        i = argv.index("--mesh")
+        if i + 1 >= len(argv):
+            print("run_suite: --mesh needs a device count",
+                  file=sys.stderr)
+            return 2
+        try:
+            mesh = int(argv[i + 1])
+        except ValueError:
+            print(f"run_suite: --mesh needs an integer, got "
+                  f"{argv[i + 1]!r}", file=sys.stderr)
+            return 2
+        if mesh < 1:
+            print("run_suite: --mesh must be >= 1", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     perf_ledger_path = None
     if "--perf-ledger" in argv:
         i = argv.index("--perf-ledger")
@@ -353,7 +389,10 @@ def main(argv=None):
         print("run_suite: no test files found", file=sys.stderr)
         return 2
 
-    env = _child_env(faults=faults, chaos=chaos)
+    env = _child_env(faults=faults, chaos=chaos, mesh=mesh)
+    if mesh:
+        print(f"# run_suite: forcing a {mesh}-device host mesh in "
+              "children (--mesh)", flush=True)
     kill_dir = None
     preexisting_reports = set()
     preexisting_health = set()
@@ -508,6 +547,7 @@ def main(argv=None):
             "n_failed": n_fail,
             "n_empty": n_empty,
             "n_retried": n_retried,
+            "mesh": mesh,
             "dots_passed": sum(d for *_x, d in results),
             "files": [{"file": name, "rc": rc,
                        "wall_s": round(dt, 3), "dots": dots,

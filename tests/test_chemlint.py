@@ -117,7 +117,7 @@ class TestKnobRules:
     def test_bad_fixture_flags_every_read_shape(self):
         by = _by_rule(_lint_fix("knobs_bad.py"))
         raws = by.get("knob-raw-env-read", [])
-        assert len(raws) == 6
+        assert len(raws) == 8
         expected = set(_probe_lines("knobs_bad.py",
                                     "# knob-raw-env-read"))
         assert {v.line for v in raws} == expected

@@ -87,6 +87,28 @@ class TestExtraction:
         torn.write_text('{"parsed": {"value": ')
         assert perf_ledger.extract(str(torn)) is None
 
+    def test_multichip_dryrun_shape_is_skipped(self):
+        # rounds 1-5 banked the family as a dryrun transcript (rc +
+        # tail, no metrics): not an extractable perf artifact
+        entry = perf_ledger.extract(
+            os.path.join(_REPO, "MULTICHIP_r01.json"))
+        assert entry is None
+
+    def test_multichip_bench_extracts(self, tmp_path):
+        doc = {"tool": "bench_multichip", "platform": "cpu",
+               "mech": "grisyn", "B": 256, "n_devices": 8,
+               "rebin_ms_per_elem": 100.0,
+               "sort_only_ms_per_elem": 150.0,
+               "rebin_speedup": 1.5,
+               "calibration": None}
+        p = tmp_path / "MULTICHIP_r99.json"
+        p.write_text(json.dumps(doc))
+        entry = perf_ledger.extract(str(p))
+        assert entry["kind"] == "multichip"
+        assert entry["metrics"]["rebin_speedup"] == 1.5
+        assert perf_ledger.METRIC_DIRECTIONS[
+            "rebin_speedup"] == "higher"
+
     def test_normalization_direction(self):
         cal = _cal_module()
         entry = {"kind": "step_cost", "platform": "cpu",
@@ -149,6 +171,24 @@ class TestCheckGate:
         p.write_text("{}")
         rc, verdict = perf_ledger.check(ledger, str(p), band=1.5)
         assert rc == 2 and "error" in verdict
+
+    def test_missing_artifact_fails_check(self, ledger, tmp_path):
+        # a ledger row whose backing artifact file is gone is an
+        # unauditable baseline: --check must refuse outright
+        doctored = dict(ledger)
+        doctored["entries"] = list(ledger["entries"]) + [
+            {"kind": "bench", "mech": "x", "platform": "cpu",
+             "metrics": {"throughput": 1.0}, "normalized": {},
+             "artifact": "BENCH_r99_deleted.json"}]
+        lpath = tmp_path / "doctored_ledger.json"
+        lpath.write_text(json.dumps(doctored))
+        rc = perf_ledger.main(
+            ["--root", _REPO, "--ledger", str(lpath),
+             "--check", self._fresh_capture(tmp_path)])
+        assert rc == 1
+        assert perf_ledger.missing_artifacts(
+            doctored, _REPO) == ["BENCH_r99_deleted.json"]
+        assert perf_ledger.missing_artifacts(ledger, _REPO) == []
 
     def test_cli_roundtrip(self, tmp_path, capsys):
         out = str(tmp_path / "ledger.json")

@@ -254,3 +254,33 @@ class TestPSRModels:
         assert psr.maxTbound == 4000.0
         with pytest.raises(ValueError):
             psr.steady_state_tolerances = (-1.0, 1e-5)
+
+
+class TestFusedNewton:
+    @pytest.mark.slow
+    def test_solve_psr_fused_matches_split(self, mech, inlet_state,
+                                           hot_guess):
+        # ISSUE 16: the fused Newton phase evaluates (r, J) through
+        # jax.linearize over the residual — the primal is compiled
+        # TOGETHER with the tangent program (unlike odeint, where each
+        # call site dead-code-eliminates the unused output), so the
+        # fixed point can drift by fusion rounding at the last bits.
+        # The contract: identical Newton trajectory length and
+        # convergence, state agreement at ~1e-12 of scale.
+        from pychemkin_tpu.ops import kinetics
+        Y_in, h_in = inlet_state
+        T_g, Y_g = hot_guess
+        sols = {}
+        for mode in ("split", "fused"):
+            with kinetics.fuse_mode(mode):
+                sols[mode] = psr_ops.solve_psr(
+                    mech, "tau", "ENRG", P=P_ATM, Y_in=Y_in,
+                    h_in=h_in, T_guess=T_g, Y_guess=Y_g,
+                    tau=1e-3, mdot=10.0)
+        s, f = sols["split"], sols["fused"]
+        assert bool(s.converged) and bool(f.converged)
+        assert int(s.n_newton) == int(f.n_newton)
+        T_s, T_f = float(s.T), float(f.T)
+        assert abs(T_s - T_f) <= 1e-12 * max(1.0, abs(T_s))
+        dY = float(np.max(np.abs(np.asarray(s.Y) - np.asarray(f.Y))))
+        assert dY <= 1e-12

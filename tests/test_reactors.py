@@ -203,3 +203,92 @@ def test_vmap_sweep_batch(mech):
         rtol=1e-7, atol=1e-13)
     assert bool(jnp.all(ok))
     assert np.all(np.isfinite(np.asarray(taus)))
+
+
+# ---------------------------------------------------------------------------
+# fused kinetics+Jacobian emission (ISSUE 16): the split path is the
+# oracle — fusing the Newton attempt's (f, J) into one program must not
+# change the primal trajectory
+
+class TestFusedEmission:
+    def test_fuse_mode_resolution(self, mech):
+        from pychemkin_tpu.ops import kinetics
+        with kinetics.fuse_mode("fused"):
+            assert kinetics.fused_enabled(mech)
+        with kinetics.fuse_mode("split"):
+            assert not kinetics.fused_enabled(mech)
+
+    def _solve(self, mech, fuse, **kw):
+        from pychemkin_tpu.ops import kinetics
+        Y0 = stoich_h2_air(mech)
+        with kinetics.fuse_mode(fuse):
+            return reactors.solve_batch(
+                mech, "CONP", "ENRG", 1200.0, P_ATM, Y0, 2e-4,
+                n_out=11, rtol=1e-6, atol=1e-12, **kw)
+
+    def test_fused_kernel_point_bitwise(self, mech):
+        # cheap fast-lane guard: the fused (f, J) program evaluated at
+        # a point state must bit-match the split rhs + jac pair (they
+        # are the same expressions — see ops/jacobian.fused_rhs_jacobian)
+        from pychemkin_tpu.mechanism import staging
+        from pychemkin_tpu.ops import jacobian
+        Y0 = stoich_h2_air(mech)
+        y = jnp.concatenate([jnp.asarray(Y0), jnp.array([1250.0])])
+        args = reactors.BatchArgs(
+            mech=mech,
+            constraint=reactors.constant_profile(P_ATM),
+            tprof=reactors.constant_profile(1000.0),
+            qloss=reactors.constant_profile(0.0),
+            area=reactors.constant_profile(0.0),
+            mass=1.0)
+        fj = staging.build_fused_kernel(mech, "CONP", "ENRG")
+        # exactly how odeint consumes it: each call site drops one
+        # output and XLA dead-code-eliminates the other branch — the
+        # bit-identity contract is per call site, not for a program
+        # forced to materialize both outputs at once
+        f = jax.jit(lambda t, y, a: fj(t, y, a)[0])(0.0, y, args)
+        J = jax.jit(lambda t, y, a: fj(t, y, a)[1])(0.0, y, args)
+        f_split = jax.jit(reactors.conp_enrg_rhs)(0.0, y, args)
+        J_split = jax.jit(jacobian.batch_rhs_jacobian(
+            "CONP", "ENRG"))(0.0, y, args)
+        assert np.array_equal(np.asarray(f), np.asarray(f_split))
+        assert np.array_equal(np.asarray(J), np.asarray(J_split))
+
+    @pytest.mark.slow
+    def test_solve_batch_fused_bitwise_h2o2(self, mech):
+        s = self._solve(mech, "split")
+        f = self._solve(mech, "fused")
+        # same expressions, one program: bitwise on h2o2
+        assert np.array_equal(np.asarray(s.T), np.asarray(f.T))
+        assert np.array_equal(np.asarray(s.Y), np.asarray(f.Y))
+        assert np.array_equal(np.asarray(s.times), np.asarray(f.times))
+        assert np.array_equal(np.asarray(s.ignition_time),
+                              np.asarray(f.ignition_time),
+                              equal_nan=True)
+        assert int(s.n_steps) == int(f.n_steps)
+
+    @pytest.mark.slow
+    def test_solve_batch_fused_grisyn_scale_relative(self):
+        # GRI-scale: two XLA programs of the same math may differ by
+        # value-dependent fusion rounding — bounded at 1e-12 of the
+        # state scale, far inside rtol
+        from pychemkin_tpu.ops import kinetics
+        grisyn = load_embedded("grisyn")
+        names = list(grisyn.species_names)
+        X = np.zeros(grisyn.n_species)
+        X[names.index("H2")] = 2.0
+        X[names.index("O2")] = 1.0
+        X[names.index("N2")] = 3.76
+        Y0 = np.asarray(thermo.X_to_Y(grisyn, jnp.asarray(X / X.sum())))
+        sols = {}
+        for mode in ("split", "fused"):
+            with kinetics.fuse_mode(mode):
+                sols[mode] = reactors.solve_batch(
+                    grisyn, "CONP", "ENRG", 1400.0, P_ATM, Y0, 2e-5,
+                    n_out=5, rtol=1e-6, atol=1e-12)
+        s, f = sols["split"], sols["fused"]
+        for a, b in ((s.T, f.T), (s.Y, f.Y)):
+            a, b = np.asarray(a), np.asarray(b)
+            scale = max(1.0, float(np.max(np.abs(a))))
+            assert float(np.max(np.abs(a - b))) <= 1e-12 * scale
+        assert bool(s.success) and bool(f.success)

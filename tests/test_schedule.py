@@ -314,20 +314,86 @@ class TestScheduledSweep:
         assert report["schedule_compaction"] is True
         assert report["schedule_cohorts"] == 2
 
-    def test_multi_device_mesh_sorts_without_compaction(self, h2o2):
+    def test_multi_device_mesh_compacts_and_matches_static(self, h2o2):
+        # the multi-device scheduled path now re-bins survivors across
+        # the mesh mid-sweep (PYCHEMKIN_MESH_COMPACT default-on). The
+        # bit-identity contract is against the single-device scheduled
+        # sweep THROUGH THE SAME KERNEL (per-lane math independent of
+        # shard placement) and holds bitwise on h2o2; GRI-scale
+        # mechanisms sit in the ~1e-13 per-program-width band (see
+        # compaction.MIN_BUCKET). The static shard program runs
+        # width-1 per-device blocks — below the MIN_BUCKET floor —
+        # so it only agrees to solver tolerance.
         T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 8, 1e-4)
         mesh = parallel.make_mesh()       # the 8-device virtual mesh
         report = {}
         t_x, ok_x, st_x = parallel.sharded_ignition_sweep(
             h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends, mesh=mesh,
             schedule="sorted", job_report=report)
+        t_1, ok_1, st_1 = parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+            mesh=parallel.make_mesh(1), schedule="sorted")
         t_s, ok_s, st_s = parallel.sharded_ignition_sweep(
             h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends, mesh=mesh,
             schedule="static")
-        assert report["schedule_compaction"] is False
-        assert np.array_equal(np.asarray(t_s), np.asarray(t_x),
+        assert report["schedule_compaction"] is True
+        assert np.array_equal(np.asarray(t_1), np.asarray(t_x),
                               equal_nan=True)
+        assert np.array_equal(np.asarray(ok_1), np.asarray(ok_x))
+        assert np.array_equal(np.asarray(st_1), np.asarray(st_x))
+        assert np.allclose(np.asarray(t_s), np.asarray(t_x),
+                           rtol=1e-5, equal_nan=True)
         assert np.array_equal(np.asarray(st_s), np.asarray(st_x))
+
+    def test_multi_device_mesh_compact_knob_off(self, h2o2, monkeypatch):
+        monkeypatch.setenv("PYCHEMKIN_MESH_COMPACT", "0")
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 8, 1e-4)
+        mesh = parallel.make_mesh()
+        report = {}
+        parallel.sharded_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends, mesh=mesh,
+            schedule="sorted", job_report=report)
+        assert report["schedule_compaction"] is False
+
+    @pytest.mark.slow
+    def test_mesh_rebin_keeps_fault_elem_identity(self, h2o2):
+        """Re-binning fidelity on the mesh: a shard-re-binned sweep
+        with an injected nan_rhs fault keeps the faulted element's
+        ORIGINAL caller id through the GLOBAL permutation (cohort sort
+        + cross-shard re-bins) and rescues identically to the
+        single-device compacted path."""
+        B = 72     # > one 8*n_dev-aligned rung, so the mesh must re-bin
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, B, 1e-3)
+        spec = FaultSpec(mode="nan_rhs", elements=(2,), heal_at=1)
+        kw = dict(rtol=1e-6, atol=1e-12, max_steps_per_segment=20_000)
+        outs = {}
+        rec = telemetry.get_recorder()
+        for name, mesh in (("multi", parallel.make_mesh()),
+                           ("single", parallel.make_mesh(1))):
+            rebins0 = rec.counters.get("schedule.mesh_rebins", 0)
+            with faultinject.inject(spec):
+                t_x, ok_x, st_x = parallel.sharded_ignition_sweep(
+                    h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+                    mesh=mesh, schedule="sorted", **kw)
+                # element 2, in CALLER order, is the one poisoned lane
+                # on both mesh layouts
+                assert int(st_x[2]) != 0
+                assert np.sum(np.asarray(st_x) != 0) == 1
+                times, ok, st, rep = rescue.resilient_ignition_sweep(
+                    h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+                    base_results={"times": np.array(t_x),
+                                  "ok": np.array(ok_x),
+                                  "status": np.array(st_x)}, **kw)
+            if name == "multi":
+                assert rec.counters.get("schedule.mesh_rebins",
+                                        0) > rebins0
+            assert rep.n_failed == 1 and rep.n_rescued == 1
+            outs[name] = (np.asarray(times), np.asarray(ok),
+                          np.asarray(st))
+        # identical rescue, identical caller-order results: the global
+        # permutation never leaked a wrong elem id into the fault mask
+        for a, b in zip(outs["multi"], outs["single"]):
+            assert np.array_equal(a, b, equal_nan=True)
 
     def test_rescue_ladder_interaction(self, h2o2):
         """A scheduled sweep with an injected failure feeds the SAME

@@ -637,13 +637,16 @@ class TestDeviceCounterBridge:
 #: the resilience counters added by ISSUE 3, the durability fields
 #: (driver-run sweeps) added by ISSUE 4, the Jacobian-mode /
 #: mechanism-sparsity fields added by ISSUE 6, and the ROP kernel
-#: mode (sparse/dense primal kinetics path) added by ISSUE 11
+#: mode (sparse/dense primal kinetics path) added by ISSUE 11, and
+#: the fused-kernel mode + mesh shape (fuse_mode / n_devices) added by
+#: ISSUE 16
 RUNG_SCHEMA_KEYS = (
     "platform", "n_chips", "mech", "B", "chunk", "compile_s", "run_s",
     "throughput", "rtol", "atol", "t_end", "n_ok", "n_ignited",
     "n_steps", "n_rejected", "n_newton", "steps_per_sec",
     "model_f32_gflop", "model_f64_gflop", "mfu_pct",
-    "jac_mode", "rop_mode", "schedule", "solve_profile",
+    "jac_mode", "rop_mode", "fuse_mode", "n_devices",
+    "schedule", "solve_profile",
     "calibration",
     "nu_nnz_frac", "n_species_active",
     "n_failed", "n_rescued", "n_abandoned", "status_counts",
@@ -653,7 +656,8 @@ RUNG_SCHEMA_KEYS = (
 #: rung keys that _build_summary must forward into configs_run
 CONFIGS_RUN_KEYS = (
     "mech", "B", "chunk", "throughput", "mfu_pct", "n_failed",
-    "jac_mode", "rop_mode", "schedule", "solve_profile",
+    "jac_mode", "rop_mode", "fuse_mode", "n_devices",
+    "schedule", "solve_profile",
     "nu_nnz_frac", "n_species_active",
     "n_rescued", "n_abandoned", "status_counts",
     "resume_count", "chunks_replayed", "driver_overhead_s",
@@ -684,6 +688,7 @@ def _fake_config_result(mech, B, platform="tpu", n_failed=0):
         "n_rejected": B, "n_newton": 400 * B, "steps_per_sec": 1e5,
         "model_f32_gflop": 1.0, "model_f64_gflop": 0.1, "mfu_pct": 1.5,
         "jac_mode": "analytic", "rop_mode": "dense",
+        "fuse_mode": "split", "n_devices": 4,
         "schedule": "static", "solve_profile": "off",
         "calibration": _fake_calibration(),
         "nu_nnz_frac": 0.32, "n_species_active": 10,

@@ -20,7 +20,14 @@ Three attempt models ride in the artifact:
   analytical Jacobian, full-matrix LU), formula-identical to the
   PR-6 artifact's ``attempt_model`` for cross-round comparability;
 - ``attempt_model_ad``     — the retired dense-AD build (the
-  ``f64_jac`` rescue rung).
+  ``f64_jac`` rescue rung);
+- ``attempt_model_fused``  — the ISSUE-16 fused-emission attempt: ONE
+  program returns ``(f, J)`` from a shared ROP evaluation
+  (``fj_fused_f64`` component), so the attempt's separate Jacobian
+  build and its first Newton RHS collapse into one evaluation. The
+  ``fused_vs_split`` block carries the headline pair comparison:
+  ``pair_split_s = t_jac_analytic + t_rhs`` vs ``pair_fused_s =
+  t_fj`` — what one (Jacobian, RHS) refresh costs on each path.
 
 Each model reports both the historical ``n_newton_assumed = 6`` split
 (cross-round comparable) and, when ``--measure-newton`` ran (default),
@@ -143,6 +150,13 @@ def run_ablation(mech_name: str, B: int, repeats: int,
             "CONP", "ENRG", jnp.float32(0.0), y, args32))(
             ys.astype(jnp.float32))
 
+    # the fused (f, J) emission (ISSUE 16): one program, one shared ROP
+    # evaluation — timed f64 only (auto never fuses under mixed
+    # precision, where the f32 Jacobian cast voids the sharing)
+    def fj_fused64(ys):
+        return jax.vmap(lambda y: jacobian._batch_jac_core(
+            "CONP", "ENRG", 0.0, y, args, with_rhs=True))(ys)
+
     def newton_matrix(J):
         return jnp.eye(N, dtype=J.dtype) - (h * _GAMMA) * J
 
@@ -202,6 +216,7 @@ def run_ablation(mech_name: str, B: int, repeats: int,
                 ("jac_f32", jax.jit(jac32)),
                 ("jac_analytic_f64", jax.jit(jac_analytic64)),
                 ("jac_analytic_f32", jax.jit(jac_analytic32)),
+                ("fj_fused_f64", jax.jit(fj_fused64)),
         ]:
             _run(name, fn, (ys,))
     # mechanism-specialized sparse-kernel components (ISSUE 11).
@@ -294,6 +309,44 @@ def run_ablation(mech_name: str, B: int, repeats: int,
                 100 * t_new_m / t_att_m, 2)
         return out
 
+    def fused_attempt_model(fj_key, lu_key, rhs_key, solve_key):
+        t_fj = components[fj_key]["run_s"]
+        t_lu = components[lu_key]["run_s"]
+        t_rhs = components[rhs_key]["run_s"]
+        t_solve = components[solve_key]["run_s"]
+
+        def split(n):
+            # the fused program returns the attempt's Jacobian AND its
+            # first Newton RHS in one evaluation; the remaining n-1
+            # RHS refreshes route through the same program with the J
+            # output dead-code-eliminated (~t_rhs each). Every Newton
+            # iteration still pays its solve.
+            t_newton = (n - 1) * t_rhs + n * t_solve
+            t_attempt = t_fj + t_lu + t_newton + t_solve
+            return t_attempt, t_newton
+
+        t_attempt, t_newton = split(n_newton)
+        out = {
+            "n_newton_assumed": n_newton,
+            "fj_component": fj_key,
+            "lu_component": lu_key,
+            "rhs_component": rhs_key,
+            "solve_component": solve_key,
+            "attempt_s": round(t_attempt, 6),
+            "fj_pct": round(100 * t_fj / t_attempt, 2),
+            "lu_pct": round(100 * t_lu / t_attempt, 2),
+            "newton_rhs_solve_pct": round(100 * t_newton / t_attempt, 2),
+            "err_filter_pct": round(100 * t_solve / t_attempt, 2),
+        }
+        if newton_measured is not None:
+            n_meas = newton_measured["n_newton_per_attempt"]
+            t_att_m, t_new_m = split(n_meas)
+            out["n_newton_measured"] = n_meas
+            out["attempt_s_measured"] = round(t_att_m, 6)
+            out["newton_rhs_solve_pct_measured"] = round(
+                100 * t_new_m / t_att_m, 2)
+        return out
+
     lu_key = "lu_nopivot_f32" if mixed else "lu_pivoted_f32"
     f32_flop, f64_flop = _flop_model(mech, n_steps=1, n_rejected=0,
                                      n_newton=n_newton)
@@ -342,10 +395,31 @@ def run_ablation(mech_name: str, B: int, repeats: int,
         "attempt_model_dense": attempt_model(
             "jac_analytic_f32" if mixed else "jac_analytic_f64",
             lu_key, "rhs_f64", "tri_solve_f32"),
+        # the ISSUE-16 fused attempt: one (f, J) program replaces the
+        # dense twin's separate Jacobian build + first Newton RHS
+        # (fused is an f64-only path — auto stays split under mixed
+        # precision — so the twin comparison is pinned to the f64
+        # dense components regardless of platform)
+        "attempt_model_fused": fused_attempt_model(
+            "fj_fused_f64", lu_key, "rhs_f64", "tri_solve_f32"),
         # the retired dense-AD attempt (f64_jac rescue rung)
         "attempt_model_ad": attempt_model(
             "jac_f32" if mixed else "jac_f64",
             lu_key, "rhs_f64", "tri_solve_f32"),
+        # the ISSUE-16 headline: what one (Jacobian, RHS) refresh costs
+        # split (two programs, ROP ladder paid twice) vs fused (one
+        # program, shared ROP evaluation)
+        "fused_vs_split": {
+            "pair_split_s": round(
+                components["jac_analytic_f64"]["run_s"]
+                + components["rhs_f64"]["run_s"], 6),
+            "pair_fused_s": round(
+                components["fj_fused_f64"]["run_s"], 6),
+            "pair_speedup": round(
+                (components["jac_analytic_f64"]["run_s"]
+                 + components["rhs_f64"]["run_s"])
+                / max(components["fj_fused_f64"]["run_s"], 1e-12), 3),
+        },
         "analytic_vs_ad": {
             "jac_speedup_f64": round(
                 components["jac_f64"]["run_s"]

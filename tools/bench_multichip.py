@@ -1,0 +1,277 @@
+"""Multi-device compaction bench: the MULTICHIP_rNN artifact producer.
+
+Rounds 1-5 banked ``MULTICHIP_r*.json`` as a dryrun transcript (rc +
+output tail of ``__graft_entry__.dryrun_multichip`` — a correctness
+smoke, no numbers). ISSUE 16 gives the family metrics: this tool runs
+the ignition-SCREENING sweep (the ``batch_efficiency`` mix: wide
+T0/phi/P straddling the ignition boundary, seed 0) on a FORCED
+N-device host mesh and times the cross-shard re-binned compaction
+path against the sort-only multi-device path it replaces:
+
+- **re-binned** — ``schedule="sorted"`` with ``PYCHEMKIN_MESH_COMPACT``
+  on (the default): every round runs shard_mapped across the mesh,
+  survivors re-bin globally into the halving ladder between rounds;
+- **sort-only** — the same sweep with ``PYCHEMKIN_MESH_COMPACT=0``:
+  cohort sorting but full width to the last straggler (the pre-ISSUE-16
+  multi-device behaviour);
+- **single-device compacted** — the caller-order fidelity oracle:
+  the same conditions through the same kernel on a 1-device mesh.
+
+Two hard claims ride in the artifact beside the timings. First, the
+re-binned results **match the single-device compacted sweep in caller
+order**: bitwise where XLA:CPU lowers the per-device and single-device
+program widths identically (h2o2 — property-tested in
+tests/test_schedule.py), and within 1e-9 relative with identical
+ok/status/finite patterns on GRI-scale mechanisms, whose per-lane math
+picks up ~1e-13 fusion rounding between widely differing program
+widths (the band the batch-efficiency rung documents). Lanes sitting
+exactly on the step-attempt budget boundary are excluded from the
+status comparison — a last-bit difference there legitimately flips
+``BUDGET_EXHAUSTED`` <-> ``OK`` (counted in ``n_boundary_lanes``).
+Second, the timed re-binned pass triggers **zero new XLA compiles**
+after per-rung warmup (every shard_mapped rung program's ``jax.jit``
+cache size is constant across the timed pass).
+
+The device count is forced BEFORE jax imports via
+``--xla_force_host_platform_device_count`` — run standalone::
+
+    python tools/bench_multichip.py --devices 8 --batch 256 \
+        --mech grisyn --out MULTICHIP_r06.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _force_devices(n: int) -> None:
+    """Pin the CPU backend and force ``n`` host devices. Must run
+    before jax is imported (XLA reads the flag at backend init)."""
+    assert "jax" not in sys.modules, \
+        "--devices must be applied before jax imports"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def run_bench(mech_name: str, B: int, n_devices: int, t_end,
+              max_steps: int) -> dict:
+    import jax
+    import numpy as np
+
+    from pychemkin_tpu import parallel, schedule, telemetry
+    from pychemkin_tpu.benchmarks import _PROTOCOL
+    from pychemkin_tpu.mechanism import load_embedded
+    from pychemkin_tpu.resilience.status import SolveStatus
+    from pychemkin_tpu.schedule import compaction
+    from pychemkin_tpu.surrogate.dataset import phi_composition
+    from pychemkin_tpu.utils import calibration
+
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", devices
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} devices, have {len(devices)}")
+    _, t_end_proto, rtol, atol = _PROTOCOL[mech_name]
+    t_end = float(t_end if t_end is not None else t_end_proto)
+    mech = load_embedded(mech_name)
+
+    # the batch_efficiency screening mix, verbatim (seed 0): wide
+    # temperature (cold lanes never ignite, marginal lanes grind),
+    # wide equivalence ratio, 1-2 atm
+    rng = np.random.default_rng(0)
+    T0s = rng.uniform(700.0, 1500.0, B)
+    phis = rng.uniform(0.5, 2.0, B)
+    P0s = 1.01325e6 * (1.0 + rng.uniform(0.0, 1.0, B))
+    Y0s = np.stack([phi_composition(mech, float(p))[0] for p in phis])
+
+    mesh_n = parallel.make_mesh(n_devices)
+    mesh_1 = parallel.make_mesh(1)
+    rec = telemetry.get_recorder()
+
+    def sweep(mesh, t_ends_arr, job_report=None):
+        return parallel.sharded_ignition_sweep(
+            mech, "CONP", "ENRG", T0s, P0s, Y0s, t_ends_arr,
+            mesh=mesh, rtol=rtol, atol=atol,
+            max_steps_per_segment=max_steps, schedule="sorted",
+            job_report=job_report)
+
+    unit = 8 * n_devices      # MIN_BUCKET lanes per shard
+    ladder = compaction.compaction_ladder(B, lane_multiple=unit)
+
+    def warm(mesh, lane_multiple):
+        # compile-only warmup: a vanishing-horizon sweep compiles the
+        # full-width programs, then each narrow ladder rung compiles
+        # from an explicit width-sized tiny sweep (narrow rungs never
+        # run at a tiny horizon — everything finishes in round 1)
+        sweep(mesh, np.full(B, 1e-7))
+        for w in compaction.compaction_ladder(
+                B, lane_multiple=lane_multiple):
+            sel = np.minimum(np.arange(w), B - 1)
+            schedule.compacted_ignition_sweep(
+                mech, "CONP", "ENRG", T0s[sel], P0s[sel], Y0s[sel],
+                np.full(w, 1e-7), ladder=(w,), rtol=rtol, atol=atol,
+                max_steps_per_segment=max_steps,
+                mesh=mesh if mesh.devices.size > 1 else None)
+
+    t_ends = np.full(B, t_end)
+
+    # --- pass 1: mesh, re-binned (the ISSUE-16 path) ----------------
+    assert os.environ.get("PYCHEMKIN_MESH_COMPACT", "1") != "0", \
+        "re-binned pass needs PYCHEMKIN_MESH_COMPACT on"
+    warm(mesh_n, unit)
+    # the zero-new-compiles claim: every shard_mapped rung program's
+    # jit cache is frozen by warmup — the timed pass adds nothing
+    progs = [p for ps in compaction._MESH_PROGRAM_CACHE.values()
+             for p in ps]
+    sizes_before = [p._cache_size() for p in progs]
+    rebins0 = rec.snapshot(write=False)["counters"].get(
+        "schedule.mesh_rebins", 0)
+    jr_rebin: dict = {}
+    t0 = time.time()
+    t_r, ok_r, st_r = sweep(mesh_n, t_ends, job_report=jr_rebin)
+    wall_rebin = time.time() - t0
+    sizes_after = [p._cache_size() for p in progs]
+    mesh_rebins = rec.snapshot(write=False)["counters"].get(
+        "schedule.mesh_rebins", 0) - rebins0
+    zero_new_compiles = sizes_before == sizes_after
+    assert jr_rebin.get("schedule_compaction") is True, jr_rebin
+    print(f"# rebin: {wall_rebin:.1f}s ({wall_rebin/B*1e3:.0f} "
+          f"ms/elem), {mesh_rebins} re-bins, compiles "
+          f"{'frozen' if zero_new_compiles else 'GREW'}",
+          file=sys.stderr)
+
+    # --- pass 2: mesh, sort-only (the pre-ISSUE-16 behaviour) -------
+    os.environ["PYCHEMKIN_MESH_COMPACT"] = "0"
+    try:
+        jr_sort: dict = {}
+        sweep(mesh_n, np.full(B, 1e-7))            # warm shard program
+        t0 = time.time()
+        t_s, ok_s, st_s = sweep(mesh_n, t_ends, job_report=jr_sort)
+        wall_sort = time.time() - t0
+    finally:
+        del os.environ["PYCHEMKIN_MESH_COMPACT"]
+    assert jr_sort.get("schedule_compaction") is not True, jr_sort
+    print(f"# sort-only: {wall_sort:.1f}s ({wall_sort/B*1e3:.0f} "
+          f"ms/elem)", file=sys.stderr)
+
+    # --- pass 3: single-device compacted (the bit-identity oracle) --
+    warm(mesh_1, 8)
+    t0 = time.time()
+    t_1, ok_1, st_1 = sweep(mesh_1, t_ends)
+    wall_single = time.time() - t0
+    print(f"# single-device: {wall_single:.1f}s", file=sys.stderr)
+
+    t_r, ok_r, st_r, t_s, ok_s, st_s, t_1, ok_1, st_1 = map(
+        np.asarray, (t_r, ok_r, st_r, t_s, ok_s, st_s, t_1, ok_1,
+                     st_1))
+    bit_vs_single = bool(
+        np.array_equal(t_r, t_1, equal_nan=True)
+        and np.array_equal(ok_r, ok_1) and np.array_equal(st_r, st_1))
+    # the honest mesh-vs-single contract (see module docstring):
+    # bitwise only where per-device and single-device program widths
+    # lower identically; otherwise identical ok/status/finite
+    # patterns off the budget boundary plus a tight deviation bound.
+    bud = int(SolveStatus.BUDGET_EXHAUSTED)
+    boundary = (st_r == bud) | (st_1 == bud)
+    core = ~boundary
+    both_1 = np.isfinite(t_r) & np.isfinite(t_1) & core
+    rel_dev_single = (float(np.max(np.abs(t_r[both_1] - t_1[both_1])
+                                   / np.abs(t_1[both_1])))
+                      if both_1.any() else 0.0)
+    match_vs_single = bool(
+        np.array_equal(ok_r[core], ok_1[core])
+        and np.array_equal(st_r[core], st_1[core])
+        and np.array_equal(np.isfinite(t_r[core]),
+                           np.isfinite(t_1[core]))
+        and rel_dev_single < 1e-9)
+    # vs the legacy shard program: same two-programs caveat as the
+    # batch_efficiency rung (per-device blocks can run below the
+    # 8-lane width-invariance floor) — record status agreement and
+    # the measured deviation, never claim bitwise
+    status_match_sort = bool(np.array_equal(ok_r, ok_s)
+                             and np.array_equal(st_r, st_s))
+    both = np.isfinite(t_r) & np.isfinite(t_s)
+    rel_dev_sort = (float(np.max(np.abs(t_r[both] - t_s[both])
+                                 / np.abs(t_r[both])))
+                    if both.any() else 0.0)
+
+    return {
+        "tool": "bench_multichip",
+        "platform": devices[0].platform,
+        "forced_host_devices": True,
+        "n_devices": n_devices,
+        "mech": mech_name,
+        "B": B,
+        "seed": 0,
+        "T_range": [700.0, 1500.0],
+        "phi_range": [0.5, 2.0],
+        "P_atm_range": [1.0, 2.0],
+        "t_end": t_end,
+        "rtol": rtol,
+        "atol": atol,
+        "max_steps": max_steps,
+        "ladder": [int(w) for w in ladder],
+        "round_len": compaction._round_len(),
+        "calibration": calibration.probe(),
+        "rebin_wall_s": round(wall_rebin, 3),
+        "sort_only_wall_s": round(wall_sort, 3),
+        "single_device_wall_s": round(wall_single, 3),
+        "rebin_ms_per_elem": round(wall_rebin / B * 1e3, 3),
+        "sort_only_ms_per_elem": round(wall_sort / B * 1e3, 3),
+        "rebin_speedup": round(wall_sort / wall_rebin, 3),
+        "mesh_rebins": int(mesh_rebins),
+        "zero_new_compiles": zero_new_compiles,
+        "jit_cache_entries": sum(sizes_after),
+        "bit_identical_vs_single_device": bit_vs_single,
+        "match_vs_single_device": match_vs_single,
+        "times_max_rel_dev_vs_single_device": float(
+            f"{rel_dev_single:.3g}"),
+        "n_boundary_lanes": int(boundary.sum()),
+        "n_status_mismatch_vs_single": int(
+            np.sum(st_r != st_1)),
+        "status_match_vs_sort_only": status_match_sort,
+        "times_max_rel_dev_vs_sort_only": float(
+            f"{rel_dev_sort:.3g}"),
+        "n_ok": int(ok_r.sum()),
+        "n_budget_capped": int(np.sum(
+            st_r == int(SolveStatus.BUDGET_EXHAUSTED))),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mech", default="grisyn",
+                   choices=["h2o2", "grisyn", "gri30"])
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--t-end", type=float, default=None,
+                   help="horizon (default: the mech's bench protocol)")
+    p.add_argument("--max-steps", type=int, default=10_000,
+                   help="per-element step-attempt budget (the "
+                        "batch_efficiency cap for super-marginal "
+                        "lanes)")
+    p.add_argument("--out", default="MULTICHIP_r06.json")
+    args = p.parse_args(argv)
+
+    _force_devices(args.devices)
+    out = run_bench(args.mech, args.batch, args.devices, args.t_end,
+                    args.max_steps)
+    from pychemkin_tpu import telemetry
+    telemetry.atomic_write_json(args.out, out)
+    print(json.dumps(out))
+    ok = (out["match_vs_single_device"]
+          and out["zero_new_compiles"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,10 @@
 
 Every round has banked perf artifacts (``BENCH_r*.json`` bench
 summaries, ``STEP_COST_*.json`` step-cost ablations,
-``BATCH_EFF_*.json`` batch-efficiency rungs), and every round's notes
+``BATCH_EFF_*.json`` batch-efficiency rungs, ``MULTICHIP_*.json``
+multi-device compaction benches — rounds with the pre-ISSUE-16
+dryrun-transcript shape carry no metrics and are skipped), and every
+round's notes
 carry the same caveat: the container speed drifted, so raw numbers
 from different captures do not compare. This tool turns those
 artifacts into ONE normalized time series and gives CI the missing
@@ -27,7 +30,9 @@ cross-PR regression gate:
   names the metric, the baseline artifact, and both values. When both
   sides carry a calibration block the comparison is between
   NORMALIZED values (container drift divided out); otherwise it falls
-  back to raw values and says so.
+  back to raw values and says so. A ledger entry whose backing
+  artifact file is missing from ``--root`` fails the check outright
+  (rc 1, naming the files) — an unauditable baseline gates nothing.
 
 Usage::
 
@@ -64,6 +69,9 @@ METRIC_DIRECTIONS: Dict[str, str] = {
     "static_ms_per_elem_top": "lower",
     "sched_ms_per_elem_top": "lower",
     "speedup_top": "higher",
+    "rebin_ms_per_elem": "lower",
+    "sort_only_ms_per_elem": "lower",
+    "rebin_speedup": "higher",
 }
 
 
@@ -162,7 +170,27 @@ def _batch_eff(doc: Dict) -> Optional[Dict]:
             "calibration": doc.get("calibration")}
 
 
-_EXTRACTORS = (_bench_summary, _step_cost, _batch_eff)
+def _multichip(doc: Dict) -> Optional[Dict]:
+    """The ``tools/bench_multichip.py`` artifact (``MULTICHIP_r06``
+    on). Rounds 1-5 banked the family as a dryrun transcript
+    (rc + output tail, no numbers) — those files extract to None and
+    are skipped, by design."""
+    if doc.get("tool") != "bench_multichip":
+        return None
+    metrics: Dict[str, float] = {}
+    for name in ("rebin_ms_per_elem", "sort_only_ms_per_elem",
+                 "rebin_speedup"):
+        if doc.get(name) is not None:
+            metrics[name] = float(doc[name])
+    if not metrics:
+        return None
+    return {"kind": "multichip", "platform": doc.get("platform"),
+            "mech": doc.get("mech"), "B": doc.get("B"),
+            "metrics": metrics,
+            "calibration": doc.get("calibration")}
+
+
+_EXTRACTORS = (_bench_summary, _step_cost, _batch_eff, _multichip)
 
 
 def extract(path: str) -> Optional[Dict]:
@@ -214,7 +242,8 @@ def discover(root: str) -> List[str]:
         if name.endswith(".json") and (
                 name.startswith("BENCH_")
                 or name.startswith("STEP_COST_")
-                or name.startswith("BATCH_EFF_")):
+                or name.startswith("BATCH_EFF_")
+                or name.startswith("MULTICHIP_")):
             out.append(os.path.join(root, name))
     return out
 
@@ -311,6 +340,18 @@ def check(ledger: Dict, capture_path: str, band: float) -> Tuple[int,
     return (1 if verdict["regressions"] else 0), verdict
 
 
+def missing_artifacts(ledger: Dict, root: str) -> List[str]:
+    """Ledger entries whose backing artifact file is gone from
+    ``root``. A ledger row without its artifact is an unauditable
+    baseline — --check refuses to gate against such a ledger."""
+    missing = []
+    for e in ledger.get("entries", []):
+        name = e.get("artifact")
+        if name and not os.path.exists(os.path.join(root, name)):
+            missing.append(name)
+    return missing
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--root", default=_REPO,
@@ -345,6 +386,14 @@ def main(argv=None) -> int:
                  else discover(args.root))
         ledger = build_ledger(paths)
     if args.check:
+        gone = missing_artifacts(ledger, args.root)
+        if gone:
+            print(json.dumps({"error": "ledger entries reference "
+                              "missing artifact files",
+                              "missing": gone}))
+            print("# perf_ledger: MISSING ARTIFACTS: "
+                  + ", ".join(gone), file=sys.stderr)
+            return 1
         rc, verdict = check(ledger, args.check, args.band)
         print(json.dumps(verdict))
         if rc == 1:
