@@ -34,6 +34,13 @@ re-read per poll):
   the cap for K polls: the ROADMAP #3 scale-up signal.
 - ``DEADLINE_PRESSURE`` (warn)  — deadline-expired fraction of the
   windowed request stream above threshold.
+- ``COMPILE_STORM`` (warn)      — new program compiles while traffic
+  is flowing: after warmup the compile counters must be flat, so any
+  windowed growth means a knob flip / ladder escape / cache miss is
+  paying trace+build wall on the serving path. The guard the
+  autoscaler's add/respawn path consumes — a respawned backend whose
+  warmup missed the persistent XLA cache shows up here, not as a
+  mystery p99 cliff.
 """
 
 from __future__ import annotations
@@ -49,6 +56,7 @@ from .timeseries import SnapshotRing, WindowView
 #: ``telemetry.schema.HEALTH_SIGNALS``, mirroring SCHEDULE_COUNTERS
 SIGNAL_NAMES = (
     "BACKEND_DOWN",
+    "COMPILE_STORM",
     "ERROR_BUDGET_BURN",
     "SURROGATE_RETRAIN",
     "PREDICTOR_DECALIBRATED",
@@ -212,6 +220,28 @@ def _eval_fraction_above(rule: Dict[str, Any], ring: SnapshotRing
                   "den": n_den, "threshold": threshold}
 
 
+def _eval_counter_delta_above(rule: Dict[str, Any], ring: SnapshotRing
+                              ) -> Tuple[bool, Dict[str, Any]]:
+    """Windowed growth of a counter family WHILE traffic flows — the
+    post-warmup-recompile guard. The traffic gate encodes "after
+    warmup": warmup compiles happen before the backend takes requests,
+    so compile-counter growth in a window that also served traffic is
+    a storm, never the expected cold start."""
+    view = ring.window(_window_s(rule))
+    if view is None:
+        return False, {}
+    counters = tuple(rule.get("counters", ("program.compiles",)))
+    threshold = float(rule.get("threshold", 0.0))
+    traffic = rule.get("traffic_counter", "serve.requests")
+    min_traffic = int(rule.get("min_traffic", 1))
+    delta = sum(view.delta(c) for c in counters)
+    n_traffic = view.delta(traffic)
+    cond = delta > threshold and n_traffic >= min_traffic
+    return cond, {"delta": delta, "threshold": threshold,
+                  "traffic": n_traffic, "min_traffic": min_traffic,
+                  "counters": list(counters)}
+
+
 #: evaluator registry: rule["kind"] -> evaluator. Operator rule dicts
 #: compose these kinds with their own counters/thresholds — adding a
 #: rule needs no code unless it needs a genuinely new SHAPE of check.
@@ -223,6 +253,7 @@ EVALUATORS: Dict[str, Callable[[Dict[str, Any], SnapshotRing],
     "gauge_below": _eval_gauge_below,
     "occupancy_saturated": _eval_occupancy_saturated,
     "fraction_above": _eval_fraction_above,
+    "counter_delta_above": _eval_counter_delta_above,
 }
 
 #: the shipped rule set — pure dicts; thresholds default to the
@@ -243,6 +274,11 @@ DEFAULT_RULES = (
      "kind": "occupancy_saturated"},
     {"name": "DEADLINE_PRESSURE", "severity": "warn",
      "kind": "fraction_above"},
+    # any compile under traffic is already wrong (threshold 0), and a
+    # knob flip recompiles ONE program per affected shape — so fire on
+    # the first bad poll, no hysteresis slack
+    {"name": "COMPILE_STORM", "severity": "warn",
+     "kind": "counter_delta_above", "fire_for": 1},
 )
 
 #: sparkline glyphs for the per-signal recent window (ok / firing)

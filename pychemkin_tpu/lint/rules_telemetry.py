@@ -26,6 +26,12 @@ tree:
   literal in ``pychemkin_tpu/health/signals.py`` must appear in the
   schema's ``HEALTH_SIGNALS``: a typo'd operator-signal name fails
   chemlint, not a dashboard or a page at 3 am.
+- ``telemetry-program-counters`` — the schema's ``PROGRAM_COUNTERS``
+  tuple must be derivable from the schema's own counter sets (the
+  observatory reads the same names it emits), and the serving path's
+  ``serve.dispatch`` span must carry the ``PROGRAM_SPAN_FIELD``
+  keyword — drop it and per-program wall attribution silently loses
+  the dispatch stream.
 
 The schema module holds only literal tuples, so everything here is
 AST-extraction — no imports of instrumented modules.
@@ -42,6 +48,7 @@ from .engine import (LintContext, ModuleInfo, Violation, call_name,
 SCHEMA_RELPATH = "pychemkin_tpu/telemetry/schema.py"
 SCHEDULE_RELPATH = "pychemkin_tpu/schedule/__init__.py"
 HEALTH_SIGNALS_RELPATH = "pychemkin_tpu/health/signals.py"
+SERVER_RELPATH = "pychemkin_tpu/serve/server.py"
 
 #: method/function name -> (schema category, name-argument index)
 EMIT_SITES: Dict[str, Tuple[str, int]] = {
@@ -299,3 +306,66 @@ def check_health_signals(ctx: LintContext) -> Iterable[Violation]:
                 f"schema's HEALTH_SIGNALS ({SCHEMA_RELPATH}) — a "
                 "typo'd signal silently forks the alert series; add "
                 "it to the schema or fix the name")
+
+
+def _extract_str_assigns(mod: ModuleInfo) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` string assignments."""
+    out: Dict[str, str] = {}
+    if mod.tree is None:
+        return out
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = node.value.value
+    return out
+
+
+@rule("telemetry-program-counters",
+      "schema.PROGRAM_COUNTERS must be derivable from the schema's "
+      "counters, and the serve.dispatch span must carry the "
+      "PROGRAM_SPAN_FIELD keyword", full_only=True)
+def check_program_counters(ctx: LintContext) -> Iterable[Violation]:
+    schema = load_schema(ctx)
+    schema_mod = ctx.parse_repo_file(SCHEMA_RELPATH)
+    if schema is None or schema_mod is None or schema_mod.tree is None:
+        return
+    sets_ = _extract_sets(schema_mod)
+    counters = schema["counters"]["exact"]
+    prefixes = schema["counters"]["prefixes"]
+    for name in sorted(sets_.get("PROGRAM_COUNTERS", ())):
+        if name in counters or any(name.startswith(p)
+                                   for p in prefixes):
+            continue
+        yield Violation(
+            "telemetry-program-counters", SCHEMA_RELPATH, 1,
+            f"PROGRAM_COUNTERS entry {name!r} is not derivable from "
+            f"the schema's own counter sets in {SCHEMA_RELPATH}")
+    span_field = _extract_str_assigns(schema_mod).get(
+        "PROGRAM_SPAN_FIELD")
+    if span_field is None:
+        yield Violation(
+            "telemetry-program-counters", SCHEMA_RELPATH, 1,
+            "PROGRAM_SPAN_FIELD string is missing from the canonical "
+            f"schema {SCHEMA_RELPATH}")
+        return
+    server = ctx.parse_repo_file(SERVER_RELPATH)
+    if server is None or server.tree is None:
+        return
+    for node, cname, (cat, idx) in _iter_emit_calls(server):
+        if cat != "spans":
+            continue
+        names = [n for n, _ in _literal_names(node, idx, server)]
+        if "serve.dispatch" not in names:
+            continue
+        if any(kw.arg == span_field for kw in node.keywords):
+            continue
+        yield Violation(
+            "telemetry-program-counters", SERVER_RELPATH, node.lineno,
+            f"serve.dispatch span is missing the {span_field!r} "
+            "keyword — per-program wall attribution silently loses "
+            "the dispatch stream")

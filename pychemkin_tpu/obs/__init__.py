@@ -1,0 +1,34 @@
+"""Program observatory: per-compiled-program cost attribution.
+
+Every jit entry point the repo dispatches (serve engine buckets,
+compaction ladder rungs, surrogate predict) registers a stable
+``program_id`` — a hash of (mechanism signature, kind, shape, resolved
+knob config) — and banks its dispatches into the existing telemetry
+surfaces:
+
+- ``program.compiles`` / ``program.compiles.<id>`` counters (compile
+  events, classified persistent-XLA-cache warm vs cold when the jax
+  monitoring hook is available);
+- ``program.wall_ms.<id>`` histograms (per-dispatch wall, mergeable
+  fleet-wide by histogram-state summation);
+- a per-process registry (:func:`get_registry`) carrying the program
+  metadata, model-FLOP totals from the analytic cost model
+  (:mod:`pychemkin_tpu.mechanism.costmodel`), and first-compile wall.
+
+``chemtop`` merges the per-backend ``programs`` metrics blocks into a
+fleet panel reporting wall share, achieved GFLOP/s, and ``mfu_pct``
+against the calibrated GEMM roof; the health engine's
+``COMPILE_STORM`` signal and ``run_suite --compile-audit`` consume the
+compile counters as the "zero new compiles after warmup" guard.
+"""
+
+from __future__ import annotations
+
+from .programs import (ProgramRegistry, cache_hits, cache_listener_available,
+                       get_registry, mech_signature, program_id,
+                       reset_registry)
+
+__all__ = [
+    "ProgramRegistry", "cache_hits", "cache_listener_available",
+    "get_registry", "mech_signature", "program_id", "reset_registry",
+]

@@ -1,0 +1,257 @@
+"""Compiled-program registry: identity, compile events, dispatch cost.
+
+A *program* is one compiled XLA executable the repo can dispatch: a
+serve engine's jitted batch fn at one bucket shape, one compaction
+ladder rung of an ignition sweep kernel, a surrogate ensemble predict.
+Its identity — :func:`program_id` — hashes everything that keys the
+jit cache entry (mechanism signature, kind, shape, resolved knob
+config) and nothing about the process that compiled it, so the same
+logical program gets the same id across respawns and across the fleet
+(the join key chemtop merges on).
+
+The registry is deliberately dumb: pure-python bookkeeping plus
+counter/histogram emission through the normal recorder, so everything
+downstream (fleet merge, windowed health deltas, the compile-audit
+gate) rides machinery that already exists. Wall time lives in
+``program.wall_ms.<id>`` histograms — their states sum EXACTLY under
+fleet merge, so per-program wall shares are computed from summed
+states, never averaged percentages. Model FLOPs accumulate in the
+registry and ship in the ``programs`` metrics block.
+
+This module must stay importable without jax (chemtop-side tests
+import it for :func:`program_id`); the persistent-compile-cache
+listener imports jax lazily and degrades to "unknown" classification
+when the internal monitoring hook is absent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .. import telemetry
+
+#: length of the truncated sha256 hex id — 48 bits, far beyond any
+#: plausible fleet's distinct-program count
+_ID_LEN = 12
+
+
+def program_id(mech_sig: str, kind: str, shape: Tuple[int, ...],
+               config: Dict[str, Any]) -> str:
+    """Stable identity of one compiled program: sha256 over a canonical
+    JSON encoding of (mechanism signature, kind, shape, sorted resolved
+    config), truncated to 12 hex chars. Pure function of its arguments
+    — stable across process respawn by construction, different under
+    any knob/mech/shape perturbation because those ARE the payload."""
+    payload = json.dumps(
+        {"mech": str(mech_sig), "kind": str(kind),
+         "shape": [int(s) for s in shape],
+         "config": {str(k): config[k] for k in sorted(config)}},
+        sort_keys=True, separators=(",", ":"), default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:_ID_LEN]
+
+
+# -- persistent compile-cache classification --------------------------------
+
+#: monotone count of persistent-XLA-cache hit events observed by the
+#: (lazily installed) jax monitoring listener; ``available`` stays
+#: False when the internal hook is missing (classification "unknown")
+_CACHE_EVENTS = {"n": 0, "installed": False, "available": False}
+_CACHE_LOCK = threading.Lock()
+
+
+def _install_cache_listener() -> None:
+    with _CACHE_LOCK:
+        if _CACHE_EVENTS["installed"]:
+            return
+        _CACHE_EVENTS["installed"] = True
+        try:
+            # jax 0.4.x internal hook: every persistent-compilation-
+            # cache hit records a '/jax/compilation_cache/cache_hits'
+            # event through jax._src.monitoring. Internal API —
+            # any import/signature drift degrades to "unknown".
+            from jax._src import monitoring
+
+            def _on_event(event: str, **kw: Any) -> None:
+                if "cache_hit" in event:
+                    with _CACHE_LOCK:
+                        _CACHE_EVENTS["n"] += 1
+
+            monitoring.register_event_listener(_on_event)
+            _CACHE_EVENTS["available"] = True
+        except Exception:
+            _CACHE_EVENTS["available"] = False
+
+
+def cache_hits() -> int:
+    """Persistent-cache hit events seen so far (installs the listener
+    on first call); -1 when the monitoring hook is unavailable. Sample
+    before/after a compiling dispatch and pass the delta to
+    :meth:`ProgramRegistry.record_dispatch` to classify warm vs cold."""
+    _install_cache_listener()
+    with _CACHE_LOCK:
+        return _CACHE_EVENTS["n"] if _CACHE_EVENTS["available"] else -1
+
+
+def cache_listener_available() -> bool:
+    _install_cache_listener()
+    return bool(_CACHE_EVENTS["available"])
+
+
+# -- mechanism signature memo -----------------------------------------------
+
+#: id(record) -> signature memo; the staged kernel's sig is preferred
+#: (already computed at parse time), else one checkpoint.signature
+#: pass per distinct record object
+_SIG_MEMO: Dict[int, str] = {}
+_SIG_LOCK = threading.Lock()
+
+
+def mech_signature(mech) -> str:
+    """The record's mechanism signature for program identity: the
+    staged kernel's parse-time sig when present, else computed once
+    per record object (memoized by ``id`` — records are immutable in
+    practice and the memo is advisory identity, not correctness)."""
+    stage = getattr(mech, "rop_stage", None)
+    if stage is not None and getattr(stage, "sig", None):
+        return str(stage.sig)
+    key = id(mech)
+    with _SIG_LOCK:
+        sig = _SIG_MEMO.get(key)
+    if sig is None:
+        from ..mechanism.staging import mechanism_signature
+        sig = mechanism_signature(mech)
+        with _SIG_LOCK:
+            _SIG_MEMO[key] = sig
+    return sig
+
+
+# -- the registry -----------------------------------------------------------
+
+class ProgramRegistry:
+    """Per-process program bookkeeping, thread-safe under one lock.
+
+    ``register`` is idempotent; ``record_dispatch`` banks one dispatch
+    of a registered program: compile events increment the
+    ``program.compiles`` counters (global = sum of the per-id family)
+    and store first-compile wall + warm/cold classification; every
+    accounted dispatch observes its wall into the program's
+    ``program.wall_ms.<id>`` histogram and accumulates model GFLOPs.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._programs: Dict[str, Dict[str, Any]] = {}
+
+    def register(self, pid: str, *, kind: str, mech_sig: str,
+                 shape: Tuple[int, ...], config: Dict[str, Any]) -> str:
+        with self._lock:
+            if pid not in self._programs:
+                self._programs[pid] = {
+                    "kind": str(kind),
+                    "mech_sig": str(mech_sig)[:12],
+                    "shape": [int(s) for s in shape],
+                    "config": {str(k): _jsonable(v)
+                               for k, v in sorted(config.items())},
+                    "compiles": 0,
+                    "dispatches": 0,
+                    "model_gflop_sum": 0.0,
+                    "first_compile_ms": None,
+                    "cache_source": None,
+                }
+        return pid
+
+    def dispatches(self, pid: str) -> int:
+        with self._lock:
+            p = self._programs.get(pid)
+            return int(p["dispatches"]) if p else 0
+
+    def record_dispatch(self, pid: str, wall_ms: float, *,
+                        model_gflop: Optional[float] = None,
+                        compiled: bool = False,
+                        cache_hits_delta: Optional[int] = None,
+                        recorder=None,
+                        accounted: bool = True) -> None:
+        """Bank one dispatch. ``compiled`` dispatches count into the
+        compile counters and store first-compile wall / warm-vs-cold
+        (``cache_hits_delta`` > 0 means the executable came from the
+        persistent cache — a warm compile; 0 means a real trace+build;
+        None/negative means unclassifiable). ``accounted=False``
+        (warmup) skips the wall histogram and model-FLOP accumulation
+        so warm-up dummies never pollute the cost attribution, while
+        compile events still land — warmup compiles ARE the expected
+        cold/warm population the audit baselines against."""
+        rec = recorder if recorder is not None else telemetry.get_recorder()
+        with self._lock:
+            p = self._programs.get(pid)
+            if p is None:    # defensive: dispatch before register
+                return
+            if compiled:
+                p["compiles"] += 1
+                if p["first_compile_ms"] is None:
+                    p["first_compile_ms"] = round(float(wall_ms), 3)
+                    if cache_hits_delta is None or cache_hits_delta < 0:
+                        p["cache_source"] = "unknown"
+                    elif cache_hits_delta > 0:
+                        p["cache_source"] = "warm"
+                    else:
+                        p["cache_source"] = "cold"
+            if accounted:
+                p["dispatches"] += 1
+                if model_gflop is not None:
+                    p["model_gflop_sum"] += float(model_gflop)
+        if compiled:
+            rec.inc("program.compiles")
+            rec.inc(f"program.compiles.{pid}")
+        if accounted:
+            rec.observe(f"program.wall_ms.{pid}", float(wall_ms))
+
+    def add_model_gflop(self, pid: str, gflop: float) -> None:
+        """Late model-FLOP attribution (a sweep splits its total across
+        the rungs it actually ran, proportional to rung wall)."""
+        with self._lock:
+            p = self._programs.get(pid)
+            if p is not None:
+                p["model_gflop_sum"] += float(gflop)
+
+    def programs_state(self) -> Dict[str, Any]:
+        """JSON-ready registry state for the metrics reply: per-id
+        metadata + compile/dispatch/model-FLOP tallies (wall ships
+        separately as ``program.wall_ms.<id>`` histogram states)."""
+        with self._lock:
+            by_id = {pid: dict(p) for pid, p in self._programs.items()}
+        return {"by_id": by_id,
+                "cache_listener": bool(_CACHE_EVENTS["available"])}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs.clear()
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    return str(v)
+
+
+_REGISTRY: Optional[ProgramRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def get_registry() -> ProgramRegistry:
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = ProgramRegistry()
+        return _REGISTRY
+
+
+def reset_registry() -> None:
+    """Fresh registry (tests; a forked backend startup)."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        _REGISTRY = ProgramRegistry()

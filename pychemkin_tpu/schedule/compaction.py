@@ -29,6 +29,7 @@ relative on GRI-scale mechanisms across widely differing widths
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -36,7 +37,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import knobs, telemetry
-from ..ops import reactors
+from ..mechanism import costmodel
+from ..obs import programs as obs_programs
+from ..ops import kinetics, reactors
 from ..ops.odeint import solve_profile_enabled
 from ..resilience import faultinject
 from ..resilience.driver import edge_pad_indices
@@ -267,6 +270,58 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
         out["dt_final"] = np.full(B, np.nan)
         out["stiffness"] = np.full(B, np.nan)
 
+    # -- program observatory: one registered program per ladder rung.
+    # The rung's resolved config binds the trace-time knobs its jit
+    # programs resolve; its id is stable across sweeps/respawns, so
+    # rung wall and model FLOPs aggregate per compiled shape.
+    registry = obs_programs.get_registry()
+    mech_sig = obs_programs.mech_signature(mech)
+    staged = getattr(mech, "rop_stage", None) is not None
+    rop_mode = ("sparse" if (staged
+                             and kinetics.resolve_rop_mode() == "sparse")
+                else "dense")
+    fused = jac_mode == "analytic" and kinetics.fused_enabled(mech)
+    sweep_cfg = {
+        "rop_mode": rop_mode,
+        "fuse_mode": "fused" if fused else "split",
+        "jac_mode": jac_mode, "profile": prof,
+        "rtol": rtol, "atol": atol,
+        "max_steps": int(max_steps_per_segment),
+        "round_len": rl, "fault_level": int(fault_level),
+        "n_devices": n_dev,
+        "schedule": knobs.value("PYCHEMKIN_SCHEDULE"),
+    }
+    _rung_pids: Dict[int, str] = {}
+
+    def _rung_pid(w: int) -> str:
+        pid = _rung_pids.get(w)
+        if pid is None:
+            pid = obs_programs.program_id(mech_sig, "sweep.ignition",
+                                          (w,), sweep_cfg)
+            registry.register(pid, kind="sweep.ignition",
+                              mech_sig=mech_sig, shape=(w,),
+                              config=sweep_cfg)
+            _rung_pids[w] = pid
+        return pid
+
+    def _bank_round(w: int, wall_ms: float, d_attempts: float,
+                    d_newtons: float, hits_before: int,
+                    compiled: bool) -> None:
+        # model FLOPs of this round's REAL work: the cumulative-counter
+        # deltas over the current batch (padding lanes included — edge
+        # duplicates burn real hardware FLOPs)
+        gflop = costmodel.integration_flops(
+            mech, d_attempts, d_newtons, rop_mode=rop_mode,
+            jac_mode=jac_mode if jac_mode in ("analytic", "ad")
+            else "analytic", fused=fused) / 1e9
+        hits_delta = (obs_programs.cache_hits() - hits_before
+                      if compiled and hits_before >= 0 else None)
+        registry.record_dispatch(
+            _rung_pid(w), wall_ms, model_gflop=gflop,
+            compiled=compiled, cache_hits_delta=hits_delta,
+            recorder=rec)
+        rec.observe("sweep.solve_ms", wall_ms)
+
     def _gather(arrs, idx):
         return [jax.tree_util.tree_map(lambda a: a[idx], c)
                 for c in arrs]
@@ -279,6 +334,12 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
               _gather([T0s, P0s, Y0s, t_ends, elem_ids], pad)]
     if place is not None:
         inputs = [place(a) for a in inputs]
+    # the first round's wall includes init (its compile is part of the
+    # top rung's first-dispatch cost); cumulative-counter baselines
+    # start at zero for the freshly padded batch
+    prev = {k: np.zeros(width, np.int64)
+            for k in ("n_steps", "n_rejected", "n_newton")}
+    round_t0 = time.perf_counter()
     state = init_p(*inputs)
 
     n_compactions = 0
@@ -289,10 +350,22 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
     max_rounds = -(-int(max_steps_per_segment) * 2 // max(rl, 1)) + 8
     harvested = np.zeros(B, bool)
     while True:
+        compiled = registry.dispatches(_rung_pid(width)) == 0
+        hits_before = obs_programs.cache_hits() if compiled else -1
         state = advance_p(state, *inputs)
         h = {k: np.asarray(v) for k, v in
              harvest_p(state, *inputs).items()}
+        # np.asarray above forces the host transfer, so this wall is
+        # device-fenced — one round = one dispatch of the rung program
+        wall_ms = (time.perf_counter() - round_t0) * 1e3
         rounds += 1
+        d_attempts = float((h["n_steps"] - prev["n_steps"]).sum()
+                           + (h["n_rejected"]
+                              - prev["n_rejected"]).sum())
+        d_newtons = float((h["n_newton"] - prev["n_newton"]).sum())
+        prev = {k: h[k] for k in prev}
+        _bank_round(width, wall_ms, d_attempts, d_newtons,
+                    hits_before, compiled)
         done = h["done"]
         new = done & ~harvested[gidx]
         if new.any():
@@ -333,9 +406,11 @@ def compacted_ignition_sweep(mech, problem, energy, T0s, P0s, Y0s,
                 inputs = [place(a) for a in inputs]
                 rec.inc("schedule.mesh_rebins")
             gidx = gidx[pad]
+            prev = {k: prev[k][pad] for k in prev}
             width = bucket
             n_compactions += 1
             rec.inc("schedule.compactions")
+        round_t0 = time.perf_counter()
     rec.event("schedule.compaction", label=label, B=B,
               rounds=rounds, n_compactions=n_compactions,
               ladder=list(rungs), round_len=rl, n_devices=n_dev)

@@ -38,7 +38,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
+from ..mechanism import costmodel
+from ..obs import programs as obs_programs
 from ..ops import equilibrium as eq_ops
 from ..ops import psr as psr_ops
 from ..ops import reactors as reactor_ops
@@ -120,6 +122,12 @@ class Engine:
                      else telemetry.get_recorder())
         self._jit_cache: Dict[Tuple, Any] = {}
         self._rescue_cache: Dict[Tuple, Any] = {}
+        #: resolved knob config per jit-cache key, captured when the
+        #: wrapper is created (= at trace configuration time), and the
+        #: program_id memo per (key, profile, bucket) — the obs
+        #: registry's identity inputs
+        self._cfg_cache: Dict[Tuple, Dict[str, Any]] = {}
+        self._pid_cache: Dict[Tuple, str] = {}
         self._cache_lock = threading.Lock()
         #: set by ChemServer.warmup around ladder compiles: engines
         #: with per-request accounting (surrogate hit/miss) must not
@@ -163,7 +171,90 @@ class Engine:
             if fn is None:
                 fn = self._jit_cache[cache_key] = jax.jit(
                     self._make_batch_fn(key))
+                # capture the knob config the eventual traces of this
+                # wrapper will resolve (rop/fuse are trace-time knobs
+                # the jit cache does NOT key on, so the wrapper's
+                # creation is the moment they bind)
+                self._cfg_cache[cache_key] = self._resolved_config(key)
             return fn
+
+    # -- program observatory ---------------------------------------------
+    def _config_extras(self) -> Dict[str, Any]:
+        """Kind-specific solver knobs joining the program identity."""
+        return {}
+
+    def _resolved_config(self, key: Tuple) -> Dict[str, Any]:
+        """The resolved knob config keying this engine's compiled
+        programs: effective ROP layout (the sparse REQUEST degrades to
+        dense on stage-less records), fused-vs-split kinetics, the
+        solve-profile flag, and the schedule mode, plus the subclass's
+        solver knobs."""
+        from ..ops import kinetics
+        staged = getattr(self.mech, "rop_stage", None) is not None
+        rop = kinetics.resolve_rop_mode()
+        cfg: Dict[str, Any] = {
+            "rop_mode": "sparse" if (staged and rop == "sparse")
+            else "dense",
+            "fuse_mode": ("fused" if kinetics.fused_enabled(self.mech)
+                          else "split"),
+            "profile": bool(solve_profile_enabled()),
+            "schedule": knobs.value("PYCHEMKIN_SCHEDULE"),
+        }
+        if key:
+            cfg["group_key"] = list(key)
+        cfg.update(self._config_extras())
+        return cfg
+
+    def program_id(self, bucket: int, key: Tuple) -> str:
+        """The compiled program's stable identity at this bucket shape
+        (registers it with the obs registry on first sight). Memoized
+        per (group key, profile flag, bucket) — the same axes the jit
+        cache keys on, plus the shape."""
+        self._batch_fn(key)          # bind the config if not yet
+        cfg_key = (key, solve_profile_enabled())
+        pid_key = cfg_key + (int(bucket),)
+        with self._cache_lock:
+            pid = self._pid_cache.get(pid_key)
+            cfg = self._cfg_cache[cfg_key]
+        if pid is None:
+            sig = obs_programs.mech_signature(self.mech)
+            pid = obs_programs.program_id(sig, self.kind,
+                                          (int(bucket),), cfg)
+            obs_programs.get_registry().register(
+                pid, kind=self.kind, mech_sig=sig,
+                shape=(int(bucket),), config=cfg)
+            with self._cache_lock:
+                self._pid_cache[pid_key] = pid
+        return pid
+
+    def model_gflop(self, out: Dict[str, np.ndarray],
+                    cfg: Dict[str, Any]) -> Optional[float]:
+        """Analytic model GFLOPs of one dispatched batch, from the
+        in-kernel physics profile when present — padding lanes
+        INCLUDED (edge duplicates burn real hardware FLOPs; this is
+        the achieved-GFLOP/s numerator, not a useful-work metric).
+        None when the output carries no solver counters (profile off,
+        or a kind outside the kinetics hot path)."""
+        if "n_steps" in out:
+            attempts = float(np.asarray(out["n_steps"]).sum())
+            if "n_rejected" in out:
+                attempts += float(np.asarray(out["n_rejected"]).sum())
+            newtons = (float(np.asarray(out["n_newton"]).sum())
+                       if "n_newton" in out else 6.0 * attempts)
+        elif "n_newton" in out:
+            # fixed-point kinds (PSR): every Newton iteration builds
+            # and factors, so iterations ARE the attempts
+            newtons = float(np.asarray(out["n_newton"]).sum())
+            attempts = newtons
+        else:
+            return None
+        try:
+            return costmodel.integration_flops(
+                self.mech, attempts, newtons,
+                rop_mode=cfg.get("rop_mode", "dense"),
+                fused=cfg.get("fuse_mode") == "fused") / 1e9
+        except (TypeError, ValueError):
+            return None
 
     def profile_at(self, out: Dict[str, np.ndarray],
                    i: int) -> Optional[Dict[str, Any]]:
@@ -199,13 +290,34 @@ class Engine:
     def solve(self, payloads: List[Dict[str, Any]], bucket: int,
               key: Tuple) -> Tuple[Dict[str, np.ndarray], float]:
         """Solve one padded micro-batch; returns (result arrays at
-        bucket shape, device-fenced solve seconds)."""
+        bucket shape, device-fenced solve seconds). Every dispatch is
+        banked with the program observatory: compile events (detected
+        by the per-kind trace counter moving) record first-compile
+        wall and persistent-cache warm/cold; accounted dispatches
+        observe wall into ``program.wall_ms.<id>`` and accumulate
+        model FLOPs."""
         args = self.stack(payloads, bucket)
+        pid = self.program_id(bucket, key)
+        kind_counter = f"serve.compiles.{self.kind}"
+        compiles_before = self._rec.counters.get(kind_counter, 0)
+        hits_before = obs_programs.cache_hits()
         t0 = time.perf_counter()
         out = self._batch_fn(key)(*args)
         out = jax.block_until_ready(out)
         solve_s = time.perf_counter() - t0
-        return {k: np.asarray(v) for k, v in out.items()}, solve_s
+        out = {k: np.asarray(v) for k, v in out.items()}
+        compiled = (self._rec.counters.get(kind_counter, 0)
+                    > compiles_before)
+        hits_delta = (obs_programs.cache_hits() - hits_before
+                      if compiled and hits_before >= 0 else None)
+        cfg = self._cfg_cache.get((key, solve_profile_enabled()), {})
+        obs_programs.get_registry().record_dispatch(
+            pid, solve_s * 1e3,
+            model_gflop=(None if self._warming
+                         else self.model_gflop(out, cfg)),
+            compiled=compiled, cache_hits_delta=hits_delta,
+            recorder=self._rec, accounted=not self._warming)
+        return out, solve_s
 
     def value_at(self, out: Dict[str, np.ndarray],
                  i: int) -> Dict[str, Any]:
@@ -260,6 +372,13 @@ class IgnitionEngine(Engine):
         KK = self.mech.n_species
         return {"T0": 1200.0, "P0": 1.01325e6,
                 "Y0": np.full(KK, 1.0 / KK), "t_end": 1e-5}
+
+    def _config_extras(self):
+        return {"problem": self.problem, "energy": self.energy,
+                "rtol": self.rtol, "atol": self.atol,
+                "max_steps": self.max_steps,
+                "ignition_mode": str(self.ignition_mode),
+                "jac_mode": "analytic"}
 
     def _make_batch_fn(self, key):
         def fn(T0s, P0s, Y0s, t_ends):
@@ -346,6 +465,9 @@ class EquilibriumEngine(Engine):
     def __init__(self, mech, recorder=None, *, n_iter=80):
         super().__init__(mech, recorder)
         self.n_iter = n_iter
+
+    def _config_extras(self):
+        return {"n_iter": self.n_iter}
 
     def normalize(self, payload):
         Y = _f64(payload["Y"])
@@ -445,6 +567,10 @@ class PSREngine(Engine):
         self.n_newton = n_newton
         self.n_pseudo = n_pseudo
         self.solver_kwargs = solver_kwargs
+
+    def _config_extras(self):
+        return {"energy": self.energy, "n_newton": self.n_newton,
+                "n_pseudo": self.n_pseudo}
 
     def normalize(self, payload):
         Y_in = _f64(payload["Y_in"])
@@ -630,6 +756,10 @@ class SurrogateEngine(Engine):
             ign_disagree_max=ign_disagree_max,
             ign_t_end_frac=ign_t_end_frac,
             eq_resid_max=eq_resid_max)
+
+    def _config_extras(self):
+        return {"base_kind": self.base_kind,
+                "model_sig": str(self.model.mech_sig)[:12]}
 
     # -- payload: the surrogate speaks the base engine's schema ----------
     def normalize(self, payload):

@@ -565,8 +565,12 @@ class ChemServer:
                                     eng.bucket_ladder or self.buckets)
         t_form = time.perf_counter()
         # .get: counters is a defaultdict and an unlocked missing-key
-        # read would INSERT, racing a live snapshot()
-        compiles_before = self._rec.counters.get("serve.compiles", 0)
+        # read would INSERT, racing a live snapshot(). Per-KIND
+        # counter: the global serve.compiles is the fleet sum across
+        # kinds, so a concurrent engine's recompile would mask (or
+        # fake) this group's compile verdict under the global read.
+        kind_counter = f"serve.compiles.{kind}"
+        compiles_before = self._rec.counters.get(kind_counter, 0)
         try:
             out, solve_s = eng.solve([r.payload for r in reqs],
                                      bucket, key)
@@ -584,8 +588,11 @@ class ChemServer:
                 self._fail_future(r.future, exc)
             return
         solve_ms = solve_s * 1e3
-        compile_hit = (self._rec.counters.get("serve.compiles", 0)
+        compile_hit = (self._rec.counters.get(kind_counter, 0)
                        == compiles_before)
+        # the compiled program this group dispatched to — memoized in
+        # the engine, so the hot path pays a dict lookup
+        program_id = eng.program_id(bucket, key)
         self._rec.inc("serve.batches")
         self._rec.observe("serve.batch_occupancy", occupancy)
         self._rec.observe("serve.solve_ms", solve_ms)
@@ -651,6 +658,7 @@ class ChemServer:
                         occupancy=occupancy, compile_hit=compile_hit,
                         lane=i, status=name_of(status),
                         schedule=self.schedule_mode,
+                        program_id=program_id,
                         **(prof or {}))
                     if eng.trace_span_name:
                         # engine-declared extra span (e.g. the

@@ -91,6 +91,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from .. import knobs, telemetry
+from ..obs import programs as obs_programs
 from ..resilience import procfaults
 from ..resilience.driver import GracefulStop
 from ..resilience.procfaults import BackendPoisonedError
@@ -239,6 +240,26 @@ class _ConnWriter:
             except OSError:
                 self._rec.inc("serve.transport.reply_dropped")
                 return               # connection gone; reader cleans up
+
+
+#: one calibration probe per backend process, run lazily at the first
+#: metrics scrape (off the serving hot path) and shipped verbatim in
+#: every reply — the scraper-side mfu_pct denominator must come from
+#: the machine that did the work, not the machine doing the merging
+_CALIBRATION = {"probe": None, "tried": False}
+_CALIBRATION_LOCK = threading.Lock()
+
+
+def _calibration_probe() -> Optional[Dict]:
+    with _CALIBRATION_LOCK:
+        if not _CALIBRATION["tried"]:
+            _CALIBRATION["tried"] = True
+            try:
+                from ..utils import calibration
+                _CALIBRATION["probe"] = calibration.probe()
+            except Exception:  # noqa: BLE001 — telemetry, not verdict
+                _CALIBRATION["probe"] = None
+        return _CALIBRATION["probe"]
 
 
 class _Tenant:
@@ -481,6 +502,13 @@ class TransportServer:
                 "gauges": snap["gauges"],
                 "histograms": snap["histograms"],
                 "histogram_states": self._rec.histogram_states(),
+                # the program observatory: per-compiled-program
+                # metadata, compile tallies, and model-FLOP sums (wall
+                # rides the program.wall_ms.* histogram states above);
+                # plus this backend's calibrated GEMM roof, the
+                # denominator of the fleet's mfu_pct
+                "programs": obs_programs.get_registry().programs_state(),
+                "calibration": _calibration_probe(),
                 # per-mechanism scheduling state (mode, live window/
                 # batch-cap, ladder, per-bucket occupancy p50) — the
                 # adaptive-ladder view chemtop renders per backend

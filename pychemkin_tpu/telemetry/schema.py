@@ -47,6 +47,7 @@ COUNTERS = (
     "odeint.solves",
     "odeint.stalled",
     "odeint.steps",
+    "program.compiles",
     "resilience.abandoned",
     "resilience.rescued",
     "schedule.cohorts",
@@ -84,6 +85,7 @@ COUNTER_PREFIXES = (
     "model.status.",
     "odeint.newton.",
     "odeint.status.",
+    "program.compiles.",
     "resilience.status.",
     "serve.compiles.",
     "serve.status.",
@@ -109,10 +111,13 @@ HISTOGRAMS = (
     "solve.dt_min_ns",
     "solve.newton_per_attempt",
     "solve.steps_per_lane",
+    "sweep.solve_ms",
 )
 
-#: per-bucket occupancy distributions: serve.occupancy.b<bucket>
+#: per-bucket occupancy distributions: serve.occupancy.b<bucket>;
+#: per-compiled-program wall time: program.wall_ms.<program_id>
 HISTOGRAM_PREFIXES = (
+    "program.wall_ms.",
     "serve.occupancy.b",
 )
 
@@ -176,6 +181,7 @@ EVENT_PREFIXES = ()
 #: signal name fails chemlint, not production dashboards.
 HEALTH_SIGNALS = (
     "BACKEND_DOWN",
+    "COMPILE_STORM",
     "DEADLINE_PRESSURE",
     "ERROR_BUDGET_BURN",
     "LADDER_SATURATED",
@@ -196,6 +202,25 @@ HEALTH_EVENT_FIELDS = (
     "fired_at",
     "cleared_at",
 )
+
+# -- program observatory ----------------------------------------------------
+
+#: the counters :mod:`pychemkin_tpu.obs.programs` emits — every entry
+#: must be derivable from :data:`COUNTERS` / :data:`COUNTER_PREFIXES`
+#: and every counter the obs package increments must be derivable from
+#: this tuple (the lint's ``telemetry-program-counters`` rule checks
+#: both directions, mirroring ``SCHEDULE_COUNTERS``). The global is
+#: always the sum of the per-program family.
+PROGRAM_COUNTERS = (
+    "program.compiles",
+    "program.compiles.",
+)
+
+#: the trace-span field carrying the compiled-program identity on
+#: ``serve.dispatch`` spans — the join key between wall-clock spans
+#: and the obs registry's per-program cost attribution. The lint pins
+#: the field to the actual ``emit_span`` call site in serve/server.py.
+PROGRAM_SPAN_FIELD = "program_id"
 
 # -- timers (recorder.section blocks) ---------------------------------------
 
@@ -224,5 +249,6 @@ __all__ = [
     "COUNTERS", "COUNTER_PREFIXES", "GAUGES", "GAUGE_PREFIXES",
     "HISTOGRAMS", "HISTOGRAM_PREFIXES", "EVENTS", "EVENT_PREFIXES",
     "HEALTH_SIGNALS", "HEALTH_EVENT_FIELDS",
+    "PROGRAM_COUNTERS", "PROGRAM_SPAN_FIELD",
     "TIMERS", "TIMER_PREFIXES", "SPANS", "SPAN_PREFIXES",
 ]

@@ -61,6 +61,13 @@ Behaviour:
   ``tests/lint_baseline.json`` fails the suite immediately, naming
   the rule, file, and line. ``--lint-only`` stops after the analyzer
   (the fast CI pre-gate);
+- ``--compile-audit`` runs ``tools/compile_audit.py`` as a subprocess
+  (again: no jax in this orchestrator): one warmed server + scheduled
+  sweep, then a mixed-kind soak — any ``program.compiles`` growth
+  after warmup fails the suite rc 1, naming the recompiled program
+  ids. ``PYCHEMKIN_COMPILE_AUDIT_PERTURB=1`` in the caller's env
+  drives the negative twin (a knob flip mid-run), which MUST fail.
+  With no test files named the run stops after the audit;
 - under ``--chaos`` the children also get ``PYCHEMKIN_KILL_REPORT_DIR``
   (a fresh temp dir unless the caller exported one), and after the run
   the suite ASSERTS at least one ``kill_report*.json`` artifact exists
@@ -318,10 +325,11 @@ def main(argv=None):
     chaos = "--chaos" in argv
     lint = "--lint" in argv
     lint_only = "--lint-only" in argv
-    if faults or chaos or lint or lint_only:
+    compile_audit = "--compile-audit" in argv
+    if faults or chaos or lint or lint_only or compile_audit:
         argv = [a for a in argv
                 if a not in ("--faults", "--chaos", "--lint",
-                             "--lint-only")]
+                             "--lint-only", "--compile-audit")]
     if lint or lint_only:
         # the static-analysis ratchet runs BEFORE any pytest child: a
         # new violation fails the suite immediately, naming the rule,
@@ -330,6 +338,32 @@ def main(argv=None):
         if lint_rc != 0:
             return lint_rc
         if lint_only:
+            return 0
+    if compile_audit:
+        # the post-warmup recompile gate (ISSUE 17): a subprocess, so
+        # this orchestrator keeps its never-imports-jax contract. The
+        # PYCHEMKIN_COMPILE_AUDIT_PERTURB env rides through _child_env
+        # to drive the negative twin, which must come back rc 1.
+        audit_tool = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "compile_audit.py")
+        try:
+            audit = subprocess.run(
+                [sys.executable, audit_tool], env=_child_env(),
+                timeout=FILE_TIMEOUT)
+            audit_rc = audit.returncode
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            print(f"# run_suite: compile-audit could not run: {exc}",
+                  flush=True)
+            audit_rc = 2
+        print(f"# run_suite: compile-audit rc={audit_rc}", flush=True)
+        if audit_rc != 0:
+            print("# run_suite: COMPILE-AUDIT FAILURE: a warmed "
+                  "server/sweep paid a compile under live traffic",
+                  flush=True)
+            return 1
+        if not argv:
+            # audit-only invocation: the gate IS the verdict
             return 0
     summary_json = None
     if "--summary-json" in argv:

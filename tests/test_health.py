@@ -394,6 +394,35 @@ class TestShippedRules:
         assert states[3]["PREDICTOR_DECALIBRATED"]["state"] == "firing"
         assert states[4]["PREDICTOR_DECALIBRATED"]["state"] == "ok"
 
+    def test_compile_storm_gated_on_traffic(self):
+        """ISSUE 17: post-warmup compiles page, warmup compiles don't.
+        The traffic gate encodes the phase boundary — warmup compiles
+        land BEFORE serve.requests moves, so a compile delta with zero
+        traffic in the window is the expected cold start, while a
+        compile delta WITH traffic is live requests paying trace+build
+        wall (fire_for=1: one recompile is already a contract breach)."""
+        def sample(t, compiles, requests):
+            return _backend_sample(t, counters={
+                "program.compiles": compiles,
+                "serve.requests": requests})
+        # warmup compiles before any traffic: gated silent
+        engine, states = _run_rules(
+            [sample(0.0, 0, 0), sample(10.0, 6, 0)])
+        assert states[-1]["COMPILE_STORM"]["state"] == "ok"
+        # a compile DURING live traffic fires on the next poll
+        engine, states = _run_rules(
+            [sample(0.0, 6, 0), sample(10.0, 6, 40),
+             sample(20.0, 8, 80),
+             sample(400.0, 8, 200), sample(410.0, 8, 240)])
+        assert states[1]["COMPILE_STORM"]["state"] == "ok"
+        sig = states[2]["COMPILE_STORM"]
+        assert sig["state"] == "firing"
+        assert sig["severity"] == "warn"
+        assert sig["evidence"]["delta"] == pytest.approx(2.0)
+        assert sig["evidence"]["traffic"] >= 1
+        # once the storm ages out of the window it clears
+        assert states[-1]["COMPILE_STORM"]["state"] == "ok"
+
     def test_ladder_saturated_needs_k_polls(self):
         # occupancy of the top bucket pinned at the cap: censored p95
         # == cap; fires only after SATURATED_POLLS consecutive polls

@@ -1,0 +1,286 @@
+"""Program-observatory tests: content-addressed program identity, the
+registry's compile/dispatch bookkeeping, and the analytic cost model.
+
+The cost-model tests are the per-reaction-type contract of ISSUE 17:
+each staged row-set cardinality moves EXACTLY the FLOP terms it funds
+(a falloff row buys Troe blending, a third-body row buys a [M] sum,
+a PLOG table buys log-interpolation) and NOTHING else — the dense-mode
+counts, which ignore the sparse index sets by construction, must stay
+bit-identical under every such perturbation. That "changes iff" shape
+is what makes the model trustworthy as a denominator for mfu_pct.
+
+Everything here runs without jax except the embedded-mechanism
+cross-checks (costmodel itself is stdlib+numpy by contract — chemtop
+and perf_ledger import it from non-jax processes).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pychemkin_tpu import telemetry
+from pychemkin_tpu.mechanism import costmodel
+from pychemkin_tpu.obs import programs as obs_programs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+T = costmodel.TRANSCENDENTAL_FLOPS
+
+
+class _FakeStage:
+    """A synthetic StagedRopKernel: only the index-set cardinalities
+    matter to the cost model, so rows are zero-filled placeholders."""
+
+    def __init__(self, II=40, KK=12, nnz_f=96, nnz_r=80, nnz_kc=80,
+                 n_rev=28, n_fall=5, n_tb=4, n_revp=2, n_jac=320):
+        self.II, self.KK = II, KK
+        self.of_rxn = np.zeros(nnz_f, np.int32)
+        self.or_rxn = np.zeros(nnz_r, np.int32)
+        self.kc_rxn = np.zeros(nnz_kc, np.int32)
+        self.rev_rows = np.zeros(n_rev, np.int32)
+        self.falloff_rows = np.zeros(n_fall, np.int32)
+        self.tb_rows = np.zeros(n_tb, np.int32)
+        self.revp_rows = np.zeros(n_revp, np.int32)
+        self.jac_rxn = np.zeros(n_jac, np.int32)
+        self.sig = "fakestage"
+
+
+def _sparse_rhs(**kw):
+    n_plog = kw.pop("n_plog", 0)
+    card = costmodel.cardinalities(_FakeStage(**kw), n_plog=n_plog)
+    return costmodel.rhs_flops(card, "sparse")
+
+
+def _dense_rhs(**kw):
+    n_plog = kw.pop("n_plog", 0)
+    card = costmodel.cardinalities(_FakeStage(**kw), n_plog=n_plog)
+    return costmodel.rhs_flops(card, "dense")
+
+
+class TestCostModelRowSets:
+    """FLOP counts change iff the corresponding staged row sets do."""
+
+    def test_plain_arrhenius_row(self):
+        # one more reaction row: one Arrhenius eval + its dense-matvec
+        # column and q-assembly slot on the sparse path
+        base, more = _sparse_rhs(), _sparse_rhs(II=41)
+        assert more - base == pytest.approx((T + 6) + 2 * 12 + 2)
+
+    def test_falloff_row_buys_troe_blending_only(self):
+        base, more = _sparse_rhs(), _sparse_rhs(n_fall=6)
+        assert more - base == pytest.approx(3 * T + 12)
+        # dense-mode counts ignore the falloff row set entirely
+        assert _dense_rhs(n_fall=6) == _dense_rhs()
+
+    def test_reversible_row_buys_kc_work_only(self):
+        base, more = _sparse_rhs(), _sparse_rhs(n_rev=29)
+        assert more - base == pytest.approx((T + 8) + 6)
+        assert _dense_rhs(n_rev=29) == _dense_rhs()
+
+    def test_third_body_row_buys_concentration_sum(self):
+        base, more = _sparse_rhs(), _sparse_rhs(n_tb=5)
+        assert more - base == pytest.approx(2 * 12)     # 2*KK
+        assert _dense_rhs(n_tb=5) == _dense_rhs()
+
+    def test_plog_table_buys_pressure_interpolation(self):
+        base, more = _sparse_rhs(), _sparse_rhs(n_plog=1)
+        assert more - base == pytest.approx(2 * T + 20)
+        # PLOG rate work is shared by both ROP modes (record-level)
+        assert _dense_rhs(n_plog=1) - _dense_rhs() == 0.0
+        card = costmodel.cardinalities(_FakeStage(), n_plog=1)
+        card0 = costmodel.cardinalities(_FakeStage(), n_plog=0)
+        assert (costmodel.rate_constant_flops(card)
+                - costmodel.rate_constant_flops(card0)
+                == pytest.approx(2 * T + 20))
+
+    def test_order_matrix_nonzeros(self):
+        assert (_sparse_rhs(nnz_f=97) - _sparse_rhs()
+                == pytest.approx(2.0))
+        assert (_sparse_rhs(nnz_r=81) - _sparse_rhs()
+                == pytest.approx(2.0))
+        assert (_sparse_rhs(nnz_kc=81) - _sparse_rhs()
+                == pytest.approx(2.0))
+
+    def test_jac_triples_only_move_sparse_jacobian(self):
+        c = costmodel.cardinalities(_FakeStage())
+        c_more = costmodel.cardinalities(_FakeStage(n_jac=321))
+        assert (costmodel.jac_flops(c_more, "sparse", "analytic")
+                - costmodel.jac_flops(c, "sparse", "analytic")
+                == pytest.approx(6.0))
+        # dense analytic and both RHS modes never see jac_rxn
+        assert (costmodel.jac_flops(c_more, "dense", "analytic")
+                == costmodel.jac_flops(c, "dense", "analytic"))
+        assert (costmodel.rhs_flops(c_more, "sparse")
+                == costmodel.rhs_flops(c, "sparse"))
+
+    def test_linalg_depends_only_on_species_count(self):
+        c = costmodel.cardinalities(_FakeStage())
+        perturbed = costmodel.cardinalities(
+            _FakeStage(II=80, nnz_f=200, n_rev=50, n_fall=9))
+        assert costmodel.linalg_flops(c) == costmodel.linalg_flops(
+            perturbed)
+        assert (costmodel.linalg_flops(c, "dense")
+                != costmodel.linalg_flops(
+                    costmodel.cardinalities(_FakeStage(KK=13)),
+                    "dense"))
+
+    def test_attempt_composition(self):
+        stage = _FakeStage()
+        card = costmodel.cardinalities(stage, n_plog=0)
+        out = costmodel.attempt_flops(stage, rop_mode="sparse",
+                                      solver="bordered", n_newton=6.0)
+        la = costmodel.linalg_flops(card, "bordered")
+        want = (costmodel.jac_flops(card, "sparse", "analytic")
+                + la["factor"] + 6.0 * out["rhs"] + 7.0 * la["solve"])
+        assert out["total"] == pytest.approx(want)
+        # fused build folds the first Newton RHS into the (f, J) pair
+        fused = costmodel.attempt_flops(stage, rop_mode="sparse",
+                                        fused=True, n_newton=6.0)
+        assert fused["jacobian"] == pytest.approx(
+            costmodel.jac_flops(card, "sparse", "analytic")
+            + costmodel.FUSED_RHS_FRACTION * out["rhs"])
+        assert fused["total"] < out["total"] + out["rhs"]
+
+    def test_stageless_record_degrades_to_dense(self):
+        class _Rec:
+            nu_f = np.zeros((7, 4))
+        card = costmodel.cardinalities(_Rec())
+        assert card["II"] == 7 and card["KK"] == 4
+        assert card["nnz_f"] == 0 and card["n_jac"] == 0
+        with pytest.raises(ValueError):
+            costmodel.rhs_flops(card, "blocked")
+        with pytest.raises(TypeError):
+            costmodel.cardinalities(object())
+
+
+class TestCostModelEmbedded:
+    """Cross-checks against the real staged mechanisms."""
+
+    def test_embedded_cardinalities_and_ordering(self):
+        from pychemkin_tpu.mechanism import load_embedded
+        for name in ("h2o2", "grisyn"):
+            mech = load_embedded(name)
+            card = costmodel.cardinalities(mech)
+            assert card["II"] > 0 and card["n_rev"] > 0
+            assert card["nnz_f"] >= card["II"]
+            dense = costmodel.attempt_flops(mech, rop_mode="dense",
+                                            solver="dense")
+            sparse = costmodel.attempt_flops(mech, rop_mode="sparse",
+                                             solver="bordered")
+            assert 0 < sparse["total"] < dense["total"]
+            b = costmodel.attempt_bytes(mech, rop_mode="sparse")
+            assert b["total"] > 0
+            # the model is finite, JSON-serializable evidence
+            json.dumps({"f": dense, "b": b})
+
+
+class TestProgramId:
+    def test_shape_and_determinism(self):
+        pid = obs_programs.program_id(
+            "sigA", "serve.ignition", (8,), {"rop": "sparse"})
+        assert len(pid) == 12
+        assert int(pid, 16) >= 0
+        assert pid == obs_programs.program_id(
+            "sigA", "serve.ignition", (8,), {"rop": "sparse"})
+
+    def test_any_perturbation_changes_id(self):
+        base = dict(mech_sig="sigA", kind="serve.ignition", shape=(8,),
+                    config={"rop": "sparse", "prof": False})
+        pid = obs_programs.program_id(**base)
+        seen = {pid}
+        for twist in (
+                {"mech_sig": "sigB"},
+                {"kind": "serve.equilibrium"},
+                {"shape": (16,)},
+                {"config": {"rop": "dense", "prof": False}},
+                {"config": {"rop": "sparse", "prof": True}},
+                {"config": {"rop": "sparse"}},
+        ):
+            other = obs_programs.program_id(**{**base, **twist})
+            assert other not in seen, twist
+            seen.add(other)
+
+    def test_stable_across_process_respawn(self):
+        """Content-addressed identity: a fresh interpreter computing
+        the same (sig, kind, shape, config) MUST print the same id —
+        this is the join key the fleet merge relies on."""
+        args = ("sigA", "sweep.ignition", (64,),
+                {"rop_mode": "sparse", "n": 3})
+        pid = obs_programs.program_id(*args)
+        code = (
+            "from pychemkin_tpu.obs.programs import program_id;"
+            "print(program_id('sigA','sweep.ignition',(64,),"
+            "{'rop_mode':'sparse','n':3}))")
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO, text=True,
+            capture_output=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == pid
+
+
+class TestRegistry:
+    def test_compile_and_dispatch_accounting(self):
+        reg = obs_programs.ProgramRegistry()
+        rec = telemetry.MetricsRecorder()
+        pid = obs_programs.program_id("s", "serve.ignition", (4,), {})
+        reg.register(pid, kind="serve.ignition", mech_sig="s",
+                     shape=(4,), config={"prof": False})
+        reg.register(pid, kind="serve.ignition", mech_sig="s",
+                     shape=(4,), config={"prof": False})  # idempotent
+        # warmup: compile banked, wall NOT attributed
+        reg.record_dispatch(pid, 120.0, compiled=True,
+                            cache_hits_delta=0, recorder=rec,
+                            accounted=False)
+        assert rec.counters["program.compiles"] == 1
+        assert rec.counters[f"program.compiles.{pid}"] == 1
+        assert f"program.wall_ms.{pid}" not in rec.histograms
+        assert reg.dispatches(pid) == 0
+        # live dispatches: wall + model FLOPs attributed, no compiles
+        reg.record_dispatch(pid, 2.0, model_gflop=0.5, recorder=rec)
+        reg.record_dispatch(pid, 3.0, model_gflop=0.5, recorder=rec)
+        assert rec.counters["program.compiles"] == 1
+        assert reg.dispatches(pid) == 2
+        h = rec.histograms[f"program.wall_ms.{pid}"]
+        assert h.count == 2 and h.sum == pytest.approx(5.0)
+        row = reg.programs_state()["by_id"][pid]
+        assert row["compiles"] == 1 and row["dispatches"] == 2
+        assert row["first_compile_ms"] == pytest.approx(120.0)
+        assert row["cache_source"] == "cold"
+        assert row["model_gflop_sum"] == pytest.approx(1.0)
+        json.dumps(reg.programs_state())
+
+    def test_cache_source_classification(self):
+        reg = obs_programs.ProgramRegistry()
+        rec = telemetry.MetricsRecorder()
+        for delta, want in ((3, "warm"), (None, "unknown"),
+                            (-1, "unknown")):
+            pid = obs_programs.program_id("s", "k", (1,),
+                                          {"d": str(delta)})
+            reg.register(pid, kind="k", mech_sig="s", shape=(1,),
+                         config={})
+            reg.record_dispatch(pid, 50.0, compiled=True,
+                                cache_hits_delta=delta, recorder=rec,
+                                accounted=False)
+            assert (reg.programs_state()["by_id"][pid]["cache_source"]
+                    == want), delta
+
+    def test_unregistered_dispatch_is_dropped(self):
+        reg = obs_programs.ProgramRegistry()
+        rec = telemetry.MetricsRecorder()
+        reg.record_dispatch("deadbeef0000", 1.0, recorder=rec)
+        assert not rec.counters and not rec.histograms
+
+    def test_global_registry_reset(self):
+        obs_programs.reset_registry()
+        reg = obs_programs.get_registry()
+        assert reg is obs_programs.get_registry()
+        pid = obs_programs.program_id("s", "k", (2,), {})
+        reg.register(pid, kind="k", mech_sig="s", shape=(2,), config={})
+        obs_programs.reset_registry()
+        assert pid not in obs_programs.get_registry(
+        ).programs_state()["by_id"]

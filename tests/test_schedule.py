@@ -220,6 +220,41 @@ class TestCompaction:
         assert np.all(out["n_steps"] > 0)
         assert np.all(out["n_newton"] >= out["n_steps"])
 
+    def test_sweep_programs_register_once_per_rung(self, h2o2):
+        """ISSUE 17 observatory contract on the sweep side: every
+        ladder rung that runs registers ONE program id whose first
+        dispatch is its compile (per-program counters, not one global
+        blob), wall lands in sweep.solve_ms + program.wall_ms.<id>,
+        and an identical re-run pays ZERO compiles — the regression
+        the compile-audit gate enforces."""
+        from pychemkin_tpu.obs import programs as obs_programs
+        obs_programs.reset_registry()
+        T0s, P0s, Y0s, t_ends = _mixed_conditions(h2o2, 16, 2e-4)
+        rec = telemetry.MetricsRecorder()
+        schedule.compacted_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+            ladder=(16, 8), round_len=100, recorder=rec)
+        by_id = obs_programs.get_registry().programs_state()["by_id"]
+        pids = {p for p, row in by_id.items()
+                if row["kind"] == "sweep.ignition"}
+        assert pids
+        per_prog = {k: v for k, v in rec.counters.items()
+                    if k.startswith("program.compiles.")}
+        assert set(per_prog) == {f"program.compiles.{p}"
+                                 for p in pids}
+        assert all(v == 1 for v in per_prog.values())
+        assert rec.counters["program.compiles"] == len(pids)
+        assert rec.histograms["sweep.solve_ms"].count >= 1
+        for p in pids:
+            assert rec.histograms[f"program.wall_ms.{p}"].count >= 1
+        rec2 = telemetry.MetricsRecorder()
+        schedule.compacted_ignition_sweep(
+            h2o2, "CONP", "ENRG", T0s, P0s, Y0s, t_ends,
+            ladder=(16, 8), round_len=100, recorder=rec2)
+        assert not any(k.startswith("program.compiles")
+                       for k in rec2.counters)
+        assert rec2.histograms["sweep.solve_ms"].count >= 1
+
 
 # ---------------------------------------------------------------------------
 # driver order plumbing

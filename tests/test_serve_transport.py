@@ -513,6 +513,75 @@ class TestChemtopMerge:
         assert legacy["schedule"] == {}
         assert "schedule[" not in chemtop.render(legacy)
 
+    def test_programs_block_merges_by_content_address(self):
+        """ISSUE 17: program_id is content-addressed, so the same id
+        on two backends IS the same compiled program — compiles/
+        dispatches/model-FLOPs sum, wall comes from the MERGED
+        program.wall_ms.<id> states (summed states, never averaged
+        per-backend shares), mfu is taken against the FASTEST measured
+        GEMM roof in the fleet, and coverage is attributed program
+        wall over total measured solver wall."""
+        from tools import chemtop
+
+        shared, only_b = "aabbccddeeff", "112233445566"
+
+        def add_programs(rep, rows, walls, gemm_gflops):
+            rep["programs"] = {"by_id": rows, "cache_listener": True}
+            rep["calibration"] = {"probe_version": 1,
+                                  "gemm_gflops": gemm_gflops}
+            for pid, values in walls.items():
+                h = telemetry.Histogram()
+                for v in values:
+                    h.observe(v)
+                rep["histogram_states"][
+                    f"program.wall_ms.{pid}"] = h.state()
+                rep["histograms"][
+                    f"program.wall_ms.{pid}"] = h.summary()
+
+        def row(compiles, dispatches, gflop, first_ms, src):
+            return {"kind": "serve.ignition", "mech_sig": "deadbeef",
+                    "shape": [8], "config": {"rop_mode": "sparse"},
+                    "compiles": compiles, "dispatches": dispatches,
+                    "model_gflop_sum": gflop,
+                    "first_compile_ms": first_ms,
+                    "cache_source": src}
+
+        a = self._reply(1, 10, [1.0])
+        b = self._reply(2, 5, [2.0])
+        add_programs(a, {shared: row(1, 1, 0.02, 120.0, "cold")},
+                     {shared: [1.0]}, 40.0)
+        add_programs(b, {shared: row(1, 2, 0.01, 80.0, "warm"),
+                         only_b: row(1, 3, 0.03, 95.0, "warm")},
+                     {shared: [0.5], only_b: [1.5]}, 50.0)
+        fleet = chemtop.merge_fleet([a, b])
+        prog = fleet["programs"]
+        assert set(prog["by_id"]) == {shared, only_b}
+        srow = prog["by_id"][shared]
+        assert srow["compiles"] == 2 and srow["dispatches"] == 3
+        # wall from the merged states: 1.0 + 0.5 ms
+        assert srow["wall_ms"] == pytest.approx(1.5)
+        assert srow["model_gflop_sum"] == pytest.approx(0.03)
+        assert srow["achieved_gflops"] == pytest.approx(20.0)
+        # roof = fastest backend's GEMM (50), not the mean
+        assert prog["roof_gflops"] == 50.0
+        assert srow["mfu_pct"] == pytest.approx(40.0)
+        assert srow["wall_share"] == pytest.approx(0.5)
+        # metadata from the first carrier, not overwritten
+        assert srow["first_compile_ms"] == 120.0
+        assert srow["cache_source"] == "cold"
+        # coverage: 3.0 ms attributed over 3.0 ms serve.solve_ms
+        assert prog["attributed_wall_ms"] == pytest.approx(3.0)
+        assert prog["solver_wall_ms"] == pytest.approx(3.0)
+        assert prog["coverage"] == pytest.approx(1.0)
+        assert prog["cache_listener"] is True
+        txt = chemtop.render(fleet)
+        assert "programs: 2" in txt and shared in txt
+        # a programs-less legacy fleet merges and renders silently
+        legacy = chemtop.merge_fleet([self._reply(4, 1, [1.0])])
+        assert legacy["programs"]["by_id"] == {}
+        assert legacy["programs"]["roof_gflops"] is None
+        assert "programs:" not in chemtop.render(legacy)
+
     def test_solver_panel_merges_and_legacy_renders_na(self):
         """ISSUE-14: the solver panel — solve.* histograms merged
         fleet-wide plus the per-backend predictor-calibration gauge.

@@ -8,7 +8,12 @@ histograms merged from their RAW bucket states (so fleet p50/p95/p99
 come from the merged distribution, not averaged per-process
 percentiles), plus a per-backend liveness row (pid, generation —
 the supervisor's re-exec stamp, so a churning backend is visible —
-and uptime).
+and uptime). Backends running the program observatory additionally
+contribute a ``programs`` block: per compiled-program wall shares
+(from the merged ``program.wall_ms.<id>`` states), analytic
+model-FLOP throughput, and ``mfu_pct`` against the fleet's measured
+GEMM roof — the "where does the solver wall actually go, and is it
+compute" panel.
 
 Three modes:
 
@@ -93,6 +98,9 @@ def merge_fleet(replies: List[Dict]) -> Dict:
     hist_states: Dict[str, List[Dict]] = {}
     sched_by_mech: Dict[str, List[Dict]] = {}
     predictor_corr: List[Optional[float]] = []
+    prog_by_id: Dict[str, Dict] = {}
+    calibrations: List[Dict] = []
+    cache_listener = False
     backends = []
     for rep in replies:
         row = {"port": rep.get("port"), "pid": rep.get("pid"),
@@ -129,6 +137,35 @@ def merge_fleet(replies: List[Dict]) -> Dict:
             hist_states.setdefault(name, []).append(state)
         for mech, st in (rep.get("schedule") or {}).items():
             sched_by_mech.setdefault(mech, []).append(st)
+        # program observatory: program_id is content-addressed (mech
+        # signature + kind + shape + resolved knob config), so the
+        # same id on two backends IS the same compiled program —
+        # metadata from the first carrier, counts summed. Wall comes
+        # from the MERGED program.wall_ms.<id> states below, never
+        # from averaged per-backend shares.
+        prog = rep.get("programs") or {}
+        cache_listener = cache_listener or bool(
+            prog.get("cache_listener"))
+        for pid, row in sorted((prog.get("by_id") or {}).items()):
+            agg = prog_by_id.setdefault(pid, {
+                "kind": row.get("kind"),
+                "mech_sig": row.get("mech_sig"),
+                "shape": row.get("shape"),
+                "config": row.get("config"),
+                "compiles": 0, "dispatches": 0,
+                "model_gflop_sum": 0.0,
+                "first_compile_ms": None, "cache_source": None,
+            })
+            agg["compiles"] += int(row.get("compiles", 0))
+            agg["dispatches"] += int(row.get("dispatches", 0))
+            agg["model_gflop_sum"] += float(
+                row.get("model_gflop_sum", 0.0))
+            if agg["first_compile_ms"] is None:
+                agg["first_compile_ms"] = row.get("first_compile_ms")
+            if agg["cache_source"] is None:
+                agg["cache_source"] = row.get("cache_source")
+        if rep.get("calibration"):
+            calibrations.append(rep["calibration"])
     # surrogate fast-path gauge: fleet hit rate from the SUMMED
     # counters (never averaged per-backend rates), fallbacks alongside
     # — a dropping hit rate is the signal to retrain/widen the box
@@ -180,6 +217,45 @@ def merge_fleet(replies: List[Dict]) -> Dict:
             "ladder": list(ladder),
             "bucket_occupancy_p50": per_bucket,
         }
+    # program observatory panel: per-program wall from the MERGED
+    # program.wall_ms.<id> distributions (state sums are exact, so
+    # fleet wall shares come from summed states — never from averaging
+    # per-backend percentages), achieved GFLOP/s from the analytic
+    # model-FLOP accumulators over that wall, and mfu_pct against the
+    # fastest measured GEMM roof among the alive backends (the
+    # conservative choice on a heterogeneous fleet: mfu never
+    # flatters). Coverage is the acceptance number — attributed
+    # program wall over total measured solver wall (serve + sweep).
+    roof = max((float(c.get("gemm_gflops", 0.0) or 0.0)
+                for c in calibrations), default=0.0) or None
+    attributed_wall = 0.0
+    for pid, agg in prog_by_id.items():
+        h = histograms.get(f"program.wall_ms.{pid}") or {}
+        wall_ms = float(h.get("sum", 0.0) or 0.0)
+        agg["wall_ms"] = round(wall_ms, 3)
+        attributed_wall += wall_ms
+        gflop = agg["model_gflop_sum"]
+        agg["achieved_gflops"] = (
+            round(gflop / (wall_ms / 1e3), 3)
+            if wall_ms > 0 and gflop > 0 else None)
+        agg["mfu_pct"] = (
+            round(100.0 * agg["achieved_gflops"] / roof, 3)
+            if agg["achieved_gflops"] is not None and roof else None)
+    for agg in prog_by_id.values():
+        agg["wall_share"] = (round(agg["wall_ms"] / attributed_wall, 4)
+                             if attributed_wall > 0 else None)
+    solver_wall = sum(
+        float((histograms.get(name) or {}).get("sum", 0.0) or 0.0)
+        for name in ("serve.solve_ms", "sweep.solve_ms"))
+    programs = {
+        "by_id": prog_by_id,
+        "attributed_wall_ms": round(attributed_wall, 3),
+        "solver_wall_ms": round(solver_wall, 3),
+        "coverage": (round(attributed_wall / solver_wall, 4)
+                     if solver_wall > 0 else None),
+        "roof_gflops": roof,
+        "cache_listener": cache_listener,
+    }
     return {
         "t": time.time(),
         "n_backends": len(backends),
@@ -190,6 +266,8 @@ def merge_fleet(replies: List[Dict]) -> Dict:
         "surrogate": surrogate,
         "schedule": schedule,
         "solver": solver,
+        "programs": programs,
+        "calibration": calibrations,
         "histograms": histograms,
         "histogram_states": merged_states,
     }
@@ -280,6 +358,40 @@ def render(snapshot: Dict, view=None, signals=None) -> str:
             f"  dt_min p50 {_p50('dt_min_ns')}ns"
             f"  steps/lane p50 {_p50('steps_per_lane')}"
             f"  predictor_corr {corr_txt}{trend_txt}")
+    prog = snapshot.get("programs") or {}
+    by_id = prog.get("by_id") or {}
+    if by_id:
+        cov = prog.get("coverage")
+        roof = prog.get("roof_gflops")
+        lines.append(
+            f"  programs: {len(by_id)}  "
+            f"wall {prog.get('attributed_wall_ms', 0):.0f}"
+            f"/{prog.get('solver_wall_ms', 0):.0f}ms  "
+            f"coverage {'n/a' if cov is None else f'{cov:.1%}'}  "
+            f"roof {'n/a' if not roof else f'{roof:.1f}'} GF/s  "
+            f"cache_listener "
+            f"{'on' if prog.get('cache_listener') else 'off'}")
+        ranked = sorted(by_id.items(),
+                        key=lambda kv: -(kv[1].get("wall_ms") or 0.0))
+        for pid, p in ranked[:8]:
+            shape = "x".join(str(s) for s in (p.get("shape") or ()))
+            share = p.get("wall_share")
+            gfs = p.get("achieved_gflops")
+            mfu = p.get("mfu_pct")
+            src = p.get("cache_source") or "-"
+            lines.append(
+                f"    {pid}  {p.get('kind')}[{shape}]  "
+                f"{'n/a' if share is None else f'{share:.1%}'} "
+                f"of wall ({p.get('wall_ms', 0):.0f}ms/"
+                f"{p.get('dispatches', 0)}d)  "
+                f"{'n/a' if gfs is None else f'{gfs:.2f}'} GF/s  "
+                f"mfu {'n/a' if mfu is None else f'{mfu:.1f}%'}  "
+                f"compiles {p.get('compiles', 0)}({src})")
+        if len(ranked) > 8:
+            rest = sum(p.get("wall_ms") or 0.0
+                       for _, p in ranked[8:])
+            lines.append(f"    (+{len(ranked) - 8} more programs, "
+                         f"{rest:.0f}ms)")
     for name in ("serve.queue_wait_ms", "serve.solve_ms"):
         h = snapshot["histograms"].get(name)
         if h and h.get("count"):

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""compile_audit — the post-warmup recompile gate.
+
+The serving contract since ISSUE 9 is "live traffic never pays a
+compile": :meth:`ChemServer.warmup` traces the whole bucket ladder up
+front, adaptive scheduling only picks warmed rungs, and the scheduled
+sweep's per-rung programs compile once per width. The program
+observatory (``pychemkin_tpu/obs``) finally makes that contract
+CHECKABLE from the outside — every compile increments the
+``program.compiles`` counter family with a content-addressed program
+id — and this tool turns it into a CI gate:
+
+1. build one in-process ``ChemServer`` (h2o2 by default) and
+   ``warmup()`` its engines; run one scheduled compacted ignition
+   sweep (the sweep's first pass through each ladder rung IS its
+   warmup — there is no separate warm phase for sweeps);
+2. snapshot the per-program compile counters;
+3. serve a mixed-kind soak (ignition + equilibrium across buckets) and
+   repeat the SAME sweep;
+4. diff: any ``program.compiles`` growth after step 2 means a live
+   dispatch paid trace+build wall — rc 1, naming the offending
+   program ids and their configs (the diff is the debugging payload:
+   a knob flipped mid-run shows up as a new program id whose config
+   differs in exactly the flipped field).
+
+The same run feeds both phases' counter snapshots through the health
+rule engine and reports whether ``COMPILE_STORM`` fired — the gate
+and the pager alert are exercised by the same evidence.
+
+``--perturb`` (or ``PYCHEMKIN_COMPILE_AUDIT_PERTURB=1`` in the env —
+how ``run_suite --compile-audit`` drives the negative twin) flips
+``PYCHEMKIN_SOLVE_PROFILE`` between the phases: a trace-time knob the
+jit caches do not key on, so every engine re-traces on its next
+dispatch. The perturbed twin MUST fail rc 1 and fire COMPILE_STORM;
+the unperturbed run must stay green. A gate that cannot fail is not a
+gate.
+
+Usage::
+
+    python tools/compile_audit.py --mech h2o2 --out COMPILE_AUDIT.json
+    PYCHEMKIN_COMPILE_AUDIT_PERTURB=1 python tools/compile_audit.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np                                         # noqa: E402
+
+from pychemkin_tpu import health, schedule, serve, telemetry  # noqa: E402
+from pychemkin_tpu.mechanism import load_embedded          # noqa: E402
+from pychemkin_tpu.obs import programs as obs_programs     # noqa: E402
+from pychemkin_tpu.serve import loadgen                    # noqa: E402
+
+P_ATM = 1.01325e6
+PERTURB_ENV = "PYCHEMKIN_COMPILE_AUDIT_PERTURB"
+
+
+def _compile_counters(rec) -> dict:
+    """The ``program.compiles*`` family from one recorder — the whole
+    audit diffs exactly what the schema exports, nothing bespoke."""
+    return {k: int(v) for k, v in rec.counters.items()
+            if k.startswith("program.compiles")}
+
+
+def _sample(rec) -> dict:
+    """One health-ring sample from the live recorder: the same
+    normalize path a chemtop scrape takes, so COMPILE_STORM sees the
+    same evidence here as it would on a real fleet."""
+    return health.normalize_sample({
+        "counters": dict(rec.counters),
+        "histogram_states": {},
+        "pid": os.getpid(),
+        "uptime_s": 0.0,
+    })
+
+
+def _run_sweep(mech, B: int, rec) -> None:
+    Y0 = loadgen.stoich_h2_air_Y(mech)
+    T0s = np.linspace(1000.0, 1400.0, B)
+    schedule.compacted_ignition_sweep(
+        mech, "CONP", "ENRG", T0s,
+        np.full(B, P_ATM), np.tile(Y0, (B, 1)),
+        np.full(B, 2e-5), rtol=1e-6, atol=1e-9,
+        round_len=64, recorder=rec, label="compile_audit")
+
+
+def _soak(server, Y0, n: int) -> None:
+    futs = []
+    for i in range(n):
+        if i % 2 == 0:
+            futs.append(server.submit_ignition(
+                T0=1100.0 + 25.0 * i, P0=P_ATM, Y0=Y0, t_end=2e-5))
+        else:
+            futs.append(server.submit_equilibrium(
+                T=1200.0 + 10.0 * i, P=P_ATM, Y=Y0, option=1))
+    for f in futs:
+        f.result(timeout=300)
+
+
+def run_audit(mech_name: str, n_requests: int, sweep_B: int,
+              perturb: bool) -> dict:
+    mech = load_embedded(mech_name)
+    rec = telemetry.get_recorder()
+    obs_programs.reset_registry()
+    Y0 = loadgen.stoich_h2_air_Y(mech)
+
+    server = serve.ChemServer(mech, bucket_sizes=(1, 4, 8),
+                              max_delay_ms=1.0, recorder=rec,
+                              kinds=("ignition", "equilibrium")).start()
+    try:
+        # phase W: everything tier-1 traffic will touch gets compiled
+        # here — the serve ladder via warmup(), the sweep rungs via a
+        # first full pass
+        server.warmup()
+        _run_sweep(mech, sweep_B, rec)
+        warm = _compile_counters(rec)
+        ring = health.SnapshotRing(cap=8)
+        engine = health.HealthEngine(
+            recorder=telemetry.MetricsRecorder())
+        ring.append(_sample(rec))
+        engine.evaluate(ring)
+
+        if perturb:
+            # the negative twin: flip a trace-time knob the jit caches
+            # do not key on — every engine re-traces on next dispatch
+            cur = os.environ.get("PYCHEMKIN_SOLVE_PROFILE")
+            os.environ["PYCHEMKIN_SOLVE_PROFILE"] = \
+                "" if cur in ("1", "true") else "1"
+
+        # phase L: live mixed-kind soak + the SAME sweep again
+        _soak(server, Y0, n_requests)
+        _run_sweep(mech, sweep_B, rec)
+
+        live = _compile_counters(rec)
+        ring.append(_sample(rec))
+        signals = engine.evaluate(ring)
+    finally:
+        server.close()
+
+    new = {k: live.get(k, 0) - warm.get(k, 0)
+           for k in live if live.get(k, 0) > warm.get(k, 0)}
+    # name the offending programs: the registry still holds the full
+    # config of every id, so the report says WHAT recompiled, not just
+    # that something did
+    state = obs_programs.get_registry().programs_state()["by_id"]
+    offenders = {
+        pid.split("program.compiles.", 1)[-1]: state.get(
+            pid.split("program.compiles.", 1)[-1], {})
+        for pid in new if pid != "program.compiles"}
+    storm = next((s for s in signals
+                  if s["signal"] == "COMPILE_STORM"), None)
+    rc = 1 if new else 0
+    return {
+        "tool": "compile_audit",
+        "t": time.time(),
+        "mech": mech_name,
+        "perturb": perturb,
+        "n_requests": n_requests,
+        "sweep_B": sweep_B,
+        "warm_compiles": warm,
+        "live_compiles": live,
+        "new_compiles": new,
+        "offenders": offenders,
+        "compile_storm": {
+            "state": storm["state"] if storm else None,
+            "evidence": (storm.get("evidence") if storm else None),
+        },
+        "rc": rc,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mech", default="h2o2",
+                   choices=["h2o2", "grisyn"])
+    p.add_argument("--requests", type=int, default=12,
+                   help="mixed-kind soak size in phase L")
+    p.add_argument("--sweep-batch", type=int, default=96,
+                   help="scheduled-sweep width (both phases)")
+    p.add_argument("--perturb", action="store_true",
+                   help="flip PYCHEMKIN_SOLVE_PROFILE between phases "
+                        f"(also via {PERTURB_ENV}=1) — the audit MUST "
+                        "then fail")
+    p.add_argument("--out", default=None,
+                   help="bank the verdict JSON here (atomic)")
+    args = p.parse_args(argv)
+    perturb = args.perturb or bool(os.environ.get(PERTURB_ENV))
+
+    out = run_audit(args.mech, args.requests, args.sweep_batch,
+                    perturb)
+    if args.out:
+        telemetry.atomic_write_json(args.out, out)
+    print(json.dumps(out))
+    if out["rc"]:
+        print("# compile_audit: POST-WARMUP COMPILES: "
+              + ", ".join(sorted(out["new_compiles"])),
+              file=sys.stderr)
+    return out["rc"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

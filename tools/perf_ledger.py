@@ -5,7 +5,11 @@ Every round has banked perf artifacts (``BENCH_r*.json`` bench
 summaries, ``STEP_COST_*.json`` step-cost ablations,
 ``BATCH_EFF_*.json`` batch-efficiency rungs, ``MULTICHIP_*.json``
 multi-device compaction benches — rounds with the pre-ISSUE-16
-dryrun-transcript shape carry no metrics and are skipped), and every
+dryrun-transcript shape carry no metrics and are skipped —
+and ``FLEET_*.json`` chemtop snapshots, whose program-observatory
+block contributes per-compiled-program rows: per-dispatch wall,
+analytic model FLOPs, achieved GFLOP/s, and wall-attribution
+coverage), and every
 round's notes
 carry the same caveat: the container speed drifted, so raw numbers
 from different captures do not compare. This tool turns those
@@ -75,6 +79,26 @@ METRIC_DIRECTIONS: Dict[str, str] = {
 }
 
 
+def _direction(name: str) -> str:
+    """Better-direction for a metric, including the DYNAMIC families
+    the exact table cannot enumerate (the per-program fleet rows are
+    keyed by content-addressed program ids)."""
+    if name in METRIC_DIRECTIONS:
+        return METRIC_DIRECTIONS[name]
+    if name.endswith(("_gflops", "_speedup", "coverage", "mfu_pct",
+                      "_gflop_per_dispatch")):
+        return "higher"
+    return "lower"
+
+
+def _calibration_free(name: str) -> bool:
+    """Metrics that are COUNTS, not speeds — analytic FLOP totals and
+    attribution ratios are container-independent, so normalizing them
+    by the speed factor would manufacture drift."""
+    return name.endswith(("_mflop", "coverage", "mfu_pct",
+                          "_gflop_per_dispatch"))
+
+
 def _calibration_module():
     """``pychemkin_tpu/utils/calibration.py`` loaded STANDALONE (the
     ledger must work without importing the jax-importing package
@@ -139,6 +163,14 @@ def _step_cost(doc: Dict) -> Optional[Dict]:
     if am.get("attempt_s_measured"):
         metrics["attempt_ms_measured"] = \
             float(am["attempt_s_measured"]) * 1e3
+    # the ISSUE-17 analytic columns: model FLOP count (calibration-
+    # free — a count regression means the cost model or the staging
+    # cardinalities moved) and model throughput over the measured
+    # attempt (a speed, normalized like any other)
+    if am.get("model_mflop"):
+        metrics["attempt_model_mflop"] = float(am["model_mflop"])
+    if am.get("model_gflops"):
+        metrics["attempt_model_gflops"] = float(am["model_gflops"])
     if not metrics:
         return None
     return {"kind": "step_cost", "platform": doc.get("platform"),
@@ -190,7 +222,44 @@ def _multichip(doc: Dict) -> Optional[Dict]:
             "calibration": doc.get("calibration")}
 
 
-_EXTRACTORS = (_bench_summary, _step_cost, _batch_eff, _multichip)
+def _fleet_snapshot(doc: Dict) -> Optional[Dict]:
+    """A ``chemtop --once --out`` fleet snapshot carrying the program
+    observatory block (``FLEET_*.json``). Each registered program
+    becomes a row of per-dispatch wall, per-dispatch analytic model
+    FLOPs, and achieved GFLOP/s — program ids are content-addressed
+    (mech+kind+shape+config), so the same id across captures IS the
+    same compiled program and the rows gate like any other metric.
+    Coverage (attributed wall over measured solver wall) rides along:
+    a coverage drop means dispatches stopped being attributed."""
+    prog = doc.get("programs")
+    if not isinstance(prog, dict) or "n_backends" not in doc:
+        return None
+    metrics: Dict[str, float] = {}
+    for pid, row in sorted((prog.get("by_id") or {}).items()):
+        n = int(row.get("dispatches") or 0)
+        wall = float(row.get("wall_ms") or 0.0)
+        if n > 0 and wall > 0:
+            metrics[f"prog.{pid}.ms_per_dispatch"] = round(wall / n, 6)
+            gflop = float(row.get("model_gflop_sum") or 0.0)
+            if gflop > 0:
+                metrics[f"prog.{pid}.model_gflop_per_dispatch"] = \
+                    round(gflop / n, 6)
+        if row.get("achieved_gflops"):
+            metrics[f"prog.{pid}.achieved_gflops"] = \
+                float(row["achieved_gflops"])
+    if prog.get("coverage") is not None:
+        metrics["program_wall_coverage"] = float(prog["coverage"])
+    if not metrics:
+        return None
+    cal = doc.get("calibration")
+    if isinstance(cal, list):
+        cal = cal[0] if cal else None
+    return {"kind": "fleet", "platform": None, "mech": None,
+            "B": None, "metrics": metrics, "calibration": cal}
+
+
+_EXTRACTORS = (_bench_summary, _step_cost, _batch_eff, _multichip,
+               _fleet_snapshot)
 
 
 def extract(path: str) -> Optional[Dict]:
@@ -225,7 +294,9 @@ def _normalize(entry: Dict, cal_mod) -> Dict:
     for name, raw in entry["metrics"].items():
         if factor is None:
             normalized[name] = None
-        elif METRIC_DIRECTIONS.get(name) == "higher":
+        elif _calibration_free(name):
+            normalized[name] = raw
+        elif _direction(name) == "higher":
             normalized[name] = round(raw / factor, 4)
         else:
             normalized[name] = round(raw * factor, 4)
@@ -243,7 +314,8 @@ def discover(root: str) -> List[str]:
                 name.startswith("BENCH_")
                 or name.startswith("STEP_COST_")
                 or name.startswith("BATCH_EFF_")
-                or name.startswith("MULTICHIP_")):
+                or name.startswith("MULTICHIP_")
+                or name.startswith("FLEET_")):
             out.append(os.path.join(root, name))
     return out
 
@@ -326,7 +398,7 @@ def check(ledger: Dict, capture_path: str, band: float) -> Tuple[int,
         new = capture["normalized"][name] if use_norm else raw
         old = (baseline["normalized"][name] if use_norm
                else base_raw)
-        direction = METRIC_DIRECTIONS.get(name, "lower")
+        direction = _direction(name)
         if old <= 0 or new <= 0:
             continue
         # ratio > 1 means WORSE in both directions
