@@ -1,0 +1,27 @@
+"""Elastic self-healing fleet: router, controller, HTTP ingress.
+
+One supervised backend (:mod:`pychemkin_tpu.serve.supervisor`) already
+survives crashes; this package makes a POOL of them elastic:
+
+- :mod:`.router` — mechanism-aware rendezvous routing, fleet-wide
+  tenant quotas, typed loss re-routing (requests never hang);
+- :mod:`.controller` — the signal-driven reconciliation loop: health
+  signals in, bounded add/replace/drain actions out, every decision a
+  typed ``fleet.action`` event;
+- :mod:`.ingress` — the stdlib-HTTP front door mapping the transport
+  payload schema onto POST JSON, with ``/healthz`` and ``/metrics``.
+
+The control plane (router + controller + ingress) is stdlib+telemetry
+code that runs in orchestrator processes; the chemistry (and the
+accelerator work) lives in the supervised children.
+"""
+
+from .controller import FleetController, shared_cache_env
+from .ingress import FleetIngress
+from .router import FleetRouter, assignments, rendezvous_rank, \
+    route_key
+
+__all__ = [
+    "FleetController", "FleetIngress", "FleetRouter", "assignments",
+    "rendezvous_rank", "route_key", "shared_cache_env",
+]

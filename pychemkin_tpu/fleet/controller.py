@@ -1,0 +1,356 @@
+"""Signal-driven fleet reconciliation: the elastic self-healing loop.
+
+The health engine (:mod:`pychemkin_tpu.health`) turns each member's
+metrics series into a handful of typed operator signals with fire/clear
+hysteresis built in; this controller closes the loop by ACTING on them,
+one bounded action per cooldown window:
+
+==================  =====================================================
+``LADDER_SATURATED``  a member's top occupancy bucket is pinned at
+                      capacity → **add** a backend (the ladder cannot
+                      absorb more; a second member splits the key space)
+``DEADLINE_PRESSURE`` sustained deadline-miss fraction → **add** (same
+                      remedy: admission is outrunning solve capacity)
+member ``dead``       respawn budget exhausted (``BACKEND_DOWN`` with no
+                      recovery left in the member) → **replace** — the
+                      supervisor already resolved its in-flight as typed
+                      ``BACKEND_LOST``/re-routes; the controller's job is
+                      restoring pool capacity
+sustained idleness    zero in-flight fleet-wide, nothing firing, for
+                      ``idle_polls`` consecutive polls → **drain** the
+                      newest member down to the pool floor
+==================  =====================================================
+
+Why scale-up is CHEAP here (and therefore safe to trigger from a
+signal): every member is spawned with the same ``PYCHEMKIN_STAGING_DIR``
+and the same persistent-XLA-cache dir (``PYCHEMKIN_CACHE_DIR`` — see
+:func:`shared_cache_env`), so a new member's warmup replays compiled
+programs from disk instead of tracing them. The PR-17 observatory's
+compile telemetry (``program.compiles`` vs ``cache_hits``) makes that
+claim checkable per scale-up, and the ``COMPILE_STORM`` signal pages
+when it stops being true.
+
+Bounds and pacing come from the knob registry —
+``PYCHEMKIN_FLEET_MIN`` / ``PYCHEMKIN_FLEET_MAX`` /
+``PYCHEMKIN_FLEET_COOLDOWN_S`` / ``PYCHEMKIN_FLEET_POLL_S`` — and every
+decision lands as one typed ``fleet.action`` event plus the
+``fleet.pool_size`` gauge, so chemtop and the loadgen artifact replay
+the controller's story without parsing logs.
+
+:meth:`FleetController.step` is synchronous and side-effect-complete
+(the fast-lane tests drive it directly against fake members);
+:meth:`run`/:meth:`start` wrap it in the poll loop real deployments
+use. The controller itself is stdlib+telemetry code — the chemistry
+(and the accelerator) lives in the supervised children it spawns.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import knobs, telemetry
+from .router import FleetRouter
+
+#: signals whose firing means "the pool is too small"
+SCALE_UP_SIGNALS = ("LADDER_SATURATED", "DEADLINE_PRESSURE")
+
+
+def shared_cache_env(base_dir: str) -> Dict[str, str]:
+    """Env overrides every member of one fleet should share so that
+    scale-up costs zero new XLA compiles: one staging dir (staged
+    mechanism programs + fusion plans) and one persistent-compile-cache
+    dir. Pass the result as the spawn factory's ``env_overrides``."""
+    base_dir = os.path.abspath(base_dir)
+    return {
+        "PYCHEMKIN_STAGING_DIR": os.path.join(base_dir, "staging"),
+        "PYCHEMKIN_CACHE_DIR": os.path.join(base_dir, "xla_cache"),
+    }
+
+
+class FleetController:
+    """Reconciles a :class:`~pychemkin_tpu.fleet.router.FleetRouter`'s
+    member pool against the members' health signals.
+
+    ``make_backend(member_id)`` must return a STARTED member (a
+    :class:`~pychemkin_tpu.serve.supervisor.Supervisor` natively:
+    ``alive``/``accepting``/``stats()``/``firing()``/``drain()``/
+    ``close()``); the factory owns the shared-cache env plumbing
+    (:func:`shared_cache_env`).
+    """
+
+    def __init__(self, router: FleetRouter,
+                 make_backend: Callable[[str], Any], *,
+                 min_size: Optional[int] = None,
+                 max_size: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 poll_s: Optional[float] = None,
+                 idle_polls: int = 5,
+                 drain_timeout_s: float = 60.0,
+                 recorder=None):
+        self.router = router
+        self.make_backend = make_backend
+        self.min_size = int(knobs.value("PYCHEMKIN_FLEET_MIN")
+                            if min_size is None else min_size)
+        self.max_size = int(knobs.value("PYCHEMKIN_FLEET_MAX")
+                            if max_size is None else max_size)
+        if self.max_size < self.min_size:
+            self.max_size = self.min_size
+        self.cooldown_s = float(
+            knobs.value("PYCHEMKIN_FLEET_COOLDOWN_S")
+            if cooldown_s is None else cooldown_s)
+        self.poll_s = float(knobs.value("PYCHEMKIN_FLEET_POLL_S")
+                            if poll_s is None else poll_s)
+        self.idle_polls = max(1, int(idle_polls))
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
+        self._lock = threading.RLock()
+        self._seq = 0                       # guarded-by: _lock
+        self._last_action_t: Optional[float] = None  # guarded-by: _lock
+        self._idle_streak = 0               # guarded-by: _lock
+        self._actions: List[Dict] = []      # guarded-by: _lock
+        self._step_count = 0                # guarded-by: _lock
+        self._drain_threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- membership ------------------------------------------------------
+    def _next_member_id(self) -> str:
+        taken = set(self.router.member_ids())
+        with self._lock:
+            # skip ids already in the pool: a router seeded with
+            # members the controller did not create must never be
+            # silently overwritten by the controller's own sequence
+            while f"m{self._seq}" in taken:
+                self._seq += 1
+            mid = f"m{self._seq}"
+            self._seq += 1
+        return mid
+
+    def ensure_min(self) -> List[Dict[str, Any]]:
+        """Bring the pool up to the floor (initial fill; also heals a
+        pool that lost members faster than replace could run)."""
+        actions = []
+        while len(self.router.member_ids()) < self.min_size:
+            actions.append(self._add(reason="min_size"))
+        return actions
+
+    def _add(self, *, reason: str,
+             evidence: Optional[Dict] = None) -> Dict[str, Any]:
+        mid = self._next_member_id()
+        backend = self.make_backend(mid)
+        self.router.add(mid, backend)
+        return self._record_action("add", member=mid, reason=reason,
+                                   evidence=evidence)
+
+    def _replace(self, dead_mid: str,
+                 dead_stats: Dict) -> Dict[str, Any]:
+        old = self.router.remove(dead_mid)
+        mid = self._next_member_id()
+        backend = self.make_backend(mid)
+        self.router.add(mid, backend)
+        if old is not None:
+            try:
+                # resolves any leftovers typed; the dead member holds
+                # no process, so this is bookkeeping, not teardown time
+                old.close()
+            except Exception:        # noqa: BLE001 — dead member cleanup
+                pass
+        return self._record_action(
+            "replace", member=mid, reason="respawn_exhausted",
+            replaced=dead_mid,
+            evidence={"respawns": dead_stats.get("respawns"),
+                      "backend_lost_requests":
+                          dead_stats.get("backend_lost_requests")})
+
+    def _drain(self, mid: str) -> Dict[str, Any]:
+        """Route-side drain NOW (no new assignments), then the
+        blocking member-side drain/close off-thread — step() must stay
+        a bounded reconciliation pass, not a 60s wait."""
+        self.router.start_drain(mid)
+        action = self._record_action("drain", member=mid,
+                                     reason="idle")
+
+        def _finish():
+            backend = self.router.get(mid)
+            leftover = None
+            if backend is not None:
+                try:
+                    leftover = backend.drain(self.drain_timeout_s)
+                    backend.close()
+                except Exception:    # noqa: BLE001 — drain must conclude
+                    pass
+            self.router.remove(mid)
+            self._record_action("drain_complete", member=mid,
+                                reason="idle", leftover=leftover,
+                                cooldown_free=True)
+
+        th = threading.Thread(target=_finish, name=f"fleet-drain-{mid}",
+                              daemon=True)
+        th.start()
+        with self._lock:
+            self._drain_threads.append(th)
+        return action
+
+    def _record_action(self, action: str, *, member: str, reason: str,
+                       cooldown_free: bool = False,
+                       **fields) -> Dict[str, Any]:
+        pool = len(self.router.member_ids())
+        record = {"t": time.time(), "action": action, "member": member,
+                  "reason": reason, "pool_size": pool, **fields}
+        with self._lock:
+            if not cooldown_free:
+                self._last_action_t = time.monotonic()
+            self._actions.append(record)
+        self._rec.event("fleet.action", **record)
+        self._rec.gauge("fleet.pool_size", pool)
+        return record
+
+    def _cooldown_ok(self) -> bool:
+        with self._lock:
+            last = self._last_action_t
+        return (last is None
+                or time.monotonic() - last >= self.cooldown_s)
+
+    # -- the reconciliation pass ----------------------------------------
+    def step(self) -> List[Dict[str, Any]]:
+        """One reconciliation pass; returns the actions taken (possibly
+        none). Ordering is deliberate: replace (healing — exempt from
+        the cooldown, a dead member helps nobody) before add (capacity)
+        before drain (economy)."""
+        actions: List[Dict[str, Any]] = []
+        member_stats: Dict[str, Dict] = {}
+        saturated: List[Dict[str, Any]] = []
+        for mid in self.router.member_ids():
+            backend = self.router.get(mid)
+            if backend is None:
+                continue
+            try:
+                stats = backend.stats()
+            except Exception:        # noqa: BLE001 — sick member ≈ dead
+                stats = {"dead": True}
+            member_stats[mid] = stats
+            try:
+                for sig in backend.firing():
+                    if sig.get("signal") in SCALE_UP_SIGNALS:
+                        saturated.append(
+                            {"member": mid, **{k: sig.get(k) for k in
+                                               ("signal", "severity",
+                                                "evidence")}})
+            except Exception:        # noqa: BLE001 — no signals ≠ no pool
+                pass
+
+        # 1. replace dead members (respawn budget exhausted)
+        for mid, stats in member_stats.items():
+            if stats.get("dead"):
+                actions.append(self._replace(mid, stats))
+
+        pool = len(self.router.member_ids())
+
+        # 2. add on saturation signals
+        if saturated and pool < self.max_size and self._cooldown_ok():
+            worst = saturated[0]
+            actions.append(self._add(
+                reason=worst.get("signal", "saturated"),
+                evidence=worst))
+            with self._lock:
+                self._idle_streak = 0
+
+        # 3. drain on sustained idleness
+        busy = (bool(saturated)
+                or any(s.get("n_inflight", 0) > 0
+                       for s in member_stats.values()))
+        with self._lock:
+            self._idle_streak = 0 if busy else self._idle_streak + 1
+            idle_ready = self._idle_streak >= self.idle_polls
+        if (idle_ready and not actions and pool > self.min_size
+                and self._cooldown_ok()):
+            draining = set(self.router.stats()["draining"])
+            candidates = [m for m in self.router.member_ids()
+                          if m not in draining]
+            if len(candidates) > self.min_size:
+                # newest first: the scale-up members go before the
+                # long-lived floor (their caches are the shared dir's,
+                # nothing member-local is lost)
+                victim = max(candidates,
+                             key=lambda m: int(m.lstrip("m") or 0)
+                             if m.lstrip("m").isdigit() else -1)
+                actions.append(self._drain(victim))
+                with self._lock:
+                    self._idle_streak = 0
+        with self._lock:
+            self._step_count += 1
+        return actions
+
+    @property
+    def steps(self) -> int:
+        """Completed reconciliation passes. Member spawn is synchronous
+        with the pass that decides it, so a caller that needs the pool
+        to reflect every decision made so far (artifact snapshots)
+        waits for this to advance rather than sleeping a poll interval."""
+        with self._lock:
+            return self._step_count
+
+    # -- the poll loop ---------------------------------------------------
+    def run(self) -> None:
+        """Blocking reconciliation loop (until :meth:`stop`)."""
+        self.ensure_min()
+        while not self._stop.wait(self.poll_s):
+            self.step()
+
+    def start(self) -> "FleetController":
+        self.ensure_min()
+        self._thread = threading.Thread(
+            target=self.run, name="fleet-controller", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, close_members: bool = False,
+             timeout: float = 120.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(self.poll_s * 4, 10.0))
+        with self._lock:
+            drainers = list(self._drain_threads)
+        for th in drainers:
+            th.join(timeout=self.drain_timeout_s + 10.0)
+        if close_members:
+            for mid in self.router.member_ids():
+                backend = self.router.remove(mid)
+                if backend is None:
+                    continue
+                try:
+                    backend.drain(timeout)
+                    backend.close()
+                except Exception:    # noqa: BLE001 — best-effort teardown
+                    pass
+
+    # -- read side -------------------------------------------------------
+    def actions(self) -> List[Dict[str, Any]]:
+        """The decision log (every ``fleet.action`` emitted), oldest
+        first — what the loadgen artifact banks."""
+        with self._lock:
+            return [dict(a) for a in self._actions]
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-ready controller state for the chemtop fleet panel and
+        the ingress ``/metrics`` reply."""
+        with self._lock:
+            idle_streak = self._idle_streak
+            last = self._last_action_t
+            n_actions = len(self._actions)
+            recent = [dict(a) for a in self._actions[-8:]]
+        return {
+            "pool_size": len(self.router.member_ids()),
+            "min_size": self.min_size, "max_size": self.max_size,
+            "cooldown_s": self.cooldown_s, "poll_s": self.poll_s,
+            "idle_streak": idle_streak,
+            "cooldown_remaining_s": (
+                0.0 if last is None else round(max(
+                    0.0, self.cooldown_s
+                    - (time.monotonic() - last)), 3)),
+            "n_actions": n_actions, "recent_actions": recent,
+        }

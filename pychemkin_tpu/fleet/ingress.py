@@ -1,0 +1,273 @@
+"""HTTP front door for a fleet: stdlib ``http.server`` over the router.
+
+The TCP transport (:mod:`pychemkin_tpu.serve.transport`) speaks a
+length-prefixed JSON protocol that wants a persistent client; the
+ingress maps the SAME payload schema onto plain HTTP so anything that
+can POST JSON can drive the fleet — curl, a load balancer health
+check, the ``--fleet`` loadgen — while the router underneath keeps the
+mech-affinity, fleet-wide quota, and loss re-routing guarantees.
+
+Endpoints:
+
+``POST /v1/submit``
+    Body mirrors the transport submit frame:
+    ``{"kind", "tenant"?, "deadline_ms"?, "timeout_s"?, "payload"}``.
+    Replies ``200 {"op": "result", "result": {...}}`` (the
+    ``ServeResult`` fields, exactly what ``result_to_wire`` puts on
+    the TCP wire — ``status``/``status_name`` make every failure
+    typed); ``429 {"op": "error", "error": "ServerOverloaded",
+    "retry_after_ms": ...}`` with a ``Retry-After`` header when the
+    fleet tenant quota rejects (the hint comes from the router's
+    observed request life — the HTTP spelling of ``retry_hint_ms()``);
+    ``503`` when no member is eligible; ``400`` for malformed
+    requests. A request on an admitted future NEVER hangs: the member
+    resolves it typed, the router re-routes a lost member, and the
+    handler's own wait cap returns ``504`` as a last resort.
+
+``GET /healthz``
+    ``200``/``503`` + per-member ``alive``/``accepting``/``draining``
+    — a load balancer's probe target.
+
+``GET /metrics``
+    One JSON scrape: router stats, controller state, and every
+    member's merged metrics reply (the chemtop fleet merge consumes
+    ``members`` directly).
+
+The ingress deliberately avoids importing the serve transport: it
+shares the payload schema by construction, not by import — the HTTP
+mapping has no business coupling to the TCP framing internals.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as futures_mod
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from .. import telemetry
+from ..serve.errors import ServerClosed, ServerOverloaded
+from ..telemetry import trace
+from .router import FleetRouter
+
+#: last-resort wait cap (s) for a submit with no deadline of its own —
+#: admitted futures always resolve, so this only bounds pathology
+DEFAULT_WAIT_S = 120.0
+
+
+def _jsonable(x: Any) -> Any:
+    """Numpy-tolerant JSON encoding (same contract as the transport's
+    encoder, restated here so the ingress never imports it)."""
+    if isinstance(x, dict):
+        return {k: _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    tolist = getattr(x, "tolist", None)
+    if tolist is not None and not isinstance(x, (str, bytes)):
+        return tolist()
+    item = getattr(x, "item", None)
+    if item is not None and not isinstance(x, (str, bytes)):
+        return item()
+    return x
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One HTTP exchange. The ingress instance rides on the server
+    object (``self.server.ingress``)."""
+
+    protocol_version = "HTTP/1.1"
+
+    # the stdlib logs every request to stderr; the fleet's story lives
+    # in telemetry, not interleaved with the operator's terminal
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        pass
+
+    def _reply(self, code: int, obj: Dict[str, Any],
+               headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(_jsonable(obj)).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib dispatch name
+        ingress = self.server.ingress
+        if self.path == "/healthz":
+            code, doc = ingress.healthz()
+            self._reply(code, doc)
+        elif self.path == "/metrics":
+            self._reply(200, ingress.metrics())
+        else:
+            self._reply(404, {"op": "error", "error": "NotFound",
+                              "message": self.path})
+
+    def do_POST(self) -> None:  # noqa: N802 — stdlib dispatch name
+        ingress = self.server.ingress
+        if self.path not in ("/v1/submit", "/submit"):
+            self._reply(404, {"op": "error", "error": "NotFound",
+                              "message": self.path})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n).decode("utf-8"))
+            if not isinstance(req, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as exc:
+            self._reply(400, {"op": "error", "error": "BadRequest",
+                              "message": str(exc)})
+            return
+        code, doc, headers = ingress.handle_submit(req)
+        self._reply(code, doc, headers)
+
+
+class FleetIngress:
+    """The fleet's HTTP front door. ``controller`` is optional — when
+    present its state rides on ``/metrics`` so one scrape tells the
+    whole elastic story."""
+
+    def __init__(self, router: FleetRouter, *, controller=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 recorder=None):
+        self.router = router
+        self.controller = controller
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.ingress = self
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "FleetIngress":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-ingress",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "FleetIngress":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- request handling (transport-agnostic, unit-testable) -----------
+    def handle_submit(self, req: Dict[str, Any]
+                      ) -> Tuple[int, Dict[str, Any],
+                                 Optional[Dict[str, str]]]:
+        """Map one submit body onto the router; returns
+        ``(http_status, reply_doc, extra_headers)``."""
+        self._rec.inc("fleet.http.requests")
+        kind = req.get("kind")
+        payload = req.get("payload")
+        if not isinstance(kind, str) or not isinstance(payload, dict):
+            return 400, {"op": "error", "error": "BadRequest",
+                         "message": "need string 'kind' and object "
+                                    "'payload'"}, None
+        tenant = req.get("tenant")
+        if tenant is not None:
+            tenant = str(tenant)
+        deadline_ms = req.get("deadline_ms")
+        try:
+            fut = self.router.submit(
+                kind, tenant=tenant,
+                deadline_ms=(None if deadline_ms is None
+                             else float(deadline_ms)),
+                # same rule as the TCP wire: a "trace" key PRESENT
+                # (even null) is the client's sampling decision;
+                # missing means the router draws one
+                trace_id=(req["trace"] if "trace" in req
+                          else trace.UNSET),
+                **payload)
+        except ServerOverloaded as exc:
+            self._rec.inc("fleet.http.rejected")
+            retry_ms = float(exc.retry_after_ms
+                             if exc.retry_after_ms is not None
+                             else self.router.retry_hint_ms())
+            return 429, {"op": "error", "error": "ServerOverloaded",
+                         "message": str(exc),
+                         "queue_depth": exc.queue_depth,
+                         "retry_after_ms": retry_ms}, {
+                "Retry-After": str(max(1, int(retry_ms / 1000.0 + 1)))}
+        except ServerClosed as exc:
+            self._rec.inc("fleet.http.rejected")
+            return 503, {"op": "error", "error": "ServerClosed",
+                         "message": str(exc)}, None
+        except KeyError as exc:
+            return 400, {"op": "error", "error": "BadRequest",
+                         "message": str(exc)}, None
+        wait_s = float(req.get("timeout_s") or (
+            DEFAULT_WAIT_S if deadline_ms is None
+            else float(deadline_ms) / 1e3 + 30.0))
+        try:
+            result = fut.result(timeout=wait_s)
+        except ServerClosed as exc:
+            return 503, {"op": "error", "error": "ServerClosed",
+                         "message": str(exc)}, None
+        except futures_mod.TimeoutError:
+            return 504, {"op": "error", "error": "Timeout",
+                         "message": f"no resolution in {wait_s}s"}, None
+        except Exception as exc:     # noqa: BLE001 — typed error reply
+            return 500, {"op": "error",
+                         "error": type(exc).__name__,
+                         "message": str(exc)}, None
+        return 200, {"op": "result",
+                     "result": dict(result._asdict())}, None
+
+    # -- read endpoints --------------------------------------------------
+    def healthz(self) -> Tuple[int, Dict[str, Any]]:
+        members = {}
+        n_ok = 0
+        for mid in self.router.member_ids():
+            backend = self.router.get(mid)
+            if backend is None:
+                continue
+            try:
+                alive = bool(getattr(backend, "alive", True))
+                accepting = bool(getattr(backend, "accepting", True))
+            except Exception:        # noqa: BLE001 — probe must answer
+                alive = accepting = False
+            members[mid] = {"alive": alive, "accepting": accepting}
+            if alive:
+                n_ok += 1
+        ok = n_ok > 0
+        return (200 if ok else 503), {
+            "ok": ok, "t": time.time(), "pool_size": len(members),
+            "n_alive": n_ok, "members": members}
+
+    def metrics(self) -> Dict[str, Any]:
+        doc: Dict[str, Any] = {"t": time.time(),
+                               "router": self.router.stats()}
+        if self.controller is not None:
+            doc["controller"] = self.controller.state()
+        members = {}
+        for mid in self.router.member_ids():
+            backend = self.router.get(mid)
+            if backend is None:
+                continue
+            try:
+                members[mid] = backend.metrics()
+            except Exception as exc:  # noqa: BLE001 — scrape must land
+                members[mid] = {
+                    "error": f"{type(exc).__name__}: {exc}"}
+        doc["members"] = members
+        return doc

@@ -1,0 +1,391 @@
+"""Mechanism/tenant-aware consistent routing: the fleet's front tier.
+
+Steady-state chemistry throughput is batch-occupancy throughput
+(arXiv:2005.11468), and occupancy only survives fleet scale if
+same-mechanism traffic COALESCES: two backends each half-filling a
+bucket ladder solve the same work twice as slowly as one full ladder.
+The router therefore hashes on the MECHANISM signature — rendezvous
+(highest-random-weight) hashing over the member pool — so every
+request for one mech lands on the same backend while it is healthy,
+and the load-balanced many-chemistry placement problem of
+arXiv:2112.05834 reduces to key placement:
+
+- **stability**: adding/removing a member moves only the keys whose
+  winning member changed (~1/N of them) — every other mech keeps its
+  warm backend, its formed batches, and its compile cache locality;
+- **graceful drain**: a member entering drain stops winning NEW
+  assignments but finishes what it holds (the zero-loss drain
+  contract — :meth:`pychemkin_tpu.serve.supervisor.Supervisor.drain`);
+- **loss re-routing**: a member lost mid-request resolves through the
+  supervisor's typed ``BACKEND_LOST`` path, and the router re-submits
+  to the next-ranked member with the REMAINING deadline — the caller
+  sees OK or a typed status, never a hang;
+- **bounded-load spill**: affinity holds until the winning member
+  pushes back (``ServerOverloaded``); the overflow then goes to the
+  next-ranked member — which is how a freshly added scale-up member
+  starts absorbing a single-mechanism ramp within one poll instead of
+  idling behind a saturated primary.
+
+Tenant quotas are honored FLEET-WIDE: the per-backend transport quota
+bounds one process, the router's quota bounds the tenant across the
+pool, so scale-up does not silently multiply a tenant's admission.
+
+Pure routing core (:func:`rendezvous_rank`, :func:`route_key`,
+:func:`assignments`) is separated from the threaded dispatch layer so
+the stability/affinity/redistribution properties are testable without
+processes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from .. import telemetry
+from ..resilience.status import SolveStatus
+from ..serve.errors import ServerClosed, ServerOverloaded, \
+    TransportClosed
+from ..serve.futures import ServeFuture
+from ..telemetry import trace
+
+#: fallback overload backoff hint (ms) before any result has been
+#: observed — one default batch window's worth, deliberately small
+DEFAULT_RETRY_HINT_MS = 50.0
+
+
+# ---------------------------------------------------------------------------
+# pure routing core
+
+def rendezvous_rank(key: str, member_ids: Iterable[str]) -> List[str]:
+    """Members ordered by highest-random-weight for ``key`` (best
+    first). Pure and deterministic: the winner only changes for a key
+    when the winner itself joins or leaves the pool — the consistent-
+    routing property every fleet test pins."""
+    def weight(mid: str) -> int:
+        digest = hashlib.sha256(
+            f"{mid}|{key}".encode("utf-8")).digest()
+        return int.from_bytes(digest[:8], "big")
+    return sorted(member_ids, key=lambda m: (weight(m), m),
+                  reverse=True)
+
+
+def route_key(mech: str) -> str:
+    """The routing key of one request: the mechanism signature alone.
+    Tenancy is deliberately NOT part of the key — two tenants sharing
+    a mech must share batches (occupancy is the throughput), and the
+    fleet-wide tenant quota bounds them without forking placement."""
+    return str(mech)
+
+
+def assignments(keys: Sequence[str], member_ids: Iterable[str]
+                ) -> Dict[str, Optional[str]]:
+    """Winning member per key (None with an empty pool) — the pure
+    placement map the property tests diff across pool changes."""
+    ids = list(member_ids)
+    return {k: (rendezvous_rank(k, ids)[0] if ids else None)
+            for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# threaded dispatch layer
+
+class _Route:
+    """One admitted request's routing state: which members were
+    burned, the absolute deadline its re-routes must respect."""
+
+    __slots__ = ("kind", "tenant", "payload", "future", "deadline",
+                 "trace_id", "tried", "t_submit")
+
+    def __init__(self, kind, tenant, payload, deadline, trace_id):
+        self.kind = kind
+        self.tenant = tenant
+        self.payload = payload
+        self.future = ServeFuture()
+        self.deadline = deadline     # absolute perf_counter, or None
+        self.trace_id = trace_id
+        self.tried: set = set()
+        self.t_submit = time.perf_counter()
+
+
+class FleetRouter:
+    """Routes requests across a pool of supervised backends (anything
+    with ``submit(kind, tenant=, deadline_ms=, trace_id=, **payload)``
+    → future, plus ``alive``/``accepting``; a
+    :class:`~pychemkin_tpu.serve.supervisor.Supervisor` natively).
+
+    ``tenants`` is the same ``{name: {"mech", "quota"}}`` block the
+    transport config carries; the router resolves tenant → mech for
+    the routing key and enforces each quota across the WHOLE pool.
+    """
+
+    def __init__(self, tenants: Optional[Dict[str, Dict]] = None,
+                 recorder=None, default_tenant: str = "default"):
+        self.default_tenant = str(default_tenant)
+        self._rec = (recorder if recorder is not None
+                     else telemetry.get_recorder())
+        self._lock = threading.RLock()
+        self._members: Dict[str, Any] = {}       # guarded-by: _lock
+        self._draining: set = set()              # guarded-by: _lock
+        self._assigned: Dict[str, int] = {}      # guarded-by: _lock
+        self._reroutes = 0                       # guarded-by: _lock
+        self._rejected = 0                       # guarded-by: _lock
+        self._inflight: Dict[str, int] = {}      # guarded-by: _lock
+        self._latency_ms: Optional[float] = None  # guarded-by: _lock
+        self._tenants = {
+            str(name): {"mech": str(spec.get("mech", name)),
+                        "quota": int(spec.get("quota", 64))}
+            for name, spec in (tenants or {}).items()}
+        if self.default_tenant not in self._tenants:
+            self._tenants[self.default_tenant] = {
+                "mech": self.default_tenant, "quota": 64}
+
+    # -- pool management -------------------------------------------------
+    def add(self, member_id: str, backend: Any) -> None:
+        with self._lock:
+            self._members[str(member_id)] = backend
+            self._draining.discard(str(member_id))
+
+    def remove(self, member_id: str) -> Optional[Any]:
+        with self._lock:
+            self._draining.discard(str(member_id))
+            return self._members.pop(str(member_id), None)
+
+    def start_drain(self, member_id: str) -> None:
+        """Stop assigning NEW work to a member; it keeps whatever it
+        already holds (the supervisor-side :meth:`drain` finishes
+        those). Keys it was winning redistribute to the next-ranked
+        member without touching any healthy member's assignments."""
+        with self._lock:
+            if member_id in self._members:
+                self._draining.add(str(member_id))
+
+    def member_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def get(self, member_id: str) -> Optional[Any]:
+        with self._lock:
+            return self._members.get(str(member_id))
+
+    def _eligible(self) -> Dict[str, Any]:
+        """Members that may win NEW assignments: present, not
+        draining, alive, and accepting submits."""
+        with self._lock:
+            pool = {mid: b for mid, b in self._members.items()
+                    if mid not in self._draining}
+        out = {}
+        for mid, backend in pool.items():
+            try:
+                if getattr(backend, "alive", True) and \
+                        getattr(backend, "accepting", True):
+                    out[mid] = backend
+            except Exception:        # noqa: BLE001 — a sick member is skipped
+                continue
+        return out
+
+    # -- request path ----------------------------------------------------
+    def tenant_mech(self, tenant: str) -> str:
+        spec = self._tenants.get(str(tenant))
+        if spec is None:
+            raise KeyError(f"unknown tenant {tenant!r}")
+        return spec["mech"]
+
+    def retry_hint_ms(self) -> float:
+        """Backoff hint for a rejected caller: the recent typical
+        request life (EMA of queue wait + solve) — after that long at
+        least one in-flight slot has freed."""
+        with self._lock:
+            hint = self._latency_ms
+        return round(float(hint if hint is not None
+                           else DEFAULT_RETRY_HINT_MS), 3)
+
+    def submit(self, kind: str, *, tenant: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               trace_id=trace.UNSET, **payload) -> ServeFuture:
+        """Admit one request fleet-wide. Raises
+        :class:`ServerOverloaded` (fleet tenant quota) or
+        :class:`ServerClosed` (no eligible member) at the call site;
+        an ADMITTED request's future always resolves — OK, a typed
+        status (``BACKEND_LOST`` only after re-routing is exhausted),
+        or the member's typed error — never a hang."""
+        tenant = (self.default_tenant if tenant is None
+                  else str(tenant))
+        spec = self._tenants.get(str(tenant))
+        if spec is None:
+            raise KeyError(f"unknown tenant {tenant!r}")
+        with self._lock:
+            inflight = self._inflight.get(tenant, 0)
+            if inflight >= spec["quota"]:
+                self._rejected += 1
+                over = True
+            else:
+                self._inflight[tenant] = inflight + 1
+                over = False
+        if over:
+            self._rec.inc("fleet.rejected")
+            raise ServerOverloaded(
+                f"tenant {tenant!r} fleet-wide quota "
+                f"({spec['quota']}) saturated",
+                queue_depth=spec["quota"],
+                retry_after_ms=self.retry_hint_ms())
+        t_submit = time.perf_counter()
+        route = _Route(
+            kind=kind, tenant=tenant, payload=dict(payload),
+            deadline=(None if deadline_ms is None
+                      else t_submit + float(deadline_ms) * 1e-3),
+            trace_id=trace.resolve_trace_id(trace_id))
+        self._rec.inc("fleet.requests")
+        try:
+            sent = self._dispatch(route, first=True)
+        except BaseException:
+            self._finish_tenant(tenant)
+            raise
+        if not sent:
+            self._finish_tenant(tenant)
+            raise ServerClosed("no eligible fleet member")
+        return route.future
+
+    def _finish_tenant(self, tenant: str) -> None:
+        with self._lock:
+            self._inflight[tenant] = max(
+                0, self._inflight.get(tenant, 0) - 1)
+
+    def _resolve(self, route: _Route, result=None, exc=None) -> None:
+        self._finish_tenant(route.tenant)
+        if result is not None:
+            with self._lock:
+                life_ms = (time.perf_counter()
+                           - route.t_submit) * 1e3
+                self._latency_ms = (
+                    life_ms if self._latency_ms is None
+                    else 0.8 * self._latency_ms + 0.2 * life_ms)
+        try:
+            if exc is not None:
+                route.future.set_exception(exc)
+            else:
+                route.future.set_result(result)
+        except Exception:            # noqa: BLE001 — racing resolution
+            pass
+
+    def _dispatch(self, route: _Route, first: bool = False) -> bool:
+        """Send ``route`` to the best untried eligible member; returns
+        False when none is left. On the FIRST attempt failures raise
+        at the call site; on re-routes everything resolves through the
+        future (callback context must never raise)."""
+        mech = self.tenant_mech(route.tenant)
+        eligible = self._eligible()
+        overloaded: Optional[ServerOverloaded] = None
+        for mid in rendezvous_rank(route_key(mech), eligible):
+            if mid in route.tried:
+                continue
+            backend = eligible[mid]
+            remaining_ms = None
+            if route.deadline is not None:
+                remaining_ms = (route.deadline
+                                - time.perf_counter()) * 1e3
+                if remaining_ms <= 0.0:
+                    # expired between hops: the supervisor would
+                    # resolve it DEADLINE_EXCEEDED anyway — let the
+                    # best member do that (typed, never a hang)
+                    remaining_ms = 0.0
+            route.tried.add(mid)
+            try:
+                member_fut = backend.submit(
+                    route.kind, tenant=route.tenant,
+                    deadline_ms=remaining_ms,
+                    trace_id=route.trace_id, **route.payload)
+            except (ServerClosed, TransportClosed):
+                continue             # raced into drain/death: next
+            except ServerOverloaded as exc:
+                # bounded-load spill: affinity holds until the winner
+                # pushes back, then the next-ranked member absorbs
+                # the overflow (how a fresh scale-up member starts
+                # taking a single-mech ramp's traffic)
+                overloaded = exc
+                continue
+            with self._lock:
+                self._assigned[mid] = self._assigned.get(mid, 0) + 1
+            member_fut.add_done_callback(
+                lambda f, r=route, m=mid: self._on_member_done(
+                    r, m, f))
+            return True
+        if overloaded is not None:
+            # every eligible member pushed back: the fleet really IS
+            # full — surface the overload (typed backpressure), at the
+            # call site on first attempt, through the future after
+            if first:
+                raise overloaded
+            self._resolve(route, exc=overloaded)
+            return True
+        return False
+
+    def _on_member_done(self, route: _Route, member_id: str,
+                        fut: ServeFuture) -> None:
+        exc = fut.exception()
+        if exc is not None:
+            if isinstance(exc, (ServerClosed, TransportClosed)):
+                # the member went away under the request: re-route
+                self._reroute(route, member_id, reason=type(
+                    exc).__name__)
+                return
+            if isinstance(exc, ServerOverloaded):
+                # transport-path pushback (the refusal rode the
+                # future): same bounded-load spill as at submit
+                self._reroute(route, member_id,
+                              reason="ServerOverloaded",
+                              fallback_exc=exc)
+                return
+            self._resolve(route, exc=exc)
+            return
+        result = fut.result()
+        if int(result.status) == int(SolveStatus.BACKEND_LOST):
+            # the member's OWN respawn budget is spent; the fleet has
+            # more members — re-submit with the remaining deadline
+            self._reroute(route, member_id, reason="BACKEND_LOST",
+                          fallback=result)
+            return
+        self._resolve(route, result=result)
+
+    def _reroute(self, route: _Route, member_id: str, *,
+                 reason: str, fallback=None,
+                 fallback_exc=None) -> None:
+        expired = (route.deadline is not None
+                   and time.perf_counter() >= route.deadline)
+        if not expired:
+            with self._lock:
+                self._reroutes += 1
+            self._rec.inc("fleet.reroutes")
+            trace.emit_span(
+                self._rec, route.trace_id, "fleet.reroute",
+                (time.perf_counter() - route.t_submit) * 1e3,
+                member=member_id, reason=reason)
+            if self._dispatch(route):
+                return
+        if fallback is not None:
+            self._resolve(route, result=fallback)
+        elif fallback_exc is not None:
+            self._resolve(route, exc=fallback_exc)
+        else:
+            self._resolve(route, exc=ServerClosed(
+                f"member {member_id} lost ({reason}); no eligible "
+                "member left to re-route to"))
+
+    # -- read side -------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """JSON-ready routing state: per-member assignment counts,
+        re-routes, fleet-wide tenant in-flight vs quota, drain set."""
+        with self._lock:
+            return {
+                "members": sorted(self._members),
+                "draining": sorted(self._draining),
+                "assigned": dict(self._assigned),
+                "reroutes": self._reroutes,
+                "rejected": self._rejected,
+                "tenants": {
+                    name: {"inflight": self._inflight.get(name, 0),
+                           "quota": spec["quota"],
+                           "mech": spec["mech"]}
+                    for name, spec in sorted(self._tenants.items())},
+            }

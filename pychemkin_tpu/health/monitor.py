@@ -35,13 +35,17 @@ class HealthMonitor:
 
     def __init__(self, recorder=None,
                  history_path: Optional[str] = None,
-                 rules=None, ring_cap: Optional[int] = None):
+                 rules=None, ring_cap: Optional[int] = None,
+                 member: Optional[str] = None):
         if ring_cap is None:
             ring_cap = knobs.value("PYCHEMKIN_HEALTH_RING")
         self.history_path = history_path
+        #: fleet-member id this monitor's whole series is scoped to
+        #: (ISSUE 18); None = unscoped (single backend / merged fleet)
+        self.member = member
         self._ring = SnapshotRing(cap=ring_cap)  # guarded-by: _lock
-        self._engine = HealthEngine(rules=rules,
-                                    recorder=recorder)  # guarded-by: _lock
+        self._engine = HealthEngine(rules=rules, recorder=recorder,
+                                    member=member)  # guarded-by: _lock
         self._history_error: Optional[str] = None  # guarded-by: _lock
         self._n_samples = 0                        # guarded-by: _lock
         self._lock = threading.Lock()
@@ -52,7 +56,7 @@ class HealthMonitor:
         """Feed one metrics reply (any surface shape — see
         :func:`~.timeseries.normalize_sample`); returns the evaluated
         per-signal state."""
-        sample = normalize_sample(reply, t=t)
+        sample = normalize_sample(reply, t=t, member=self.member)
         with self._lock:
             self._ring.append(sample)
             signals = self._engine.evaluate(self._ring)
@@ -99,6 +103,8 @@ class HealthMonitor:
                 "timeline": self._engine.timeline(),
                 "restarts": window.restarts if window else 0,
             }
+            if self.member is not None:
+                out["member"] = self.member
             if self.history_path:
                 out["history_path"] = self.history_path
             if self._history_error:

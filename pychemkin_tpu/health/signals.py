@@ -306,10 +306,18 @@ class HealthEngine:
 
     Single-threaded by design (the chemtop poll loop, or the
     monitor's sampler thread under the monitor's lock); hand one
-    engine to one caller."""
+    engine to one caller.
+
+    ``member`` scopes the engine to one fleet member (ISSUE 18):
+    every signal state, timeline entry, and ``health.signal`` event
+    carries the member id, so a pool of per-backend engines yields
+    per-member firing — the fleet controller's replace decision reads
+    WHICH backend is down, not just that one is."""
 
     def __init__(self, rules=None, recorder=None,
-                 max_timeline: int = 512):
+                 max_timeline: int = 512,
+                 member: Optional[str] = None):
+        self.member = member
         self.rules: List[Dict[str, Any]] = [
             dict(r) for r in (DEFAULT_RULES if rules is None
                               else rules)]
@@ -348,6 +356,8 @@ class HealthEngine:
                   "state": state, "window_s": _window_s(rule),
                   "evidence": dict(st.evidence),
                   "fired_at": st.fired_at, "cleared_at": st.cleared_at}
+        if self.member is not None:
+            record["member"] = self.member
         self._timeline.append(record)
         del self._timeline[:-self._max_timeline]
         if self._rec is not None:
@@ -356,7 +366,8 @@ class HealthEngine:
                 severity=record["severity"], state=state,
                 window_s=record["window_s"],
                 evidence=record["evidence"],
-                fired_at=st.fired_at, cleared_at=st.cleared_at)
+                fired_at=st.fired_at, cleared_at=st.cleared_at,
+                member=self.member)
 
     def evaluate(self, ring: SnapshotRing,
                  t: Optional[float] = None) -> List[Dict[str, Any]]:
@@ -408,7 +419,7 @@ class HealthEngine:
         out = []
         for rule in self.rules:
             st = self._state[rule["name"]]
-            out.append({
+            entry = {
                 "signal": rule["name"],
                 "severity": rule.get("severity", "warn"),
                 "state": "firing" if st.firing else "ok",
@@ -419,7 +430,10 @@ class HealthEngine:
                 "recent": "".join(
                     _SPARK_FIRING if b else _SPARK_OK
                     for b in st.recent),
-            })
+            }
+            if self.member is not None:
+                entry["member"] = self.member
+            out.append(entry)
         return out
 
     def timeline(self) -> List[Dict[str, Any]]:

@@ -55,7 +55,8 @@ def _mean(values: Iterable[Optional[float]]) -> Optional[float]:
 
 
 def normalize_sample(reply: Optional[Dict[str, Any]],
-                     t: Optional[float] = None) -> Dict[str, Any]:
+                     t: Optional[float] = None,
+                     member: Optional[str] = None) -> Dict[str, Any]:
     """One canonical fleet-health sample from any of the metrics
     surfaces: a chemtop merged fleet snapshot (``merge_fleet``), a
     single backend's ``metrics`` reply, or ``Supervisor.metrics()``'s
@@ -64,10 +65,16 @@ def normalize_sample(reply: Optional[Dict[str, Any]],
     the health layer must keep deriving exactly when the fleet is
     unhealthy.
 
+    ``member`` tags the sample with the fleet-member id the series
+    belongs to (ISSUE 18): a per-backend monitor scopes its whole
+    history to one backend, so rules fire per-member instead of one
+    sick backend masking (or being masked by) the fleet aggregate.
+
     Shape: ``{"t", "n_alive", "n_backends", "generations", "errors",
-    "counters", "gauges", "hist_states"}`` — JSON-ready, so the same
-    dict rides the in-memory ring, the JSONL history file, and the
-    ``chemtop --check-signals`` replay."""
+    "counters", "gauges", "hist_states"}`` (plus ``"member"`` when
+    scoped) — JSON-ready, so the same dict rides the in-memory ring,
+    the JSONL history file, and the ``chemtop --check-signals``
+    replay."""
     reply = dict(reply or {})
     counters: Dict[str, int] = {}
     gauges: Dict[str, Optional[float]] = {}
@@ -125,7 +132,7 @@ def normalize_sample(reply: Optional[Dict[str, Any]],
                 counters[f"supervisor.{k}"] = (
                     counters.get(f"supervisor.{k}", 0)
                     + int(sup.get(k) or 0))
-    return {
+    out = {
         "t": float(t if t is not None else time.time()),
         "n_alive": n_alive,
         "n_backends": n_backends,
@@ -136,6 +143,11 @@ def normalize_sample(reply: Optional[Dict[str, Any]],
         "gauges": gauges,
         "hist_states": hist_states,
     }
+    if member is None:
+        member = reply.get("member")
+    if member is not None:
+        out["member"] = str(member)
+    return out
 
 
 def _authoritative(sample: Dict[str, Any]) -> bool:

@@ -447,6 +447,40 @@ register(
     "chemtop --check-signals). Unset disables banking.",
     _str, "health")
 
+# -- fleet (pychemkin_tpu/fleet): autoscaling controller bounds ------------
+# same observability-must-not-crash semantics as the health group: a
+# garbage bound must not take down the controller mid-incident
+
+register(
+    "PYCHEMKIN_FLEET_MIN", "int", 1,
+    "Minimum pool size the fleet controller will drain down to. "
+    "Unparseable values fall back.",
+    _int("PYCHEMKIN_FLEET_MIN", on_invalid="default",
+         default=1, lo=1),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_MAX", "int", 4,
+    "Maximum pool size the fleet controller will scale up to. "
+    "Unparseable values fall back.",
+    _int("PYCHEMKIN_FLEET_MAX", on_invalid="default",
+         default=4, lo=1),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_COOLDOWN_S", "float", 30.0,
+    "Minimum seconds between two fleet controller actions (add/"
+    "drain/replace) — one action, then observe its effect before "
+    "the next. Unparseable values fall back.",
+    _float("PYCHEMKIN_FLEET_COOLDOWN_S", on_invalid="default",
+           default=30.0),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_POLL_S", "float", 2.0,
+    "Reconciliation poll interval of the fleet controller's run "
+    "loop (seconds). Unparseable values fall back.",
+    _float("PYCHEMKIN_FLEET_POLL_S", on_invalid="default",
+           default=2.0),
+    "fleet")
+
 register(
     "PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS", "int", 2,
     "Backend respawn budget for a supervisor's lifetime.",

@@ -153,7 +153,8 @@ class Supervisor:
                  recorder=None,
                  kill_report_dir: Optional[str] = None,
                  health_history_path: Optional[str] = None,
-                 health_sample_s: float = 2.0):
+                 health_sample_s: float = 2.0,
+                 member: Optional[str] = None):
         self.config = dict(config or {})
         self.host = host
         self._backend_argv = backend_argv
@@ -179,8 +180,13 @@ class Supervisor:
                     health_dir,
                     f"health_{os.getpid()}_{next(_HEALTH_SEQ)}.jsonl")
         self.health_sample_s = float(health_sample_s)
+        #: fleet-member id (ISSUE 18): scopes this supervisor's whole
+        #: health-signal series — a pool of supervisors yields
+        #: per-member firing, the controller's replace decision input
+        self.member = member
         self._health = HealthMonitor(recorder=self._rec,
-                                     history_path=health_history_path)
+                                     history_path=health_history_path,
+                                     member=member)
         self._last_pong: Optional[float] = None  # guarded-by: _lock
         self._lock = threading.RLock()
         self._proc: Optional[subprocess.Popen] = None  # guarded-by: _lock
@@ -194,6 +200,11 @@ class Supervisor:
         self._lost_requests = 0                  # guarded-by: _lock
         self._lost_reason: Optional[str] = None  # guarded-by: _lock
         self._draining = False                   # guarded-by: _lock
+        # drain() sets ONLY this: submits are refused while the
+        # respawn/re-submit machinery stays live, so a backend dying
+        # mid-drain still heals its in-flight requests (close() would
+        # park the monitor loop and lose them)
+        self._refusing = False                   # guarded-by: _lock
         self._dead = False                       # guarded-by: _lock
         self._started = False                    # guarded-by: _lock
         self._monitor: Optional[threading.Thread] = None
@@ -333,16 +344,27 @@ class Supervisor:
             return (not self._dead and self._proc is not None
                     and self._proc.poll() is None)
 
+    @property
+    def accepting(self) -> bool:
+        """Whether a new submit would be admitted (started, not
+        draining, not dead) — what the fleet router checks before
+        assigning a request to this member."""
+        with self._lock:
+            return (self._started and not self._draining
+                    and not self._refusing and not self._dead)
+
     def stats(self) -> Dict[str, Any]:
         """JSON-ready supervisor-side counters (the soak artifact's
         ``supervisor`` block)."""
         with self._lock:
-            return {"generation": self._respawns,
+            return {"member": self.member,
+                    "generation": self._respawns,
                     "respawns": self._respawns,
                     "max_respawns": self.max_respawns,
                     "resubmits": self._resubmits,
                     "backend_lost_requests": self._lost_requests,
                     "n_inflight": len(self._inflight),
+                    "draining": self._draining or self._refusing,
                     "alive": (self._proc is not None
                               and self._proc.poll() is None),
                     "dead": self._dead}
@@ -386,6 +408,13 @@ class Supervisor:
         (what the loadgen soak artifact banks under ``"health"``)."""
         return self._health.state()
 
+    def firing(self, min_severity: str = "warn"
+               ) -> List[Dict[str, Any]]:
+        """Currently-firing health signals at/above ``min_severity``,
+        scoped to this member's series — the fleet controller's
+        scale/replace decision input."""
+        return self._health.firing(min_severity)
+
     def install_signal_handlers(self) -> GracefulStop:
         """SIGTERM/SIGINT → graceful drain (flag only; the heartbeat
         thread notices and starts :meth:`close`)."""
@@ -410,7 +439,7 @@ class Supervisor:
         healed or ``BACKEND_LOST`` request's trace shows the dead
         generation it rode through."""
         with self._lock:
-            if self._draining or self._dead:
+            if self._draining or self._refusing or self._dead:
                 raise ServerClosed(
                     "supervisor is draining or backend is lost")
             if not self._started:
@@ -849,6 +878,34 @@ class Supervisor:
             self._try_send(entry)
 
     # -- shutdown --------------------------------------------------------
+    def drain(self, timeout: float = 60.0) -> int:
+        """Refuse new submits and wait for the in-flight requests to
+        resolve — WITHOUT touching the backend process or the
+        respawn machinery. The fleet controller's first half of
+        removing a member: a backend that dies mid-drain still gets
+        its in-flight healed by respawn + re-submission, and only a
+        drain that returned 0 may be followed by :meth:`close`
+        without risking adopted requests (the controller must never
+        SIGKILL a backend that still holds them).
+
+        Idempotent: repeat calls keep refusing and wait again.
+        Returns the typed leftover count — in-flight requests still
+        unresolved when ``timeout`` passed (0 = zero-loss drain;
+        every request resolved OK or with a typed status)."""
+        with self._lock:
+            self._refusing = True
+        deadline = time.perf_counter() + max(0.0, float(timeout))
+        while True:
+            with self._lock:
+                leftover = len(self._inflight)
+            if leftover == 0 or time.perf_counter() >= deadline:
+                break
+            time.sleep(0.01)
+        self._rec.event("supervisor.drain_wait", leftover=leftover,
+                        timeout_s=float(timeout),
+                        member=self.member)
+        return leftover
+
     def close(self, timeout: float = 120.0) -> bool:
         """Graceful stop: SIGTERM the backend (its ``GracefulStop``
         drains every ChemServer; replies flush back), wait for it to

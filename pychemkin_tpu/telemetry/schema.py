@@ -35,6 +35,11 @@ COUNTERS = (
     "checkpoint.saves",
     "driver.retries",
     "flame.programs_built",
+    "fleet.http.requests",
+    "fleet.http.rejected",
+    "fleet.rejected",
+    "fleet.requests",
+    "fleet.reroutes",
     "flame.solves",
     "linalg.pivot_fallback",
     "linalg.refine_stagnated",
@@ -95,6 +100,7 @@ COUNTER_PREFIXES = (
 # -- gauges -----------------------------------------------------------------
 
 GAUGES = (
+    "fleet.pool_size",
     "schedule.predictor_corr",
     "serve.queue_depth",
 )
@@ -140,6 +146,7 @@ EVENTS = (
     "driver.reexec_failed",
     "driver.retry",
     "flame",
+    "fleet.action",
     "health.signal",
     "odeint",
     "rescue",
@@ -161,6 +168,7 @@ EVENTS = (
     "staging.failed",
     "supervisor.backend_lost",
     "supervisor.drain",
+    "supervisor.drain_wait",
     "supervisor.kill_report",
     "supervisor.kill_report_failed",
     "supervisor.respawn_exhausted",
@@ -201,6 +209,7 @@ HEALTH_EVENT_FIELDS = (
     "evidence",
     "fired_at",
     "cleared_at",
+    "member",
 )
 
 # -- program observatory ----------------------------------------------------
@@ -232,6 +241,7 @@ TIMER_PREFIXES = ()
 
 SPANS = (
     "client.wire",
+    "fleet.reroute",
     "rescue.rung",
     "serve.admission",
     "serve.batch_window",

@@ -48,12 +48,16 @@ Behaviour:
 - ``--chaos`` is the PROCESS-level counterpart for the serving path:
   children get ``PYCHEMKIN_PROC_FAULTS`` set to a canned
   kill-backend-at-request spec (unless already exported) and — when no
-  files are named — the run is restricted to ``tests/test_serve_transport.py``,
+  files are named — the run is restricted to
+  ``tests/test_serve_transport.py`` and ``tests/test_fleet.py``,
   whose env-gated chaos tests spawn supervised backends that inherit
   the spec. Every chaos recovery path (kill / hang / poison) runs in
-  CI on CPU this way; the file's deterministic tests scrub the env var
+  CI on CPU this way; the files' deterministic tests scrub the env var
   themselves (autouse fixture), so the canned spec cannot leak into
-  them;
+  them. A fleet chaos soak additionally banks its controller action
+  log (``fleet_actions*.jsonl`` in the kill dir) and the suite fails
+  rc 1 unless some new log carries a typed ``replace`` decision — the
+  elastic kill-one-member healing path is CI-enforced;
 - ``--lint`` runs the chemlint static-analysis ratchet
   (``pychemkin_tpu/lint``, importlib-loaded STANDALONE like the
   summary sink — this orchestrator never imports jax) BEFORE the
@@ -417,6 +421,11 @@ def main(argv=None):
             files.append(os.path.join(here, "test_resilience.py"))
         if chaos:
             files.append(os.path.join(here, "test_serve_transport.py"))
+            # the fleet's env-gated chaos soak (ISSUE 18): a member is
+            # killed mid-load with its respawn budget zeroed, and the
+            # suite gate below asserts the controller's typed REPLACE
+            # action landed (fleet_actions*.jsonl in the kill dir)
+            files.append(os.path.join(here, "test_fleet.py"))
     else:
         files = sorted(glob.glob(os.path.join(here, "test_*.py")))
     if not files:
@@ -453,6 +462,8 @@ def main(argv=None):
             os.path.join(kill_dir, "kill_report*.json")))
         preexisting_health = set(glob.glob(
             os.path.join(health_dir, "health_*.jsonl")))
+        preexisting_fleet = set(glob.glob(
+            os.path.join(kill_dir, "fleet_actions*.jsonl")))
     results = []
     t_suite = time.time()
 
@@ -514,6 +525,7 @@ def main(argv=None):
 
     kill_reports = None
     health_histories = None
+    fleet_logs = None
     if chaos:
         kill_reports = sorted(
             p for p in glob.glob(
@@ -570,6 +582,43 @@ def main(argv=None):
                       "BACKEND_DOWN signal", flush=True)
                 if suite_rc in (0, 5):
                     suite_rc = 1
+        # fleet-chaos gate (ISSUE 18): when a fleet soak banked its
+        # controller action log, the injected member kill must show up
+        # as a typed REPLACE decision — the elastic replace path is
+        # CI-enforced, not just unit-tested. Zero logs skips the gate
+        # (same shape as the health-history gate: only runs that
+        # actually exercised a fleet can be held to it). The parse is
+        # torn-tail tolerant: the log is an append-only JSONL.
+        fleet_logs = sorted(
+            p for p in glob.glob(
+                os.path.join(kill_dir, "fleet_actions*.jsonl"))
+            if p not in preexisting_fleet)
+        if fleet_logs:
+            import json as _json
+            replaced = False
+            for path in fleet_logs:
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        for line in fh:
+                            try:
+                                act = _json.loads(line)
+                            except ValueError:
+                                continue
+                            if act.get("action") == "replace":
+                                replaced = True
+                except OSError:
+                    continue
+            print(f"# run_suite: chaos fleet action logs: "
+                  f"{len(fleet_logs)} new, replace="
+                  f"{'yes' if replaced else 'NO'}", flush=True)
+            if not replaced:
+                print("# run_suite: CHAOS FAILURE: no fleet action "
+                      "log shows a typed replace decision for the "
+                      "killed member", flush=True)
+                if suite_rc in (0, 5):
+                    suite_rc = 1
+        else:
+            fleet_logs = None
 
     if summary_json:
         summary = {
@@ -592,6 +641,8 @@ def main(argv=None):
             summary["kill_reports"] = kill_reports
         if health_histories is not None:
             summary["health_histories"] = health_histories
+        if fleet_logs is not None:
+            summary["fleet_action_logs"] = fleet_logs
         try:
             _sink_module().atomic_write_json(summary_json, summary)
             print(f"# run_suite: summary banked to {summary_json}",

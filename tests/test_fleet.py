@@ -1,0 +1,724 @@
+"""Elastic fleet tests (ISSUE 18): rendezvous routing, the
+signal-driven controller, the HTTP ingress, and zero-loss drain.
+
+Fast lane: pure rendezvous-placement properties (stability ~1/N,
+drain/loss redistribution never touching a healthy member's keys),
+the threaded router over protocol-complete in-memory fake members
+(mech affinity, fleet-wide tenant quota, ``BACKEND_LOST`` re-routing
+with the remaining deadline, bounded-load overload spill), the
+controller's reconciliation pass (add on ``LADDER_SATURATED``,
+cooldown pacing, cooldown-exempt replace, idle drain to the floor,
+member-id collision regression), the stdlib HTTP ingress end to end,
+and the :meth:`Supervisor.drain` zero-loss contract against the
+stdlib fake backend from ``test_serve_transport``.
+
+Env-gated lane (``python tests/run_suite.py --chaos``): a REAL
+3-member fake-backend fleet with the ambient procfault spec injected
+into the rendezvous winner (respawn budget zeroed) — the SIGKILL
+mid-load exhausts the member, every request still resolves OK through
+re-routing, the controller's replace heals the pool, and the typed
+action log is banked where the run_suite fleet gate replays it.
+
+Slow lane: the real-process soak — ``tools/loadgen.py --fleet`` with
+a kill spec over real supervised chemistry backends; zero requests
+lost, replace in the banked action log.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import test_serve_transport as tst
+from pychemkin_tpu import telemetry
+from pychemkin_tpu.fleet import (
+    FleetController,
+    FleetIngress,
+    FleetRouter,
+    assignments,
+    rendezvous_rank,
+    route_key,
+)
+from pychemkin_tpu.resilience import procfaults
+from pychemkin_tpu.resilience.status import SolveStatus
+from pychemkin_tpu.serve.errors import ServerClosed, ServerOverloaded
+from pychemkin_tpu.serve.futures import ServeFuture, make_result
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_wait = tst._wait
+fake_backend_path = tst.fake_backend_path  # re-export the fixture
+
+
+@pytest.fixture(autouse=True)
+def _no_env_chaos(monkeypatch, request):
+    """Same determinism rule as test_serve_transport: programmatic
+    tests never see an ambient chaos spec; env_chaos tests opt in."""
+    if "env_chaos" not in request.keywords:
+        monkeypatch.delenv("PYCHEMKIN_PROC_FAULTS", raising=False)
+
+
+# ---------------------------------------------------------------------------
+# protocol-complete in-memory fleet member
+
+class FakeMember:
+    """Fake fleet member: resolves every submit with a canned result.
+    Failure knobs: ``submit_exc`` raises AT submit, ``future_exc``
+    rides the returned future, ``status`` types the result, ``hold``
+    parks futures for the test to resolve."""
+
+    def __init__(self, member_id, *, submit_exc=None, future_exc=None,
+                 status=SolveStatus.OK, hold=False):
+        self.id = member_id
+        self.alive = True
+        self.accepting = True
+        self.submit_exc = submit_exc
+        self.future_exc = future_exc
+        self.status = status
+        self.hold = hold
+        self.submits = []
+        self.pending = []
+        self.dead = False
+        self.signals = []
+        self.drained = False
+        self.closed = False
+
+    def result(self, kind="equilibrium", status=None):
+        status = int(self.status if status is None else status)
+        return make_result({"T": 1931.25}, status, kind=kind,
+                           bucket=1, occupancy=1, queue_wait_ms=0.1,
+                           solve_ms=1.0)
+
+    def submit(self, kind, *, tenant=None, deadline_ms=None,
+               trace_id=None, **payload):
+        if self.submit_exc is not None:
+            raise self.submit_exc
+        self.submits.append({"kind": kind, "tenant": tenant,
+                             "deadline_ms": deadline_ms,
+                             "payload": payload})
+        fut = ServeFuture()
+        if self.hold:
+            self.pending.append(fut)
+        elif self.future_exc is not None:
+            fut.set_exception(self.future_exc)
+        else:
+            fut.set_result(self.result(kind))
+        return fut
+
+    def stats(self):
+        return {"member": self.id, "n_inflight": len(self.pending),
+                "dead": self.dead, "respawns": 0,
+                "backend_lost_requests": 0, "draining": False,
+                "alive": self.alive}
+
+    def firing(self, min_severity="warn"):
+        return list(self.signals)
+
+    def drain(self, timeout=60.0):
+        self.drained = True
+        return len(self.pending)
+
+    def close(self, timeout=120.0):
+        self.closed = True
+        return True
+
+    def metrics(self, timeout=30.0):
+        return {"counters": {}, "supervisor": self.stats()}
+
+
+def _pool(*ids, **kw):
+    members = {mid: FakeMember(mid, **kw) for mid in ids}
+    router = FleetRouter(
+        tenants={"default": {"mech": "h2o2", "quota": 64}},
+        recorder=telemetry.MetricsRecorder())
+    for mid, m in members.items():
+        router.add(mid, m)
+    return router, members
+
+
+def _winner(router, mech="h2o2"):
+    return rendezvous_rank(route_key(mech), router.member_ids())[0]
+
+
+# ---------------------------------------------------------------------------
+# pure placement properties
+
+class TestRendezvousPlacement:
+    KEYS = [f"mech{i}" for i in range(400)]
+
+    def test_rank_deterministic_and_order_independent(self):
+        a = rendezvous_rank("gri30", ["m0", "m1", "m2", "m3"])
+        b = rendezvous_rank("gri30", ["m3", "m1", "m0", "m2"])
+        assert a == b
+        assert sorted(a) == ["m0", "m1", "m2", "m3"]
+
+    def test_add_member_moves_about_one_nth_to_it_only(self):
+        """Growing 4 → 5 members: every key that moves, moves TO the
+        new member, and roughly 1/5 of them do (the consistent-routing
+        stability bound)."""
+        old_ids = ["m0", "m1", "m2", "m3"]
+        before = assignments(self.KEYS, old_ids)
+        after = assignments(self.KEYS, old_ids + ["m4"])
+        moved = [k for k in self.KEYS if before[k] != after[k]]
+        assert all(after[k] == "m4" for k in moved)
+        frac = len(moved) / len(self.KEYS)
+        assert 0.10 < frac < 0.32, frac
+
+    def test_remove_member_moves_only_its_keys(self):
+        ids = ["m0", "m1", "m2", "m3", "m4"]
+        before = assignments(self.KEYS, ids)
+        after = assignments(self.KEYS, [m for m in ids if m != "m2"])
+        for k in self.KEYS:
+            if before[k] == "m2":
+                assert after[k] != "m2"
+            else:
+                # a healthy member's keys never move
+                assert after[k] == before[k], k
+
+    def test_route_key_is_mech_only(self):
+        # tenancy must not fork placement: occupancy wants one-mech
+        # traffic coalesced regardless of who sent it
+        assert route_key("h2o2") == "h2o2"
+
+    def test_empty_pool_assigns_none(self):
+        assert assignments(["h2o2"], []) == {"h2o2": None}
+
+
+# ---------------------------------------------------------------------------
+# the threaded router over fake members
+
+class TestRouterDispatch:
+    def test_mech_affinity_all_to_winner(self):
+        router, members = _pool("m0", "m1", "m2")
+        win = _winner(router)
+        for i in range(20):
+            assert router.submit("equilibrium",
+                                 T=float(i)).result(timeout=10).ok
+        assert len(members[win].submits) == 20
+        for mid, m in members.items():
+            if mid != win:
+                assert m.submits == []
+        assert router.stats()["assigned"] == {win: 20}
+
+    def test_unknown_tenant_is_typed(self):
+        router, _ = _pool("m0")
+        with pytest.raises(KeyError):
+            router.submit("equilibrium", tenant="nobody", T=1.0)
+
+    def test_no_eligible_member_raises_server_closed(self):
+        router, members = _pool("m0")
+        members["m0"].alive = False
+        with pytest.raises(ServerClosed):
+            router.submit("equilibrium", T=1.0)
+
+    def test_drain_stops_new_work_but_inflight_finishes(self):
+        router, members = _pool("m0", "m1", "m2", hold=True)
+        win = _winner(router)
+        held = router.submit("equilibrium", T=0.0)
+        assert len(members[win].pending) == 1
+        router.start_drain(win)
+        fut2 = router.submit("equilibrium", T=1.0)
+        # new work skipped the draining winner...
+        assert len(members[win].submits) == 1
+        second = next(m for mid, m in members.items()
+                      if mid != win and m.submits)
+        # ...and the in-flight request still resolves on the drained
+        # member when it finishes (zero-loss drain, router side)
+        members[win].pending[0].set_result(members[win].result())
+        assert held.result(timeout=10).ok
+        second.pending[0].set_result(second.result())
+        assert fut2.result(timeout=10).ok
+        assert router.stats()["draining"] == [win]
+
+    def test_backend_lost_reroutes_with_remaining_deadline(self):
+        router, members = _pool("m0", "m1", "m2")
+        win = _winner(router)
+        members[win].status = SolveStatus.BACKEND_LOST
+        res = router.submit("equilibrium", deadline_ms=60_000.0,
+                            T=1.0).result(timeout=10)
+        assert res.ok                      # healed by the re-route
+        hop2 = next(m for mid, m in members.items()
+                    if mid != win and m.submits)
+        # the second hop got the REMAINING deadline, not a fresh one
+        assert 0.0 < hop2.submits[0]["deadline_ms"] <= 60_000.0
+        assert router.stats()["reroutes"] == 1
+
+    def test_all_members_lost_resolves_typed_not_hang(self):
+        router, members = _pool("m0", "m1", "m2",
+                                status=SolveStatus.BACKEND_LOST)
+        res = router.submit("equilibrium", T=1.0).result(timeout=10)
+        assert int(res.status) == int(SolveStatus.BACKEND_LOST)
+        assert res.status_name == "BACKEND_LOST"
+        assert router.stats()["reroutes"] >= 1
+
+    def test_raced_closed_member_skipped_at_submit(self):
+        router, members = _pool("m0", "m1", "m2")
+        win = _winner(router)
+        members[win].submit_exc = ServerClosed("raced into close")
+        assert router.submit("equilibrium", T=1.0).result(timeout=10).ok
+        assert sum(len(m.submits) for m in members.values()) == 1
+
+    def test_member_death_via_future_reroutes(self):
+        router, members = _pool("m0", "m1", "m2")
+        win = _winner(router)
+        members[win].future_exc = ServerClosed("died under request")
+        assert router.submit("equilibrium", T=1.0).result(timeout=10).ok
+        assert router.stats()["reroutes"] == 1
+
+    def test_overload_spills_to_next_ranked(self):
+        """Affinity holds until the winner pushes back; then the
+        next-ranked member absorbs the overflow — how a fresh
+        scale-up member starts taking a single-mech ramp."""
+        router, members = _pool("m0", "m1", "m2")
+        win = _winner(router)
+        members[win].submit_exc = ServerOverloaded(
+            "full", queue_depth=256)
+        assert router.submit("equilibrium", T=1.0).result(timeout=10).ok
+        spill = rendezvous_rank(route_key("h2o2"),
+                                router.member_ids())[1]
+        assert len(members[spill].submits) == 1
+
+    def test_all_overloaded_surfaces_backpressure(self):
+        router, members = _pool("m0", "m1")
+        for m in members.values():
+            m.submit_exc = ServerOverloaded("full", queue_depth=256)
+        with pytest.raises(ServerOverloaded):
+            router.submit("equilibrium", T=1.0)
+
+    def test_fleet_quota_rejects_and_frees(self):
+        router = FleetRouter(
+            tenants={"acme": {"mech": "h2o2", "quota": 2}},
+            recorder=telemetry.MetricsRecorder(),
+            default_tenant="acme")
+        m = FakeMember("m0", hold=True)
+        router.add("m0", m)
+        f1 = router.submit("equilibrium", T=0.0)
+        router.submit("equilibrium", T=1.0)
+        with pytest.raises(ServerOverloaded) as ei:
+            router.submit("equilibrium", T=2.0)
+        assert ei.value.retry_after_ms is not None
+        assert router.stats()["tenants"]["acme"]["inflight"] == 2
+        assert router.stats()["rejected"] == 1
+        m.pending[0].set_result(m.result())
+        assert f1.result(timeout=10).ok
+        # the resolved request freed its fleet-wide slot
+        router.submit("equilibrium", T=3.0)
+        assert router.stats()["tenants"]["acme"]["inflight"] == 2
+
+    def test_redistribution_never_touches_healthy_assignments(self):
+        """The satellite property at the router level: draining one
+        member re-homes ONLY the mechs it was winning."""
+        tenants = {f"t{i}": {"mech": f"mech{i}", "quota": 8}
+                   for i in range(12)}
+        router = FleetRouter(tenants=tenants,
+                             recorder=telemetry.MetricsRecorder())
+        members = {mid: FakeMember(mid) for mid in
+                   ("m0", "m1", "m2", "m3")}
+        for mid, m in members.items():
+            router.add(mid, m)
+
+        def placement():
+            marks = {mid: len(m.submits)
+                     for mid, m in members.items()}
+            out = {}
+            for t in tenants:
+                assert router.submit("equilibrium", tenant=t,
+                                     T=1.0).result(timeout=10).ok
+                out[t] = next(mid for mid, m in members.items()
+                              if len(m.submits) > marks[mid])
+                marks[out[t]] += 1
+            return out
+
+        before = placement()
+        drained = next(iter(set(before.values())))
+        router.start_drain(drained)
+        after = placement()
+        for t in tenants:
+            if before[t] == drained:
+                assert after[t] != drained
+            else:
+                assert after[t] == before[t], t
+
+
+# ---------------------------------------------------------------------------
+# the controller's reconciliation pass
+
+def _controller(router, registry, **kw):
+    def make_backend(mid):
+        m = FakeMember(mid)
+        registry[mid] = m
+        return m
+    kw.setdefault("min_size", 2)
+    kw.setdefault("max_size", 4)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("recorder", telemetry.MetricsRecorder())
+    return FleetController(router, make_backend, **kw)
+
+
+class TestFleetController:
+    def test_ensure_min_fills_pool_with_typed_actions(self):
+        rec = telemetry.MetricsRecorder()
+        router = FleetRouter(recorder=rec)
+        registry = {}
+        ctl = _controller(router, registry, min_size=3, recorder=rec)
+        acts = ctl.ensure_min()
+        assert [a["action"] for a in acts] == ["add"] * 3
+        assert all(a["reason"] == "min_size" for a in acts)
+        assert len(router.member_ids()) == 3
+        ev = rec.last_event("fleet.action")
+        assert ev is not None and ev["pool_size"] == 3
+
+    def test_add_on_saturation_up_to_max(self):
+        router = FleetRouter(recorder=telemetry.MetricsRecorder())
+        registry = {}
+        ctl = _controller(router, registry, min_size=2, max_size=3)
+        ctl.ensure_min()
+        registry["m0"].signals = [{"signal": "LADDER_SATURATED",
+                                   "severity": "warn",
+                                   "evidence": {"bucket": 32}}]
+        acts = ctl.step()
+        assert [a["action"] for a in acts] == ["add"]
+        assert acts[0]["reason"] == "LADDER_SATURATED"
+        assert acts[0]["evidence"]["member"] == "m0"
+        assert len(router.member_ids()) == 3
+        # at max_size the signal no longer adds
+        assert ctl.step() == []
+
+    def test_cooldown_paces_scale_up(self):
+        router = FleetRouter(recorder=telemetry.MetricsRecorder())
+        registry = {}
+        ctl = _controller(router, registry, min_size=1, max_size=4,
+                          cooldown_s=3600.0)
+        ctl.ensure_min()                  # starts the cooldown window
+        registry["m0"].signals = [{"signal": "DEADLINE_PRESSURE",
+                                   "severity": "warn", "evidence": {}}]
+        assert ctl.step() == []           # paced, not ignored
+        assert ctl.state()["cooldown_remaining_s"] > 0.0
+
+    def test_replace_dead_member_bypasses_cooldown(self):
+        router = FleetRouter(recorder=telemetry.MetricsRecorder())
+        registry = {}
+        ctl = _controller(router, registry, min_size=2,
+                          cooldown_s=3600.0)
+        ctl.ensure_min()
+        registry["m0"].dead = True
+        acts = ctl.step()
+        assert [a["action"] for a in acts] == ["replace"]
+        assert acts[0]["replaced"] == "m0"
+        assert acts[0]["reason"] == "respawn_exhausted"
+        assert registry["m0"].closed
+        assert "m0" not in router.member_ids()
+        assert len(router.member_ids()) == 2
+
+    def test_idle_drain_to_floor_with_zero_leftover(self):
+        router = FleetRouter(recorder=telemetry.MetricsRecorder())
+        registry = {}
+        ctl = _controller(router, registry, min_size=1, max_size=3,
+                          idle_polls=2, drain_timeout_s=5.0)
+        ctl.ensure_min()
+        ctl._add(reason="test_seed")      # pool 2, floor 1
+        acts = []
+        for _ in range(4):
+            acts += ctl.step()
+        drains = [a for a in acts if a["action"] == "drain"]
+        assert len(drains) == 1
+        victim = drains[0]["member"]      # the NEWEST member goes
+        assert victim == "m1"
+        _wait(lambda: any(a["action"] == "drain_complete"
+                          for a in ctl.actions()),
+              what="drain_complete action")
+        done = next(a for a in ctl.actions()
+                    if a["action"] == "drain_complete")
+        assert done["leftover"] == 0      # zero-loss drain, typed
+        assert registry[victim].drained and registry[victim].closed
+        assert router.member_ids() == ["m0"]
+        # at the floor: no further drain
+        for _ in range(4):
+            assert ctl.step() == []
+        ctl.stop()
+
+    def test_member_id_collision_regression(self):
+        """A router seeded with members the controller did not create
+        must never have them silently overwritten by the controller's
+        own id sequence."""
+        router = FleetRouter(recorder=telemetry.MetricsRecorder())
+        for mid in ("m0", "m1", "m2"):
+            router.add(mid, FakeMember(mid))
+        registry = {}
+        ctl = _controller(router, registry, min_size=4)
+        ctl.ensure_min()
+        assert len(router.member_ids()) == 4
+        assert set(registry) == {"m3"}
+
+    def test_busy_pool_never_drains(self):
+        router = FleetRouter(recorder=telemetry.MetricsRecorder())
+        registry = {}
+        ctl = _controller(router, registry, min_size=1, max_size=3,
+                          idle_polls=1)
+        ctl.ensure_min()
+        ctl._add(reason="test_seed")
+        registry["m0"].pending.append(ServeFuture())  # in-flight
+        for _ in range(5):
+            assert ctl.step() == []
+        assert len(router.member_ids()) == 2
+
+
+# ---------------------------------------------------------------------------
+# the HTTP ingress
+
+def _post(url, doc, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (resp.status, json.loads(resp.read().decode()),
+                    dict(resp.headers))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def _get(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+class TestFleetIngress:
+    def test_submit_ok_over_http(self):
+        router, members = _pool("m0", "m1")
+        with FleetIngress(router,
+                          recorder=telemetry.MetricsRecorder()) as ing:
+            base = f"http://{ing.host}:{ing.port}"
+            code, doc, _ = _post(f"{base}/v1/submit",
+                                 {"kind": "equilibrium",
+                                  "payload": {"T": 1200.0}})
+        assert code == 200 and doc["op"] == "result"
+        assert doc["result"]["status_name"] == "OK"
+        assert doc["result"]["value"]["T"] == 1931.25
+
+    def test_loadgen_http_client_encodes_numpy_payloads(self):
+        # regression: default_samplers payloads carry numpy arrays
+        # (Y=Y0) — the loadgen HTTP adapter must encode them, or an
+        # HTTP-ingress soak dies client-side before the wire
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "loadgen_tool", os.path.join(
+                os.path.dirname(__file__), "..", "tools", "loadgen.py"))
+        loadgen_tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(loadgen_tool)
+        router, _ = _pool("m0")
+        with FleetIngress(router,
+                          recorder=telemetry.MetricsRecorder()) as ing:
+            client = loadgen_tool._HttpFleetClient(
+                f"http://{ing.host}:{ing.port}")
+            fut = client.submit(
+                "equilibrium", T=np.float64(1200.0),
+                Y=np.array([0.1, 0.9]), option=1)
+            res = fut.result(timeout=30)
+        assert res.status_name == "OK"
+        assert res.value["T"] == 1931.25
+
+    def test_quota_reject_is_429_with_retry_after(self):
+        router = FleetRouter(
+            tenants={"default": {"mech": "h2o2", "quota": 0}},
+            recorder=telemetry.MetricsRecorder())
+        router.add("m0", FakeMember("m0"))
+        with FleetIngress(router,
+                          recorder=telemetry.MetricsRecorder()) as ing:
+            base = f"http://{ing.host}:{ing.port}"
+            code, doc, headers = _post(f"{base}/v1/submit",
+                                       {"kind": "equilibrium",
+                                        "payload": {"T": 1.0}})
+        assert code == 429
+        assert doc["error"] == "ServerOverloaded"
+        assert doc["retry_after_ms"] > 0.0
+        assert int(headers["Retry-After"]) >= 1
+
+    def test_malformed_and_unknown_paths_are_typed(self):
+        router, _ = _pool("m0")
+        with FleetIngress(router,
+                          recorder=telemetry.MetricsRecorder()) as ing:
+            base = f"http://{ing.host}:{ing.port}"
+            code, doc, _ = _post(f"{base}/v1/submit",
+                                 {"payload": {"T": 1.0}})
+            assert (code, doc["error"]) == (400, "BadRequest")
+            code, doc, _ = _post(f"{base}/nope", {})
+            assert (code, doc["error"]) == (404, "NotFound")
+            code, doc = _get(f"{base}/nope")
+            assert (code, doc["error"]) == (404, "NotFound")
+
+    def test_healthz_tracks_pool_liveness(self):
+        router, members = _pool("m0", "m1")
+        with FleetIngress(router,
+                          recorder=telemetry.MetricsRecorder()) as ing:
+            base = f"http://{ing.host}:{ing.port}"
+            code, doc = _get(f"{base}/healthz")
+            assert code == 200 and doc["n_alive"] == 2
+            for m in members.values():
+                m.alive = False
+            code, doc = _get(f"{base}/healthz")
+            assert code == 503 and not doc["ok"]
+
+    def test_metrics_scrape_carries_fleet_story(self):
+        router, _ = _pool("m0", "m1")
+        registry = {}
+        ctl = _controller(router, registry, min_size=2)
+        with FleetIngress(router, controller=ctl,
+                          recorder=telemetry.MetricsRecorder()) as ing:
+            code, doc = _get(f"http://{ing.host}:{ing.port}/metrics")
+        assert code == 200
+        assert doc["router"]["members"] == ["m0", "m1"]
+        assert doc["controller"]["pool_size"] == 2
+        assert set(doc["members"]) == {"m0", "m1"}
+
+    def test_no_member_is_503_and_wait_cap_is_504(self):
+        router, members = _pool("m0", hold=True)
+        ing = FleetIngress(router,
+                           recorder=telemetry.MetricsRecorder())
+        # unit-level: handle_submit is transport-agnostic
+        code, doc, _ = ing.handle_submit(
+            {"kind": "equilibrium", "payload": {"T": 1.0},
+             "timeout_s": 0.05})
+        assert (code, doc["error"]) == (504, "Timeout")
+        members["m0"].alive = False
+        code, doc, _ = ing.handle_submit(
+            {"kind": "equilibrium", "payload": {"T": 1.0}})
+        assert (code, doc["error"]) == (503, "ServerClosed")
+
+
+# ---------------------------------------------------------------------------
+# the Supervisor.drain zero-loss contract (real process, fake backend)
+
+class TestSupervisorDrain:
+    def test_drain_is_idempotent_and_typed(self, fake_backend_path):
+        rec = telemetry.MetricsRecorder()
+        sup = tst._fake_supervisor(fake_backend_path, recorder=rec)
+        with sup:
+            assert sup.submit("equilibrium",
+                              T=1.0).result(timeout=30).ok
+            assert sup.drain(timeout=30.0) == 0   # zero-loss
+            assert sup.accepting is False
+            assert sup.alive is True              # drain ≠ death
+            with pytest.raises(ServerClosed):
+                sup.submit("equilibrium", T=2.0)
+            assert sup.drain(timeout=5.0) == 0    # idempotent
+            assert sup.stats()["draining"] is True
+        ev = rec.last_event("supervisor.drain_wait")
+        assert ev is not None and ev["leftover"] == 0
+
+
+# ---------------------------------------------------------------------------
+# env-driven fleet chaos (run_suite --chaos): SIGKILL mid-load,
+# zero loss, controller replace, banked action log
+
+@pytest.mark.env_chaos
+@pytest.mark.skipif("PYCHEMKIN_PROC_FAULTS" not in os.environ,
+                    reason="env-driven chaos: run via "
+                           "tests/run_suite.py --chaos")
+class TestEnvDrivenFleetChaos:
+    def test_kill_mid_load_zero_loss_and_replace(
+            self, fake_backend_path):
+        assert procfaults.enabled()
+        (spec,) = procfaults.specs("kill_backend_at_request")
+        rec = telemetry.MetricsRecorder()
+        router = FleetRouter(
+            tenants={"default": {"mech": "h2o2", "quota": 64}},
+            recorder=rec)
+        # the victim must be the member that RECEIVES the mech's
+        # traffic; its respawn budget is zeroed so the kill exhausts
+        # it (typed BACKEND_LOST) instead of healing by respawn
+        victim = rendezvous_rank(route_key("h2o2"),
+                                 [f"m{i}" for i in range(3)])[0]
+        sups = {}
+
+        def make_backend(mid):
+            env, kw = {}, {}
+            if mid == victim:
+                env["FAKE_PROCFAULTS_PATH"] = tst.PROCFAULTS_PATH
+                kw["max_respawns"] = 0
+            sup = tst._fake_supervisor(fake_backend_path, env=env,
+                                       member=mid, recorder=rec, **kw)
+            sup.start()
+            sups[mid] = sup
+            return sup
+
+        ctl = FleetController(router, make_backend, min_size=3,
+                              max_size=4, cooldown_s=0.0, poll_s=0.1,
+                              recorder=rec)
+        try:
+            ctl.ensure_min()
+            results = []
+            for i in range(spec.request + 5):
+                fut = router.submit("equilibrium", T=float(i),
+                                    deadline_ms=60_000.0)
+                results.append(fut.result(timeout=60))
+            # ZERO loss: the kill landed mid-load, the in-flight
+            # request resolved typed at the member and the router
+            # re-routed it — every caller saw OK
+            assert all(r.ok for r in results)
+            assert router.stats()["reroutes"] >= 1
+            _wait(lambda: sups[victim].stats()["dead"],
+                  what="victim marked dead")
+            assert sups[victim].stats()["backend_lost_requests"] >= 1
+            acts = ctl.step()
+            assert any(a["action"] == "replace" for a in acts)
+            rep = next(a for a in ctl.actions()
+                       if a["action"] == "replace")
+            assert rep["replaced"] == victim
+            # the replacement pool serves traffic (no chaos env rode
+            # along to the new member)
+            assert router.submit("equilibrium",
+                                 T=99.0).result(timeout=60).ok
+            assert len(router.member_ids()) == 3
+        finally:
+            # bank the typed decision log where the run_suite fleet
+            # gate replays it for the replace event
+            kill_dir = os.environ.get("PYCHEMKIN_KILL_REPORT_DIR")
+            if kill_dir:
+                path = os.path.join(
+                    kill_dir, f"fleet_actions_{os.getpid()}.jsonl")
+                for act in ctl.actions():
+                    telemetry.append_jsonl(path, act)
+            ctl.stop(close_members=True, timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# slow lane: the real-process fleet soak through tools/loadgen.py
+
+@pytest.mark.slow
+class TestFleetSoakSlow:
+    def test_loadgen_fleet_chaos_soak(self, tmp_path):
+        out = tmp_path / "FLEET_SOAK.json"
+        spec = json.dumps([{"mode": "kill_backend_at_request",
+                            "request": 3}])
+        env = dict(os.environ)
+        env.pop("PYCHEMKIN_PROC_FAULTS", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "loadgen.py"),
+             "--fleet", "2", "--n", "24", "--rate", "50",
+             "--mech", "h2o2", "--chaos", spec, "--timeout", "120",
+             "--out", str(out), "--obs-dir", str(tmp_path / "obs")],
+            env=env, capture_output=True, text=True, timeout=840)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        doc = json.loads(out.read_text())
+        # zero loss under the kill: everything resolved typed
+        assert doc["n_requests"] == 24
+        assert doc["n_timeout"] == 0 and doc["n_error"] == 0
+        fleet = doc["fleet"]
+        actions = fleet["actions"]
+        assert any(a["action"] == "replace" for a in actions)
+        assert os.path.exists(fleet["actions_path"])
+        # the replacement member exists and the victim is gone
+        rep = next(a for a in actions if a["action"] == "replace")
+        assert rep["replaced"] not in fleet["router"]["members"]

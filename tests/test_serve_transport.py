@@ -1177,7 +1177,7 @@ class TestRunSuiteChaosFlag:
             rs._run_child = orig
         assert rc == 0
         assert [os.path.basename(f) for f in recorded["files"]] == \
-            ["test_serve_transport.py"]
+            ["test_serve_transport.py", "test_fleet.py"]
         assert "PYCHEMKIN_PROC_FAULTS" in recorded["env"]
         assert recorded["env"]["PYCHEMKIN_KILL_REPORT_DIR"] == \
             str(tmp_path)

@@ -42,6 +42,7 @@ Three modes:
 Usage::
 
     python tools/chemtop.py --ports 41231 --once --out FLEET.json
+    python tools/chemtop.py --ingress 127.0.0.1:8080 --interval 2
     python tools/chemtop.py --ports 41231,41232 --interval 2 \
         --history FLEET_HEALTH.jsonl
     python tools/chemtop.py --check-signals FLEET_HEALTH.jsonl
@@ -86,6 +87,23 @@ def scrape(host: str, port: int, timeout: float = 30.0) -> Dict:
     reply.pop("id", None)
     reply["port"] = port
     return reply
+
+
+def scrape_ingress(url: str, timeout: float = 30.0) -> Dict:
+    """One fleet-ingress ``/metrics`` scrape (``pychemkin_tpu/fleet/
+    ingress.py``): the reply carries every member's merged metrics
+    under ``members`` plus the router's and controller's state — one
+    HTTP GET answers for the whole elastic pool. Unreachable ingress
+    yields ``{"url", "error"}`` instead of raising."""
+    import urllib.request
+    if not url.startswith(("http://", "https://")):
+        url = f"http://{url}"
+    try:
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/metrics", timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+    except Exception as exc:  # noqa: BLE001 — scrape must answer
+        return {"url": url, "error": f"{type(exc).__name__}: {exc}"}
 
 
 def merge_fleet(replies: List[Dict]) -> Dict:
@@ -273,14 +291,45 @@ def merge_fleet(replies: List[Dict]) -> Dict:
     }
 
 
-def render(snapshot: Dict, view=None, signals=None) -> str:
+def render(snapshot: Dict, view=None, signals=None,
+           fleet: Optional[Dict] = None) -> str:
     """Human top-style view of one merged snapshot. ``view`` (a
     health ``WindowView`` from the watch loop's ring) adds windowed
     trends — notably the fleet ``predictor_corr`` latest vs
     window-start; ``signals`` (the engine's per-signal state) adds
-    the alerts panel with a per-signal recent sparkline."""
+    the alerts panel with a per-signal recent sparkline; ``fleet``
+    (the ingress reply's ``router``/``controller`` blocks) adds the
+    fleet-controller panel — pool vs bounds, routing spread, and the
+    recent typed ``fleet.action`` decisions."""
     lines = [f"chemtop — {snapshot['n_alive']}/"
              f"{snapshot['n_backends']} backends alive"]
+    if fleet:
+        ctl = fleet.get("controller") or {}
+        rt = fleet.get("router") or {}
+        if ctl:
+            lines.append(
+                f"  fleet: pool {ctl.get('pool_size')} "
+                f"[{ctl.get('min_size')}..{ctl.get('max_size')}]  "
+                f"cooldown {ctl.get('cooldown_remaining_s', 0):.0f}"
+                f"/{ctl.get('cooldown_s', 0):.0f}s  "
+                f"idle_streak {ctl.get('idle_streak')}  "
+                f"actions {ctl.get('n_actions', 0)}")
+            for act in (ctl.get("recent_actions") or [])[-4:]:
+                lines.append(
+                    f"    action {act.get('action')} "
+                    f"{act.get('member')}  reason "
+                    f"{act.get('reason')}  pool "
+                    f"{act.get('pool_size')}")
+        if rt:
+            spread = "  ".join(
+                f"{m}={n}" for m, n in
+                sorted((rt.get("assigned") or {}).items()))
+            draining = ",".join(rt.get("draining") or []) or "-"
+            lines.append(
+                f"  router: reroutes {rt.get('reroutes', 0)}  "
+                f"rejected {rt.get('rejected', 0)}  "
+                f"draining {draining}"
+                + (f"  assigned {spread}" if spread else ""))
     for sig in (signals or []):
         if sig["state"] != "firing":
             continue
@@ -409,7 +458,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--ports", default=None,
                    help="comma list of backend ports to scrape "
-                        "(required unless --check-signals)")
+                        "(required unless --check-signals or "
+                        "--ingress)")
+    p.add_argument("--ingress", default=None, metavar="HOST:PORT",
+                   help="scrape a fleet HTTP ingress /metrics "
+                        "endpoint instead of TCP backends; adds the "
+                        "fleet-controller panel (pool vs bounds, "
+                        "recent fleet.action decisions, routing "
+                        "spread)")
     p.add_argument("--once", action="store_true",
                    help="one scrape: JSON line to stdout (CI mode)")
     p.add_argument("--out", default=None,
@@ -488,11 +544,29 @@ def main(argv=None) -> int:
                                 args.require_cycle)
         print(json.dumps(verdict), flush=True)
         return verdict["rc"]
-    if not args.ports:
-        print("chemtop: --ports is required (or --check-signals)",
-              file=sys.stderr)
+    if not args.ports and not args.ingress:
+        print("chemtop: --ports or --ingress is required (or "
+              "--check-signals)", file=sys.stderr)
         return 2
-    ports = [int(x) for x in args.ports.split(",") if x.strip()]
+    ports = [int(x) for x in (args.ports or "").split(",")
+             if x.strip()]
+
+    def poll():
+        """One poll: (per-backend metrics replies, fleet blocks)."""
+        if args.ingress:
+            doc = scrape_ingress(args.ingress, args.timeout)
+            if doc.get("error"):
+                return [doc], None
+            replies = []
+            for mid, rep in sorted((doc.get("members") or {}).items()):
+                rep = dict(rep)
+                # the backend-row key: members have ids, not ports
+                rep.setdefault("port", mid)
+                replies.append(rep)
+            return replies, {"router": doc.get("router"),
+                             "controller": doc.get("controller")}
+        return [scrape(args.host, port, args.timeout)
+                for port in ports], None
     window_s = (args.window if args.window is not None
                 else knobs.value("PYCHEMKIN_HEALTH_WINDOW_S"))
     # the watch loop's health pipeline: ring + rule engine over the
@@ -503,13 +577,16 @@ def main(argv=None) -> int:
     engine = health.HealthEngine(recorder=telemetry.MetricsRecorder())
     n = 0
     while True:
-        snapshot = merge_fleet([scrape(args.host, port, args.timeout)
-                                for port in ports])
+        replies, fleet = poll()
+        snapshot = merge_fleet(replies)
+        if fleet:
+            snapshot["fleet"] = fleet
         if args.out:
             telemetry.atomic_write_json(args.out, snapshot)
         if args.once:
             print(json.dumps(snapshot), flush=True)
-            return 0 if snapshot["n_alive"] == len(ports) else 1
+            return 0 if (snapshot["n_alive"] > 0 if args.ingress
+                         else snapshot["n_alive"] == len(ports)) else 1
         sample = ring.append(health.normalize_sample(snapshot))
         signals = engine.evaluate(ring)
         if args.history:
@@ -518,7 +595,7 @@ def main(argv=None) -> int:
                                     "sample": sample,
                                     "signals": signals})
         print(render(snapshot, view=ring.window(window_s),
-                     signals=signals), flush=True)
+                     signals=signals, fleet=fleet), flush=True)
         n += 1
         if args.iterations is not None and n >= args.iterations:
             return 0

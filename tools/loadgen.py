@@ -36,6 +36,9 @@ Usage::
     python tools/loadgen.py --transport --deadline-ms 60000 \
         --chaos '[{"mode": "kill_backend_at_request", "request": 20}]' \
         --rate 50 --n 100 --out SOAK.json
+    python tools/loadgen.py --fleet 3 --fleet-http --rate 50 --n 150 \
+        --chaos '[{"mode": "kill_backend_at_request", "request": 20}]' \
+        --deadline-ms 60000 --out FLEET_SOAK.json
 
 The artifact carries the request-side latency distribution
 (p50/p95/p99/mean/max ms), occupancy, rejection/timeout/rescue counts,
@@ -72,6 +75,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -145,6 +149,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "backend loss")
     p.add_argument("--max-respawns", type=int, default=None,
                    help="supervisor backend respawn budget")
+    # -- elastic fleet soak mode ----------------------------------------
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="fleet mode: N supervised backends behind the "
+                        "mechanism-aware router (pychemkin_tpu/fleet) "
+                        "with the signal-driven controller; all "
+                        "members share one staging + XLA cache dir so "
+                        "scale-up/replace costs zero new compiles. "
+                        "--chaos then injects the fault into the "
+                        "FIRST member only, with respawn budget 0 — "
+                        "its death exercises the typed BACKEND_LOST "
+                        "re-route + controller replace path")
+    p.add_argument("--fleet-max", type=int, default=None,
+                   help="controller max pool size (default N+1)")
+    p.add_argument("--fleet-http", action="store_true",
+                   help="drive the fleet over the HTTP ingress front "
+                        "door instead of the in-process router")
+    p.add_argument("--fleet-poll-s", type=float, default=0.5,
+                   help="controller reconciliation poll interval, s")
     return p
 
 
@@ -314,6 +336,224 @@ def _run_transport(args, kinds, bucket_sizes, rng, samplers, obs,
     return summary, extra
 
 
+class _HttpFleetClient:
+    """The ``run_load`` duck type over the fleet HTTP ingress: each
+    submit is one POST on a worker thread resolving a ServeFuture —
+    the soak core cannot tell HTTP from the in-process router. Typed
+    mapping back: 429 → :class:`ServerOverloaded` (counted as a
+    rejection), other HTTP errors → :class:`ServerClosed`/
+    :class:`ServeError` (counted, never raised out of the run)."""
+
+    def __init__(self, base_url: str):
+        self.base = base_url.rstrip("/")
+
+    def submit(self, kind, *, deadline_ms=None, trace_id=None,
+               **payload):
+        from pychemkin_tpu.serve.futures import ServeFuture
+
+        fut = ServeFuture()
+        body = {"kind": kind, "payload": payload, "trace": trace_id}
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        threading.Thread(target=self._do, args=(fut, body),
+                         daemon=True).start()
+        return fut
+
+    def _do(self, fut, body):
+        import urllib.error
+        import urllib.request
+
+        from pychemkin_tpu.serve.errors import (
+            ServeError, ServerClosed, ServerOverloaded)
+        from pychemkin_tpu.serve.futures import ServeResult
+
+        try:
+            # sampler payloads carry numpy arrays (Y0 etc.) — encode
+            # with the transport's numpy-tolerant encoder or every
+            # submit dies client-side as a TypeError before the wire
+            from pychemkin_tpu.serve.transport import _jsonable
+            req = urllib.request.Request(
+                self.base + "/v1/submit",
+                data=json.dumps(_jsonable(body)).encode("utf-8"),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=600.0) as r:
+                doc = json.loads(r.read().decode("utf-8"))
+            fut.set_result(ServeResult(**doc["result"]))
+        except urllib.error.HTTPError as exc:
+            try:
+                doc = json.loads(exc.read().decode("utf-8"))
+            except Exception:        # noqa: BLE001 — torn error body
+                doc = {}
+            if exc.code == 429:
+                fut.set_exception(ServerOverloaded(
+                    doc.get("message", "fleet overloaded"),
+                    queue_depth=int(doc.get("queue_depth", 0)),
+                    retry_after_ms=doc.get("retry_after_ms")))
+            else:
+                fut.set_exception(ServerClosed(
+                    f"HTTP {exc.code}: {doc.get('message')}"))
+        except Exception as exc:     # noqa: BLE001 — typed, counted
+            fut.set_exception(ServeError(
+                f"{type(exc).__name__}: {exc}"))
+
+
+def _run_fleet(args, kinds, bucket_sizes, rng, samplers, obs,
+               classify=None):
+    """The elastic-fleet soak: N supervised members behind the
+    mech-aware router, the controller reconciling on their health
+    signals, optionally the HTTP ingress in front. Banks per-member
+    occupancy/health/compile telemetry and the controller's full
+    typed action log (also as ``fleet_actions.jsonl`` in the obs dir
+    — the ``run_suite --chaos`` fleet gate's artifact)."""
+    from pychemkin_tpu.fleet import (FleetController, FleetIngress,
+                                     FleetRouter, rendezvous_rank,
+                                     route_key, shared_cache_env)
+
+    if args.chaos is not None:
+        json.loads(args.chaos)       # fail fast on a typo'd spec
+    rec = obs.recorder
+    engine_config = _surrogate_config(args, kinds, _engine_config())
+    config = {
+        "tenants": {args.tenant: {"mech": args.mech,
+                                  "quota": args.quota}},
+        "kinds": kinds,
+        "chem": {"bucket_sizes": list(bucket_sizes),
+                 "max_batch_size": args.max_batch,
+                 "max_delay_ms": args.delay_ms,
+                 "queue_depth": args.queue_depth},
+        "engine_config": engine_config,
+    }
+    # one staging + XLA cache dir for the whole fleet: the first
+    # member's warmup pays the compiles, every later spawn (scale-up,
+    # replace) replays them from disk — the zero-compile-scale-up
+    # contract the per-member program.compiles counters prove
+    shared = shared_cache_env(os.path.join(obs.dir, "shared_cache"))
+    # the chaos victim must be the member that actually RECEIVES the
+    # mech's traffic — the rendezvous winner of the initial pool (the
+    # controller's ensure_min ids are m0..m{N-1}) — or the injected
+    # kill never fires and the soak proves nothing
+    victim = (rendezvous_rank(route_key(args.mech),
+                              [f"m{i}" for i in range(args.fleet)])[0]
+              if args.chaos is not None else None)
+    chaos_pending = [args.chaos] if args.chaos is not None else []
+
+    def make_backend(mid):
+        env = {"PYCHEMKIN_TELEMETRY_PATH": os.path.join(
+                   obs.dir, f"backend_{mid}.jsonl"),
+               "PYCHEMKIN_FLIGHT_DIR": obs.dir, **shared}
+        max_respawns = args.max_respawns
+        if chaos_pending and mid == victim:
+            # the designated victim: fault injected, respawn budget
+            # zeroed, so its death exhausts the member (typed
+            # BACKEND_LOST + router re-route) and the controller's
+            # REPLACE path — not just a same-member respawn — heals it
+            env["PYCHEMKIN_PROC_FAULTS"] = chaos_pending.pop()
+            max_respawns = 0
+        sup = Supervisor(config, env_overrides=env,
+                         retry_budget=args.retry_budget,
+                         max_respawns=max_respawns,
+                         default_tenant=args.tenant, recorder=rec,
+                         kill_report_dir=obs.dir,
+                         health_history_path=os.path.join(
+                             obs.dir, f"health_{mid}.jsonl"),
+                         member=mid)
+        sup.start()
+        print(f"# loadgen: fleet member {mid} ready on port "
+              f"{sup.port}", file=sys.stderr)
+        return sup
+
+    router = FleetRouter(
+        tenants={args.tenant: {"mech": args.mech,
+                               "quota": args.quota}},
+        recorder=rec, default_tenant=args.tenant)
+    ctl = FleetController(router, make_backend,
+                          min_size=args.fleet,
+                          max_size=(args.fleet_max
+                                    if args.fleet_max is not None
+                                    else args.fleet + 1),
+                          poll_s=args.fleet_poll_s, recorder=rec)
+    print(f"# loadgen: spawning fleet of {args.fleet} "
+          f"(chaos={'on' if args.chaos else 'off'}, "
+          f"front={'http' if args.fleet_http else 'router'})",
+          file=sys.stderr)
+    ctl.start()
+    ingress = None
+    target = router
+    try:
+        if args.fleet_http:
+            ingress = FleetIngress(router, controller=ctl,
+                                   recorder=rec).start()
+            target = _HttpFleetClient(
+                f"http://{ingress.host}:{ingress.port}")
+            print(f"# loadgen: ingress on "
+                  f"http://{ingress.host}:{ingress.port}",
+                  file=sys.stderr)
+        summary = loadgen.run_load(
+            target, samplers, rate_hz=args.rate, n_requests=args.n,
+            rng=rng, result_timeout_s=args.timeout,
+            deadline_ms=args.deadline_ms,
+            trace_events=obs.trace_events,
+            n_exemplars=args.exemplars, classify=classify)
+        if args.chaos is not None:
+            # a short ramp can outrun the poll loop: the kill lands
+            # mid-load but the controller has not stepped past the
+            # corpse yet — wait for the replace so the banked action
+            # log deterministically carries the healing decision
+            deadline = time.time() + 30.0
+            while time.time() < deadline and not any(
+                    a["action"] == "replace" for a in ctl.actions()):
+                time.sleep(0.2)
+        # member spawn is synchronous with the reconciliation pass that
+        # decides it, so a scale-up triggered at the tail of the load
+        # can still be mid-spawn here — wait for the loop to complete
+        # two more passes so every decision made under load is in the
+        # router (and the action log) before the snapshot
+        settled = ctl.steps + 2
+        deadline = time.time() + 60.0
+        while time.time() < deadline and ctl.steps < settled:
+            time.sleep(0.2)
+        members = {}
+        for mid in router.member_ids():
+            sup = router.get(mid)
+            if sup is None:
+                continue
+            block = {"stats": sup.stats(),
+                     "health": sup.health_state()}
+            try:
+                m = sup.metrics()
+                block["counters"] = m.get("counters")
+                block["occupancy"] = (m.get("histograms") or {}).get(
+                    "serve.batch_occupancy")
+                block["programs"] = m.get("programs")
+            except Exception as exc:  # noqa: BLE001 — dead member row
+                block["metrics_error"] = (
+                    f"{type(exc).__name__}: {exc}")
+            members[mid] = block
+        fleet_block = {
+            "n": args.fleet,
+            "front": "http" if args.fleet_http else "router",
+            "shared_cache": shared,
+            "members": members,
+            "router": router.stats(),
+            "controller": ctl.state(),
+            "actions": ctl.actions(),
+        }
+    finally:
+        if ingress is not None:
+            ingress.close()
+        ctl.stop(close_members=True)
+    # the controller's typed decision log, one JSONL line per action —
+    # what the run_suite fleet-chaos gate replays for a replace event
+    actions_path = os.path.join(obs.dir, "fleet_actions.jsonl")
+    for act in fleet_block["actions"]:
+        telemetry.append_jsonl(actions_path, act)
+    fleet_block["actions_path"] = actions_path
+    return summary, {"fleet": fleet_block, "transport": True,
+                     "tenant": args.tenant, "quota": args.quota,
+                     "chaos": (json.loads(args.chaos)
+                               if args.chaos else None)}
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
@@ -343,7 +583,12 @@ def main(argv=None) -> int:
         samplers = loadgen.default_samplers(mech, kinds)
     obs = _Obs(args)
 
-    runner = _run_transport if args.transport else _run_inprocess
+    if args.fleet is not None:
+        runner = _run_fleet
+    elif args.transport:
+        runner = _run_transport
+    else:
+        runner = _run_inprocess
     summary, extra = runner(args, kinds, bucket_sizes, rng, samplers,
                             obs, classify)
     if stiffness_mix is not None:
