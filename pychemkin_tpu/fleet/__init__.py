@@ -9,7 +9,10 @@ survives crashes; this package makes a POOL of them elastic:
   signals in, bounded add/replace/drain actions out, every decision a
   typed ``fleet.action`` event;
 - :mod:`.ingress` — the stdlib-HTTP front door mapping the transport
-  payload schema onto POST JSON, with ``/healthz`` and ``/metrics``.
+  payload schema onto POST JSON, with ``/healthz`` and ``/metrics``;
+- :mod:`.journal` — the ingress's crash-safe accept WAL: restart
+  replays accepted-unfinished requests, idempotency keys return
+  banked replies (ISSUE 19).
 
 The control plane (router + controller + ingress) is stdlib+telemetry
 code that runs in orchestrator processes; the chemistry (and the
@@ -18,10 +21,12 @@ accelerator work) lives in the supervised children.
 
 from .controller import FleetController, shared_cache_env
 from .ingress import FleetIngress
-from .router import FleetRouter, assignments, rendezvous_rank, \
-    route_key
+from .journal import IngressJournal
+from .router import (FleetRouter, MemberBreaker, assignments,
+                     rendezvous_rank, route_key)
 
 __all__ = [
-    "FleetController", "FleetIngress", "FleetRouter", "assignments",
-    "rendezvous_rank", "route_key", "shared_cache_env",
+    "FleetController", "FleetIngress", "FleetRouter", "IngressJournal",
+    "MemberBreaker", "assignments", "rendezvous_rank", "route_key",
+    "shared_cache_env",
 ]

@@ -21,6 +21,23 @@ sustained idleness    zero in-flight fleet-wide, nothing firing, for
                       newest member down to the pool floor
 ==================  =====================================================
 
+**Reconciliation is asynchronous** (ISSUE 19): a member spawn takes
+~15 s under full load (process start + warmup), and the PR-18
+controller paid that bill INSIDE the reconciliation pass — a spawn in
+flight delayed the next replace decision by its whole duration. Now
+``step()`` only *decides*: the decision lands in the action log
+immediately, the spawn runs on a tracked worker thread, and the
+member id is visible as a typed ``SPAWNING`` state in the router
+(counted in pool-size math so the controller never double-heals,
+never dispatchable until the backend is live). A spawn that itself
+hangs is bounded by ``PYCHEMKIN_FLEET_SPAWN_DEADLINE_S``: the
+controller emits a typed ``fleet.spawn_timeout`` event, abandons the
+id, and the next pass heals the deficit with a fresh spawn (a
+late-arriving abandoned backend is closed on arrival). Completion and
+failure land as cooldown-free ``spawn_complete``/``spawn_failed``
+actions, so the ``fleet.action`` timeline tells the whole story:
+decision at decision time, outcome at outcome time.
+
 Why scale-up is CHEAP here (and therefore safe to trigger from a
 signal): every member is spawned with the same ``PYCHEMKIN_STAGING_DIR``
 and the same persistent-XLA-cache dir (``PYCHEMKIN_CACHE_DIR`` — see
@@ -37,11 +54,12 @@ decision lands as one typed ``fleet.action`` event plus the
 ``fleet.pool_size`` gauge, so chemtop and the loadgen artifact replay
 the controller's story without parsing logs.
 
-:meth:`FleetController.step` is synchronous and side-effect-complete
-(the fast-lane tests drive it directly against fake members);
-:meth:`run`/:meth:`start` wrap it in the poll loop real deployments
-use. The controller itself is stdlib+telemetry code — the chemistry
-(and the accelerator) lives in the supervised children it spawns.
+:meth:`FleetController.step` is synchronous as a DECISION pass (the
+fast-lane tests drive it directly against fake members and then
+:meth:`wait_spawns` for the outcomes); :meth:`run`/:meth:`start` wrap
+it in the poll loop real deployments use. The controller itself is
+stdlib+telemetry code — the chemistry (and the accelerator) lives in
+the supervised children it spawns.
 """
 
 from __future__ import annotations
@@ -70,6 +88,24 @@ def shared_cache_env(base_dir: str) -> Dict[str, str]:
     }
 
 
+class _PendingSpawn:
+    """One in-flight member spawn: the decision is on the action log,
+    the factory call is on ``thread``, and ``abandoned`` (flipped by
+    the spawn-deadline sweep) tells a late worker to discard its
+    backend instead of adding it."""
+
+    __slots__ = ("mid", "action", "reason", "t_started", "thread",
+                 "abandoned")
+
+    def __init__(self, mid: str, action: str, reason: str):
+        self.mid = mid
+        self.action = action
+        self.reason = reason
+        self.t_started = time.monotonic()
+        self.thread: Optional[threading.Thread] = None
+        self.abandoned = False
+
+
 class FleetController:
     """Reconciles a :class:`~pychemkin_tpu.fleet.router.FleetRouter`'s
     member pool against the members' health signals.
@@ -78,7 +114,8 @@ class FleetController:
     :class:`~pychemkin_tpu.serve.supervisor.Supervisor` natively:
     ``alive``/``accepting``/``stats()``/``firing()``/``drain()``/
     ``close()``); the factory owns the shared-cache env plumbing
-    (:func:`shared_cache_env`).
+    (:func:`shared_cache_env`). The factory is called on controller
+    worker threads — it must be thread-safe for concurrent spawns.
     """
 
     def __init__(self, router: FleetRouter,
@@ -89,6 +126,7 @@ class FleetController:
                  poll_s: Optional[float] = None,
                  idle_polls: int = 5,
                  drain_timeout_s: float = 60.0,
+                 spawn_deadline_s: Optional[float] = None,
                  recorder=None):
         self.router = router
         self.make_backend = make_backend
@@ -105,6 +143,9 @@ class FleetController:
                             if poll_s is None else poll_s)
         self.idle_polls = max(1, int(idle_polls))
         self.drain_timeout_s = float(drain_timeout_s)
+        self.spawn_deadline_s = float(
+            knobs.value("PYCHEMKIN_FLEET_SPAWN_DEADLINE_S")
+            if spawn_deadline_s is None else spawn_deadline_s)
         self._rec = (recorder if recorder is not None
                      else telemetry.get_recorder())
         self._lock = threading.RLock()
@@ -113,45 +154,105 @@ class FleetController:
         self._idle_streak = 0               # guarded-by: _lock
         self._actions: List[Dict] = []      # guarded-by: _lock
         self._step_count = 0                # guarded-by: _lock
+        self._pending: Dict[str, _PendingSpawn] = {}  # guarded-by: _lock
         self._drain_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # -- membership ------------------------------------------------------
     def _next_member_id(self) -> str:
-        taken = set(self.router.member_ids())
+        taken = (set(self.router.member_ids())
+                 | set(self.router.spawning_ids()))
         with self._lock:
-            # skip ids already in the pool: a router seeded with
-            # members the controller did not create must never be
-            # silently overwritten by the controller's own sequence
+            # skip ids already in the pool (or mid-spawn): a router
+            # seeded with members the controller did not create must
+            # never be silently overwritten by the controller's own
+            # sequence
+            taken |= set(self._pending)
             while f"m{self._seq}" in taken:
                 self._seq += 1
             mid = f"m{self._seq}"
             self._seq += 1
         return mid
 
+    def _pool_total(self) -> int:
+        """Live members + spawns in flight — what sizing decisions
+        compare against min/max, so a pending spawn is never doubled
+        up on."""
+        with self._lock:
+            n_pending = sum(1 for p in self._pending.values()
+                            if not p.abandoned)
+        return len(self.router.member_ids()) + n_pending
+
     def ensure_min(self) -> List[Dict[str, Any]]:
         """Bring the pool up to the floor (initial fill; also heals a
-        pool that lost members faster than replace could run)."""
+        pool that lost members faster than replace could run). Issues
+        the spawns asynchronously, then WAITS for them — callers of
+        this method want a pool, not a promise; the non-blocking path
+        is :meth:`step`'s deficit heal."""
         actions = []
-        while len(self.router.member_ids()) < self.min_size:
+        while self._pool_total() < self.min_size:
             actions.append(self._add(reason="min_size"))
+        self.wait_spawns()
         return actions
+
+    def _spawn(self, action: str, *, reason: str,
+               evidence: Optional[Dict] = None,
+               **fields) -> Dict[str, Any]:
+        """Record the decision NOW, run the factory on a tracked
+        worker thread — the reconciliation pass never waits on a
+        spawn (the PR-18 leftover this PR closes)."""
+        mid = self._next_member_id()
+        pending = _PendingSpawn(mid, action, reason)
+        with self._lock:
+            self._pending[mid] = pending
+        self.router.note_spawning(mid)
+        record = self._record_action(action, member=mid, reason=reason,
+                                     evidence=evidence, **fields)
+
+        def _worker():
+            try:
+                backend = self.make_backend(mid)
+            except Exception as exc:  # noqa: BLE001 — typed outcome
+                with self._lock:
+                    self._pending.pop(mid, None)
+                self.router.abandon_spawn(mid)
+                self._record_action(
+                    "spawn_failed", member=mid, reason=reason,
+                    cooldown_free=True,
+                    evidence={"error":
+                              f"{type(exc).__name__}: {exc}"})
+                return
+            with self._lock:
+                abandoned = pending.abandoned
+                self._pending.pop(mid, None)
+            if abandoned:
+                # the deadline sweep already gave up on this id; a
+                # fresh spawn may be healing the deficit — discard
+                try:
+                    backend.close()
+                except Exception:    # noqa: BLE001 — teardown
+                    pass
+                self._record_action("spawn_discarded", member=mid,
+                                    reason=reason, cooldown_free=True)
+                return
+            self.router.add(mid, backend)
+            self._record_action("spawn_complete", member=mid,
+                                reason=reason, cooldown_free=True)
+
+        th = threading.Thread(target=_worker,
+                              name=f"fleet-spawn-{mid}", daemon=True)
+        pending.thread = th
+        th.start()
+        return record
 
     def _add(self, *, reason: str,
              evidence: Optional[Dict] = None) -> Dict[str, Any]:
-        mid = self._next_member_id()
-        backend = self.make_backend(mid)
-        self.router.add(mid, backend)
-        return self._record_action("add", member=mid, reason=reason,
-                                   evidence=evidence)
+        return self._spawn("add", reason=reason, evidence=evidence)
 
     def _replace(self, dead_mid: str,
                  dead_stats: Dict) -> Dict[str, Any]:
         old = self.router.remove(dead_mid)
-        mid = self._next_member_id()
-        backend = self.make_backend(mid)
-        self.router.add(mid, backend)
         if old is not None:
             try:
                 # resolves any leftovers typed; the dead member holds
@@ -159,12 +260,52 @@ class FleetController:
                 old.close()
             except Exception:        # noqa: BLE001 — dead member cleanup
                 pass
-        return self._record_action(
-            "replace", member=mid, reason="respawn_exhausted",
-            replaced=dead_mid,
+        return self._spawn(
+            "replace", reason="respawn_exhausted", replaced=dead_mid,
             evidence={"respawns": dead_stats.get("respawns"),
                       "backend_lost_requests":
                           dead_stats.get("backend_lost_requests")})
+
+    def _sweep_spawn_deadlines(self) -> List[Dict[str, Any]]:
+        """Bound every in-flight spawn: past the deadline, the id is
+        abandoned (typed ``fleet.spawn_timeout`` event) and the pool
+        deficit becomes visible again for the next heal."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [p for p in self._pending.values()
+                       if not p.abandoned
+                       and now - p.t_started > self.spawn_deadline_s]
+            for p in expired:
+                p.abandoned = True
+        actions = []
+        for p in expired:
+            self.router.abandon_spawn(p.mid)
+            self._rec.event(
+                "fleet.spawn_timeout", member=p.mid, action=p.action,
+                reason=p.reason,
+                elapsed_s=round(now - p.t_started, 3),
+                deadline_s=self.spawn_deadline_s)
+            actions.append(self._record_action(
+                "spawn_timeout", member=p.mid, reason=p.reason,
+                cooldown_free=True))
+        return actions
+
+    def wait_spawns(self, timeout_s: Optional[float] = None) -> bool:
+        """Join every non-abandoned in-flight spawn (tests, teardown,
+        artifact settling). Returns True when none remain."""
+        deadline = time.monotonic() + (
+            self.spawn_deadline_s if timeout_s is None else timeout_s)
+        while True:
+            with self._lock:
+                threads = [p.thread for p in self._pending.values()
+                           if not p.abandoned
+                           and p.thread is not None]
+            if not threads:
+                return True
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return False
+            threads[0].join(timeout=min(left, 0.5))
 
     def _drain(self, mid: str) -> Dict[str, Any]:
         """Route-side drain NOW (no new assignments), then the
@@ -199,8 +340,12 @@ class FleetController:
                        cooldown_free: bool = False,
                        **fields) -> Dict[str, Any]:
         pool = len(self.router.member_ids())
+        with self._lock:
+            n_spawning = sum(1 for p in self._pending.values()
+                             if not p.abandoned)
         record = {"t": time.time(), "action": action, "member": member,
-                  "reason": reason, "pool_size": pool, **fields}
+                  "reason": reason, "pool_size": pool,
+                  "n_spawning": n_spawning, **fields}
         with self._lock:
             if not cooldown_free:
                 self._last_action_t = time.monotonic()
@@ -218,10 +363,20 @@ class FleetController:
     # -- the reconciliation pass ----------------------------------------
     def step(self) -> List[Dict[str, Any]]:
         """One reconciliation pass; returns the actions taken (possibly
-        none). Ordering is deliberate: replace (healing — exempt from
-        the cooldown, a dead member helps nobody) before add (capacity)
-        before drain (economy)."""
+        none). Ordering is deliberate: spawn-deadline sweep (bound the
+        in-flight work) before replace (healing — exempt from the
+        cooldown, a dead member helps nobody) before deficit heal
+        before add (capacity) before drain (economy). Every action
+        here is a DECISION — spawns complete asynchronously."""
         actions: List[Dict[str, Any]] = []
+
+        # 0. bound in-flight spawns; sync the gray-failure machinery
+        actions.extend(self._sweep_spawn_deadlines())
+        try:
+            self.router.health_poll()
+        except Exception:            # noqa: BLE001 — health must not stop healing
+            pass
+
         member_stats: Dict[str, Dict] = {}
         saturated: List[Dict[str, Any]] = []
         for mid in self.router.member_ids():
@@ -248,7 +403,12 @@ class FleetController:
             if stats.get("dead"):
                 actions.append(self._replace(mid, stats))
 
-        pool = len(self.router.member_ids())
+        # 1.5 heal a deficit replace couldn't see (an abandoned spawn,
+        # members lost faster than polls) — async, unlike ensure_min
+        while self._pool_total() < self.min_size:
+            actions.append(self._add(reason="min_size"))
+
+        pool = self._pool_total()
 
         # 2. add on saturation signals
         if saturated and pool < self.max_size and self._cooldown_ok():
@@ -287,10 +447,11 @@ class FleetController:
 
     @property
     def steps(self) -> int:
-        """Completed reconciliation passes. Member spawn is synchronous
-        with the pass that decides it, so a caller that needs the pool
-        to reflect every decision made so far (artifact snapshots)
-        waits for this to advance rather than sleeping a poll interval."""
+        """Completed reconciliation passes. Member spawn is ASYNC with
+        the pass that decides it (ISSUE 19), so a caller that needs
+        the pool to reflect every decision made so far (artifact
+        snapshots) waits for this to advance AND for
+        :meth:`wait_spawns` / an empty ``state()["spawning"]``."""
         with self._lock:
             return self._step_count
 
@@ -313,6 +474,7 @@ class FleetController:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=max(self.poll_s * 4, 10.0))
+        self.wait_spawns(timeout_s=10.0)
         with self._lock:
             drainers = list(self._drain_threads)
         for th in drainers:
@@ -343,10 +505,14 @@ class FleetController:
             last = self._last_action_t
             n_actions = len(self._actions)
             recent = [dict(a) for a in self._actions[-8:]]
+            spawning = sorted(mid for mid, p in self._pending.items()
+                              if not p.abandoned)
         return {
             "pool_size": len(self.router.member_ids()),
+            "spawning": spawning,
             "min_size": self.min_size, "max_size": self.max_size,
             "cooldown_s": self.cooldown_s, "poll_s": self.poll_s,
+            "spawn_deadline_s": self.spawn_deadline_s,
             "idle_streak": idle_streak,
             "cooldown_remaining_s": (
                 0.0 if last is None else round(max(

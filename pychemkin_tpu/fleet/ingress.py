@@ -33,6 +33,20 @@ Endpoints:
     member's merged metrics reply (the chemtop fleet merge consumes
     ``members`` directly).
 
+**Durability** (ISSUE 19): with a journal configured
+(``journal_path=`` or ``PYCHEMKIN_FLEET_JOURNAL``), every ACCEPTED
+submit is appended to a crash-safe JSONL write-ahead log
+(:mod:`pychemkin_tpu.fleet.journal`) before the client's reply, and
+its terminal reply is banked as a done record. A restarted ingress
+replays accepted-but-unfinished entries exactly once with their
+REMAINING wall-clock deadline (expired entries close out as typed
+504s, no dispatch), and a request carrying an ``idempotency_key``
+already banked returns the banked reply without re-solving — a client
+whose connection died mid-solve retries the same key safely.
+Duplicate keys that race the original IN FLIGHT attach to the same
+resolution instead of double-solving. Rejections (400/429/503 at
+admission) are never journaled: nothing was promised.
+
 The ingress deliberately avoids importing the serve transport: it
 shares the payload schema by construction, not by import — the HTTP
 mapping has no business coupling to the TCP framing internals.
@@ -47,9 +61,11 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
-from .. import telemetry
+from .. import knobs, telemetry
 from ..serve.errors import ServerClosed, ServerOverloaded
 from ..telemetry import trace
+from .journal import (IngressJournal, new_request_id,
+                      remaining_deadline_ms)
 from .router import FleetRouter
 
 #: last-resort wait cap (s) for a submit with no deadline of its own —
@@ -125,18 +141,41 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(code, doc, headers)
 
 
+class _PendingIdem:
+    """A duplicate idempotency key racing the original in flight waits
+    here instead of double-solving."""
+
+    __slots__ = ("event", "code", "doc", "headers")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.code: int = 0
+        self.doc: Dict[str, Any] = {}
+        self.headers: Optional[Dict[str, str]] = None
+
+
 class FleetIngress:
     """The fleet's HTTP front door. ``controller`` is optional — when
     present its state rides on ``/metrics`` so one scrape tells the
-    whole elastic story."""
+    whole elastic story. ``journal_path`` (or the
+    ``PYCHEMKIN_FLEET_JOURNAL`` knob) turns on the durable accept
+    journal; pass ``None``/unset for the PR-18 in-memory behavior."""
 
     def __init__(self, router: FleetRouter, *, controller=None,
                  host: str = "127.0.0.1", port: int = 0,
+                 journal_path: Optional[str] = None,
                  recorder=None):
         self.router = router
         self.controller = controller
         self._rec = (recorder if recorder is not None
                      else telemetry.get_recorder())
+        if journal_path is None:
+            journal_path = knobs.value("PYCHEMKIN_FLEET_JOURNAL")
+        self.journal = (IngressJournal(journal_path)
+                        if journal_path else None)
+        self._idem_lock = threading.Lock()
+        self._inflight_idem: Dict[str, _PendingIdem] = {}
+        self._replayed = 0
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.ingress = self
@@ -152,6 +191,9 @@ class FleetIngress:
         return self._httpd.server_address[1]
 
     def start(self) -> "FleetIngress":
+        # honor crashed promises before taking new ones: replayed
+        # entries re-enter the router ahead of fresh client load
+        self.replay_journal()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="fleet-ingress",
             daemon=True)
@@ -163,6 +205,8 @@ class FleetIngress:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        if self.journal is not None:
+            self.journal.close()
 
     def __enter__(self) -> "FleetIngress":
         return self.start()
@@ -175,7 +219,11 @@ class FleetIngress:
                       ) -> Tuple[int, Dict[str, Any],
                                  Optional[Dict[str, str]]]:
         """Map one submit body onto the router; returns
-        ``(http_status, reply_doc, extra_headers)``."""
+        ``(http_status, reply_doc, extra_headers)``. With a journal,
+        an ``idempotency_key`` in the body makes the request safely
+        retryable: a banked duplicate returns the stored reply (with
+        an ``X-Idempotent-Replay: 1`` header), a racing duplicate
+        attaches to the in-flight resolution."""
         self._rec.inc("fleet.http.requests")
         kind = req.get("kind")
         payload = req.get("payload")
@@ -183,10 +231,64 @@ class FleetIngress:
             return 400, {"op": "error", "error": "BadRequest",
                          "message": "need string 'kind' and object "
                                     "'payload'"}, None
+        deadline_ms = req.get("deadline_ms")
+        wait_s = float(req.get("timeout_s") or (
+            DEFAULT_WAIT_S if deadline_ms is None
+            else float(deadline_ms) / 1e3 + 30.0))
+        idem = req.get("idempotency_key")
+        if idem is not None:
+            idem = str(idem)
+        pending: Optional[_PendingIdem] = None
+        if self.journal is not None and idem:
+            banked = self.journal.banked(idem)
+            if banked is not None:
+                self._rec.inc("fleet.journal.duplicates")
+                code, doc = banked
+                return code, dict(doc), {"X-Idempotent-Replay": "1"}
+            with self._idem_lock:
+                existing = self._inflight_idem.get(idem)
+                if existing is None:
+                    pending = _PendingIdem()
+                    self._inflight_idem[idem] = pending
+            if existing is not None:
+                # the first accept owns the solve; this duplicate
+                # just waits for its terminal reply
+                self._rec.inc("fleet.journal.duplicates")
+                if existing.event.wait(timeout=wait_s):
+                    return (existing.code, dict(existing.doc),
+                            {"X-Idempotent-Replay": "1"})
+                return 504, {"op": "error", "error": "Timeout",
+                             "message":
+                                 f"no resolution in {wait_s}s"}, None
+        try:
+            code, doc, headers = self._admit_and_wait(req, wait_s,
+                                                      idem=idem)
+        finally:
+            if pending is not None:
+                with self._idem_lock:
+                    self._inflight_idem.pop(idem, None)
+        if pending is not None:
+            pending.code, pending.doc, pending.headers = \
+                code, doc, headers
+            pending.event.set()
+        return code, doc, headers
+
+    def _admit_and_wait(self, req: Dict[str, Any], wait_s: float, *,
+                        idem: Optional[str] = None,
+                        rid: Optional[str] = None
+                        ) -> Tuple[int, Dict[str, Any],
+                                   Optional[Dict[str, str]]]:
+        """Admission + accept journaling + wait + done journaling —
+        one path for live requests AND journal replays (a replay
+        passes its original ``rid`` so no second accept record is
+        written; its done record closes the original promise)."""
+        kind = req["kind"]
+        payload = req["payload"]
         tenant = req.get("tenant")
         if tenant is not None:
             tenant = str(tenant)
         deadline_ms = req.get("deadline_ms")
+        is_replay = rid is not None
         try:
             fut = self.router.submit(
                 kind, tenant=tenant,
@@ -203,35 +305,98 @@ class FleetIngress:
             retry_ms = float(exc.retry_after_ms
                              if exc.retry_after_ms is not None
                              else self.router.retry_hint_ms())
-            return 429, {"op": "error", "error": "ServerOverloaded",
-                         "message": str(exc),
-                         "queue_depth": exc.queue_depth,
-                         "retry_after_ms": retry_ms}, {
+            code, doc, headers = 429, {
+                "op": "error", "error": "ServerOverloaded",
+                "message": str(exc), "queue_depth": exc.queue_depth,
+                "retry_after_ms": retry_ms}, {
                 "Retry-After": str(max(1, int(retry_ms / 1000.0 + 1)))}
+            # a live rejection was never promised — only a REPLAYED
+            # promise must still be closed out in the journal
+            if is_replay:
+                self.journal.record_done(rid, code, doc, idem=idem)
+            return code, doc, headers
         except ServerClosed as exc:
             self._rec.inc("fleet.http.rejected")
-            return 503, {"op": "error", "error": "ServerClosed",
-                         "message": str(exc)}, None
+            code, doc = 503, {"op": "error", "error": "ServerClosed",
+                              "message": str(exc)}
+            if is_replay:
+                self.journal.record_done(rid, code, doc, idem=idem)
+            return code, doc, None
         except KeyError as exc:
-            return 400, {"op": "error", "error": "BadRequest",
-                         "message": str(exc)}, None
-        wait_s = float(req.get("timeout_s") or (
-            DEFAULT_WAIT_S if deadline_ms is None
-            else float(deadline_ms) / 1e3 + 30.0))
+            code, doc = 400, {"op": "error", "error": "BadRequest",
+                              "message": str(exc)}
+            if is_replay:
+                self.journal.record_done(rid, code, doc, idem=idem)
+            return code, doc, None
+        if self.journal is not None and not is_replay:
+            # the durability line: this append lands BEFORE the client
+            # ever learns the request was accepted
+            rid = new_request_id()
+            body = {"kind": kind, "tenant": tenant,
+                    "deadline_ms": deadline_ms,
+                    "payload": _jsonable(payload)}
+            if "trace" in req:
+                body["trace"] = req["trace"]
+            self.journal.record_accept(rid, body=body, idem=idem)
+            self._rec.inc("fleet.journal.appends")
         try:
             result = fut.result(timeout=wait_s)
+            code, doc, headers = 200, {
+                "op": "result",
+                "result": dict(result._asdict())}, None
         except ServerClosed as exc:
-            return 503, {"op": "error", "error": "ServerClosed",
-                         "message": str(exc)}, None
+            code, doc, headers = 503, {
+                "op": "error", "error": "ServerClosed",
+                "message": str(exc)}, None
         except futures_mod.TimeoutError:
-            return 504, {"op": "error", "error": "Timeout",
-                         "message": f"no resolution in {wait_s}s"}, None
+            code, doc, headers = 504, {
+                "op": "error", "error": "Timeout",
+                "message": f"no resolution in {wait_s}s"}, None
         except Exception as exc:     # noqa: BLE001 — typed error reply
-            return 500, {"op": "error",
-                         "error": type(exc).__name__,
-                         "message": str(exc)}, None
-        return 200, {"op": "result",
-                     "result": dict(result._asdict())}, None
+            code, doc, headers = 500, {
+                "op": "error", "error": type(exc).__name__,
+                "message": str(exc)}, None
+        if self.journal is not None and rid is not None:
+            self.journal.record_done(rid, code, _jsonable(doc),
+                                     idem=idem)
+        return code, doc, headers
+
+    def replay_journal(self) -> int:
+        """Re-dispatch every accepted-but-unfinished journal entry
+        (``start()`` calls this before serving). Each entry runs with
+        its REMAINING wall-clock deadline; an already-expired entry is
+        closed out as a typed 504 done record without dispatch. The
+        solves run on worker threads — the replayed promise needs a
+        done record, not a waiting client — so this returns as soon as
+        the entries are re-admitted. Returns the number of entries
+        replayed."""
+        if self.journal is None:
+            return 0
+        entries = self.journal.unfinished()
+        for rec in entries:
+            rid = rec.get("rid") or new_request_id()
+            idem = rec.get("idem")
+            self._rec.inc("fleet.journal.replayed")
+            self._replayed += 1
+            remaining = remaining_deadline_ms(rec)
+            if remaining is not None and remaining <= 0.0:
+                self.journal.record_done(
+                    rid, 504, {"op": "error", "error": "Timeout",
+                               "message": "deadline expired before "
+                                          "restart replay"},
+                    idem=idem)
+                continue
+            replay_req = dict(rec.get("body") or {})
+            if remaining is not None:
+                replay_req["deadline_ms"] = remaining
+            wait_s = (DEFAULT_WAIT_S if remaining is None
+                      else remaining / 1e3 + 30.0)
+            threading.Thread(
+                target=self._admit_and_wait,
+                args=(replay_req, wait_s),
+                kwargs={"idem": idem, "rid": rid},
+                name=f"journal-replay-{rid[:8]}", daemon=True).start()
+        return len(entries)
 
     # -- read endpoints --------------------------------------------------
     def healthz(self) -> Tuple[int, Dict[str, Any]]:
@@ -259,6 +424,9 @@ class FleetIngress:
                                "router": self.router.stats()}
         if self.controller is not None:
             doc["controller"] = self.controller.state()
+        if self.journal is not None:
+            doc["journal"] = {"path": self.journal.path,
+                              "replayed": self._replayed}
         members = {}
         for mid in self.router.member_ids():
             backend = self.router.get(mid)

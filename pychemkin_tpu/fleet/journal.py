@@ -1,0 +1,181 @@
+"""Durable ingress journal: the write-ahead log behind "accepted means
+it will resolve — even across an ingress crash".
+
+The ingress's in-memory contract (an admitted future always resolves
+typed) dies with the process: a SIGKILL between acceptance and reply
+loses the request with no trace, and the client's only recourse is a
+blind retry that may double-solve. This module closes that hole with
+the telemetry spine's crash-safety discipline
+(:func:`~pychemkin_tpu.telemetry.append_jsonl` — whole-line appends to
+an ``O_APPEND`` fd, torn-tail-tolerant reads):
+
+- **accept record** — appended BEFORE the client ever sees a 2xx:
+  request id, the full submit body, the client's optional
+  ``idempotency_key``, wall-clock accept time and deadline. If the
+  process dies after this line, restart knows the promise exists.
+- **done record** — appended when the ingress produces the terminal
+  reply for that request id, banking the HTTP status + body. Accept
+  without done == unfinished.
+- **replay** (:meth:`IngressJournal.unfinished` driven by
+  ``FleetIngress.replay_journal``) — on restart, every unfinished
+  accept is re-submitted with its REMAINING wall-clock deadline
+  (expired entries are closed out typed, never dispatched), exactly
+  once: the replayed submit writes its own done record.
+- **idempotency** — done records keyed by ``idempotency_key`` are
+  banked (bounded LRU); a duplicate key returns the banked reply
+  without touching the router, across restarts included.
+
+Rejections (429/503/400) are never journaled: the client got a typed
+refusal and nothing was promised. The journal is one file per ingress;
+concurrent handler threads append whole lines, so records interleave
+but never tear (same guarantee the telemetry sink gives event lines).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..telemetry import dumps_line, read_jsonl
+
+#: banked idempotent replies kept in memory (oldest evicted first);
+#: the journal file itself remains the durable record past this bound
+IDEM_CACHE = 4096
+
+
+def new_request_id() -> str:
+    """Journal-scoped unique request id (uuid4 hex — must survive
+    restarts, so no in-process counter)."""
+    return uuid.uuid4().hex
+
+
+class IngressJournal:
+    """Append-side + scan-side of the ingress WAL.
+
+    Thread-safe: handler threads append concurrently; the append path
+    is one ``os.write`` of a whole line on an ``O_APPEND`` fd.
+    """
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        #: idem key -> (http_status, reply_doc); guarded-by: _lock
+        self._banked: "OrderedDict[str, Tuple[int, Dict]]" = \
+            OrderedDict()
+        self._unfinished: List[Dict[str, Any]] = []
+        self._load()
+        # open AFTER the scan so the scan never reads our own appends
+        self._fd = os.open(self.path,
+                           os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                           0o644)
+
+    # -- scan side -------------------------------------------------------
+    def _load(self) -> None:
+        """Replay the file into banked replies + unfinished accepts.
+        A torn final line (the one write a SIGKILL can truncate) is
+        skipped by ``read_jsonl`` — at worst the client of that very
+        last accept retries into a fresh solve, which is the same
+        outcome as dying a microsecond earlier."""
+        if not os.path.exists(self.path):
+            return
+        accepts: Dict[str, Dict[str, Any]] = {}
+        for rec in read_jsonl(self.path):
+            op = rec.get("op")
+            if op == "accept" and isinstance(rec.get("rid"), str):
+                accepts[rec["rid"]] = rec
+            elif op == "done":
+                accepts.pop(rec.get("rid"), None)
+                idem = rec.get("idem")
+                if isinstance(idem, str) and "code" in rec:
+                    self._bank(idem, int(rec["code"]),
+                               rec.get("doc") or {})
+        self._unfinished = sorted(accepts.values(),
+                                  key=lambda r: r.get("t", 0.0))
+
+    def unfinished(self) -> List[Dict[str, Any]]:
+        """Accept records with no done record, oldest first — what a
+        restart must re-dispatch (or close out expired)."""
+        with self._lock:
+            return [dict(r) for r in self._unfinished]
+
+    # -- append side -----------------------------------------------------
+    def _append(self, rec: Dict[str, Any]) -> None:
+        os.write(self._fd, (dumps_line(rec) + "\n").encode("utf-8"))
+
+    def record_accept(self, rid: str, *, body: Dict[str, Any],
+                      idem: Optional[str] = None,
+                      t: Optional[float] = None) -> None:
+        """MUST land before the client learns of acceptance — that
+        ordering is the entire durability contract."""
+        self._append({"op": "accept", "rid": rid, "idem": idem,
+                      "t": time.time() if t is None else t,
+                      "body": body})
+
+    def record_done(self, rid: str, code: int, doc: Dict[str, Any], *,
+                    idem: Optional[str] = None,
+                    t: Optional[float] = None) -> None:
+        self._append({"op": "done", "rid": rid, "idem": idem,
+                      "code": int(code),
+                      "t": time.time() if t is None else t,
+                      "doc": doc})
+        if idem:
+            with self._lock:
+                self._bank(idem, int(code), doc)
+
+    # -- idempotency bank ------------------------------------------------
+    def _bank(self, idem: str, code: int, doc: Dict) -> None:
+        # caller holds _lock (or is the single-threaded loader)
+        self._banked[idem] = (code, doc)
+        self._banked.move_to_end(idem)
+        while len(self._banked) > IDEM_CACHE:
+            self._banked.popitem(last=False)
+
+    def banked(self, idem: str) -> Optional[Tuple[int, Dict]]:
+        """The terminal reply previously produced for this idempotency
+        key, or None — the "duplicate returns the banked result
+        without re-solving" path."""
+        with self._lock:
+            hit = self._banked.get(idem)
+            if hit is not None:
+                self._banked.move_to_end(idem)
+            return hit
+
+    def close(self) -> None:
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+
+    def __enter__(self) -> "IngressJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def remaining_deadline_ms(accept: Dict[str, Any],
+                          now: Optional[float] = None
+                          ) -> Optional[float]:
+    """What is left of a replayed request's wall-clock budget: the
+    original ``deadline_ms`` minus the time the request already spent
+    accepted (crash + restart included). ``None`` when the request had
+    no deadline; ``<= 0`` means expired — close it out typed, never
+    dispatch."""
+    body = accept.get("body") or {}
+    deadline_ms = body.get("deadline_ms")
+    if deadline_ms is None:
+        return None
+    now = time.time() if now is None else now
+    elapsed_ms = max(0.0, now - float(accept.get("t", now))) * 1e3
+    return float(deadline_ms) - elapsed_ms
+
+
+__all__ = ["IngressJournal", "new_request_id",
+           "remaining_deadline_ms", "IDEM_CACHE"]

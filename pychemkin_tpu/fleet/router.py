@@ -26,6 +26,27 @@ arXiv:2112.05834 reduces to key placement:
   starts absorbing a single-mechanism ramp within one poll instead of
   idling behind a saturated primary.
 
+Gray-failure immunity (ISSUE 19) rides the same placement machinery:
+
+- **per-member circuit breakers** consume the cross-member
+  ``MEMBER_DEGRADED`` signal (:mod:`pychemkin_tpu.health.outlier`):
+  a tripped member's breaker OPENs — it stops winning new
+  assignments while its in-flight work drains, and rendezvous spill
+  absorbs its keys exactly like a drain; after ``BREAKER_OPEN_S`` it
+  goes HALF-OPEN, admitting a bounded number of probe requests whose
+  latencies are the only way the detector can prove recovery;
+- **hedged requests**: when an in-flight request's elapsed time
+  crosses its member's recent windowed p99, the router re-issues it
+  to the next rendezvous choice and takes the first typed answer —
+  first-wins dedup by request id, the loser is cancelled/discarded,
+  and ``fleet.hedge.{issued,won,wasted}`` count the economics. One
+  slow member costs one hedge, never a deadline — and the hedge's
+  completions on healthy peers are what bootstraps the fleet-median
+  baseline the outlier detector needs under single-mech affinity;
+- **typed transition states**: members mid-SPAWNING (the async
+  controller's in-flight adds) and mid-DRAINING are visible in
+  :meth:`member_states` and excluded from new assignments.
+
 Tenant quotas are honored FLEET-WIDE: the per-backend transport quota
 bounds one process, the router's quota bounds the tenant across the
 pool, so scale-up does not silently multiply a tenant's admission.
@@ -33,17 +54,20 @@ pool, so scale-up does not silently multiply a tenant's admission.
 Pure routing core (:func:`rendezvous_rank`, :func:`route_key`,
 :func:`assignments`) is separated from the threaded dispatch layer so
 the stability/affinity/redistribution properties are testable without
-processes.
+processes; :class:`MemberBreaker` and the hedge decision take an
+injectable clock for the same reason.
 """
 
 from __future__ import annotations
 
 import hashlib
+import itertools
 import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
-from .. import telemetry
+from .. import knobs, telemetry
+from ..health.outlier import MemberOutlierTracker
 from ..resilience.status import SolveStatus
 from ..serve.errors import ServerClosed, ServerOverloaded, \
     TransportClosed
@@ -53,6 +77,11 @@ from ..telemetry import trace
 #: fallback overload backoff hint (ms) before any result has been
 #: observed — one default batch window's worth, deliberately small
 DEFAULT_RETRY_HINT_MS = 50.0
+
+#: how often the hedge scanner ALSO runs a health poll (outlier
+#: evaluation + breaker sync) when no controller is driving one —
+#: expressed in scanner iterations, computed from the poll knob
+HEALTH_EVERY_S = 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -89,24 +118,144 @@ def assignments(keys: Sequence[str], member_ids: Iterable[str]
 
 
 # ---------------------------------------------------------------------------
+# per-member circuit breaker
+
+class MemberBreaker:
+    """closed → open → half-open state machine for ONE member.
+
+    Driven by the outlier detector (``trip`` while MEMBER_DEGRADED
+    fires, ``clear`` when it clears) and consulted by the dispatch
+    loop (``try_acquire`` per assignment). OPEN sheds every new
+    assignment; after ``open_s`` the first ``try_acquire`` moves to
+    HALF_OPEN, which admits at most ``probes`` concurrent probe
+    requests — their completions are the recovery evidence. A trip
+    while HALF_OPEN re-opens only after at least one probe has
+    completed (the probes must be allowed to finish and testify).
+
+    Pure and clock-injectable: ``clock`` is any monotonic float
+    callable, so the state machine unit-tests with a fake clock.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, member_id: str, *,
+                 open_s: Optional[float] = None,
+                 probes: Optional[int] = None,
+                 clock=time.monotonic):
+        self.member_id = str(member_id)
+        self.open_s = float(
+            knobs.value("PYCHEMKIN_FLEET_BREAKER_OPEN_S")
+            if open_s is None else open_s)
+        self.probes = int(
+            knobs.value("PYCHEMKIN_FLEET_BREAKER_PROBES")
+            if probes is None else probes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self._opened_at: Optional[float] = None
+        self._probes_inflight = 0
+        self._probes_done = 0
+        self.n_trips = 0
+
+    def trip(self, now: Optional[float] = None) -> bool:
+        """The member's MEMBER_DEGRADED is firing. Returns True when
+        this call actually opened the breaker (a transition)."""
+        with self._lock:
+            if self.state == self.OPEN:
+                return False         # keep the original open stamp
+            if self.state == self.HALF_OPEN and self._probes_done < 1:
+                return False         # let the probes testify first
+            self.state = self.OPEN
+            self._opened_at = self._clock() if now is None else now
+            self._probes_inflight = 0
+            self._probes_done = 0
+            self.n_trips += 1
+            return True
+
+    def clear(self) -> bool:
+        """The member's MEMBER_DEGRADED cleared. Returns True on an
+        actual open/half-open → closed transition."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return False
+            self.state = self.CLOSED
+            self._opened_at = None
+            self._probes_inflight = 0
+            self._probes_done = 0
+            return True
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        """May one NEW assignment go to this member right now? A True
+        return from HALF_OPEN takes a probe slot the caller must give
+        back via :meth:`release`."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            now = self._clock() if now is None else now
+            if self.state == self.OPEN:
+                if (self._opened_at is not None
+                        and now - self._opened_at < self.open_s):
+                    return False
+                self.state = self.HALF_OPEN
+                self._probes_inflight = 0
+                self._probes_done = 0
+            if self._probes_inflight >= self.probes:
+                return False
+            self._probes_inflight += 1
+            return True
+
+    def release(self, *, completed: bool = True) -> None:
+        """Give back a probe slot (``completed`` False when the
+        acquire never turned into a live submit)."""
+        with self._lock:
+            if self.state != self.HALF_OPEN:
+                return
+            self._probes_inflight = max(0, self._probes_inflight - 1)
+            if completed:
+                self._probes_done += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self.state, "opened_at": self._opened_at,
+                    "probes_inflight": self._probes_inflight,
+                    "probes_done": self._probes_done,
+                    "n_trips": self.n_trips}
+
+
+# ---------------------------------------------------------------------------
 # threaded dispatch layer
 
 class _Route:
     """One admitted request's routing state: which members were
-    burned, the absolute deadline its re-routes must respect."""
+    burned, the absolute deadline its re-routes must respect, and the
+    first-wins bookkeeping hedging needs (``done``/``winner`` guarded
+    by the router lock)."""
 
-    __slots__ = ("kind", "tenant", "payload", "future", "deadline",
-                 "trace_id", "tried", "t_submit")
+    __slots__ = ("id", "kind", "tenant", "payload", "future",
+                 "deadline", "trace_id", "tried", "t_submit",
+                 "t_dispatched", "member_futs", "last_member",
+                 "done", "winner", "hedged", "hedge_member")
 
-    def __init__(self, kind, tenant, payload, deadline, trace_id):
+    def __init__(self, rid, kind, tenant, payload, deadline, trace_id,
+                 t_submit):
+        self.id = rid
         self.kind = kind
         self.tenant = tenant
         self.payload = payload
         self.future = ServeFuture()
-        self.deadline = deadline     # absolute perf_counter, or None
+        self.deadline = deadline     # absolute clock time, or None
         self.trace_id = trace_id
         self.tried: set = set()
-        self.t_submit = time.perf_counter()
+        self.t_submit = t_submit
+        self.t_dispatched: Dict[str, float] = {}
+        self.member_futs: Dict[str, Any] = {}
+        self.last_member: Optional[str] = None
+        self.done = False
+        self.winner: Optional[str] = None
+        self.hedged = False
+        self.hedge_member: Optional[str] = None
 
 
 class FleetRouter:
@@ -118,21 +267,45 @@ class FleetRouter:
     ``tenants`` is the same ``{name: {"mech", "quota"}}`` block the
     transport config carries; the router resolves tenant → mech for
     the routing key and enforces each quota across the WHOLE pool.
+
+    ``hedge`` (default: the ``PYCHEMKIN_FLEET_HEDGE`` knob) runs the
+    background hedge scanner; pass False in unit tests and drive
+    :meth:`hedge_scan` / :meth:`health_poll` with a fake ``clock``
+    instead. ``clock`` must be monotonic (``time.perf_counter``-like);
+    it stamps submits, deadlines, and hedge decisions.
     """
 
     def __init__(self, tenants: Optional[Dict[str, Dict]] = None,
-                 recorder=None, default_tenant: str = "default"):
+                 recorder=None, default_tenant: str = "default",
+                 hedge: Optional[bool] = None, clock=None):
         self.default_tenant = str(default_tenant)
         self._rec = (recorder if recorder is not None
                      else telemetry.get_recorder())
+        self._clock = clock if clock is not None else time.perf_counter
         self._lock = threading.RLock()
         self._members: Dict[str, Any] = {}       # guarded-by: _lock
         self._draining: set = set()              # guarded-by: _lock
+        self._spawning: set = set()              # guarded-by: _lock
         self._assigned: Dict[str, int] = {}      # guarded-by: _lock
         self._reroutes = 0                       # guarded-by: _lock
         self._rejected = 0                       # guarded-by: _lock
         self._inflight: Dict[str, int] = {}      # guarded-by: _lock
         self._latency_ms: Optional[float] = None  # guarded-by: _lock
+        self._routes: Dict[int, _Route] = {}     # guarded-by: _lock
+        self._route_ids = itertools.count()
+        self._breakers: Dict[str, MemberBreaker] = {}  # guarded-by: _lock
+        self._hedge_stats = {"issued": 0, "won": 0,
+                             "wasted": 0}        # guarded-by: _lock
+        self.outliers = MemberOutlierTracker(self._rec)
+        self.hedge_enabled = bool(
+            knobs.value("PYCHEMKIN_FLEET_HEDGE")
+            if hedge is None else hedge)
+        self._hedge_floor_ms = float(
+            knobs.value("PYCHEMKIN_FLEET_HEDGE_FLOOR_MS"))
+        self._hedge_poll_ms = float(
+            knobs.value("PYCHEMKIN_FLEET_HEDGE_POLL_MS"))
+        self._scanner: Optional[threading.Thread] = None
+        self._stop = threading.Event()
         self._tenants = {
             str(name): {"mech": str(spec.get("mech", name)),
                         "quota": int(spec.get("quota", 64))}
@@ -144,13 +317,19 @@ class FleetRouter:
     # -- pool management -------------------------------------------------
     def add(self, member_id: str, backend: Any) -> None:
         with self._lock:
-            self._members[str(member_id)] = backend
-            self._draining.discard(str(member_id))
+            mid = str(member_id)
+            self._members[mid] = backend
+            self._draining.discard(mid)
+            self._spawning.discard(mid)
 
     def remove(self, member_id: str) -> Optional[Any]:
         with self._lock:
-            self._draining.discard(str(member_id))
-            return self._members.pop(str(member_id), None)
+            mid = str(member_id)
+            self._draining.discard(mid)
+            self._breakers.pop(mid, None)
+            backend = self._members.pop(mid, None)
+        self.outliers.forget(str(member_id))
+        return backend
 
     def start_drain(self, member_id: str) -> None:
         """Stop assigning NEW work to a member; it keeps whatever it
@@ -161,6 +340,24 @@ class FleetRouter:
             if member_id in self._members:
                 self._draining.add(str(member_id))
 
+    def note_spawning(self, member_id: str) -> None:
+        """A member id whose backend is still being spawned (the
+        async controller's in-flight add): visible in
+        :meth:`member_states`/:meth:`stats` so pool-size math counts
+        it, never dispatchable until :meth:`add` lands it."""
+        with self._lock:
+            self._spawning.add(str(member_id))
+
+    def abandon_spawn(self, member_id: str) -> None:
+        """The controller gave up on a spawn (deadline): drop the
+        typed SPAWNING state without adding a backend."""
+        with self._lock:
+            self._spawning.discard(str(member_id))
+
+    def spawning_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._spawning)
+
     def member_ids(self) -> List[str]:
         with self._lock:
             return sorted(self._members)
@@ -169,9 +366,28 @@ class FleetRouter:
         with self._lock:
             return self._members.get(str(member_id))
 
+    def member_states(self) -> Dict[str, str]:
+        """Typed per-member routing state: ``spawning`` (backend not
+        yet live), ``draining``, the breaker states ``open`` /
+        ``half_open``, or ``ok``."""
+        with self._lock:
+            out = {mid: "spawning" for mid in self._spawning}
+            for mid in self._members:
+                if mid in self._draining:
+                    out[mid] = "draining"
+                    continue
+                br = self._breakers.get(mid)
+                state = br.snapshot()["state"] if br is not None \
+                    else MemberBreaker.CLOSED
+                out[mid] = ("ok" if state == MemberBreaker.CLOSED
+                            else state)
+            return out
+
     def _eligible(self) -> Dict[str, Any]:
         """Members that may win NEW assignments: present, not
-        draining, alive, and accepting submits."""
+        draining, alive, and accepting submits. Breaker admission is
+        checked per-dispatch (half-open probe slots are a bounded
+        resource, not a pool property)."""
         with self._lock:
             pool = {mid: b for mid, b in self._members.items()
                     if mid not in self._draining}
@@ -230,13 +446,16 @@ class FleetRouter:
                 f"({spec['quota']}) saturated",
                 queue_depth=spec["quota"],
                 retry_after_ms=self.retry_hint_ms())
-        t_submit = time.perf_counter()
+        t_submit = self._clock()
         route = _Route(
+            rid=next(self._route_ids),
             kind=kind, tenant=tenant, payload=dict(payload),
             deadline=(None if deadline_ms is None
                       else t_submit + float(deadline_ms) * 1e-3),
-            trace_id=trace.resolve_trace_id(trace_id))
+            trace_id=trace.resolve_trace_id(trace_id),
+            t_submit=t_submit)
         self._rec.inc("fleet.requests")
+        self._ensure_scanner()
         try:
             sent = self._dispatch(route, first=True)
         except BaseException:
@@ -245,6 +464,9 @@ class FleetRouter:
         if not sent:
             self._finish_tenant(tenant)
             raise ServerClosed("no eligible fleet member")
+        with self._lock:
+            if not route.done:
+                self._routes[route.id] = route
         return route.future
 
     def _finish_tenant(self, tenant: str) -> None:
@@ -252,15 +474,42 @@ class FleetRouter:
             self._inflight[tenant] = max(
                 0, self._inflight.get(tenant, 0) - 1)
 
-    def _resolve(self, route: _Route, result=None, exc=None) -> None:
-        self._finish_tenant(route.tenant)
-        if result is not None:
-            with self._lock:
-                life_ms = (time.perf_counter()
-                           - route.t_submit) * 1e3
+    def _resolve(self, route: _Route, result=None, exc=None,
+                 member: Optional[str] = None) -> None:
+        """First-wins resolution: exactly one member's answer (or one
+        terminal error) lands on the caller future; a hedge loser
+        arriving later is discarded here by the ``done`` flag."""
+        with self._lock:
+            if route.done:
+                return
+            route.done = True
+            route.winner = member
+            self._routes.pop(route.id, None)
+            losers = [f for m, f in route.member_futs.items()
+                      if m != member]
+            if result is not None:
+                life_ms = (self._clock() - route.t_submit) * 1e3
                 self._latency_ms = (
                     life_ms if self._latency_ms is None
                     else 0.8 * self._latency_ms + 0.2 * life_ms)
+            hedge_won = hedge_wasted = False
+            if route.hedged and member is not None:
+                hedge_won = member == route.hedge_member
+                hedge_wasted = not hedge_won
+                self._hedge_stats["won" if hedge_won
+                                  else "wasted"] += 1
+        if hedge_won:
+            self._rec.inc("fleet.hedge.won")
+        elif hedge_wasted:
+            self._rec.inc("fleet.hedge.wasted")
+        self._finish_tenant(route.tenant)
+        for lf in losers:
+            # best-effort: a loser still queued dies here; one already
+            # running finishes and is discarded by the done flag
+            try:
+                lf.cancel()
+            except Exception:        # noqa: BLE001 — loser teardown
+                pass
         try:
             if exc is not None:
                 route.future.set_exception(exc)
@@ -269,22 +518,32 @@ class FleetRouter:
         except Exception:            # noqa: BLE001 — racing resolution
             pass
 
-    def _dispatch(self, route: _Route, first: bool = False) -> bool:
+    def _dispatch(self, route: _Route, first: bool = False,
+                  hedge: bool = False) -> bool:
         """Send ``route`` to the best untried eligible member; returns
         False when none is left. On the FIRST attempt failures raise
         at the call site; on re-routes everything resolves through the
-        future (callback context must never raise)."""
+        future (callback context must never raise); a hedge attempt
+        that finds no member is simply not issued."""
+        with self._lock:
+            if route.done:
+                return True
         mech = self.tenant_mech(route.tenant)
         eligible = self._eligible()
         overloaded: Optional[ServerOverloaded] = None
         for mid in rendezvous_rank(route_key(mech), eligible):
             if mid in route.tried:
                 continue
+            with self._lock:
+                breaker = self._breakers.get(mid)
+            if breaker is not None and not breaker.try_acquire():
+                # open/half-open-saturated breaker: shed this NEW
+                # assignment; rendezvous spill finds the next member
+                continue
             backend = eligible[mid]
             remaining_ms = None
             if route.deadline is not None:
-                remaining_ms = (route.deadline
-                                - time.perf_counter()) * 1e3
+                remaining_ms = (route.deadline - self._clock()) * 1e3
                 if remaining_ms <= 0.0:
                     # expired between hops: the supervisor would
                     # resolve it DEADLINE_EXCEEDED anyway — let the
@@ -297,20 +556,29 @@ class FleetRouter:
                     deadline_ms=remaining_ms,
                     trace_id=route.trace_id, **route.payload)
             except (ServerClosed, TransportClosed):
+                if breaker is not None:
+                    breaker.release(completed=False)
                 continue             # raced into drain/death: next
             except ServerOverloaded as exc:
                 # bounded-load spill: affinity holds until the winner
                 # pushes back, then the next-ranked member absorbs
                 # the overflow (how a fresh scale-up member starts
                 # taking a single-mech ramp's traffic)
+                if breaker is not None:
+                    breaker.release(completed=False)
                 overloaded = exc
                 continue
             with self._lock:
                 self._assigned[mid] = self._assigned.get(mid, 0) + 1
+                route.t_dispatched[mid] = self._clock()
+                route.member_futs[mid] = member_fut
+                route.last_member = mid
             member_fut.add_done_callback(
                 lambda f, r=route, m=mid: self._on_member_done(
                     r, m, f))
             return True
+        if hedge:
+            return False             # no one to hedge to: not an error
         if overloaded is not None:
             # every eligible member pushed back: the fleet really IS
             # full — surface the overload (typed backpressure), at the
@@ -323,7 +591,27 @@ class FleetRouter:
 
     def _on_member_done(self, route: _Route, member_id: str,
                         fut: ServeFuture) -> None:
-        exc = fut.exception()
+        with self._lock:
+            breaker = self._breakers.get(member_id)
+            t_disp = route.t_dispatched.get(member_id)
+            already = route.done
+        if breaker is not None:
+            breaker.release(completed=True)
+        exc = fut.exception() if not fut.cancelled() \
+            else TransportClosed("hedge loser cancelled")
+        if t_disp is not None and (exc is None or fut.cancelled()):
+            # member-attributed service time, winners and hedge
+            # losers alike: a gray member's slow completions are
+            # exactly the outlier detector's evidence. A loser
+            # cancelled while still pending contributes its
+            # elapsed-at-cancel as a CENSORED sample (it ran AT LEAST
+            # this long) — a member slow enough that every request
+            # hedges away from it would otherwise never complete
+            # anything and could never fire MEMBER_DEGRADED
+            self.outliers.observe(
+                member_id, (self._clock() - t_disp) * 1e3)
+        if already:
+            return                   # hedge loser: result discarded
         if exc is not None:
             if isinstance(exc, (ServerClosed, TransportClosed)):
                 # the member went away under the request: re-route
@@ -337,7 +625,7 @@ class FleetRouter:
                               reason="ServerOverloaded",
                               fallback_exc=exc)
                 return
-            self._resolve(route, exc=exc)
+            self._resolve(route, exc=exc, member=member_id)
             return
         result = fut.result()
         if int(result.status) == int(SolveStatus.BACKEND_LOST):
@@ -346,20 +634,23 @@ class FleetRouter:
             self._reroute(route, member_id, reason="BACKEND_LOST",
                           fallback=result)
             return
-        self._resolve(route, result=result)
+        self._resolve(route, result=result, member=member_id)
 
     def _reroute(self, route: _Route, member_id: str, *,
                  reason: str, fallback=None,
                  fallback_exc=None) -> None:
+        with self._lock:
+            if route.done:
+                return               # the hedge already answered
         expired = (route.deadline is not None
-                   and time.perf_counter() >= route.deadline)
+                   and self._clock() >= route.deadline)
         if not expired:
             with self._lock:
                 self._reroutes += 1
             self._rec.inc("fleet.reroutes")
             trace.emit_span(
                 self._rec, route.trace_id, "fleet.reroute",
-                (time.perf_counter() - route.t_submit) * 1e3,
+                (self._clock() - route.t_submit) * 1e3,
                 member=member_id, reason=reason)
             if self._dispatch(route):
                 return
@@ -372,20 +663,141 @@ class FleetRouter:
                 f"member {member_id} lost ({reason}); no eligible "
                 "member left to re-route to"))
 
+    # -- hedging ---------------------------------------------------------
+    def _hedge_threshold_ms(self, member_id: str) -> float:
+        """Elapsed-time trigger for one member: its recent windowed
+        p99 when the detector has one, else the fleet latency EMA,
+        floored by the hedge floor either way."""
+        p99 = self.outliers.p99(member_id)
+        if p99 is None:
+            with self._lock:
+                p99 = self._latency_ms
+        return max(self._hedge_floor_ms,
+                   p99 if p99 is not None else 0.0)
+
+    def hedge_scan(self, now: Optional[float] = None) -> int:
+        """One pass over the in-flight routes: issue a hedge for every
+        request whose elapsed time on its current member crossed that
+        member's threshold and that has an untried eligible member
+        left. At most one hedge per request — one slow member costs
+        one hedge. Returns the number issued (the scanner thread
+        calls this; tests call it directly with a fake ``now``)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            candidates = [r for r in self._routes.values()
+                          if not r.done and not r.hedged
+                          and r.last_member is not None]
+        issued = 0
+        for route in candidates:
+            t_disp = route.t_dispatched.get(route.last_member)
+            if t_disp is None:
+                continue
+            elapsed_ms = (now - t_disp) * 1e3
+            if elapsed_ms <= self._hedge_threshold_ms(
+                    route.last_member):
+                continue
+            primary = route.last_member
+            route.hedged = True
+            if not self._dispatch(route, hedge=True):
+                route.hedged = False  # nobody to hedge to (yet)
+                continue
+            with self._lock:
+                route.hedge_member = route.last_member
+                self._hedge_stats["issued"] += 1
+            issued += 1
+            self._rec.inc("fleet.hedge.issued")
+            trace.emit_span(
+                self._rec, route.trace_id, "fleet.reroute",
+                elapsed_ms, member=primary, reason="hedge")
+        return issued
+
+    def _ensure_scanner(self) -> None:
+        if not self.hedge_enabled or self._scanner is not None:
+            return
+        with self._lock:
+            if self._scanner is not None:
+                return
+            self._scanner = threading.Thread(
+                target=self._scan_loop, name="fleet-hedge-scanner",
+                daemon=True)
+            self._scanner.start()
+
+    def _scan_loop(self) -> None:
+        poll_s = self._hedge_poll_ms * 1e-3
+        health_every = max(1, int(HEALTH_EVERY_S / poll_s))
+        i = 0
+        while not self._stop.wait(poll_s):
+            i += 1
+            try:
+                self.hedge_scan()
+                if i % health_every == 0:
+                    # self-contained health loop: an ingress-only
+                    # fleet (no controller polling) still trips
+                    # breakers and clears them
+                    self.health_poll()
+            except Exception:        # noqa: BLE001 — scanner must survive
+                pass
+
+    # -- health / breaker sync -------------------------------------------
+    def health_poll(self, t: Optional[float] = None
+                    ) -> List[Dict[str, Any]]:
+        """One outlier evaluation + breaker sync: MEMBER_DEGRADED
+        firing trips the member's breaker, clearing closes it.
+        Called by the controller's reconciliation step, the scanner
+        thread, or a test's fake clock. Returns the detector's
+        transitions."""
+        transitions = self.outliers.evaluate(t)
+        firing = set(self.outliers.firing())
+        with self._lock:
+            mids = list(self._members)
+            for mid in firing:
+                if mid in self._members \
+                        and mid not in self._breakers:
+                    self._breakers[mid] = MemberBreaker(
+                        mid, clock=self._clock)
+            breakers = dict(self._breakers)
+        for mid in mids:
+            br = breakers.get(mid)
+            if br is None:
+                continue
+            if mid in firing:
+                br.trip()
+            else:
+                br.clear()
+        return transitions
+
+    def close(self) -> None:
+        """Stop the hedge scanner thread (members are NOT closed —
+        the controller owns their lifecycle)."""
+        self._stop.set()
+        scanner = self._scanner
+        if scanner is not None:
+            scanner.join(timeout=2.0)
+
     # -- read side -------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """JSON-ready routing state: per-member assignment counts,
-        re-routes, fleet-wide tenant in-flight vs quota, drain set."""
+        re-routes, fleet-wide tenant in-flight vs quota, drain set,
+        typed transition states, breakers, hedge economics."""
         with self._lock:
-            return {
+            out = {
                 "members": sorted(self._members),
                 "draining": sorted(self._draining),
+                "spawning": sorted(self._spawning),
                 "assigned": dict(self._assigned),
                 "reroutes": self._reroutes,
                 "rejected": self._rejected,
+                "inflight_routes": len(self._routes),
+                "hedge": dict(self._hedge_stats),
+                "breakers": {mid: br.snapshot()
+                             for mid, br in
+                             sorted(self._breakers.items())},
                 "tenants": {
                     name: {"inflight": self._inflight.get(name, 0),
                            "quota": spec["quota"],
                            "mech": spec["mech"]}
                     for name, spec in sorted(self._tenants.items())},
             }
+        out["states"] = self.member_states()
+        out["outliers"] = self.outliers.state()
+        return out

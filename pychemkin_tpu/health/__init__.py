@@ -19,6 +19,10 @@ suite orchestrator:
 - :mod:`.monitor` — the thread-safe embeddable form (ring + engine +
   JSONL history banking) the supervisor runs; chemtop's poll loop
   drives the ring/engine directly.
+- :mod:`.outlier` — the cross-member view the per-member engines
+  cannot have: windowed per-member p99 vs the fleet median with
+  hysteresis, emitting ``MEMBER_DEGRADED`` — the gray-failure signal
+  the fleet router's breakers consume (ISSUE 19).
 
 The consumers ROADMAP #3 (autoscaling) and #4 (surrogate flywheel)
 read these signals instead of re-inventing scraping: LADDER_SATURATED
@@ -26,6 +30,7 @@ is the scale-up trigger, SURROGATE_RETRAIN the retrain trigger.
 """
 
 from .monitor import HealthMonitor
+from .outlier import MemberOutlierTracker
 from .signals import (
     DEFAULT_RULES,
     EVALUATORS,
@@ -47,6 +52,7 @@ __all__ = [
     "EVALUATORS",
     "HealthEngine",
     "HealthMonitor",
+    "MemberOutlierTracker",
     "SEVERITIES",
     "SIGNAL_NAMES",
     "SnapshotRing",

@@ -1,0 +1,254 @@
+"""Cross-member latency outlier detection — the ``MEMBER_DEGRADED``
+signal.
+
+The per-member :class:`~.signals.HealthEngine` pool sees one member at
+a time, so it can catch a DEAD member (``BACKEND_DOWN``) but never a
+GRAY one: a backend that is alive, answers heartbeats, and is 20×
+slower than its peers looks healthy from inside its own scrape. Gray
+is a *relative* property — this tracker owns the cross-member view.
+
+The router feeds it member-attributed request latencies (every
+completion, winners and hedge losers alike — a slow member's slow
+completions are exactly the evidence); each member accumulates into a
+:class:`~..telemetry.recorder.Histogram`, and every evaluation
+snapshots the mergeable state so the windowed distribution is the
+subtraction of two scrapes (the :meth:`WindowView.hist_window`
+discipline — true windowed p99, not since-boot).
+
+Fire rule: a member's windowed p99 at least ``factor`` × the fleet
+median of its PEERS' windowed p99s (leave-one-out — a self-including
+median would sit midway between a lone victim and its lone peer), on
+``min_n``+ in-window completions, with at
+least one peer contributing data (an outlier needs a crowd). Clear
+rule: p99 back at or under ``clear_factor`` × median — *positive*
+evidence of recovery on probe traffic, so a breaker-ejected member
+whose window merely drained empty HOLDS its firing state instead of
+flapping closed. Both directions need ``polls`` consecutive
+evaluations (the engine's fire_for/clear_for hysteresis shape).
+
+Transitions — and only transitions — are emitted as ``health.signal``
+events carrying ``telemetry.schema.HEALTH_EVENT_FIELDS`` with
+``signal="MEMBER_DEGRADED"`` and the member id, exactly like a
+member-scoped engine; the steady state is readable from
+:meth:`state`.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .. import knobs
+from ..telemetry.recorder import (Histogram, merge_histogram_states,
+                                  subtract_histogram_states)
+
+#: the one signal this module emits (pinned into
+#: ``telemetry.schema.HEALTH_SIGNALS`` alongside the engine's names)
+MEMBER_DEGRADED = "MEMBER_DEGRADED"
+
+#: in-window completions required before a member can CLEAR — probe
+#: traffic through a half-open breaker is sparse by construction, so
+#: recovery must be provable on far fewer samples than degradation
+CLEAR_MIN_N = 2
+
+
+class _MemberState:
+    __slots__ = ("hist", "snaps", "firing", "consec_true",
+                 "consec_false", "fired_at", "cleared_at", "last")
+
+    def __init__(self):
+        self.hist = Histogram()
+        self.snaps: deque = deque()      # (t, cumulative state)
+        self.firing = False
+        self.consec_true = 0
+        self.consec_false = 0
+        self.fired_at: Optional[float] = None
+        self.cleared_at: Optional[float] = None
+        self.last: Dict[str, Any] = {}
+
+
+class MemberOutlierTracker:
+    """Windowed per-member p99 vs fleet median, with hysteresis.
+
+    Thread-safe: ``observe`` runs on router completion callbacks while
+    ``evaluate`` runs on the controller poll (or a test's fake clock).
+    All timestamps are caller-supplied wall-clock-like floats so unit
+    tests drive it with a fake clock; production passes nothing and
+    gets ``time.time()``.
+    """
+
+    def __init__(self, recorder=None, *,
+                 window_s: Optional[float] = None,
+                 factor: Optional[float] = None,
+                 clear_factor: Optional[float] = None,
+                 min_n: Optional[int] = None,
+                 polls: Optional[int] = None,
+                 max_timeline: int = 256):
+        self._rec = recorder
+        self.window_s = float(
+            knobs.value("PYCHEMKIN_FLEET_DEGRADED_WINDOW_S")
+            if window_s is None else window_s)
+        self.factor = float(
+            knobs.value("PYCHEMKIN_FLEET_DEGRADED_FACTOR")
+            if factor is None else factor)
+        self.clear_factor = float(
+            knobs.value("PYCHEMKIN_FLEET_DEGRADED_CLEAR")
+            if clear_factor is None else clear_factor)
+        self.min_n = int(
+            knobs.value("PYCHEMKIN_FLEET_DEGRADED_MIN_N")
+            if min_n is None else min_n)
+        self.polls = int(
+            knobs.value("PYCHEMKIN_FLEET_DEGRADED_POLLS")
+            if polls is None else polls)
+        self._members: Dict[str, _MemberState] = {}
+        self._timeline: deque = deque(maxlen=max_timeline)
+        self._lock = threading.Lock()
+
+    # -- feeding ---------------------------------------------------------
+    def observe(self, member: str, latency_ms: float) -> None:
+        """One completed request served by ``member`` in
+        ``latency_ms`` (dispatch-to-done, per member — a hedged
+        request contributes one observation per completing member)."""
+        with self._lock:
+            st = self._members.get(member)
+            if st is None:
+                st = self._members[member] = _MemberState()
+            st.hist.observe(float(latency_ms))
+
+    def forget(self, member: str) -> None:
+        """Drop a removed member (a firing state is closed out with a
+        cleared transition so timelines always balance)."""
+        with self._lock:
+            st = self._members.pop(member, None)
+            if st is None or not st.firing:
+                return
+            st.cleared_at = time.time()
+            self._transition(member, st, "cleared", st.cleared_at,
+                             {"reason": "member_removed"})
+
+    # -- evaluation ------------------------------------------------------
+    def _windowed(self, st: _MemberState, t: float) -> Dict[str, Any]:
+        """Summary of the observations inside [t - window_s, t]."""
+        cur = st.hist.state()
+        st.snaps.append((t, cur))
+        # keep exactly one snapshot at or before the window edge as
+        # the subtraction base; everything older is unreachable
+        edge = t - self.window_s
+        while len(st.snaps) >= 2 and st.snaps[1][0] <= edge:
+            st.snaps.popleft()
+        base = st.snaps[0][1] if st.snaps[0][0] <= edge else None
+        # same-process histograms only grow, so the base is always a
+        # prefix — no HistogramSubtractionError path here
+        return merge_histogram_states(
+            [subtract_histogram_states(cur, base)])
+
+    def evaluate(self, t: Optional[float] = None
+                 ) -> List[Dict[str, Any]]:
+        """One poll: recompute every member's windowed p99, compare
+        against the fleet median, update hysteresis, emit transition
+        events. Returns the transitions (empty most polls)."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            if t is None:
+                t = time.time()
+            windows = {mid: self._windowed(st, t)
+                       for mid, st in self._members.items()}
+            p99s = {mid: w["p99"] for mid, w in windows.items()
+                    if w.get("count", 0) >= CLEAR_MIN_N}
+            for mid, st in self._members.items():
+                w = windows[mid]
+                n = int(w.get("count", 0))
+                p99 = w.get("p99")
+                # leave-one-out fleet median: the member is compared
+                # against its PEERS' p99s, never its own — under
+                # single-mech affinity often only two members have
+                # samples, and a self-including median would park the
+                # midpoint between victim and peer where no factor
+                # ever fires
+                peers = [v for m, v in p99s.items() if m != mid]
+                median = statistics.median(peers) if peers else None
+                if p99 is None or median is None or median <= 0.0:
+                    # no data for this member (or no peer baseline):
+                    # HOLD state — an ejected member's empty window is
+                    # not evidence of recovery
+                    continue
+                ratio = p99 / median
+                st.last = {"p99_ms": round(p99, 3),
+                           "median_ms": round(median, 3),
+                           "ratio": round(ratio, 3), "n": n,
+                           "n_peers": len(peers)}
+                fire_cond = (n >= self.min_n
+                             and ratio >= self.factor)
+                clear_cond = (n >= CLEAR_MIN_N
+                              and ratio <= self.clear_factor)
+                if not st.firing:
+                    st.consec_true = st.consec_true + 1 if fire_cond \
+                        else 0
+                    if st.consec_true >= self.polls:
+                        st.firing, st.consec_true = True, 0
+                        st.fired_at = t
+                        out.append(self._transition(
+                            mid, st, "fired", t, st.last))
+                else:
+                    st.consec_false = st.consec_false + 1 \
+                        if clear_cond else 0
+                    if st.consec_false >= self.polls:
+                        st.firing, st.consec_false = False, 0
+                        st.cleared_at = t
+                        out.append(self._transition(
+                            mid, st, "cleared", t, st.last))
+        return out
+
+    def _transition(self, member: str, st: _MemberState, state: str,
+                    t: float, evidence: Dict[str, Any]
+                    ) -> Dict[str, Any]:
+        record = {"t": t, "signal": MEMBER_DEGRADED,
+                  "severity": "warn", "state": state,
+                  "window_s": self.window_s,
+                  "evidence": dict(evidence),
+                  "fired_at": st.fired_at,
+                  "cleared_at": st.cleared_at, "member": member}
+        self._timeline.append(record)
+        if self._rec is not None:
+            self._rec.event(
+                "health.signal", signal=MEMBER_DEGRADED,
+                severity="warn", state=state,
+                window_s=self.window_s, evidence=record["evidence"],
+                fired_at=st.fired_at, cleared_at=st.cleared_at,
+                member=member)
+        return record
+
+    # -- reading ---------------------------------------------------------
+    def firing(self) -> List[str]:
+        """Member ids currently MEMBER_DEGRADED, sorted."""
+        with self._lock:
+            return sorted(m for m, st in self._members.items()
+                          if st.firing)
+
+    def p99(self, member: str) -> Optional[float]:
+        """The member's p99 (ms) from its last evaluation window —
+        the hedge trigger's per-member threshold. ``None`` until the
+        member has a windowed baseline."""
+        with self._lock:
+            st = self._members.get(member)
+            if st is None:
+                return None
+            return st.last.get("p99_ms")
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {mid: {"firing": st.firing,
+                          "fired_at": st.fired_at,
+                          "cleared_at": st.cleared_at,
+                          "total": st.hist.count, **st.last}
+                    for mid, st in sorted(self._members.items())}
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._timeline)
+
+
+__all__ = ["MemberOutlierTracker", "MEMBER_DEGRADED", "CLEAR_MIN_N"]

@@ -62,6 +62,7 @@ SIGNAL_NAMES = (
     "PREDICTOR_DECALIBRATED",
     "LADDER_SATURATED",
     "DEADLINE_PRESSURE",
+    "MEMBER_DEGRADED",
 )
 
 #: severity ladder, least to most urgent; ``--check-signals`` gates on

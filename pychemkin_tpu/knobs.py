@@ -480,6 +480,99 @@ register(
     _float("PYCHEMKIN_FLEET_POLL_S", on_invalid="default",
            default=2.0),
     "fleet")
+register(
+    "PYCHEMKIN_FLEET_SPAWN_DEADLINE_S", "float", 120.0,
+    "Seconds an async member spawn may run before the controller "
+    "abandons it (typed fleet.spawn_timeout event; a late backend is "
+    "closed on arrival). Unparseable values fall back.",
+    _float("PYCHEMKIN_FLEET_SPAWN_DEADLINE_S", on_invalid="default",
+           default=120.0),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_DEGRADED_FACTOR", "float", 4.0,
+    "MEMBER_DEGRADED fires when a member's windowed p99 latency sits "
+    "this factor above the fleet median. Unparseable values fall "
+    "back.",
+    _float("PYCHEMKIN_FLEET_DEGRADED_FACTOR", on_invalid="default",
+           default=4.0),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_DEGRADED_CLEAR", "float", 2.0,
+    "MEMBER_DEGRADED clears when the member's windowed p99 drops "
+    "back under this factor of the fleet median (hysteresis band "
+    "between clear and fire factors). Unparseable values fall back.",
+    _float("PYCHEMKIN_FLEET_DEGRADED_CLEAR", on_invalid="default",
+           default=2.0),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_DEGRADED_MIN_N", "int", 6,
+    "Minimum completed requests in a member's latency window before "
+    "MEMBER_DEGRADED may fire for it (clear needs only 2 — probe "
+    "traffic through a half-open breaker is sparse). Unparseable "
+    "values fall back.",
+    _int("PYCHEMKIN_FLEET_DEGRADED_MIN_N", on_invalid="default",
+         default=6, lo=2),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_DEGRADED_WINDOW_S", "float", 30.0,
+    "Width (seconds) of the per-member latency window the outlier "
+    "detector compares against the fleet median. Unparseable values "
+    "fall back.",
+    _float("PYCHEMKIN_FLEET_DEGRADED_WINDOW_S", on_invalid="default",
+           default=30.0),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_DEGRADED_POLLS", "int", 2,
+    "Consecutive outlier evaluations the fire (or clear) condition "
+    "must hold before MEMBER_DEGRADED transitions. Unparseable "
+    "values fall back.",
+    _int("PYCHEMKIN_FLEET_DEGRADED_POLLS", on_invalid="default",
+         default=2, lo=1),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_BREAKER_OPEN_S", "float", 10.0,
+    "Seconds a tripped member breaker stays open before moving to "
+    "half-open and admitting probe requests. Unparseable values "
+    "fall back.",
+    _float("PYCHEMKIN_FLEET_BREAKER_OPEN_S", on_invalid="default",
+           default=10.0),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_BREAKER_PROBES", "int", 2,
+    "Concurrent probe requests a half-open member breaker admits "
+    "while deciding between close and re-open. Unparseable values "
+    "fall back.",
+    _int("PYCHEMKIN_FLEET_BREAKER_PROBES", on_invalid="default",
+         default=2, lo=1),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_HEDGE", "bool (0 disables)", True,
+    "Hedged requests: when a request's elapsed time crosses its "
+    "member's recent p99, re-issue to the next rendezvous choice and "
+    "take the first typed answer; =0 disables the hedge scanner.",
+    _bool01, "fleet")
+register(
+    "PYCHEMKIN_FLEET_HEDGE_FLOOR_MS", "float", 50.0,
+    "Floor (ms) under the per-member p99 hedge trigger — requests "
+    "younger than this are never hedged, whatever the percentile "
+    "says. Unparseable values fall back.",
+    _float("PYCHEMKIN_FLEET_HEDGE_FLOOR_MS", on_invalid="default",
+           default=50.0),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_HEDGE_POLL_MS", "float", 20.0,
+    "Scan interval (ms) of the router's hedge scanner over in-flight "
+    "requests. Unparseable values fall back.",
+    _float("PYCHEMKIN_FLEET_HEDGE_POLL_MS", on_invalid="default",
+           default=20.0, clamp=(1.0, 60000.0)),
+    "fleet")
+register(
+    "PYCHEMKIN_FLEET_JOURNAL", "path", None,
+    "Path of the ingress write-ahead journal (O_APPEND JSONL). When "
+    "set, accepted requests are journaled before the 200 reply, "
+    "unfinished entries replay on restart, and duplicate idempotency "
+    "keys return the banked result. Unset disables the journal.",
+    _str, "fleet")
 
 register(
     "PYCHEMKIN_SUPERVISOR_MAX_RESPAWNS", "int", 2,

@@ -60,6 +60,18 @@ still-armed spec would re-fire every generation):
                         default heals in the respawned process
                         (``heal_on_reexec``; the supervisor stamps the
                         child's re-exec count exactly like the driver).
+- ``slow_replies``      delay every RESULT reply by ``seconds`` from
+                        request ordinal ``request`` onward — the gray
+                        backend: alive, heartbeats fine, 20× slower
+                        than its peers. Only the outlier detector /
+                        breaker / hedge path catches it; no watchdog
+                        ever will.
+- ``stall_after_accept``  accept submit ordinal ``request`` (the
+                        client got its admission) but never send its
+                        reply — a request wedged mid-batch. The
+                        supervisor sees a healthy backend; only the
+                        requester's deadline or a hedge rescues the
+                        caller.
 
 Activation, either source (programmatic wins):
 
@@ -101,11 +113,12 @@ REEXEC_COUNT_ENV = "_PYCHEMKIN_DRIVER_REEXEC"
 
 MODES = ("kill_at_chunk", "hang_child", "poison_backend",
          "torn_checkpoint", "fail_chunk",
-         "kill_backend_at_request", "hang_heartbeat")
+         "kill_backend_at_request", "hang_heartbeat",
+         "slow_replies", "stall_after_accept")
 
 #: modes that target the SERVING path (request ordinals, not chunks)
 SERVE_MODES = ("kill_backend_at_request", "hang_heartbeat",
-               "poison_backend")
+               "poison_backend", "slow_replies", "stall_after_accept")
 
 
 class BackendPoisonedError(RuntimeError):
@@ -143,10 +156,12 @@ class ProcFaultSpec(NamedTuple):
         # dual-path poison_backend stays driver-targeted unless the
         # spec names a request explicitly
         req_default = 0 if mode in ("kill_backend_at_request",
-                                    "hang_heartbeat") else -1
-        # a hung heartbeat stays hung: every ping from `request` onward
-        # misses, unless the spec bounds it explicitly
-        n_default = -1 if mode == "hang_heartbeat" else 1
+                                    "hang_heartbeat", "slow_replies",
+                                    "stall_after_accept") else -1
+        # persistent wedges stay wedged: every hit from `request`
+        # onward fires, unless the spec bounds it explicitly
+        n_default = -1 if mode in ("hang_heartbeat",
+                                   "slow_replies") else 1
         return cls(mode=mode, chunk=int(d.get("chunk", 0)),
                    n_times=int(d.get("n_times", n_default)),
                    seconds=float(d.get("seconds", 3600.0)), when=when,
@@ -226,8 +241,9 @@ def _fires(spec: ProcFaultSpec, ordinal: int) -> bool:
 
 def _fires_serve(spec: ProcFaultSpec, ordinal: int) -> bool:
     """Serving-path firing rule: a spec without ``request`` never
-    fires here; ``hang_heartbeat`` matches every ordinal from its
-    target onward (a wedge persists), the others match exactly.
+    fires here; ``hang_heartbeat`` and ``slow_replies`` match every
+    ordinal from their target onward (a wedge or gray slowdown
+    persists), the others match exactly.
     ``heal_on_reexec`` (default True) gates EVERY serving mode: a
     respawned backend carries the supervisor's re-exec stamp and is
     immune — request ordinals restart in the fresh process, so a
@@ -236,7 +252,7 @@ def _fires_serve(spec: ProcFaultSpec, ordinal: int) -> bool:
     to chaos-test the budget-exhaustion path itself."""
     if spec.request < 0:
         return False
-    if spec.mode == "hang_heartbeat":
+    if spec.mode in ("hang_heartbeat", "slow_replies"):
         if ordinal < spec.request:
             return False
     elif spec.request != ordinal:
@@ -302,6 +318,29 @@ def on_serve_request(ordinal: int) -> None:
                 and _fires_serve(spec, ordinal):
             raise BackendPoisonedError(
                 f"injected poison_backend at request {ordinal}")
+
+
+def serve_reply_delay(ordinal: int) -> float:
+    """Hook: a transport backend is about to send the RESULT reply for
+    submit ordinal ``ordinal`` — returns the injected delay in seconds
+    (0.0 when no ``slow_replies`` spec fires). The caller must apply
+    the delay WITHOUT blocking its receive loop (timer thread), so
+    heartbeats keep flowing: gray, not dead."""
+    delay = 0.0
+    for spec in specs("slow_replies"):
+        if _fires_serve(spec, ordinal):
+            delay = max(delay, spec.seconds)
+    return delay
+
+
+def serve_stall_after_accept(ordinal: int) -> bool:
+    """Hook: should the reply for accepted submit ordinal ``ordinal``
+    be silently dropped (request wedged mid-batch)? The backend stays
+    healthy; the caller's deadline or hedge is the only way out."""
+    for spec in specs("stall_after_accept"):
+        if _fires_serve(spec, ordinal):
+            return True
+    return False
 
 
 def on_heartbeat(ordinal: int) -> None:

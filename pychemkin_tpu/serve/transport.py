@@ -525,8 +525,9 @@ class TransportServer:
 
     def _handle_submit(self, msg: Dict, writer: _ConnWriter) -> None:
         rid = msg.get("id")
+        ordinal = next(self._req_ordinal)
         try:
-            procfaults.on_serve_request(next(self._req_ordinal))
+            procfaults.on_serve_request(ordinal)
         except BackendPoisonedError as exc:
             # the poisoned-client failure class: the supervisor's
             # is_poisoned classification reads this reply and respawns
@@ -586,7 +587,8 @@ class TransportServer:
             return
 
         def _reply(f: ServeFuture, _rid=rid, _tenant=tenant,
-                   _tid=(None if tid is trace.UNSET else tid)) -> None:
+                   _tid=(None if tid is trace.UNSET else tid),
+                   _ordinal=ordinal) -> None:
             with self._quota_lock:
                 _tenant.inflight -= 1
             exc = f.exception()
@@ -605,8 +607,21 @@ class TransportServer:
             # enqueue only: this runs on the ChemServer worker/rescue
             # threads, and a blocking send here would let one stalled
             # client wedge batching for every tenant
-            writer.send(out)
+            delay = procfaults.serve_reply_delay(_ordinal)
+            if delay > 0:
+                # gray-failure injection: delay ONLY this reply, off
+                # the worker thread — the receive loop, heartbeats and
+                # the rest of the batch stay live (slow, not dead)
+                threading.Timer(delay, writer.send, args=(out,)).start()
+            else:
+                writer.send(out)
 
+        if procfaults.serve_stall_after_accept(ordinal):
+            # gray-failure injection: the submit was admitted (quota
+            # held, batch slot taken) but its reply never leaves —
+            # the wedged-mid-batch shape only the caller's deadline
+            # or a router hedge can rescue
+            return
         fut.add_done_callback(_reply)
 
 

@@ -37,6 +37,12 @@ COUNTERS = (
     "flame.programs_built",
     "fleet.http.requests",
     "fleet.http.rejected",
+    "fleet.hedge.issued",
+    "fleet.hedge.won",
+    "fleet.hedge.wasted",
+    "fleet.journal.appends",
+    "fleet.journal.duplicates",
+    "fleet.journal.replayed",
     "fleet.rejected",
     "fleet.requests",
     "fleet.reroutes",
@@ -147,6 +153,7 @@ EVENTS = (
     "driver.retry",
     "flame",
     "fleet.action",
+    "fleet.spawn_timeout",
     "health.signal",
     "odeint",
     "rescue",
@@ -193,6 +200,7 @@ HEALTH_SIGNALS = (
     "DEADLINE_PRESSURE",
     "ERROR_BUDGET_BURN",
     "LADDER_SATURATED",
+    "MEMBER_DEGRADED",
     "PREDICTOR_DECALIBRATED",
     "SURROGATE_RETRAIN",
 )

@@ -229,6 +229,13 @@ FAULTS_ENV_SPEC = ('[{"mode": "nan_rhs", "elements": [1], '
 CHAOS_ENV_SPEC = ('[{"mode": "kill_backend_at_request", '
                   '"request": 2}]')
 
+#: the --chaos GRAY injection spec (ISSUE 19): the serving backend
+#: answers heartbeats but lags every reply — exercised by the
+#: env-gated lane of tests/test_fleet_gray.py (MEMBER_DEGRADED must
+#: fire, hedges must win, the breaker must shed; nothing dies, so the
+#: kill/replace machinery must stay quiet)
+GRAY_ENV_SPEC = '[{"mode": "slow_replies", "seconds": 0.45}]'
+
 
 def _child_env(faults=False, chaos=False, mesh=None):
     env = dict(os.environ)
@@ -426,6 +433,11 @@ def main(argv=None):
             # suite gate below asserts the controller's typed REPLACE
             # action landed (fleet_actions*.jsonl in the kill dir)
             files.append(os.path.join(here, "test_fleet.py"))
+            # the gray-failure lane (ISSUE 19): a member goes SLOW
+            # (not dead) — runs with its own slow_replies spec (see
+            # the per-file override in the child loop) and banks
+            # fleet_gray*.json for the gray gate below
+            files.append(os.path.join(here, "test_fleet_gray.py"))
     else:
         files = sorted(glob.glob(os.path.join(here, "test_*.py")))
     if not files:
@@ -464,6 +476,8 @@ def main(argv=None):
             os.path.join(health_dir, "health_*.jsonl")))
         preexisting_fleet = set(glob.glob(
             os.path.join(kill_dir, "fleet_actions*.jsonl")))
+        preexisting_gray = set(glob.glob(
+            os.path.join(kill_dir, "fleet_gray*.json")))
     results = []
     t_suite = time.time()
 
@@ -472,8 +486,16 @@ def main(argv=None):
         # a file selected as a whole (directly or via a dir) runs whole;
         # node-id selectors only narrow files not otherwise selected
         targets = [f] if f in selected else selectors.get(f, [f])
+        child_env = env
+        if chaos and name == "test_fleet_gray.py" \
+                and "PYCHEMKIN_PROC_FAULTS" not in os.environ:
+            # the gray scenario: this file's env-gated lane needs the
+            # slow-replies spec, not the SIGKILL one (a caller-set
+            # spec still wins, matching _child_env's setdefault)
+            child_env = dict(env)
+            child_env["PYCHEMKIN_PROC_FAULTS"] = GRAY_ENV_SPEC
         t0 = time.time()
-        rc, dots = _run_child(targets, flags, env)
+        rc, dots = _run_child(targets, flags, child_env)
         retried = False
         if rc < 0:
             # child died on a signal (OOM kill, sporadic XLA:CPU
@@ -482,7 +504,7 @@ def main(argv=None):
             # and is never retried, so real failures stay failures
             print(f"# run_suite: {name}: killed by signal {-rc}; "
                   "retrying once", flush=True)
-            rc, dots = _run_child(targets, flags, env)
+            rc, dots = _run_child(targets, flags, child_env)
             retried = True
         dt = time.time() - t0
         # rc=5 = "no tests collected" in this child's session (e.g. a
@@ -526,6 +548,7 @@ def main(argv=None):
     kill_reports = None
     health_histories = None
     fleet_logs = None
+    gray_files = None
     if chaos:
         kill_reports = sorted(
             p for p in glob.glob(
@@ -619,6 +642,41 @@ def main(argv=None):
                     suite_rc = 1
         else:
             fleet_logs = None
+        # gray gate (ISSUE 19): when the slow_replies lane banked its
+        # evidence, the injected gray member must show up as a fired
+        # MEMBER_DEGRADED signal AND at least one winning hedge — the
+        # gray-failure detection path is CI-enforced, not just
+        # unit-tested. Zero files skips (same shape as the gates
+        # above: only runs that exercised the gray lane are held to
+        # it).
+        gray_files = sorted(
+            p for p in glob.glob(
+                os.path.join(kill_dir, "fleet_gray*.json"))
+            if p not in preexisting_gray)
+        if gray_files:
+            import json as _json
+            degraded_fired = hedge_won = False
+            for path in gray_files:
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        doc = _json.load(fh)
+                except (OSError, ValueError):
+                    continue
+                degraded_fired |= bool(doc.get("member_degraded_fired"))
+                hedge_won |= (doc.get("hedge", {}).get("won", 0) >= 1)
+            print(f"# run_suite: chaos gray evidence: "
+                  f"{len(gray_files)} new, degraded="
+                  f"{'yes' if degraded_fired else 'NO'}, hedge_won="
+                  f"{'yes' if hedge_won else 'NO'}", flush=True)
+            if not (degraded_fired and hedge_won):
+                print("# run_suite: CHAOS FAILURE: the gray lane "
+                      "banked evidence without a fired "
+                      "MEMBER_DEGRADED signal and a winning hedge",
+                      flush=True)
+                if suite_rc in (0, 5):
+                    suite_rc = 1
+        else:
+            gray_files = None
 
     if summary_json:
         summary = {
@@ -643,6 +701,8 @@ def main(argv=None):
             summary["health_histories"] = health_histories
         if fleet_logs is not None:
             summary["fleet_action_logs"] = fleet_logs
+        if gray_files is not None:
+            summary["fleet_gray_files"] = gray_files
         try:
             _sink_module().atomic_write_json(summary_json, summary)
             print(f"# run_suite: summary banked to {summary_json}",

@@ -134,9 +134,12 @@ class FakeMember:
 
 def _pool(*ids, **kw):
     members = {mid: FakeMember(mid, **kw) for mid in ids}
+    # hedge=False: fake members resolve instantly — these tests drive
+    # hedge_scan()/health_poll() directly (test_fleet_gray) instead of
+    # paying a background scanner thread per pool
     router = FleetRouter(
         tenants={"default": {"mech": "h2o2", "quota": 64}},
-        recorder=telemetry.MetricsRecorder())
+        recorder=telemetry.MetricsRecorder(), hedge=False)
     for mid, m in members.items():
         router.add(mid, m)
     return router, members
@@ -295,7 +298,7 @@ class TestRouterDispatch:
         router = FleetRouter(
             tenants={"acme": {"mech": "h2o2", "quota": 2}},
             recorder=telemetry.MetricsRecorder(),
-            default_tenant="acme")
+            default_tenant="acme", hedge=False)
         m = FakeMember("m0", hold=True)
         router.add("m0", m)
         f1 = router.submit("equilibrium", T=0.0)
@@ -317,7 +320,8 @@ class TestRouterDispatch:
         tenants = {f"t{i}": {"mech": f"mech{i}", "quota": 8}
                    for i in range(12)}
         router = FleetRouter(tenants=tenants,
-                             recorder=telemetry.MetricsRecorder())
+                             recorder=telemetry.MetricsRecorder(),
+                             hedge=False)
         members = {mid: FakeMember(mid) for mid in
                    ("m0", "m1", "m2", "m3")}
         for mid, m in members.items():
@@ -372,8 +376,11 @@ class TestFleetController:
         assert [a["action"] for a in acts] == ["add"] * 3
         assert all(a["reason"] == "min_size" for a in acts)
         assert len(router.member_ids()) == 3
-        ev = rec.last_event("fleet.action")
-        assert ev is not None and ev["pool_size"] == 3
+        # the async outcome landed too: one spawn_complete per decision
+        done = [a for a in ctl.actions()
+                if a["action"] == "spawn_complete"]
+        assert len(done) == 3
+        assert rec.last_event("fleet.action") is not None
 
     def test_add_on_saturation_up_to_max(self):
         router = FleetRouter(recorder=telemetry.MetricsRecorder())
@@ -387,6 +394,7 @@ class TestFleetController:
         assert [a["action"] for a in acts] == ["add"]
         assert acts[0]["reason"] == "LADDER_SATURATED"
         assert acts[0]["evidence"]["member"] == "m0"
+        ctl.wait_spawns()
         assert len(router.member_ids()) == 3
         # at max_size the signal no longer adds
         assert ctl.step() == []
@@ -415,6 +423,7 @@ class TestFleetController:
         assert acts[0]["reason"] == "respawn_exhausted"
         assert registry["m0"].closed
         assert "m0" not in router.member_ids()
+        ctl.wait_spawns()
         assert len(router.member_ids()) == 2
 
     def test_idle_drain_to_floor_with_zero_leftover(self):
@@ -424,6 +433,7 @@ class TestFleetController:
                           idle_polls=2, drain_timeout_s=5.0)
         ctl.ensure_min()
         ctl._add(reason="test_seed")      # pool 2, floor 1
+        ctl.wait_spawns()
         acts = []
         for _ in range(4):
             acts += ctl.step()
@@ -464,6 +474,7 @@ class TestFleetController:
                           idle_polls=1)
         ctl.ensure_min()
         ctl._add(reason="test_seed")
+        ctl.wait_spawns()
         registry["m0"].pending.append(ServeFuture())  # in-flight
         for _ in range(5):
             assert ctl.step() == []
@@ -679,6 +690,7 @@ class TestEnvDrivenFleetChaos:
             # along to the new member)
             assert router.submit("equilibrium",
                                  T=99.0).result(timeout=60).ok
+            ctl.wait_spawns()
             assert len(router.member_ids()) == 3
         finally:
             # bank the typed decision log where the run_suite fleet

@@ -1,0 +1,774 @@
+"""Gray-failure immunity tests (ISSUE 19): breakers, hedging, the
+outlier detector, the durable ingress journal, and the async
+controller — ALL fast-lane.
+
+Everything here is pure in-process machinery: fake clocks drive the
+breaker state machine and the hedge scanner, protocol-complete
+in-memory fake members stand in for supervised backends, and the
+journal tests simulate an ingress crash by writing accept records with
+no done record. No process spawns, no sleeps beyond short waits on
+real threads. The REAL gray backend (procfault-injected slow replies
+over spawned fake-backend processes) lives in ``test_fleet``'s
+env-chaos lane and the loadgen soak.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import test_serve_transport as tst
+from pychemkin_tpu import telemetry
+from pychemkin_tpu.fleet import (
+    FleetController,
+    FleetIngress,
+    FleetRouter,
+    IngressJournal,
+    MemberBreaker,
+    rendezvous_rank,
+    route_key,
+)
+from pychemkin_tpu.fleet.journal import remaining_deadline_ms
+from pychemkin_tpu.health.outlier import (
+    MEMBER_DEGRADED,
+    MemberOutlierTracker,
+)
+from pychemkin_tpu.resilience import procfaults
+from pychemkin_tpu.resilience.procfaults import (
+    REEXEC_COUNT_ENV,
+    ProcFaultSpec,
+)
+from test_fleet import FakeMember, _pool, _winner
+
+_wait = tst._wait
+fake_backend_path = tst.fake_backend_path  # re-export the fixture
+
+
+@pytest.fixture(autouse=True)
+def _no_env_chaos(monkeypatch, request):
+    """Same determinism rule as test_fleet: programmatic tests never
+    see an ambient chaos spec; env_chaos tests opt in."""
+    if "env_chaos" not in request.keywords:
+        monkeypatch.delenv("PYCHEMKIN_PROC_FAULTS", raising=False)
+        monkeypatch.delenv(REEXEC_COUNT_ENV, raising=False)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# the breaker state machine, fake clock, no threads
+
+class TestMemberBreaker:
+    def test_closed_open_halfopen_cycle(self):
+        clk = FakeClock()
+        br = MemberBreaker("m0", open_s=10.0, probes=2, clock=clk)
+        assert br.try_acquire()              # closed admits freely
+        assert br.trip() is True             # transition counted
+        assert br.trip() is False            # already open: no-op
+        assert br.snapshot()["state"] == MemberBreaker.OPEN
+        assert not br.try_acquire()          # open sheds
+        clk.advance(9.9)
+        assert not br.try_acquire()          # still inside open_s
+        clk.advance(0.2)
+        assert br.try_acquire()              # half-open: probe slot 1
+        assert br.try_acquire()              # probe slot 2
+        assert not br.try_acquire()          # probes bounded
+        br.release(completed=True)
+        assert br.try_acquire()              # freed slot re-usable
+        assert br.clear() is True
+        assert br.snapshot()["state"] == MemberBreaker.CLOSED
+        assert br.clear() is False
+
+    def test_halfopen_retrip_requires_probe_evidence(self):
+        clk = FakeClock()
+        br = MemberBreaker("m0", open_s=5.0, probes=1, clock=clk)
+        br.trip()
+        clk.advance(5.1)
+        assert br.try_acquire()              # half-open, probe out
+        # the detector still fires, but no probe has completed yet:
+        # the probe must be allowed to testify before re-opening
+        assert br.trip() is False
+        assert br.snapshot()["state"] == MemberBreaker.HALF_OPEN
+        br.release(completed=True)
+        assert br.trip() is True             # evidence in: re-open
+        assert br.snapshot()["n_trips"] == 2
+
+    def test_incomplete_acquire_returns_slot_without_evidence(self):
+        clk = FakeClock()
+        br = MemberBreaker("m0", open_s=1.0, probes=1, clock=clk)
+        br.trip()
+        clk.advance(1.1)
+        assert br.try_acquire()
+        br.release(completed=False)          # submit never went live
+        assert br.snapshot()["probes_done"] == 0
+        assert br.trip() is False            # still no evidence
+
+
+# ---------------------------------------------------------------------------
+# the cross-member outlier detector, fake time
+
+def _tracker(rec=None, **kw):
+    kw.setdefault("window_s", 10.0)
+    kw.setdefault("factor", 4.0)
+    kw.setdefault("clear_factor", 2.0)
+    kw.setdefault("min_n", 4)
+    kw.setdefault("polls", 2)
+    return MemberOutlierTracker(rec, **kw)
+
+
+def _feed(trk, member, ms, n):
+    for _ in range(n):
+        trk.observe(member, ms)
+
+
+class TestOutlierTracker:
+    def test_degraded_fires_with_hysteresis_and_clears(self):
+        rec = telemetry.MetricsRecorder()
+        trk = _tracker(rec)
+        _feed(trk, "slow", 500.0, 6)
+        _feed(trk, "a", 10.0, 6)
+        _feed(trk, "b", 12.0, 6)
+        assert trk.evaluate(t=100.0) == []   # poll 1 of 2: held
+        out = trk.evaluate(t=101.0)          # poll 2: fires
+        assert [(x["member"], x["state"]) for x in out] == \
+            [("slow", "fired")]
+        assert out[0]["signal"] == MEMBER_DEGRADED
+        assert out[0]["evidence"]["ratio"] >= 4.0
+        assert trk.firing() == ["slow"]
+        ev = rec.last_event("health.signal")
+        assert ev["signal"] == MEMBER_DEGRADED
+        assert ev["member"] == "slow"
+        # recovery: the next WINDOW (past the old observations) shows
+        # the member back at fleet speed on probe traffic
+        _feed(trk, "slow", 11.0, 3)
+        _feed(trk, "a", 10.0, 3)
+        assert trk.evaluate(t=113.0) == []   # clear poll 1 of 2
+        out = trk.evaluate(t=114.0)
+        assert [(x["member"], x["state"]) for x in out] == \
+            [("slow", "cleared")]
+        assert trk.firing() == []
+
+    def test_empty_window_holds_firing_state(self):
+        """A breaker-ejected member gets no traffic; its drained
+        window is NOT evidence of recovery — the signal must hold
+        until probes produce positive evidence."""
+        trk = _tracker()
+        _feed(trk, "slow", 500.0, 6)
+        _feed(trk, "a", 10.0, 6)
+        _feed(trk, "b", 12.0, 6)
+        trk.evaluate(t=100.0)
+        trk.evaluate(t=101.0)
+        assert trk.firing() == ["slow"]
+        for t in (115.0, 116.0, 117.0):      # windows empty now
+            assert trk.evaluate(t=t) == []
+        assert trk.firing() == ["slow"]      # held, not flapped
+
+    def test_single_member_never_fires(self):
+        """An outlier needs a crowd: one member with no peers has no
+        fleet median to be an outlier of."""
+        trk = _tracker()
+        _feed(trk, "only", 500.0, 12)
+        assert trk.evaluate(t=100.0) == []
+        assert trk.evaluate(t=101.0) == []
+        assert trk.firing() == []
+
+    def test_forget_closes_out_firing_member(self):
+        trk = _tracker()
+        _feed(trk, "slow", 500.0, 6)
+        _feed(trk, "a", 10.0, 6)
+        _feed(trk, "b", 12.0, 6)
+        trk.evaluate(t=100.0)
+        trk.evaluate(t=101.0)
+        trk.forget("slow")
+        assert trk.firing() == []
+        last = trk.timeline()[-1]
+        assert last["state"] == "cleared"
+        assert last["evidence"] == {"reason": "member_removed"}
+
+    def test_p99_is_the_windowed_view(self):
+        trk = _tracker()
+        _feed(trk, "m", 100.0, 6)
+        _feed(trk, "peer", 100.0, 6)
+        trk.evaluate(t=100.0)
+        assert trk.p99("m") == pytest.approx(100.0, rel=0.5)
+        assert trk.p99("nobody") is None
+
+
+# ---------------------------------------------------------------------------
+# hedged requests: first-wins dedup, counters, loser cancellation
+
+def _hedge_pool(*ids):
+    clk = FakeClock(100.0)
+    members = {mid: FakeMember(mid, hold=True) for mid in ids}
+    router = FleetRouter(
+        tenants={"default": {"mech": "h2o2", "quota": 64}},
+        recorder=telemetry.MetricsRecorder(), hedge=False, clock=clk)
+    for mid, m in members.items():
+        router.add(mid, m)
+    return router, members, clk
+
+
+class TestHedgedRequests:
+    def test_hedge_issues_after_threshold_and_hedge_wins(self):
+        router, members, clk = _hedge_pool("m0", "m1", "m2")
+        win = _winner(router)
+        fut = router.submit("equilibrium", T=1.0)
+        assert clk.advance(0.010) and router.hedge_scan() == 0
+        clk.advance(0.100)                   # past the 50 ms floor
+        assert router.hedge_scan() == 1
+        hedge_mid = next(mid for mid, m in members.items()
+                         if mid != win and m.submits)
+        # first-wins: the hedge answers, the caller future resolves
+        members[hedge_mid].pending[0].set_result(
+            members[hedge_mid].result())
+        res = fut.result(timeout=10)
+        assert res.ok
+        stats = router.stats()
+        assert stats["hedge"] == {"issued": 1, "won": 1, "wasted": 0}
+        # the loser (still queued on the slow member) was cancelled
+        assert members[win].pending[0].cancelled()
+        assert stats["inflight_routes"] == 0
+
+    def test_primary_wins_makes_hedge_wasted(self):
+        router, members, clk = _hedge_pool("m0", "m1", "m2")
+        win = _winner(router)
+        fut = router.submit("equilibrium", T=1.0)
+        clk.advance(0.100)
+        assert router.hedge_scan() == 1
+        members[win].pending[0].set_result(members[win].result())
+        assert fut.result(timeout=10).ok
+        assert router.stats()["hedge"] == {"issued": 1, "won": 0,
+                                           "wasted": 1}
+
+    def test_at_most_one_hedge_per_request(self):
+        router, members, clk = _hedge_pool("m0", "m1", "m2")
+        fut = router.submit("equilibrium", T=1.0)
+        clk.advance(0.100)
+        assert router.hedge_scan() == 1
+        clk.advance(5.0)
+        assert router.hedge_scan() == 0      # one slow member, one hedge
+        win = _winner(router)
+        members[win].pending[0].set_result(members[win].result())
+        assert fut.result(timeout=10).ok
+
+    def test_no_peer_no_hedge(self):
+        router, members, clk = _hedge_pool("m0")
+        router.submit("equilibrium", T=1.0)
+        clk.advance(5.0)
+        assert router.hedge_scan() == 0
+        assert router.stats()["hedge"]["issued"] == 0
+        members["m0"].pending[0].set_result(members["m0"].result())
+
+    def test_hedge_latency_bootstraps_peer_baseline(self):
+        """Under single-mech affinity only the winner has latency
+        data; hedge completions are what populate the peers, making
+        the fleet median meaningful for MEMBER_DEGRADED."""
+        router, members, clk = _hedge_pool("m0", "m1", "m2")
+        win = _winner(router)
+        fut = router.submit("equilibrium", T=1.0)
+        clk.advance(0.100)
+        router.hedge_scan()
+        hedge_mid = next(mid for mid, m in members.items()
+                         if mid != win and m.submits)
+        clk.advance(0.005)
+        members[hedge_mid].pending[0].set_result(
+            members[hedge_mid].result())
+        fut.result(timeout=10)
+        assert router.outliers.state()[hedge_mid]["total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# MEMBER_DEGRADED → breaker trip → shed → recover, through the router
+
+class TestHealthBreakerSync:
+    def test_degraded_member_is_ejected_then_recovers(self):
+        router, members = _pool("m0", "m1", "m2")
+        win = _winner(router)
+        others = [m for m in ("m0", "m1", "m2") if m != win]
+        for _ in range(8):
+            router.outliers.observe(win, 800.0)
+            for mid in others:
+                router.outliers.observe(mid, 10.0)
+        assert router.health_poll(t=1000.0) == []
+        out = router.health_poll(t=1001.0)
+        assert [(x["member"], x["state"]) for x in out] == \
+            [(win, "fired")]
+        assert router.member_states()[win] == "open"
+        # new assignments shed to the spill member while open
+        assert router.submit("equilibrium", T=1.0).result(
+            timeout=10).ok
+        assert members[win].submits == []
+        spill = next(m for m in others if members[m].submits)
+        assert members[spill].submits
+        # recovery: the next window shows the member back at fleet
+        # speed (probe traffic), the signal clears, the breaker closes
+        for _ in range(3):
+            router.outliers.observe(win, 11.0)
+            router.outliers.observe(spill, 10.0)
+        # evaluations past t=1001 + the 30 s default window, so the
+        # subtraction base excludes the degraded-era observations
+        router.health_poll(t=1032.0)
+        out = router.health_poll(t=1033.0)
+        assert [(x["member"], x["state"]) for x in out] == \
+            [(win, "cleared")]
+        assert router.member_states()[win] == "ok"
+
+    def test_all_breakers_open_is_typed_not_a_hang(self):
+        from pychemkin_tpu.serve.errors import ServerClosed
+
+        router, members = _pool("m0", "m1")
+        for mid in ("m0", "m1"):
+            router.outliers.observe(mid, 100.0)
+        # trip both breakers by hand (the detector would never fire
+        # both — this is the pathological floor)
+        for mid in ("m0", "m1"):
+            router._breakers[mid] = br = MemberBreaker(
+                mid, open_s=3600.0)
+            br.trip()
+        with pytest.raises(ServerClosed):
+            router.submit("equilibrium", T=1.0)
+
+
+# ---------------------------------------------------------------------------
+# the gray procfault serving modes
+
+class TestGrayProcfaultModes:
+    def test_slow_replies_spec_defaults_and_persistence(self):
+        spec = ProcFaultSpec.from_dict({"mode": "slow_replies",
+                                        "seconds": 0.25})
+        assert spec.request == 0             # live by default
+        assert spec.n_times == -1            # gray persists
+        with procfaults.inject(spec):
+            assert procfaults.serve_reply_delay(0) == 0.25
+            assert procfaults.serve_reply_delay(7) == 0.25
+        assert procfaults.serve_reply_delay(0) == 0.0
+
+    def test_slow_replies_from_request_onward(self):
+        spec = ProcFaultSpec.from_dict({"mode": "slow_replies",
+                                        "request": 3, "seconds": 0.5})
+        with procfaults.inject(spec):
+            assert procfaults.serve_reply_delay(2) == 0.0
+            assert procfaults.serve_reply_delay(3) == 0.5
+
+    def test_slow_replies_heals_on_reexec(self, monkeypatch):
+        spec = ProcFaultSpec.from_dict({"mode": "slow_replies",
+                                        "seconds": 0.5})
+        monkeypatch.setenv(REEXEC_COUNT_ENV, "1")
+        with procfaults.inject(spec):
+            assert procfaults.serve_reply_delay(0) == 0.0
+
+    def test_stall_after_accept_fires_once_at_target(self):
+        spec = ProcFaultSpec.from_dict({"mode": "stall_after_accept",
+                                        "request": 2})
+        with procfaults.inject(spec):
+            assert not procfaults.serve_stall_after_accept(1)
+            assert procfaults.serve_stall_after_accept(2)
+            # n_times=1 by default: the wedge is one request, not an
+            # unbounded leak of tenant quota slots
+            assert not procfaults.serve_stall_after_accept(2)
+
+
+# ---------------------------------------------------------------------------
+# the durable ingress journal
+
+class TestIngressJournal:
+    def test_accept_done_roundtrip_across_restart(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        with IngressJournal(path) as j:
+            j.record_accept("r1", body={"kind": "equilibrium",
+                                        "payload": {"T": 1.0}},
+                            idem="k1")
+            j.record_accept("r2", body={"kind": "equilibrium",
+                                        "payload": {"T": 2.0}})
+            j.record_done("r1", 200, {"op": "result"}, idem="k1")
+        j2 = IngressJournal(path)            # the restarted process
+        assert j2.banked("k1") == (200, {"op": "result"})
+        assert [r["rid"] for r in j2.unfinished()] == ["r2"]
+        j2.close()
+
+    def test_torn_tail_is_skipped(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        with IngressJournal(path) as j:
+            j.record_accept("r1", body={"kind": "equilibrium",
+                                        "payload": {}})
+        with open(path, "a") as f:           # SIGKILL mid-append
+            f.write('{"op": "accept", "rid": "r2", "bo')
+        j2 = IngressJournal(path)
+        assert [r["rid"] for r in j2.unfinished()] == ["r1"]
+        j2.close()
+
+    def test_remaining_deadline_accounts_crash_downtime(self):
+        now = 1000.0
+        rec = {"t": 990.0, "body": {"deadline_ms": 60_000.0}}
+        assert remaining_deadline_ms(rec, now=now) == \
+            pytest.approx(50_000.0)
+        rec = {"t": 900.0, "body": {"deadline_ms": 10_000.0}}
+        assert remaining_deadline_ms(rec, now=now) < 0.0
+        assert remaining_deadline_ms({"t": 990.0, "body": {}},
+                                     now=now) is None
+
+
+class TestIngressDurability:
+    def _ingress(self, router, path):
+        rec = telemetry.MetricsRecorder()
+        ing = FleetIngress(router, journal_path=path, recorder=rec)
+        return ing, rec
+
+    def test_duplicate_idempotency_key_returns_banked_result(
+            self, tmp_path):
+        router, members = _pool("m0")
+        ing, rec = self._ingress(router, str(tmp_path / "wal.jsonl"))
+        body = {"kind": "equilibrium", "payload": {"T": 1.0},
+                "idempotency_key": "req-001"}
+        code, doc, _ = ing.handle_submit(body)
+        assert code == 200 and doc["result"]["status_name"] == "OK"
+        assert len(members["m0"].submits) == 1
+        assert rec.counters["fleet.journal.appends"] == 1
+        code2, doc2, headers = ing.handle_submit(dict(body))
+        assert (code2, doc2["result"]) == (200, doc["result"])
+        assert headers["X-Idempotent-Replay"] == "1"
+        # banked means NO re-solve: the member saw exactly one submit
+        assert len(members["m0"].submits) == 1
+        assert rec.counters["fleet.journal.duplicates"] == 1
+        ing._httpd.server_close()
+
+    def test_racing_duplicate_attaches_to_inflight_solve(
+            self, tmp_path):
+        router, members = _pool("m0", hold=True)
+        ing, rec = self._ingress(router, str(tmp_path / "wal.jsonl"))
+        body = {"kind": "equilibrium", "payload": {"T": 1.0},
+                "idempotency_key": "race", "timeout_s": 20}
+        replies = []
+
+        def call():
+            replies.append(ing.handle_submit(dict(body)))
+
+        t1 = threading.Thread(target=call, daemon=True)
+        t1.start()
+        _wait(lambda: members["m0"].pending, what="first submit held")
+        t2 = threading.Thread(target=call, daemon=True)
+        t2.start()
+        _wait(lambda: rec.counters.get("fleet.journal.duplicates"),
+              what="duplicate attached")
+        assert len(members["m0"].submits) == 1   # no double-solve
+        members["m0"].pending[0].set_result(members["m0"].result())
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert [c for c, _, _ in replies] == [200, 200]
+        ing._httpd.server_close()
+
+    def test_crash_replay_resolves_unfinished_exactly_once(
+            self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        # the crashed ingress: accepted (journaled), died before reply
+        with IngressJournal(path) as j:
+            j.record_accept(
+                "dead-rid",
+                body={"kind": "equilibrium", "tenant": None,
+                      "deadline_ms": None, "payload": {"T": 7.0}},
+                idem="crashed-key")
+        router, members = _pool("m0")
+        ing, rec = self._ingress(router, path)
+        assert ing.replay_journal() == 1
+        _wait(lambda: ing.journal.banked("crashed-key"),
+              what="replayed entry resolved")
+        code, doc = ing.journal.banked("crashed-key")
+        assert code == 200
+        assert doc["result"]["value"]["T"] == 1931.25
+        assert len(members["m0"].submits) == 1
+        assert rec.counters["fleet.journal.replayed"] == 1
+        # the crashed client's retry: banked, NO new dispatch
+        code2, doc2, headers = ing.handle_submit(
+            {"kind": "equilibrium", "payload": {"T": 7.0},
+             "idempotency_key": "crashed-key"})
+        assert (code2, headers["X-Idempotent-Replay"]) == (200, "1")
+        assert len(members["m0"].submits) == 1
+        ing._httpd.server_close()
+        # a SECOND restart finds the done record: nothing to replay
+        router2, members2 = _pool("m0")
+        ing2, _ = self._ingress(router2, path)
+        assert ing2.replay_journal() == 0
+        assert members2["m0"].submits == []
+        ing2._httpd.server_close()
+
+    def test_expired_entry_closes_typed_without_dispatch(
+            self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        with IngressJournal(path) as j:
+            j.record_accept(
+                "old-rid",
+                body={"kind": "equilibrium", "tenant": None,
+                      "deadline_ms": 5_000.0, "payload": {"T": 1.0}},
+                idem="old-key", t=time.time() - 60.0)
+        router, members = _pool("m0")
+        ing, rec = self._ingress(router, path)
+        assert ing.replay_journal() == 1
+        code, doc = ing.journal.banked("old-key")
+        assert code == 504 and doc["error"] == "Timeout"
+        assert members["m0"].submits == []   # expired: never dispatched
+        ing._httpd.server_close()
+
+    def test_rejections_are_never_journaled(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        router = FleetRouter(
+            tenants={"default": {"mech": "h2o2", "quota": 0}},
+            recorder=telemetry.MetricsRecorder(), hedge=False)
+        router.add("m0", FakeMember("m0"))
+        ing, rec = self._ingress(router, path)
+        code, doc, _ = ing.handle_submit(
+            {"kind": "equilibrium", "payload": {"T": 1.0},
+             "idempotency_key": "rejected"})
+        assert code == 429
+        assert rec.counters.get("fleet.journal.appends") is None
+        assert ing.journal.unfinished() == []
+        # nothing was promised, so the retry is a fresh attempt, not
+        # a banked 429
+        assert ing.journal.banked("rejected") is None
+        ing._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the async controller: decisions never wait on spawns
+
+def _async_ctl(router, make_backend, **kw):
+    kw.setdefault("min_size", 0)
+    kw.setdefault("max_size", 4)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("recorder", telemetry.MetricsRecorder())
+    return FleetController(router, make_backend, **kw)
+
+
+class TestAsyncReconciliation:
+    def test_stalled_spawn_never_blocks_replace_decision(self):
+        """The tentpole proof: with one spawn artificially stalled, a
+        concurrent member death is detected and its replace DECIDED on
+        the very next pass — both decisions land on the typed
+        ``fleet.action`` timeline before any spawn completes."""
+        rec = telemetry.MetricsRecorder()
+        router = FleetRouter(recorder=rec, hedge=False)
+        for mid in ("m0", "m1"):
+            router.add(mid, FakeMember(mid))
+        gate = threading.Event()
+
+        def make_backend(mid):
+            gate.wait(30.0)                  # a ~15 s spawn, condensed
+            return FakeMember(mid)
+
+        ctl = _async_ctl(router, make_backend, min_size=2,
+                         recorder=rec)
+        try:
+            router.get("m0").dead = True
+            acts = ctl.step()
+            assert [a["action"] for a in acts] == ["replace"]
+            assert acts[0]["replaced"] == "m0"
+            assert ctl.state()["spawning"]   # in flight, typed
+            router.get("m1").dead = True
+            t0 = time.monotonic()
+            acts2 = ctl.step()               # must not wait on spawn 1
+            assert time.monotonic() - t0 < 1.0
+            assert any(a["action"] == "replace"
+                       and a["replaced"] == "m1" for a in acts2)
+            timeline = [a["action"] for a in ctl.actions()]
+            assert timeline.count("replace") == 2
+            assert "spawn_complete" not in timeline
+            assert len(ctl.state()["spawning"]) == 2
+            gate.set()
+            assert ctl.wait_spawns(10.0)
+            assert len(router.member_ids()) == 2
+            timeline = [a["action"] for a in ctl.actions()]
+            assert timeline.count("spawn_complete") == 2
+        finally:
+            gate.set()
+            ctl.stop()
+
+    def test_spawn_deadline_times_out_and_discards_late_backend(self):
+        rec = telemetry.MetricsRecorder()
+        router = FleetRouter(recorder=rec, hedge=False)
+        gate = threading.Event()
+        created = {}
+
+        def make_backend(mid):
+            gate.wait(30.0)
+            m = FakeMember(mid)
+            created[mid] = m
+            return m
+
+        ctl = _async_ctl(router, make_backend, recorder=rec,
+                         spawn_deadline_s=0.05)
+        try:
+            ctl._add(reason="test_seed")
+            assert router.spawning_ids() == ["m0"]
+            time.sleep(0.1)
+            acts = ctl.step()
+            assert any(a["action"] == "spawn_timeout" for a in acts)
+            ev = rec.last_event("fleet.spawn_timeout")
+            assert ev is not None and ev["member"] == "m0"
+            assert router.spawning_ids() == []
+            # the spawn eventually returns: its backend is closed and
+            # discarded, never added behind the controller's back
+            gate.set()
+            _wait(lambda: any(a["action"] == "spawn_discarded"
+                              for a in ctl.actions()),
+                  what="late backend discarded")
+            assert created["m0"].closed
+            assert router.member_ids() == []
+        finally:
+            gate.set()
+            ctl.stop()
+
+    def test_spawn_failure_is_typed_and_deficit_heals(self):
+        rec = telemetry.MetricsRecorder()
+        router = FleetRouter(recorder=rec, hedge=False)
+        calls = []
+
+        def make_backend(mid):
+            calls.append(mid)
+            if len(calls) == 1:
+                raise RuntimeError("factory exploded")
+            return FakeMember(mid)
+
+        ctl = _async_ctl(router, make_backend, min_size=1,
+                         recorder=rec)
+        try:
+            ctl._add(reason="min_size")
+            ctl.wait_spawns(10.0)
+            failed = [a for a in ctl.actions()
+                      if a["action"] == "spawn_failed"]
+            assert len(failed) == 1
+            assert "factory exploded" in failed[0]["evidence"]["error"]
+            assert router.member_ids() == []
+            acts = ctl.step()                # the deficit heal
+            assert any(a["action"] == "add"
+                       and a["reason"] == "min_size" for a in acts)
+            ctl.wait_spawns(10.0)
+            assert len(router.member_ids()) == 1
+        finally:
+            ctl.stop()
+
+    def test_pool_math_counts_inflight_spawns(self):
+        """A pending spawn must never be doubled up on: ensure_min /
+        the deficit heal see live + spawning, not just live."""
+        rec = telemetry.MetricsRecorder()
+        router = FleetRouter(recorder=rec, hedge=False)
+        gate = threading.Event()
+
+        def make_backend(mid):
+            gate.wait(30.0)
+            return FakeMember(mid)
+
+        ctl = _async_ctl(router, make_backend, min_size=2,
+                         recorder=rec)
+        try:
+            ctl._add(reason="warm")
+            ctl._add(reason="warm")
+            acts = ctl.step()                # deficit already covered
+            assert not any(a["action"] == "add" for a in acts)
+            gate.set()
+            ctl.wait_spawns(10.0)
+            assert len(router.member_ids()) == 2
+        finally:
+            gate.set()
+            ctl.stop()
+
+
+# ---------------------------------------------------------------------------
+# env-driven GRAY chaos (run_suite --chaos): one real fake-backend
+# member answers heartbeats but lags every reply — MEMBER_DEGRADED
+# fires, hedges win, the breaker sheds, nothing hangs, no replace
+
+@pytest.mark.env_chaos
+@pytest.mark.skipif(
+    "slow_replies" not in os.environ.get("PYCHEMKIN_PROC_FAULTS", ""),
+    reason="env-driven gray chaos: run via tests/run_suite.py --chaos")
+class TestEnvDrivenGrayChaos:
+    def test_slow_member_degrades_hedges_and_sheds(
+            self, fake_backend_path):
+        assert procfaults.enabled()
+        (spec,) = procfaults.specs("slow_replies")
+        assert spec.seconds > 0.1            # must clear the hedge floor
+        rec = telemetry.MetricsRecorder()
+        router = FleetRouter(
+            tenants={"default": {"mech": "h2o2", "quota": 64}},
+            recorder=rec)
+        # the victim must be the member that RECEIVES the mech's
+        # traffic: the rendezvous winner goes gray, not dead — it
+        # keeps answering heartbeats while every reply lags
+        victim = rendezvous_rank(route_key("h2o2"),
+                                 [f"m{i}" for i in range(3)])[0]
+        sups = {}
+
+        def make_backend(mid):
+            env = {}
+            if mid == victim:
+                env["FAKE_PROCFAULTS_PATH"] = tst.PROCFAULTS_PATH
+            sup = tst._fake_supervisor(fake_backend_path, env=env,
+                                       member=mid, recorder=rec)
+            sup.start()
+            sups[mid] = sup
+            return sup
+
+        ctl = FleetController(router, make_backend, min_size=3,
+                              max_size=4, cooldown_s=0.0, poll_s=0.1,
+                              recorder=rec)
+        try:
+            ctl.ensure_min()
+            results = []
+            for i in range(10):
+                fut = router.submit("equilibrium", T=float(i),
+                                    deadline_ms=60_000.0)
+                results.append(fut.result(timeout=60))
+            # zero hangs, zero loss: every caller saw OK — the gray
+            # member's lag was absorbed by winning hedges
+            assert all(r.ok for r in results)
+            _wait(lambda: router.stats()["hedge"]["won"] >= 1,
+                  what="a hedge won against the gray member")
+            # the cross-member detector fires on the victim (the
+            # scanner thread polls health_poll for us)
+            _wait(lambda: router.outliers.firing() == [victim],
+                  what="MEMBER_DEGRADED fired for the victim")
+            _wait(lambda: router.member_states()[victim] == "open",
+                  what="victim breaker opened")
+            # gray is not dead: heartbeats flowed the whole time, so
+            # no BACKEND_DOWN, no respawn, no replace decision
+            assert not sups[victim].stats()["dead"]
+            ctl.step()
+            assert not any(a["action"] == "replace"
+                           for a in ctl.actions())
+            # shed: a new assignment lands on a peer and resolves OK
+            r = router.submit("equilibrium", T=99.0,
+                              deadline_ms=60_000.0).result(timeout=60)
+            assert r.ok
+        finally:
+            # bank the gray evidence where the run_suite gray gate
+            # replays it: MEMBER_DEGRADED must have fired and at
+            # least one hedge must have won
+            kill_dir = os.environ.get("PYCHEMKIN_KILL_REPORT_DIR")
+            if kill_dir:
+                stats = router.stats()
+                timeline = router.outliers.timeline()
+                doc = {
+                    "member_degraded_fired": any(
+                        t["state"] == "fired" for t in timeline),
+                    "degraded_member": victim,
+                    "hedge": stats["hedge"],
+                    "breakers": stats["breakers"],
+                    "outlier_timeline": timeline,
+                }
+                telemetry.atomic_write_json(
+                    os.path.join(kill_dir,
+                                 f"fleet_gray_{os.getpid()}.json"),
+                    doc)
+            router.close()
+            ctl.stop(close_members=True, timeout=30.0)

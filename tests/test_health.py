@@ -21,6 +21,7 @@ import pytest
 
 from pychemkin_tpu import health, knobs, telemetry
 from pychemkin_tpu.health import monitor as health_monitor
+from pychemkin_tpu.health import outlier as health_outlier
 from pychemkin_tpu.health import signals as health_signals
 from pychemkin_tpu.health import timeseries
 from pychemkin_tpu.telemetry import schema
@@ -531,7 +532,13 @@ class TestEngineMechanics:
     def test_signal_names_match_schema(self):
         assert set(health.SIGNAL_NAMES) <= set(schema.HEALTH_SIGNALS)
         shipped = {r["name"] for r in health.DEFAULT_RULES}
-        assert shipped == set(health.SIGNAL_NAMES)
+        # MEMBER_DEGRADED ships from the cross-member outlier tracker
+        # (health.outlier), not a rule dict — the one signal whose
+        # evidence is relative across members and so can't be a
+        # single-series rule
+        engine_external = {health_outlier.MEMBER_DEGRADED}
+        assert shipped == set(health.SIGNAL_NAMES) - engine_external
+        assert engine_external <= set(health.SIGNAL_NAMES)
 
 
 class TestReplayAndCheckSignals:

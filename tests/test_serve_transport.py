@@ -798,10 +798,21 @@ FAKE_BACKEND = textwrap.dedent('''
                                     "message": "fake wedged client"},
                              lock)
                     continue
+                if (procfaults is not None
+                        and procfaults.serve_stall_after_accept(o)):
+                    continue          # accepted, never answered
                 res = dict(CANNED)
                 res["kind"] = msg.get("kind", "equilibrium")
-                send_msg(conn, {"op": "result", "id": rid,
-                                "result": res}, lock)
+                out = {"op": "result", "id": rid, "result": res}
+                delay = (procfaults.serve_reply_delay(o)
+                         if procfaults is not None else 0.0)
+                if delay > 0:
+                    # gray, not dead: the reply lags on a timer thread
+                    # while this loop keeps answering heartbeats
+                    threading.Timer(delay, send_msg,
+                                    args=(conn, out, lock)).start()
+                else:
+                    send_msg(conn, out, lock)
             elif op == "stats":
                 send_msg(conn, {"op": "stats_reply", "id": rid,
                                 "tenants": {},
@@ -1160,6 +1171,8 @@ class TestRunSuiteChaosFlag:
             recorded.setdefault("files", []).extend(
                 a for a in targets if a.endswith(".py"))
             recorded["env"] = env
+            recorded.setdefault("specs", []).append(
+                env.get("PYCHEMKIN_PROC_FAULTS"))
             # a well-behaved chaos child banks a kill report
             with open(os.path.join(env["PYCHEMKIN_KILL_REPORT_DIR"],
                                    "kill_report_g0_1.json"), "w") as f:
@@ -1177,8 +1190,13 @@ class TestRunSuiteChaosFlag:
             rs._run_child = orig
         assert rc == 0
         assert [os.path.basename(f) for f in recorded["files"]] == \
-            ["test_serve_transport.py", "test_fleet.py"]
-        assert "PYCHEMKIN_PROC_FAULTS" in recorded["env"]
+            ["test_serve_transport.py", "test_fleet.py",
+             "test_fleet_gray.py"]
+        # the kill spec rides the first two children; the gray lane
+        # gets its own slow_replies spec (per-file override)
+        assert "kill_backend_at_request" in recorded["specs"][0]
+        assert "kill_backend_at_request" in recorded["specs"][1]
+        assert "slow_replies" in recorded["specs"][2]
         assert recorded["env"]["PYCHEMKIN_KILL_REPORT_DIR"] == \
             str(tmp_path)
 

@@ -330,6 +330,30 @@ def render(snapshot: Dict, view=None, signals=None,
                 f"rejected {rt.get('rejected', 0)}  "
                 f"draining {draining}"
                 + (f"  assigned {spread}" if spread else ""))
+            # gray-failure economics (ISSUE 19): hedge counters, any
+            # tripped breaker, any member the outlier detector holds
+            # MEMBER_DEGRADED for — silent when the fleet is clean
+            hedge = rt.get("hedge") or {}
+            if any(hedge.values()):
+                lines.append(
+                    f"  hedge: issued {hedge.get('issued', 0)}  "
+                    f"won {hedge.get('won', 0)}  "
+                    f"wasted {hedge.get('wasted', 0)}")
+            for mid, br in sorted((rt.get("breakers") or {}).items()):
+                if br.get("state") == "closed" \
+                        and not br.get("n_trips"):
+                    continue
+                lines.append(
+                    f"  breaker {mid}: {br.get('state')}  "
+                    f"trips {br.get('n_trips', 0)}  "
+                    f"probes {br.get('probes_done', 0)}")
+            for mid, o in sorted((rt.get("outliers") or {}).items()):
+                if not o.get("firing"):
+                    continue
+                lines.append(
+                    f"  DEGRADED {mid}: p99 {o.get('p99_ms')}ms vs "
+                    f"median {o.get('median_ms')}ms "
+                    f"(x{o.get('ratio')})")
     for sig in (signals or []):
         if sig["state"] != "firing":
             continue

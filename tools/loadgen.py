@@ -409,8 +409,14 @@ def _run_fleet(args, kinds, bucket_sizes, rng, samplers, obs,
                                      FleetRouter, rendezvous_rank,
                                      route_key, shared_cache_env)
 
+    chaos_modes = set()
     if args.chaos is not None:
-        json.loads(args.chaos)       # fail fast on a typo'd spec
+        # fail fast on a typo'd spec; gray modes (a member that is
+        # slow, not dead) steer the victim wiring and the post-load
+        # waits below
+        chaos_modes = {s.get("mode") for s in json.loads(args.chaos)}
+    gray_chaos = bool(chaos_modes) and chaos_modes <= {
+        "slow_replies", "stall_after_accept"}
     rec = obs.recorder
     engine_config = _surrogate_config(args, kinds, _engine_config())
     config = {
@@ -443,12 +449,16 @@ def _run_fleet(args, kinds, bucket_sizes, rng, samplers, obs,
                "PYCHEMKIN_FLIGHT_DIR": obs.dir, **shared}
         max_respawns = args.max_respawns
         if chaos_pending and mid == victim:
-            # the designated victim: fault injected, respawn budget
-            # zeroed, so its death exhausts the member (typed
-            # BACKEND_LOST + router re-route) and the controller's
-            # REPLACE path — not just a same-member respawn — heals it
+            # the designated victim: fault injected. For KILL modes
+            # the respawn budget is zeroed so its death exhausts the
+            # member (typed BACKEND_LOST + router re-route) and the
+            # controller's REPLACE path — not just a same-member
+            # respawn — heals it. A GRAY victim keeps its budget: it
+            # never dies, and the healing story is MEMBER_DEGRADED +
+            # hedges + the breaker, not a replace.
             env["PYCHEMKIN_PROC_FAULTS"] = chaos_pending.pop()
-            max_respawns = 0
+            if not gray_chaos:
+                max_respawns = 0
         sup = Supervisor(config, env_overrides=env,
                          retry_budget=args.retry_budget,
                          max_respawns=max_respawns,
@@ -494,7 +504,18 @@ def _run_fleet(args, kinds, bucket_sizes, rng, samplers, obs,
             deadline_ms=args.deadline_ms,
             trace_events=obs.trace_events,
             n_exemplars=args.exemplars, classify=classify)
-        if args.chaos is not None:
+        if gray_chaos and "slow_replies" in chaos_modes:
+            # the gray story: nothing dies, so there is no replace to
+            # wait for — wait instead for the cross-member detector to
+            # fire on the victim and for at least one winning hedge,
+            # so the banked evidence deterministically carries both
+            deadline = time.time() + 30.0
+            while time.time() < deadline and not (
+                    any(tr["state"] == "fired"
+                        for tr in router.outliers.timeline())
+                    and router.stats()["hedge"]["won"] >= 1):
+                time.sleep(0.2)
+        elif args.chaos is not None:
             # a short ramp can outrun the poll loop: the kill lands
             # mid-load but the controller has not stepped past the
             # corpse yet — wait for the replace so the banked action
@@ -503,14 +524,15 @@ def _run_fleet(args, kinds, bucket_sizes, rng, samplers, obs,
             while time.time() < deadline and not any(
                     a["action"] == "replace" for a in ctl.actions()):
                 time.sleep(0.2)
-        # member spawn is synchronous with the reconciliation pass that
-        # decides it, so a scale-up triggered at the tail of the load
-        # can still be mid-spawn here — wait for the loop to complete
-        # two more passes so every decision made under load is in the
+        # spawns decided at the tail of the load run on worker threads
+        # (ISSUE 19: reconciliation is asynchronous) — wait for the
+        # loop to complete two more passes AND for every in-flight
+        # spawn to land, so every decision made under load is in the
         # router (and the action log) before the snapshot
         settled = ctl.steps + 2
         deadline = time.time() + 60.0
-        while time.time() < deadline and ctl.steps < settled:
+        while time.time() < deadline and (
+                ctl.steps < settled or ctl.state()["spawning"]):
             time.sleep(0.2)
         members = {}
         for mid in router.member_ids():
@@ -537,10 +559,18 @@ def _run_fleet(args, kinds, bucket_sizes, rng, samplers, obs,
             "router": router.stats(),
             "controller": ctl.state(),
             "actions": ctl.actions(),
+            # the gray-failure evidence (ISSUE 19): every
+            # MEMBER_DEGRADED fire/clear transition with its
+            # p99-vs-median ratios — alongside router.hedge /
+            # router.breakers this is the acceptance artifact's proof
+            # that a slow member was detected, shed, and recovered
+            "degraded_timeline": router.outliers.timeline(),
+            "chaos_victim": victim,
         }
     finally:
         if ingress is not None:
             ingress.close()
+        router.close()               # stop the hedge scanner thread
         ctl.stop(close_members=True)
     # the controller's typed decision log, one JSONL line per action —
     # what the run_suite fleet-chaos gate replays for a replace event
