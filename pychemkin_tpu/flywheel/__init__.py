@@ -1,0 +1,49 @@
+"""The surrogate flywheel: production traffic trains the model.
+
+Closes the loop PR 9 left open. The surrogate serving path already
+produced everything a retrain needs — every ``SURROGATE_MISS`` is
+rescued by the real solver (a free, solver-verified label at exactly
+the conditions the model is weak on) and the health engine already
+detects hit-rate collapse (``SURROGATE_RETRAIN``). This package wires
+those ends together into an autonomous loop:
+
+- :mod:`.bank` — :class:`~pychemkin_tpu.flywheel.bank.MissBank`
+  captures rescued misses into signed dataset shards (the exact
+  :mod:`pychemkin_tpu.surrogate.dataset` schema, atomic banking,
+  per-kind ring budgets, mechanism-signature poison protection).
+- :mod:`.daemon` — :class:`~pychemkin_tpu.flywheel.daemon
+  .FlywheelDaemon` reconciles on the fleet health monitor's
+  ``SURROGATE_RETRAIN`` (per-kind via evidence ``req_kind``), labels
+  an active-learning box aimed at the banked miss hull through the
+  durable sweep driver (SIGKILL-resumable), and fits candidates with
+  the incumbent's architecture.
+- :mod:`.shadow` — :class:`~pychemkin_tpu.flywheel.shadow
+  .ShadowEvaluator` rides candidates on live traffic (same compiled
+  programs, zero new XLA compiles; predicts + gates, never answers)
+  and tallies would-have-hit vs the incumbent.
+- :mod:`.promote` — :func:`~pychemkin_tpu.flywheel.promote
+  .apply_verdict` promotes only a candidate that beats the incumbent
+  hit rate with ZERO gate regressions — an atomic, versioned
+  (``model_gen``) weight swap fanned out to every fleet member — and
+  emits typed ``flywheel.promoted`` / ``flywheel.rejected`` events
+  either way.
+
+The serving guarantee is untouched: candidates never answer a request;
+the verification gates stay between every model (incumbent or
+promoted) and the client; a wrong-headed candidate (scrambled labels,
+stale mechanism) dies in shadow or at the signature checks.
+"""
+
+from .bank import CONDITION_FIELDS, MissBank
+from .daemon import RETRAIN_SIGNAL, FlywheelDaemon
+from .promote import apply_verdict
+from .shadow import ShadowEvaluator
+
+__all__ = [
+    "CONDITION_FIELDS",
+    "FlywheelDaemon",
+    "MissBank",
+    "RETRAIN_SIGNAL",
+    "ShadowEvaluator",
+    "apply_verdict",
+]

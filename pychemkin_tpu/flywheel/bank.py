@@ -1,0 +1,286 @@
+"""Miss banking: the serving path's free training labels.
+
+Every ``SURROGATE_MISS`` that rung 1 rescues pays for a real solve at
+exactly the conditions where the model is weak — and then, without a
+bank, throws the answer away. :class:`MissBank` is the capture hook the
+surrogate engines call from the rescue path
+(:meth:`pychemkin_tpu.serve.engines.SurrogateEngine.rescue_one` with
+``bank=``): it turns the (payload, solver-verified value) pair into a
+training row in the EXACT shard schema of
+:mod:`pychemkin_tpu.surrogate.dataset`, so the retrain daemon merges
+banked misses with base datasets through the same
+:func:`~pychemkin_tpu.surrogate.dataset.load_shards` signature checks
+that protect every other training input.
+
+Trust properties:
+
+- Only ``SolveStatus.OK`` labels bank (ignition additionally requires a
+  detected ignition inside the horizon; psr requires Newton
+  convergence) — a failed rescue is an incident, not a label.
+- Every shard carries the serving mechanism's ``mech_sig``; the loaders
+  refuse foreign shards, so a mechanism swap mid-run can never poison
+  the training pool (:meth:`shard_paths` additionally filters, so
+  stale-but-well-formed shards from a previous mechanism are skipped,
+  not fatal).
+- Shards bank atomically (tmp + rename via
+  :func:`pychemkin_tpu.telemetry.atomic_savez`) and the per-kind ring
+  budget (``PYCHEMKIN_FLYWHEEL_BANK_MAX_SHARDS``) evicts oldest-first,
+  so the pool is bounded and a crash never leaves a torn shard.
+
+A JSON sidecar per kind tracks the banked CONDITION box (payload-space
+min/max of the dimensions the sampler can target) — the retrain
+daemon's active-learning box, aimed at the densest miss region.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import knobs, telemetry
+from ..resilience import checkpoint
+from ..resilience.status import SolveStatus
+from ..surrogate import dataset as sg_dataset
+from ..surrogate import model as sg_model
+
+#: payload-space condition dimensions tracked per kind — the axes the
+#: dataset sampler (:class:`~pychemkin_tpu.surrogate.dataset.SampleBox`)
+#: can aim an active-learning draw at
+CONDITION_FIELDS = {
+    "ignition": ("T0", "P0", "t_end"),
+    "equilibrium": ("T", "P"),
+    "psr": ("tau", "P"),
+}
+
+
+class MissBank:
+    """Bounded, signed, per-kind pool of rescued-miss training rows.
+
+    ``root`` is the bank directory (created on first flush). Rows
+    accumulate in memory and bank as one shard every ``shard_rows``
+    rows (``PYCHEMKIN_FLYWHEEL_BANK_ROWS``); :meth:`flush` banks a
+    partial shard on demand (the daemon calls it before a retrain).
+    Thread-safe: ``note_miss`` arrives from rescue worker threads.
+    """
+
+    def __init__(self, root: str, mech, recorder=None, *,
+                 max_shards: Optional[int] = None,
+                 shard_rows: Optional[int] = None):
+        self.root = root
+        self.mech = mech
+        self._rec = recorder if recorder is not None \
+            else telemetry.MetricsRecorder()
+        self.max_shards = int(max_shards) if max_shards is not None \
+            else knobs.value("PYCHEMKIN_FLYWHEEL_BANK_MAX_SHARDS")
+        self.shard_rows = int(shard_rows) if shard_rows is not None \
+            else knobs.value("PYCHEMKIN_FLYWHEEL_BANK_ROWS")
+        self.mech_sig = sg_dataset.mech_signature(mech)
+        self._lock = threading.Lock()
+        # per-kind pending rows: lists of (x, y, conditions) tuples
+        self._pending: Dict[str, List] = {}
+        self._option: Dict[str, int] = {}
+        # next shard index per kind, resumed from what's on disk so a
+        # restart appends after the newest shard instead of clobbering
+        self._next_idx: Dict[str, int] = {}
+
+    # -- capture (the serving-path hook) --------------------------------
+    def note_miss(self, kind: str, payload: Dict[str, Any],
+                  value: Dict[str, Any], *, status: int) -> bool:
+        """Bank one rescued miss; returns True when the row was
+        accepted. ``payload`` is the engine-normalized request,
+        ``value`` the base engine's ``value_at`` of the rescue answer,
+        ``status`` its ``SolveStatus``. Unlabelable rows (failed
+        rescue, undetected ignition) are dropped — never trained on."""
+        if int(status) != int(SolveStatus.OK):
+            return False
+        row = self._build_row(kind, payload, value)
+        if row is None:
+            return False
+        with self._lock:
+            self._pending.setdefault(kind, []).append(row)
+            if kind == "equilibrium":
+                self._option[kind] = int(payload.get("option", 1))
+            n_pending = len(self._pending[kind])
+            if n_pending >= self.shard_rows:
+                self._flush_locked(kind)
+        self._rec.inc("flywheel.banked")
+        self._rec.inc(f"flywheel.banked.{kind}")
+        return True
+
+    def _build_row(self, kind, payload, value):
+        if kind == "ignition":
+            t = float(value.get("ignition_time_s", np.nan))
+            t_end = float(payload["t_end"])
+            if not (np.isfinite(t) and 0.0 < t < t_end):
+                return None     # rescue answered, but no event to label
+            x = np.asarray(sg_model.features(
+                payload["T0"], payload["P0"], payload["Y0"]))
+            y = np.array([np.log10(t)])
+            cond = {"T0": float(payload["T0"]),
+                    "P0": float(payload["P0"]), "t_end": t_end}
+        elif kind == "equilibrium":
+            X_eq = np.asarray(value["X"], np.float64)
+            if not np.all(np.isfinite(X_eq)):
+                return None
+            Yn = np.asarray(payload["Y"], np.float64)
+            Yn = Yn / max(Yn.sum(), 1e-30)
+            x = np.asarray(sg_model.features(
+                payload["T"], payload["P"], Yn))
+            y = np.log(np.maximum(X_eq, sg_model.X_FLOOR))
+            cond = {"T": float(payload["T"]), "P": float(payload["P"])}
+        elif kind == "psr":
+            if not bool(value.get("converged", False)):
+                return None
+            T_out = float(value["T"])
+            Y_out = np.asarray(value["Y"], np.float64)
+            if not (np.isfinite(T_out) and T_out > 0.0
+                    and np.all(np.isfinite(Y_out))):
+                return None
+            x = np.asarray(sg_model.psr_features(
+                payload["tau"], payload["P"], payload["Y_in"],
+                payload["h_in"]))
+            y = np.concatenate(
+                [[T_out / sg_model.PSR_T_SCALE],
+                 np.log(np.maximum(Y_out, sg_model.X_FLOOR))])
+            cond = {"tau": float(payload["tau"]),
+                    "P": float(payload["P"])}
+        else:
+            return None
+        return (np.asarray(x, np.float64).ravel(),
+                np.asarray(y, np.float64).ravel(), cond)
+
+    # -- banking --------------------------------------------------------
+    def flush(self, kind: Optional[str] = None) -> List[str]:
+        """Bank pending rows now (all kinds, or one); returns the
+        paths written. The daemon calls this before merging so a
+        retrain sees every captured miss, not just full shards."""
+        kinds = [kind] if kind is not None else sorted(self._pending)
+        paths = []
+        with self._lock:
+            for k in kinds:
+                p = self._flush_locked(k)
+                if p is not None:
+                    paths.append(p)
+        return paths
+
+    def _flush_locked(self, kind) -> Optional[str]:
+        rows = self._pending.get(kind) or []
+        if not rows:
+            return None
+        self._pending[kind] = []
+        x = np.stack([r[0] for r in rows])
+        y = np.stack([r[1] for r in rows])
+        conds = [r[2] for r in rows]
+        idx = self._next_idx.get(kind)
+        if idx is None:
+            idx = self._scan_next_index(kind)
+        self._next_idx[kind] = idx + 1
+        os.makedirs(self.root, exist_ok=True)
+        path = os.path.join(self.root, f"miss_{kind}_{idx:05d}.npz")
+        option = self._option.get(kind, -1)
+        shard = {
+            "v": sg_dataset.SHARD_VERSION, "kind": kind,
+            # a bank shard's problem identity: captured live traffic,
+            # not a sampled box — distinct by construction, and
+            # load_shards only pins sig when asked to
+            "sig": checkpoint.config_signature(
+                "flywheel-miss-bank", kind, int(idx), int(option),
+                tree=self.mech),
+            "mech_sig": self.mech_sig,
+            "x": x, "y": y,
+            "valid": np.ones(x.shape[0], bool),
+            # the trained-domain box this shard contributes: the hull
+            # of its own rows (load_shards unions boxes across shards)
+            "lo": x.min(axis=0), "hi": x.max(axis=0),
+            "t_end": float(max((c.get("t_end", 0.0) for c in conds),
+                               default=0.0)),
+            "option": int(option),
+            "status_counts": {str(int(SolveStatus.OK)): x.shape[0]},
+        }
+        sg_dataset.save_shard(path, shard)
+        self._update_conditions_locked(kind, conds)
+        self._evict_locked(kind)
+        return path
+
+    def _scan_next_index(self, kind) -> int:
+        taken = [-1]
+        for p in glob.glob(os.path.join(self.root,
+                                        f"miss_{kind}_*.npz")):
+            stem = os.path.basename(p)[:-4]
+            try:
+                taken.append(int(stem.rsplit("_", 1)[1]))
+            except ValueError:
+                continue
+        return max(taken) + 1
+
+    def _evict_locked(self, kind) -> None:
+        paths = self._sorted_paths(kind)
+        for p in paths[:max(0, len(paths) - self.max_shards)]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass            # already gone — eviction is advisory
+
+    def _sorted_paths(self, kind) -> List[str]:
+        return sorted(glob.glob(
+            os.path.join(self.root, f"miss_{kind}_*.npz")))
+
+    # -- read side ------------------------------------------------------
+    def shard_paths(self, kind: str,
+                    mech_sig: Optional[str] = None) -> List[str]:
+        """Banked shard paths for ``kind``, oldest first, SKIPPING any
+        shard whose ``mech_sig`` disagrees with ``mech_sig`` (default:
+        this bank's serving mechanism) — a leftover pool from a
+        previous mechanism is ignored, not fatal."""
+        want = mech_sig if mech_sig is not None else self.mech_sig
+        out = []
+        for p in self._sorted_paths(kind):
+            try:
+                with np.load(p, allow_pickle=False) as f:
+                    if str(f["mech_sig"]) == want:
+                        out.append(p)
+            except (OSError, KeyError, ValueError):
+                continue        # torn/foreign file: skip, don't poison
+        return out
+
+    def pending_rows(self, kind: str) -> int:
+        with self._lock:
+            return len(self._pending.get(kind) or [])
+
+    def miss_box(self, kind: str) -> Optional[Dict[str, Any]]:
+        """The banked condition hull for ``kind`` (payload-space
+        min/max per :data:`CONDITION_FIELDS` axis plus the row count),
+        or None before any flush — what the daemon aims the
+        active-learning sample box at."""
+        path = self._conditions_path(kind)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _conditions_path(self, kind) -> str:
+        return os.path.join(self.root, f"miss_{kind}_conditions.json")
+
+    def _update_conditions_locked(self, kind, conds) -> None:
+        fields = CONDITION_FIELDS.get(kind, ())
+        cur = self.miss_box(kind) or {
+            "n": 0, "lo": {}, "hi": {}}
+        for c in conds:
+            for f in fields:
+                if f not in c:
+                    continue
+                v = float(c[f])
+                cur["lo"][f] = min(cur["lo"].get(f, v), v)
+                cur["hi"][f] = max(cur["hi"].get(f, v), v)
+        cur["n"] = int(cur.get("n", 0)) + len(conds)
+        path = self._conditions_path(kind)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cur, f, sort_keys=True)
+        os.replace(tmp, path)

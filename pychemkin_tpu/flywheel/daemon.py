@@ -1,0 +1,282 @@
+"""The retrain daemon: health signals in, shadowed candidates out.
+
+One :class:`FlywheelDaemon` runs per fleet. Its reconciliation loop
+(:meth:`poll` — same observed-state-vs-desired-state discipline as the
+fleet autoscaler) watches the fleet health monitor for
+``SURROGATE_RETRAIN`` (the hit-rate-collapse signal of
+:mod:`pychemkin_tpu.health.signals`, kind-scoped via the evidence's
+``req_kind``) and drives the full round:
+
+1. **Retrain** (:meth:`retrain`): flush the miss bank, aim an
+   active-learning sample box at the banked miss-condition hull (the
+   densest miss region — new labels go where production traffic
+   actually missed), label it through the durable sweep driver
+   (:func:`~pychemkin_tpu.surrogate.dataset.generate_dataset` with an
+   ``out_path``: checkpointed, SIGKILL-resumable), merge base + banked
+   + active shards under the
+   :func:`~pychemkin_tpu.surrogate.dataset.load_shards` signature
+   checks, and fit a candidate with the INCUMBENT's architecture (same
+   param-pytree structure = the promotion path re-uses every compiled
+   program).
+2. **Shadow** (:meth:`start_round` attaches): the candidate rides live
+   traffic on every target, predicting and gating, never answering.
+3. **Verdict** (:meth:`finish_round`): promotion fan-out or rejection
+   via :func:`pychemkin_tpu.flywheel.promote.apply_verdict`; either
+   way a typed ``flywheel.round`` event closes the round.
+
+The daemon never imports the serve layer: targets are duck-typed
+(``engine(kind)`` + ``promote_model(kind, model)`` — a
+``ChemServer``), so it drives a single in-process server and a fleet
+of transport-backed members identically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import knobs, telemetry
+from ..surrogate import dataset as sg_dataset
+from ..surrogate import train as sg_train
+from .shadow import ShadowEvaluator
+from . import promote as fw_promote
+
+#: the health signal that triggers a retrain round
+RETRAIN_SIGNAL = "SURROGATE_RETRAIN"
+
+
+def _pad_range(lo: float, hi: float, frac: float = 0.05):
+    """A degenerate banked hull (one miss) still needs a samplable
+    box: pad both ends by ``frac`` of the span (or of the value)."""
+    lo, hi = float(lo), float(hi)
+    span = max(hi - lo, abs(hi) * frac, 1e-12)
+    return (lo - frac * span, hi + frac * span)
+
+
+class FlywheelDaemon:
+    """Drives retrain → shadow → verdict rounds for one fleet."""
+
+    def __init__(self, mech, monitor, bank, targets: Sequence[Any], *,
+                 kinds: Sequence[str] = ("ignition",),
+                 model_dir: Optional[str] = None,
+                 base_shards: Optional[Dict[str, List[str]]] = None,
+                 recorder=None, train_kwargs: Optional[Dict] = None,
+                 active_n: Optional[int] = None, seed: int = 0,
+                 shadow_min_n: Optional[int] = None,
+                 promote_margin: Optional[float] = None,
+                 solver_kwargs: Optional[Dict[str, Dict]] = None,
+                 base_box: Optional[Dict[str, Any]] = None):
+        self.mech = mech
+        self.monitor = monitor
+        self.bank = bank
+        self.targets = list(targets)
+        self.kinds = tuple(kinds)
+        self.model_dir = model_dir
+        self.base_shards = dict(base_shards or {})
+        self._rec = recorder if recorder is not None \
+            else telemetry.MetricsRecorder()
+        self.train_kwargs = dict(train_kwargs or {})
+        self.active_n = int(active_n) if active_n is not None \
+            else knobs.value("PYCHEMKIN_FLYWHEEL_ACTIVE_N")
+        self.seed = int(seed)
+        self.shadow_min_n = shadow_min_n
+        self.promote_margin = promote_margin
+        self.solver_kwargs = dict(solver_kwargs or {})
+        #: per-kind starting SampleBox for the active-learning draw
+        #: (axes the miss hull doesn't cover keep these values); kinds
+        #: trained off the default box — e.g. a cold-inlet psr — pass
+        #: theirs here so active labels stay on the incumbent's manifold
+        self.base_box = dict(base_box or {})
+        #: in-flight rounds: kind -> (candidate, ShadowEvaluator)
+        self._shadows: Dict[str, Any] = {}
+        self._round: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- plumbing --------------------------------------------------------
+    def _engine(self, kind: str):
+        return self.targets[0].engine(f"surrogate_{kind}")
+
+    def incumbent(self, kind: str):
+        return self._engine(kind).model
+
+    def shadowing(self, kind: str) -> bool:
+        with self._lock:
+            return kind in self._shadows
+
+    # -- active learning -------------------------------------------------
+    def active_box(self, kind: str) -> sg_dataset.SampleBox:
+        """The retrain draw's sample box: the banked miss-condition
+        hull (padded) on every axis the sampler can target, the
+        default box elsewhere — so generation concentrates labels in
+        the region production traffic is actually missing in."""
+        box = self.base_box.get(kind, sg_dataset.SampleBox())
+        hull = self.bank.miss_box(kind)
+        if not hull or not hull.get("n"):
+            return box
+        lo, hi = hull["lo"], hull["hi"]
+
+        def rng(f):
+            return _pad_range(lo[f], hi[f])
+
+        if kind == "ignition":
+            if "T0" in lo:
+                box = box._replace(T=rng("T0"))
+            if "P0" in lo:
+                box = box._replace(P=rng("P0"))
+            if "t_end" in hi:
+                box = box._replace(t_end=float(hi["t_end"]))
+        elif kind == "equilibrium":
+            if "T" in lo:
+                box = box._replace(T=rng("T"))
+            if "P" in lo:
+                box = box._replace(P=rng("P"))
+        elif kind == "psr":
+            if "tau" in lo:
+                box = box._replace(tau=rng("tau"))
+            if "P" in lo:
+                box = box._replace(P=rng("P"))
+        return box
+
+    # -- the round -------------------------------------------------------
+    def retrain(self, kind: str, *, scramble: bool = False):
+        """Produce one candidate model for ``kind``; returns it.
+
+        ``scramble=True`` is the chaos hook: the merged dataset's
+        labels are permuted before the fit, yielding a
+        plausible-shaped but WRONG candidate — the shadow gate must
+        reject it (exercised by the soak's bad-candidate round)."""
+        incumbent = self.incumbent(kind)
+        gen = int(incumbent.meta.get("model_gen", 0))
+        rnd = self._round.get(kind, 0)
+        self._round[kind] = rnd + 1
+
+        self.bank.flush(kind)
+        box = self.active_box(kind)
+        active_path = os.path.join(
+            self.bank.root, f"active_{kind}_r{rnd:03d}.npz")
+        os.makedirs(self.bank.root, exist_ok=True)
+        sg_dataset.generate_dataset(
+            self.mech, kind, n=self.active_n,
+            seed=self.seed + 1000 * rnd, box=box,
+            out_path=active_path, recorder=self._rec,
+            solver_kwargs=self.solver_kwargs.get(kind))
+
+        paths = (list(self.base_shards.get(kind, ()))
+                 + self.bank.shard_paths(kind) + [active_path])
+        data = sg_dataset.load_shards(
+            paths, expect_mech_sig=self.bank.mech_sig)
+        if scramble:
+            rng = np.random.default_rng(self.seed + 7 * rnd)
+            idx = np.flatnonzero(np.asarray(data["valid"], bool))
+            y = np.array(data["y"])
+            y[idx] = y[rng.permutation(idx)]
+            data = dict(data, y=y)
+
+        # the incumbent's architecture, member for member: same param
+        # pytree structure means install_model re-uses every compiled
+        # batch program (the zero-new-compiles promotion contract)
+        kw = {"hidden": tuple(
+                  int(h) for h in
+                  str(incumbent.meta.get("hidden", "32,32")).split(",")),
+              "steps": int(incumbent.meta.get("steps", 400)),
+              "n_members": len(incumbent.members),
+              "seed": self.seed + 1000 * rnd + 1}
+        kw.update(self.train_kwargs)
+        candidate, _curves = sg_train.fit_surrogate(data, **kw)
+        return candidate._replace(
+            meta={**candidate.meta, "model_gen": gen + 1})
+
+    def start_round(self, kind: str, *, scramble: bool = False):
+        """Retrain and attach the candidate as a shadow on every
+        target; returns the candidate. No-op (returns the in-flight
+        candidate) when a round is already riding."""
+        with self._lock:
+            inflight = self._shadows.get(kind)
+        if inflight is not None:
+            return inflight[0]
+        candidate = self.retrain(kind, scramble=scramble)
+        shadow = ShadowEvaluator(candidate, recorder=self._rec)
+        for t in self.targets:
+            t.engine(f"surrogate_{kind}").attach_shadow(shadow)
+        with self._lock:
+            self._shadows[kind] = (candidate, shadow)
+        self._rec.inc("flywheel.rounds")
+        return candidate
+
+    def finish_round(self, kind: str) -> Optional[Dict[str, Any]]:
+        """Conclude the in-flight round if the shadow has seen enough
+        traffic: detach, promote or reject, emit ``flywheel.round``.
+        Returns the summary, or None while undecided (shadow keeps
+        riding) or when no round is in flight."""
+        with self._lock:
+            inflight = self._shadows.get(kind)
+        if inflight is None:
+            return None
+        candidate, shadow = inflight
+        if shadow.verdict(min_n=self.shadow_min_n,
+                          margin=self.promote_margin) == "undecided":
+            return None
+        for t in self.targets:
+            t.engine(f"surrogate_{kind}").detach_shadow()
+        with self._lock:
+            self._shadows.pop(kind, None)
+        summary = fw_promote.apply_verdict(
+            kind, candidate, shadow, self.targets,
+            recorder=self._rec, model_dir=self.model_dir,
+            min_n=self.shadow_min_n, margin=self.promote_margin)
+        stats = summary["stats"]
+        self._rec.event("flywheel.round", req_kind=kind,
+                        verdict=summary["verdict"],
+                        model_gen=summary["model_gen"],
+                        n=stats["n"],
+                        cand_hit_rate=round(stats["cand_hit_rate"], 4),
+                        inc_hit_rate=round(stats["inc_hit_rate"], 4),
+                        regressions=stats["regressions"])
+        return summary
+
+    # -- reconciliation --------------------------------------------------
+    def poll(self) -> List[Dict[str, Any]]:
+        """One reconciliation step: conclude any decided shadow round,
+        then start rounds for every kind the health engine says needs
+        one (``SURROGATE_RETRAIN``, kind-scoped via the evidence's
+        ``req_kind``; an unscoped firing covers every configured
+        kind). Returns the actions taken."""
+        actions: List[Dict[str, Any]] = []
+        for kind in self.kinds:
+            if self.shadowing(kind):
+                summary = self.finish_round(kind)
+                if summary is not None:
+                    actions.append({"action": "conclude", "kind": kind,
+                                    "verdict": summary["verdict"]})
+        wanted = set()
+        for sig in self.monitor.firing():
+            if sig.get("signal") != RETRAIN_SIGNAL:
+                continue
+            req_kind = (sig.get("evidence") or {}).get("req_kind")
+            if req_kind is None:
+                wanted.update(self.kinds)
+            elif req_kind in self.kinds:
+                wanted.add(req_kind)
+        for kind in sorted(wanted):
+            if not self.shadowing(kind):
+                self.start_round(kind)
+                actions.append({"action": "retrain", "kind": kind})
+        return actions
+
+    def run(self, stop_event: threading.Event,
+            poll_s: Optional[float] = None) -> None:
+        """Blocking reconciliation loop (run in a thread); one
+        :meth:`poll` per ``PYCHEMKIN_FLYWHEEL_POLL_S``. A poll crash
+        counts ``flywheel.errors`` and the loop keeps going — the
+        flywheel degrades to static serving, never takes it down."""
+        if poll_s is None:
+            poll_s = knobs.value("PYCHEMKIN_FLYWHEEL_POLL_S")
+        while not stop_event.is_set():
+            try:
+                self.poll()
+            except Exception:
+                self._rec.inc("flywheel.errors")
+            stop_event.wait(float(poll_s))

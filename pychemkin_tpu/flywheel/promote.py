@@ -1,0 +1,82 @@
+"""Atomic promotion: the one gate between a candidate and production.
+
+:func:`apply_verdict` reads the shadow tallies, decides, and acts:
+
+- ``promote`` — fan the candidate out to every target server via
+  ``promote_model`` (each engine's
+  :meth:`~pychemkin_tpu.serve.engines.SurrogateEngine.install_model`
+  swap: one attribute assignment under the engine lock, zero new XLA
+  compiles for a same-architecture candidate), bank the promoted
+  weights to the model directory for rollback, and emit ONE typed
+  ``flywheel.promoted`` event carrying the shadow stats.
+- ``reject`` — the incumbent keeps serving untouched; the candidate's
+  weights are dropped and a typed ``flywheel.rejected`` event records
+  why (the stats make the regression count auditable).
+- ``undecided`` — nothing happens; the caller keeps shadowing.
+
+Both terminal outcomes are events, not log lines: the acceptance
+artifact asserts on them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+from .. import telemetry
+from ..surrogate import model as sg_model
+
+
+def apply_verdict(kind: str, candidate, shadow,
+                  targets: Sequence[Any], *, recorder=None,
+                  model_dir: Optional[str] = None,
+                  min_n: Optional[int] = None,
+                  margin: Optional[float] = None) -> Dict[str, Any]:
+    """Decide and act on one shadowed candidate; returns a summary
+    dict (``verdict``, ``stats``, ``model_gen``, per-target install
+    generations). ``targets`` are ``ChemServer``-shaped (duck-typed
+    ``promote_model(kind, model)``); ``kind`` is the BASE request
+    kind (``ignition``/...), promotion goes to ``surrogate_<kind>``.
+    """
+    rec = recorder if recorder is not None \
+        else telemetry.MetricsRecorder()
+    verdict = shadow.verdict(min_n=min_n, margin=margin)
+    stats = shadow.stats()
+    summary: Dict[str, Any] = {
+        "kind": kind, "verdict": verdict, "stats": stats,
+        "model_gen": int(candidate.meta.get("model_gen", 0)),
+    }
+    if verdict == "undecided":
+        return summary
+
+    if verdict == "promote":
+        gens = []
+        for t in targets:
+            gens.append(int(t.promote_model(f"surrogate_{kind}",
+                                            candidate)))
+        summary["installed_gens"] = gens
+        if model_dir is not None:
+            # bank the promoted weights BEFORE declaring victory: the
+            # rollback path (install gen N-1 by hand) needs the file
+            os.makedirs(model_dir, exist_ok=True)
+            path = os.path.join(
+                model_dir, f"{kind}_gen{summary['model_gen']:03d}.npz")
+            sg_model.save_model(path, candidate)
+            summary["model_path"] = path
+        rec.inc("flywheel.promoted")
+        rec.event("flywheel.promoted", req_kind=kind,
+                  model_gen=summary["model_gen"],
+                  n=stats["n"], cand_hits=stats["cand_hits"],
+                  inc_hits=stats["inc_hits"],
+                  regressions=stats["regressions"],
+                  xcheck_mean=stats.get("xcheck_mean"),
+                  targets=len(targets))
+    else:
+        rec.inc("flywheel.rejected")
+        rec.event("flywheel.rejected", req_kind=kind,
+                  model_gen=summary["model_gen"],
+                  n=stats["n"], cand_hits=stats["cand_hits"],
+                  inc_hits=stats["inc_hits"],
+                  regressions=stats["regressions"],
+                  xcheck_mean=stats.get("xcheck_mean"))
+    return summary

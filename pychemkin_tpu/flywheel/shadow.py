@@ -1,0 +1,159 @@
+"""Shadow evaluation: a candidate model rides live traffic, answers
+nothing.
+
+A retrained candidate is never trusted on its training metrics — it is
+attached to the serving engine
+(:meth:`pychemkin_tpu.serve.engines.SurrogateEngine.attach_shadow`),
+which replays every accounted live batch through the candidate's
+weights via ``predict_with`` (the SAME compiled program — a
+same-architecture candidate adds zero XLA compiles to the hot path).
+The shadow accumulates, per batch:
+
+- would-have-hit: lanes the candidate's gate verifies,
+- incumbent hits: lanes the serving model verified,
+- **regressions**: lanes the incumbent verified but the candidate
+  missed — the one number that must be ZERO for promotion (a flywheel
+  round may only ADD coverage, never trade old hits for new ones),
+- **cross-check disagreement**: on lanes where BOTH models claim a
+  gate-verified answer, the mean distance between those answers in
+  the model's target space (``engine.answer_array``). An ensemble
+  retrained on poisoned labels agrees with itself — and so can pass
+  the disagreement gate — but it cannot agree with the trusted
+  incumbent; above ``PYCHEMKIN_FLYWHEEL_XCHECK_TOL`` the verdict is
+  reject, whatever the hit counts say,
+- gate-residual sums for both, for the artifact.
+
+:meth:`verdict` turns the tallies into ``promote`` / ``reject`` /
+``undecided`` under the ``PYCHEMKIN_FLYWHEEL_SHADOW_MIN_N`` sample
+floor and ``PYCHEMKIN_FLYWHEEL_PROMOTE_MARGIN`` improvement margin.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .. import knobs, telemetry
+from ..surrogate import model as sg_model
+
+
+class ShadowEvaluator:
+    """Accumulates candidate-vs-incumbent gate outcomes over live
+    batches. One instance may shadow several engines (a fleet): the
+    tallies merge under the lock. Never raises out of
+    ``observe_batch`` by contract of the engine hook (the engine wraps
+    it anyway and counts ``flywheel.errors``)."""
+
+    def __init__(self, model, recorder=None):
+        self.model = model
+        self._params = sg_model.model_params(model)
+        self._rec = recorder if recorder is not None \
+            else telemetry.MetricsRecorder()
+        self._lock = threading.Lock()
+        self.n = 0
+        self.cand_hits = 0
+        self.inc_hits = 0
+        self.regressions = 0
+        self._cand_resid = 0.0
+        self._inc_resid = 0.0
+        self._resid_n = 0
+        self._xcheck_sum = 0.0
+        self._xcheck_n = 0
+
+    @property
+    def model_gen(self) -> int:
+        return int(self.model.meta.get("model_gen", 0))
+
+    # -- the engine hook -------------------------------------------------
+    def observe_batch(self, engine, key, payloads, bucket, out) -> None:
+        """Replay one live batch through the candidate. ``out`` is the
+        incumbent's result dict (bucket shape); only the real lanes
+        are tallied."""
+        cand = engine.predict_with(self._params, payloads, bucket, key)
+        n = len(payloads)
+        cand_ver = np.asarray(cand["verified"][:n], bool)
+        inc_ver = np.asarray(out["verified"][:n], bool)
+        cand_r = np.asarray(cand["residual"][:n], np.float64)
+        inc_r = np.asarray(out["residual"][:n], np.float64)
+        both = np.isfinite(cand_r) & np.isfinite(inc_r)
+        # the cross-check: both-verified lanes carry two answers that
+        # each passed a gate — per-lane mean |distance| in the model's
+        # target space must be ~0 between honest models
+        agree = cand_ver & inc_ver
+        x_sum, x_n = 0.0, 0
+        if agree.any():
+            d = np.abs(engine.answer_array(cand, n)
+                       - engine.answer_array(out, n)).mean(axis=1)
+            lanes = agree & np.isfinite(d)
+            x_sum, x_n = float(d[lanes].sum()), int(lanes.sum())
+        with self._lock:
+            self.n += n
+            self.cand_hits += int(cand_ver.sum())
+            self.inc_hits += int(inc_ver.sum())
+            self.regressions += int((inc_ver & ~cand_ver).sum())
+            self._cand_resid += float(cand_r[both].sum())
+            self._inc_resid += float(inc_r[both].sum())
+            self._resid_n += int(both.sum())
+            self._xcheck_sum += x_sum
+            self._xcheck_n += x_n
+        self._rec.inc("flywheel.shadow.evals", n)
+
+    # -- read side -------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            n = self.n
+            return {
+                "n": n,
+                "model_gen": self.model_gen,
+                "cand_hits": self.cand_hits,
+                "inc_hits": self.inc_hits,
+                "regressions": self.regressions,
+                "cand_hit_rate": self.cand_hits / n if n else 0.0,
+                "inc_hit_rate": self.inc_hits / n if n else 0.0,
+                "cand_mean_residual": (
+                    self._cand_resid / self._resid_n
+                    if self._resid_n else None),
+                "inc_mean_residual": (
+                    self._inc_resid / self._resid_n
+                    if self._resid_n else None),
+                "xcheck_n": self._xcheck_n,
+                "xcheck_mean": (self._xcheck_sum / self._xcheck_n
+                                if self._xcheck_n else None),
+            }
+
+    def verdict(self, *, min_n: Optional[int] = None,
+                margin: Optional[float] = None) -> str:
+        """``promote`` | ``reject`` | ``undecided``.
+
+        - fewer than ``min_n`` shadowed requests → ``undecided`` (keep
+          riding traffic; never judge on a handful of lanes);
+        - ANY regression → ``reject`` (the incumbent's coverage is the
+          floor — a candidate that trades hits is worse even if its
+          total is higher);
+        - cross-check disagreement above
+          ``PYCHEMKIN_FLYWHEEL_XCHECK_TOL`` → ``reject`` (the
+          candidate's verified answers contradict the incumbent's —
+          a poisoned/scrambled model whose self-consistent ensemble
+          fooled the gate);
+        - otherwise promote iff the candidate's extra hits clear
+          ``margin`` (a fraction of shadowed requests; default 0 means
+          at least ONE strictly new verified answer).
+        """
+        if min_n is None:
+            min_n = knobs.value("PYCHEMKIN_FLYWHEEL_SHADOW_MIN_N")
+        if margin is None:
+            margin = knobs.value("PYCHEMKIN_FLYWHEEL_PROMOTE_MARGIN")
+        tol = knobs.value("PYCHEMKIN_FLYWHEEL_XCHECK_TOL")
+        with self._lock:
+            if self.n < int(min_n):
+                return "undecided"
+            if self.regressions > 0:
+                return "reject"
+            if (self._xcheck_n
+                    and self._xcheck_sum / self._xcheck_n > float(tol)):
+                return "reject"
+            if self.cand_hits - self.inc_hits > float(margin) * self.n:
+                return "promote"
+            return "reject"

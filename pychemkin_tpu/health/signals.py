@@ -157,8 +157,14 @@ def _eval_ratio_below(rule: Dict[str, Any], ring: SnapshotRing
     n = sum(view.delta(d) for d in den)
     ratio = (view.delta(num) / n) if n else None
     cond = n >= min_n and ratio is not None and ratio < threshold
-    return cond, {"ratio": _round(ratio), "n": n,
-                  "threshold": threshold, "min_n": min_n}
+    evidence = {"ratio": _round(ratio), "n": n,
+                "threshold": threshold, "min_n": min_n}
+    if "req_kind" in rule:
+        # kind-scoped rule instance (the per-kind SURROGATE_RETRAIN
+        # family): the scope rides the evidence, not a new top-level
+        # event field — the health.signal schema stays fixed
+        evidence["req_kind"] = rule["req_kind"]
+    return cond, evidence
 
 
 def _eval_gauge_below(rule: Dict[str, Any], ring: SnapshotRing
@@ -267,6 +273,27 @@ DEFAULT_RULES = (
      "kind": "backend_down", "fire_for": 1, "clear_for": 1},
     {"name": "ERROR_BUDGET_BURN", "severity": "page",
      "kind": "burn_rate"},
+    # kind-scoped instances of SURROGATE_RETRAIN first: an
+    # equilibrium-only miss storm must retrain the equilibrium model,
+    # not the ignition one. The fleet-wide rule follows as the coarse
+    # backstop (and the name's canonical entry for readers that key
+    # state by bare signal name); the per-kind series stay silent on
+    # idle streams (min_n gate).
+    {"name": "SURROGATE_RETRAIN", "severity": "warn",
+     "kind": "ratio_below", "req_kind": "ignition",
+     "num_counter": "serve.surrogate.hit.ignition",
+     "den_counters": ("serve.surrogate.hit.ignition",
+                      "serve.surrogate.fallback.ignition")},
+    {"name": "SURROGATE_RETRAIN", "severity": "warn",
+     "kind": "ratio_below", "req_kind": "equilibrium",
+     "num_counter": "serve.surrogate.hit.equilibrium",
+     "den_counters": ("serve.surrogate.hit.equilibrium",
+                      "serve.surrogate.fallback.equilibrium")},
+    {"name": "SURROGATE_RETRAIN", "severity": "warn",
+     "kind": "ratio_below", "req_kind": "psr",
+     "num_counter": "serve.surrogate.hit.psr",
+     "den_counters": ("serve.surrogate.hit.psr",
+                      "serve.surrogate.fallback.psr")},
     {"name": "SURROGATE_RETRAIN", "severity": "warn",
      "kind": "ratio_below"},
     {"name": "PREDICTOR_DECALIBRATED", "severity": "warn",
@@ -285,6 +312,16 @@ DEFAULT_RULES = (
 #: sparkline glyphs for the per-signal recent window (ok / firing)
 _SPARK_OK, _SPARK_FIRING = "·", "▇"
 RECENT_POLLS = 12
+
+
+def _rule_key(rule: Dict[str, Any]) -> str:
+    """The per-rule state key: the signal name, scoped by ``req_kind``
+    when present — so kind-scoped instances of one signal (the
+    per-kind SURROGATE_RETRAIN family) track independent hysteresis
+    instead of colliding on the name."""
+    req_kind = rule.get("req_kind")
+    return (f"{rule['name']}@{req_kind}" if req_kind
+            else str(rule["name"]))
 
 
 class _RuleState:
@@ -332,7 +369,11 @@ class HealthEngine:
                     f"{kind!r} (have {sorted(EVALUATORS)})")
         self._rec = recorder
         self._state: Dict[str, _RuleState] = {
-            r["name"]: _RuleState() for r in self.rules}
+            _rule_key(r): _RuleState() for r in self.rules}
+        if len(self._state) != len(self.rules):
+            raise ValueError(
+                "health rules must be unique per (name, req_kind): "
+                f"{[_rule_key(r) for r in self.rules]}")
         self._timeline: List[Dict[str, Any]] = []
         self._max_timeline = int(max_timeline)
 
@@ -380,7 +421,7 @@ class HealthEngine:
         if t is None:
             t = float(latest["t"]) if latest else time.time()
         for rule in self.rules:
-            st = self._state[rule["name"]]
+            st = self._state[_rule_key(rule)]
             try:
                 cond, evidence = EVALUATORS[rule["kind"]](rule, ring)
             except Exception as exc:  # noqa: BLE001 — degrade, never crash
@@ -419,7 +460,7 @@ class HealthEngine:
         entries carry)."""
         out = []
         for rule in self.rules:
-            st = self._state[rule["name"]]
+            st = self._state[_rule_key(rule)]
             entry = {
                 "signal": rule["name"],
                 "severity": rule.get("severity", "warn"),

@@ -604,6 +604,76 @@ register(
     "Max equilibrium Gibbs/element-balance residual of a predicted "
     "state the gate accepts.",
     _float("PYCHEMKIN_SURROGATE_EQ_RESID"), "surrogate")
+register(
+    "PYCHEMKIN_SURROGATE_PSR_RESID", "float", 0.05,
+    "Max tau-scaled PSR steady-state residual (rms over species + "
+    "scaled temperature) of a predicted reactor state the gate "
+    "accepts.",
+    _float("PYCHEMKIN_SURROGATE_PSR_RESID"), "surrogate")
+
+register(
+    "PYCHEMKIN_FLYWHEEL_DIR", "path", None,
+    "Root directory the surrogate flywheel banks miss shards, active-"
+    "learning shards, and promoted model generations into. Unset "
+    "disables miss banking.",
+    _str, "flywheel")
+register(
+    "PYCHEMKIN_FLYWHEEL_BANK_ROWS", "int", 256,
+    "Solver-verified miss rows buffered per request kind before the "
+    "bank flushes them as one signed dataset shard. Unparseable "
+    "values fall back.",
+    _int("PYCHEMKIN_FLYWHEEL_BANK_ROWS", on_invalid="default",
+         default=256, lo=1),
+    "flywheel")
+register(
+    "PYCHEMKIN_FLYWHEEL_BANK_MAX_SHARDS", "int", 64,
+    "Per-kind ring budget of banked miss shards; flushing past it "
+    "evicts the oldest shard. Unparseable values fall back.",
+    _int("PYCHEMKIN_FLYWHEEL_BANK_MAX_SHARDS", on_invalid="default",
+         default=64, lo=1),
+    "flywheel")
+register(
+    "PYCHEMKIN_FLYWHEEL_SHADOW_MIN_N", "int", 32,
+    "Live requests a candidate model must shadow before the flywheel "
+    "reaches a promote/reject verdict. Unparseable values fall back.",
+    _int("PYCHEMKIN_FLYWHEEL_SHADOW_MIN_N", on_invalid="default",
+         default=32, lo=1),
+    "flywheel")
+register(
+    "PYCHEMKIN_FLYWHEEL_PROMOTE_MARGIN", "float", 0.0,
+    "Shadow hit-rate margin a candidate must beat the incumbent by "
+    "(in absolute rate) to be promoted. Unparseable values fall back.",
+    _float("PYCHEMKIN_FLYWHEEL_PROMOTE_MARGIN", on_invalid="default",
+           default=0.0),
+    "flywheel")
+register(
+    "PYCHEMKIN_FLYWHEEL_ACTIVE_N", "int", 96,
+    "Active-learning labels generated per retrain round (sampled over "
+    "the banked miss region, labeled through the checkpointed sweep "
+    "driver). Unparseable values fall back.",
+    _int("PYCHEMKIN_FLYWHEEL_ACTIVE_N", on_invalid="default",
+         default=96, lo=2),
+    "flywheel")
+register(
+    "PYCHEMKIN_FLYWHEEL_XCHECK_TOL", "float", 0.02,
+    "Shadow cross-check tolerance: on lanes where BOTH incumbent and "
+    "candidate claim a gate-verified answer, the mean per-lane "
+    "disagreement of those answers (model target space: log10 s for "
+    "ignition, ln mole fraction / scaled T for equilibrium and psr) "
+    "must stay below this or the candidate is rejected — the backstop "
+    "that catches a coherently-wrong model whose ensemble agrees with "
+    "itself (and so passes the disagreement gate) but contradicts the "
+    "trusted incumbent. Unparseable values fall back.",
+    _float("PYCHEMKIN_FLYWHEEL_XCHECK_TOL", on_invalid="default",
+           default=0.02, clamp=(1e-6, 1e6)),
+    "flywheel")
+register(
+    "PYCHEMKIN_FLYWHEEL_POLL_S", "float", 2.0,
+    "Poll interval (s) of the flywheel daemon's reconciliation loop. "
+    "Unparseable values fall back.",
+    _float("PYCHEMKIN_FLYWHEEL_POLL_S", on_invalid="default",
+           default=2.0, clamp=(0.01, 3600.0)),
+    "flywheel")
 
 
 # -- README table -----------------------------------------------------------

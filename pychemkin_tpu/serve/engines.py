@@ -700,14 +700,31 @@ class SurrogateEngine(Engine):
       it to ITS engine instance at build time (works over a transport
       backend's wire config; see ``ChemServer.configure_engine``).
     - gate thresholds (``domain_margin``/``ign_disagree_max``/
-      ``ign_t_end_frac``/``eq_resid_max``) override the
-      ``PYCHEMKIN_SURROGATE_*`` env knobs.
+      ``ign_t_end_frac``/``eq_resid_max``/``psr_resid_max``) override
+      the ``PYCHEMKIN_SURROGATE_*`` env knobs.
+    - ``bank=`` an optional miss bank
+      (:class:`pychemkin_tpu.flywheel.bank.MissBank`-shaped, duck-
+      typed): every rung-1 fallback hands it the payload plus the
+      solver-verified answer — the flywheel's free-label capture. A
+      bank failure increments ``flywheel.errors`` and never breaks the
+      rescue.
 
-    Telemetry: ``serve.surrogate.hit`` / ``.miss`` counters at solve,
-    ``serve.surrogate.fallback`` when rung 1 re-solves a miss, a
-    ``serve.surrogate.residual`` histogram (gate residual /
-    ensemble disagreement per lane), and one ``serve.surrogate`` trace
-    span per traced request carrying ``verified``/``residual``.
+    **Flywheel integration.** The trained weights are NOT baked into
+    the compiled program: the jitted batch function takes the model's
+    param pytree (:func:`pychemkin_tpu.surrogate.model.model_params`)
+    as a runtime argument, so (a) :meth:`install_model` atomically
+    swaps a same-architecture candidate in with ZERO new XLA compiles
+    on the hot path, and (b) :meth:`predict_with` runs a shadow
+    candidate's weights through the SAME compiled program against live
+    traffic. ``model_gen`` (the model's ``meta["model_gen"]``, 0 for a
+    hand-trained gen-0) rides every ``serve.surrogate`` span.
+
+    Telemetry: ``serve.surrogate.hit`` / ``.miss`` counters (global +
+    per-base-kind family) at solve, ``serve.surrogate.fallback`` when
+    rung 1 re-solves a miss, a ``serve.surrogate.residual`` histogram
+    (gate residual / ensemble disagreement per lane), and one
+    ``serve.surrogate`` trace span per traced request carrying
+    ``verified``/``residual``/``model_gen``.
     """
 
     base_kind = "?"
@@ -720,25 +737,20 @@ class SurrogateEngine(Engine):
     def __init__(self, mech, recorder=None, *, model=None,
                  model_path=None, base_engine=None, base_config=None,
                  domain_margin=None, ign_disagree_max=None,
-                 ign_t_end_frac=None, eq_resid_max=None):
+                 ign_t_end_frac=None, eq_resid_max=None,
+                 psr_resid_max=None, bank=None):
         super().__init__(mech, recorder)
         if model is None:
             if model_path is None:
                 raise ValueError(
                     f"{self.kind}: need model= or model_path=")
             model = sg_model.load_model(model_path)
-        if model.kind != self.base_kind:
-            raise ValueError(
-                f"{self.kind}: model was trained for kind "
-                f"{model.kind!r}, this engine wraps {self.base_kind!r}")
-        mech_sig = sg_dataset.mech_signature(mech)
-        if model.mech_sig != mech_sig:
-            raise sg_dataset.DatasetSignatureError(
-                f"{self.kind}: model mech_sig {model.mech_sig[:12]}… "
-                f"does not match the serving mechanism "
-                f"({mech_sig[:12]}…) — it was trained against "
-                "different chemistry; retrain before serving")
+        self._mech_sig = sg_dataset.mech_signature(mech)
+        self._check_model(model)
         self.model = model
+        self._params = sg_model.model_params(model)
+        self._bank = bank
+        self._shadow = None
         if base_engine is not None:
             if base_engine.kind != self.base_kind:
                 raise ValueError(
@@ -755,11 +767,84 @@ class SurrogateEngine(Engine):
             domain_margin=domain_margin,
             ign_disagree_max=ign_disagree_max,
             ign_t_end_frac=ign_t_end_frac,
-            eq_resid_max=eq_resid_max)
+            eq_resid_max=eq_resid_max,
+            psr_resid_max=psr_resid_max)
+
+    def _check_model(self, model) -> None:
+        """The attach-time trust checks — shared by the constructor and
+        :meth:`install_model` so a flywheel promotion can never relax
+        them. Subclasses extend (the equilibrium engine pins the
+        constraint option)."""
+        if model.kind != self.base_kind:
+            raise ValueError(
+                f"{self.kind}: model was trained for kind "
+                f"{model.kind!r}, this engine wraps {self.base_kind!r}")
+        if model.mech_sig != self._mech_sig:
+            raise sg_dataset.DatasetSignatureError(
+                f"{self.kind}: model mech_sig {model.mech_sig[:12]}… "
+                f"does not match the serving mechanism "
+                f"({self._mech_sig[:12]}…) — it was trained against "
+                "different chemistry; retrain before serving")
 
     def _config_extras(self):
         return {"base_kind": self.base_kind,
                 "model_sig": str(self.model.mech_sig)[:12]}
+
+    # -- the flywheel surface --------------------------------------------
+    @property
+    def model_gen(self) -> int:
+        """The serving model's generation (0 = hand-trained gen-0;
+        each flywheel promotion installs gen+1)."""
+        return int(self.model.meta.get("model_gen", 0))
+
+    def install_model(self, model) -> int:
+        """Atomically swap the serving model (a flywheel promotion).
+
+        Runs the same kind/mechanism-signature trust checks as the
+        constructor, then replaces the param pytree the compiled batch
+        programs read per dispatch — one Python attribute assignment,
+        so in-flight batches finish on the old weights and the next
+        dispatch reads the new ones. A candidate with the incumbent's
+        architecture reuses every compiled program (zero new XLA
+        compiles); a changed architecture retraces visibly into
+        ``serve.compiles.<kind>``. Returns the installed model's
+        generation."""
+        self._check_model(model)
+        with self._cache_lock:
+            self.model = model
+            self._params = sg_model.model_params(model)
+        return self.model_gen
+
+    def attach_shadow(self, shadow) -> None:
+        """Attach a shadow evaluator (duck-typed:
+        ``observe_batch(engine, key, payloads, bucket, out)``): every
+        accounted live batch is replayed through the candidate's
+        weights via :meth:`predict_with`. The shadow predicts and
+        gates but NEVER answers."""
+        self._shadow = shadow
+
+    def detach_shadow(self) -> None:
+        self._shadow = None
+
+    def predict_with(self, params, payloads, bucket, key):
+        """Run the already-compiled batch program with ``params``
+        (a candidate's :func:`~pychemkin_tpu.surrogate.model
+        .model_params` pytree) over normalized ``payloads`` — the
+        shadow-evaluation primitive. Same architecture = same compiled
+        program; returns the result dict as numpy at bucket shape."""
+        args = self.stack(payloads, bucket)
+        inner = Engine._batch_fn(self, key)
+        out = jax.block_until_ready(inner(params, *args))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def answer_array(self, out, n):
+        """The physical answer of ``out``'s first ``n`` lanes as an
+        ``(n, d)`` float array in the model's TARGET space (log10 s
+        for ignition, ln mole fraction / scaled T for equilibrium and
+        psr) — the shadow cross-check surface: two models that both
+        claim a gate-verified answer for the same lane must agree
+        here, or one of them is coherently wrong."""
+        raise NotImplementedError
 
     # -- payload: the surrogate speaks the base engine's schema ----------
     def normalize(self, payload):
@@ -772,6 +857,18 @@ class SurrogateEngine(Engine):
         return self.base.dummy_payload()
 
     # -- batched predict + verify ----------------------------------------
+    def _batch_fn(self, key):
+        # the jitted inner takes the model's param pytree as its first
+        # RUNTIME argument (see the class docstring); this thin wrapper
+        # binds whatever params are installed at CALL time, so a
+        # promotion swaps weights without touching the jit cache
+        inner = Engine._batch_fn(self, key)
+
+        def call(*cols):
+            return inner(self._params, *cols)
+
+        return call
+
     def solve(self, payloads, bucket, key):
         out, solve_s = super().solve(payloads, bucket, key)
         if self._warming:
@@ -780,17 +877,30 @@ class SurrogateEngine(Engine):
             # acceptance contract sums against live traffic
             return out, solve_s
         # hit/miss accounting over the REAL lanes only (padding lanes
-        # are edge duplicates, not requests)
+        # are edge duplicates, not requests); the per-base-kind family
+        # feeds the kind-scoped SURROGATE_RETRAIN rules and chemtop's
+        # flywheel panel
         ver = np.asarray(out["verified"][:len(payloads)], bool)
         hits = int(ver.sum())
         if hits:
             self._rec.inc("serve.surrogate.hit", hits)
+            self._rec.inc(f"serve.surrogate.hit.{self.base_kind}", hits)
         if len(payloads) - hits:
             self._rec.inc("serve.surrogate.miss", len(payloads) - hits)
+            self._rec.inc(f"serve.surrogate.miss.{self.base_kind}",
+                          len(payloads) - hits)
         for r in np.asarray(out["residual"][:len(payloads)],
                             np.float64):
             if np.isfinite(r):
                 self._rec.observe("serve.surrogate.residual", float(r))
+        shadow = self._shadow
+        if shadow is not None:
+            # candidate rides the same live batch, answers nothing; a
+            # shadow failure must never take down serving
+            try:
+                shadow.observe_batch(self, key, payloads, bucket, out)
+            except Exception:
+                self._rec.inc("flywheel.errors")
         return out, solve_s
 
     def span_fields(self, out, i):
@@ -798,7 +908,8 @@ class SurrogateEngine(Engine):
         # non-finite residuals (a far-out-of-domain extrapolation) ride
         # as null: the JSONL sink must stay strict-JSON parseable
         return {"verified": bool(out["verified"][i]),
-                "residual": round(r, 6) if np.isfinite(r) else None}
+                "residual": round(r, 6) if np.isfinite(r) else None,
+                "model_gen": self.model_gen}
 
     def value_at(self, out, i):
         val = self.base.value_at(out, i)
@@ -825,8 +936,27 @@ class SurrogateEngine(Engine):
             # kind, bucket=1) runs, so results bit-match it
             out, _ = self.base.solve([payload], 1, key)
             self._rec.inc("serve.surrogate.fallback")
-            return out, int(out["status"][0])
+            self._rec.inc(f"serve.surrogate.fallback.{self.base_kind}")
+            status = int(out["status"][0])
+            bank = self._bank
+            if bank is not None:
+                # the flywheel's free label: this payload just got a
+                # solver-verified answer exactly where the model is
+                # weak. Banking must never break the rescue.
+                try:
+                    bank.note_miss(self.base_kind, payload,
+                                   self.base.value_at(out, 0),
+                                   status=status)
+                except Exception:
+                    self._rec.inc("flywheel.errors")
+            return out, status
         return self.base.rescue_one(payload, key, level - 1, elem_id)
+
+
+#: composition floor of the shadow cross-check's ln-space answer
+#: comparison — well above the model's X_FLOOR so trace species don't
+#: register as disagreement between two honest models
+_XCHECK_FLOOR = 1e-6
 
 
 class IgnitionSurrogateEngine(SurrogateEngine):
@@ -838,14 +968,17 @@ class IgnitionSurrogateEngine(SurrogateEngine):
     base_kind = "ignition"
 
     def _make_batch_fn(self, key):
-        model, gate = self.model, self.gate
+        gate = self.gate
 
-        def fn(T0s, P0s, Y0s, t_ends):
+        def fn(params, T0s, P0s, Y0s, t_ends):
             self._count_trace()
+            members, norm, lo, hi = params
             feats = sg_model.features(T0s, P0s, Y0s)
-            preds = sg_model.predict(model, feats)[..., 0]   # [M, B]
+            preds = sg_model.predict_params(
+                members, norm, feats)[..., 0]                # [M, B]
             ok, disagree = sg_verify.ignition_gate(
-                model, feats, preds, t_ends, gate)
+                sg_verify.DomainBox(lo, hi), feats, preds, t_ends,
+                gate)
             t_pred = 10.0 ** jnp.mean(preds, axis=0)
             times = jnp.where(ok, t_pred, jnp.nan)
             status = jnp.where(
@@ -855,6 +988,10 @@ class IgnitionSurrogateEngine(SurrogateEngine):
                     "verified": ok, "residual": disagree}
 
         return fn
+
+    def answer_array(self, out, n):
+        t = np.asarray(out["times"][:n], np.float64)
+        return np.log10(np.maximum(t, 1e-300))[:, None]
 
 
 class EquilibriumSurrogateEngine(SurrogateEngine):
@@ -870,15 +1007,25 @@ class EquilibriumSurrogateEngine(SurrogateEngine):
     def __init__(self, mech, recorder=None, **kwargs):
         super().__init__(mech, recorder, **kwargs)
         self.option = int(self.model.meta.get("option", 1))
-        if self.option != 1:
+
+    def _check_model(self, model):
+        super()._check_model(model)
+        option = int(model.meta.get("option", 1))
+        if option != 1:
             # the batch fn passes the request's (T, P) through as the
             # equilibrium state and the Gibbs gate evaluates at that
             # (T, P) — only valid for the fixed-(T,P) constraint pair.
             # Other options need a predicted (T, P) head first.
             raise ValueError(
                 f"{self.kind}: model was labeled under equilibrium "
-                f"option {self.option}; only option 1 (fixed T,P) is "
+                f"option {option}; only option 1 (fixed T,P) is "
                 "currently servable")
+        pinned = getattr(self, "option", None)
+        if pinned is not None and option != pinned:
+            raise ValueError(
+                f"{self.kind}: candidate model was labeled under "
+                f"equilibrium option {option}, the serving engine "
+                f"pins option {pinned}")
 
     def normalize(self, payload):
         norm = super().normalize(payload)
@@ -890,21 +1037,24 @@ class EquilibriumSurrogateEngine(SurrogateEngine):
         return norm
 
     def _make_batch_fn(self, key):
-        model, gate, mech = self.model, self.gate, self.mech
+        gate, mech = self.gate, self.mech
 
-        def fn(Ts, Ps, Ys):
+        def fn(params, Ts, Ps, Ys):
             self._count_trace()
+            members, norm, lo, hi = params
             Yn = Ys / jnp.maximum(jnp.sum(Ys, axis=1, keepdims=True),
                                   1e-30)
             feats = sg_model.features(Ts, Ps, Yn)
-            ln_x = jnp.mean(sg_model.predict(model, feats),
+            ln_x = jnp.mean(sg_model.predict_params(members, norm,
+                                                    feats),
                             axis=0)                        # [B, KK]
             x = jnp.exp(ln_x)
             X = x / jnp.maximum(jnp.sum(x, axis=1, keepdims=True),
                                 1e-30)
             b = jax.vmap(lambda Y: eq_ops.element_moles(mech, Y))(Yn)
             ok, resid = sg_verify.equilibrium_gate(
-                mech, model, feats, Ts, Ps, X, b, gate)
+                mech, sg_verify.DomainBox(lo, hi), feats, Ts, Ps, X,
+                b, gate)
             wbar = jnp.maximum(X @ mech.wt, 1e-30)
             Y_eq = X * mech.wt / wbar[:, None]
             h = jax.vmap(lambda T, Y: thermo.mixture_enthalpy_mass(
@@ -923,6 +1073,68 @@ class EquilibriumSurrogateEngine(SurrogateEngine):
                     "verified": ok, "residual": resid}
 
         return fn
+
+    def answer_array(self, out, n):
+        # floored well above X_FLOOR: trace species wobble freely in
+        # ln space without two honest models "disagreeing" there
+        X = np.asarray(out["X"][:n], np.float64)
+        return np.log(np.maximum(X, _XCHECK_FLOOR))
+
+
+class PSRSurrogateEngine(SurrogateEngine):
+    """Learned PSR steady state over the :class:`PSREngine` payload —
+    the third hot kind (the batched-PSR workload of arXiv:2005.11468),
+    predicting the full reactor exit state ``(T, Y)`` from
+    ``(tau, P, inlet)``. Gate: in-domain bound + the reactor's own
+    tau-scaled steady-state residual evaluated AT the predicted state
+    (:func:`pychemkin_tpu.surrogate.verify.psr_gate`) — one RHS
+    evaluation against the real solver's damped Newton + pseudo-
+    transient march. Fallback rung 1 is the real PSR Newton at bucket
+    1 with the same bit-match contract as every surrogate kind."""
+
+    kind = "surrogate_psr"
+    base_kind = "psr"
+
+    def _make_batch_fn(self, key):
+        gate, mech = self.gate, self.mech
+        energy = self.base.energy
+
+        def fn(params, taus, Ps, Y_ins, h_ins, T_gs, Y_gs):
+            self._count_trace()
+            members, norm, lo, hi = params
+            feats = sg_model.psr_features(taus, Ps, Y_ins, h_ins)
+            mean = jnp.mean(sg_model.predict_params(members, norm,
+                                                    feats),
+                            axis=0)                    # [B, KK+1]
+            T_pred = mean[:, 0] * sg_model.PSR_T_SCALE
+            y = jnp.exp(mean[:, 1:])
+            Y_pred = jnp.clip(y, 0.0, 1.0)
+            Y_pred = Y_pred / jnp.maximum(
+                jnp.sum(Y_pred, axis=1, keepdims=True), 1e-30)
+            ok, resid = sg_verify.psr_gate(
+                mech, sg_verify.DomainBox(lo, hi), feats, taus, Ps,
+                Y_ins, h_ins, T_pred, Y_pred, gate, energy=energy)
+
+            def mask(a):
+                # unverified lanes must carry NO prediction: NaN, not
+                # a plausible-looking wrong answer
+                return jnp.where(ok if a.ndim == 1 else ok[:, None],
+                                 a, jnp.nan)
+
+            status = jnp.where(ok, jnp.int32(SolveStatus.OK),
+                               jnp.int32(SolveStatus.SURROGATE_MISS))
+            return {"T": mask(T_pred), "Y": mask(Y_pred),
+                    "residual": resid, "converged": ok,
+                    "status": status, "verified": ok}
+
+        return fn
+
+    def answer_array(self, out, n):
+        T = (np.asarray(out["T"][:n], np.float64)
+             / sg_model.PSR_T_SCALE)
+        Y = np.log(np.maximum(np.asarray(out["Y"][:n], np.float64),
+                              _XCHECK_FLOOR))
+        return np.concatenate([T[:, None], Y], axis=1)
 
 
 class DuplicateEngineKindError(ValueError):
@@ -971,5 +1183,6 @@ def zero_config_kinds() -> Tuple[str, ...]:
 
 
 for _cls in (IgnitionEngine, EquilibriumEngine, PSREngine,
-             IgnitionSurrogateEngine, EquilibriumSurrogateEngine):
+             IgnitionSurrogateEngine, EquilibriumSurrogateEngine,
+             PSRSurrogateEngine):
     register_engine(_cls.kind, _cls)

@@ -134,6 +134,49 @@ def stiffness_mix_sampler(mech, kind: str = "ignition", *,
     return sampler, classify
 
 
+#: initially-out-of-domain draw ranges per base kind, each shifted off
+#: ONE axis of the default trained box
+#: (:class:`pychemkin_tpu.surrogate.dataset.SampleBox`: T 1250–1400 K,
+#: P 0.9–1.2 MPa, tau 0.3–3 ms) so a gen-0 surrogate misses — the
+#: flywheel soak's traffic shape: every fallback is a banked label in
+#: exactly the region the next retrain must cover
+OOD_MIX_T = (1410.0, 1520.0)       # ignition: hotter than trained
+OOD_MIX_EQ_T = (1450.0, 1800.0)    # equilibrium: above trained box
+OOD_MIX_TAU = (6.0e-3, 2.4e-2)     # psr: longer residence times
+
+
+def ood_mix_sampler(mech, kind: str, *, P=1.01325e6, t_end=6e-4):
+    """An initially out-of-domain sampler for one surrogate-family
+    kind: payload draws sit OUTSIDE the default trained box on one
+    axis (temperature for ignition/equilibrium, residence time for
+    psr) while composition stays on the default fuel/air recipe — so
+    round-0 traffic is all fallback, the misses bank, and the
+    round-over-round hit-rate climb is attributable to the flywheel,
+    not to a drifting stream."""
+    from ..surrogate.dataset import phi_composition
+
+    Y0 = phi_composition(mech, 1.0)[0]
+    base = (kind[len(SURROGATE_PREFIX):]
+            if kind.startswith(SURROGATE_PREFIX) else kind)
+    if base == "ignition":
+        def s(i, rng, _k=kind):
+            return _k, dict(T0=float(rng.uniform(*OOD_MIX_T)), P0=P,
+                            Y0=Y0, t_end=t_end)
+    elif base == "equilibrium":
+        def s(i, rng, _k=kind):
+            return _k, dict(T=float(rng.uniform(*OOD_MIX_EQ_T)), P=P,
+                            Y=Y0, option=1)
+    elif base == "psr":
+        def s(i, rng, _k=kind):
+            ln = rng.uniform(np.log(OOD_MIX_TAU[0]),
+                             np.log(OOD_MIX_TAU[1]))
+            return _k, dict(tau=float(np.exp(ln)), P=P, Y_in=Y0,
+                            T_in=300.0, T_guess=1800.0)
+    else:
+        raise ValueError(f"no ood-mix sampler for kind {kind!r}")
+    return s
+
+
 def run_load(server, samplers: Sequence[Sampler], *,
              rate_hz: float, n_requests: int,
              rng: np.random.Generator,

@@ -201,6 +201,47 @@ class ChemServer:
                     "before first use")
             self._engine_config[kind] = dict(ctor_kwargs)
 
+    def promote_model(self, kind: str, model) -> int:
+        """Atomically swap the trained model behind a BUILT surrogate
+        engine (the flywheel's promotion fan-out endpoint).
+
+        Unlike :meth:`configure_engine` — which refuses already-built
+        kinds because ctor kwargs cannot retroactively apply — this is
+        the one sanctioned live mutation: the engine re-runs its
+        attach-time trust checks (kind, mech signature, pinned
+        equilibrium option) and swaps the param pytree its compiled
+        programs read per dispatch. In-flight batches finish on the
+        old weights; a same-architecture candidate adds zero XLA
+        compiles. Returns the installed ``model_gen``."""
+        with self._lock:
+            eng = self._engines.get(kind)
+        if eng is None:
+            raise ValueError(
+                f"engine {kind!r} is not built; configure_engine + "
+                "warmup it before promoting models into it")
+        install = getattr(eng, "install_model", None)
+        if install is None:
+            raise ValueError(
+                f"engine {kind!r} does not serve a swappable model")
+        return install(model)
+
+    def flywheel_state(self) -> Dict[str, Any]:
+        """The flywheel facts a fleet scraper needs beyond counters:
+        incumbent ``model_gen`` per surrogate base kind and the most
+        recent round verdict (from the recorder's event tail) —
+        chemtop's flywheel panel merges these across backends."""
+        with self._lock:
+            gens = {eng.base_kind: eng.model_gen
+                    for eng in self._engines.values()
+                    if hasattr(eng, "model_gen")}
+        last = self._rec.last_event("flywheel.round")
+        return {"model_gen": gens,
+                "last_round": ({"t": last.get("t"),
+                                "req_kind": last.get("req_kind"),
+                                "verdict": last.get("verdict"),
+                                "model_gen": last.get("model_gen")}
+                               if last else None)}
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ChemServer":
         # threads are created AND started before _started flips, all

@@ -514,6 +514,11 @@ class TransportServer:
                 # adaptive-ladder view chemtop renders per backend
                 "schedule": {mech: srv.schedule_state()
                              for mech, srv
+                             in sorted(self._servers.items())},
+                # surrogate-flywheel state (incumbent model_gen per
+                # kind, last round verdict) for chemtop's panel
+                "flywheel": {mech: srv.flywheel_state()
+                             for mech, srv
                              in sorted(self._servers.items())}}
 
     def _overload_reply(self, rid, *, scope: str, queue_depth: int,

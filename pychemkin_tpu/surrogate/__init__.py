@@ -39,27 +39,36 @@ from .dataset import (
     save_shard,
 )
 from .model import (
+    PSR_T_SCALE,
     SurrogateModel,
     features,
     init_mlp,
     load_model,
     mlp_apply,
+    model_params,
     predict,
+    predict_params,
+    psr_features,
     save_model,
 )
 from .train import fit_surrogate, train_member, training_curve_artifact
 from .verify import (
+    DomainBox,
     GateConfig,
     equilibrium_gate,
     equilibrium_residual,
     gate_config,
     ignition_gate,
     in_domain,
+    psr_gate,
+    psr_residual,
 )
 
 __all__ = [
     "DatasetSignatureError",
+    "DomainBox",
     "GateConfig",
+    "PSR_T_SCALE",
     "SampleBox",
     "SurrogateModel",
     "equilibrium_gate",
@@ -76,9 +85,14 @@ __all__ = [
     "load_shards",
     "mech_signature",
     "mlp_apply",
+    "model_params",
     "phi_composition",
     "predict",
+    "predict_params",
     "problem_signature",
+    "psr_features",
+    "psr_gate",
+    "psr_residual",
     "sample_inputs",
     "save_model",
     "save_shard",

@@ -47,14 +47,14 @@ from ..resilience import checkpoint
 from ..resilience.driver import run_vmapped_sweep_job
 from .. import telemetry
 from ..resilience.status import SolveStatus, status_counts
-from .model import X_FLOOR, features
+from .model import PSR_T_SCALE, X_FLOOR, features, psr_features
 
 #: shard-file layout version; an old shard REFUSES to load (unlike a
 #: checkpoint, a training shard is an input, not an optimization)
 SHARD_VERSION = 1
 
 #: the request kinds a dataset can label
-KINDS = ("ignition", "equilibrium")
+KINDS = ("ignition", "equilibrium", "psr")
 
 #: per-kind default solver configuration for labeling — the serving
 #: protocol's knobs (tight enough to trust, cheap enough to sweep)
@@ -62,6 +62,7 @@ DEFAULT_SOLVER_KWARGS = {
     "ignition": {"rtol": 1e-6, "atol": 1e-10,
                  "max_steps_per_segment": 4000},
     "equilibrium": {"option": 1, "n_iter": 80},
+    "psr": {"energy": "ENRG", "n_newton": 50, "n_pseudo": 100},
 }
 
 
@@ -79,11 +80,14 @@ class SampleBox(NamedTuple):
     h2o2/grisyn fixture family, CH4/air when the mechanism carries
     CH4), so the box stays low-dimensional while the feature map sees
     full log-concentration inputs. ``t_end`` is the ignition
-    integration horizon (ignition kind only)."""
+    integration horizon (ignition kind only); ``tau`` the sampled
+    residence-time range (psr kind only, where ``T`` is the INLET
+    temperature)."""
     T: Tuple[float, float] = (1250.0, 1400.0)
     P: Tuple[float, float] = (0.9e6, 1.2e6)
     phi: Tuple[float, float] = (0.85, 1.15)
     t_end: float = 4e-4
+    tau: Tuple[float, float] = (3e-4, 3e-3)
 
 
 def phi_composition(mech, phi, fuel: Optional[str] = None) -> np.ndarray:
@@ -125,9 +129,14 @@ def sample_inputs(mech, box: SampleBox, n: int,
     T = rng.uniform(*box.T, size=n)
     P = np.exp(rng.uniform(np.log(box.P[0]), np.log(box.P[1]), size=n))
     phi = rng.uniform(*box.phi, size=n)
+    # tau draws LAST so the (T, P, phi) sequences of every pre-psr
+    # (box, n, seed) stay bit-identical to what they were before the
+    # psr kind existed — banked checkpoints keep resuming
+    tau = np.exp(rng.uniform(np.log(box.tau[0]), np.log(box.tau[1]),
+                             size=n))
     return {"T": T, "P": P, "phi": phi,
             "Y": phi_composition(mech, phi),
-            "t_end": np.full(n, box.t_end)}
+            "t_end": np.full(n, box.t_end), "tau": tau}
 
 
 def mech_signature(mech) -> str:
@@ -189,6 +198,31 @@ def _equilibrium_index_solve(mech, inputs, kw):
     return index_solve, ("X_eq", "residual", "status")
 
 
+def _psr_index_solve(mech, inputs, kw):
+    from ..ops import psr as psr_ops
+    from ..ops import thermo
+
+    energy = str(kw.pop("energy", "ENRG"))
+    fn = jax.jit(jax.vmap(lambda tau, P, Y, h: psr_ops.solve_psr(
+        mech, psr_ops.MODE_TAU, energy, P=P, Y_in=Y, h_in=h,
+        T_guess=1800.0, Y_guess=Y, tau=tau, **kw)))
+    h_fn = jax.jit(jax.vmap(lambda T, Y: thermo.mixture_enthalpy_mass(
+        mech, T, Y)))
+
+    def index_solve(idx):
+        Y = jnp.asarray(inputs["Y"][idx])
+        h = h_fn(jnp.asarray(inputs["T"][idx]), Y)
+        sol = fn(jnp.asarray(inputs["tau"][idx]),
+                 jnp.asarray(inputs["P"][idx]), Y, h)
+        return {"T_out": np.asarray(sol.T), "Y_out": np.asarray(sol.Y),
+                "h_in": np.asarray(h),
+                "converged": np.asarray(sol.converged),
+                "status": np.asarray(sol.status)}
+
+    return index_solve, ("T_out", "Y_out", "h_in", "converged",
+                         "status")
+
+
 def generate_dataset(mech, kind: str, *, n: int, seed: int = 0,
                      box: Optional[SampleBox] = None,
                      out_path: Optional[str] = None,
@@ -221,8 +255,9 @@ def generate_dataset(mech, kind: str, *, n: int, seed: int = 0,
     # into the trained model's meta (the serve engine refuses requests
     # for any other option)
     option = int(kw.get("option", 1)) if kind == "equilibrium" else -1
-    make = (_ignition_index_solve if kind == "ignition"
-            else _equilibrium_index_solve)
+    make = {"ignition": _ignition_index_solve,
+            "equilibrium": _equilibrium_index_solve,
+            "psr": _psr_index_solve}[kind]
     index_solve, result_keys = make(mech, inputs, kw)
     results, report = run_vmapped_sweep_job(
         index_solve, int(n), chunk_size=chunk_size,
@@ -238,8 +273,9 @@ def generate_dataset(mech, kind: str, *, n: int, seed: int = 0,
 
 def _build_shard(mech, kind, box, inputs, results, sig,
                  option: int = -1) -> Dict:
-    feats = np.asarray(features(inputs["T"], inputs["P"], inputs["Y"]))
     if kind == "ignition":
+        feats = np.asarray(features(inputs["T"], inputs["P"],
+                                    inputs["Y"]))
         t = np.asarray(results["time_s"], np.float64)
         valid = (np.asarray(results["ok"], bool)
                  & (np.asarray(results["status"])
@@ -250,7 +286,26 @@ def _build_shard(mech, kind, box, inputs, results, sig,
         # excludes from every consumer
         y = np.where(valid, np.log10(np.where(valid, t, 1.0)),
                      0.0)[:, None]
+    elif kind == "psr":
+        T_out = np.asarray(results["T_out"], np.float64)
+        Y_out = np.asarray(results["Y_out"], np.float64)
+        h_in = np.asarray(results["h_in"], np.float64)
+        feats = np.asarray(psr_features(
+            inputs["tau"], inputs["P"], inputs["Y"], h_in))
+        valid = ((np.asarray(results["status"])
+                  == int(SolveStatus.OK))
+                 & np.asarray(results["converged"], bool)
+                 & np.isfinite(T_out) & (T_out > 0.0)
+                 & np.all(np.isfinite(Y_out), axis=1))
+        # reactor-state targets: scaled exit temperature next to
+        # log-mass-fractions (same decades-spanning treatment as the
+        # equilibrium targets)
+        y = np.concatenate(
+            [(T_out / PSR_T_SCALE)[:, None],
+             np.log(np.maximum(Y_out, X_FLOOR))], axis=1)
     else:
+        feats = np.asarray(features(inputs["T"], inputs["P"],
+                                    inputs["Y"]))
         X_eq = np.asarray(results["X_eq"], np.float64)
         valid = (np.asarray(results["status"])
                  == int(SolveStatus.OK)) & np.all(
@@ -258,12 +313,25 @@ def _build_shard(mech, kind, box, inputs, results, sig,
         y = np.log(np.maximum(X_eq, X_FLOOR))
     # the trained-domain box in FEATURE space: what verify.in_domain
     # gates against — evaluated at the SAMPLED box's corners (every
-    # feature is monotone in each of T, P, phi), not the draw's
-    # min/max, so a small shard doesn't understate its coverage
-    cT, cP, cphi = (g.ravel() for g in np.meshgrid(
-        np.asarray(box.T), np.asarray(box.P), np.asarray(box.phi)))
-    corner_feats = np.asarray(
-        features(cT, cP, phi_composition(mech, cphi)))
+    # feature is monotone in each of T, P, phi — and tau, h_in for the
+    # psr map), not the draw's min/max, so a small shard doesn't
+    # understate its coverage
+    if kind == "psr":
+        from ..ops import thermo
+
+        ctau, cP, cT, cphi = (g.ravel() for g in np.meshgrid(
+            np.asarray(box.tau), np.asarray(box.P),
+            np.asarray(box.T), np.asarray(box.phi)))
+        cY = phi_composition(mech, cphi)
+        ch = np.asarray(jax.vmap(
+            lambda t, yy: thermo.mixture_enthalpy_mass(mech, t, yy))(
+                jnp.asarray(cT), jnp.asarray(cY)))
+        corner_feats = np.asarray(psr_features(ctau, cP, cY, ch))
+    else:
+        cT, cP, cphi = (g.ravel() for g in np.meshgrid(
+            np.asarray(box.T), np.asarray(box.P), np.asarray(box.phi)))
+        corner_feats = np.asarray(
+            features(cT, cP, phi_composition(mech, cphi)))
     lo = corner_feats.min(axis=0)
     hi = corner_feats.max(axis=0)
     return {

@@ -66,6 +66,11 @@ class SurrogateModel(NamedTuple):
     meta: Dict[str, Any]            # extra static facts (option, t_end…)
 
 
+#: temperature scale of the PSR surrogate's first target component
+#: (T/PSR_T_SCALE keeps it O(1) next to the ln-mass-fraction columns)
+PSR_T_SCALE = 1.0e3
+
+
 def features(T, P, Y):
     """The shared surrogate feature map for (T, P, composition) boxes:
     ``[1000/T, log10 P, log10 Y_k...]`` — Arrhenius-like inverse
@@ -78,6 +83,24 @@ def features(T, P, Y):
     Y = jnp.asarray(Y, jnp.float64)
     cols = [1000.0 / T, jnp.log10(P)]
     logY = jnp.log10(jnp.maximum(Y, Y_FLOOR))
+    return jnp.concatenate(
+        [jnp.stack(cols, axis=-1), logY], axis=-1)
+
+
+def psr_features(tau, P, Y_in, h_in):
+    """Feature map of the PSR-state surrogate: ``[log10 tau, log10 P,
+    1e-10 * h_in, log10 Y_in_k...]``. Residence time and pressure span
+    decades (log); inlet enthalpy is near-linear in inlet temperature
+    so a fixed rescale keeps it O(1); inlet composition rides the same
+    log-concentration representation as :func:`features`. Batched over
+    the leading axis; ``Y_in`` is ``[..., KK]`` mass fractions."""
+    tau = jnp.asarray(tau, jnp.float64)
+    P = jnp.asarray(P, jnp.float64)
+    h_in = jnp.asarray(h_in, jnp.float64)
+    Y_in = jnp.asarray(Y_in, jnp.float64)
+    cols = [jnp.log10(jnp.maximum(tau, 1e-30)), jnp.log10(P),
+            1e-10 * h_in]
+    logY = jnp.log10(jnp.maximum(Y_in, Y_FLOOR))
     return jnp.concatenate(
         [jnp.stack(cols, axis=-1), logY], axis=-1)
 
@@ -106,14 +129,32 @@ def mlp_apply(params, x):
     return x @ W + b
 
 
+def model_params(model: SurrogateModel):
+    """The model's numeric leaves as one pytree ``(members, norm, lo,
+    hi)`` — everything :func:`predict` and the domain gates read.
+    Serving passes this as a RUNTIME argument to its jitted batch
+    functions instead of closing over the model, so swapping weights
+    of the same architecture (a flywheel promotion, a shadow
+    candidate) reuses the already-compiled program: zero new XLA
+    compiles on the hot path."""
+    return (model.members, model.norm,
+            jnp.asarray(model.lo), jnp.asarray(model.hi))
+
+
+def predict_params(members, norm: Normalization, feats):
+    """:func:`predict` against bare param leaves (the jit-traceable
+    form — see :func:`model_params`)."""
+    xn = (feats - norm.x_mean) / norm.x_std
+    preds = jnp.stack([mlp_apply(m, xn) for m in members])
+    return preds * norm.y_std + norm.y_mean
+
+
 def predict(model: SurrogateModel, feats):
     """Every ensemble member's denormalized prediction for raw
     features ``feats`` ``[..., F]``; returns ``[M, ..., O]``. The
     caller takes the mean as the answer and the spread as the
     trust/disagreement signal (:mod:`.verify`)."""
-    xn = (feats - model.norm.x_mean) / model.norm.x_std
-    preds = jnp.stack([mlp_apply(m, xn) for m in model.members])
-    return preds * model.norm.y_std + model.norm.y_mean
+    return predict_params(model.members, model.norm, feats)
 
 
 def layer_sizes(member) -> List[int]:

@@ -36,6 +36,16 @@ construction time; explicit kwargs win):
   this fraction of the request's ``t_end`` (default 0.8).
 - ``PYCHEMKIN_SURROGATE_EQ_RESID``       max equilibrium
   element-potential/element-balance residual (default 0.05).
+- ``PYCHEMKIN_SURROGATE_PSR_RESID``      max tau-scaled PSR
+  steady-state residual of the predicted reactor state (default
+  0.05).
+
+The **PSR** gate mirrors the equilibrium one in spirit: plugging the
+predicted ``(T, Y)`` into the reactor's own steady-state equations
+(:func:`pychemkin_tpu.ops.psr.make_rhs`, tau mode) and scaling by the
+residence time yields an O(1) mass/energy-imbalance fraction — one
+RHS evaluation against the real solver's damped-Newton + pseudo-
+transient march.
 """
 
 from __future__ import annotations
@@ -58,12 +68,23 @@ class GateConfig(NamedTuple):
     ign_disagree_max: float = 0.1
     ign_t_end_frac: float = 0.8
     eq_resid_max: float = 0.05
+    psr_resid_max: float = 0.05
+
+
+class DomainBox(NamedTuple):
+    """The trained-domain corner the gates read (``.lo``/``.hi`` duck-
+    typed like :class:`~pychemkin_tpu.surrogate.model.SurrogateModel`).
+    Serving builds one from its runtime param pytree so the gates see
+    TRACED bounds — a promoted model's grown box needs no recompile."""
+    lo: Any
+    hi: Any
 
 
 def gate_config(*, domain_margin: Optional[float] = None,
                 ign_disagree_max: Optional[float] = None,
                 ign_t_end_frac: Optional[float] = None,
-                eq_resid_max: Optional[float] = None) -> GateConfig:
+                eq_resid_max: Optional[float] = None,
+                psr_resid_max: Optional[float] = None) -> GateConfig:
     """Thresholds from explicit kwargs, else env, else the registry
     defaults (pychemkin_tpu.knobs owns default + parse semantics)."""
     def pick(val, env):
@@ -77,7 +98,9 @@ def gate_config(*, domain_margin: Optional[float] = None,
         ign_t_end_frac=pick(ign_t_end_frac,
                             "PYCHEMKIN_SURROGATE_IGN_TEND_FRAC"),
         eq_resid_max=pick(eq_resid_max,
-                          "PYCHEMKIN_SURROGATE_EQ_RESID"))
+                          "PYCHEMKIN_SURROGATE_EQ_RESID"),
+        psr_resid_max=pick(psr_resid_max,
+                           "PYCHEMKIN_SURROGATE_PSR_RESID"))
 
 
 def in_domain(lo, hi, feats, margin: float = 0.0):
@@ -153,4 +176,44 @@ def equilibrium_gate(mech, model, feats, T, P, X_pred, b,
         mech, t, p, x, bb))(T, P, X_pred, b)
     ok = (in_domain(model.lo, model.hi, feats, cfg.domain_margin)
           & jnp.isfinite(resid) & (resid <= cfg.eq_resid_max))
+    return ok, resid
+
+
+def psr_residual(mech, tau, P, Y_in, h_in, T, Y, energy: str = "ENRG"):
+    """Tau-scaled steady-state residual of ONE predicted PSR state
+    (vmap for batches): the reactor's own transient RHS evaluated at
+    the predicted ``(Y, T)``, times the residence time, so each
+    component is an O(1) imbalance FRACTION (the same scaling the real
+    solver's Newton drives to zero; temperature divided by
+    :data:`~pychemkin_tpu.ops.psr.T_SCALE` to sit next to the mass
+    fractions). Non-finite components count as a large miss instead of
+    poisoning the mean."""
+    from ..ops import psr as psr_ops
+
+    zero = jnp.zeros((), jnp.float64)
+    args = psr_ops.PSRArgs(
+        mech=mech, P=P, Y_in=Y_in, h_in=h_in, tau=tau,
+        volume=zero, mdot=zero, qloss=zero, T_fixed=zero)
+    rhs = psr_ops.make_rhs(psr_ops.MODE_TAU, energy)
+    y = jnp.concatenate([Y, jnp.reshape(T, (1,))])
+    r = rhs(0.0, y, args) * jnp.maximum(tau, _TINY)
+    r = r.at[-1].divide(psr_ops.T_SCALE)
+    r = jnp.where(jnp.isfinite(r), r, 1e3)
+    return jnp.sqrt(jnp.mean(r * r))
+
+
+def psr_gate(mech, model, feats, tau, P, Y_in, h_in, T_pred, Y_pred,
+             cfg: GateConfig, energy: str = "ENRG"):
+    """The PSR acceptance mask (batched): in-domain AND the tau-scaled
+    steady-state residual of the predicted state under
+    :func:`psr_residual` below the threshold. Returns
+    ``(verified [B], residual [B])``."""
+    import jax
+
+    resid = jax.vmap(lambda t, p, yi, hi_, T, Y: psr_residual(
+        mech, t, p, yi, hi_, T, Y, energy))(
+            tau, P, Y_in, h_in, T_pred, Y_pred)
+    ok = (in_domain(model.lo, model.hi, feats, cfg.domain_margin)
+          & jnp.isfinite(T_pred) & (T_pred > 0.0)
+          & jnp.isfinite(resid) & (resid <= cfg.psr_resid_max))
     return ok, resid

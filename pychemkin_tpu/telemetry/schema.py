@@ -47,6 +47,12 @@ COUNTERS = (
     "fleet.requests",
     "fleet.reroutes",
     "flame.solves",
+    "flywheel.banked",
+    "flywheel.errors",
+    "flywheel.promoted",
+    "flywheel.rejected",
+    "flywheel.rounds",
+    "flywheel.shadow.evals",
     "linalg.pivot_fallback",
     "linalg.refine_stagnated",
     "model.failed_solves",
@@ -93,6 +99,7 @@ COUNTERS = (
 #: dynamic counter families: the suffix is runtime data (a status
 #: name, an engine kind, a tenant id)
 COUNTER_PREFIXES = (
+    "flywheel.banked.",
     "model.status.",
     "odeint.newton.",
     "odeint.status.",
@@ -100,6 +107,9 @@ COUNTER_PREFIXES = (
     "resilience.status.",
     "serve.compiles.",
     "serve.status.",
+    "serve.surrogate.fallback.",
+    "serve.surrogate.hit.",
+    "serve.surrogate.miss.",
     "serve.tenant_rejected.",
 )
 
@@ -154,6 +164,9 @@ EVENTS = (
     "flame",
     "fleet.action",
     "fleet.spawn_timeout",
+    "flywheel.promoted",
+    "flywheel.rejected",
+    "flywheel.round",
     "health.signal",
     "odeint",
     "rescue",
@@ -239,6 +252,15 @@ PROGRAM_COUNTERS = (
 #: the field to the actual ``emit_span`` call site in serve/server.py.
 PROGRAM_SPAN_FIELD = "program_id"
 
+# -- surrogate flywheel -----------------------------------------------------
+
+#: the trace-span field carrying the serving surrogate's model
+#: generation on ``serve.surrogate`` spans (stamped from the model's
+#: ``meta["model_gen"]``) — the join key between a traced answer and
+#: the flywheel promotion (``flywheel.promoted`` event) that installed
+#: the model which produced it.
+MODEL_GEN_SPAN_FIELD = "model_gen"
+
 # -- timers (recorder.section blocks) ---------------------------------------
 
 TIMERS = ()
@@ -267,6 +289,6 @@ __all__ = [
     "COUNTERS", "COUNTER_PREFIXES", "GAUGES", "GAUGE_PREFIXES",
     "HISTOGRAMS", "HISTOGRAM_PREFIXES", "EVENTS", "EVENT_PREFIXES",
     "HEALTH_SIGNALS", "HEALTH_EVENT_FIELDS",
-    "PROGRAM_COUNTERS", "PROGRAM_SPAN_FIELD",
+    "PROGRAM_COUNTERS", "PROGRAM_SPAN_FIELD", "MODEL_GEN_SPAN_FIELD",
     "TIMERS", "TIMER_PREFIXES", "SPANS", "SPAN_PREFIXES",
 ]

@@ -72,6 +72,17 @@ Behaviour:
   ids. ``PYCHEMKIN_COMPILE_AUDIT_PERTURB=1`` in the caller's env
   drives the negative twin (a knob flip mid-run), which MUST fail.
   With no test files named the run stops after the audit;
+- ``--flywheel`` runs the surrogate-flywheel closed-loop soak
+  (``tools/loadgen.py --flywheel-rounds``, ISSUE 20) as a subprocess
+  — OOD traffic misses, banks, retrains, shadows, promotes — and
+  holds the banked artifact to the acceptance contract: at least two
+  promotions with every per-kind hit rate at least DOUBLED from
+  round 0, the scrambled-labels chaos candidate shadow-REJECTED with
+  the incumbent left serving (and a typed ``flywheel.rejected`` event
+  recording it), zero unverified answers reaching clients, zero
+  post-warmup compiles on the serving path. Minutes of wall clock:
+  the slow lane's gate, run next to ``--mesh 8 -m slow``. With no
+  test files named the run stops after the soak;
 - under ``--chaos`` the children also get ``PYCHEMKIN_KILL_REPORT_DIR``
   (a fresh temp dir unless the caller exported one), and after the run
   the suite ASSERTS at least one ``kill_report*.json`` artifact exists
@@ -216,6 +227,76 @@ def _run_lint() -> int:
     print(f"# run_suite: chemlint rc={rc}", flush=True)
     return rc
 
+
+def _run_flywheel_gate() -> int:
+    """The surrogate-flywheel soak gate (ISSUE 20): run the closed
+    loop end to end in a subprocess (no jax in this orchestrator) and
+    hold the banked artifact to the acceptance contract — the hit
+    rate must CLIMB through promotions, the scrambled-labels chaos
+    candidate must die in shadow with the incumbent left serving, no
+    unverified answer may reach a client, and the serving path must
+    stay at zero post-warmup compiles."""
+    import json as _json
+    here = os.path.dirname(os.path.abspath(__file__))
+    tool = os.path.join(os.path.dirname(here), "tools", "loadgen.py")
+    out = os.path.join(tempfile.mkdtemp(prefix="pychemkin_flywheel_"),
+                       "FLYWHEEL_r01.json")
+    cmd = [sys.executable, tool, "--flywheel-rounds", "2",
+           "--seed", "0", "--out", out]
+    try:
+        rc = subprocess.run(cmd, env=_child_env(),
+                            timeout=FILE_TIMEOUT).returncode
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        print(f"# run_suite: flywheel soak could not run: {exc}",
+              flush=True)
+        return 2
+    if rc != 0:
+        print(f"# run_suite: FLYWHEEL FAILURE: soak exited rc={rc}",
+              flush=True)
+        return 1
+    try:
+        with open(out, encoding="utf-8") as fh:
+            doc = _json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"# run_suite: FLYWHEEL FAILURE: unreadable artifact "
+              f"{out}: {exc}", flush=True)
+        return 1
+    problems = []
+    if doc.get("promotions", 0) < 2:
+        problems.append(f"promotions {doc.get('promotions')} < 2")
+    r0 = doc.get("hit_rate_round0") or {}
+    rf = doc.get("hit_rate_final") or {}
+    for kind in sorted(rf):
+        start, final = float(r0.get(kind) or 0.0), float(rf[kind])
+        climbed = (final >= 2.0 * start) if start > 0.0 \
+            else (final > 0.0)
+        if not climbed:
+            problems.append(
+                f"{kind} hit rate {start} -> {final}: did not climb")
+    if doc.get("unverified_answers", 1) != 0:
+        problems.append(f"{doc.get('unverified_answers')} unverified "
+                        "answers reached clients")
+    if doc.get("compiles_after_warmup", 1) != 0:
+        problems.append(f"{doc.get('compiles_after_warmup')} "
+                        "post-warmup compiles on the serving path")
+    scr = doc.get("scramble") or {}
+    if scr.get("verdict") != "reject" or not scr.get("incumbent_kept"):
+        problems.append(
+            f"scrambled candidate verdict={scr.get('verdict')} "
+            f"incumbent_kept={scr.get('incumbent_kept')}")
+    if not any(ev.get("kind") == "flywheel.rejected"
+               for ev in doc.get("flywheel_events") or []):
+        problems.append("no typed flywheel.rejected event")
+    print(f"# run_suite: flywheel soak: promotions="
+          f"{doc.get('promotions')} rejections={doc.get('rejections')}"
+          f" hit_rate {r0} -> {rf} scramble={scr.get('verdict')}"
+          f" (artifact: {out})", flush=True)
+    if problems:
+        print("# run_suite: FLYWHEEL FAILURE: " + "; ".join(problems),
+              flush=True)
+        return 1
+    return 0
+
 #: the --faults default injection spec: element 1 gets a NaN RHS that
 #: heals at rescue rung 1 — exercised by the env-gated tests of
 #: tests/test_resilience.py
@@ -337,10 +418,13 @@ def main(argv=None):
     lint = "--lint" in argv
     lint_only = "--lint-only" in argv
     compile_audit = "--compile-audit" in argv
-    if faults or chaos or lint or lint_only or compile_audit:
+    flywheel_soak = "--flywheel" in argv
+    if (faults or chaos or lint or lint_only or compile_audit
+            or flywheel_soak):
         argv = [a for a in argv
                 if a not in ("--faults", "--chaos", "--lint",
-                             "--lint-only", "--compile-audit")]
+                             "--lint-only", "--compile-audit",
+                             "--flywheel")]
     if lint or lint_only:
         # the static-analysis ratchet runs BEFORE any pytest child: a
         # new violation fails the suite immediately, naming the rule,
@@ -375,6 +459,13 @@ def main(argv=None):
             return 1
         if not argv:
             # audit-only invocation: the gate IS the verdict
+            return 0
+    if flywheel_soak:
+        # the closed-loop soak gate (ISSUE 20) — a subprocess, same
+        # no-jax-here contract as the compile audit above
+        if _run_flywheel_gate() != 0:
+            return 1
+        if not argv:
             return 0
     summary_json = None
     if "--summary-json" in argv:

@@ -1,0 +1,384 @@
+"""Surrogate flywheel (ISSUE 20): miss banking, shadow verdicts,
+atomic promotion, daemon reconciliation scoping, and the chemtop
+flywheel panel.
+
+Fast lane: pure units only — synthetic rows, fake engines/targets,
+tiny throwaway models. The end-to-end closed loop (OOD traffic →
+bank → retrain → shadow → promote, plus the scrambled-labels chaos
+round) is the ``tools/loadgen.py --flywheel-rounds`` soak, gated in
+``run_suite`` next to the chaos soaks (slow lane).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pychemkin_tpu import flywheel as fw, surrogate as sg, telemetry
+from pychemkin_tpu.mechanism import load_embedded
+from pychemkin_tpu.resilience.status import SolveStatus
+from pychemkin_tpu.surrogate import dataset as sg_dataset
+from pychemkin_tpu.surrogate import model as sg_model
+
+
+@pytest.fixture(scope="module")
+def mech():
+    return load_embedded("h2o2")
+
+
+def _ign_payload(mech, T0=1300.0, t_end=4e-4):
+    Y0 = sg.phi_composition(mech, 1.0)[0]
+    return {"T0": T0, "P0": 1.0e6, "Y0": Y0, "t_end": t_end}
+
+
+class TestMissBank:
+    def test_roundtrip_merges_under_load_shards(self, tmp_path, mech):
+        rec = telemetry.MetricsRecorder()
+        bank = fw.MissBank(str(tmp_path), mech, rec, shard_rows=64)
+        for i in range(3):
+            ok = bank.note_miss(
+                "ignition", _ign_payload(mech, 1300.0 + 10 * i),
+                {"ignition_time_s": 1e-4 * (i + 1)},
+                status=int(SolveStatus.OK))
+            assert ok
+        assert bank.pending_rows("ignition") == 3
+        paths = bank.flush("ignition")
+        assert len(paths) == 1
+        # the banked shard speaks the dataset schema: the daemon's
+        # merge path is load_shards with the mech-signature check on
+        data = sg_dataset.load_shards(paths,
+                                      expect_mech_sig=bank.mech_sig)
+        assert data["x"].shape[0] == 3
+        np.testing.assert_allclose(
+            np.sort(data["y"].ravel()),
+            np.sort(np.log10([1e-4, 2e-4, 3e-4])))
+        assert rec.counters["flywheel.banked"] == 3
+        assert rec.counters["flywheel.banked.ignition"] == 3
+
+    def test_unlabelable_rows_are_dropped(self, tmp_path, mech):
+        bank = fw.MissBank(str(tmp_path), mech)
+        # a failed rescue is an incident, not a label
+        assert not bank.note_miss(
+            "ignition", _ign_payload(mech),
+            {"ignition_time_s": 1e-4},
+            status=int(SolveStatus.NEWTON_STALL))
+        # rescue answered OK but no ignition inside the horizon
+        assert not bank.note_miss(
+            "ignition", _ign_payload(mech, t_end=4e-4),
+            {"ignition_time_s": float("nan")},
+            status=int(SolveStatus.OK))
+        assert not bank.note_miss(
+            "ignition", _ign_payload(mech, t_end=4e-4),
+            {"ignition_time_s": 5e-4}, status=int(SolveStatus.OK))
+        assert bank.pending_rows("ignition") == 0
+
+    def test_ring_eviction_keeps_newest(self, tmp_path, mech):
+        bank = fw.MissBank(str(tmp_path), mech, shard_rows=1,
+                           max_shards=2)
+        for i in range(4):
+            bank.note_miss("ignition", _ign_payload(mech, 1300.0 + i),
+                           {"ignition_time_s": 1e-4},
+                           status=int(SolveStatus.OK))
+        names = [os.path.basename(p)
+                 for p in bank.shard_paths("ignition")]
+        assert names == ["miss_ignition_00002.npz",
+                         "miss_ignition_00003.npz"]
+
+    def test_foreign_mech_sig_shard_is_skipped(self, tmp_path, mech):
+        bank = fw.MissBank(str(tmp_path), mech, shard_rows=1)
+        bank.note_miss("ignition", _ign_payload(mech),
+                       {"ignition_time_s": 1e-4},
+                       status=int(SolveStatus.OK))
+        good = bank.shard_paths("ignition")
+        assert len(good) == 1
+        # a well-formed shard from ANOTHER mechanism lands in the same
+        # dir (say, a stale pool after a mech swap): filtered, not
+        # fatal, never merged
+        with np.load(good[0], allow_pickle=False) as f:
+            foreign = {k: f[k] for k in f.files}
+        foreign["mech_sig"] = "deadbeef"
+        np.savez(str(tmp_path / "miss_ignition_00099.npz"), **foreign)
+        assert bank.shard_paths("ignition") == good
+
+    def test_condition_hull_aims_the_active_box(self, tmp_path, mech):
+        bank = fw.MissBank(str(tmp_path), mech, shard_rows=64)
+        assert bank.miss_box("ignition") is None
+        for T0 in (1420.0, 1480.0):
+            bank.note_miss("ignition",
+                           _ign_payload(mech, T0, t_end=6e-4),
+                           {"ignition_time_s": 1e-4},
+                           status=int(SolveStatus.OK))
+        bank.flush("ignition")
+        hull = bank.miss_box("ignition")
+        assert hull["n"] == 2
+        assert hull["lo"]["T0"] == 1420.0
+        assert hull["hi"]["T0"] == 1480.0
+        # the daemon aims its retrain draw at the hull (padded), and
+        # keeps the default box axes the hull does not cover
+        daemon = fw.FlywheelDaemon(mech, None, bank, [],
+                                   kinds=("ignition",))
+        box = daemon.active_box("ignition")
+        assert box.T[0] < 1420.0 < 1480.0 < box.T[1]
+        assert box.t_end == 6e-4
+        # an empty bank falls back to the default (or injected) box
+        empty = fw.MissBank(str(tmp_path / "empty"), mech)
+        daemon2 = fw.FlywheelDaemon(mech, None, empty, [],
+                                    kinds=("ignition",))
+        assert daemon2.active_box("ignition") == sg.SampleBox()
+
+
+def _tiny_model(seed=0, gen=3):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(16, 3))
+    data = {"x": x, "y": x[:, :1] * 0.5,
+            "valid": np.ones(16, bool), "lo": x.min(0),
+            "hi": x.max(0), "t_end": 1e-3, "kind": "ignition",
+            "option": -1, "sig": "s", "mech_sig": "m"}
+    model, _ = sg.fit_surrogate(data, hidden=(4,), steps=5,
+                                n_members=1, seed=seed)
+    return model._replace(meta={**model.meta, "model_gen": gen})
+
+
+class _FakeEngine:
+    """predict_with returns a canned candidate result; answer_array
+    reads the ``ans`` field — the shadow's whole engine surface."""
+
+    def __init__(self, cand_out):
+        self.cand_out = cand_out
+
+    def predict_with(self, params, payloads, bucket, key):
+        return self.cand_out
+
+    def answer_array(self, out, n):
+        return np.asarray(out["ans"][:n], np.float64).reshape(n, -1)
+
+
+def _out(verified, ans=None):
+    v = np.asarray(verified, bool)
+    if ans is None:
+        ans = np.zeros(v.shape[0])
+    return {"verified": v, "residual": np.zeros(v.shape[0]),
+            "ans": np.asarray(ans, np.float64)}
+
+
+def _ride(shadow, cand_ver, inc_ver, cand_ans=None, inc_ans=None):
+    n = len(cand_ver)
+    eng = _FakeEngine(_out(cand_ver, cand_ans))
+    shadow.observe_batch(eng, None, list(range(n)), n,
+                         _out(inc_ver, inc_ans))
+
+
+class TestShadowVerdict:
+    def test_undecided_below_min_n(self):
+        shadow = fw.ShadowEvaluator(_tiny_model())
+        _ride(shadow, [True] * 3, [False] * 3)
+        assert shadow.verdict(min_n=4, margin=0.0) == "undecided"
+
+    def test_any_regression_rejects(self):
+        shadow = fw.ShadowEvaluator(_tiny_model())
+        # candidate finds 3 new hits but LOSES one the incumbent had
+        _ride(shadow, [True, True, True, False],
+              [False, False, False, True])
+        assert shadow.stats()["regressions"] == 1
+        assert shadow.verdict(min_n=4, margin=0.0) == "reject"
+
+    def test_strict_improvement_promotes_tie_rejects(self):
+        shadow = fw.ShadowEvaluator(_tiny_model())
+        _ride(shadow, [True, True, True, True],
+              [True, True, True, False])
+        assert shadow.verdict(min_n=4, margin=0.0) == "promote"
+        tie = fw.ShadowEvaluator(_tiny_model())
+        _ride(tie, [True] * 4, [True] * 4)
+        assert tie.verdict(min_n=4, margin=0.0) == "reject"
+
+    def test_xcheck_disagreement_rejects_coherently_wrong(self):
+        # a scrambled-labels ensemble agrees with itself, passes the
+        # disagreement gate, and even out-hits the incumbent — but its
+        # verified answers contradict the incumbent's far beyond
+        # PYCHEMKIN_FLYWHEEL_XCHECK_TOL, and that alone rejects it
+        shadow = fw.ShadowEvaluator(_tiny_model())
+        _ride(shadow, [True] * 5, [True] * 4 + [False],
+              cand_ans=[1.0] * 5, inc_ans=[0.0] * 5)
+        st = shadow.stats()
+        assert st["cand_hits"] > st["inc_hits"]
+        assert st["regressions"] == 0
+        assert st["xcheck_mean"] == pytest.approx(1.0)
+        assert shadow.verdict(min_n=4, margin=0.0) == "reject"
+        # same tallies with AGREEING answers promote
+        honest = fw.ShadowEvaluator(_tiny_model())
+        _ride(honest, [True] * 5, [True] * 4 + [False],
+              cand_ans=[1.0] * 5, inc_ans=[1.0] * 5)
+        assert honest.verdict(min_n=4, margin=0.0) == "promote"
+
+
+class _Target:
+    """ChemServer-shaped promotion target."""
+
+    def __init__(self):
+        self.installed = None
+
+    def promote_model(self, kind, model):
+        self.installed = (kind, model)
+        return int(model.meta.get("model_gen", 0))
+
+    def engine(self, kind):
+        raise AssertionError("apply_verdict must not need engines")
+
+
+class TestPromotion:
+    def test_promote_fans_out_banks_weights_emits_event(
+            self, tmp_path):
+        rec = telemetry.MetricsRecorder()
+        candidate = _tiny_model(gen=4)
+        shadow = fw.ShadowEvaluator(candidate)
+        _ride(shadow, [True] * 5, [False] * 5)
+        targets = [_Target(), _Target()]
+        summary = fw.apply_verdict(
+            "ignition", candidate, shadow, targets, recorder=rec,
+            model_dir=str(tmp_path), min_n=4, margin=0.0)
+        assert summary["verdict"] == "promote"
+        assert summary["installed_gens"] == [4, 4]
+        for t in targets:
+            assert t.installed[0] == "surrogate_ignition"
+        # the rollback file: gen N's weights banked before victory
+        path = summary["model_path"]
+        assert os.path.basename(path) == "ignition_gen004.npz"
+        rolled = sg_model.load_model(path)
+        assert int(rolled.meta["model_gen"]) == 4
+        (ev,) = rec.events("flywheel.promoted")
+        assert ev["req_kind"] == "ignition"
+        assert ev["model_gen"] == 4 and ev["targets"] == 2
+        assert rec.counters["flywheel.promoted"] == 1
+
+    def test_reject_leaves_incumbent_serving(self, tmp_path):
+        rec = telemetry.MetricsRecorder()
+        candidate = _tiny_model(gen=4)
+        shadow = fw.ShadowEvaluator(candidate)
+        _ride(shadow, [True] * 5, [True] * 5)     # tie: no new hits
+        target = _Target()
+        summary = fw.apply_verdict(
+            "ignition", candidate, shadow, [target], recorder=rec,
+            model_dir=str(tmp_path), min_n=4, margin=0.0)
+        assert summary["verdict"] == "reject"
+        assert target.installed is None
+        assert not os.listdir(tmp_path)           # no weights banked
+        (ev,) = rec.events("flywheel.rejected")
+        assert ev["req_kind"] == "ignition"
+        assert rec.events("flywheel.promoted") == []
+
+
+class _FiringMonitor:
+    def __init__(self, signals):
+        self.signals = signals
+
+    def firing(self, min_severity="warn"):
+        return self.signals
+
+
+class _UndecidedShadow:
+    def verdict(self, *, min_n=None, margin=None):
+        return "undecided"
+
+
+class _SpyDaemon(fw.FlywheelDaemon):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.started = []
+
+    def start_round(self, kind, *, scramble=False):
+        self.started.append(kind)
+
+
+class TestDaemonPoll:
+    def _daemon(self, mech, tmp_path, signals,
+                kinds=("ignition", "psr")):
+        bank = fw.MissBank(str(tmp_path), mech)
+        return _SpyDaemon(mech, _FiringMonitor(signals), bank, [],
+                          kinds=kinds)
+
+    def test_kind_scoped_signal_starts_only_that_round(
+            self, mech, tmp_path):
+        d = self._daemon(mech, tmp_path, [
+            {"signal": "SURROGATE_RETRAIN",
+             "evidence": {"req_kind": "psr"}}])
+        actions = d.poll()
+        assert d.started == ["psr"]
+        assert actions == [{"action": "retrain", "kind": "psr"}]
+
+    def test_unscoped_signal_covers_every_configured_kind(
+            self, mech, tmp_path):
+        d = self._daemon(mech, tmp_path, [
+            {"signal": "SURROGATE_RETRAIN", "evidence": {}}])
+        d.poll()
+        assert d.started == ["ignition", "psr"]
+
+    def test_other_signals_and_unconfigured_kinds_ignored(
+            self, mech, tmp_path):
+        d = self._daemon(mech, tmp_path, [
+            {"signal": "BACKEND_DOWN", "evidence": {}},
+            {"signal": "SURROGATE_RETRAIN",
+             "evidence": {"req_kind": "equilibrium"}}])
+        assert d.poll() == []
+        assert d.started == []
+
+    def test_inflight_round_not_restarted(self, mech, tmp_path):
+        d = self._daemon(mech, tmp_path, [
+            {"signal": "SURROGATE_RETRAIN",
+             "evidence": {"req_kind": "psr"}}])
+        d._shadows["psr"] = (None, _UndecidedShadow())
+        assert d.poll() == []         # undecided round keeps riding
+        assert d.started == []
+
+
+class TestChemtopFlywheelPanel:
+    def test_merge_fleet_sums_counters_never_rates(self):
+        from tools import chemtop
+
+        # two backends with very different traffic volumes: the fleet
+        # hit rate must come from SUMMED hit/fallback counters —
+        # averaging the per-backend rates would say (1.0 + 0.0)/2
+        replies = [
+            {"counters": {"serve.surrogate.hit.ignition": 90,
+                          "serve.surrogate.fallback.ignition": 0,
+                          "flywheel.banked.ignition": 0,
+                          "flywheel.promoted": 1},
+             "flywheel": {"h2o2": {
+                 "model_gen": {"ignition": 2},
+                 "last_round": {"t": 5.0, "req_kind": "ignition",
+                                "verdict": "promote", "model_gen": 2}}}},
+            {"counters": {"serve.surrogate.hit.ignition": 0,
+                          "serve.surrogate.fallback.ignition": 10,
+                          "flywheel.banked.ignition": 10},
+             "flywheel": {"h2o2": {
+                 "model_gen": {"ignition": 1},
+                 "last_round": {"t": 2.0, "req_kind": "ignition",
+                                "verdict": "reject",
+                                "model_gen": 1}}}},
+        ]
+        merged = chemtop.merge_fleet(replies)
+        panel = merged["flywheel"]
+        ign = panel["per_kind"]["ignition"]
+        assert ign["hit"] == 90 and ign["fallback"] == 10
+        assert ign["hit_rate"] == pytest.approx(0.9)
+        assert ign["banked"] == 10
+        # incumbent generation is the MAX across members (promotion
+        # fans out; a lagging member must not hide the new gen)...
+        assert ign["model_gen"] == 2
+        # ...and the shown round is the LATEST by timestamp
+        assert panel["last_round"]["verdict"] == "promote"
+        assert panel["promoted"] == 1
+
+    def test_no_flywheel_traffic_yields_empty_panel(self):
+        from tools import chemtop
+
+        merged = chemtop.merge_fleet([{"counters": {
+            "serve.requests": 5}}])
+        panel = merged["flywheel"]
+        assert panel["per_kind"] == {}
+        assert panel["last_round"] is None
+        assert panel["banked"] == 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"] + sys.argv[1:]))

@@ -381,6 +381,40 @@ class TestShippedRules:
         assert sig["evidence"]["ratio"] == pytest.approx(0.25)
         assert sig["evidence"]["n"] == 40
 
+    def test_surrogate_retrain_scoped_per_kind(self):
+        """ISSUE 20: a psr-only hit-rate collapse fires ONLY the
+        psr-scoped SURROGATE_RETRAIN instance (evidence carries
+        ``req_kind`` — what the flywheel daemon keys retrains on); the
+        healthy ignition instance and the fleet-wide backstop (which
+        watches the UNsuffixed counters) stay silent."""
+        def sample(t, ign, psr):
+            return _backend_sample(t, counters={
+                "serve.surrogate.hit.ignition": ign[0],
+                "serve.surrogate.fallback.ignition": ign[1],
+                "serve.surrogate.hit.psr": psr[0],
+                "serve.surrogate.fallback.psr": psr[1]})
+        # drive by hand: _run_rules keys states by bare signal name,
+        # which collapses the kind-scoped family to its last entry
+        ring = health.SnapshotRing()
+        engine = health.HealthEngine()
+        for s in [sample(0.0, (0, 0), (0, 0)),
+                  sample(10.0, (30, 2), (2, 30))]:
+            ring.append(s)
+            engine.evaluate(ring)
+        entries = [e for e in engine.state()
+                   if e["signal"] == "SURROGATE_RETRAIN"]
+        # DEFAULT_RULES order: ignition, equilibrium, psr, fleet-wide
+        assert [e["state"] for e in entries] == \
+            ["ok", "ok", "firing", "ok"]
+        psr_sig = entries[2]
+        assert psr_sig["evidence"]["req_kind"] == "psr"
+        assert psr_sig["evidence"]["ratio"] == pytest.approx(2 / 32)
+        assert psr_sig["evidence"]["n"] == 32
+        firing = [s for s in engine.firing()
+                  if s["signal"] == "SURROGATE_RETRAIN"]
+        assert len(firing) == 1
+        assert firing[0]["evidence"]["req_kind"] == "psr"
+
     def test_predictor_decalibrated_below_floor(self):
         def sample(t, corr):
             return _backend_sample(

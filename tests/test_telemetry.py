@@ -422,6 +422,87 @@ class TestHealthSchema:
             assert ev["signal"] in schema.HEALTH_SIGNALS
 
 
+class TestFlywheelSchema:
+    """ISSUE 20 satellite: the flywheel counter/event names are
+    schema. The closed loop (bank -> retrain -> shadow -> promote)
+    emits them; asserting the names here keeps emitters and the
+    canonical tuples from drifting (chemlint enforces the static
+    half, exactly like the health schema above)."""
+
+    def test_flywheel_series_ride_canonical_tuples(self):
+        from pychemkin_tpu.telemetry import schema
+
+        for name in ("flywheel.banked", "flywheel.rounds",
+                     "flywheel.promoted", "flywheel.rejected",
+                     "flywheel.shadow.evals", "flywheel.errors"):
+            assert name in schema.COUNTERS, name
+        # per-kind banked family (flywheel.banked.<kind>)
+        assert "flywheel.banked." in schema.COUNTER_PREFIXES
+        for name in ("flywheel.promoted", "flywheel.rejected",
+                     "flywheel.round"):
+            assert name in schema.EVENTS, name
+
+    def test_model_gen_span_field_is_schema(self):
+        from pychemkin_tpu import telemetry
+        from pychemkin_tpu.telemetry import schema
+
+        # the join key between a traced surrogate answer and the
+        # flywheel promotion that installed the model producing it
+        assert schema.MODEL_GEN_SPAN_FIELD == "model_gen"
+        assert "MODEL_GEN_SPAN_FIELD" in schema.__all__
+        assert "serve.surrogate" in schema.SPANS
+
+    def test_promotion_events_carry_schema_kinds(self, tmp_path):
+        """The real emitters (promote.apply_verdict both verdicts)
+        produce only schema event kinds and counters."""
+        from pychemkin_tpu import flywheel as fw, surrogate as sg
+        from pychemkin_tpu.telemetry import schema
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(12, 3))
+        data = {"x": x, "y": x[:, :1], "valid": np.ones(12, bool),
+                "lo": x.min(0), "hi": x.max(0), "t_end": 1e-3,
+                "kind": "ignition", "option": -1, "sig": "s",
+                "mech_sig": "m"}
+        model, _ = sg.fit_surrogate(data, hidden=(4,), steps=5,
+                                    n_members=1)
+
+        class _T:
+            def promote_model(self, kind, m):
+                return 1
+
+        for cand_ver, inc_ver in (([True] * 4, [False] * 4),
+                                  ([True] * 4, [True] * 4)):
+            rec = MetricsRecorder()
+            shadow = fw.ShadowEvaluator(model)
+
+            class _E:
+                def predict_with(self, p, payloads, bucket, key):
+                    n = len(cand_ver)
+                    return {"verified": np.array(cand_ver),
+                            "residual": np.zeros(n),
+                            "ans": np.zeros(n)}
+
+                def answer_array(self, out, n):
+                    return np.asarray(out["ans"][:n]).reshape(n, 1)
+
+            n = len(cand_ver)
+            shadow.observe_batch(
+                _E(), None, list(range(n)), n,
+                {"verified": np.array(inc_ver),
+                 "residual": np.zeros(n), "ans": np.zeros(n)})
+            fw.apply_verdict("ignition", model, shadow, [_T()],
+                             recorder=rec, model_dir=str(tmp_path),
+                             min_n=4, margin=0.0)
+            for ev in rec.events():
+                assert ev["kind"] in schema.EVENTS, ev["kind"]
+            for name in rec.counters:
+                assert (name in schema.COUNTERS
+                        or name.startswith(
+                            tuple(schema.COUNTER_PREFIXES))), name
+
+
 class TestTrace:
     """ISSUE 8 tentpole: span records over the event spine."""
 

@@ -119,6 +119,8 @@ def merge_fleet(replies: List[Dict]) -> Dict:
     prog_by_id: Dict[str, Dict] = {}
     calibrations: List[Dict] = []
     cache_listener = False
+    fw_gens: Dict[str, int] = {}
+    fw_last_round: Optional[Dict] = None
     backends = []
     for rep in replies:
         row = {"port": rep.get("port"), "pid": rep.get("pid"),
@@ -184,6 +186,18 @@ def merge_fleet(replies: List[Dict]) -> Dict:
                 agg["cache_source"] = row.get("cache_source")
         if rep.get("calibration"):
             calibrations.append(rep["calibration"])
+        # flywheel facts: incumbent generation per kind is the MAX
+        # across backends (promotion fans out; a lagging member shows
+        # the fleet as mid-rollout, never as rolled back), last round
+        # verdict by timestamp
+        for st in (rep.get("flywheel") or {}).values():
+            for kind, gen in (st.get("model_gen") or {}).items():
+                fw_gens[kind] = max(fw_gens.get(kind, 0), int(gen))
+            lr = st.get("last_round")
+            if lr and (fw_last_round is None
+                       or (lr.get("t") or 0)
+                       > (fw_last_round.get("t") or 0)):
+                fw_last_round = lr
     # surrogate fast-path gauge: fleet hit rate from the SUMMED
     # counters (never averaged per-backend rates), fallbacks alongside
     # — a dropping hit rate is the signal to retrain/widen the box
@@ -195,6 +209,35 @@ def merge_fleet(replies: List[Dict]) -> Dict:
         "fallback": fallback,
         "hit_rate": (round(hit / (hit + fallback), 4)
                      if hit + fallback else None),
+    }
+    # flywheel panel: per-kind hit rates from the SUMMED per-kind
+    # counter families (the same never-average-rates rule as above),
+    # bank/round/promotion tallies, merged incumbent generations
+    fw_kinds = sorted(
+        {k.rsplit(".", 1)[1] for k in counters
+         if k.startswith(("serve.surrogate.hit.",
+                          "serve.surrogate.fallback.",
+                          "flywheel.banked."))} | set(fw_gens))
+    per_kind = {}
+    for kind in fw_kinds:
+        kh = counters.get(f"serve.surrogate.hit.{kind}", 0)
+        kf = counters.get(f"serve.surrogate.fallback.{kind}", 0)
+        per_kind[kind] = {
+            "hit": kh, "fallback": kf,
+            "hit_rate": (round(kh / (kh + kf), 4)
+                         if kh + kf else None),
+            "banked": counters.get(f"flywheel.banked.{kind}", 0),
+            "model_gen": fw_gens.get(kind),
+        }
+    flywheel = {
+        "banked": counters.get("flywheel.banked", 0),
+        "rounds": counters.get("flywheel.rounds", 0),
+        "promoted": counters.get("flywheel.promoted", 0),
+        "rejected": counters.get("flywheel.rejected", 0),
+        "shadow_evals": counters.get("flywheel.shadow.evals", 0),
+        "errors": counters.get("flywheel.errors", 0),
+        "per_kind": per_kind,
+        "last_round": fw_last_round,
     }
     histograms = {name: telemetry.merge_histogram_states(states)
                   for name, states in sorted(hist_states.items())}
@@ -282,6 +325,7 @@ def merge_fleet(replies: List[Dict]) -> Dict:
         "counters": counters,
         "tenants": tenants,
         "surrogate": surrogate,
+        "flywheel": flywheel,
         "schedule": schedule,
         "solver": solver,
         "programs": programs,
@@ -384,6 +428,27 @@ def render(snapshot: Dict, view=None, signals=None,
             f"  surrogate: hit {sur['hit']}  miss {sur['miss']}  "
             f"fallback {sur['fallback']}  "
             f"hit_rate {'n/a' if rate is None else f'{rate:.1%}'}")
+    fw = snapshot.get("flywheel") or {}
+    if fw.get("banked") or fw.get("rounds") or fw.get("per_kind"):
+        lines.append(
+            f"  flywheel: banked {fw.get('banked', 0)}  "
+            f"rounds {fw.get('rounds', 0)}  "
+            f"promoted {fw.get('promoted', 0)}  "
+            f"rejected {fw.get('rejected', 0)}  "
+            f"shadow_evals {fw.get('shadow_evals', 0)}")
+        for kind, row in sorted((fw.get("per_kind") or {}).items()):
+            r = row.get("hit_rate")
+            gen = row.get("model_gen")
+            lines.append(
+                f"    {kind}: hit_rate "
+                f"{'n/a' if r is None else f'{r:.1%}'}  "
+                f"banked {row.get('banked', 0)}  "
+                f"gen {'n/a' if gen is None else gen}")
+        lr = fw.get("last_round")
+        if lr:
+            lines.append(
+                f"    last_round: {lr.get('req_kind')} "
+                f"{lr.get('verdict')} gen {lr.get('model_gen')}")
     for mech, s in sorted((snapshot.get("schedule") or {}).items()):
         occ = "  ".join(f"b{b}={p:.3g}" for b, p in
                         sorted(s["bucket_occupancy_p50"].items(),

@@ -111,6 +111,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "stiffness batches; the artifact records the "
                         "mix ranges and a per-cohort (cool/mid/hot "
                         "initial-T tercile) latency split")
+    p.add_argument("--ood-mix", action="store_true",
+                   help="draw surrogate-family payloads OUTSIDE the "
+                        "default trained box on one axis (hotter T0 "
+                        "for ignition/equilibrium, longer tau for "
+                        "psr): round-0 traffic is all verified "
+                        "fallback, so every miss banks a label where "
+                        "the next retrain needs one")
+    p.add_argument("--flywheel-rounds", type=int, default=None,
+                   metavar="R",
+                   help="flywheel soak mode: run R rounds of "
+                        "initially-OOD traffic (implies --ood-mix) "
+                        "against an in-process server with the miss "
+                        "bank + retrain daemon attached; each round "
+                        "bursts traffic, feeds the health monitor, "
+                        "lets SURROGATE_RETRAIN drive a retrain + "
+                        "shadow + promote cycle, then banks the "
+                        "per-kind hit-rate climb — plus a final "
+                        "scrambled-labels chaos round that must be "
+                        "shadow-rejected — into the artifact")
+    p.add_argument("--flywheel-burst", type=int, default=24,
+                   help="requests per kind per flywheel burst")
     p.add_argument("--rate", type=float, default=100.0,
                    help="offered arrival rate, requests/s")
     p.add_argument("--n", type=int, default=200,
@@ -584,8 +605,237 @@ def _run_fleet(args, kinds, bucket_sizes, rng, samplers, obs,
                                if args.chaos else None)}
 
 
+def _run_flywheel(args) -> int:
+    """The flywheel soak (ISSUE 20): self-contained closed loop over
+    an in-process server. Trains small gen-0 surrogates on the default
+    box, then offers R rounds of initially-OOD traffic
+    (:func:`pychemkin_tpu.serve.loadgen.ood_mix_sampler`): round 0 is
+    all verified fallback, the misses bank, the health monitor's
+    per-kind ``SURROGATE_RETRAIN`` fires, the daemon retrains + rides
+    the candidate in shadow on the NEXT burst, and promotion closes
+    the loop — the artifact banks the per-kind hit-rate climb, the
+    typed ``flywheel.*`` event trail, the zero-unverified-answers
+    count, and the zero-new-compiles-after-warmup delta. A final
+    scrambled-labels chaos round proves the shadow gate rejects a
+    plausible-shaped but wrong candidate while the incumbent keeps
+    serving."""
+    from pychemkin_tpu import flywheel as fw, surrogate as sg
+    from pychemkin_tpu.health.monitor import HealthMonitor
+
+    kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
+    sur_kinds = [k for k in kinds
+                 if k.startswith(loadgen.SURROGATE_PREFIX)]
+    if not sur_kinds:
+        # the default --kinds is not a surrogate stream; the soak's
+        # canonical pair exercises per-kind retrain scoping AND the
+        # PSR-state surrogate path
+        sur_kinds = ["surrogate_ignition", "surrogate_psr"]
+    base_kinds = [k[len(loadgen.SURROGATE_PREFIX):] for k in sur_kinds]
+    mech = load_embedded(args.mech)
+    obs = _Obs(args)
+    rec = obs.recorder
+    work = os.path.join(obs.dir, "flywheel")
+    os.makedirs(work, exist_ok=True)
+
+    ign_cfg = _engine_config()["ignition"]
+    solver_kwargs = {"ignition": ign_cfg}
+    # gen-0 training boxes: the DEFAULT box per kind — except the psr
+    # inlet, which must match the production sampler's cold feed
+    # (T_in 300 K) or the incumbent is trained off the traffic
+    # manifold from the start
+    boxes = {"ignition": sg.SampleBox(),
+             "equilibrium": sg.SampleBox(),
+             "psr": sg.SampleBox(T=(295.0, 305.0))}
+    n0 = {"ignition": 48, "equilibrium": 48, "psr": 32}
+
+    base_shards, models = {}, {}
+    for bk in base_kinds:
+        path = os.path.join(work, f"base_{bk}.npz")
+        print(f"# loadgen: flywheel gen-0 {bk}: labelling "
+              f"{n0[bk]} draws", file=sys.stderr)
+        shard, _rep = sg.generate_dataset(
+            mech, bk, n=n0[bk], seed=args.seed, box=boxes[bk],
+            out_path=path, solver_kwargs=solver_kwargs.get(bk))
+        models[bk], _ = sg.fit_surrogate(
+            shard, hidden=(16, 16), steps=200, n_members=2,
+            seed=args.seed)
+        base_shards[bk] = [path]
+
+    bank = fw.MissBank(os.path.join(work, "bank"), mech, rec,
+                       shard_rows=8)
+    server = serve.ChemServer(
+        mech, bucket_sizes=(1, 8), max_batch_size=8, max_delay_ms=5.0,
+        recorder=rec, engine_config=_engine_config())
+    for bk, sk in zip(base_kinds, sur_kinds):
+        server.configure_engine(sk, model=models[bk],
+                                base_engine=server.engine(bk),
+                                bank=bank)
+    print(f"# loadgen: flywheel warming {sur_kinds}", file=sys.stderr)
+    warm = server.warmup(list(base_kinds) + list(sur_kinds))
+    server.start()
+    compiles0 = rec.counters.get("serve.compiles", 0)
+
+    monitor = HealthMonitor(recorder=rec)
+    daemon = fw.FlywheelDaemon(
+        mech, monitor, bank, [server], kinds=tuple(base_kinds),
+        model_dir=os.path.join(work, "models"),
+        base_shards=base_shards, recorder=rec,
+        train_kwargs={"steps": 200}, active_n=32,
+        seed=args.seed + 5, shadow_min_n=16, promote_margin=0.0,
+        solver_kwargs=solver_kwargs, base_box={"psr": boxes["psr"]})
+
+    samplers = {sk: loadgen.ood_mix_sampler(mech, sk)
+                for sk in sur_kinds}
+    rng = np.random.default_rng(args.seed)
+    n_burst = args.flywheel_burst
+    bad_replies = 0   # ok replies missing the verified/fallback flag
+
+    def burst(sk):
+        nonlocal bad_replies
+        futs = []
+        for i in range(n_burst):
+            kind, payload = samplers[sk](i, rng)
+            futs.append(server.submit(kind, **payload))
+        hits = fallbacks = 0
+        for f in futs:
+            r = f.result(timeout=args.timeout)
+            flag = r.value.get("surrogate")
+            if flag is None:
+                # the no-unverified-answer contract: every ok reply is
+                # either a gate-verified surrogate hit (True) or a
+                # real-solver fallback (False) — a missing flag means
+                # an answer escaped both
+                bad_replies += 1
+            elif flag:
+                hits += 1
+            else:
+                fallbacks += 1
+        return hits, fallbacks
+
+    # synthetic clock for the health monitor: each round jumps past
+    # the rule window so its ratio sees ONLY that round's deltas
+    # (plus the one at-or-before-edge baseline sample)
+    clock = [1.0e6]
+
+    def observe():
+        monitor.observe({"counters": dict(rec.counters)}, t=clock[0])
+        clock[0] += 5.0
+
+    rounds = []
+    try:
+        for r in range(args.flywheel_rounds):
+            clock[0] += 400.0
+            observe()                # this round's window baseline
+            per_kind = {}
+            for bk, sk in zip(base_kinds, sur_kinds):
+                hits, falls = burst(sk)
+                per_kind[bk] = {
+                    "n": n_burst, "hits": hits,
+                    "hit_rate": hits / n_burst, "fallbacks": falls,
+                    "banked": rec.counters.get(
+                        f"flywheel.banked.{bk}", 0),
+                    "model_gen": server.engine(sk).model_gen}
+            observe()                # the measured sample
+            actions = daemon.poll()  # SURROGATE_RETRAIN -> shadow
+            concluded = []
+            if any(a["action"] == "retrain" for a in actions):
+                for bk, sk in zip(base_kinds, sur_kinds):
+                    if daemon.shadowing(bk):
+                        burst(sk)    # candidate rides this in shadow
+                for bk in base_kinds:
+                    if daemon.shadowing(bk):
+                        s = daemon.finish_round(bk)
+                        if s is not None:
+                            concluded.append(
+                                {"kind": bk,
+                                 "verdict": s["verdict"],
+                                 "model_gen": s["model_gen"]})
+            rounds.append({"round": r, "kinds": per_kind,
+                           "actions": actions,
+                           "concluded": concluded})
+            print("# loadgen: flywheel round %d: %s (promotions %d)"
+                  % (r, ", ".join(
+                      f"{bk} {per_kind[bk]['hits']}/{n_burst}"
+                      for bk in base_kinds),
+                     rec.counters.get("flywheel.promoted", 0)),
+                  file=sys.stderr)
+
+        # chaos round: a scrambled-labels candidate against the now-
+        # strong incumbent — the shadow verdict must reject it and the
+        # incumbent must keep serving
+        scramble = None
+        promoted = [e.get("req_kind")
+                    for e in rec.events("flywheel.promoted")]
+        if promoted:
+            bk = promoted[0]
+            sk = loadgen.SURROGATE_PREFIX + bk
+            gen_before = server.engine(sk).model_gen
+            print(f"# loadgen: flywheel chaos: scrambled {bk} "
+                  "candidate", file=sys.stderr)
+            daemon.start_round(bk, scramble=True)
+            burst(sk)
+            s = daemon.finish_round(bk)
+            scramble = {
+                "kind": bk,
+                "verdict": s["verdict"] if s else "undecided",
+                "model_gen_before": gen_before,
+                "model_gen_after": server.engine(sk).model_gen,
+                "incumbent_kept":
+                    server.engine(sk).model_gen == gen_before}
+
+        compiles1 = rec.counters.get("serve.compiles", 0)
+        fw_state = server.flywheel_state()
+    finally:
+        server.close()
+
+    r0 = rounds[0]["kinds"]
+    rN = rounds[-1]["kinds"]
+    artifact = {
+        "tool": "loadgen",
+        "mode": "flywheel",
+        "mech": args.mech,
+        "kinds": sur_kinds,
+        "seed": args.seed,
+        "rounds_requested": args.flywheel_rounds,
+        "burst": n_burst,
+        "ood_mix": {"T": list(loadgen.OOD_MIX_T),
+                    "eq_T": list(loadgen.OOD_MIX_EQ_T),
+                    "tau": list(loadgen.OOD_MIX_TAU)},
+        "rounds": rounds,
+        "scramble": scramble,
+        "promotions": rec.counters.get("flywheel.promoted", 0),
+        "rejections": rec.counters.get("flywheel.rejected", 0),
+        "hit_rate_round0": {bk: r0[bk]["hit_rate"]
+                            for bk in base_kinds},
+        "hit_rate_final": {bk: rN[bk]["hit_rate"]
+                           for bk in base_kinds},
+        "model_gen": fw_state["model_gen"],
+        "banked": {bk: rec.counters.get(f"flywheel.banked.{bk}", 0)
+                   for bk in base_kinds},
+        "unverified_answers": bad_replies,
+        "warmup_compiles": warm,
+        "compiles_after_warmup": compiles1 - compiles0,
+        "flywheel_events": [e for e in rec.events()
+                            if str(e.get("kind", "")
+                                   ).startswith("flywheel.")],
+        "flywheel_state": fw_state,
+        "telemetry": rec.snapshot(),
+        **obs.artifacts(),
+    }
+    telemetry.atomic_write_json(args.out, artifact)
+    print(json.dumps({k: v for k, v in artifact.items()
+                      if k not in ("telemetry", "rounds",
+                                   "flywheel_events")}),
+          flush=True)
+    print(f"# loadgen: flywheel artifact banked to {args.out}",
+          file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.flywheel_rounds:
+        return _run_flywheel(args)
     kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
     bucket_sizes = tuple(int(b) for b in args.buckets.split(","))
 
@@ -609,6 +859,16 @@ def main(argv=None) -> int:
         stiffness_mix = {"T_range": list(loadgen.STIFFNESS_MIX_T),
                          "phi_range": list(loadgen.STIFFNESS_MIX_PHI),
                          "kinds": ign_kinds}
+    elif args.ood_mix:
+        sur_kinds = [k for k in kinds
+                     if k.startswith(loadgen.SURROGATE_PREFIX)]
+        if not sur_kinds:
+            raise SystemExit("--ood-mix needs a surrogate_* kind in "
+                             "--kinds")
+        samplers = loadgen.default_samplers(
+            mech, [k for k in kinds if k not in sur_kinds])
+        samplers.extend(loadgen.ood_mix_sampler(mech, k)
+                        for k in sur_kinds)
     else:
         samplers = loadgen.default_samplers(mech, kinds)
     obs = _Obs(args)
